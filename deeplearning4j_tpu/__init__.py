@@ -1,0 +1,33 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+Capability-equivalent rebuild of the deeplearning4j stack (reference:
+arthuremanuel/deeplearning4j @ 0.9.2-SNAPSHOT) designed TPU-first on
+JAX/XLA: params are pytrees, gradients come from ``jax.value_and_grad``,
+device parallelism is a sharding annotation over a ``jax.sharding.Mesh``
+(not thread-per-device wrappers), and every hot op compiles onto the MXU
+through XLA.
+
+Package map (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``nd``        tensor substrate shim (dtype policy, RNG streams) —
+                stands in for ND4J/libnd4j.
+- ``common``    activations / losses / updaters / schedules / weight init —
+                ND4J's IActivation / ILossFunction / IUpdater surface.
+- ``nn``        layer configs (config-as-data DSL), functional layer
+                implementations, MultiLayerNetwork & ComputationGraph
+                containers (reference: deeplearning4j-nn).
+- ``optimize``  listeners + training utilities (reference: optimize/).
+- ``eval``      Evaluation / RegressionEvaluation / ROC (reference: eval/).
+- ``datasets``  DataSet, iterators, fetchers (reference: datasets/).
+- ``parallel``  SPMD mesh training — the single engine replacing
+                ParallelWrapper, ParameterAveraging and SharedTraining
+                (reference: deeplearning4j-scaleout).
+- ``zoo``       model zoo (reference: deeplearning4j-zoo).
+- ``nlp``       sequence-vector embedding stack (reference: deeplearning4j-nlp).
+- ``keras``     Keras model import (reference: deeplearning4j-modelimport).
+- ``util``      model serialization & helpers.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nd import dtype as _dtype  # noqa: F401

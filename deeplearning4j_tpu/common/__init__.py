@@ -1,0 +1,26 @@
+"""Common numeric building blocks: activations, losses, updaters,
+learning-rate schedules, weight initializers and distributions.
+
+These correspond to ND4J's `IActivation`, `ILossFunction`, `IUpdater`
+surfaces plus deeplearning4j-nn's `nn/weights` and `nn/conf/distribution`
+packages — re-expressed as serializable configs + pure JAX functions.
+"""
+
+from deeplearning4j_tpu.common.activations import Activation, get_activation
+from deeplearning4j_tpu.common.losses import LossFunction, get_loss
+from deeplearning4j_tpu.common.updaters import (
+    Updater,
+    Sgd,
+    Adam,
+    AdaMax,
+    Nadam,
+    Nesterovs,
+    AdaGrad,
+    AdaDelta,
+    RmsProp,
+    NoOp,
+    updater_from_dict,
+)
+from deeplearning4j_tpu.common.schedules import Schedule, schedule_from_dict
+from deeplearning4j_tpu.common.weights import WeightInit, init_weights
+from deeplearning4j_tpu.common.distributions import Distribution, distribution_from_dict

@@ -1,0 +1,128 @@
+"""Activation functions.
+
+Mirrors ND4J's `IActivation` catalog as consumed by the reference
+(`nn/conf/layers/BaseLayer.java` activation field; enum set in
+nd4j `Activation`): CUBE, ELU, HARDSIGMOID, HARDTANH, IDENTITY,
+LEAKYRELU, RATIONALTANH, RELU, RRELU, SIGMOID, SOFTMAX, SOFTPLUS,
+SOFTSIGN, TANH, RECTIFIEDTANH, SELU, SWISH — plus GELU/RELU6/MISH which
+later model families need.
+
+Each activation is a pure JAX function; names are the serialization
+surface (stored in layer-config JSON).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+ActivationFn = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _identity(x):
+    return x
+
+
+def _cube(x):
+    return x ** 3
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _leakyrelu(x, alpha=0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def _rationaltanh(x):
+    # 1.7159 * tanh(2x/3) approximated rationally (matches nd4j
+    # ActivationRationalTanh semantics: a cheap tanh surrogate).
+    a = jnp.abs(2.0 * x / 3.0)
+    approx = 1.0 - 1.0 / (1.0 + a + a * a + 1.41645 * a ** 4)
+    return 1.7159 * jnp.sign(x) * approx
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+def _swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def _mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def _relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+ACTIVATIONS: dict[str, ActivationFn] = {
+    "identity": _identity,
+    "cube": _cube,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "hardsigmoid": _hardsigmoid,
+    "hardtanh": _hardtanh,
+    "leakyrelu": _leakyrelu,
+    "mish": _mish,
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": _rectifiedtanh,
+    "relu": jax.nn.relu,
+    "relu6": _relu6,
+    "rrelu": _leakyrelu,  # deterministic (test-mode) RReLU == leaky with mean slope
+    "selu": jax.nn.selu,
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": _softmax,
+    "softplus": jax.nn.softplus,
+    "softsign": _softsign,
+    "swish": _swish,
+    "tanh": jnp.tanh,
+}
+
+
+class Activation:
+    """String-keyed activation, serializable into layer-config JSON."""
+
+    def __init__(self, name: str):
+        name = name.lower()
+        if name not in ACTIVATIONS:
+            raise ValueError(f"Unknown activation: {name!r}. Known: {sorted(ACTIVATIONS)}")
+        self.name = name
+        self.fn = ACTIVATIONS[name]
+
+    def __call__(self, x):
+        return self.fn(x)
+
+    def __repr__(self):
+        return f"Activation({self.name})"
+
+    def __eq__(self, other):
+        return isinstance(other, Activation) and other.name == self.name
+
+    def __hash__(self):
+        return hash(("Activation", self.name))
+
+
+def get_activation(act) -> Activation:
+    if isinstance(act, Activation):
+        return act
+    if isinstance(act, str):
+        return Activation(act)
+    raise TypeError(f"Cannot interpret {act!r} as an activation")

@@ -1,0 +1,115 @@
+"""Weight-init distributions.
+
+Mirrors `nn/conf/distribution/` in the reference: Normal/Gaussian,
+Uniform, Binomial, Constant, LogNormal, Orthogonal, TruncatedNormal
+(+ JSON serde).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+class Distribution:
+    name = "base"
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {"distribution": self.name}
+        d.update(dataclasses.asdict(self))
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+
+@dataclasses.dataclass(eq=False)
+class NormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+    name = "normal"
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+
+
+@dataclasses.dataclass(eq=False)
+class UniformDistribution(Distribution):
+    lower: float = -1.0
+    upper: float = 1.0
+    name = "uniform"
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        return jax.random.uniform(rng, shape, dtype, self.lower, self.upper)
+
+
+@dataclasses.dataclass(eq=False)
+class BinomialDistribution(Distribution):
+    trials: int = 1
+    probability: float = 0.5
+    name = "binomial"
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        draws = jax.random.bernoulli(rng, self.probability, (self.trials,) + tuple(shape))
+        return jnp.sum(draws, axis=0).astype(dtype)
+
+
+@dataclasses.dataclass(eq=False)
+class ConstantDistribution(Distribution):
+    value: float = 0.0
+    name = "constant"
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+@dataclasses.dataclass(eq=False)
+class LogNormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+    name = "lognormal"
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        return jnp.exp(self.mean + self.std * jax.random.normal(rng, shape, dtype))
+
+
+@dataclasses.dataclass(eq=False)
+class TruncatedNormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+    name = "truncated_normal"
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        return self.mean + self.std * jax.random.truncated_normal(rng, -2.0, 2.0, shape, dtype)
+
+
+@dataclasses.dataclass(eq=False)
+class OrthogonalDistribution(Distribution):
+    gain: float = 1.0
+    name = "orthogonal"
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        return self.gain * jax.nn.initializers.orthogonal()(rng, shape, dtype)
+
+
+_DISTS = {
+    "normal": NormalDistribution,
+    "gaussian": NormalDistribution,  # reference treats Gaussian == Normal
+    "uniform": UniformDistribution,
+    "binomial": BinomialDistribution,
+    "constant": ConstantDistribution,
+    "lognormal": LogNormalDistribution,
+    "truncated_normal": TruncatedNormalDistribution,
+    "orthogonal": OrthogonalDistribution,
+}
+
+
+def distribution_from_dict(d: dict) -> Distribution:
+    d = dict(d)
+    name = d.pop("distribution")
+    return _DISTS[name](**d)

@@ -1,0 +1,228 @@
+"""Loss functions.
+
+Mirrors ND4J's `ILossFunction` catalog referenced by the output layers
+(`nn/conf/layers/LossLayer.java`, `OutputLayer`): MSE, L1, L2, MAE,
+XENT (binary cross-entropy), MCXENT (multi-class cross-entropy),
+NEGATIVELOGLIKELIHOOD, HINGE, SQUARED_HINGE, KL_DIVERGENCE, POISSON,
+COSINE_PROXIMITY, MSLE, plus weighted variants via `weights`.
+
+Design difference from the reference: DL4J losses implement analytic
+`computeGradient` w.r.t. pre-output; here gradients come from JAX
+autodiff, so a loss only needs a forward `score`. Numerically-fused
+paths (softmax+MCXENT via log-softmax, sigmoid+XENT via logits form)
+are special-cased for stability — the same motivation as DL4J's fused
+`LossMCXENT` + softmax backward shortcut.
+
+Signature: ``score_array(labels, preoutput, activation, mask, weights)``
+returns per-example scores (shape [batch] or [batch, time]); the
+container reduces (sum over output dims, mean over examples — matching
+`BaseOutputLayer.computeScore` semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.activations import Activation, get_activation
+
+_EPS = 1e-7
+
+
+def _apply_activation(preout, activation: Activation):
+    return activation(preout)
+
+
+def _finish(per_elem, mask, weights):
+    """Apply per-output weights + mask; sum over the feature axis."""
+    if weights is not None:
+        per_elem = per_elem * jnp.asarray(weights, per_elem.dtype)
+    score = jnp.sum(per_elem, axis=-1)
+    if mask is not None:
+        score = score * mask
+    return score
+
+
+class LossFunction:
+    name: str = "base"
+
+    def score_array(self, labels, preout, activation: Activation, mask=None, weights=None):
+        raise NotImplementedError
+
+    def __call__(self, labels, preout, activation, mask=None, weights=None):
+        """Mean score over examples (and masked timesteps)."""
+        sa = self.score_array(labels, preout, activation, mask, weights)
+        if mask is not None:
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+            return jnp.sum(sa) / denom
+        return jnp.mean(sa)
+
+    def to_dict(self):
+        return {"loss": self.name}
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class LossMSE(LossFunction):
+    name = "mse"
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        out = _apply_activation(preout, activation)
+        per = (out - labels) ** 2 / labels.shape[-1]
+        return _finish(per, mask, weights)
+
+
+class LossL2(LossFunction):
+    """Sum of squared errors (MSE without the 1/n)."""
+
+    name = "l2"
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        out = _apply_activation(preout, activation)
+        return _finish((out - labels) ** 2, mask, weights)
+
+
+class LossMAE(LossFunction):
+    name = "mae"
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        out = _apply_activation(preout, activation)
+        per = jnp.abs(out - labels) / labels.shape[-1]
+        return _finish(per, mask, weights)
+
+
+class LossL1(LossFunction):
+    name = "l1"
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        out = _apply_activation(preout, activation)
+        return _finish(jnp.abs(out - labels), mask, weights)
+
+
+class LossMSLE(LossFunction):
+    name = "msle"
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        out = _apply_activation(preout, activation)
+        per = (jnp.log1p(jnp.maximum(out, -1 + _EPS)) - jnp.log1p(labels)) ** 2 / labels.shape[-1]
+        return _finish(per, mask, weights)
+
+
+class LossBinaryXENT(LossFunction):
+    """Binary cross-entropy. Fused stable path when activation == sigmoid."""
+
+    name = "xent"
+
+    def __init__(self, clip_eps: float = _EPS):
+        self.clip_eps = clip_eps
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        if activation.name == "sigmoid":
+            # logits form: max(x,0) - x*z + log1p(exp(-|x|))
+            x, z = preout, labels
+            per = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        else:
+            out = _apply_activation(preout, activation)
+            out = jnp.clip(out, self.clip_eps, 1.0 - self.clip_eps)
+            per = -(labels * jnp.log(out) + (1 - labels) * jnp.log(1 - out))
+        return _finish(per, mask, weights)
+
+
+class LossMCXENT(LossFunction):
+    """Multi-class cross-entropy. Fused log-softmax path when activation==softmax."""
+
+    name = "mcxent"
+
+    def __init__(self, soft_label_clip: float = _EPS):
+        self.soft_label_clip = soft_label_clip
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        if activation.name == "softmax":
+            logp = jax.nn.log_softmax(preout, axis=-1)
+            per = -labels * logp
+        else:
+            out = _apply_activation(preout, activation)
+            per = -labels * jnp.log(jnp.clip(out, self.soft_label_clip, 1.0))
+        return _finish(per, mask, weights)
+
+
+class LossNegativeLogLikelihood(LossMCXENT):
+    """Alias of MCXENT in the reference (LossNegativeLogLikelihood extends LossMCXENT)."""
+
+    name = "negativeloglikelihood"
+
+
+class LossHinge(LossFunction):
+    name = "hinge"
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        out = _apply_activation(preout, activation)
+        y = 2.0 * labels - 1.0  # {0,1} -> {-1,1}
+        return _finish(jnp.maximum(0.0, 1.0 - y * out), mask, weights)
+
+
+class LossSquaredHinge(LossFunction):
+    name = "squaredhinge"
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        out = _apply_activation(preout, activation)
+        y = 2.0 * labels - 1.0
+        return _finish(jnp.maximum(0.0, 1.0 - y * out) ** 2, mask, weights)
+
+
+class LossKLD(LossFunction):
+    name = "kl_divergence"
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        out = _apply_activation(preout, activation)
+        out = jnp.clip(out, _EPS, 1.0)
+        lab = jnp.clip(labels, _EPS, 1.0)
+        return _finish(lab * (jnp.log(lab) - jnp.log(out)), mask, weights)
+
+
+class LossPoisson(LossFunction):
+    name = "poisson"
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        out = _apply_activation(preout, activation)
+        return _finish(out - labels * jnp.log(jnp.maximum(out, _EPS)), mask, weights)
+
+
+class LossCosineProximity(LossFunction):
+    name = "cosine_proximity"
+
+    def score_array(self, labels, preout, activation, mask=None, weights=None):
+        out = _apply_activation(preout, activation)
+        ln = jnp.linalg.norm(labels, axis=-1, keepdims=True)
+        on = jnp.linalg.norm(out, axis=-1, keepdims=True)
+        cos = jnp.sum(labels * out, axis=-1, keepdims=True) / jnp.maximum(ln * on, _EPS)
+        return _finish(-cos, mask, weights)
+
+
+_LOSSES = {
+    cls().name if cls not in (LossBinaryXENT, LossMCXENT, LossNegativeLogLikelihood) else cls.name: cls
+    for cls in [
+        LossMSE, LossL2, LossMAE, LossL1, LossMSLE, LossBinaryXENT, LossMCXENT,
+        LossNegativeLogLikelihood, LossHinge, LossSquaredHinge, LossKLD,
+        LossPoisson, LossCosineProximity,
+    ]
+}
+
+
+def get_loss(loss) -> LossFunction:
+    if isinstance(loss, LossFunction):
+        return loss
+    if isinstance(loss, str):
+        key = loss.lower()
+        if key not in _LOSSES:
+            raise ValueError(f"Unknown loss {loss!r}. Known: {sorted(_LOSSES)}")
+        return _LOSSES[key]()
+    raise TypeError(f"Cannot interpret {loss!r} as a loss function")
+
+
+def loss_from_dict(d: dict) -> LossFunction:
+    return get_loss(d["loss"])

@@ -1,0 +1,173 @@
+"""Learning-rate (and generally value) schedules.
+
+Mirrors the reference's `LearningRatePolicy` / nd4j `ISchedule` family
+(Fixed, Exponential, Inverse, Poly, Sigmoid, Step, Schedule-map —
+consumed in `BaseOptimizer`/updater `preApply` paths), plus warmup and
+cosine schedules that modern TPU training recipes expect.
+
+All schedules are pure functions of the iteration counter so they can be
+traced inside a jitted train step (the counter is a traced scalar).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax.numpy as jnp
+
+
+class Schedule:
+    name = "base"
+
+    def value_at(self, step):
+        raise NotImplementedError
+
+    def __call__(self, step):
+        return self.value_at(step)
+
+    def to_dict(self):
+        d = {"schedule": self.name}
+        d.update(dataclasses.asdict(self))
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+
+@dataclasses.dataclass(eq=False)
+class FixedSchedule(Schedule):
+    value: float
+    name = "fixed"
+
+    def value_at(self, step):
+        return self.value
+
+
+@dataclasses.dataclass(eq=False)
+class ExponentialSchedule(Schedule):
+    initial_value: float
+    gamma: float
+    name = "exponential"
+
+    def value_at(self, step):
+        return self.initial_value * self.gamma ** jnp.asarray(step, jnp.float32)
+
+
+@dataclasses.dataclass(eq=False)
+class InverseSchedule(Schedule):
+    initial_value: float
+    gamma: float
+    power: float
+    name = "inverse"
+
+    def value_at(self, step):
+        return self.initial_value / (1.0 + self.gamma * jnp.asarray(step, jnp.float32)) ** self.power
+
+
+@dataclasses.dataclass(eq=False)
+class PolySchedule(Schedule):
+    initial_value: float
+    power: float
+    max_iter: int
+    name = "poly"
+
+    def value_at(self, step):
+        frac = jnp.clip(jnp.asarray(step, jnp.float32) / self.max_iter, 0.0, 1.0)
+        return self.initial_value * (1.0 - frac) ** self.power
+
+
+@dataclasses.dataclass(eq=False)
+class SigmoidSchedule(Schedule):
+    initial_value: float
+    gamma: float
+    step_size: int
+    name = "sigmoid"
+
+    def value_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (s - self.step_size)))
+
+
+@dataclasses.dataclass(eq=False)
+class StepSchedule(Schedule):
+    initial_value: float
+    decay_rate: float
+    step_size: int
+    name = "step"
+
+    def value_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        return self.initial_value * self.decay_rate ** jnp.floor(s / self.step_size)
+
+
+@dataclasses.dataclass(eq=False)
+class MapSchedule(Schedule):
+    """Piecewise-constant schedule keyed by iteration, like nd4j MapSchedule.
+
+    Implemented branchlessly so it traces under jit.
+    """
+
+    values: Dict[int, float]
+    name = "map"
+
+    def value_at(self, step):
+        keys = sorted(self.values)
+        s = jnp.asarray(step, jnp.int32)
+        out = jnp.asarray(self.values[keys[0]], jnp.float32)
+        for k in keys[1:]:
+            out = jnp.where(s >= k, self.values[k], out)
+        return out
+
+    def to_dict(self):
+        return {"schedule": self.name, "values": {str(k): v for k, v in self.values.items()}}
+
+
+@dataclasses.dataclass(eq=False)
+class WarmupCosineSchedule(Schedule):
+    """Linear warmup then cosine decay — the standard TPU LR recipe."""
+
+    peak_value: float
+    warmup_steps: int
+    total_steps: int
+    end_value: float = 0.0
+    name = "warmup_cosine"
+
+    def value_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = self.peak_value * s / jnp.maximum(self.warmup_steps, 1)
+        frac = jnp.clip(
+            (s - self.warmup_steps) / jnp.maximum(self.total_steps - self.warmup_steps, 1),
+            0.0, 1.0,
+        )
+        cos = self.end_value + 0.5 * (self.peak_value - self.end_value) * (1 + jnp.cos(jnp.pi * frac))
+        return jnp.where(s < self.warmup_steps, warm, cos)
+
+
+_SCHEDULES = {
+    "fixed": FixedSchedule,
+    "exponential": ExponentialSchedule,
+    "inverse": InverseSchedule,
+    "poly": PolySchedule,
+    "sigmoid": SigmoidSchedule,
+    "step": StepSchedule,
+    "map": MapSchedule,
+    "warmup_cosine": WarmupCosineSchedule,
+}
+
+
+def schedule_from_dict(d) -> Schedule:
+    if isinstance(d, (int, float)):
+        return FixedSchedule(float(d))
+    d = dict(d)
+    name = d.pop("schedule")
+    cls = _SCHEDULES[name]
+    if cls is MapSchedule:
+        return MapSchedule({int(k): float(v) for k, v in d["values"].items()})
+    return cls(**d)
+
+
+def as_schedule(value) -> Schedule:
+    if isinstance(value, Schedule):
+        return value
+    return FixedSchedule(float(value))

@@ -1,0 +1,244 @@
+"""Updaters (optimizer update rules).
+
+Mirrors ND4J's `IUpdater` family as configured per layer in the
+reference (`nn/conf/layers/BaseLayer.java:52-53` holds an IUpdater;
+`nn/updater/BaseMultiLayerUpdater.java` partitions the flat gradient
+into blocks sharing updater state): Sgd, Adam, AdaMax, Nadam, Nesterovs,
+AdaGrad, AdaDelta, RmsProp, NoOp.
+
+TPU-first design: each updater is a pure (grad, state, step) → (update,
+state) transform over a *single tensor*; the container maps it across
+the param pytree (jax.tree_util), so the whole optimizer step fuses into
+the jitted train step. Updater state is a dict of arrays shaped like the
+param — flattening it for checkpoints reproduces the reference's
+"updater state is one flat vector" invariant
+(`util/ModelSerializer.java:79-120`).
+
+Learning rates may be scalars or `Schedule`s of the iteration counter.
+Defaults follow the nd4j learning configs (Adam 1e-3/0.9/0.999/1e-8,
+Nesterovs 0.1/0.9, AdaGrad 0.1/1e-6, RmsProp 0.1/0.95/1e-8,
+AdaDelta rho 0.95/1e-6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.schedules import Schedule, as_schedule, schedule_from_dict
+
+
+def _lr(lr, step):
+    if isinstance(lr, Schedule):
+        return lr.value_at(step)
+    return lr
+
+
+class Updater:
+    """Base updater config. Subclasses are dataclasses (serializable)."""
+
+    name = "base"
+
+    def init_state(self, param) -> Dict[str, Any]:
+        return {}
+
+    def apply(self, grad, state, step):
+        """Return (update_to_subtract, new_state)."""
+        raise NotImplementedError
+
+    def with_lr(self, lr):
+        """Copy of this updater with a replaced learning rate (used by
+        transfer-learning fine-tune overrides)."""
+        if hasattr(self, "learning_rate"):
+            return dataclasses.replace(self, learning_rate=lr)
+        return self
+
+    def to_dict(self):
+        d = {"updater": self.name}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, Schedule):
+                v = v.to_dict()
+            d[f.name] = v
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+
+@dataclasses.dataclass(eq=False)
+class Sgd(Updater):
+    learning_rate: Any = 1e-3
+    name = "sgd"
+
+    def apply(self, grad, state, step):
+        return _lr(self.learning_rate, step) * grad, state
+
+
+@dataclasses.dataclass(eq=False)
+class NoOp(Updater):
+    name = "noop"
+
+    def apply(self, grad, state, step):
+        return jnp.zeros_like(grad), state
+
+
+@dataclasses.dataclass(eq=False)
+class Adam(Updater):
+    learning_rate: Any = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    name = "adam"
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, step):
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        upd = _lr(self.learning_rate, step) * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return upd, {"m": m, "v": v}
+
+
+@dataclasses.dataclass(eq=False)
+class AdaMax(Updater):
+    learning_rate: Any = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    name = "adamax"
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, step):
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        u = jnp.maximum(self.beta2 * state["u"], jnp.abs(grad))
+        upd = _lr(self.learning_rate, step) / (1 - self.beta1 ** t) * m / (u + self.epsilon)
+        return upd, {"m": m, "u": u}
+
+
+@dataclasses.dataclass(eq=False)
+class Nadam(Updater):
+    learning_rate: Any = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    name = "nadam"
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, step):
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        nesterov_m = self.beta1 * mhat + (1 - self.beta1) * grad / (1 - self.beta1 ** t)
+        upd = _lr(self.learning_rate, step) * nesterov_m / (jnp.sqrt(vhat) + self.epsilon)
+        return upd, {"m": m, "v": v}
+
+
+@dataclasses.dataclass(eq=False)
+class Nesterovs(Updater):
+    learning_rate: Any = 0.1
+    momentum: float = 0.9
+    name = "nesterovs"
+
+    def init_state(self, param):
+        return {"v": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, step):
+        # Matches nd4j NesterovsUpdater: vPrev = v; v = mu*v - lr*g;
+        # update = -(mu*vPrev - (1+mu)*v)  (applied as param -= update)
+        lr = _lr(self.learning_rate, step)
+        v_prev = state["v"]
+        v = self.momentum * v_prev - lr * grad
+        upd = -(self.momentum * v_prev - (1 + self.momentum) * v)
+        return -upd, {"v": v}
+
+
+@dataclasses.dataclass(eq=False)
+class AdaGrad(Updater):
+    learning_rate: Any = 0.1
+    epsilon: float = 1e-6
+    name = "adagrad"
+
+    def init_state(self, param):
+        return {"h": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, step):
+        h = state["h"] + grad * grad
+        upd = _lr(self.learning_rate, step) * grad / (jnp.sqrt(h) + self.epsilon)
+        return upd, {"h": h}
+
+
+@dataclasses.dataclass(eq=False)
+class AdaDelta(Updater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+    name = "adadelta"
+
+    def init_state(self, param):
+        return {"msg": jnp.zeros_like(param), "msdx": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, step):
+        msg = self.rho * state["msg"] + (1 - self.rho) * grad * grad
+        dx = jnp.sqrt(state["msdx"] + self.epsilon) / jnp.sqrt(msg + self.epsilon) * grad
+        msdx = self.rho * state["msdx"] + (1 - self.rho) * dx * dx
+        return dx, {"msg": msg, "msdx": msdx}
+
+
+@dataclasses.dataclass(eq=False)
+class RmsProp(Updater):
+    learning_rate: Any = 0.1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+    name = "rmsprop"
+
+    def init_state(self, param):
+        return {"g2": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, step):
+        g2 = self.rms_decay * state["g2"] + (1 - self.rms_decay) * grad * grad
+        upd = _lr(self.learning_rate, step) * grad / (jnp.sqrt(g2 + self.epsilon))
+        return upd, {"g2": g2}
+
+
+_UPDATERS = {
+    "sgd": Sgd, "noop": NoOp, "adam": Adam, "adamax": AdaMax, "nadam": Nadam,
+    "nesterovs": Nesterovs, "adagrad": AdaGrad, "adadelta": AdaDelta, "rmsprop": RmsProp,
+}
+
+
+def get_updater(u) -> Updater:
+    if isinstance(u, Updater):
+        return u
+    if isinstance(u, str):
+        key = u.lower()
+        if key not in _UPDATERS:
+            raise ValueError(f"Unknown updater {u!r}. Known: {sorted(_UPDATERS)}")
+        return _UPDATERS[key]()
+    raise TypeError(f"Cannot interpret {u!r} as an updater")
+
+
+def updater_from_dict(d: dict) -> Updater:
+    d = dict(d)
+    name = d.pop("updater")
+    cls = _UPDATERS[name]
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in d:
+            v = d[f.name]
+            if f.name == "learning_rate" and isinstance(v, dict):
+                v = schedule_from_dict(v)
+            kwargs[f.name] = v
+    return cls(**kwargs)

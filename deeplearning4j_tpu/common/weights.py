@@ -1,0 +1,113 @@
+"""Weight initialization schemes.
+
+Mirrors `nn/weights/WeightInit.java` + `WeightInitUtil.java` in the
+reference (21 schemes): XAVIER family, RELU family, LECUN, SIGMOID_UNIFORM,
+UNIFORM, VAR_SCALING family, ZERO, ONES, IDENTITY, DISTRIBUTION.
+
+`fan_in`/`fan_out` follow the reference convention: for a dense [nIn,
+nOut] kernel fan_in=nIn, fan_out=nOut; for conv kernels fan_in =
+in_channels * prod(kernel), fan_out = out_channels * prod(kernel)
+(WeightInitUtil computes these from the param shape the same way).
+"""
+
+from __future__ import annotations
+
+import math
+from enum import Enum
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.distributions import Distribution
+
+
+class WeightInit(str, Enum):
+    ZERO = "zero"
+    ONES = "ones"
+    IDENTITY = "identity"
+    DISTRIBUTION = "distribution"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    UNIFORM = "uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    NORMAL = "normal"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    XAVIER_LEGACY = "xavier_legacy"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    SELU = "selu"  # == lecun normal, kept for config parity
+    VAR_SCALING_NORMAL_FAN_IN = "var_scaling_normal_fan_in"
+    VAR_SCALING_NORMAL_FAN_OUT = "var_scaling_normal_fan_out"
+    VAR_SCALING_NORMAL_FAN_AVG = "var_scaling_normal_fan_avg"
+    VAR_SCALING_UNIFORM_FAN_IN = "var_scaling_uniform_fan_in"
+    VAR_SCALING_UNIFORM_FAN_OUT = "var_scaling_uniform_fan_out"
+    VAR_SCALING_UNIFORM_FAN_AVG = "var_scaling_uniform_fan_avg"
+
+
+def init_weights(
+    rng,
+    shape,
+    weight_init: WeightInit | str,
+    fan_in: float,
+    fan_out: float,
+    distribution: Distribution | None = None,
+    dtype=jnp.float32,
+):
+    wi = WeightInit(weight_init) if not isinstance(weight_init, WeightInit) else weight_init
+    shape = tuple(shape)
+
+    def normal(std):
+        return std * jax.random.normal(rng, shape, dtype)
+
+    def uniform(a):
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+
+    if wi == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if wi == WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if wi == WeightInit.IDENTITY:
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2d shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if wi == WeightInit.DISTRIBUTION:
+        if distribution is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a distribution")
+        return distribution.sample(rng, shape, dtype)
+    if wi == WeightInit.SIGMOID_UNIFORM:
+        return uniform(4.0 * math.sqrt(6.0 / (fan_in + fan_out)))
+    if wi == WeightInit.UNIFORM:
+        return uniform(1.0 / math.sqrt(fan_in))
+    if wi in (WeightInit.LECUN_NORMAL, WeightInit.SELU):
+        return normal(math.sqrt(1.0 / fan_in))
+    if wi == WeightInit.LECUN_UNIFORM:
+        return uniform(math.sqrt(3.0 / fan_in))
+    if wi == WeightInit.NORMAL:
+        return normal(math.sqrt(1.0 / fan_in))
+    if wi == WeightInit.XAVIER:
+        return normal(math.sqrt(2.0 / (fan_in + fan_out)))
+    if wi == WeightInit.XAVIER_UNIFORM:
+        return uniform(math.sqrt(6.0 / (fan_in + fan_out)))
+    if wi == WeightInit.XAVIER_FAN_IN:
+        return normal(math.sqrt(1.0 / fan_in))
+    if wi == WeightInit.XAVIER_LEGACY:
+        return normal(math.sqrt(1.0 / (fan_in + fan_out)))
+    if wi == WeightInit.RELU:
+        return normal(math.sqrt(2.0 / fan_in))
+    if wi == WeightInit.RELU_UNIFORM:
+        return uniform(math.sqrt(6.0 / fan_in))
+    if wi == WeightInit.VAR_SCALING_NORMAL_FAN_IN:
+        return normal(math.sqrt(1.0 / fan_in))
+    if wi == WeightInit.VAR_SCALING_NORMAL_FAN_OUT:
+        return normal(math.sqrt(1.0 / fan_out))
+    if wi == WeightInit.VAR_SCALING_NORMAL_FAN_AVG:
+        return normal(math.sqrt(2.0 / (fan_in + fan_out)))
+    if wi == WeightInit.VAR_SCALING_UNIFORM_FAN_IN:
+        return uniform(math.sqrt(3.0 / fan_in))
+    if wi == WeightInit.VAR_SCALING_UNIFORM_FAN_OUT:
+        return uniform(math.sqrt(3.0 / fan_out))
+    if wi == WeightInit.VAR_SCALING_UNIFORM_FAN_AVG:
+        return uniform(math.sqrt(6.0 / (fan_in + fan_out)))
+    raise ValueError(f"Unhandled weight init {wi}")

@@ -1,0 +1,22 @@
+"""Data pipeline: DataSet container, iterator protocol, fetchers,
+async prefetch.
+
+Reference: ND4J `DataSet`/`DataSetIterator` + deeplearning4j `datasets/`
+(AsyncDataSetIterator, wrappers, fetchers).
+"""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    DataSetIterator,
+    ListDataSetIterator,
+    ArrayDataSetIterator,
+    AsyncDataSetIterator,
+    MultipleEpochsIterator,
+    EarlyTerminationDataSetIterator,
+    SamplingDataSetIterator,
+    BenchmarkDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.fetchers import (
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+)

@@ -1,0 +1,76 @@
+"""DataSet: one minibatch of features + labels (+ masks).
+
+Reference: ND4J `org.nd4j.linalg.dataset.DataSet` (features, labels,
+featuresMask, labelsMask) — the currency every iterator yields and
+`fit()` consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: Optional[np.ndarray] = None
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(np.shape(self.features)[0])
+
+    def split_test_and_train(self, num_train: int):
+        train = DataSet(
+            self.features[:num_train],
+            None if self.labels is None else self.labels[:num_train],
+            None if self.features_mask is None else self.features_mask[:num_train],
+            None if self.labels_mask is None else self.labels_mask[:num_train],
+        )
+        test = DataSet(
+            self.features[num_train:],
+            None if self.labels is None else self.labels[num_train:],
+            None if self.features_mask is None else self.features_mask[num_train:],
+            None if self.labels_mask is None else self.labels_mask[num_train:],
+        )
+        return train, test
+
+    def shuffle(self, seed: Optional[int] = None):
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self.num_examples())
+        self.features = self.features[perm]
+        if self.labels is not None:
+            self.labels = self.labels[perm]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[perm]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[perm]
+        return self
+
+    def batch_by(self, batch_size: int):
+        n = self.num_examples()
+        out = []
+        for i in range(0, n, batch_size):
+            out.append(DataSet(
+                self.features[i:i + batch_size],
+                None if self.labels is None else self.labels[i:i + batch_size],
+                None if self.features_mask is None else self.features_mask[i:i + batch_size],
+                None if self.labels_mask is None else self.labels_mask[i:i + batch_size],
+            ))
+        return out
+
+    @staticmethod
+    def merge(datasets):
+        def cat(xs):
+            if any(x is None for x in xs):
+                return None
+            return np.concatenate(xs, axis=0)
+        return DataSet(
+            cat([d.features for d in datasets]),
+            cat([d.labels for d in datasets]),
+            cat([d.features_mask for d in datasets]),
+            cat([d.labels_mask for d in datasets]),
+        )

@@ -1,0 +1,234 @@
+"""DataSetIterator protocol + framework-level wrappers.
+
+Reference: ND4J `DataSetIterator` + deeplearning4j `datasets/iterator/`
+(AsyncDataSetIterator with background prefetch, MultipleEpochsIterator,
+EarlyTerminationDataSetIterator, SamplingDataSetIterator,
+BenchmarkDataSetIterator, ExistingDataSetIterator…).
+
+The protocol is a resettable Python iterable of `DataSet`s; `fit()`
+accepts any of these. `AsyncDataSetIterator` reproduces the reference's
+ETL/compute overlap (background prefetch thread feeding a bounded
+queue, `datasets/iterator/AsyncDataSetIterator.java`) — on TPU this
+overlaps host-side batch assembly with device steps.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base: iterable of DataSet minibatches with reset()."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def batch_size(self) -> Optional[int]:
+        return None
+
+    def total_outcomes(self) -> Optional[int]:
+        return None
+
+    def input_columns(self) -> Optional[int]:
+        return None
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a pre-built list of DataSets (reference
+    `ListDataSetIterator.java`)."""
+
+    def __init__(self, datasets: List[DataSet], batch_size: Optional[int] = None):
+        if batch_size is not None:
+            merged = DataSet.merge(datasets)
+            datasets = merged.batch_by(batch_size)
+        self._datasets = datasets
+        self._batch = batch_size
+
+    def __iter__(self):
+        return iter(self._datasets)
+
+    def batch_size(self):
+        return self._batch
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Minibatches over (features, labels) arrays, optional shuffle each
+    epoch."""
+
+    def __init__(self, features, labels=None, batch_size: int = 32,
+                 shuffle: bool = False, seed: int = 123,
+                 features_mask=None, labels_mask=None, drop_last: bool = False):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+        self._batch = batch_size
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._drop_last = drop_last
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        idx = np.arange(n)
+        if self._shuffle:
+            self._rng.shuffle(idx)
+        stop = n - (n % self._batch) if self._drop_last else n
+        for i in range(0, stop, self._batch):
+            sel = idx[i:i + self._batch]
+            if self._drop_last and len(sel) < self._batch:
+                break
+            yield DataSet(
+                self.features[sel],
+                None if self.labels is None else self.labels[sel],
+                None if self.features_mask is None else self.features_mask[sel],
+                None if self.labels_mask is None else self.labels_mask[sel],
+            )
+
+    def batch_size(self):
+        return self._batch
+
+    def total_outcomes(self):
+        return None if self.labels is None else self.labels.shape[-1]
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper (reference
+    `AsyncDataSetIterator.java`: bounded queue + worker thread so ETL
+    overlaps device compute)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, prefetch: int = 4):
+        self.base = base
+        self.prefetch = prefetch
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        err: list = []
+
+        def worker():
+            try:
+                for ds in self.base:
+                    q.put(ds)
+            except BaseException as e:  # propagate into consumer
+                err.append(e)
+            finally:
+                q.put(self._SENTINEL)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays the base iterator N times (reference
+    `MultipleEpochsIterator.java`)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self.epochs = epochs
+        self.base = base
+
+    def __iter__(self):
+        for _ in range(self.epochs):
+            self.base.reset()
+            yield from self.base
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Caps the number of minibatches (reference
+    `EarlyTerminationDataSetIterator.java`)."""
+
+    def __init__(self, base: DataSetIterator, max_batches: int):
+        self.base = base
+        self.max_batches = max_batches
+
+    def __iter__(self):
+        for i, ds in enumerate(self.base):
+            if i >= self.max_batches:
+                return
+            yield ds
+
+    def reset(self):
+        self.base.reset()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Samples random minibatches with replacement from one DataSet
+    (reference `SamplingDataSetIterator.java`)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int, total_batches: int, seed: int = 123):
+        self.dataset = dataset
+        self._batch = batch_size
+        self.total_batches = total_batches
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        n = self.dataset.num_examples()
+        for _ in range(self.total_batches):
+            sel = self._rng.integers(0, n, size=self._batch)
+            d = self.dataset
+            yield DataSet(
+                d.features[sel],
+                None if d.labels is None else d.labels[sel],
+                None if d.features_mask is None else d.features_mask[sel],
+                None if d.labels_mask is None else d.labels_mask[sel],
+            )
+
+    def batch_size(self):
+        return self._batch
+
+
+class BenchmarkDataSetIterator(DataSetIterator):
+    """Synthetic fixed-shape batches to isolate compute from ETL
+    (reference `BenchmarkDataSetIterator.java`)."""
+
+    def __init__(self, feature_shape, num_classes: int, total_batches: int, seed: int = 42,
+                 label_shape=None):
+        rng = np.random.default_rng(seed)
+        self.features = rng.standard_normal(feature_shape).astype(np.float32)
+        batch = feature_shape[0]
+        if label_shape is not None:
+            self.labels = rng.standard_normal(label_shape).astype(np.float32)
+        else:
+            idx = rng.integers(0, num_classes, size=batch)
+            self.labels = np.eye(num_classes, dtype=np.float32)[idx]
+        self.total_batches = total_batches
+
+    def __iter__(self):
+        for _ in range(self.total_batches):
+            yield DataSet(self.features, self.labels)
+
+    def batch_size(self):
+        return self.features.shape[0]
+
+
+def as_iterator(data, labels=None, batch_size: int = 32, **kw) -> DataSetIterator:
+    """Coerce fit()-style inputs into a DataSetIterator."""
+    if isinstance(data, DataSetIterator):
+        return data
+    if isinstance(data, DataSet):
+        return ListDataSetIterator(data.batch_by(batch_size))
+    if isinstance(data, (list, tuple)) and data and isinstance(data[0], DataSet):
+        return ListDataSetIterator(list(data))
+    return ArrayDataSetIterator(data, labels, batch_size=batch_size, **kw)

@@ -1,0 +1,11 @@
+"""Evaluation suite (reference: `deeplearning4j-nn/.../eval/`):
+Evaluation (classification + confusion matrix), RegressionEvaluation,
+ROC / ROCBinary / ROCMultiClass, EvaluationBinary,
+EvaluationCalibration.
+"""
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass
+from deeplearning4j_tpu.eval.binary import EvaluationBinary
+from deeplearning4j_tpu.eval.calibration import EvaluationCalibration

@@ -1,0 +1,64 @@
+"""Calibration evaluation: reliability diagram + histograms.
+
+Reference: `eval/EvaluationCalibration.java`: bins predicted
+probabilities, tracks observed positive fraction per bin (reliability
+diagram data), residual plot + probability histogram.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class EvaluationCalibration:
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 10):
+        self.reliability_bins = reliability_bins
+        self.histogram_bins = histogram_bins
+        self._bin_counts = None
+        self._bin_pos = None
+        self._bin_prob_sum = None
+        self._prob_hist = None
+
+    def _ensure(self, c):
+        if self._bin_counts is None:
+            self._bin_counts = np.zeros((c, self.reliability_bins), dtype=np.int64)
+            self._bin_pos = np.zeros((c, self.reliability_bins), dtype=np.int64)
+            self._bin_prob_sum = np.zeros((c, self.reliability_bins), dtype=np.float64)
+            self._prob_hist = np.zeros((c, self.histogram_bins), dtype=np.int64)
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            c = labels.shape[-1]
+            labels = labels.reshape(-1, c)
+            predictions = predictions.reshape(-1, c)
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+                labels, predictions = labels[m], predictions[m]
+        self._ensure(labels.shape[-1])
+        bins = np.clip((predictions * self.reliability_bins).astype(int), 0,
+                       self.reliability_bins - 1)
+        hbins = np.clip((predictions * self.histogram_bins).astype(int), 0,
+                        self.histogram_bins - 1)
+        for c in range(labels.shape[-1]):
+            np.add.at(self._bin_counts[c], bins[:, c], 1)
+            np.add.at(self._bin_pos[c], bins[:, c], labels[:, c] >= 0.5)
+            np.add.at(self._bin_prob_sum[c], bins[:, c], predictions[:, c])
+            np.add.at(self._prob_hist[c], hbins[:, c], 1)
+
+    def reliability_diagram(self, cls: int):
+        """Returns (mean_predicted_prob, observed_fraction) per bin."""
+        counts = np.maximum(self._bin_counts[cls], 1)
+        return (self._bin_prob_sum[cls] / counts, self._bin_pos[cls] / counts)
+
+    def expected_calibration_error(self, cls: int) -> float:
+        counts = self._bin_counts[cls]
+        total = counts.sum()
+        if not total:
+            return 0.0
+        mean_p, obs = self.reliability_diagram(cls)
+        return float(np.sum(counts / total * np.abs(mean_p - obs)))
+
+    def probability_histogram(self, cls: int):
+        return self._prob_hist[cls].copy()

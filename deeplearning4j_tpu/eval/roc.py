@@ -1,0 +1,135 @@
+"""ROC / AUC evaluation.
+
+Reference: `eval/ROC.java` (exact mode when thresholdSteps==0, else
+thresholded), `ROCBinary.java` (per-output binary), `ROCMultiClass.java`
+(one-vs-all per class). AUROC via trapezoidal rule on the exact curve;
+AUPRC likewise.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def _binary_roc_points(labels: np.ndarray, probs: np.ndarray):
+    order = np.argsort(-probs, kind="stable")
+    labels = labels[order]
+    tp = np.cumsum(labels)
+    fp = np.cumsum(1 - labels)
+    total_pos = tp[-1] if len(tp) else 0
+    total_neg = fp[-1] if len(fp) else 0
+    tpr = tp / total_pos if total_pos else np.zeros_like(tp, dtype=np.float64)
+    fpr = fp / total_neg if total_neg else np.zeros_like(fp, dtype=np.float64)
+    return np.concatenate([[0.0], fpr]), np.concatenate([[0.0], tpr])
+
+
+def _auc(x, y):
+    return float(np.trapezoid(y, x))
+
+
+class ROC:
+    """Binary ROC. Accumulates raw (label, score) pairs → exact curve
+    (reference exact mode, thresholdSteps=0)."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = threshold_steps
+        self._labels: List[np.ndarray] = []
+        self._probs: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            c = labels.shape[-1]
+            labels = labels.reshape(-1, c)
+            predictions = predictions.reshape(-1, c)
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+                labels, predictions = labels[m], predictions[m]
+        if labels.ndim == 2 and labels.shape[-1] == 2:
+            # [P(class0), P(class1)] convention: positive = column 1
+            labels = labels[:, 1]
+            predictions = predictions[:, 1]
+        else:
+            labels = labels.reshape(-1)
+            predictions = predictions.reshape(-1)
+        self._labels.append(labels.astype(np.float64))
+        self._probs.append(predictions.astype(np.float64))
+
+    def _collect(self):
+        return np.concatenate(self._labels), np.concatenate(self._probs)
+
+    def calculate_auc(self) -> float:
+        labels, probs = self._collect()
+        fpr, tpr = _binary_roc_points(labels, probs)
+        return _auc(fpr, tpr)
+
+    def calculate_auprc(self) -> float:
+        labels, probs = self._collect()
+        order = np.argsort(-probs, kind="stable")
+        labels = labels[order]
+        tp = np.cumsum(labels)
+        k = np.arange(1, len(labels) + 1)
+        precision = tp / k
+        recall = tp / tp[-1] if tp[-1] else np.zeros_like(tp, dtype=np.float64)
+        return _auc(np.concatenate([[0.0], recall]), np.concatenate([[1.0], precision]))
+
+    def get_roc_curve(self):
+        labels, probs = self._collect()
+        return _binary_roc_points(labels, probs)
+
+
+class ROCBinary:
+    """Independent binary ROC per output column (reference
+    `ROCBinary.java` for multi-label sigmoid outputs)."""
+
+    def __init__(self):
+        self._rocs: Optional[List[ROC]] = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            c = labels.shape[-1]
+            labels = labels.reshape(-1, c)
+            predictions = predictions.reshape(-1, c)
+        if self._rocs is None:
+            self._rocs = [ROC() for _ in range(labels.shape[-1])]
+        for i, roc in enumerate(self._rocs):
+            roc.eval(labels[:, i], predictions[:, i])
+
+    def calculate_auc(self, col: int) -> float:
+        return self._rocs[col].calculate_auc()
+
+    def num_labels(self):
+        return 0 if self._rocs is None else len(self._rocs)
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (reference `ROCMultiClass.java`)."""
+
+    def __init__(self):
+        self._rocs: Optional[List[ROC]] = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            c = labels.shape[-1]
+            labels = labels.reshape(-1, c)
+            predictions = predictions.reshape(-1, c)
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+                labels, predictions = labels[m], predictions[m]
+        if self._rocs is None:
+            self._rocs = [ROC() for _ in range(labels.shape[-1])]
+        for i, roc in enumerate(self._rocs):
+            roc.eval(labels[:, i], predictions[:, i])
+
+    def calculate_auc(self, cls: int) -> float:
+        return self._rocs[cls].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs]))

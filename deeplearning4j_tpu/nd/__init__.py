@@ -1,0 +1,15 @@
+"""Tensor substrate shim — the ND4J-equivalent layer.
+
+The reference framework bottoms out in ND4J (`INDArray`, `Nd4j.create`,
+workspaces, JNI → libnd4j C++ kernels). Here the substrate is jax.numpy +
+XLA; this package only pins the few semantics the framework layers rely
+on: dtype policy, RNG key streams, and device placement helpers.
+"""
+
+from deeplearning4j_tpu.nd.dtype import (
+    DataTypePolicy,
+    default_policy,
+    set_default_dtype,
+    get_default_dtype,
+)
+from deeplearning4j_tpu.nd.random import RngStream

@@ -1,0 +1,67 @@
+"""Dtype policy for the framework.
+
+Reference behavior: ND4J has a global data-type setting
+(`Nd4j.setDataType`, consumed throughout deeplearning4j-nn). On TPU the
+useful policy is finer-grained: parameters and updater state in float32,
+matmul/conv compute optionally in bfloat16 (MXU-native), reductions in
+float32. `DataTypePolicy` captures that split.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DataTypePolicy:
+    """Param / compute / output dtype split.
+
+    param_dtype:   dtype parameters are stored in (and updater state).
+    compute_dtype: dtype activations are computed in. bfloat16 feeds the
+                   MXU at full rate on TPU; float32 is the safe default.
+    output_dtype:  dtype of network outputs / losses (always float32 by
+                   default so eval numerics are stable).
+    """
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+
+    def cast_compute(self, x):
+        if x.dtype != self.compute_dtype and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(self.compute_dtype)
+        return x
+
+    def cast_output(self, x):
+        if x.dtype != self.output_dtype and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(self.output_dtype)
+        return x
+
+
+_DEFAULT = DataTypePolicy()
+
+
+def default_policy() -> DataTypePolicy:
+    return _DEFAULT
+
+
+def set_default_dtype(param_dtype=None, compute_dtype=None, output_dtype=None):
+    """Global policy override, mirroring `Nd4j.setDataType`."""
+    global _DEFAULT
+    _DEFAULT = DataTypePolicy(
+        param_dtype=param_dtype or _DEFAULT.param_dtype,
+        compute_dtype=compute_dtype or _DEFAULT.compute_dtype,
+        output_dtype=output_dtype or _DEFAULT.output_dtype,
+    )
+    return _DEFAULT
+
+
+def get_default_dtype():
+    return _DEFAULT.param_dtype
+
+
+def bf16_policy() -> DataTypePolicy:
+    """float32 params, bfloat16 compute — the standard TPU training recipe."""
+    return DataTypePolicy(compute_dtype=jnp.bfloat16)

@@ -1,0 +1,32 @@
+"""RNG key streams.
+
+ND4J exposes a global seeded RNG (`Nd4j.getRandom().setSeed`); JAX is
+functional, so the framework threads explicit `jax.random` keys.
+`RngStream` is a tiny stateful convenience used at API boundaries
+(network init, dropout key supply in the non-jitted driver loop); inside
+jitted code keys are always passed explicitly.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class RngStream:
+    """Splittable stream of PRNG keys with a deterministic seed."""
+
+    def __init__(self, seed: int = 12345):
+        self._key = jax.random.PRNGKey(seed)
+        self.seed = seed
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def next_keys(self, n: int):
+        keys = jax.random.split(self._key, n + 1)
+        self._key = keys[0]
+        return keys[1:]
+
+    def fold_in(self, data: int):
+        return jax.random.fold_in(self._key, data)

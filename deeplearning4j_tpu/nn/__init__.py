@@ -1,0 +1,5 @@
+"""Neural network package: config DSL, layers, containers.
+
+Reference: deeplearning4j-nn (`nn/conf`, `nn/layers`, `nn/multilayer`,
+`nn/graph`).
+"""

@@ -1,0 +1,14 @@
+"""Configuration DSL: config-as-serializable-data.
+
+Reference: `nn/conf/NeuralNetConfiguration.java` builder →
+`MultiLayerConfiguration` / `ComputationGraphConfiguration`, all
+Jackson-JSON serializable so configs ship inside checkpoints. The same
+invariant holds here: every layer config is a dataclass with a stable
+JSON form, and model containers are constructed from configs alone.
+"""
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.builder import (
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+)

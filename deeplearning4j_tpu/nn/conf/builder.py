@@ -1,0 +1,354 @@
+"""NeuralNetConfiguration builder → MultiLayerConfiguration.
+
+Reference: `nn/conf/NeuralNetConfiguration.java:570` (Builder; global
+defaults cloned into every layer), `:727` (`list()` → ListBuilder),
+`nn/conf/MultiLayerConfiguration.java` (the serializable product), with
+`setInputType` driving nIn inference + automatic preprocessor insertion
+(`ListBuilder.setInputType` → `LayerValidation`/preprocessor logic).
+
+Global defaults (updater, weight-init, l1/l2, dropout, gradient
+normalization) are applied to a layer when the layer still carries its
+dataclass default for that field — the moral equivalent of the
+reference's "clone global conf per layer, layer overrides win".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.common.updaters import Sgd, Updater, get_updater
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeConvolutionalFlat,
+    InputTypeFeedForward,
+    InputTypeRecurrent,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    CnnToRnnPreProcessor,
+    FeedForwardToCnnPreProcessor,
+    FeedForwardToRnnPreProcessor,
+    InputPreProcessor,
+    RnnToCnnPreProcessor,
+    RnnToFeedForwardPreProcessor,
+    preprocessor_from_dict,
+)
+from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
+
+
+class GradientNormalization(str, Enum):
+    """Reference `nn/conf/GradientNormalization.java`."""
+
+    NONE = "none"
+    RENORMALIZE_L2_PER_LAYER = "renormalize_l2_per_layer"
+    RENORMALIZE_L2_PER_PARAM_TYPE = "renormalize_l2_per_param_type"
+    CLIP_ELEMENTWISE_ABSOLUTE_VALUE = "clip_elementwise_absolute_value"
+    CLIP_L2_PER_LAYER = "clip_l2_per_layer"
+    CLIP_L2_PER_PARAM_TYPE = "clip_l2_per_param_type"
+
+
+class BackpropType(str, Enum):
+    STANDARD = "standard"
+    TRUNCATED_BPTT = "tbptt"
+
+
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """Serializable product: everything a MultiLayerNetwork needs.
+
+    Reference: `nn/conf/MultiLayerConfiguration.java` — configs are data
+    and ship inside checkpoints (`ModelSerializer` writes
+    configuration.json)."""
+
+    layers: List[Layer] = dataclasses.field(default_factory=list)
+    input_preprocessors: Dict[int, InputPreProcessor] = dataclasses.field(default_factory=dict)
+    input_type: Optional[InputType] = None
+    seed: int = 12345
+    backprop_type: BackpropType = BackpropType.STANDARD
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    gradient_normalization: GradientNormalization = GradientNormalization.NONE
+    gradient_normalization_threshold: float = 1.0
+    max_norm: Optional[float] = None  # constraint applied post-update
+    pretrain: bool = False
+
+    def to_dict(self):
+        return {
+            "format": "deeplearning4j_tpu.MultiLayerConfiguration",
+            "layers": [l.to_dict() for l in self.layers],
+            "input_preprocessors": {str(i): p.to_dict() for i, p in self.input_preprocessors.items()},
+            "input_type": None if self.input_type is None else self.input_type.to_dict(),
+            "seed": self.seed,
+            "backprop_type": self.backprop_type.value,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+            "gradient_normalization": self.gradient_normalization.value,
+            "gradient_normalization_threshold": self.gradient_normalization_threshold,
+            "max_norm": self.max_norm,
+            "pretrain": self.pretrain,
+        }
+
+    def to_json(self, **kw):
+        return json.dumps(self.to_dict(), **kw)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration(
+            layers=[layer_from_dict(ld) for ld in d["layers"]],
+            input_preprocessors={int(i): preprocessor_from_dict(p)
+                                 for i, p in d.get("input_preprocessors", {}).items()},
+            input_type=None if d.get("input_type") is None else InputType.from_dict(d["input_type"]),
+            seed=d.get("seed", 12345),
+            backprop_type=BackpropType(d.get("backprop_type", "standard")),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+            gradient_normalization=GradientNormalization(d.get("gradient_normalization", "none")),
+            gradient_normalization_threshold=d.get("gradient_normalization_threshold", 1.0),
+            max_norm=d.get("max_norm"),
+            pretrain=d.get("pretrain", False),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))
+
+
+def _family(input_type: InputType) -> str:
+    if isinstance(input_type, InputTypeConvolutional):
+        return "cnn"
+    if isinstance(input_type, InputTypeConvolutionalFlat):
+        return "cnnflat"
+    if isinstance(input_type, InputTypeRecurrent):
+        return "rnn"
+    return "ff"
+
+
+def _expected_family(layer: Layer) -> str:
+    # which input family does this layer natively consume?
+    name = layer.layer_name
+    if name in ("convolution", "subsampling", "upsampling2d", "zeropadding",
+                "space_to_depth", "lrn"):
+        return "cnn"
+    if name in ("lstm", "graves_lstm", "graves_bidirectional_lstm", "simple_rnn",
+                "rnn_output", "convolution1d", "subsampling1d", "zeropadding1d",
+                "last_time_step"):
+        return "rnn"
+    if name in ("batchnorm", "activation", "dropout_layer", "global_pooling", "loss"):
+        return "any"
+    return "ff"
+
+
+def infer_preprocessor(input_type: InputType, layer: Layer) -> Optional[InputPreProcessor]:
+    """Automatic preprocessor insertion (reference ListBuilder.setInputType)."""
+    have, want = _family(input_type), _expected_family(layer)
+    if want == "any" or have == want:
+        return None
+    it = input_type
+    if have == "cnnflat" and want == "cnn":
+        return FeedForwardToCnnPreProcessor(it.height, it.width, it.channels)
+    if have == "cnnflat" and want == "ff":
+        return None  # already flat
+    if have == "cnn" and want == "ff":
+        return CnnToFeedForwardPreProcessor(it.height, it.width, it.channels)
+    if have == "cnn" and want == "rnn":
+        return CnnToRnnPreProcessor(it.height, it.width, it.channels)
+    if have == "rnn" and want == "ff":
+        return RnnToFeedForwardPreProcessor()
+    if have == "ff" and want == "rnn":
+        return FeedForwardToRnnPreProcessor(timesteps=0)
+    if have == "rnn" and want == "cnn":
+        raise ValueError("rnn→cnn requires an explicit RnnToCnnPreProcessor with h/w/c")
+    if have == "cnnflat" and want == "rnn":
+        return FeedForwardToRnnPreProcessor(timesteps=0)
+    if have == "ff" and want == "cnn":
+        raise ValueError(
+            "feed-forward→cnn requires setInputType(InputType.convolutional_flat(...)) "
+            "or an explicit FeedForwardToCnnPreProcessor")
+    return None
+
+
+class ListBuilder:
+    """`NeuralNetConfiguration.Builder.list()` equivalent."""
+
+    def __init__(self, global_conf: "NeuralNetConfiguration"):
+        self._g = global_conf
+        self._layers: List[Layer] = []
+        self._preprocessors: Dict[int, InputPreProcessor] = {}
+        self._input_type: Optional[InputType] = None
+        self._backprop_type = BackpropType.STANDARD
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+        self._pretrain = False
+
+    def layer(self, layer_or_idx, maybe_layer=None) -> "ListBuilder":
+        layer = maybe_layer if maybe_layer is not None else layer_or_idx
+        self._layers.append(layer)
+        return self
+
+    def input_preprocessor(self, idx: int, p: InputPreProcessor) -> "ListBuilder":
+        self._preprocessors[idx] = p
+        return self
+
+    def set_input_type(self, input_type: InputType) -> "ListBuilder":
+        self._input_type = input_type
+        return self
+
+    def backprop_type(self, bptype, fwd_length: int = 20, back_length: int = None) -> "ListBuilder":
+        self._backprop_type = BackpropType(bptype)
+        self._tbptt_fwd = fwd_length
+        self._tbptt_back = back_length if back_length is not None else fwd_length
+        return self
+
+    def t_bptt_lengths(self, fwd: int, back: int = None) -> "ListBuilder":
+        return self.backprop_type(BackpropType.TRUNCATED_BPTT, fwd, back)
+
+    def pretrain(self, flag: bool) -> "ListBuilder":
+        self._pretrain = flag
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        g = self._g
+        layers = [l.clone() for l in self._layers]
+        for l in layers:
+            g.apply_global_defaults(l)
+
+        preprocessors = dict(self._preprocessors)
+        current = self._input_type
+        if current is not None:
+            for i, l in enumerate(layers):
+                if i in preprocessors:
+                    current = preprocessors[i].get_output_type(current)
+                else:
+                    auto = infer_preprocessor(current, l)
+                    if auto is not None:
+                        preprocessors[i] = auto
+                        current = auto.get_output_type(current)
+                    elif _family(current) == "cnnflat" and _expected_family(l) in ("ff", "any"):
+                        current = InputType.feed_forward(current.arity())
+                l.set_n_in(current, override=not _has_explicit_n_in(l))
+                current = l.get_output_type(current)
+
+        return MultiLayerConfiguration(
+            layers=layers,
+            input_preprocessors=preprocessors,
+            input_type=self._input_type,
+            seed=g.seed_value,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+            gradient_normalization=g.gradient_normalization_value,
+            gradient_normalization_threshold=g.gradient_normalization_threshold_value,
+            max_norm=g.max_norm_value,
+            pretrain=self._pretrain,
+        )
+
+
+def _has_explicit_n_in(layer: Layer) -> bool:
+    return getattr(layer, "n_in", 0) not in (0, None)
+
+
+class NeuralNetConfiguration:
+    """Fluent global-defaults builder (reference
+    `NeuralNetConfiguration.Builder`)."""
+
+    def __init__(self):
+        self.seed_value = 12345
+        self.updater_value: Updater = Sgd(1e-3)
+        self.weight_init_value: Optional[WeightInit] = None
+        self.dist_value = None
+        self.l1_value = 0.0
+        self.l2_value = 0.0
+        self.l1_bias_value = 0.0
+        self.l2_bias_value = 0.0
+        self.dropout_value: Optional[float] = None
+        self.gradient_normalization_value = GradientNormalization.NONE
+        self.gradient_normalization_threshold_value = 1.0
+        self.max_norm_value: Optional[float] = None
+        self.activation_value = None
+        self.mini_batch = True
+
+    @staticmethod
+    def builder() -> "NeuralNetConfiguration":
+        return NeuralNetConfiguration()
+
+    def seed(self, s: int):
+        self.seed_value = int(s)
+        return self
+
+    def updater(self, u):
+        self.updater_value = get_updater(u)
+        return self
+
+    def weight_init(self, wi, dist=None):
+        self.weight_init_value = WeightInit(wi)
+        if dist is not None:
+            self.dist_value = dist
+        return self
+
+    def dist(self, d):
+        self.dist_value = d
+        self.weight_init_value = WeightInit.DISTRIBUTION
+        return self
+
+    def activation(self, a):
+        self.activation_value = a
+        return self
+
+    def l1(self, v):
+        self.l1_value = v
+        return self
+
+    def l2(self, v):
+        self.l2_value = v
+        return self
+
+    def l1_bias(self, v):
+        self.l1_bias_value = v
+        return self
+
+    def l2_bias(self, v):
+        self.l2_bias_value = v
+        return self
+
+    def dropout(self, retain_prob):
+        self.dropout_value = retain_prob
+        return self
+
+    def gradient_normalization(self, gn, threshold: float = 1.0):
+        self.gradient_normalization_value = GradientNormalization(gn)
+        self.gradient_normalization_threshold_value = threshold
+        return self
+
+    def constrain_max_norm(self, v: float):
+        self.max_norm_value = v
+        return self
+
+    def apply_global_defaults(self, layer: Layer):
+        """Push builder-level defaults into a layer, honoring layer-level
+        overrides (reference: global conf cloned per layer)."""
+        if layer.updater is None:
+            layer.updater = self.updater_value
+        if self.weight_init_value is not None and layer.weight_init == WeightInit.XAVIER:
+            layer.weight_init = self.weight_init_value
+        if self.dist_value is not None and layer.dist is None:
+            layer.dist = self.dist_value
+        if layer.l1 == 0.0:
+            layer.l1 = self.l1_value
+        if layer.l2 == 0.0:
+            layer.l2 = self.l2_value
+        if layer.l1_bias == 0.0:
+            layer.l1_bias = self.l1_bias_value
+        if layer.l2_bias == 0.0:
+            layer.l2_bias = self.l2_bias_value
+        if layer.dropout is None and self.dropout_value is not None:
+            # output-ish layers don't get input dropout by default in the
+            # reference either; applied uniformly here, harmless for eval.
+            layer.dropout = self.dropout_value
+
+    def list(self) -> ListBuilder:
+        return ListBuilder(self)

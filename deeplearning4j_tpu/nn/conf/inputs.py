@@ -1,0 +1,126 @@
+"""Input types — shape metadata used for nIn inference and automatic
+preprocessor insertion.
+
+Reference: `nn/conf/inputs/InputType.java` (feedForward, recurrent,
+convolutional, convolutionalFlat) used by
+`NeuralNetConfiguration.ListBuilder.setInputType` to wire nIns and
+insert preprocessors between layer families.
+
+Layout note (TPU-first): convolutional activations flow through the
+network as NHWC (channels-last — XLA's preferred TPU layout) and
+recurrent activations as [batch, time, features]. The reference uses
+NCHW / [batch, features, time]; conversion happens only at the API
+boundary (see MultiLayerNetwork.fit/output `data_format` argument), not
+inside the compiled graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class InputType:
+    kind = "base"
+
+    @staticmethod
+    def feed_forward(size: int) -> "InputTypeFeedForward":
+        return InputTypeFeedForward(int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: int | None = None) -> "InputTypeRecurrent":
+        return InputTypeRecurrent(int(size), timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputTypeConvolutional":
+        return InputTypeConvolutional(int(height), int(width), int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputTypeConvolutionalFlat":
+        return InputTypeConvolutionalFlat(int(height), int(width), int(channels))
+
+    def arity(self) -> int:
+        """Flattened element count per example."""
+        raise NotImplementedError
+
+    def shape(self, batch: int | None = None):
+        """Per-example array shape in the *internal* layout (no batch dim
+        unless batch given)."""
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {"kind": self.kind}
+        d.update(dataclasses.asdict(self))
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        kind = d.pop("kind")
+        return _KINDS[kind](**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class InputTypeFeedForward(InputType):
+    size: int
+    kind = "feedforward"
+
+    def arity(self):
+        return self.size
+
+    def shape(self, batch=None):
+        return (self.size,) if batch is None else (batch, self.size)
+
+
+@dataclasses.dataclass(frozen=True)
+class InputTypeRecurrent(InputType):
+    size: int
+    timesteps: int | None = None
+    kind = "recurrent"
+
+    def arity(self):
+        if self.timesteps is None:
+            raise ValueError("recurrent input with unknown timesteps has no fixed arity")
+        return self.size * self.timesteps
+
+    def shape(self, batch=None):
+        t = -1 if self.timesteps is None else self.timesteps
+        return (t, self.size) if batch is None else (batch, t, self.size)
+
+
+@dataclasses.dataclass(frozen=True)
+class InputTypeConvolutional(InputType):
+    height: int
+    width: int
+    channels: int
+    kind = "convolutional"
+
+    def arity(self):
+        return self.height * self.width * self.channels
+
+    def shape(self, batch=None):
+        # internal layout is NHWC
+        s = (self.height, self.width, self.channels)
+        return s if batch is None else (batch,) + s
+
+
+@dataclasses.dataclass(frozen=True)
+class InputTypeConvolutionalFlat(InputType):
+    height: int
+    width: int
+    channels: int
+    kind = "convolutional_flat"
+
+    def arity(self):
+        return self.height * self.width * self.channels
+
+    def shape(self, batch=None):
+        s = (self.arity(),)
+        return s if batch is None else (batch,) + s
+
+
+_KINDS = {
+    "feedforward": InputTypeFeedForward,
+    "recurrent": InputTypeRecurrent,
+    "convolutional": InputTypeConvolutional,
+    "convolutional_flat": InputTypeConvolutionalFlat,
+}

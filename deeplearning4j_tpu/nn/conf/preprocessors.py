@@ -1,0 +1,173 @@
+"""Input preprocessors — shape adapters between layer families.
+
+Reference: `nn/conf/preprocessor/` (CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor,
+FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor, RnnToCnnPreProcessor)
+— inserted automatically by `ListBuilder.setInputType` or explicitly.
+
+Flatten-order parity: the reference flattens CNN activations in NCHW
+(channel-major) order; since internal layout here is NHWC, the CNN→FF
+preprocessor transposes to NCHW before reshaping so that downstream
+dense weights are interchangeable with reference/Keras(th-ordering)
+weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeConvolutionalFlat,
+    InputTypeFeedForward,
+    InputTypeRecurrent,
+)
+
+_PREPROC_REGISTRY: Dict[str, type] = {}
+
+
+def register_preprocessor(cls):
+    _PREPROC_REGISTRY[cls.preproc_name] = cls
+    return cls
+
+
+class InputPreProcessor:
+    preproc_name = "base"
+
+    def pre_process(self, x, mask=None):
+        raise NotImplementedError
+
+    def process_mask(self, mask):
+        return mask
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {"preprocessor": self.preproc_name}
+        d.update(dataclasses.asdict(self))
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+
+def preprocessor_from_dict(d: dict) -> InputPreProcessor:
+    d = dict(d)
+    name = d.pop("preprocessor")
+    return _PREPROC_REGISTRY[name](**d)
+
+
+@register_preprocessor
+@dataclasses.dataclass(eq=False)
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    preproc_name = "cnn_to_ff"
+
+    def pre_process(self, x, mask=None):
+        # NHWC → NCHW → flatten (reference flatten order, ConvolutionUtils)
+        n = x.shape[0]
+        return jnp.transpose(x, (0, 3, 1, 2)).reshape(n, -1)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(input_type.arity())
+
+
+@register_preprocessor
+@dataclasses.dataclass(eq=False)
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    preproc_name = "ff_to_cnn"
+
+    def pre_process(self, x, mask=None):
+        n = x.shape[0]
+        nchw = x.reshape(n, self.channels, self.height, self.width)
+        return jnp.transpose(nchw, (0, 2, 3, 1))  # → NHWC
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclasses.dataclass(eq=False)
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B,T,F] → [B*T,F] (time folded into batch, reference semantics)."""
+
+    preproc_name = "rnn_to_ff"
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(-1, x.shape[-1])
+
+    def process_mask(self, mask):
+        return None if mask is None else mask.reshape(-1)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(input_type.size)
+
+
+@register_preprocessor
+@dataclasses.dataclass(eq=False)
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    timesteps: int = 0
+
+    preproc_name = "ff_to_rnn"
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(-1, self.timesteps, x.shape[-1])
+
+    def process_mask(self, mask):
+        return None if mask is None else mask.reshape(-1, self.timesteps)
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(input_type.size, self.timesteps or None)
+
+
+@register_preprocessor
+@dataclasses.dataclass(eq=False)
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """NHWC [B,H,W,C] → [B, 1, H*W*C]: spatial features become one
+    timestep's features (reference CnnToRnnPreProcessor folds each
+    example's conv output into the RNN feature axis)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    preproc_name = "cnn_to_rnn"
+
+    def pre_process(self, x, mask=None):
+        n = x.shape[0]
+        flat = jnp.transpose(x, (0, 3, 1, 2)).reshape(n, -1)
+        return flat[:, None, :]
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(input_type.arity(), 1)
+
+
+@register_preprocessor
+@dataclasses.dataclass(eq=False)
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[B,T,F] with F == C*H*W → NHWC [B*T,H,W,C] (time folded into batch)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    preproc_name = "rnn_to_cnn"
+
+    def pre_process(self, x, mask=None):
+        bt = x.shape[0] * x.shape[1]
+        nchw = x.reshape(bt, self.channels, self.height, self.width)
+        return jnp.transpose(nchw, (0, 2, 3, 1))
+
+    def process_mask(self, mask):
+        return None if mask is None else mask.reshape(-1)
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)

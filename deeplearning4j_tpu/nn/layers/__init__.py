@@ -1,0 +1,41 @@
+"""Layer catalog: config dataclasses with functional init/forward.
+
+Reference split `nn/conf/layers/*` (config) from `nn/layers/*` (runtime
+impl); here each layer is ONE dataclass carrying serializable config
+fields plus pure-JAX `init_params` / `forward` — config-as-data is
+preserved (JSON round-trip covers only the dataclass fields).
+"""
+
+from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict, register_layer
+from deeplearning4j_tpu.nn.layers.feedforward import (
+    DenseLayer,
+    OutputLayer,
+    LossLayer,
+    ActivationLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    AutoEncoder,
+)
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayer,
+    Convolution1DLayer,
+    SubsamplingLayer,
+    Subsampling1DLayer,
+    Upsampling2D,
+    ZeroPaddingLayer,
+    ZeroPadding1DLayer,
+    SpaceToDepthLayer,
+)
+from deeplearning4j_tpu.nn.layers.normalization import (
+    BatchNormalization,
+    LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    LSTM,
+    GravesLSTM,
+    GravesBidirectionalLSTM,
+    SimpleRnn,
+    RnnOutputLayer,
+    LastTimeStep,
+)
+from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer, PoolingType

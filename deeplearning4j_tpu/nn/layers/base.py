@@ -1,0 +1,205 @@
+"""Layer base: common config fields, serde registry, forward protocol.
+
+Reference: `nn/conf/layers/Layer.java` + `BaseLayer.java` (activation,
+weightInit, biasInit, dist, l1/l2/l1Bias/l2Bias, updater, dropOut) and
+the runtime `nn/api/Layer.java` contract (`activate`,
+`backpropGradient`, `feedForwardMaskArray`). Backprop is autodiff here,
+so only the forward protocol survives:
+
+    params, state = layer.init(rng, dtype)        # after shape inference
+    y, new_state = layer.forward(params, state, x, train=..., rng=..., mask=...)
+
+- `params`: dict[str, Array] with stable names ("W", "b", "RW", "gamma",
+  …) matching the reference's ParamInitializer keys — the invariant that
+  makes Keras weight copy and transfer-learning surgery deterministic.
+- `state`: dict[str, Array] for non-trained buffers (BN running stats).
+- `mask`: optional [batch, time] (RNN) mask, propagated like
+  `feedForwardMaskArray`.
+
+Dropout convention follows the reference: `dropout` is the RETAIN
+probability (dropOut(0.8) keeps 80% — `nn/conf/layers/Layer.java`
+semantics), applied to the layer INPUT with inverted scaling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.activations import Activation, get_activation
+from deeplearning4j_tpu.common.distributions import Distribution, distribution_from_dict
+from deeplearning4j_tpu.common.losses import LossFunction, get_loss
+from deeplearning4j_tpu.common.schedules import Schedule, schedule_from_dict
+from deeplearning4j_tpu.common.updaters import Updater, updater_from_dict
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+_LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls):
+    _LAYER_REGISTRY[cls.layer_name] = cls
+    return cls
+
+
+def _encode(v):
+    if isinstance(v, Activation):
+        return {"__activation__": v.name}
+    if isinstance(v, LossFunction):
+        return {"__loss__": v.name}
+    if isinstance(v, Updater):
+        return {"__updater__": v.to_dict()}
+    if isinstance(v, Distribution):
+        return {"__distribution__": v.to_dict()}
+    if isinstance(v, Schedule):
+        return {"__schedule__": v.to_dict()}
+    if isinstance(v, WeightInit):
+        return v.value
+    if isinstance(v, Enum):
+        return v.value
+    if isinstance(v, InputType):
+        return {"__inputtype__": v.to_dict()}
+    if isinstance(v, Layer):
+        return v.to_dict()
+    if isinstance(v, (list, tuple)):
+        return [_encode(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _encode(x) for k, x in v.items()}
+    return v
+
+
+def _decode(v):
+    if isinstance(v, dict):
+        if "__activation__" in v:
+            return get_activation(v["__activation__"])
+        if "__loss__" in v:
+            return get_loss(v["__loss__"])
+        if "__updater__" in v:
+            return updater_from_dict(v["__updater__"])
+        if "__distribution__" in v:
+            return distribution_from_dict(v["__distribution__"])
+        if "__schedule__" in v:
+            return schedule_from_dict(v["__schedule__"])
+        if "__inputtype__" in v:
+            return InputType.from_dict(v["__inputtype__"])
+        if "layer_name" in v and v.get("layer_name") in _LAYER_REGISTRY:
+            return layer_from_dict(v)
+        return {k: _decode(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode(x) for x in v]
+    return v
+
+
+@dataclasses.dataclass
+class Layer:
+    """Base layer config + functional implementation."""
+
+    layer_name = "base"
+
+    # common config fields (reference BaseLayer.java)
+    activation: Any = None  # Activation | str | None
+    weight_init: Any = WeightInit.XAVIER
+    bias_init: float = 0.0
+    dist: Optional[Distribution] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    l1_bias: float = 0.0
+    l2_bias: float = 0.0
+    updater: Optional[Updater] = None  # per-layer override of the global updater
+    dropout: Optional[float] = None  # RETAIN probability (reference semantics)
+    name: Optional[str] = None
+
+    def __post_init__(self):
+        if self.activation is not None:
+            self.activation = get_activation(self.activation)
+        if self.weight_init is not None and not isinstance(self.weight_init, WeightInit):
+            self.weight_init = WeightInit(self.weight_init)
+
+    # ---- shape inference -------------------------------------------------
+    def set_n_in(self, input_type: InputType, override: bool = True) -> None:
+        """Infer nIn-like fields from the incoming InputType (reference:
+        `Layer.setNIn`)."""
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    # ---- params / state --------------------------------------------------
+    def init_params(self, rng, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def init_state(self, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def has_params(self) -> bool:
+        return bool(self.init_params(jax.random.PRNGKey(0)))
+
+    # ---- forward ---------------------------------------------------------
+    def forward(
+        self,
+        params: Dict[str, jnp.ndarray],
+        state: Dict[str, jnp.ndarray],
+        x: jnp.ndarray,
+        *,
+        train: bool = False,
+        rng=None,
+        mask=None,
+    ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        raise NotImplementedError
+
+    def forward_mask(self, mask, current_type: InputType):
+        """Propagate the mask through this layer (reference
+        `feedForwardMaskArray`). Default: unchanged."""
+        return mask
+
+    # ---- input dropout (reference applies dropout to layer input) --------
+    def apply_input_dropout(self, x, train: bool, rng):
+        if not train or self.dropout is None or self.dropout >= 1.0 or rng is None:
+            return x
+        keep = jnp.asarray(self.dropout, x.dtype)
+        mask = jax.random.bernoulli(rng, self.dropout, x.shape)
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+    # ---- regularization --------------------------------------------------
+    def regularization_score(self, params: Dict[str, jnp.ndarray]):
+        """L1/L2 penalty for this layer's params (reference
+        `calcL1`/`calcL2`). Weight-like params get l1/l2; bias gets
+        l1_bias/l2_bias."""
+        score = 0.0
+        for key, value in params.items():
+            if key == "b" or key.endswith("_b") or key in ("beta",):
+                l1c, l2c = self.l1_bias, self.l2_bias
+            elif key in ("gamma", "mean", "var"):
+                continue
+            else:
+                l1c, l2c = self.l1, self.l2
+            if l1c:
+                score = score + l1c * jnp.sum(jnp.abs(value))
+            if l2c:
+                score = score + 0.5 * l2c * jnp.sum(value * value)
+        return score
+
+    # ---- serde -----------------------------------------------------------
+    def to_dict(self) -> dict:
+        d = {"layer_name": self.layer_name}
+        for f in dataclasses.fields(self):
+            d[f.name] = _encode(getattr(self, f.name))
+        return d
+
+    def clone(self) -> "Layer":
+        return layer_from_dict(self.to_dict())
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+
+def layer_from_dict(d: dict) -> Layer:
+    d = dict(d)
+    kind = d.pop("layer_name")
+    cls = _LAYER_REGISTRY[kind]
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {k: _decode(v) for k, v in d.items() if k in field_names}
+    return cls(**kwargs)

@@ -1,0 +1,393 @@
+"""Convolution layer family: Conv2D/1D, Subsampling (pooling),
+Upsampling, ZeroPadding, SpaceToDepth.
+
+Reference: `nn/conf/layers/ConvolutionLayer.java` (+ ConvolutionMode
+Same/Truncate/Strict math in `util/ConvolutionUtils.java`),
+`SubsamplingLayer.java`, `Upsampling2D.java`, `ZeroPaddingLayer.java`;
+runtime im2col+GEMM at `nn/layers/convolution/ConvolutionLayer.java:360-397`
+and the cuDNN fast path `CudnnConvolutionHelper.java`.
+
+TPU-first design: no im2col — `lax.conv_general_dilated` lowers straight
+to MXU convolutions; activations are NHWC, kernels HWIO (XLA's native
+TPU layouts). There is no helper/plug-in seam (reference
+`ConvolutionHelper.java`): XLA is the only backend.
+
+Param names: "W" [kh, kw, in, out] (HWIO), "b" [out]. The reference
+stores [out, in, kh, kw]; converters live with the Keras/DL4J import
+code, not here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.common.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeRecurrent,
+)
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+class ConvolutionMode(str, Enum):
+    """Reference `nn/conf/ConvolutionMode.java`."""
+
+    SAME = "same"
+    TRUNCATE = "truncate"
+    STRICT = "strict"
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def conv_out_size(size: int, kernel: int, stride: int, pad: int, dilation: int,
+                  mode: ConvolutionMode) -> int:
+    eff = kernel + (kernel - 1) * (dilation - 1)
+    if mode == ConvolutionMode.SAME:
+        return -(-size // stride)  # ceil
+    out = (size + 2 * pad - eff) // stride + 1
+    if mode == ConvolutionMode.STRICT and (size + 2 * pad - eff) % stride != 0:
+        raise ValueError(
+            f"ConvolutionMode.STRICT: size {size} with kernel {kernel}, stride {stride}, "
+            f"pad {pad} does not divide evenly (reference ConvolutionUtils.validateShapes)")
+    return out
+
+
+def _explicit_padding(mode: ConvolutionMode, pad_hw, kernel_hw, dilation_hw, stride_hw, in_hw):
+    """Padding spec for lax.conv / reduce_window."""
+    if mode == ConvolutionMode.SAME:
+        pads = []
+        for size, k, s, d in zip(in_hw, kernel_hw, stride_hw, dilation_hw):
+            eff = k + (k - 1) * (d - 1)
+            out = -(-size // s)
+            total = max(0, (out - 1) * s + eff - size)
+            pads.append((total // 2, total - total // 2))
+        return pads
+    return [(p, p) for p in pad_hw]
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class ConvolutionLayer(Layer):
+    layer_name = "convolution"
+
+    n_in: int = 0  # input channels
+    n_out: int = 0  # filters
+    kernel_size: Any = (5, 5)
+    stride: Any = (1, 1)
+    padding: Any = (0, 0)
+    dilation: Any = (1, 1)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    has_bias: bool = True
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.dilation = _pair(self.dilation)
+        self.convolution_mode = ConvolutionMode(self.convolution_mode)
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if not isinstance(input_type, InputTypeConvolutional):
+            raise ValueError(f"ConvolutionLayer expects convolutional input, got {input_type}")
+        if override or not self.n_in:
+            self.n_in = input_type.channels
+
+    def get_output_type(self, input_type):
+        h = conv_out_size(input_type.height, self.kernel_size[0], self.stride[0],
+                          self.padding[0], self.dilation[0], self.convolution_mode)
+        w = conv_out_size(input_type.width, self.kernel_size[1], self.stride[1],
+                          self.padding[1], self.dilation[1], self.convolution_mode)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        w = init_weights(rng, (kh, kw, self.n_in, self.n_out), self.weight_init,
+                         fan_in=fan_in, fan_out=fan_out,
+                         distribution=self.dist, dtype=dtype)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params
+
+    def pre_output(self, params, x):
+        pads = _explicit_padding(self.convolution_mode, self.padding, self.kernel_size,
+                                 self.dilation, self.stride, x.shape[1:3])
+        z = lax.conv_general_dilated(
+            x, params["W"].astype(x.dtype),
+            window_strides=self.stride,
+            padding=pads,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.has_bias:
+            z = z + params["b"].astype(z.dtype)
+        return z
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        return self.activation(self.pre_output(params, x)), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class Convolution1DLayer(ConvolutionLayer):
+    """1D conv over the time axis of recurrent data [B, T, F]
+    (reference `Convolution1DLayer.java`: RNN format in/out)."""
+
+    layer_name = "convolution1d"
+
+    def __post_init__(self):
+        # represent as kernel over (time, 1)
+        if not isinstance(self.kernel_size, (list, tuple)):
+            self.kernel_size = (self.kernel_size, 1)
+        if not isinstance(self.stride, (list, tuple)):
+            self.stride = (self.stride, 1)
+        if not isinstance(self.padding, (list, tuple)):
+            self.padding = (self.padding, 0)
+        if not isinstance(self.dilation, (list, tuple)):
+            self.dilation = (self.dilation, 1)
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if not isinstance(input_type, InputTypeRecurrent):
+            raise ValueError(f"Convolution1DLayer expects recurrent input, got {input_type}")
+        if override or not self.n_in:
+            self.n_in = input_type.size
+
+    def get_output_type(self, input_type):
+        t = input_type.timesteps
+        if t is not None:
+            t = conv_out_size(t, self.kernel_size[0], self.stride[0], self.padding[0],
+                              self.dilation[0], self.convolution_mode)
+        return InputType.recurrent(self.n_out, t)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        x4 = x[:, :, None, :]  # [B,T,F] -> NHWC [B,T,1,F]
+        z = self.pre_output(params, x4)
+        return self.activation(z[:, :, 0, :]), state
+
+    def forward_mask(self, mask, current_type):
+        if mask is None or self.kernel_size[0] == 1 and self.stride[0] == 1:
+            return mask
+        # pool the mask with the same window math (any-valid semantics)
+        m = mask[:, :, None, None].astype(jnp.float32)
+        pads = _explicit_padding(self.convolution_mode, (self.padding[0],), (self.kernel_size[0],),
+                                 (self.dilation[0],), (self.stride[0],), (m.shape[1],))
+        pooled = lax.reduce_window(m, -jnp.inf, lax.max,
+                                   (1, self.kernel_size[0], 1, 1),
+                                   (1, self.stride[0], 1, 1),
+                                   [(0, 0), pads[0], (0, 0), (0, 0)])
+        return (pooled[:, :, 0, 0] > 0).astype(mask.dtype)
+
+
+class PoolingMode(str, Enum):
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class SubsamplingLayer(Layer):
+    """Spatial pooling (reference `SubsamplingLayer.java`; cuDNN path
+    `CudnnSubsamplingHelper.java`). `lax.reduce_window` is the XLA-native
+    equivalent."""
+
+    layer_name = "subsampling"
+
+    pooling_type: PoolingMode = PoolingMode.MAX
+    kernel_size: Any = (2, 2)
+    stride: Any = (2, 2)
+    padding: Any = (0, 0)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    pnorm: int = 2
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.pooling_type = PoolingMode(self.pooling_type)
+        self.convolution_mode = ConvolutionMode(self.convolution_mode)
+        super().__post_init__()
+
+    def get_output_type(self, input_type):
+        h = conv_out_size(input_type.height, self.kernel_size[0], self.stride[0],
+                          self.padding[0], 1, self.convolution_mode)
+        w = conv_out_size(input_type.width, self.kernel_size[1], self.stride[1],
+                          self.padding[1], 1, self.convolution_mode)
+        return InputType.convolutional(h, w, input_type.channels)
+
+    def _pads(self, in_hw):
+        return _explicit_padding(self.convolution_mode, self.padding, self.kernel_size,
+                                 (1, 1), self.stride, in_hw)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        kh, kw = self.kernel_size
+        window = (1, kh, kw, 1)
+        strides = (1, self.stride[0], self.stride[1], 1)
+        pads = [(0, 0)] + self._pads(x.shape[1:3]) + [(0, 0)]
+        if self.pooling_type == PoolingMode.MAX:
+            out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+        elif self.pooling_type == PoolingMode.SUM:
+            out = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        elif self.pooling_type == PoolingMode.AVG:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides, pads)
+            out = s / counts
+        elif self.pooling_type == PoolingMode.PNORM:
+            p = float(self.pnorm)
+            s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, pads)
+            out = s ** (1.0 / p)
+        else:
+            raise ValueError(self.pooling_type)
+        return out, state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class Subsampling1DLayer(SubsamplingLayer):
+    """Pooling over time for recurrent data (reference
+    `Subsampling1DLayer.java`)."""
+
+    layer_name = "subsampling1d"
+
+    def __post_init__(self):
+        if not isinstance(self.kernel_size, (list, tuple)):
+            self.kernel_size = (self.kernel_size, 1)
+        if not isinstance(self.stride, (list, tuple)):
+            self.stride = (self.stride, 1)
+        if not isinstance(self.padding, (list, tuple)):
+            self.padding = (self.padding, 0)
+        super().__post_init__()
+
+    def get_output_type(self, input_type):
+        t = input_type.timesteps
+        if t is not None:
+            t = conv_out_size(t, self.kernel_size[0], self.stride[0], self.padding[0],
+                              1, self.convolution_mode)
+        return InputType.recurrent(input_type.size, t)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x4 = x[:, :, None, :]
+        out, state = super().forward(params, state, x4, train=train, rng=rng)
+        return out[:, :, 0, :], state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class Upsampling2D(Layer):
+    """Nearest-neighbor upsampling (reference `Upsampling2D.java`)."""
+
+    layer_name = "upsampling2d"
+    size: Any = 2
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        self.size = _pair(self.size)
+        super().__post_init__()
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(input_type.height * self.size[0],
+                                       input_type.width * self.size[1],
+                                       input_type.channels)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        out = jnp.repeat(jnp.repeat(x, self.size[0], axis=1), self.size[1], axis=2)
+        return out, state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class ZeroPaddingLayer(Layer):
+    """Zero padding for CNN activations (reference `ZeroPaddingLayer.java`).
+    `pad` is ((top, bottom), (left, right)) or a single int."""
+
+    layer_name = "zeropadding"
+    pad: Any = 1
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        if isinstance(self.pad, int):
+            self.pad = ((self.pad, self.pad), (self.pad, self.pad))
+        else:
+            p = self.pad
+            if len(p) == 2 and isinstance(p[0], int):
+                self.pad = ((p[0], p[0]), (p[1], p[1]))
+            else:
+                self.pad = tuple((int(a), int(b)) for a, b in p)
+        super().__post_init__()
+
+    def get_output_type(self, input_type):
+        (t, b), (l, r) = self.pad
+        return InputType.convolutional(input_type.height + t + b,
+                                       input_type.width + l + r,
+                                       input_type.channels)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        (t, b), (l, r) = self.pad
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class ZeroPadding1DLayer(Layer):
+    layer_name = "zeropadding1d"
+    pad: Any = 1
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        if isinstance(self.pad, int):
+            self.pad = (self.pad, self.pad)
+        super().__post_init__()
+
+    def get_output_type(self, input_type):
+        t = input_type.timesteps
+        if t is not None:
+            t = t + self.pad[0] + self.pad[1]
+        return InputType.recurrent(input_type.size, t)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.pad(x, ((0, 0), (self.pad[0], self.pad[1]), (0, 0))), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class SpaceToDepthLayer(Layer):
+    """Space-to-depth rearrangement (YOLO-style passthrough blocks)."""
+
+    layer_name = "space_to_depth"
+    block_size: int = 2
+
+    def get_output_type(self, input_type):
+        b = self.block_size
+        return InputType.convolutional(input_type.height // b, input_type.width // b,
+                                       input_type.channels * b * b)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        n, h, w, c = x.shape
+        b = self.block_size
+        out = x.reshape(n, h // b, b, w // b, b, c).transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h // b, w // b, b * b * c), state

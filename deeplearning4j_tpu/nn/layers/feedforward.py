@@ -1,0 +1,258 @@
+"""Feed-forward layer family: Dense, Output, Loss, Activation, Dropout,
+Embedding, AutoEncoder.
+
+Reference: `nn/conf/layers/DenseLayer.java`, `OutputLayer.java`,
+`LossLayer.java`, `ActivationLayer.java`, `DropoutLayer.java`,
+`EmbeddingLayer.java`, `AutoEncoder.java`; runtime math in
+`nn/layers/feedforward/**` and `nn/layers/BaseOutputLayer.java`.
+
+Param names follow the reference's `DefaultParamInitializer`: "W", "b"
+(embedding included; autoencoder adds visible bias "vb").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.activations import get_activation
+from deeplearning4j_tpu.common.losses import LossFunction, get_loss
+from deeplearning4j_tpu.common.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeFeedForward,
+    InputTypeRecurrent,
+)
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class DenseLayer(Layer):
+    layer_name = "dense"
+
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = True
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "sigmoid"  # reference default activation
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_in:
+            self.n_in = input_type.arity()
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, rng, dtype=jnp.float32):
+        w = init_weights(rng, (self.n_in, self.n_out), self.weight_init,
+                         fan_in=self.n_in, fan_out=self.n_out,
+                         distribution=self.dist, dtype=dtype)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params
+
+    def pre_output(self, params, x):
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return z
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        return self.activation(self.pre_output(params, x)), state
+
+
+class BaseOutputLayerMixin:
+    """Shared loss plumbing for OutputLayer / RnnOutputLayer / LossLayer
+    (reference `nn/layers/BaseOutputLayer.java`)."""
+
+    def compute_loss(self, params, state, x, labels, *, train=True, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        preout = self.pre_output(params, x) if params else x
+        return self.loss(labels, preout, self.activation, mask=mask)
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class OutputLayer(DenseLayer, BaseOutputLayerMixin):
+    layer_name = "output"
+
+    loss: Any = None
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "softmax"
+        if self.loss is None:
+            self.loss = "mcxent"
+        self.loss = get_loss(self.loss)
+        super().__post_init__()
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class LossLayer(Layer, BaseOutputLayerMixin):
+    """Loss without params — activation + loss on the incoming array
+    (reference `nn/conf/layers/LossLayer.java`)."""
+
+    layer_name = "loss"
+    loss: Any = None
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        if self.loss is None:
+            self.loss = "mcxent"
+        self.loss = get_loss(self.loss)
+        super().__post_init__()
+
+    def pre_output(self, params, x):
+        return x
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        return self.activation(x), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class ActivationLayer(Layer):
+    layer_name = "activation"
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        super().__post_init__()
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.activation(x), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class DropoutLayer(Layer):
+    """Standalone dropout layer (reference `DropoutLayer.java`); `dropout`
+    is the retain probability."""
+
+    layer_name = "dropout_layer"
+
+    def __post_init__(self):
+        if self.dropout is None:
+            self.dropout = 0.5
+        if self.activation is None:
+            self.activation = "identity"
+        super().__post_init__()
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.activation(self.apply_input_dropout(x, train, rng)), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class EmbeddingLayer(Layer):
+    """Index → vector lookup (reference `EmbeddingLayer.java`: input is a
+    column of indices; lookup == one-hot matmul done as a gather)."""
+
+    layer_name = "embedding"
+
+    n_in: int = 0  # vocab size
+    n_out: int = 0
+    has_bias: bool = True
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_in:
+            self.n_in = input_type.arity()
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, rng, dtype=jnp.float32):
+        w = init_weights(rng, (self.n_in, self.n_out), self.weight_init,
+                         fan_in=self.n_in, fan_out=self.n_out,
+                         distribution=self.dist, dtype=dtype)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        z = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class AutoEncoder(Layer):
+    """Denoising autoencoder with tied decode weights (reference
+    `nn/conf/layers/AutoEncoder.java` + `nn/layers/feedforward/autoencoder/
+    AutoEncoder.java`): params W, b (hidden), vb (visible); pretrain loss
+    reconstructs corrupted input through W^T."""
+
+    layer_name = "autoencoder"
+
+    n_in: int = 0
+    n_out: int = 0
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss: Any = "mse"
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "sigmoid"
+        self.loss = get_loss(self.loss)
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_in:
+            self.n_in = input_type.arity()
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, rng, dtype=jnp.float32):
+        w = init_weights(rng, (self.n_in, self.n_out), self.weight_init,
+                         fan_in=self.n_in, fan_out=self.n_out,
+                         distribution=self.dist, dtype=dtype)
+        return {
+            "W": w,
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+            "vb": jnp.zeros((self.n_in,), dtype),
+        }
+
+    def encode(self, params, x):
+        return self.activation(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return self.activation(h @ params["W"].T + params["vb"])
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        return self.encode(params, x), state
+
+    def pretrain_loss(self, params, x, rng):
+        """Denoising reconstruction loss for layerwise pretraining
+        (reference `AutoEncoder.computeGradientAndScore`)."""
+        if self.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            corrupted = jnp.where(keep, x, jnp.zeros_like(x))
+        else:
+            corrupted = x
+        recon_pre = self.encode(params, corrupted) @ params["W"].T + params["vb"]
+        return self.loss(x, recon_pre, self.activation)

@@ -1,0 +1,112 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+Reference: `nn/conf/layers/BatchNormalization.java` + runtime
+`nn/layers/normalization/BatchNormalization.java` (cuDNN fast path
+`CudnnBatchNormalizationHelper.java`), `LocalResponseNormalization.java`
+(cuDNN path `CudnnLocalResponseNormalizationHelper.java`).
+
+Param/state naming parity: the reference stores gamma/beta AND the
+running mean/var in the param table (mean/var excluded from backprop);
+here gamma/beta are params and mean/var live in the mutable `state`
+collection — checkpoint serde writes all four, preserving the key names
+("gamma", "beta", "mean", "var").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType, InputTypeConvolutional
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class BatchNormalization(Layer):
+    layer_name = "batchnorm"
+
+    n_out: int = 0  # feature/channel count, inferred
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+    lock_gamma_beta: bool = False
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_out:
+            if isinstance(input_type, InputTypeConvolutional):
+                self.n_out = input_type.channels
+            else:
+                self.n_out = input_type.size if hasattr(input_type, "size") else input_type.arity()
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def init_params(self, rng, dtype=jnp.float32):
+        if self.lock_gamma_beta:
+            return {}
+        return {
+            "gamma": jnp.full((self.n_out,), self.gamma_init, dtype),
+            "beta": jnp.full((self.n_out,), self.beta_init, dtype),
+        }
+
+    def init_state(self, dtype=jnp.float32):
+        return {
+            "mean": jnp.zeros((self.n_out,), dtype),
+            "var": jnp.ones((self.n_out,), dtype),
+        }
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        # normalize over all axes except the last (feature/channel) —
+        # covers FF [B,F], CNN NHWC [B,H,W,C] and RNN [B,T,F] uniformly.
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = 1.0 / jnp.sqrt(var + self.eps)
+        xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+        if not self.lock_gamma_beta:
+            xhat = xhat * params["gamma"].astype(x.dtype) + params["beta"].astype(x.dtype)
+        return self.activation(xhat), new_state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class LocalResponseNormalization(Layer):
+    """Across-channel LRN (AlexNet-style): x / (k + alpha*sum_{window} x^2)^beta."""
+
+    layer_name = "lrn"
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        half = self.n // 2
+        sq = x * x
+        padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, half)))
+        # windowed channel sum via cumulative sum difference (O(C))
+        csum = jnp.cumsum(padded, axis=-1)
+        csum = jnp.pad(csum, ((0, 0), (0, 0), (0, 0), (1, 0)))
+        win = csum[..., self.n:] - csum[..., :-self.n]
+        denom = (self.k + self.alpha * win) ** self.beta
+        return x / denom, state

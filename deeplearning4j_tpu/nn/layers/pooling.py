@@ -1,0 +1,86 @@
+"""Global pooling (reference `nn/conf/layers/GlobalPoolingLayer.java` +
+`nn/layers/pooling/GlobalPoolingLayer.java`): pools over time (RNN
+[B,T,F]) or space (CNN NHWC) with MAX/AVG/SUM/PNORM, mask-aware for
+variable-length sequences (`MaskedReductionUtil` semantics)."""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+from typing import Any
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeRecurrent,
+)
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+class PoolingType(str, Enum):
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class GlobalPoolingLayer(Layer):
+    layer_name = "global_pooling"
+
+    pooling_type: PoolingType = PoolingType.MAX
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def __post_init__(self):
+        self.pooling_type = PoolingType(self.pooling_type)
+        super().__post_init__()
+
+    def get_output_type(self, input_type):
+        if isinstance(input_type, InputTypeRecurrent):
+            return InputType.feed_forward(input_type.size)
+        if isinstance(input_type, InputTypeConvolutional):
+            return InputType.feed_forward(input_type.channels)
+        return input_type
+
+    def _reduce(self, x, axes, mask=None):
+        pt = self.pooling_type
+        if mask is not None:
+            # mask: [B, T] matching axis 1 (time)
+            m = mask
+            while m.ndim < x.ndim:
+                m = m[..., None]
+            if pt == PoolingType.MAX:
+                x = jnp.where(m > 0, x, jnp.full_like(x, -jnp.inf))
+                return jnp.max(x, axis=axes)
+            if pt == PoolingType.SUM:
+                return jnp.sum(x * m, axis=axes)
+            if pt == PoolingType.AVG:
+                denom = jnp.maximum(jnp.sum(m, axis=axes), 1.0)
+                return jnp.sum(x * m, axis=axes) / denom
+            if pt == PoolingType.PNORM:
+                p = float(self.pnorm)
+                return jnp.sum((jnp.abs(x) * m) ** p, axis=axes) ** (1.0 / p)
+        if pt == PoolingType.MAX:
+            return jnp.max(x, axis=axes)
+        if pt == PoolingType.SUM:
+            return jnp.sum(x, axis=axes)
+        if pt == PoolingType.AVG:
+            return jnp.mean(x, axis=axes)
+        if pt == PoolingType.PNORM:
+            p = float(self.pnorm)
+            return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        raise ValueError(pt)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        if x.ndim == 3:  # RNN [B,T,F] — pool over time
+            return self._reduce(x, 1, mask), state
+        if x.ndim == 4:  # CNN NHWC — pool over H,W
+            return self._reduce(x, (1, 2)), state
+        raise ValueError(f"GlobalPooling expects 3d or 4d input, got {x.shape}")
+
+    def forward_mask(self, mask, current_type):
+        return None

@@ -1,0 +1,330 @@
+"""Recurrent layer family: LSTM, GravesLSTM (peepholes),
+GravesBidirectionalLSTM, SimpleRnn, RnnOutputLayer, LastTimeStep.
+
+Reference: `nn/conf/layers/LSTM... GravesLSTM.java`,
+`GravesBidirectionalLSTM.java`, `RnnOutputLayer.java`; runtime math in
+`nn/layers/recurrent/LSTMHelpers.java:68,392` (one shared fwd/bwd impl
+with optional peepholes) and the cuDNN fused path
+`CudnnLSTMHelper.java`.
+
+TPU-first design: the time loop is `lax.scan` (XLA compiles it into a
+single fused while-loop on-device). The input projection `x @ W` for ALL
+timesteps is hoisted out of the scan into one large [B*T, nIn]×[nIn,4H]
+matmul (MXU-friendly); the scan body only does the [B,H]×[H,4H]
+recurrent matmul — the same restructuring cuDNN's fused kernels do.
+
+Conventions (matching the reference):
+- gate order IFOG: input, forget, output, input-modulation
+  (`LSTMParamInitializer.java:136`).
+- param names "W" [nIn,4H], "RW" [H,4H], "b" [4H]; GravesLSTM adds
+  peephole vectors "pI","pF","pO" [H] (the reference packs them into
+  RW's extra 3 columns; kept separate here, converters handle serde).
+- bidirectional sums the two directions' outputs
+  (`GravesBidirectionalLSTM.java:224` "sum outputs").
+- forget-gate bias init default 1.0 (`forgetGateBiasInit`).
+- masks: masked steps carry state through unchanged and emit zeros.
+
+Internal layout is [batch, time, features]; the reference's
+[batch, features, time] appears only at the API boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.common.activations import get_activation
+from deeplearning4j_tpu.common.losses import get_loss
+from deeplearning4j_tpu.common.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType, InputTypeRecurrent
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.feedforward import BaseOutputLayerMixin, DenseLayer
+
+
+class BaseRecurrentLayer(Layer):
+    """Adds the carry-based API used for TBPTT and rnnTimeStep streaming
+    (reference `BaseRecurrentLayer.rnnTimeStep` state keeping)."""
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def forward_with_carry(self, params, state, x, carry, *, train=False, rng=None, mask=None):
+        """Returns (y, new_state, final_carry)."""
+        raise NotImplementedError
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        y, new_state, _ = self.forward_with_carry(
+            params, state, x, self.init_carry(x.shape[0], x.dtype), train=train, rng=rng, mask=mask)
+        return y, new_state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class LSTM(BaseRecurrentLayer):
+    """Standard (no-peephole) LSTM — maps to the cuDNN-compatible subset
+    the reference accelerates via `CudnnLSTMHelper`."""
+
+    layer_name = "lstm"
+
+    n_in: int = 0
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+    gate_activation: Any = "sigmoid"
+
+    peephole = False
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "tanh"
+        self.gate_activation = get_activation(self.gate_activation)
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if not isinstance(input_type, InputTypeRecurrent):
+            raise ValueError(f"{type(self).__name__} expects recurrent input, got {input_type}")
+        if override or not self.n_in:
+            self.n_in = input_type.size
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out, getattr(input_type, "timesteps", None))
+
+    def _direction_params(self, rng, dtype, suffix=""):
+        k1, k2 = jax.random.split(rng)
+        h = self.n_out
+        w = init_weights(k1, (self.n_in, 4 * h), self.weight_init,
+                         fan_in=self.n_in, fan_out=4 * h, distribution=self.dist, dtype=dtype)
+        rw = init_weights(k2, (h, 4 * h), self.weight_init,
+                          fan_in=h, fan_out=4 * h, distribution=self.dist, dtype=dtype)
+        b = jnp.zeros((4 * h,), dtype)
+        # IFOG order: forget block is [h:2h]
+        b = b.at[h:2 * h].set(self.forget_gate_bias_init)
+        params = {"W" + suffix: w, "RW" + suffix: rw, "b" + suffix: b}
+        if self.peephole:
+            params["pI" + suffix] = jnp.zeros((h,), dtype)
+            params["pF" + suffix] = jnp.zeros((h,), dtype)
+            params["pO" + suffix] = jnp.zeros((h,), dtype)
+        return params
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return self._direction_params(rng, dtype)
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        h = self.n_out
+        return (jnp.zeros((batch, h), dtype), jnp.zeros((batch, h), dtype))
+
+    def _scan_direction(self, params, x, carry0, mask, reverse=False, suffix=""):
+        """x: [B,T,nIn] → outputs [B,T,H], final carry."""
+        h_dim = self.n_out
+        w, rw, b = params["W" + suffix], params["RW" + suffix], params["b" + suffix]
+        cdt = x.dtype
+        # hoisted input projection: one big MXU matmul over all timesteps
+        xz = (x.reshape(-1, x.shape[-1]) @ w.astype(cdt)).reshape(
+            x.shape[0], x.shape[1], 4 * h_dim) + b.astype(cdt)
+        xz_t = jnp.swapaxes(xz, 0, 1)  # [T,B,4H] time-major for scan
+        mask_t = None if mask is None else jnp.swapaxes(
+            jnp.broadcast_to(mask[..., None], mask.shape + (1,)), 0, 1)  # [T,B,1]
+        rw_c = rw.astype(cdt)
+        gate, act = self.gate_activation, self.activation
+        peep = self.peephole
+        if peep:
+            p_i = params["pI" + suffix].astype(cdt)
+            p_f = params["pF" + suffix].astype(cdt)
+            p_o = params["pO" + suffix].astype(cdt)
+
+        def cell(carry, inp):
+            h_prev, c_prev = carry
+            if mask_t is None:
+                z = inp
+                m = None
+            else:
+                z, m = inp
+            z = z + h_prev @ rw_c
+            zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+            if peep:
+                zi = zi + p_i * c_prev
+                zf = zf + p_f * c_prev
+            i = gate(zi)
+            f = gate(zf)
+            g = act(zg)
+            c = f * c_prev + i * g
+            if peep:
+                zo = zo + p_o * c
+            o = gate(zo)
+            h = o * act(c)
+            if m is not None:
+                h = jnp.where(m > 0, h, h_prev)
+                c = jnp.where(m > 0, c, c_prev)
+                out = jnp.where(m > 0, h, jnp.zeros_like(h))
+            else:
+                out = h
+            return (h, c), out
+
+        xs = xz_t if mask_t is None else (xz_t, mask_t)
+        final_carry, out_t = lax.scan(cell, carry0, xs, reverse=reverse)
+        return jnp.swapaxes(out_t, 0, 1), final_carry
+
+    def forward_with_carry(self, params, state, x, carry, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        y, final_carry = self._scan_direction(params, x, carry, mask)
+        return y, state, final_carry
+
+    def step(self, params, carry, x_t):
+        """Single-timestep streaming inference (reference `rnnTimeStep`)."""
+        y, carry = self._scan_direction(params, x_t[:, None, :], carry, None)
+        return y[:, 0, :], carry
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (Graves 2013); reference
+    `GravesLSTM.java` / `LSTMHelpers.java` peephole branches."""
+
+    layer_name = "graves_lstm"
+    peephole = True
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class GravesBidirectionalLSTM(LSTM):
+    """Bidirectional peephole LSTM; the two directions' outputs are SUMMED
+    (reference `GravesBidirectionalLSTM.java` activateOutput)."""
+
+    layer_name = "graves_bidirectional_lstm"
+    peephole = True
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kf, kb = jax.random.split(rng)
+        params = self._direction_params(kf, dtype, suffix="F")
+        params.update(self._direction_params(kb, dtype, suffix="B"))
+        return params
+
+    def forward_with_carry(self, params, state, x, carry, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        fwd_carry, bwd_carry = carry
+        yf, cf = self._scan_direction(params, x, fwd_carry, mask, suffix="F")
+        yb, cb = self._scan_direction(params, x, bwd_carry, mask, reverse=True, suffix="B")
+        return yf + yb, state, (cf, cb)
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        one = super().init_carry(batch, dtype)
+        return (one, super().init_carry(batch, dtype))
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class SimpleRnn(BaseRecurrentLayer):
+    """Vanilla Elman RNN: h_t = act(x_t W + h_{t-1} RW + b)."""
+
+    layer_name = "simple_rnn"
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "tanh"
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_in:
+            self.n_in = input_type.size
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out, getattr(input_type, "timesteps", None))
+
+    def init_params(self, rng, dtype=jnp.float32):
+        k1, k2 = jax.random.split(rng)
+        w = init_weights(k1, (self.n_in, self.n_out), self.weight_init,
+                         fan_in=self.n_in, fan_out=self.n_out, distribution=self.dist, dtype=dtype)
+        rw = init_weights(k2, (self.n_out, self.n_out), self.weight_init,
+                          fan_in=self.n_out, fan_out=self.n_out, distribution=self.dist, dtype=dtype)
+        return {"W": w, "RW": rw, "b": jnp.full((self.n_out,), self.bias_init, dtype)}
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def forward_with_carry(self, params, state, x, carry, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        cdt = x.dtype
+        xz = (x.reshape(-1, x.shape[-1]) @ params["W"].astype(cdt)).reshape(
+            x.shape[0], x.shape[1], self.n_out) + params["b"].astype(cdt)
+        xz_t = jnp.swapaxes(xz, 0, 1)
+        mask_t = None if mask is None else jnp.swapaxes(mask, 0, 1)[..., None]
+        rw = params["RW"].astype(cdt)
+        act = self.activation
+
+        def cell(h_prev, inp):
+            if mask_t is None:
+                z, m = inp, None
+            else:
+                z, m = inp
+            h = act(z + h_prev @ rw)
+            if m is not None:
+                h = jnp.where(m > 0, h, h_prev)
+                return h, jnp.where(m > 0, h, jnp.zeros_like(h))
+            return h, h
+
+        xs = xz_t if mask_t is None else (xz_t, mask_t)
+        final_carry, out_t = lax.scan(cell, carry, xs)
+        return jnp.swapaxes(out_t, 0, 1), state, final_carry
+
+    def step(self, params, carry, x_t):
+        y, _, carry = self.forward_with_carry(params, {}, x_t[:, None, :], carry)
+        return y[:, 0, :], carry
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class RnnOutputLayer(DenseLayer, BaseOutputLayerMixin):
+    """Per-timestep output + loss (reference `RnnOutputLayer.java`): the
+    dense projection is applied at every timestep; loss is mask-aware."""
+
+    layer_name = "rnn_output"
+
+    loss: Any = None
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "softmax"
+        if self.loss is None:
+            self.loss = "mcxent"
+        self.loss = get_loss(self.loss)
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_in:
+            self.n_in = input_type.size if isinstance(input_type, InputTypeRecurrent) else input_type.arity()
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out, getattr(input_type, "timesteps", None))
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        return self.activation(self.pre_output(params, x)), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class LastTimeStep(Layer):
+    """Extract the last (mask-aware) timestep: [B,T,F] → [B,F]
+    (reference graph vertex `LastTimeStepVertex.java`, usable as a layer)."""
+
+    layer_name = "last_time_step"
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(input_type.size)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        if mask is None:
+            return x[:, -1, :], state
+        idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+        out = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+        return out, state
+
+    def forward_mask(self, mask, current_type):
+        return None

@@ -1,0 +1,19 @@
+"""Training-loop support: listeners (metrics bus) and gradient
+transforms.
+
+Reference: `optimize/api/IterationListener`/`TrainingListener` +
+`optimize/listeners/*`; the ConvexOptimizer/Solver machinery collapses
+into the containers' jitted train step (SGD is the only optimizer the
+reference effectively uses for NN training — line-search variants are
+legacy), with updaters from `common.updaters`.
+"""
+
+from deeplearning4j_tpu.optimize.listeners import (
+    TrainingListener,
+    ScoreIterationListener,
+    PerformanceListener,
+    CollectScoresListener,
+    TimeIterationListener,
+    EvaluativeListener,
+    ComposedListeners,
+)

@@ -1,0 +1,68 @@
+"""Gradient normalization / clipping — `preApply` semantics.
+
+Reference: `nn/updater/BaseMultiLayerUpdater.java:318` (preApply):
+gradient normalization runs BEFORE the updater, per layer, according to
+`GradientNormalization` (`nn/conf/GradientNormalization.java`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.builder import GradientNormalization
+
+_EPS = 1e-8
+
+
+def _layer_l2(layer_grads: dict):
+    sq = sum(jnp.sum(g * g) for g in jax.tree_util.tree_leaves(layer_grads))
+    return jnp.sqrt(sq + _EPS)
+
+
+def apply_gradient_normalization(grads: dict, mode: GradientNormalization, threshold: float):
+    """`grads` is the per-layer dict {layer_key: {param: grad}}."""
+    if mode == GradientNormalization.NONE:
+        return grads
+    if mode == GradientNormalization.RENORMALIZE_L2_PER_LAYER:
+        return {
+            k: jax.tree_util.tree_map(lambda g, n=_layer_l2(v): g / n, v)
+            for k, v in grads.items()
+        }
+    if mode == GradientNormalization.RENORMALIZE_L2_PER_PARAM_TYPE:
+        return jax.tree_util.tree_map(
+            lambda g: g / jnp.sqrt(jnp.sum(g * g) + _EPS), grads)
+    if mode == GradientNormalization.CLIP_ELEMENTWISE_ABSOLUTE_VALUE:
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if mode == GradientNormalization.CLIP_L2_PER_LAYER:
+        out = {}
+        for k, v in grads.items():
+            n = _layer_l2(v)
+            scale = jnp.minimum(1.0, threshold / n)
+            out[k] = jax.tree_util.tree_map(lambda g: g * scale, v)
+        return out
+    if mode == GradientNormalization.CLIP_L2_PER_PARAM_TYPE:
+        def clip_one(g):
+            n = jnp.sqrt(jnp.sum(g * g) + _EPS)
+            return g * jnp.minimum(1.0, threshold / n)
+        return jax.tree_util.tree_map(clip_one, grads)
+    raise ValueError(mode)
+
+
+def apply_max_norm_constraint(params: dict, max_norm: float):
+    """Post-update max-norm constraint on weight-like params (reference
+    `nn/conf/constraint/MaxNormConstraint` applied via
+    `Model.applyConstraints`)."""
+
+    def constrain(path_key, p):
+        if path_key in ("b", "beta", "gamma") or p.ndim < 2:
+            return p
+        axes = tuple(range(p.ndim - 1))
+        norms = jnp.sqrt(jnp.sum(p * p, axis=axes, keepdims=True) + _EPS)
+        return p * jnp.minimum(1.0, max_norm / norms)
+
+    return {
+        lk: {pk: constrain(pk, pv) for pk, pv in lv.items()}
+        for lk, lv in params.items()
+    }

@@ -1,0 +1,164 @@
+"""Training listeners — the metrics bus.
+
+Reference: `optimize/api/TrainingListener.java` (onEpochStart/End,
+iterationDone…) and `optimize/listeners/`: ScoreIterationListener,
+PerformanceListener (samples/sec, batches/sec, ETL time —
+`PerformanceListener.java:87-88`), EvaluativeListener, CollectScores,
+TimeIteration.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def iteration_done(self, model, iteration: int, epoch: int, score: float, **info):
+        pass
+
+    def on_epoch_start(self, model, epoch: int):
+        pass
+
+    def on_epoch_end(self, model, epoch: int):
+        pass
+
+    def on_fit_start(self, model):
+        pass
+
+    def on_fit_end(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (reference
+    `ScoreIterationListener.java`)."""
+
+    def __init__(self, print_iterations: int = 10, printer: Callable[[str], None] = None):
+        self.print_iterations = max(1, print_iterations)
+        self.printer = printer or (lambda s: log.info(s))
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        if iteration % self.print_iterations == 0:
+            self.printer(f"Score at iteration {iteration} is {score}")
+
+
+class PerformanceListener(TrainingListener):
+    """Samples/sec + batches/sec + ETL time (reference
+    `PerformanceListener.java:87-88`)."""
+
+    def __init__(self, frequency: int = 1, report_etl: bool = True,
+                 printer: Callable[[str], None] = None):
+        self.frequency = max(1, frequency)
+        self.report_etl = report_etl
+        self.printer = printer or (lambda s: log.info(s))
+        self._last_time: Optional[float] = None
+        self.history: List[dict] = []
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            batch = info.get("batch_size", 0)
+            rec = {
+                "iteration": iteration,
+                "batches_per_sec": 1.0 / dt if dt > 0 else float("inf"),
+                "samples_per_sec": batch / dt if dt > 0 else float("inf"),
+                "etl_ms": info.get("etl_ms", 0.0),
+            }
+            self.history.append(rec)
+            msg = (f"iteration {iteration}; iterations/sec: {rec['batches_per_sec']:.3f}; "
+                   f"samples/sec: {rec['samples_per_sec']:.1f}")
+            if self.report_etl:
+                msg += f"; ETL: {rec['etl_ms']:.1f} ms"
+            self.printer(msg)
+        self._last_time = now
+
+
+class CollectScoresListener(TrainingListener):
+    """Accumulates (iteration, score) pairs (reference
+    `CollectScoresIterationListener.java`)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging given an expected iteration count (reference
+    `TimeIterationListener.java`)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 50,
+                 printer: Callable[[str], None] = None):
+        self.total = total_iterations
+        self.frequency = max(1, frequency)
+        self.printer = printer or (lambda s: log.info(s))
+        self._start = None
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        if self._start is None:
+            self._start = time.perf_counter()
+            return
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.perf_counter() - self._start
+            rate = iteration / elapsed
+            remaining = (self.total - iteration) / rate if rate > 0 else float("inf")
+            self.printer(f"iteration {iteration}/{self.total}; ETA {remaining:.0f}s")
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation during training (reference
+    `EvaluativeListener.java` with InvocationType)."""
+
+    def __init__(self, iterator, frequency: int = 1, invocation: str = "epoch_end",
+                 printer: Callable[[str], None] = None):
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.invocation = invocation  # "epoch_end" | "iteration_end"
+        self.printer = printer or (lambda s: log.info(s))
+        self.evaluations: List = []
+
+    def _evaluate(self, model, tag):
+        e = model.evaluate(self.iterator)
+        self.evaluations.append(e)
+        self.printer(f"[{tag}] accuracy={e.accuracy():.4f} f1={e.f1():.4f}")
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        if self.invocation == "iteration_end" and iteration % self.frequency == 0:
+            self._evaluate(model, f"iter {iteration}")
+
+    def on_epoch_end(self, model, epoch):
+        if self.invocation == "epoch_end" and epoch % self.frequency == 0:
+            self._evaluate(model, f"epoch {epoch}")
+
+
+class ComposedListeners(TrainingListener):
+    def __init__(self, listeners):
+        self.listeners = [l for l in (listeners or []) if l is not None]
+
+    def iteration_done(self, *a, **k):
+        for l in self.listeners:
+            l.iteration_done(*a, **k)
+
+    def on_epoch_start(self, *a, **k):
+        for l in self.listeners:
+            l.on_epoch_start(*a, **k)
+
+    def on_epoch_end(self, *a, **k):
+        for l in self.listeners:
+            l.on_epoch_end(*a, **k)
+
+    def on_fit_start(self, *a, **k):
+        for l in self.listeners:
+            l.on_fit_start(*a, **k)
+
+    def on_fit_end(self, *a, **k):
+        for l in self.listeners:
+            l.on_fit_end(*a, **k)

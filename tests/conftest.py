@@ -1,0 +1,14 @@
+"""Test config: force the CPU backend with 8 virtual devices so mesh /
+sharding tests run without TPU hardware (the Spark `local[N]` idea from
+the reference test suite, SURVEY.md §4)."""
+
+import os
+import sys
+
+# Must happen before jax import anywhere.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable axon TPU plugin registration
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
