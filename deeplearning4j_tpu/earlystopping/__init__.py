@@ -1,0 +1,21 @@
+"""Early stopping (reference: `earlystopping/`): configuration,
+termination conditions, score calculators, model savers, trainer.
+"""
+
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+)
+from deeplearning4j_tpu.earlystopping.conditions import (
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.savers import (
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.scorecalc import DataSetLossCalculator
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer

@@ -1,0 +1,52 @@
+"""Model savers (reference `earlystopping/saver/InMemoryModelSaver.java`,
+`LocalFileModelSaver.java`)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, model, score):
+        self._best = model.copy() if hasattr(model, "copy") else model
+
+    def save_latest_model(self, model, score):
+        self._latest = model.copy() if hasattr(model, "copy") else model
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver:
+    def __init__(self, directory):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+
+    @property
+    def best_path(self):
+        return self.directory / "bestModel.zip"
+
+    @property
+    def latest_path(self):
+        return self.directory / "latestModel.zip"
+
+    def save_best_model(self, model, score):
+        ModelSerializer.write_model(model, self.best_path)
+
+    def save_latest_model(self, model, score):
+        ModelSerializer.write_model(model, self.latest_path)
+
+    def get_best_model(self):
+        return ModelSerializer.restore_model(self.best_path)
+
+    def get_latest_model(self):
+        return ModelSerializer.restore_model(self.latest_path)

@@ -1,0 +1,33 @@
+"""Score calculators (reference `earlystopping/scorecalc/
+DataSetLossCalculator.java`): loss over a held-out iterator."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator, as_iterator
+
+
+class DataSetLossCalculator:
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = as_iterator(iterator)
+        self.average = average
+
+    def calculate_score(self, model) -> float:
+        total, n = 0.0, 0
+        self.iterator.reset()
+        for ds in self.iterator:
+            b = ds.num_examples()
+            total += model.score(ds) * (b if self.average else 1.0)
+            n += b if self.average else 1
+        return total / max(n, 1)
+
+
+class ClassificationScoreCalculator:
+    """Score = 1 - accuracy so 'lower is better' holds uniformly."""
+
+    def __init__(self, iterator):
+        self.iterator = as_iterator(iterator)
+
+    def calculate_score(self, model) -> float:
+        e = model.evaluate(self.iterator)
+        return 1.0 - e.accuracy()

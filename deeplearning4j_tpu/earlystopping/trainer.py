@@ -1,0 +1,96 @@
+"""EarlyStoppingTrainer (reference
+`earlystopping/trainer/EarlyStoppingTrainer.java`): epoch loop →
+score on holdout every N epochs → keep best model → stop on
+termination conditions (incl. per-iteration NaN guard via listener)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    TerminationReason,
+)
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+class _IterationGuard(TrainingListener):
+    def __init__(self, conditions):
+        self.conditions = conditions
+        self.triggered: Optional[str] = None
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        if self.triggered:
+            return
+        for c in self.conditions:
+            if c.terminate(score):
+                self.triggered = str(c)
+
+
+class EarlyStoppingTrainer:
+    def __init__(self, config: EarlyStoppingConfiguration, model, train_data):
+        self.config = config
+        self.model = model
+        self.train_data = train_data
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+        guard = _IterationGuard(cfg.iteration_termination_conditions)
+        self.model.listeners = list(self.model.listeners) + [guard]
+
+        best_score, best_epoch = math.inf, -1
+        score_vs_epoch = {}
+        epoch = 0
+        reason = TerminationReason.MAX_EPOCHS
+        details = "no termination condition triggered"
+        while True:
+            self.model.fit(self.train_data, epochs=1)
+            if guard.triggered:
+                reason = TerminationReason.ITERATION_TERMINATION
+                details = guard.triggered
+                break
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                score = (cfg.score_calculator.calculate_score(self.model)
+                         if cfg.score_calculator else self.model.score())
+                score_vs_epoch[epoch] = score
+                if score < best_score:
+                    best_score, best_epoch = score, epoch
+                    if cfg.model_saver:
+                        cfg.model_saver.save_best_model(self.model, score)
+                if cfg.save_last_model and cfg.model_saver:
+                    cfg.model_saver.save_latest_model(self.model, score)
+            stop = False
+            last = score_vs_epoch.get(epoch, self.model.score())
+            for c in cfg.epoch_termination_conditions:
+                if c.terminate(epoch, last):
+                    reason = TerminationReason.EPOCH_TERMINATION
+                    details = str(c)
+                    stop = True
+                    break
+            if stop:
+                break
+            epoch += 1
+
+        best_model = (cfg.model_saver.get_best_model()
+                      if cfg.model_saver and best_epoch >= 0 else self.model)
+        self.model.listeners = [l for l in self.model.listeners if l is not guard]
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            score_vs_epoch=score_vs_epoch,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score,
+            total_epochs=epoch + 1,
+            best_model=best_model,
+        )
+
+
+# Graph models use the same trainer (the reference's
+# EarlyStoppingGraphTrainer only differs in Java generics).
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer

@@ -11,7 +11,7 @@ Here the analytic gradient is jax autodiff; the checker still earns its
 keep by validating every layer's forward math end-to-end (a wrong
 forward gives a consistent-but-wrong gradient; a non-differentiable /
 numerically unstable forward shows up as mismatch). Runs in float64 on
-CPU via the `jax.experimental.enable_x64` context.
+CPU via the `jax.enable_x64` context.
 """
 
 from __future__ import annotations
@@ -42,10 +42,11 @@ def check_gradients_fn(
 
     Returns (ok, max_rel_err, failures).
     """
-    with jax.experimental.enable_x64():
+    with jax.enable_x64(True):
         params64 = jax.tree_util.tree_map(
             lambda a: jnp.asarray(np.asarray(a), jnp.float64), params)
-        grads = jax.grad(lambda p: jnp.asarray(loss_fn(p), jnp.float64))(params64)
+        loss64 = jax.jit(lambda p: jnp.asarray(loss_fn(p), jnp.float64))
+        grads = jax.jit(jax.grad(lambda p: loss64(p)))(params64)
         flat_params, treedef = jax.tree_util.tree_flatten(params64)
         flat_grads = jax.tree_util.tree_leaves(grads)
         rng = np.random.default_rng(seed)
@@ -65,7 +66,7 @@ def check_gradients_fn(
                     pert[idx] = v
                     new_flat = list(flat_params)
                     new_flat[ti] = jnp.asarray(pert)
-                    return float(loss_fn(jax.tree_util.tree_unflatten(treedef, new_flat)))
+                    return float(loss64(jax.tree_util.tree_unflatten(treedef, new_flat)))
 
                 plus = eval_at(orig + epsilon)
                 minus = eval_at(orig - epsilon)
@@ -110,11 +111,24 @@ def check_model_gradients(
     fm = None if features_mask is None else jnp.asarray(np.asarray(features_mask))
     lm = None if labels_mask is None else jnp.asarray(np.asarray(labels_mask))
 
+    from deeplearning4j_tpu.nd.dtype import DataTypePolicy
+
+    saved_policy = model.dtype
+    model.dtype = DataTypePolicy(param_dtype=jnp.float64, compute_dtype=jnp.float64,
+                                 output_dtype=jnp.float64)
+    saved_state = model.net_state
+    model.net_state = jax.tree_util.tree_map(
+        lambda a: np.asarray(a, dtype=np.float64), model.net_state)
+
     def loss_fn(p):
         loss, _ = model._loss_fn(p, model.net_state, jnp.asarray(x), jnp.asarray(y),
                                  None, fm, lm, train=False)
         return loss
 
-    return check_gradients_fn(loss_fn, model.params, epsilon=epsilon,
-                              max_rel_error=max_rel_error,
-                              max_params_per_array=max_params_per_array, seed=seed)
+    try:
+        return check_gradients_fn(loss_fn, model.params, epsilon=epsilon,
+                                  max_rel_error=max_rel_error,
+                                  max_params_per_array=max_params_per_array, seed=seed)
+    finally:
+        model.dtype = saved_policy
+        model.net_state = saved_state

@@ -1,0 +1,315 @@
+"""Graph vertices for DAG models.
+
+Reference: `nn/conf/graph/*.java` (15 vertex types) with runtime twins
+in `nn/graph/vertex/impl/*.java`: ElementWise (Add/Subtract/Product/
+Average/Max), Merge (concat), Subset, L2, L2Normalize, Scale, Shift,
+Reshape, Preprocessor, Stack, Unstack, and rnn vertices
+(LastTimeStepVertex, DuplicateToTimeSeriesVertex).
+
+Each vertex is a pure function of its input arrays; serde mirrors the
+layer registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    InputType,
+    InputTypeConvolutional,
+    InputTypeFeedForward,
+    InputTypeRecurrent,
+)
+
+_VERTEX_REGISTRY: Dict[str, type] = {}
+
+
+def register_vertex(cls):
+    _VERTEX_REGISTRY[cls.vertex_name] = cls
+    return cls
+
+
+class GraphVertex:
+    vertex_name = "base"
+
+    def forward(self, inputs: List[jnp.ndarray], masks=None, train: bool = False):
+        raise NotImplementedError
+
+    def get_output_type(self, input_types: List[InputType]) -> InputType:
+        return input_types[0]
+
+    def forward_mask(self, masks):
+        for m in masks or []:
+            if m is not None:
+                return m
+        return None
+
+    def to_dict(self):
+        d = {"vertex": self.vertex_name}
+        if dataclasses.is_dataclass(self):
+            d.update(dataclasses.asdict(self))
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+
+def vertex_from_dict(d: dict) -> GraphVertex:
+    d = dict(d)
+    name = d.pop("vertex")
+    if name == "preprocessor":
+        from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_from_dict
+        return PreprocessorVertex(preprocessor_from_dict(d["preprocessor"]))
+    return _VERTEX_REGISTRY[name](**d)
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class ElementWiseVertex(GraphVertex):
+    """Pointwise combine (reference `ElementWiseVertex.java`: Add,
+    Subtract, Product, Average, Max)."""
+
+    op: str = "add"
+    vertex_name = "elementwise"
+
+    def forward(self, inputs, masks=None, train=False):
+        op = self.op.lower()
+        if op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("subtract needs exactly 2 inputs")
+            return inputs[0] - inputs[1]
+        if op == "product":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op == "average":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out / len(inputs)
+        if op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown elementwise op {self.op}")
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature/channel axis (reference
+    `MergeVertex.java`). Internal layouts put features/channels LAST, so
+    axis=-1 for FF, RNN and CNN alike."""
+
+    vertex_name = "merge"
+
+    def forward(self, inputs, masks=None, train=False):
+        return jnp.concatenate(inputs, axis=-1)
+
+    def get_output_type(self, input_types):
+        t0 = input_types[0]
+        if isinstance(t0, InputTypeFeedForward):
+            return InputType.feed_forward(sum(t.size for t in input_types))
+        if isinstance(t0, InputTypeRecurrent):
+            return InputType.recurrent(sum(t.size for t in input_types), t0.timesteps)
+        if isinstance(t0, InputTypeConvolutional):
+            return InputType.convolutional(t0.height, t0.width,
+                                           sum(t.channels for t in input_types))
+        return t0
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class SubsetVertex(GraphVertex):
+    """Feature-range slice [from, to] inclusive (reference
+    `SubsetVertex.java`)."""
+
+    from_idx: int = 0
+    to_idx: int = 0
+    vertex_name = "subset"
+
+    def forward(self, inputs, masks=None, train=False):
+        return inputs[0][..., self.from_idx:self.to_idx + 1]
+
+    def get_output_type(self, input_types):
+        size = self.to_idx - self.from_idx + 1
+        t0 = input_types[0]
+        if isinstance(t0, InputTypeRecurrent):
+            return InputType.recurrent(size, t0.timesteps)
+        return InputType.feed_forward(size)
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs, per example (reference
+    `L2Vertex.java`)."""
+
+    eps: float = 1e-8
+    vertex_name = "l2"
+
+    def forward(self, inputs, masks=None, train=False):
+        a, b = inputs
+        d = a - b
+        axes = tuple(range(1, d.ndim))
+        return jnp.sqrt(jnp.sum(d * d, axis=axes) + self.eps)[:, None]
+
+    def get_output_type(self, input_types):
+        return InputType.feed_forward(1)
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||_2 per example (reference `L2NormalizeVertex.java`)."""
+
+    eps: float = 1e-8
+    vertex_name = "l2_normalize"
+
+    def forward(self, inputs, masks=None, train=False):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        norm = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + self.eps)
+        return x / norm
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class ScaleVertex(GraphVertex):
+    scale_factor: float = 1.0
+    vertex_name = "scale"
+
+    def forward(self, inputs, masks=None, train=False):
+        return inputs[0] * self.scale_factor
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class ShiftVertex(GraphVertex):
+    shift_factor: float = 0.0
+    vertex_name = "shift"
+
+    def forward(self, inputs, masks=None, train=False):
+        return inputs[0] + self.shift_factor
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class ReshapeVertex(GraphVertex):
+    """Reshape to [batch, *new_shape] (reference `ReshapeVertex.java`)."""
+
+    new_shape: Any = None
+    vertex_name = "reshape"
+
+    def forward(self, inputs, masks=None, train=False):
+        x = inputs[0]
+        return x.reshape((x.shape[0],) + tuple(self.new_shape))
+
+    def get_output_type(self, input_types):
+        shape = tuple(self.new_shape)
+        if len(shape) == 1:
+            return InputType.feed_forward(shape[0])
+        if len(shape) == 2:
+            return InputType.recurrent(shape[1], shape[0])
+        if len(shape) == 3:
+            return InputType.convolutional(shape[0], shape[1], shape[2])
+        return input_types[0]
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class StackVertex(GraphVertex):
+    """Stack inputs along the BATCH axis (reference `StackVertex.java`,
+    used for shared-weight twin towers)."""
+
+    vertex_name = "stack"
+
+    def forward(self, inputs, masks=None, train=False):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class UnstackVertex(GraphVertex):
+    """Take slice `from_idx` of `stack_size` equal batch chunks
+    (reference `UnstackVertex.java`)."""
+
+    from_idx: int = 0
+    stack_size: int = 1
+    vertex_name = "unstack"
+
+    def forward(self, inputs, masks=None, train=False):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_idx * step:(self.from_idx + 1) * step]
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class LastTimeStepVertex(GraphVertex):
+    """[B,T,F] → [B,F] at the last unmasked step (reference
+    `rnn/LastTimeStepVertex.java`)."""
+
+    vertex_name = "last_time_step"
+
+    def forward(self, inputs, masks=None, train=False):
+        x = inputs[0]
+        mask = masks[0] if masks else None
+        if mask is None:
+            return x[:, -1, :]
+        idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+
+    def get_output_type(self, input_types):
+        return InputType.feed_forward(input_types[0].size)
+
+    def forward_mask(self, masks):
+        return None
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[B,F] → [B,T,F] broadcast over time; T taken from a reference
+    input (reference `rnn/DuplicateToTimeSeriesVertex.java`). Here T
+    comes from the second input array's time dim."""
+
+    vertex_name = "duplicate_to_time_series"
+
+    def forward(self, inputs, masks=None, train=False):
+        x, time_ref = inputs[0], inputs[1]
+        t = time_ref.shape[1]
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[-1]))
+
+    def get_output_type(self, input_types):
+        t = input_types[1].timesteps if isinstance(input_types[1], InputTypeRecurrent) else None
+        return InputType.recurrent(input_types[0].arity(), t)
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class PreprocessorVertex(GraphVertex):
+    """Wraps an InputPreProcessor as a vertex (reference
+    `PreprocessorVertex.java`)."""
+
+    preprocessor: Any = None
+    vertex_name = "preprocessor"
+
+    def forward(self, inputs, masks=None, train=False):
+        return self.preprocessor.pre_process(inputs[0])
+
+    def get_output_type(self, input_types):
+        return self.preprocessor.get_output_type(input_types[0])
+
+    def to_dict(self):
+        return {"vertex": self.vertex_name, "preprocessor": self.preprocessor.to_dict()}

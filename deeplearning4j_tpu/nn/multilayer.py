@@ -162,6 +162,10 @@ class MultiLayerNetwork:
         h, new_state, new_carries, _, mask = self._forward_core(
             params, state, x, train=train, rng=rng, mask=fmask,
             carries=carries, upto=n - 1)
+        if (n - 1) in self.conf.input_preprocessors:
+            pp = self.conf.input_preprocessors[n - 1]
+            h = pp.pre_process(h, mask)
+            mask = pp.process_mask(mask)
         out_layer = self.layers[-1]
         si = str(n - 1)
         lrng = None if rng is None else jax.random.fold_in(rng, n - 1)

@@ -1,0 +1,21 @@
+"""SPMD parallelism — ONE engine replacing the reference's three
+(SURVEY.md §2.13):
+
+- `ParallelWrapper` (thread-per-device replicas + param averaging or
+  encoded gradient sharing) → data-sharded jitted train step; XLA
+  inserts the gradient all-reduce over ICI.
+- Spark `ParameterAveragingTrainingMaster` (sync rounds, tree
+  aggregation) → local-SGD mode: k per-replica steps under `shard_map`,
+  then parameter `pmean` (the `averaging_frequency` knob survives).
+- `SharedTrainingMaster` + Aeron parameter server (async threshold-
+  compressed updates over UDP) → unnecessary on ICI: synchronous
+  `psum` at ~TB/s replaces compressed gossip designed for 10GbE; the
+  cadence knob is kept for DCN-spanning topologies.
+
+Mesh axes are named ("data", "model", "seq", "pipe") so tensor/sequence/
+pipeline parallelism are sharding specs, not new engines.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh, device_mesh
+from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+from deeplearning4j_tpu.parallel.inference import ParallelInference

@@ -1,0 +1,86 @@
+"""Device mesh construction.
+
+The mesh is the TPU-native replacement for the reference's device
+topology handling (`ParallelWrapper`'s one-thread-per-GPU model and the
+Spark cluster layout): a named grid of devices over which arrays are
+sharded with `jax.sharding.NamedSharding`. Axis conventions:
+
+- "data":  data parallelism (gradient all-reduce rides ICI)
+- "model": tensor parallelism (activations/weights split)
+- "seq":   sequence/context parallelism (ring attention)
+- "pipe":  pipeline stages
+- "expert": MoE expert parallelism
+
+Multi-host: the same mesh spans hosts transparently once
+`jax.distributed.initialize()` has run (DCN-spanning axes should be the
+outermost/slowest-varying — `make_mesh` orders axes as given).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Serializable mesh description: ordered {axis_name: size}."""
+
+    axes: tuple  # of (name, size)
+
+    @staticmethod
+    def data_parallel(n: Optional[int] = None) -> "MeshSpec":
+        n = n or len(jax.devices())
+        return MeshSpec((("data", n),))
+
+    @staticmethod
+    def of(**axes: int) -> "MeshSpec":
+        return MeshSpec(tuple(axes.items()))
+
+    def names(self):
+        return tuple(n for n, _ in self.axes)
+
+    def shape(self):
+        return tuple(s for _, s in self.axes)
+
+    def size(self):
+        return int(np.prod(self.shape())) if self.axes else 1
+
+    def to_dict(self):
+        return {"axes": list(map(list, self.axes))}
+
+    @staticmethod
+    def from_dict(d):
+        return MeshSpec(tuple((n, int(s)) for n, s in d["axes"]))
+
+
+def make_mesh(spec: MeshSpec | Dict[str, int] | None = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    if spec is None:
+        spec = MeshSpec.data_parallel()
+    if isinstance(spec, dict):
+        spec = MeshSpec(tuple(spec.items()))
+    devices = list(devices) if devices is not None else jax.devices()
+    n = spec.size()
+    if len(devices) < n:
+        raise ValueError(f"Mesh {spec} needs {n} devices, have {len(devices)}")
+    grid = np.array(devices[:n]).reshape(spec.shape())
+    return Mesh(grid, spec.names())
+
+
+def device_mesh(n_data: Optional[int] = None) -> Mesh:
+    """Convenience: 1-axis data-parallel mesh over all (or n) devices."""
+    return make_mesh(MeshSpec.data_parallel(n_data))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def data_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Batch-dim sharding over the data axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))

@@ -1,0 +1,7 @@
+"""Transfer learning (reference: `nn/transferlearning/`)."""
+
+from deeplearning4j_tpu.transferlearning.transfer import (
+    TransferLearning,
+    FineTuneConfiguration,
+    TransferLearningHelper,
+)

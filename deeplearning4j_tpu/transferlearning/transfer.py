@@ -1,0 +1,197 @@
+"""Transfer learning: graph surgery on config-as-data + param copy.
+
+Reference: `nn/transferlearning/TransferLearning.java:73`
+(fineTuneConfiguration), `:84` (setFeatureExtractor → frozen layers),
+`:98+` (nOutReplace), plus `FineTuneConfiguration` and
+`TransferLearningHelper` (featurize-once workflow).
+
+Because configs are data and params are name-keyed pytrees, surgery is:
+clone config dicts → edit layer list → rebuild net → copy params whose
+layer+shape survive. Freezing = updater→NoOp on the frozen prefix (the
+reference wraps in FrozenLayer; effect is identical: no updates, and
+the helper below skips even computing their gradients by featurizing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.updaters import NoOp, Updater, get_updater
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import as_iterator
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Global overrides applied to every non-frozen layer (reference
+    `FineTuneConfiguration.java`)."""
+
+    updater: Optional[Updater] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    seed: Optional[int] = None
+
+    def apply(self, layer: Layer):
+        if self.updater is not None:
+            layer.updater = get_updater(self.updater)
+        if self.l1 is not None:
+            layer.l1 = self.l1
+        if self.l2 is not None:
+            layer.l2 = self.l2
+        if self.dropout is not None:
+            layer.dropout = self.dropout
+
+
+class TransferLearning:
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            self._net = net
+            self._layers: List[Layer] = [l.clone() for l in net.conf.layers]
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._frozen_upto = -1
+            self._replaced: dict = {}
+            self._appended: List[Layer] = []
+            self._removed_from_output = 0
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] (reference
+            `setFeatureExtractor`)."""
+            self._frozen_upto = layer_idx
+            return self
+
+        def n_out_replace(self, layer_idx: int, n_out: int, weight_init=None):
+            """Replace layer's nOut and re-init it + the next layer's nIn
+            (reference `nOutReplace`)."""
+            self._replaced[layer_idx] = (n_out, weight_init)
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int):
+            self._removed_from_output += n
+            return self
+
+        def add_layer(self, layer: Layer):
+            self._appended.append(layer)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            old_net = self._net
+            layers = self._layers
+            if self._removed_from_output:
+                layers = layers[:-self._removed_from_output]
+            reinit: set = set()
+            for idx, (n_out, wi) in self._replaced.items():
+                layers[idx].n_out = n_out
+                if wi is not None:
+                    layers[idx].weight_init = wi
+                reinit.add(idx)
+                if idx + 1 < len(layers) and hasattr(layers[idx + 1], "n_in"):
+                    layers[idx + 1].n_in = n_out
+                    reinit.add(idx + 1)
+            base = len(layers)
+            layers = layers + [l.clone() for l in self._appended]
+            for i in range(base, len(layers)):
+                reinit.add(i)
+            for i, l in enumerate(layers):
+                if i <= self._frozen_upto:
+                    l.updater = NoOp()
+                elif self._fine_tune is not None:
+                    self._fine_tune.apply(l)
+
+            old = old_net.conf
+            conf = MultiLayerConfiguration(
+                layers=layers,
+                input_preprocessors={i: p for i, p in old.input_preprocessors.items()
+                                     if i < len(layers)},
+                input_type=old.input_type,
+                seed=(self._fine_tune.seed if self._fine_tune and self._fine_tune.seed
+                      else old.seed),
+                backprop_type=old.backprop_type,
+                tbptt_fwd_length=old.tbptt_fwd_length,
+                tbptt_back_length=old.tbptt_back_length,
+                gradient_normalization=old.gradient_normalization,
+                gradient_normalization_threshold=old.gradient_normalization_threshold,
+                max_norm=old.max_norm,
+            )
+            new_net = MultiLayerNetwork(conf, old_net.dtype).init()
+            # copy surviving params (name+shape match, not reinitialized)
+            for i in range(min(len(layers), len(old_net.conf.layers))):
+                si = str(i)
+                if i in reinit or si not in old_net.params:
+                    continue
+                if si in new_net.params:
+                    for pk, arr in old_net.params[si].items():
+                        if pk in new_net.params[si] and \
+                                new_net.params[si][pk].shape == arr.shape:
+                            new_net.params[si][pk] = jnp.asarray(np.asarray(arr))
+                if si in old_net.net_state and si in new_net.net_state:
+                    for pk, arr in old_net.net_state[si].items():
+                        if new_net.net_state[si].get(pk) is not None and \
+                                new_net.net_state[si][pk].shape == arr.shape:
+                            new_net.net_state[si][pk] = jnp.asarray(np.asarray(arr))
+            return new_net
+
+
+class TransferLearningHelper:
+    """Featurize-once workflow (reference `TransferLearningHelper.java`):
+    run inputs through the frozen prefix ONCE, then train only the
+    unfrozen tail on the cached features."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_upto: int):
+        self.full_net = net
+        self.frozen_upto = frozen_upto
+        tail_layers = [l.clone() for l in net.conf.layers[frozen_upto + 1:]]
+        old = net.conf
+        tail_pre = {i - (frozen_upto + 1): p for i, p in old.input_preprocessors.items()
+                    if i > frozen_upto}
+        conf = MultiLayerConfiguration(
+            layers=tail_layers,
+            input_preprocessors=tail_pre,
+            seed=old.seed,
+            backprop_type=old.backprop_type,
+            tbptt_fwd_length=old.tbptt_fwd_length,
+        )
+        self.unfrozen = MultiLayerNetwork(conf, net.dtype).init()
+        for i in range(len(tail_layers)):
+            src = str(i + frozen_upto + 1)
+            dst = str(i)
+            if src in net.params and dst in self.unfrozen.params:
+                self.unfrozen.params[dst] = jax.tree_util.tree_map(
+                    lambda a: a, net.params[src])
+            if src in net.net_state and dst in self.unfrozen.net_state:
+                self.unfrozen.net_state[dst] = jax.tree_util.tree_map(
+                    lambda a: a, net.net_state[src])
+
+    def featurize(self, dataset: DataSet) -> DataSet:
+        acts = self.full_net.feed_forward(jnp.asarray(dataset.features))
+        return DataSet(np.asarray(acts[self.frozen_upto]), dataset.labels,
+                       dataset.features_mask, dataset.labels_mask)
+
+    def fit_featurized(self, data, **kw):
+        self.unfrozen.fit(data, **kw)
+        # write trained tail params back into the full net
+        for i in range(len(self.unfrozen.conf.layers)):
+            src, dst = str(i), str(i + self.frozen_upto + 1)
+            if src in self.unfrozen.params:
+                self.full_net.params[dst] = self.unfrozen.params[src]
+            if src in self.unfrozen.net_state:
+                self.full_net.net_state[dst] = self.unfrozen.net_state[src]
+        return self
+
+    def output_from_featurized(self, features):
+        return self.unfrozen.output(features)

@@ -1,0 +1,3 @@
+"""Utilities: model serialization, model guessing."""
+
+from deeplearning4j_tpu.util.serializer import ModelSerializer

@@ -1,0 +1,125 @@
+"""Model persistence.
+
+Reference: `util/ModelSerializer.java:40,79-120` — a zip containing
+`configuration.json` + `coefficients.bin` (one flat param vector) +
+`updaterState.bin`. Same container idea here: a zip holding
+
+- configuration.json   (MultiLayerConfiguration / ComputationGraph JSON)
+- params.npz           (param table, "0_W"-style keys — the stable
+                        naming replaces flat-vector offsets)
+- state.npz            (BN running stats etc.)
+- updater.npz          (updater state, "<layer>_<param>__<slot>" keys)
+- meta.json            (format version, model class, counters)
+
+`restore` reconstructs the network from config alone then loads arrays —
+the same two-phase restore the reference uses (conf → init → set
+params).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from pathlib import Path
+from typing import Union
+
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _save_npz(zf: zipfile.ZipFile, name: str, arrays: dict):
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    zf.writestr(name, buf.getvalue())
+
+
+def _load_npz(zf: zipfile.ZipFile, name: str) -> dict:
+    if name not in zf.namelist():
+        return {}
+    with zf.open(name) as f:
+        data = np.load(io.BytesIO(f.read()))
+        return {k: data[k] for k in data.files}
+
+
+def _flatten_updater(upd_state: dict) -> dict:
+    flat = {}
+    for lk, lv in upd_state.items():
+        for pk, slots in lv.items():
+            for slot, arr in slots.items():
+                flat[f"{lk}::{pk}__{slot}"] = arr
+    return flat
+
+
+def _unflatten_updater(flat: dict) -> dict:
+    out: dict = {}
+    for key, arr in flat.items():
+        lp, slot = key.rsplit("__", 1)
+        lk, pk = lp.split("::", 1)
+        out.setdefault(lk, {}).setdefault(pk, {})[slot] = jnp.asarray(arr)
+    return out
+
+
+class ModelSerializer:
+    @staticmethod
+    def write_model(model, path: Union[str, Path], save_updater: bool = True):
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        model_type = ("ComputationGraph" if isinstance(model, ComputationGraph)
+                      else "MultiLayerNetwork")
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("configuration.json", model.conf.to_json(indent=2))
+            params_flat = {}
+            for lk, lv in model.params.items():
+                for pk, arr in lv.items():
+                    params_flat[f"{lk}::{pk}"] = arr
+            _save_npz(zf, "params.npz", params_flat)
+            state_flat = {}
+            for lk, lv in model.net_state.items():
+                for pk, arr in lv.items():
+                    state_flat[f"{lk}::{pk}"] = arr
+            _save_npz(zf, "state.npz", state_flat)
+            if save_updater:
+                _save_npz(zf, "updater.npz", _flatten_updater(model.updater_state))
+            zf.writestr("meta.json", json.dumps({
+                "format_version": FORMAT_VERSION,
+                "model_type": model_type,
+                "iteration_count": model.iteration_count,
+                "epoch_count": model.epoch_count,
+            }))
+
+    @staticmethod
+    def restore_model(path: Union[str, Path], load_updater: bool = True):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+        with zipfile.ZipFile(path, "r") as zf:
+            conf_json = json.loads(zf.read("configuration.json"))
+            meta = json.loads(zf.read("meta.json")) if "meta.json" in zf.namelist() else {}
+            if meta.get("model_type") == "ComputationGraph" or \
+                    conf_json.get("format", "").endswith("ComputationGraphConfiguration"):
+                conf = ComputationGraphConfiguration.from_dict(conf_json)
+                model = ComputationGraph(conf)
+            else:
+                conf = MultiLayerConfiguration.from_dict(conf_json)
+                model = MultiLayerNetwork(conf)
+            model.init()
+            params_flat = _load_npz(zf, "params.npz")
+            for key, arr in params_flat.items():
+                lk, pk = key.split("::", 1)
+                model.params[lk][pk] = jnp.asarray(arr)
+            state_flat = _load_npz(zf, "state.npz")
+            for key, arr in state_flat.items():
+                lk, pk = key.split("::", 1)
+                model.net_state.setdefault(lk, {})[pk] = jnp.asarray(arr)
+            if load_updater:
+                upd_flat = _load_npz(zf, "updater.npz")
+                if upd_flat:
+                    model.updater_state = _unflatten_updater(upd_flat)
+            model.iteration_count = meta.get("iteration_count", 0)
+            model.epoch_count = meta.get("epoch_count", 0)
+            return model

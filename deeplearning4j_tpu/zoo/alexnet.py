@@ -1,0 +1,64 @@
+"""AlexNet (reference `zoo/model/AlexNet.java`: conv11x11s4(96) + LRN +
+maxpool → conv5x5(256) + LRN + maxpool → conv3x3(384) ×2 → conv3x3(256)
++ maxpool → dense(4096)×2 with dropout → softmax)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.distributions import NormalDistribution
+from deeplearning4j_tpu.common.updaters import Nesterovs
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    LocalResponseNormalization,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class AlexNet(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 height: int = 224, width: int = 224, channels: int = 3):
+        super().__init__(num_classes=num_classes, seed=seed)
+        self.height, self.width, self.channels = height, width, channels
+
+    def conf(self):
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(Nesterovs(1e-2, 0.9))
+                .weight_init(WeightInit.DISTRIBUTION)
+                .dist(NormalDistribution(0.0, 0.01))
+                .l2(5e-4)
+                .list()
+                .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11), stride=(4, 4),
+                                        activation="relu", name="cnn1"))
+                .layer(LocalResponseNormalization(name="lrn1"))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2), name="maxpool1"))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5), stride=(1, 1),
+                                        padding=(2, 2), activation="relu", bias_init=1.0,
+                                        name="cnn2"))
+                .layer(LocalResponseNormalization(name="lrn2"))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2), name="maxpool2"))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3), stride=(1, 1),
+                                        padding=(1, 1), activation="relu", name="cnn3"))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3), stride=(1, 1),
+                                        padding=(1, 1), activation="relu", bias_init=1.0,
+                                        name="cnn4"))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3), stride=(1, 1),
+                                        padding=(1, 1), activation="relu", bias_init=1.0,
+                                        name="cnn5"))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2), name="maxpool3"))
+                .layer(DenseLayer(n_out=4096, activation="relu", bias_init=1.0,
+                                  dropout=0.5, name="ffn1"))
+                .layer(DenseLayer(n_out=4096, activation="relu", bias_init=1.0,
+                                  dropout=0.5, name="ffn2"))
+                .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                                   loss="mcxent", name="output"))
+                .set_input_type(InputType.convolutional(self.height, self.width, self.channels))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init(self.seed)

@@ -1,0 +1,64 @@
+"""ZooModel template.
+
+Reference: `zoo/ZooModel.java:40-52`: `init()` builds the network from
+its config; `initPretrained()` downloads checked-sum weights
+(`:52-81`). Pretrained downloads require the reference's hosted DL4J
+weight files (Java serialization) — not importable here; pretrained
+loading is wired to our own `ModelSerializer` format plus the Keras
+importer for h5 weights.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from enum import Enum
+from pathlib import Path
+from typing import Optional
+
+from deeplearning4j_tpu.datasets.fetchers import CACHE_DIR
+
+
+class PretrainedType(str, Enum):
+    IMAGENET = "imagenet"
+    MNIST = "mnist"
+    CIFAR10 = "cifar10"
+    VGGFACE = "vggface"
+
+
+class ZooModel:
+    """Subclasses implement `init()` → model and optionally provide
+    pretrained checkpoint URLs."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, **kwargs):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.kwargs = kwargs
+
+    def init(self):
+        raise NotImplementedError
+
+    def pretrained_url(self, ptype: PretrainedType) -> Optional[str]:
+        return None
+
+    def pretrained_checksum(self, ptype: PretrainedType) -> Optional[str]:
+        return None
+
+    def init_pretrained(self, ptype: PretrainedType = PretrainedType.IMAGENET):
+        """Download + verify + load a pretrained checkpoint
+        (reference `ZooModel.initPretrained` with checksum check :81)."""
+        url = self.pretrained_url(ptype)
+        if url is None:
+            raise ValueError(f"{type(self).__name__} has no pretrained weights for {ptype}")
+        dest = CACHE_DIR / "zoo" / f"{type(self).__name__}_{ptype.value}.zip"
+        if not dest.exists():
+            import urllib.request
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            urllib.request.urlretrieve(url, dest)  # noqa: S310
+        expected = self.pretrained_checksum(ptype)
+        if expected:
+            h = hashlib.sha256(dest.read_bytes()).hexdigest()
+            if h != expected:
+                dest.unlink()
+                raise IOError(f"Checksum mismatch for {dest}: {h} != {expected}")
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+        return ModelSerializer.restore_model(dest)

@@ -1,0 +1,47 @@
+"""LeNet (reference `zoo/model/LeNet.java:86-104`): conv5x5(20,relu) →
+maxpool2 → conv5x5(50,relu) → maxpool2 → dense(500,relu) →
+softmax output. BASELINE config 0 model."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class LeNet(ZooModel):
+    def __init__(self, num_classes: int = 10, seed: int = 123,
+                 height: int = 28, width: int = 28, channels: int = 1,
+                 updater=None):
+        super().__init__(num_classes=num_classes, seed=seed)
+        self.height, self.width, self.channels = height, width, channels
+        self.updater = updater or Adam(1e-3)
+
+    def conf(self):
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(self.updater)
+                .weight_init(WeightInit.XAVIER)
+                .list()
+                .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                        activation="relu", name="cnn1"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), name="maxpool1"))
+                .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                                        activation="relu", name="cnn2"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), name="maxpool2"))
+                .layer(DenseLayer(n_out=500, activation="relu", name="ffn1"))
+                .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                                   loss="mcxent", name="output"))
+                .set_input_type(InputType.convolutional(self.height, self.width, self.channels))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init(self.seed)

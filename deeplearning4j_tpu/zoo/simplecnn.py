@@ -1,0 +1,50 @@
+"""SimpleCNN (reference `zoo/model/SimpleCNN.java`): small conv net with
+batchnorm used for quick experiments."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class SimpleCNN(ZooModel):
+    def __init__(self, num_classes: int = 10, seed: int = 123,
+                 height: int = 48, width: int = 48, channels: int = 3):
+        super().__init__(num_classes=num_classes, seed=seed)
+        self.height, self.width, self.channels = height, width, channels
+
+    def conf(self):
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(Adam(1e-3))
+                .weight_init(WeightInit.RELU)
+                .list()
+                .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3), stride=(1, 1),
+                                        convolution_mode=ConvolutionMode.SAME,
+                                        activation="relu"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=32, kernel_size=(3, 3),
+                                        convolution_mode=ConvolutionMode.SAME,
+                                        activation="relu"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=128, activation="relu", dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(self.height, self.width, self.channels))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init(self.seed)

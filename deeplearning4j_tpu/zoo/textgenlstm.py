@@ -1,0 +1,40 @@
+"""TextGenerationLSTM (reference `zoo/model/TextGenerationLSTM.java`):
+two stacked GravesLSTM(256) + RnnOutputLayer over the character
+vocabulary, TBPTT 50. BASELINE config 2 (char-RNN) model."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.updaters import RmsProp
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.builder import BackpropType
+from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class TextGenerationLSTM(ZooModel):
+    def __init__(self, vocab_size: int = 77, hidden: int = 256, seed: int = 123,
+                 tbptt_length: int = 50):
+        super().__init__(num_classes=vocab_size, seed=seed)
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.tbptt_length = tbptt_length
+
+    def conf(self):
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(RmsProp(1e-2))
+                .weight_init(WeightInit.XAVIER)
+                .list()
+                .layer(GravesLSTM(n_in=self.vocab_size, n_out=self.hidden,
+                                  activation="tanh"))
+                .layer(GravesLSTM(n_in=self.hidden, n_out=self.hidden,
+                                  activation="tanh"))
+                .layer(RnnOutputLayer(n_in=self.hidden, n_out=self.vocab_size,
+                                      activation="softmax", loss="mcxent"))
+                .backprop_type(BackpropType.TRUNCATED_BPTT, self.tbptt_length)
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init(self.seed)

@@ -1,0 +1,60 @@
+"""VGG16 / VGG19 (reference `zoo/model/VGG16.java`, `VGG19.java`):
+stacked 3x3 same-padded conv blocks with maxpool, then 4096-dense ×2 and
+softmax."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.updaters import Nesterovs
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+def _vgg_conf(block_sizes, num_classes, seed, height, width, channels):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(Nesterovs(1e-2, 0.9))
+         .weight_init(WeightInit.RELU)
+         .list())
+    i = 0
+    for filters, reps in block_sizes:
+        for _ in range(reps):
+            b = b.layer(ConvolutionLayer(n_out=filters, kernel_size=(3, 3), stride=(1, 1),
+                                         convolution_mode=ConvolutionMode.SAME,
+                                         activation="relu", name=f"conv{i}"))
+            i += 1
+        b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), name=f"pool{i}"))
+    return (b.layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5, name="fc1"))
+             .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5, name="fc2"))
+             .layer(OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent",
+                                name="output"))
+             .set_input_type(InputType.convolutional(height, width, channels))
+             .build())
+
+
+class VGG16(ZooModel):
+    BLOCKS = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 height: int = 224, width: int = 224, channels: int = 3):
+        super().__init__(num_classes=num_classes, seed=seed)
+        self.height, self.width, self.channels = height, width, channels
+
+    def conf(self):
+        return _vgg_conf(self.BLOCKS, self.num_classes, self.seed,
+                         self.height, self.width, self.channels)
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init(self.seed)
+
+
+class VGG19(VGG16):
+    BLOCKS = [(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)]

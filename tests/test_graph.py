@@ -1,0 +1,213 @@
+"""ComputationGraph tests: vertices, topo sort, multi-input/output,
+serde — mirrors the reference TestComputationGraphNetwork."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.fetchers import load_iris
+from deeplearning4j_tpu.datasets.multidataset import MultiDataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import (
+    DuplicateToTimeSeriesVertex,
+    ElementWiseVertex,
+    L2NormalizeVertex,
+    L2Vertex,
+    LastTimeStepVertex,
+    MergeVertex,
+    ReshapeVertex,
+    ScaleVertex,
+    ShiftVertex,
+    StackVertex,
+    SubsetVertex,
+    UnstackVertex,
+    vertex_from_dict,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, LSTM, OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.gradientcheck import check_gradients_fn
+
+
+def simple_graph_conf():
+    g = ComputationGraphConfiguration.graph_builder(
+        NeuralNetConfiguration.builder().seed(42).updater(Adam(0.02)))
+    g.add_inputs("in")
+    g.add_layer("dense", DenseLayer(n_in=4, n_out=16, activation="relu"), "in")
+    g.add_layer("out", OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                   loss="mcxent"), "dense")
+    g.set_outputs("out")
+    return g.build()
+
+
+class TestVertices:
+    def test_elementwise_ops(self):
+        a, b = jnp.ones((2, 3)), 2 * jnp.ones((2, 3))
+        assert float(ElementWiseVertex(op="add").forward([a, b])[0, 0]) == 3
+        assert float(ElementWiseVertex(op="subtract").forward([a, b])[0, 0]) == -1
+        assert float(ElementWiseVertex(op="product").forward([a, b])[0, 0]) == 2
+        assert float(ElementWiseVertex(op="average").forward([a, b])[0, 0]) == 1.5
+        assert float(ElementWiseVertex(op="max").forward([a, b])[0, 0]) == 2
+
+    def test_merge_subset(self):
+        a = jnp.ones((2, 3))
+        b = jnp.zeros((2, 2))
+        m = MergeVertex().forward([a, b])
+        assert m.shape == (2, 5)
+        s = SubsetVertex(from_idx=1, to_idx=3).forward([m])
+        assert s.shape == (2, 3)
+
+    def test_l2_vertices(self):
+        a = jnp.array([[3.0, 4.0]])
+        b = jnp.zeros((1, 2))
+        np.testing.assert_allclose(L2Vertex().forward([a, b]), [[5.0]], rtol=1e-5)
+        n = L2NormalizeVertex().forward([a])
+        np.testing.assert_allclose(n, [[0.6, 0.8]], rtol=1e-5)
+
+    def test_scale_shift_reshape(self):
+        x = jnp.ones((2, 6))
+        np.testing.assert_allclose(ScaleVertex(scale_factor=3.0).forward([x]),
+                                   3 * np.ones((2, 6)))
+        np.testing.assert_allclose(ShiftVertex(shift_factor=1.0).forward([x]),
+                                   2 * np.ones((2, 6)))
+        r = ReshapeVertex(new_shape=[2, 3]).forward([x])
+        assert r.shape == (2, 2, 3)
+
+    def test_stack_unstack(self):
+        a, b = jnp.ones((2, 3)), 2 * jnp.ones((2, 3))
+        st = StackVertex().forward([a, b])
+        assert st.shape == (4, 3)
+        u0 = UnstackVertex(from_idx=0, stack_size=2).forward([st])
+        u1 = UnstackVertex(from_idx=1, stack_size=2).forward([st])
+        np.testing.assert_allclose(u0, a)
+        np.testing.assert_allclose(u1, b)
+
+    def test_rnn_vertices(self):
+        x = jnp.arange(24.0).reshape(2, 4, 3)
+        last = LastTimeStepVertex().forward([x], masks=[None])
+        np.testing.assert_allclose(last, x[:, -1, :])
+        ff = jnp.ones((2, 5))
+        dup = DuplicateToTimeSeriesVertex().forward([ff, x])
+        assert dup.shape == (2, 4, 5)
+
+    def test_vertex_serde(self):
+        for v in [ElementWiseVertex(op="max"), MergeVertex(),
+                  SubsetVertex(from_idx=2, to_idx=5), ScaleVertex(scale_factor=2.0),
+                  StackVertex(), UnstackVertex(from_idx=1, stack_size=3),
+                  LastTimeStepVertex(), ReshapeVertex(new_shape=[3, 4])]:
+            v2 = vertex_from_dict(v.to_dict())
+            assert type(v2) is type(v)
+
+
+class TestGraphContainer:
+    def test_topo_sort_and_fit_iris(self):
+        x, y = load_iris()
+        net = ComputationGraph(simple_graph_conf()).init()
+        net.fit(x, y, epochs=30, batch_size=50)
+        e = net.evaluate(
+            __import__("deeplearning4j_tpu.datasets.iterator",
+                       fromlist=["ArrayDataSetIterator"]).ArrayDataSetIterator(
+                x, y, batch_size=150))
+        assert e.accuracy() > 0.9
+
+    def test_skip_connection_graph(self):
+        """Residual-style add vertex."""
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01)))
+        g.add_inputs("in")
+        g.add_layer("fc1", DenseLayer(n_in=4, n_out=4, activation="tanh"), "in")
+        g.add_vertex("residual", ElementWiseVertex(op="add"), "fc1", "in")
+        g.add_layer("out", OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                       loss="mcxent"), "residual")
+        g.set_outputs("out")
+        conf = g.build()
+        net = ComputationGraph(conf).init()
+        x = np.random.randn(6, 4).astype(np.float32)
+        y = np.eye(2)[np.random.randint(0, 2, 6)].astype(np.float32)
+        net.fit(x, y, epochs=5, batch_size=6)
+        assert np.isfinite(net.score())
+        assert net.output(x).shape == (6, 2)
+
+    def test_multi_input_multi_output(self):
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(2).updater(Adam(0.01)))
+        g.add_inputs("inA", "inB")
+        g.add_vertex("merged", MergeVertex(), "inA", "inB")
+        g.add_layer("shared", DenseLayer(n_in=7, n_out=8, activation="relu"), "merged")
+        g.add_layer("outA", OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                        loss="mcxent"), "shared")
+        g.add_layer("outB", OutputLayer(n_in=8, n_out=1, activation="identity",
+                                        loss="mse"), "shared")
+        g.set_outputs("outA", "outB")
+        net = ComputationGraph(g.build()).init()
+        xa = np.random.randn(5, 3).astype(np.float32)
+        xb = np.random.randn(5, 4).astype(np.float32)
+        ya = np.eye(2)[np.random.randint(0, 2, 5)].astype(np.float32)
+        yb = np.random.randn(5, 1).astype(np.float32)
+        mds = MultiDataSet(features=[xa, xb], labels=[ya, yb])
+        net.fit(mds, epochs=3)
+        oa, ob = net.output(xa, xb)
+        assert oa.shape == (5, 2) and ob.shape == (5, 1)
+
+    def test_rnn_graph_with_last_time_step(self):
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01)))
+        g.add_inputs("seq")
+        g.add_layer("lstm", LSTM(n_in=5, n_out=8), "seq")
+        g.add_vertex("last", LastTimeStepVertex(), "lstm")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                       loss="mcxent"), "last")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        x = np.random.randn(4, 7, 5).astype(np.float32)
+        y = np.eye(3)[np.random.randint(0, 3, 4)].astype(np.float32)
+        net.fit(x, y, epochs=3, batch_size=4)
+        assert net.output(x).shape == (4, 3)
+
+    def test_graph_conf_serde(self):
+        conf = simple_graph_conf()
+        js = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(js)
+        assert conf2.to_json() == js
+        n1 = ComputationGraph(conf).init()
+        n2 = ComputationGraph(conf2).init()
+        for k, v in n1.param_table().items():
+            np.testing.assert_allclose(np.asarray(v), np.asarray(n2.param_table()[k]))
+
+    def test_cycle_detection(self):
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder())
+        g.add_inputs("in")
+        g.add_layer("a", DenseLayer(n_in=2, n_out=2), "b")
+        g.add_layer("b", DenseLayer(n_in=2, n_out=2), "a")
+        g.add_layer("out", OutputLayer(n_in=2, n_out=2), "b")
+        g.set_outputs("out")
+        with pytest.raises(ValueError):
+            g.build()
+
+    def test_graph_gradients(self):
+        """Gradient-check a graph with fan-out (epsilon summation at
+        fan-out comes from autodiff — reference setVertexEpsilon)."""
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(5))
+        g.add_inputs("in")
+        g.add_layer("fc", DenseLayer(n_in=3, n_out=4, activation="tanh"), "in")
+        g.add_vertex("doubled", ElementWiseVertex(op="add"), "fc", "fc")
+        g.add_layer("out", OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                       loss="mcxent"), "doubled")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        x = np.random.default_rng(0).standard_normal((4, 3))
+        y = np.eye(2)[np.random.default_rng(1).integers(0, 2, 4)]
+
+        import jax
+        from deeplearning4j_tpu.nd.dtype import DataTypePolicy
+        net.dtype = DataTypePolicy(jnp.float64, jnp.float64, jnp.float64)
+
+        def loss_fn(p):
+            loss, _ = net._loss_fn(p, net.net_state, [jnp.asarray(x)], [jnp.asarray(y)],
+                                   None, None, None, train=False)
+            return loss
+
+        ok, worst, fails = check_gradients_fn(loss_fn, net.params)
+        assert ok, f"worst {worst} {fails[:3]}"

@@ -1,0 +1,348 @@
+"""Layer-level tests: shapes, forward semantics, serde, gradient checks.
+
+Models the reference's gradientcheck suite
+(`deeplearning4j-core/src/test/java/org/deeplearning4j/gradientcheck/`)
+— every layer family validated against central finite differences.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.gradientcheck import check_gradients_fn, check_model_gradients
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    LSTM,
+    ActivationLayer,
+    AutoEncoder,
+    BatchNormalization,
+    Convolution1DLayer,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LastTimeStep,
+    LocalResponseNormalization,
+    LossLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    SimpleRnn,
+    SpaceToDepthLayer,
+    Subsampling1DLayer,
+    SubsamplingLayer,
+    Upsampling2D,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode
+from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+RNG = jax.random.PRNGKey(0)
+
+
+def rand(*shape, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape)
+
+
+class TestShapes:
+    def test_dense(self):
+        l = DenseLayer(n_in=5, n_out=3)
+        p = l.init_params(RNG)
+        assert p["W"].shape == (5, 3) and p["b"].shape == (3,)
+        y, _ = l.forward(p, {}, rand(2, 5))
+        assert y.shape == (2, 3)
+
+    def test_conv_truncate_and_same(self):
+        l = ConvolutionLayer(n_in=3, n_out=8, kernel_size=(3, 3), stride=(2, 2))
+        out = l.get_output_type(InputType.convolutional(9, 9, 3))
+        assert (out.height, out.width, out.channels) == (4, 4, 8)
+        p = l.init_params(RNG)
+        y, _ = l.forward(p, {}, rand(2, 9, 9, 3))
+        assert y.shape == (2, 4, 4, 8)
+
+        l2 = ConvolutionLayer(n_in=3, n_out=8, kernel_size=(3, 3), stride=(2, 2),
+                              convolution_mode=ConvolutionMode.SAME)
+        out2 = l2.get_output_type(InputType.convolutional(9, 9, 3))
+        assert (out2.height, out2.width) == (5, 5)
+        y2, _ = l2.forward(l2.init_params(RNG), {}, rand(2, 9, 9, 3))
+        assert y2.shape == (2, 5, 5, 8)
+
+    def test_subsampling(self):
+        l = SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2))
+        y, _ = l.forward({}, {}, rand(2, 8, 8, 4))
+        assert y.shape == (2, 4, 4, 4)
+        # max pooling actually takes the max
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        y, _ = l.forward({}, {}, x)
+        np.testing.assert_allclose(np.asarray(y)[0, :, :, 0], [[5, 7], [13, 15]])
+
+    def test_avg_pool_counts_padding_correctly(self):
+        l = SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2), stride=(2, 2),
+                             convolution_mode=ConvolutionMode.SAME)
+        x = jnp.ones((1, 3, 3, 1))
+        y, _ = l.forward({}, {}, x)
+        np.testing.assert_allclose(np.asarray(y), np.ones((1, 2, 2, 1)))
+
+    def test_upsampling_zeropad(self):
+        y, _ = Upsampling2D(size=2).forward({}, {}, rand(1, 3, 3, 2))
+        assert y.shape == (1, 6, 6, 2)
+        y, _ = ZeroPaddingLayer(pad=((1, 2), (0, 3))).forward({}, {}, rand(1, 3, 3, 2))
+        assert y.shape == (1, 6, 6, 2)
+
+    def test_space_to_depth(self):
+        y, _ = SpaceToDepthLayer(block_size=2).forward({}, {}, rand(1, 4, 4, 3))
+        assert y.shape == (1, 2, 2, 12)
+
+    def test_lstm_shapes(self):
+        l = LSTM(n_in=6, n_out=4)
+        p = l.init_params(RNG)
+        assert p["W"].shape == (6, 16) and p["RW"].shape == (4, 16) and p["b"].shape == (16,)
+        y, _ = l.forward(p, {}, rand(3, 7, 6))
+        assert y.shape == (3, 7, 4)
+
+    def test_lstm_forget_bias(self):
+        l = LSTM(n_in=2, n_out=3, forget_gate_bias_init=1.0)
+        b = l.init_params(RNG)["b"]
+        np.testing.assert_allclose(b[3:6], jnp.ones(3))
+        np.testing.assert_allclose(b[:3], jnp.zeros(3))
+
+    def test_bidirectional_sums(self):
+        l = GravesBidirectionalLSTM(n_in=3, n_out=4)
+        p = l.init_params(RNG)
+        assert set(p) == {"WF", "RWF", "bF", "pIF", "pFF", "pOF",
+                          "WB", "RWB", "bB", "pIB", "pFB", "pOB"}
+        y, _ = l.forward(p, {}, rand(2, 5, 3))
+        assert y.shape == (2, 5, 4)
+
+    def test_embedding(self):
+        l = EmbeddingLayer(n_in=10, n_out=4)
+        p = l.init_params(RNG)
+        idx = jnp.array([[1], [3]])
+        y, _ = l.forward(p, {}, idx)
+        assert y.shape == (2, 4)
+        np.testing.assert_allclose(y[0], p["W"][1] + p["b"], atol=1e-6)
+
+    def test_batchnorm_train_vs_eval(self):
+        l = BatchNormalization(n_out=4)
+        p, s = l.init_params(RNG), l.init_state()
+        x = rand(32, 4, seed=3) * 5 + 2
+        y, s2 = l.forward(p, s, x, train=True)
+        np.testing.assert_allclose(float(jnp.mean(y)), 0.0, atol=1e-5)
+        np.testing.assert_allclose(float(jnp.std(y)), 1.0, atol=1e-2)
+        assert not np.allclose(np.asarray(s2["mean"]), 0)
+        # eval path uses running stats
+        y_eval, s3 = l.forward(p, s2, x, train=False)
+        assert s3 is s2
+
+    def test_global_pooling_masked(self):
+        l = GlobalPoolingLayer(pooling_type="avg")
+        x = jnp.stack([jnp.ones((4, 2)), 2 * jnp.ones((4, 2))])
+        mask = jnp.array([[1, 1, 0, 0], [1, 1, 1, 1]], dtype=jnp.float32)
+        y, _ = l.forward({}, {}, x, mask=mask)
+        np.testing.assert_allclose(y, [[1, 1], [2, 2]])
+
+    def test_last_time_step_masked(self):
+        l = LastTimeStep()
+        x = jnp.arange(12.0).reshape(1, 4, 3)
+        mask = jnp.array([[1, 1, 0, 0]], dtype=jnp.float32)
+        y, _ = l.forward({}, {}, x, mask=mask)
+        np.testing.assert_allclose(y, [[3.0, 4.0, 5.0]])
+
+    def test_conv1d_subsampling1d(self):
+        l = Convolution1DLayer(n_in=4, n_out=6, kernel_size=3, stride=1)
+        p = l.init_params(RNG)
+        y, _ = l.forward(p, {}, rand(2, 8, 4))
+        assert y.shape == (2, 6, 6)
+        s = Subsampling1DLayer(kernel_size=2, stride=2)
+        y2, _ = s.forward({}, {}, y)
+        assert y2.shape == (2, 3, 6)
+
+    def test_lrn_shape_preserved(self):
+        l = LocalResponseNormalization()
+        x = rand(2, 4, 4, 8)
+        y, _ = l.forward({}, {}, x)
+        assert y.shape == x.shape
+
+    def test_dropout_train_only(self):
+        l = DropoutLayer(dropout=0.5)
+        x = jnp.ones((4, 10))
+        y_eval, _ = l.forward({}, {}, x, train=False)
+        np.testing.assert_allclose(y_eval, x)
+        y_train, _ = l.forward({}, {}, x, train=True, rng=RNG)
+        assert float(jnp.min(y_train)) == 0.0  # some dropped
+        assert float(jnp.max(y_train)) == 2.0  # inverted scaling 1/0.5
+
+
+class TestSerde:
+    @pytest.mark.parametrize("layer", [
+        DenseLayer(n_in=3, n_out=4, activation="relu", l2=1e-4),
+        OutputLayer(n_in=4, n_out=2, loss="mcxent"),
+        ConvolutionLayer(n_in=1, n_out=6, kernel_size=(5, 5),
+                         convolution_mode=ConvolutionMode.SAME),
+        SubsamplingLayer(pooling_type="avg", kernel_size=(3, 3)),
+        LSTM(n_in=5, n_out=7, gate_activation="hardsigmoid"),
+        GravesLSTM(n_in=5, n_out=7),
+        GravesBidirectionalLSTM(n_in=5, n_out=7),
+        BatchNormalization(n_out=3, decay=0.8),
+        EmbeddingLayer(n_in=100, n_out=16),
+        GlobalPoolingLayer(pooling_type="pnorm", pnorm=3),
+        RnnOutputLayer(n_in=4, n_out=2),
+        AutoEncoder(n_in=8, n_out=4, corruption_level=0.2),
+        ZeroPaddingLayer(pad=2),
+        LossLayer(loss="mse", activation="identity"),
+    ])
+    def test_roundtrip(self, layer):
+        d = layer.to_dict()
+        import json
+        layer2 = layer_from_dict(json.loads(json.dumps(d)))
+        assert layer2 == layer
+
+
+class TestGradientChecks:
+    """Central finite-difference validation, per layer family
+    (reference GradientCheckTests / CNNGradientCheckTest /
+    LSTMGradientCheckTests)."""
+
+    def _check(self, conf, x, y, **kw):
+        net = MultiLayerNetwork(conf).init()
+        ok, worst, failures = check_model_gradients(net, x, y, **kw)
+        assert ok, f"worst rel err {worst}; failures {failures[:5]}"
+
+    def test_dense_mlp(self):
+        conf = (NeuralNetConfiguration.builder().seed(42).list()
+                .layer(DenseLayer(n_in=4, n_out=5, activation="tanh"))
+                .layer(OutputLayer(n_in=5, n_out=3, activation="softmax", loss="mcxent"))
+                .build())
+        x = np.random.default_rng(0).standard_normal((6, 4))
+        y = np.eye(3)[np.random.default_rng(1).integers(0, 3, 6)]
+        self._check(conf, x, y)
+
+    def test_dense_l1_l2(self):
+        conf = (NeuralNetConfiguration.builder().seed(42).l2(1e-2).l1(1e-3).list()
+                .layer(DenseLayer(n_in=4, n_out=5, activation="sigmoid"))
+                .layer(OutputLayer(n_in=5, n_out=3, activation="identity", loss="mse"))
+                .build())
+        x = np.random.default_rng(0).standard_normal((5, 4))
+        y = np.random.default_rng(1).standard_normal((5, 3))
+        self._check(conf, x, y)
+
+    def test_cnn(self):
+        conf = (NeuralNetConfiguration.builder().seed(42).list()
+                .layer(ConvolutionLayer(n_out=3, kernel_size=(2, 2), stride=(1, 1),
+                                        activation="tanh"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(6, 6, 2))
+                .build())
+        x = np.random.default_rng(0).standard_normal((3, 6, 6, 2))
+        y = np.eye(2)[np.random.default_rng(1).integers(0, 2, 3)]
+        self._check(conf, x, y)
+
+    def test_batchnorm(self):
+        conf = (NeuralNetConfiguration.builder().seed(42).list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="identity"))
+                .layer(BatchNormalization())
+                .layer(ActivationLayer(activation="relu"))
+                .layer(OutputLayer(n_in=6, n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        x = np.random.default_rng(0).standard_normal((8, 4))
+        y = np.eye(3)[np.random.default_rng(1).integers(0, 3, 8)]
+        # batch-stat path is evaluated train=False inside the checker but
+        # uses running stats — use train stats by pre-populating state
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(jnp.asarray(x))  # populate nothing; just smoke
+        ok, worst, failures = check_model_gradients(net, x, y, max_rel_error=1e-4)
+        assert ok, f"worst {worst} {failures[:3]}"
+
+    def test_lstm(self):
+        conf = (NeuralNetConfiguration.builder().seed(42).list()
+                .layer(LSTM(n_in=3, n_out=4, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss="mcxent"))
+                .build())
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 5, 3))
+        y = np.eye(2)[rng.integers(0, 2, (2, 5))]
+        self._check(conf, x, y)
+
+    def test_graves_lstm_peepholes(self):
+        conf = (NeuralNetConfiguration.builder().seed(42).list()
+                .layer(GravesLSTM(n_in=3, n_out=4))
+                .layer(RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss="mcxent"))
+                .build())
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 4, 3))
+        y = np.eye(2)[rng.integers(0, 2, (2, 4))]
+        self._check(conf, x, y)
+
+    def test_bidirectional_lstm_masked(self):
+        conf = (NeuralNetConfiguration.builder().seed(42).list()
+                .layer(GravesBidirectionalLSTM(n_in=3, n_out=4))
+                .layer(RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss="mcxent"))
+                .build())
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 5, 3))
+        y = np.eye(2)[rng.integers(0, 2, (2, 5))]
+        fmask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], dtype=np.float64)
+        net = MultiLayerNetwork(conf).init()
+        ok, worst, failures = check_model_gradients(
+            net, x, y, features_mask=fmask, labels_mask=fmask)
+        assert ok, f"worst {worst} {failures[:3]}"
+
+    def test_simple_rnn(self):
+        conf = (NeuralNetConfiguration.builder().seed(42).list()
+                .layer(SimpleRnn(n_in=3, n_out=4))
+                .layer(RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss="mcxent"))
+                .build())
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 5, 3))
+        y = np.eye(2)[rng.integers(0, 2, (2, 5))]
+        self._check(conf, x, y)
+
+    def test_global_pooling_cnn(self):
+        conf = (NeuralNetConfiguration.builder().seed(42).list()
+                .layer(ConvolutionLayer(n_out=3, kernel_size=(2, 2), activation="tanh"))
+                .layer(GlobalPoolingLayer(pooling_type="avg"))
+                .layer(OutputLayer(n_in=3, n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(5, 5, 2))
+                .build())
+        x = np.random.default_rng(0).standard_normal((3, 5, 5, 2))
+        y = np.eye(2)[np.random.default_rng(1).integers(0, 2, 3)]
+        self._check(conf, x, y)
+
+    def test_embedding_gradient(self):
+        conf = (NeuralNetConfiguration.builder().seed(42).list()
+                .layer(EmbeddingLayer(n_in=8, n_out=4, activation="tanh"))
+                .layer(OutputLayer(n_in=4, n_out=3, activation="softmax", loss="mcxent"))
+                .build())
+        x = np.random.default_rng(0).integers(0, 8, (6, 1)).astype(np.float64)
+        y = np.eye(3)[np.random.default_rng(1).integers(0, 3, 6)]
+        self._check(conf, x, y)
+
+    @pytest.mark.parametrize("loss,act", [
+        ("mse", "identity"), ("mae", "identity"), ("xent", "sigmoid"),
+        ("hinge", "identity"), ("poisson", "softplus"), ("squaredhinge", "identity"),
+    ])
+    def test_loss_functions(self, loss, act):
+        """Reference LossFunctionGradientCheck."""
+        conf = (NeuralNetConfiguration.builder().seed(42).list()
+                .layer(DenseLayer(n_in=3, n_out=4, activation="tanh"))
+                .layer(OutputLayer(n_in=4, n_out=2, activation=act, loss=loss))
+                .build())
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((5, 3))
+        if loss in ("xent",):
+            y = rng.integers(0, 2, (5, 2)).astype(np.float64)
+        elif loss in ("hinge", "squaredhinge"):
+            y = np.eye(2)[rng.integers(0, 2, 5)]
+        elif loss == "poisson":
+            y = rng.poisson(2.0, (5, 2)).astype(np.float64)
+        else:
+            y = rng.standard_normal((5, 2))
+        self._check(conf, x, y)

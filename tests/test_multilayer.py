@@ -1,0 +1,206 @@
+"""MultiLayerNetwork container tests: end-to-end training, serde,
+masking, TBPTT, streaming inference — mirrors the reference's
+MultiLayerTest / BackPropMLPTest / MultiLayerTestRNN."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.common.updaters import Adam, Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import IrisDataSetIterator, load_iris
+from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.builder import BackpropType, MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    LSTM,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+
+
+def iris_mlp_conf(updater=None):
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(updater or Adam(0.02))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+
+
+class TestTraining:
+    def test_iris_learns(self):
+        x, y = load_iris()
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        listener = CollectScoresListener()
+        net.set_listeners(listener)
+        net.fit(x, y, epochs=30, batch_size=50)
+        e = net.evaluate(ArrayDataSetIterator(x, y, batch_size=150))
+        assert e.accuracy() > 0.9, e.stats()
+        first_score = listener.scores[0][1]
+        last_score = listener.scores[-1][1]
+        assert last_score < first_score * 0.5
+
+    def test_score_decreases_xor(self):
+        x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], dtype=np.float32)
+        y = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], dtype=np.float32)
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.1)).list()
+                .layer(DenseLayer(n_in=2, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax", loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y, epochs=200, batch_size=4, shuffle=False)
+        out = np.asarray(net.output(x))
+        assert np.all(np.argmax(out, 1) == np.argmax(y, 1))
+
+    def test_output_shape_and_softmax(self):
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        out = np.asarray(net.output(np.random.randn(5, 4).astype(np.float32)))
+        assert out.shape == (5, 3)
+        np.testing.assert_allclose(out.sum(axis=1), np.ones(5), atol=1e-5)
+
+    def test_num_params(self):
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        assert net.num_params() == 4 * 16 + 16 + 16 * 3 + 3
+
+    def test_param_table_keys(self):
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        assert set(net.param_table()) == {"0_W", "0_b", "1_W", "1_b"}
+
+    def test_fit_with_iterator_and_listeners(self):
+        it = IrisDataSetIterator(batch_size=32)
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        scores = CollectScoresListener()
+        net.set_listeners(scores)
+        net.fit(it, epochs=3)
+        assert net.iteration_count == 3 * 5  # 150/32 → 5 batches
+        assert net.epoch_count == 3
+        assert len(scores.scores) == 15
+
+    def test_cnn_smoke(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2)).list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3), activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.randn(8, 8, 8, 1).astype(np.float32)
+        y = np.eye(2)[np.random.randint(0, 2, 8)].astype(np.float32)
+        s0 = None
+        net.fit(x, y, epochs=10, batch_size=8, shuffle=False)
+        assert np.isfinite(net.score())
+
+    def test_nchw_data_format(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3)))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(6, 6, 2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x_nchw = np.random.randn(4, 2, 6, 6).astype(np.float32)
+        out = net.output(x_nchw, data_format="NCHW")
+        assert out.shape == (4, 2)
+        # same data in native NHWC gives identical results
+        out2 = net.output(np.transpose(x_nchw, (0, 2, 3, 1)))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-6)
+
+
+class TestRnn:
+    def _rnn_conf(self, tbptt=False):
+        b = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2)).list()
+             .layer(LSTM(n_in=5, n_out=8))
+             .layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax", loss="mcxent")))
+        if tbptt:
+            b = b.backprop_type(BackpropType.TRUNCATED_BPTT, 4)
+        return b.build()
+
+    def test_rnn_fit_and_output(self):
+        net = MultiLayerNetwork(self._rnn_conf()).init()
+        x = np.random.randn(4, 10, 5).astype(np.float32)
+        y = np.eye(3)[np.random.randint(0, 3, (4, 10))].astype(np.float32)
+        net.fit(x, y, epochs=3, batch_size=4)
+        out = net.output(x)
+        assert out.shape == (4, 10, 3)
+
+    def test_tbptt_runs(self):
+        net = MultiLayerNetwork(self._rnn_conf(tbptt=True)).init()
+        x = np.random.randn(2, 12, 5).astype(np.float32)
+        y = np.eye(3)[np.random.randint(0, 3, (2, 12))].astype(np.float32)
+        net.fit(x, y, epochs=2, batch_size=2)
+        assert np.isfinite(net.score())
+
+    def test_variable_length_masking(self):
+        """Masked steps must not change the loss (reference
+        TestVariableLengthTS idea)."""
+        net = MultiLayerNetwork(self._rnn_conf()).init()
+        x_short = np.random.randn(2, 3, 5).astype(np.float32)
+        y_short = np.eye(3)[np.random.randint(0, 3, (2, 3))].astype(np.float32)
+        # pad to length 6 with garbage + mask
+        x_pad = np.concatenate([x_short, 99 * np.ones((2, 3, 5), np.float32)], axis=1)
+        y_pad = np.concatenate([y_short, np.zeros((2, 3, 3), np.float32)], axis=1)
+        mask = np.concatenate([np.ones((2, 3)), np.zeros((2, 3))], axis=1).astype(np.float32)
+        s_short = net.score(DataSet(x_short, y_short))
+        s_pad = net.score(DataSet(x_pad, y_pad, features_mask=mask, labels_mask=mask))
+        np.testing.assert_allclose(s_short, s_pad, rtol=1e-5)
+
+    def test_rnn_time_step_matches_full_forward(self):
+        """Streaming rnnTimeStep == full-sequence forward (reference
+        MultiLayerTestRNN.testRnnTimeStep)."""
+        net = MultiLayerNetwork(self._rnn_conf()).init()
+        x = np.random.randn(2, 6, 5).astype(np.float32)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        stream = []
+        for t in range(6):
+            stream.append(np.asarray(net.rnn_time_step(x[:, t, :])))
+        stream = np.stack(stream, axis=1)
+        np.testing.assert_allclose(full, stream, atol=1e-5)
+
+    def test_nft_data_format(self):
+        net = MultiLayerNetwork(self._rnn_conf()).init()
+        x = np.random.randn(2, 6, 5).astype(np.float32)
+        x_nft = np.transpose(x, (0, 2, 1))  # [B,F,T] reference layout
+        out_native = np.asarray(net.output(x))
+        out_nft = np.asarray(net.output(x_nft, data_format="NFT"))
+        np.testing.assert_allclose(out_native, out_nft, atol=1e-6)
+
+
+class TestConfSerde:
+    def test_multilayer_conf_json_roundtrip(self):
+        conf = (NeuralNetConfiguration.builder().seed(11).updater(Adam(2e-3))
+                .l2(1e-4).list()
+                .layer(ConvolutionLayer(n_out=6, kernel_size=(5, 5), activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(BatchNormalization())
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(28, 28, 1))
+                .build())
+        js = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        assert conf2.to_json() == js
+        # same params from same seed
+        n1 = MultiLayerNetwork(conf).init()
+        n2 = MultiLayerNetwork(conf2).init()
+        for k in n1.param_table():
+            np.testing.assert_allclose(np.asarray(n1.param_table()[k]),
+                                       np.asarray(n2.param_table()[k]))
+
+    def test_dropout_not_applied_at_inference(self):
+        conf = (NeuralNetConfiguration.builder().seed(5).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu", dropout=0.5))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax", loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.randn(3, 4).astype(np.float32)
+        o1 = np.asarray(net.output(x))
+        o2 = np.asarray(net.output(x))
+        np.testing.assert_allclose(o1, o2)

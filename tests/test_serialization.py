@@ -1,0 +1,82 @@
+"""ModelSerializer round-trip tests (reference: ModelSerializer zip of
+configuration.json + coefficients + updaterState)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.fetchers import load_iris
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization,
+    DenseLayer,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+
+def test_multilayer_roundtrip(tmp_path):
+    conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(0.02)).list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x, y = load_iris()
+    net.fit(x, y, epochs=2, batch_size=50)
+    path = tmp_path / "model.zip"
+    ModelSerializer.write_model(net, path)
+    net2 = ModelSerializer.restore_model(path)
+    # params, state, updater state, counters and outputs all survive
+    for k, v in net.param_table().items():
+        np.testing.assert_allclose(np.asarray(v), np.asarray(net2.param_table()[k]))
+    np.testing.assert_allclose(np.asarray(net.net_state["1"]["mean"]),
+                               np.asarray(net2.net_state["1"]["mean"]))
+    assert net2.iteration_count == net.iteration_count
+    out1 = np.asarray(net.output(x[:8]))
+    out2 = np.asarray(net2.output(x[:8]))
+    np.testing.assert_allclose(out1, out2, atol=1e-6)
+    # training continues seamlessly (updater state restored)
+    m0 = np.asarray(net.updater_state["0"]["W"]["m"])
+    m2 = np.asarray(net2.updater_state["0"]["W"]["m"])
+    np.testing.assert_allclose(m0, m2)
+    net2.fit(x, y, epochs=1, batch_size=50)
+
+
+def test_lstm_roundtrip(tmp_path):
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(LSTM(n_in=3, n_out=5))
+            .layer(RnnOutputLayer(n_in=5, n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    path = tmp_path / "lstm.zip"
+    ModelSerializer.write_model(net, path)
+    net2 = ModelSerializer.restore_model(path)
+    x = np.random.randn(2, 4, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)), np.asarray(net2.output(x)),
+                               atol=1e-6)
+
+
+def test_graph_roundtrip(tmp_path):
+    g = ComputationGraphConfiguration.graph_builder(
+        NeuralNetConfiguration.builder().seed(9).updater(Adam(0.01)))
+    g.add_inputs("in")
+    g.add_layer("fc_1", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+    g.add_vertex("res", ElementWiseVertex(op="add"), "fc_1", "fc_1")
+    g.add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss="mcxent"), "res")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    x, y = load_iris()
+    net.fit(x, y, epochs=1, batch_size=50)
+    path = tmp_path / "graph.zip"
+    ModelSerializer.write_model(net, path)
+    net2 = ModelSerializer.restore_model(path)
+    assert isinstance(net2, ComputationGraph)
+    np.testing.assert_allclose(np.asarray(net.output(x[:5])),
+                               np.asarray(net2.output(x[:5])), atol=1e-6)
