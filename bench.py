@@ -1,0 +1,70 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+
+BASELINE config 1 (north star). The reference publishes no numbers
+(BASELINE.md); `REF_BASELINE` below is the comparison anchor we adopt:
+a strong fp32 ResNet-50 per-V100 training throughput (~360 img/s) for
+the DL4J-era cuDNN path the north star names. `vs_baseline` =
+measured / REF_BASELINE.
+
+Runs on whatever jax.default_backend() provides (the driver runs it on
+one real TPU chip). Synthetic data (BenchmarkDataSetIterator pattern,
+reference `datasets/iterator/impl/BenchmarkDataSetIterator.java`) so
+ETL is excluded, matching how the reference's PerformanceListener
+isolates compute.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REF_BASELINE = 360.0  # img/s — est. per-V100 fp32 ResNet-50 (cuDNN-era)
+
+
+def main():
+    from deeplearning4j_tpu.zoo.resnet50 import ResNet50
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch = 64 if on_tpu else 8
+    size = 224 if on_tpu else 64
+    steps = 20 if on_tpu else 3
+
+    model = ResNet50(num_classes=1000, height=size, width=size, channels=3)
+    net = model.init()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, size, size, 3)), jnp.bfloat16 if on_tpu else jnp.float32)
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+
+    step = net._make_train_step()
+    params, upd, state = net.params, net.updater_state, net.net_state
+
+    # warmup / compile
+    params, upd, state, loss = _run(step, params, upd, state, 0, x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(1, steps + 1):
+        params, upd, state, loss = _run(step, params, upd, state, i, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / REF_BASELINE, 3),
+    }))
+
+
+def _run(step, params, upd, state, it, x, y):
+    out = step(params, upd, state, it, [x], [y], jax.random.PRNGKey(it), None, None)
+    params, upd, state, loss = out[0], out[1], out[2], out[3]
+    return params, upd, state, loss
+
+
+if __name__ == "__main__":
+    main()

@@ -1,14 +1,21 @@
 """Test config: force the CPU backend with 8 virtual devices so mesh /
 sharding tests run without TPU hardware (the Spark `local[N]` idea from
-the reference test suite, SURVEY.md §4)."""
+the reference test suite, SURVEY.md §4).
+
+The axon TPU plugin registers itself at interpreter start (sitecustomize)
+and forces `jax_platforms="axon,cpu"` via jax config — so env vars alone
+are too late. We update the config explicitly before any backend
+initialization.
+"""
 
 import os
 import sys
 
-# Must happen before jax import anywhere.
-os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable axon TPU plugin registration
-os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
