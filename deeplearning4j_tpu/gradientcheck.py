@@ -101,7 +101,8 @@ def check_model_gradients(
     Dropout must be disabled in the config (the reference asserts this
     too — stochastic forward breaks finite differences)."""
     for layer in model.layers:
-        if layer.dropout is not None and layer.dropout < 1.0:
+        d = layer.dropout
+        if d is not None and (not isinstance(d, (int, float)) or d < 1.0):
             raise ValueError("Gradient checks require dropout disabled "
                              "(reference GradientCheckUtil precondition)")
     if not model._initialized:

@@ -12,3 +12,16 @@ from deeplearning4j_tpu.nn.conf.builder import (
     NeuralNetConfiguration,
     MultiLayerConfiguration,
 )
+from deeplearning4j_tpu.nn.conf.dropout import (
+    Dropout,
+    AlphaDropout,
+    GaussianDropout,
+    GaussianNoise,
+)
+from deeplearning4j_tpu.nn.conf.weightnoise import DropConnect, WeightNoise
+from deeplearning4j_tpu.nn.conf.constraints import (
+    MaxNormConstraint,
+    MinMaxNormConstraint,
+    UnitNormConstraint,
+    NonNegativeConstraint,
+)

@@ -132,11 +132,11 @@ def _expected_family(layer: Layer) -> str:
     # which input family does this layer natively consume?
     name = layer.layer_name
     if name in ("convolution", "subsampling", "upsampling2d", "zeropadding",
-                "space_to_depth", "lrn"):
+                "space_to_depth", "lrn", "yolo2_output"):
         return "cnn"
     if name in ("lstm", "graves_lstm", "graves_bidirectional_lstm", "simple_rnn",
                 "rnn_output", "convolution1d", "subsampling1d", "zeropadding1d",
-                "last_time_step"):
+                "upsampling1d", "last_time_step"):
         return "rnn"
     if name in ("batchnorm", "activation", "dropout_layer", "global_pooling", "loss"):
         return "any"

@@ -1,0 +1,122 @@
+"""Parameter constraints applied after each update step.
+
+Reference: `nn/conf/constraint/BaseConstraint.java` + MaxNormConstraint,
+MinMaxNormConstraint, UnitNormConstraint, NonNegativeConstraint —
+invoked via `Model.applyConstraints` (`nn/api/Model.java:264`) at the
+end of every iteration. By default constraints apply to weight-like
+params only (the reference constrains params enumerated per-constraint;
+biases are opt-in via `apply_to_bias`).
+
+Norms reduce over all axes except the last (output/feature axis) —
+matching the reference's per-output-unit column norms on [in, out]
+dense weights and [kh, kw, in, out] conv kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+_CONSTRAINT_REGISTRY = {}
+_EPS = 1e-8
+
+
+def register_constraint(cls):
+    _CONSTRAINT_REGISTRY[cls.kind] = cls
+    return cls
+
+
+class LayerConstraint:
+    kind = "base"
+    apply_to_bias: bool = False
+
+    def apply(self, w):
+        raise NotImplementedError
+
+    def apply_params(self, params: dict) -> dict:
+        out = {}
+        for name, w in params.items():
+            is_bias = name == "b" or name.endswith("_b") or name in ("beta", "gamma")
+            if (is_bias and not self.apply_to_bias) or w.ndim < 1:
+                out[name] = w
+            else:
+                out[name] = self.apply(w)
+        return out
+
+    def _norms(self, w):
+        axes = tuple(range(w.ndim - 1)) if w.ndim > 1 else (0,)
+        return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True) + _EPS)
+
+    def to_dict(self):
+        d = {"kind": self.kind}
+        for f in dataclasses.fields(self):
+            d[f.name] = getattr(self, f.name)
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+
+def constraint_from_dict(d):
+    d = dict(d)
+    cls = _CONSTRAINT_REGISTRY[d.pop("kind")]
+    return cls(**d)
+
+
+@register_constraint
+@dataclasses.dataclass(eq=False)
+class MaxNormConstraint(LayerConstraint):
+    """Rescale columns whose L2 norm exceeds `max_norm`
+    (reference `MaxNormConstraint.java`)."""
+
+    kind = "max_norm"
+    max_norm: float = 2.0
+    apply_to_bias: bool = False
+
+    def apply(self, w):
+        n = self._norms(w)
+        return w * jnp.minimum(1.0, self.max_norm / n)
+
+
+@register_constraint
+@dataclasses.dataclass(eq=False)
+class MinMaxNormConstraint(LayerConstraint):
+    """Clamp column norms into [min, max], interpolated by `rate`
+    (reference `MinMaxNormConstraint.java`)."""
+
+    kind = "min_max_norm"
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0
+    apply_to_bias: bool = False
+
+    def apply(self, w):
+        n = self._norms(w)
+        target = jnp.clip(n, self.min_norm, self.max_norm)
+        scale = self.rate * (target / n) + (1.0 - self.rate)
+        return w * scale
+
+
+@register_constraint
+@dataclasses.dataclass(eq=False)
+class UnitNormConstraint(LayerConstraint):
+    """Force unit column norms (reference `UnitNormConstraint.java`)."""
+
+    kind = "unit_norm"
+    apply_to_bias: bool = False
+
+    def apply(self, w):
+        return w / self._norms(w)
+
+
+@register_constraint
+@dataclasses.dataclass(eq=False)
+class NonNegativeConstraint(LayerConstraint):
+    """Clip params at zero (reference `NonNegativeConstraint.java`)."""
+
+    kind = "non_negative"
+    apply_to_bias: bool = True
+
+    def apply(self, w):
+        return jnp.maximum(w, 0.0)

@@ -1,0 +1,117 @@
+"""IDropout hierarchy — per-layer input noise/dropout schemes.
+
+Reference: `nn/conf/dropout/*.java` (Dropout, AlphaDropout,
+GaussianDropout, GaussianNoise). The reference applies these to the
+layer INPUT during training; plain `Dropout(p)` keeps activations with
+probability p (p = RETAIN probability, `Dropout.java` semantics) and
+rescales by 1/p (inverted dropout).
+
+All are pure functions of (rng, x) so they trace cleanly under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+_DROPOUT_REGISTRY = {}
+
+
+def register_dropout(cls):
+    _DROPOUT_REGISTRY[cls.kind] = cls
+    return cls
+
+
+class IDropout:
+    """Base: `apply(rng, x)` returns the noised activations (train only)."""
+
+    kind = "base"
+
+    def apply(self, rng, x):
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {"kind": self.kind}
+        for f in dataclasses.fields(self):
+            d[f.name] = getattr(self, f.name)
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+
+def dropout_from_dict(d):
+    d = dict(d)
+    cls = _DROPOUT_REGISTRY[d.pop("kind")]
+    return cls(**d)
+
+
+@register_dropout
+@dataclasses.dataclass(eq=False)
+class Dropout(IDropout):
+    """Standard inverted dropout; `p` is the RETAIN probability
+    (reference `nn/conf/dropout/Dropout.java`)."""
+
+    kind = "dropout"
+    p: float = 0.5
+
+    def apply(self, rng, x):
+        if self.p >= 1.0:
+            return x
+        keep = jax.random.bernoulli(rng, self.p, x.shape)
+        return jnp.where(keep, x / jnp.asarray(self.p, x.dtype), jnp.zeros_like(x))
+
+
+@register_dropout
+@dataclasses.dataclass(eq=False)
+class AlphaDropout(IDropout):
+    """SELU-preserving dropout (reference `AlphaDropout.java`): dropped
+    units are set to alpha' and the result is affinely corrected so mean
+    and variance are preserved under SELU statistics."""
+
+    kind = "alpha_dropout"
+    p: float = 0.5  # retain probability
+
+    _ALPHA = 1.6732632423543772
+    _LAMBDA = 1.0507009873554805
+
+    def apply(self, rng, x):
+        if self.p >= 1.0:
+            return x
+        p = self.p
+        alpha_p = -self._LAMBDA * self._ALPHA
+        a = (p + alpha_p ** 2 * p * (1 - p)) ** -0.5
+        b = -a * (1 - p) * alpha_p
+        keep = jax.random.bernoulli(rng, p, x.shape)
+        dropped = jnp.where(keep, x, jnp.asarray(alpha_p, x.dtype))
+        return a * dropped + b
+
+
+@register_dropout
+@dataclasses.dataclass(eq=False)
+class GaussianDropout(IDropout):
+    """Multiplicative gaussian noise N(1, rate/(1-rate)) (reference
+    `GaussianDropout.java`)."""
+
+    kind = "gaussian_dropout"
+    rate: float = 0.5
+
+    def apply(self, rng, x):
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(rng, x.shape, x.dtype)
+        return x * noise
+
+
+@register_dropout
+@dataclasses.dataclass(eq=False)
+class GaussianNoise(IDropout):
+    """Additive gaussian noise N(0, stddev^2) (reference
+    `GaussianNoise.java`)."""
+
+    kind = "gaussian_noise"
+    stddev: float = 0.1
+
+    def apply(self, rng, x):
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)

@@ -1,0 +1,105 @@
+"""Weight noise — train-time transforms of a layer's weights.
+
+Reference: `nn/conf/weightnoise/DropConnect.java` (bernoulli mask on
+weights at use time) and `WeightNoise.java` (additive or multiplicative
+noise from a Distribution, optionally applied to bias too).
+
+The container applies these to the layer's params right before the
+layer's forward during training (the reference hooks
+`getParameter(...)` via `IWeightNoise.getParameter`), so autodiff sees
+the noised weights — matching reference backprop semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.distributions import (
+    Distribution,
+    NormalDistribution,
+    distribution_from_dict,
+)
+
+_WEIGHT_NOISE_REGISTRY = {}
+
+
+def register_weight_noise(cls):
+    _WEIGHT_NOISE_REGISTRY[cls.kind] = cls
+    return cls
+
+
+class IWeightNoise:
+    kind = "base"
+    apply_to_bias: bool = False
+
+    def apply(self, rng, name: str, w):
+        raise NotImplementedError
+
+    def apply_params(self, rng, params: dict) -> dict:
+        out = {}
+        for i, (name, w) in enumerate(sorted(params.items())):
+            is_bias = name == "b" or name.endswith("_b")
+            if is_bias and not self.apply_to_bias:
+                out[name] = w
+            else:
+                out[name] = self.apply(jax.random.fold_in(rng, i), name, w)
+        return out
+
+    def to_dict(self):
+        d = {"kind": self.kind}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            d[f.name] = v.to_dict() if isinstance(v, Distribution) else v
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+
+def weight_noise_from_dict(d):
+    d = dict(d)
+    cls = _WEIGHT_NOISE_REGISTRY[d.pop("kind")]
+    if isinstance(d.get("dist"), dict):
+        d["dist"] = distribution_from_dict(d["dist"])
+    return cls(**d)
+
+
+@register_weight_noise
+@dataclasses.dataclass(eq=False)
+class DropConnect(IWeightNoise):
+    """Drop individual weights with probability 1-p at use time
+    (reference `DropConnect.java`; `p` = retain, inverted scaling)."""
+
+    kind = "drop_connect"
+    p: float = 0.5
+    apply_to_bias: bool = False
+
+    def apply(self, rng, name, w):
+        if self.p >= 1.0:
+            return w
+        keep = jax.random.bernoulli(rng, self.p, w.shape)
+        return jnp.where(keep, w / jnp.asarray(self.p, w.dtype), jnp.zeros_like(w))
+
+
+@register_weight_noise
+@dataclasses.dataclass(eq=False)
+class WeightNoise(IWeightNoise):
+    """Additive (w + n) or multiplicative (w * n) noise drawn from
+    `dist` (reference `WeightNoise.java`)."""
+
+    kind = "weight_noise"
+    dist: Optional[Distribution] = None
+    additive: bool = True
+    apply_to_bias: bool = False
+
+    def __post_init__(self):
+        if self.dist is None:
+            self.dist = NormalDistribution(0.0, 0.01)
+
+    def apply(self, rng, name, w):
+        noise = self.dist.sample(rng, w.shape, w.dtype)
+        return w + noise if self.additive else w * noise

@@ -317,7 +317,10 @@ class ComputationGraph:
             if is_output and stop_at_loss:
                 preouts[name] = (h, mask, lrng)
                 continue
-            h, st = layer.forward(params.get(name, {}), state.get(name, {}), h,
+            lparams = layer.apply_weight_noise(
+                params.get(name, {}), train,
+                None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
+            h, st = layer.forward(lparams, state.get(name, {}), h,
                                   train=train, rng=lrng, mask=mask)
             if st:
                 new_state[name] = st
@@ -338,7 +341,10 @@ class ComputationGraph:
             h, mask, lrng = preouts[name]
             y = self.dtype.cast_compute(jnp.asarray(labels[oi]))
             lmask = lmasks[oi] if lmasks[oi] is not None else mask
-            total = total + layer.compute_loss(params.get(name, {}), state.get(name, {}),
+            lparams = layer.apply_weight_noise(
+                params.get(name, {}), train,
+                None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
+            total = total + layer.compute_loss(lparams, state.get(name, {}),
                                                h, y, train=train, rng=lrng, mask=lmask)
         for name, node in self.conf.nodes.items():
             if node.kind == "layer" and name in params:
@@ -356,7 +362,7 @@ class ComputationGraph:
                 delta, new_s = updater.apply(g, upd_state[lk][pk], step)
                 lp[pk] = params[lk][pk] - delta.astype(params[lk][pk].dtype)
                 lu[pk] = new_s
-            new_params[lk] = lp
+            new_params[lk] = layer.apply_constraints(lp)
             new_upd[lk] = lu
         if self.conf.max_norm is not None:
             new_params = apply_max_norm_constraint(new_params, self.conf.max_norm)

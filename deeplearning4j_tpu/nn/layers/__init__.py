@@ -21,6 +21,7 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     Convolution1DLayer,
     SubsamplingLayer,
     Subsampling1DLayer,
+    Upsampling1D,
     Upsampling2D,
     ZeroPaddingLayer,
     ZeroPadding1DLayer,
@@ -39,3 +40,13 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
     LastTimeStep,
 )
 from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer, PoolingType
+from deeplearning4j_tpu.nn.layers.variational import (
+    VariationalAutoencoder,
+    GaussianReconstructionDistribution,
+    BernoulliReconstructionDistribution,
+    ExponentialReconstructionDistribution,
+)
+from deeplearning4j_tpu.nn.layers.rbm import RBM, HiddenUnit, VisibleUnit
+from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
+from deeplearning4j_tpu.nn.layers.training import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer

@@ -36,7 +36,10 @@ from deeplearning4j_tpu.common.losses import LossFunction, get_loss
 from deeplearning4j_tpu.common.schedules import Schedule, schedule_from_dict
 from deeplearning4j_tpu.common.updaters import Updater, updater_from_dict
 from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf.constraints import LayerConstraint, constraint_from_dict
+from deeplearning4j_tpu.nn.conf.dropout import IDropout, dropout_from_dict
 from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.weightnoise import IWeightNoise, weight_noise_from_dict
 
 _LAYER_REGISTRY: Dict[str, type] = {}
 
@@ -57,6 +60,12 @@ def _encode(v):
         return {"__distribution__": v.to_dict()}
     if isinstance(v, Schedule):
         return {"__schedule__": v.to_dict()}
+    if isinstance(v, IDropout):
+        return {"__dropout__": v.to_dict()}
+    if isinstance(v, IWeightNoise):
+        return {"__weightnoise__": v.to_dict()}
+    if isinstance(v, LayerConstraint):
+        return {"__constraint__": v.to_dict()}
     if isinstance(v, WeightInit):
         return v.value
     if isinstance(v, Enum):
@@ -84,6 +93,12 @@ def _decode(v):
             return distribution_from_dict(v["__distribution__"])
         if "__schedule__" in v:
             return schedule_from_dict(v["__schedule__"])
+        if "__dropout__" in v:
+            return dropout_from_dict(v["__dropout__"])
+        if "__weightnoise__" in v:
+            return weight_noise_from_dict(v["__weightnoise__"])
+        if "__constraint__" in v:
+            return constraint_from_dict(v["__constraint__"])
         if "__inputtype__" in v:
             return InputType.from_dict(v["__inputtype__"])
         if "layer_name" in v and v.get("layer_name") in _LAYER_REGISTRY:
@@ -110,7 +125,9 @@ class Layer:
     l1_bias: float = 0.0
     l2_bias: float = 0.0
     updater: Optional[Updater] = None  # per-layer override of the global updater
-    dropout: Optional[float] = None  # RETAIN probability (reference semantics)
+    dropout: Any = None  # float RETAIN probability (reference semantics) or IDropout
+    weight_noise: Optional[IWeightNoise] = None  # DropConnect / WeightNoise
+    constraints: Any = None  # list[LayerConstraint], applied post-update
     name: Optional[str] = None
 
     def __post_init__(self):
@@ -157,11 +174,31 @@ class Layer:
 
     # ---- input dropout (reference applies dropout to layer input) --------
     def apply_input_dropout(self, x, train: bool, rng):
-        if not train or self.dropout is None or self.dropout >= 1.0 or rng is None:
+        if not train or self.dropout is None or rng is None:
+            return x
+        if isinstance(self.dropout, IDropout):
+            return self.dropout.apply(rng, x)
+        if self.dropout >= 1.0:
             return x
         keep = jnp.asarray(self.dropout, x.dtype)
         mask = jax.random.bernoulli(rng, self.dropout, x.shape)
         return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+    # ---- weight noise (container calls before forward during training) ---
+    def apply_weight_noise(self, params, train: bool, rng):
+        if not train or self.weight_noise is None or rng is None or not params:
+            return params
+        return self.weight_noise.apply_params(rng, params)
+
+    # ---- constraints (container calls after each param update) -----------
+    def apply_constraints(self, params):
+        if not self.constraints or not params:
+            return params
+        cs = self.constraints if isinstance(self.constraints, (list, tuple)) \
+            else [self.constraints]
+        for c in cs:
+            params = c.apply_params(params)
+        return params
 
     # ---- regularization --------------------------------------------------
     def regularization_score(self, params: Dict[str, jnp.ndarray]):

@@ -391,3 +391,34 @@ class SpaceToDepthLayer(Layer):
         b = self.block_size
         out = x.reshape(n, h // b, b, w // b, b, c).transpose(0, 1, 3, 2, 4, 5)
         return out.reshape(n, h // b, w // b, b * b * c), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class Upsampling1D(Layer):
+    """Nearest-neighbor upsampling along time [B, T, F] (reference
+    `nn/conf/layers/Upsampling1D.java`)."""
+
+    layer_name = "upsampling1d"
+    size: int = 2
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        if isinstance(self.size, (tuple, list)):
+            self.size = int(self.size[0])
+        super().__post_init__()
+
+    def get_output_type(self, input_type):
+        if isinstance(input_type, InputTypeRecurrent):
+            ts = None if input_type.timesteps is None else input_type.timesteps * self.size
+            return InputType.recurrent(input_type.size, ts)
+        return input_type
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.repeat(x, self.size, axis=1), state
+
+    def forward_mask(self, mask, current_type):
+        if mask is None:
+            return None
+        return jnp.repeat(mask, self.size, axis=1)

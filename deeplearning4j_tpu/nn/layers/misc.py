@@ -1,0 +1,62 @@
+"""Misc layer wrappers: FrozenLayer.
+
+Reference: `nn/conf/layers/misc/FrozenLayer.java` + runtime
+`nn/layers/FrozenLayer.java`: wraps any layer so it participates in
+forward/backward shape-wise but its params never change and it adds no
+regularization score. Used by transfer learning's feature-extractor
+freezing (`nn/transferlearning/TransferLearning.java:84`).
+
+JAX realisation: forward runs the inner layer in inference mode with
+`stop_gradient` on the params (so upstream layers still get gradients
+through the frozen block), and the updater is NoOp.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+from deeplearning4j_tpu.common.updaters import NoOp
+from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class FrozenLayer(Layer):
+    layer_name = "frozen"
+
+    layer: Optional[Layer] = None
+
+    def __post_init__(self):
+        if isinstance(self.layer, dict):
+            self.layer = layer_from_dict(self.layer)
+        self.updater = NoOp()
+        super().__post_init__()
+
+    # shape / params delegate to the wrapped layer
+    def set_n_in(self, input_type, override=True):
+        self.layer.set_n_in(input_type, override)
+
+    def get_output_type(self, input_type):
+        return self.layer.get_output_type(input_type)
+
+    def init_params(self, rng, dtype=None):
+        import jax.numpy as jnp
+        return self.layer.init_params(rng, dtype if dtype is not None else jnp.float32)
+
+    def init_state(self, dtype=None):
+        import jax.numpy as jnp
+        return self.layer.init_state(dtype if dtype is not None else jnp.float32)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        # inner layer always runs in inference mode (no dropout on frozen parts)
+        return self.layer.forward(frozen, state, x, train=False, rng=None, mask=mask)
+
+    def forward_mask(self, mask, current_type):
+        return self.layer.forward_mask(mask, current_type)
+
+    def regularization_score(self, params):
+        return 0.0

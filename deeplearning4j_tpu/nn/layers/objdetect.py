@@ -1,0 +1,135 @@
+"""YOLOv2 object-detection output layer.
+
+Reference: `nn/conf/layers/objdetect/Yolo2OutputLayer.java` + runtime
+`nn/layers/objdetect/Yolo2OutputLayer.java` (714 LoC): loss over a
+grid of anchor boxes — lambda_coord-weighted position loss on
+(sigmoid(tx), sigmoid(ty), sqrt(w), sqrt(h)), IOU-target confidence
+loss with lambda_noobj down-weighting for empty anchors, and
+cross-entropy over class probabilities for object cells. The
+responsible anchor per cell is the one with max IOU against the ground
+truth (same assignment rule as the reference).
+
+Layouts are NHWC (TPU-native): activations [B, H, W, A*(5+C)], labels
+[B, H, W, 4+C] where the 4 box values are (x1, y1, x2, y2) in *grid*
+coordinates and the C one-hot class vector is all-zero for empty cells
+(reference label format transposed from its NCHW [mb, 4+C, H, W]).
+
+Everything is dense tensor math — no per-box Python loops — so the
+whole loss jits and fuses on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.feedforward import BaseOutputLayerMixin
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class Yolo2OutputLayer(Layer, BaseOutputLayerMixin):
+    layer_name = "yolo2_output"
+
+    anchors: Any = ((1.0, 1.0),)  # [A, 2] anchor (w, h) in grid units
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        self.anchors = tuple(tuple(float(v) for v in a) for a in self.anchors)
+        super().__post_init__()
+
+    @property
+    def n_anchors(self):
+        return len(self.anchors)
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def _split(self, x):
+        """[B,H,W,A*(5+C)] → xy [B,H,W,A,2], wh [..,2], conf [..], cls [..,C]."""
+        b, h, w, d = x.shape
+        a = self.n_anchors
+        per = d // a
+        x = x.reshape(b, h, w, a, per)
+        return x[..., 0:2], x[..., 2:4], x[..., 4], x[..., 5:]
+
+    def _pred_boxes(self, txy, twh):
+        """Decode to (cx, cy, w, h) in grid coordinates."""
+        h, w = txy.shape[1], txy.shape[2]
+        gy, gx = jnp.meshgrid(jnp.arange(h, dtype=txy.dtype),
+                              jnp.arange(w, dtype=txy.dtype), indexing="ij")
+        grid = jnp.stack([gx, gy], axis=-1)[None, :, :, None, :]  # [1,H,W,1,2]
+        anchors = jnp.asarray(np.array(self.anchors), txy.dtype)[None, None, None, :, :]
+        cxy = jax.nn.sigmoid(txy) + grid
+        wh = anchors * jnp.exp(twh)
+        return cxy, wh
+
+    @staticmethod
+    def _iou(cxy, wh, gt_cxy, gt_wh):
+        p1 = cxy - wh / 2.0
+        p2 = cxy + wh / 2.0
+        g1 = gt_cxy - gt_wh / 2.0
+        g2 = gt_cxy + gt_wh / 2.0
+        inter_lo = jnp.maximum(p1, g1)
+        inter_hi = jnp.minimum(p2, g2)
+        inter = jnp.prod(jnp.clip(inter_hi - inter_lo, 0.0, None), axis=-1)
+        area_p = jnp.prod(jnp.clip(p2 - p1, 0.0, None), axis=-1)
+        area_g = jnp.prod(jnp.clip(g2 - g1, 0.0, None), axis=-1)
+        return inter / (area_p + area_g - inter + 1e-9)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        """Activated predictions (reference `YoloUtils.activate`):
+        sigmoid xy+conf, exp-scaled wh, softmax classes — concatenated
+        back into [B,H,W,A*(5+C)]."""
+        txy, twh, tconf, tcls = self._split(x)
+        cxy, wh = self._pred_boxes(txy, twh)
+        conf = jax.nn.sigmoid(tconf)[..., None]
+        cls = jax.nn.softmax(tcls, axis=-1)
+        out = jnp.concatenate([cxy, wh, conf, cls], axis=-1)
+        return out.reshape(x.shape[0], x.shape[1], x.shape[2], -1), state
+
+    def compute_loss(self, params, state, x, labels, *, train=True, rng=None, mask=None):
+        txy, twh, tconf, tcls = self._split(x)
+        cxy, wh = self._pred_boxes(txy, twh)
+
+        gt_box = labels[..., 0:4]           # [B,H,W,4] = x1,y1,x2,y2 (grid units)
+        gt_cls = labels[..., 4:]            # [B,H,W,C] one-hot (zero ⇒ no object)
+        obj_cell = (jnp.sum(gt_cls, axis=-1) > 0).astype(x.dtype)  # [B,H,W]
+
+        gt_cxy = (gt_box[..., 0:2] + gt_box[..., 2:4]) / 2.0
+        gt_wh = jnp.clip(gt_box[..., 2:4] - gt_box[..., 0:2], 1e-6, None)
+
+        iou = self._iou(cxy, wh, gt_cxy[:, :, :, None, :], gt_wh[:, :, :, None, :])
+        responsible = jax.nn.one_hot(jnp.argmax(iou, axis=-1), self.n_anchors,
+                                     dtype=x.dtype)              # [B,H,W,A]
+        obj_mask = responsible * obj_cell[..., None]             # [B,H,W,A]
+        noobj_mask = 1.0 - obj_mask
+
+        # position: predicted cell offset vs truth offset; sqrt size space
+        gt_off = gt_cxy - jnp.floor(gt_cxy)
+        pos_xy = jnp.sum((jax.nn.sigmoid(txy) - gt_off[:, :, :, None, :]) ** 2, axis=-1)
+        pos_wh = jnp.sum((jnp.sqrt(wh + 1e-9)
+                          - jnp.sqrt(gt_wh[:, :, :, None, :] + 1e-9)) ** 2, axis=-1)
+        pos_loss = self.lambda_coord * jnp.sum(obj_mask * (pos_xy + pos_wh))
+
+        # confidence: target = IOU for responsible anchors, 0 otherwise
+        conf = jax.nn.sigmoid(tconf)
+        conf_loss = jnp.sum(obj_mask * (conf - jax.lax.stop_gradient(iou)) ** 2) \
+            + self.lambda_no_obj * jnp.sum(noobj_mask * conf ** 2)
+
+        # classes: softmax CE per object cell
+        logp = jax.nn.log_softmax(tcls, axis=-1)
+        ce = -jnp.sum(gt_cls[:, :, :, None, :] * logp, axis=-1)
+        cls_loss = jnp.sum(obj_mask * ce)
+
+        batch = x.shape[0]
+        return (pos_loss + conf_loss + cls_loss) / batch

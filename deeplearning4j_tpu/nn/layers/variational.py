@@ -1,0 +1,273 @@
+"""Variational autoencoder layer.
+
+Reference: `nn/conf/layers/variational/VariationalAutoencoder.java`
+(config: encoderLayerSizes/decoderLayerSizes, reconstruction
+distribution, pzxActivationFunction, numSamples) and the runtime
+`nn/layers/variational/VariationalAutoencoder.java:51` (1,163 LoC;
+`computeGradientAndScore` :168 = ELBO; supervised forward uses the
+q(z|x) mean as the layer activation).
+
+Param names follow the reference's
+`VariationalAutoencoderParamInitializer`: encoder "eNW"/"eNb", latent
+"pZXMeanW"/"pZXMeanb"/"pZXLogStd2W"/"pZXLogStd2b", decoder "dNW"/"dNb",
+reconstruction "pXZW"/"pXZb" — so transfer-learning surgery and
+checkpoints are name-stable.
+
+TPU-first: the whole ELBO (encoder MLP → reparameterised sample →
+decoder MLP → reconstruction log-prob + analytic KL) is one pure
+function; `pretrain_loss` plugs into the container's jitted layerwise
+pretraining exactly like AutoEncoder/RBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.activations import get_activation
+from deeplearning4j_tpu.common.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+_RECON_REGISTRY = {}
+
+
+def register_recon(cls):
+    _RECON_REGISTRY[cls.kind] = cls
+    return cls
+
+
+class ReconstructionDistribution:
+    """p(x|z) family (reference
+    `nn/conf/layers/variational/ReconstructionDistribution.java`)."""
+
+    kind = "base"
+
+    def n_dist_params(self, data_size: int) -> int:
+        raise NotImplementedError
+
+    def log_prob(self, x, dist_params):
+        """Sum log p(x|z) per example → [batch]."""
+        raise NotImplementedError
+
+    def sample_mean(self, dist_params):
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {"kind": self.kind}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            d[f.name] = v.name if hasattr(v, "name") and callable(v) else v
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+
+def recon_from_dict(d):
+    d = dict(d)
+    cls = _RECON_REGISTRY[d.pop("kind")]
+    return cls(**d)
+
+
+@register_recon
+@dataclasses.dataclass(eq=False)
+class GaussianReconstructionDistribution(ReconstructionDistribution):
+    """N(mean, sigma^2) with learned per-feature mean and log-variance
+    (reference `GaussianReconstructionDistribution.java`)."""
+
+    kind = "gaussian"
+    activation: Any = "identity"
+
+    def __post_init__(self):
+        self.activation = get_activation(self.activation)
+
+    def n_dist_params(self, data_size):
+        return 2 * data_size
+
+    def _split(self, dist_params):
+        n = dist_params.shape[-1] // 2
+        mean = self.activation(dist_params[..., :n])
+        log_var = dist_params[..., n:]
+        return mean, log_var
+
+    def log_prob(self, x, dist_params):
+        mean, log_var = self._split(dist_params)
+        log2pi = jnp.log(2.0 * jnp.pi)
+        ll = -0.5 * (log2pi + log_var + (x - mean) ** 2 / jnp.exp(log_var))
+        return jnp.sum(ll, axis=-1)
+
+    def sample_mean(self, dist_params):
+        return self._split(dist_params)[0]
+
+
+@register_recon
+@dataclasses.dataclass(eq=False)
+class BernoulliReconstructionDistribution(ReconstructionDistribution):
+    """Bernoulli(p) for binary-ish data (reference
+    `BernoulliReconstructionDistribution.java`; sigmoid by default)."""
+
+    kind = "bernoulli"
+    activation: Any = "sigmoid"
+
+    def __post_init__(self):
+        self.activation = get_activation(self.activation)
+
+    def n_dist_params(self, data_size):
+        return data_size
+
+    def log_prob(self, x, dist_params):
+        p = jnp.clip(self.activation(dist_params), 1e-7, 1.0 - 1e-7)
+        ll = x * jnp.log(p) + (1.0 - x) * jnp.log1p(-p)
+        return jnp.sum(ll, axis=-1)
+
+    def sample_mean(self, dist_params):
+        return self.activation(dist_params)
+
+
+@register_recon
+@dataclasses.dataclass(eq=False)
+class ExponentialReconstructionDistribution(ReconstructionDistribution):
+    """Exp(lambda = exp(gamma)) (reference
+    `ExponentialReconstructionDistribution.java`)."""
+
+    kind = "exponential"
+    activation: Any = "identity"
+
+    def __post_init__(self):
+        self.activation = get_activation(self.activation)
+
+    def n_dist_params(self, data_size):
+        return data_size
+
+    def log_prob(self, x, dist_params):
+        gamma = self.activation(dist_params)
+        return jnp.sum(gamma - jnp.exp(gamma) * x, axis=-1)
+
+    def sample_mean(self, dist_params):
+        return jnp.exp(-self.activation(dist_params))
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class VariationalAutoencoder(Layer):
+    layer_name = "vae"
+
+    n_in: int = 0
+    n_out: int = 0  # latent size
+    encoder_layer_sizes: Any = (100,)
+    decoder_layer_sizes: Any = (100,)
+    reconstruction_distribution: Any = None
+    pzx_activation: Any = "identity"
+    num_samples: int = 1
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "relu"  # encoder/decoder hidden activation
+        if self.reconstruction_distribution is None:
+            self.reconstruction_distribution = GaussianReconstructionDistribution()
+        elif isinstance(self.reconstruction_distribution, dict):
+            self.reconstruction_distribution = recon_from_dict(
+                self.reconstruction_distribution)
+        self.pzx_activation = get_activation(self.pzx_activation)
+        self.encoder_layer_sizes = tuple(self.encoder_layer_sizes)
+        self.decoder_layer_sizes = tuple(self.decoder_layer_sizes)
+        super().__post_init__()
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["reconstruction_distribution"] = self.reconstruction_distribution.to_dict()
+        d["pzx_activation"] = self.pzx_activation.name
+        return d
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_in:
+            self.n_in = input_type.arity()
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    # ------------------------------------------------------------ params
+    def init_params(self, rng, dtype=jnp.float32):
+        params = {}
+        i = 0
+
+        def dense(key, name, n_in, n_out):
+            params[name + "W"] = init_weights(
+                key, (n_in, n_out), self.weight_init, fan_in=n_in,
+                fan_out=n_out, distribution=self.dist, dtype=dtype)
+            params[name + "b"] = jnp.zeros((n_out,), dtype)
+
+        last = self.n_in
+        for j, sz in enumerate(self.encoder_layer_sizes):
+            dense(jax.random.fold_in(rng, i), f"e{j}", last, sz)
+            i += 1
+            last = sz
+        dense(jax.random.fold_in(rng, i), "pZXMean", last, self.n_out); i += 1
+        dense(jax.random.fold_in(rng, i), "pZXLogStd2", last, self.n_out); i += 1
+        last = self.n_out
+        for j, sz in enumerate(self.decoder_layer_sizes):
+            dense(jax.random.fold_in(rng, i), f"d{j}", last, sz)
+            i += 1
+            last = sz
+        n_dist = self.reconstruction_distribution.n_dist_params(self.n_in)
+        dense(jax.random.fold_in(rng, i), "pXZ", last, n_dist)
+        return params
+
+    # ------------------------------------------------------------ pieces
+    def encode(self, params, x):
+        h = x
+        for j in range(len(self.encoder_layer_sizes)):
+            h = self.activation(h @ params[f"e{j}W"] + params[f"e{j}b"])
+        # reference applies pzxActivationFn to BOTH heads
+        # (VariationalAutoencoder.java:181-183)
+        mean = self.pzx_activation(h @ params["pZXMeanW"] + params["pZXMeanb"])
+        log_var = self.pzx_activation(h @ params["pZXLogStd2W"] + params["pZXLogStd2b"])
+        return mean, log_var
+
+    def decode(self, params, z):
+        h = z
+        for j in range(len(self.decoder_layer_sizes)):
+            h = self.activation(h @ params[f"d{j}W"] + params[f"d{j}b"])
+        return h @ params["pXZW"] + params["pXZb"]
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        mean, _ = self.encode(params, x)
+        return mean, state
+
+    # ------------------------------------------------------------ ELBO
+    def pretrain_loss(self, params, x, rng):
+        """Negative ELBO (reference `computeGradientAndScore` :168):
+        -E_q[log p(x|z)] + KL(q(z|x) || N(0, I)), reparameterised,
+        averaged over `num_samples` MC samples."""
+        mean, log_var = self.encode(params, x)
+        kl = 0.5 * jnp.sum(jnp.exp(log_var) + mean ** 2 - 1.0 - log_var, axis=-1)
+        rec = 0.0
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        for s in range(self.num_samples):
+            eps = jax.random.normal(jax.random.fold_in(key, s), mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * log_var) * eps
+            dist_params = self.decode(params, z)
+            rec = rec + self.reconstruction_distribution.log_prob(x, dist_params)
+        rec = rec / self.num_samples
+        return jnp.mean(kl - rec)
+
+    def reconstruction_probability(self, params, x, rng, num_samples=None):
+        """Mean MC estimate of log p(x) used for anomaly scoring
+        (reference `reconstructionLogProbability`)."""
+        ns = num_samples or self.num_samples
+        mean, log_var = self.encode(params, x)
+        total = 0.0
+        for s in range(ns):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * log_var) * eps
+            total = total + self.reconstruction_distribution.log_prob(
+                x, self.decode(params, z))
+        return total / ns
+
+    def generate_at_mean_given_z(self, params, z):
+        return self.reconstruction_distribution.sample_mean(self.decode(params, z))

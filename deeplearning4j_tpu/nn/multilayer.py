@@ -138,7 +138,9 @@ class MultiLayerNetwork:
                 h = pp.pre_process(h, mask)
                 mask = pp.process_mask(mask)
             lrng = None if rng is None else jax.random.fold_in(rng, i)
-            lparams = params.get(si, {})
+            lparams = layer.apply_weight_noise(
+                params.get(si, {}), train,
+                None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
             lstate = state.get(si, {})
             if carries is not None and isinstance(layer, BaseRecurrentLayer):
                 carry_in = carries.get(si)
@@ -171,7 +173,10 @@ class MultiLayerNetwork:
         lrng = None if rng is None else jax.random.fold_in(rng, n - 1)
         label_mask = lmask if lmask is not None else mask
         y = self.dtype.cast_compute(jnp.asarray(y))
-        loss = out_layer.compute_loss(params.get(si, {}), state.get(si, {}), h, y,
+        out_params = out_layer.apply_weight_noise(
+            params.get(si, {}), train,
+            None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
+        loss = out_layer.compute_loss(out_params, state.get(si, {}), h, y,
                                       train=train, rng=lrng, mask=label_mask)
         reg = 0.0
         for i, layer in enumerate(self.layers):
@@ -191,7 +196,7 @@ class MultiLayerNetwork:
                 delta, new_s = updater.apply(g, upd_state[lk][pk], step)
                 lp[pk] = params[lk][pk] - delta.astype(params[lk][pk].dtype)
                 lu[pk] = new_s
-            new_params[lk] = lp
+            new_params[lk] = layer.apply_constraints(lp)
             new_upd[lk] = lu
         if self.conf.max_norm is not None:
             new_params = apply_max_norm_constraint(new_params, self.conf.max_norm)

@@ -11,3 +11,6 @@ from deeplearning4j_tpu.zoo.vgg import VGG16, VGG19
 from deeplearning4j_tpu.zoo.simplecnn import SimpleCNN
 from deeplearning4j_tpu.zoo.resnet50 import ResNet50
 from deeplearning4j_tpu.zoo.textgenlstm import TextGenerationLSTM
+from deeplearning4j_tpu.zoo.googlenet import GoogLeNet
+from deeplearning4j_tpu.zoo.inceptionresnet import InceptionResNetV1
+from deeplearning4j_tpu.zoo.facenet import FaceNetNN4Small2

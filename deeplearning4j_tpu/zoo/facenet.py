@@ -1,0 +1,119 @@
+"""FaceNet NN4-small2 — face-embedding model.
+
+Reference: `zoo/model/FaceNetNN4Small2.java` (+ helper
+`zoo/model/helper/FaceNetHelper.java`): GoogLeNet-style stem, inception
+modules 3a/3b/3c (3c strided, no 1x1 branch), 4a/4e (strided), 5a/5b
+(no 5x5 branch), global average pool, 128-d dense embedding,
+L2NormalizeVertex, center-loss softmax head.
+
+Pool-type mix in the reference alternates max and L2 (p-norm) pooling
+branches; both map to `lax.reduce_window` here (SubsamplingLayer PNORM).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import L2NormalizeVertex, MergeVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    CenterLossOutputLayer,
+    ConvolutionLayer,
+    DenseLayer,
+    GlobalPoolingLayer,
+    LocalResponseNormalization,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode, PoolingMode
+from deeplearning4j_tpu.nn.layers.pooling import PoolingType
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class FaceNetNN4Small2(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 height: int = 96, width: int = 96, channels: int = 3,
+                 embedding_size: int = 128):
+        super().__init__(num_classes=num_classes, seed=seed)
+        self.height, self.width, self.channels = height, width, channels
+        self.embedding_size = embedding_size
+
+    def _conv(self, g, name, inp, filters, kernel, stride=(1, 1)):
+        g.add_layer(name, ConvolutionLayer(
+            n_out=filters, kernel_size=kernel, stride=stride,
+            convolution_mode=ConvolutionMode.SAME, activation="relu"), inp)
+        return name
+
+    def _inception(self, g, name, inp, n1, r3, n3, r5, n5, pool_mode, pp,
+                   stride=(1, 1)):
+        """FaceNetHelper.appendGraph-style module; n1/n5/pp of 0 drop the
+        branch (reference 3c/4e/5x variants)."""
+        branches = []
+        if n1:
+            branches.append(self._conv(g, f"{name}_1x1", inp, n1, (1, 1)))
+        b3r = self._conv(g, f"{name}_3x3r", inp, r3, (1, 1))
+        branches.append(self._conv(g, f"{name}_3x3", b3r, n3, (3, 3), stride))
+        if n5:
+            b5r = self._conv(g, f"{name}_5x5r", inp, r5, (1, 1))
+            branches.append(self._conv(g, f"{name}_5x5", b5r, n5, (5, 5), stride))
+        g.add_layer(f"{name}_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=stride, pooling_type=pool_mode,
+            convolution_mode=ConvolutionMode.SAME), inp)
+        if pp:
+            branches.append(self._conv(g, f"{name}_poolproj", f"{name}_pool", pp, (1, 1)))
+        else:
+            branches.append(f"{name}_pool")
+        g.add_vertex(f"{name}_merge", MergeVertex(), *branches)
+        return f"{name}_merge"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        builder = NeuralNetConfiguration.builder() \
+            .seed(self.seed) \
+            .updater(Adam(0.1)) \
+            .weight_init(WeightInit.RELU) \
+            .l2(5e-5)
+        g = ComputationGraphConfiguration.graph_builder(builder)
+        g.add_inputs("input")
+        g.set_input_types(InputType.convolutional(self.height, self.width, self.channels))
+
+        x = self._conv(g, "stem1", "input", 64, (7, 7), (2, 2))
+        g.add_layer("stem_pool1", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        g.add_layer("stem_lrn1", LocalResponseNormalization(), "stem_pool1")
+        x = self._conv(g, "stem2", "stem_lrn1", 64, (1, 1))
+        x = self._conv(g, "stem3", x, 192, (3, 3))
+        g.add_layer("stem_lrn2", LocalResponseNormalization(), x)
+        g.add_layer("stem_pool2", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), "stem_lrn2")
+
+        x = self._inception(g, "inc3a", "stem_pool2", 64, 96, 128, 16, 32,
+                            PoolingMode.MAX, 32)
+        x = self._inception(g, "inc3b", x, 64, 96, 128, 32, 64,
+                            PoolingMode.PNORM, 64)
+        x = self._inception(g, "inc3c", x, 0, 128, 256, 32, 64,
+                            PoolingMode.MAX, 0, stride=(2, 2))
+
+        x = self._inception(g, "inc4a", x, 256, 96, 192, 32, 64,
+                            PoolingMode.PNORM, 128)
+        x = self._inception(g, "inc4e", x, 0, 160, 256, 64, 128,
+                            PoolingMode.MAX, 0, stride=(2, 2))
+
+        x = self._inception(g, "inc5a", x, 256, 96, 384, 0, 0,
+                            PoolingMode.PNORM, 96)
+        x = self._inception(g, "inc5b", x, 256, 96, 384, 0, 0,
+                            PoolingMode.MAX, 96)
+
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type=PoolingType.AVG), x)
+        g.add_layer("bottleneck", DenseLayer(
+            n_out=self.embedding_size, activation="identity"), "avgpool")
+        g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.add_layer("output", CenterLossOutputLayer(
+            n_out=self.num_classes, activation="softmax", loss="mcxent",
+            alpha=0.9, lambda_=2e-4), "embeddings")
+        g.set_outputs("output")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init(self.seed)

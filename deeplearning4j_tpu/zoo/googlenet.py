@@ -1,0 +1,119 @@
+"""GoogLeNet (Inception v1).
+
+Reference: `zoo/model/GoogLeNet.java` — stem (7x7/2 conv → maxpool →
+LRN → 1x1 → 3x3 → LRN → maxpool), nine inception modules
+(3a/3b, 4a–4e, 5a/5b) each merging four branches (1x1; 1x1→3x3;
+1x1→5x5; maxpool→1x1), global average pool, 40% dropout, softmax FC.
+
+NHWC / MXU-native convs; branch merge = channel-concat MergeVertex.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.updaters import Nesterovs
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer,
+    ConvolutionLayer,
+    GlobalPoolingLayer,
+    LocalResponseNormalization,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode
+from deeplearning4j_tpu.nn.layers.pooling import PoolingType
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+# (1x1, (3x3 reduce, 3x3), (5x5 reduce, 5x5), pool-proj) per module
+_INCEPTION = {
+    "3a": (64, (96, 128), (16, 32), 32),
+    "3b": (128, (128, 192), (32, 96), 64),
+    "4a": (192, (96, 208), (16, 48), 64),
+    "4b": (160, (112, 224), (24, 64), 64),
+    "4c": (128, (128, 256), (24, 64), 64),
+    "4d": (112, (144, 288), (32, 64), 64),
+    "4e": (256, (160, 320), (32, 128), 128),
+    "5a": (256, (160, 320), (32, 128), 128),
+    "5b": (384, (192, 384), (48, 128), 128),
+}
+
+
+class GoogLeNet(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 height: int = 224, width: int = 224, channels: int = 3):
+        super().__init__(num_classes=num_classes, seed=seed)
+        self.height, self.width, self.channels = height, width, channels
+
+    def _conv(self, g, name, inp, filters, kernel, stride=(1, 1)):
+        g.add_layer(name, ConvolutionLayer(
+            n_out=filters, kernel_size=kernel, stride=stride,
+            convolution_mode=ConvolutionMode.SAME, activation="relu"), inp)
+        return name
+
+    def _inception(self, g, name, inp, spec):
+        n1, (r3, n3), (r5, n5), pp = spec
+        b1 = self._conv(g, f"{name}_1x1", inp, n1, (1, 1))
+        b2r = self._conv(g, f"{name}_3x3r", inp, r3, (1, 1))
+        b2 = self._conv(g, f"{name}_3x3", b2r, n3, (3, 3))
+        b3r = self._conv(g, f"{name}_5x5r", inp, r5, (1, 1))
+        b3 = self._conv(g, f"{name}_5x5", b3r, n5, (5, 5))
+        g.add_layer(f"{name}_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(1, 1),
+            convolution_mode=ConvolutionMode.SAME), inp)
+        b4 = self._conv(g, f"{name}_poolproj", f"{name}_pool", pp, (1, 1))
+        g.add_vertex(f"{name}_merge", MergeVertex(), b1, b2, b3, b4)
+        return f"{name}_merge"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        builder = NeuralNetConfiguration.builder() \
+            .seed(self.seed) \
+            .updater(Nesterovs(1e-2, 0.9)) \
+            .weight_init(WeightInit.RELU) \
+            .l2(5e-4)
+        g = ComputationGraphConfiguration.graph_builder(builder)
+        g.add_inputs("input")
+        g.set_input_types(InputType.convolutional(self.height, self.width, self.channels))
+
+        x = self._conv(g, "stem_conv1", "input", 64, (7, 7), (2, 2))
+        g.add_layer("stem_pool1", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        g.add_layer("stem_lrn1", LocalResponseNormalization(), "stem_pool1")
+        x = self._conv(g, "stem_conv2", "stem_lrn1", 64, (1, 1))
+        x = self._conv(g, "stem_conv3", x, 192, (3, 3))
+        g.add_layer("stem_lrn2", LocalResponseNormalization(), x)
+        g.add_layer("stem_pool2", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), "stem_lrn2")
+        x = "stem_pool2"
+
+        for name in ("3a", "3b"):
+            x = self._inception(g, f"inc{name}", x, _INCEPTION[name])
+        g.add_layer("pool3", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        x = "pool3"
+        for name in ("4a", "4b", "4c", "4d", "4e"):
+            x = self._inception(g, f"inc{name}", x, _INCEPTION[name])
+        g.add_layer("pool4", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        x = "pool4"
+        for name in ("5a", "5b"):
+            x = self._inception(g, f"inc{name}", x, _INCEPTION[name])
+
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type=PoolingType.AVG), x)
+        # reference GoogLeNet.java:172: fc1 1024-wide carrying dropOut(0.4)
+        # — DL4J dropOut() is the RETAIN probability
+        g.add_layer("fc1", DenseLayer(n_out=1024, activation="relu", dropout=0.4),
+                    "avgpool")
+        g.add_layer("output", OutputLayer(
+            n_out=self.num_classes, activation="softmax", loss="mcxent"), "fc1")
+        g.set_outputs("output")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init(self.seed)

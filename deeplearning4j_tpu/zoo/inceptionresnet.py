@@ -1,0 +1,157 @@
+"""InceptionResNetV1 — face-embedding backbone.
+
+Reference: `zoo/model/InceptionResNetV1.java` (+ helper
+`zoo/model/helper/InceptionResNetHelper.java`): stem convs, 5× block35
+(Inception-ResNet-A), reduction-A, 10× block17 (B, with 1x7/7x1
+factorised convs), reduction-B, 5× block8 (C, 1x3/3x1), global average
+pool, dropout, 128-d bottleneck, L2 normalisation, center-loss softmax
+output (the FaceNet training head).
+
+Residual scaling uses ScaleVertex + ElementWiseVertex(add) exactly as
+the reference composes them.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.updaters import RmsProp
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import (
+    ElementWiseVertex,
+    L2NormalizeVertex,
+    MergeVertex,
+    ScaleVertex,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    CenterLossOutputLayer,
+    ConvolutionLayer,
+    DenseLayer,
+    GlobalPoolingLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode
+from deeplearning4j_tpu.nn.layers.pooling import PoolingType
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class InceptionResNetV1(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 height: int = 160, width: int = 160, channels: int = 3,
+                 embedding_size: int = 128,
+                 blocks35: int = 5, blocks17: int = 10, blocks8: int = 5):
+        super().__init__(num_classes=num_classes, seed=seed)
+        self.height, self.width, self.channels = height, width, channels
+        self.embedding_size = embedding_size
+        self.blocks35, self.blocks17, self.blocks8 = blocks35, blocks17, blocks8
+
+    def _conv(self, g, name, inp, filters, kernel, stride=(1, 1),
+              mode=ConvolutionMode.SAME, act="relu"):
+        g.add_layer(name, ConvolutionLayer(
+            n_out=filters, kernel_size=kernel, stride=stride,
+            convolution_mode=mode, activation=act), inp)
+        return name
+
+    def _residual(self, g, name, inp, branch_out, n_channels, scale):
+        """merge branches → 1x1 linear expand → scale → add → relu
+        (reference InceptionResNetHelper block pattern)."""
+        up = self._conv(g, f"{name}_up", branch_out, n_channels, (1, 1), act="identity")
+        g.add_vertex(f"{name}_scale", ScaleVertex(scale_factor=scale), up)
+        g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp, f"{name}_scale")
+        g.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_add")
+        return f"{name}_relu"
+
+    def _block35(self, g, name, inp):
+        b1 = self._conv(g, f"{name}_b1", inp, 32, (1, 1))
+        b2 = self._conv(g, f"{name}_b2b", self._conv(g, f"{name}_b2a", inp, 32, (1, 1)),
+                        32, (3, 3))
+        b3a = self._conv(g, f"{name}_b3a", inp, 32, (1, 1))
+        b3b = self._conv(g, f"{name}_b3b", b3a, 32, (3, 3))
+        b3 = self._conv(g, f"{name}_b3c", b3b, 32, (3, 3))
+        g.add_vertex(f"{name}_merge", MergeVertex(), b1, b2, b3)
+        return self._residual(g, name, inp, f"{name}_merge", 256, 0.17)
+
+    def _block17(self, g, name, inp):
+        b1 = self._conv(g, f"{name}_b1", inp, 128, (1, 1))
+        b2a = self._conv(g, f"{name}_b2a", inp, 128, (1, 1))
+        b2b = self._conv(g, f"{name}_b2b", b2a, 128, (1, 7))
+        b2 = self._conv(g, f"{name}_b2c", b2b, 128, (7, 1))
+        g.add_vertex(f"{name}_merge", MergeVertex(), b1, b2)
+        return self._residual(g, name, inp, f"{name}_merge", 896, 0.10)
+
+    def _block8(self, g, name, inp):
+        b1 = self._conv(g, f"{name}_b1", inp, 192, (1, 1))
+        b2a = self._conv(g, f"{name}_b2a", inp, 192, (1, 1))
+        b2b = self._conv(g, f"{name}_b2b", b2a, 192, (1, 3))
+        b2 = self._conv(g, f"{name}_b2c", b2b, 192, (3, 1))
+        g.add_vertex(f"{name}_merge", MergeVertex(), b1, b2)
+        return self._residual(g, name, inp, f"{name}_merge", 1792, 0.20)
+
+    def conf(self) -> ComputationGraphConfiguration:
+        builder = NeuralNetConfiguration.builder() \
+            .seed(self.seed) \
+            .updater(RmsProp(0.1)) \
+            .weight_init(WeightInit.RELU) \
+            .l2(5e-5)
+        g = ComputationGraphConfiguration.graph_builder(builder)
+        g.add_inputs("input")
+        g.set_input_types(InputType.convolutional(self.height, self.width, self.channels))
+
+        # stem (reference `InceptionResNetV1.java` stem convs)
+        x = self._conv(g, "stem1", "input", 32, (3, 3), (2, 2))
+        x = self._conv(g, "stem2", x, 32, (3, 3))
+        x = self._conv(g, "stem3", x, 64, (3, 3))
+        g.add_layer("stem_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        x = self._conv(g, "stem4", "stem_pool", 80, (1, 1))
+        x = self._conv(g, "stem5", x, 192, (3, 3))
+        x = self._conv(g, "stem6", x, 256, (3, 3), (2, 2))
+
+        for i in range(self.blocks35):
+            x = self._block35(g, f"block35_{i}", x)
+
+        # reduction-A
+        g.add_layer("redA_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        ra1 = self._conv(g, "redA_b1", x, 384, (3, 3), (2, 2))
+        ra2a = self._conv(g, "redA_b2a", x, 192, (1, 1))
+        ra2b = self._conv(g, "redA_b2b", ra2a, 192, (3, 3))
+        ra2 = self._conv(g, "redA_b2c", ra2b, 256, (3, 3), (2, 2))
+        g.add_vertex("redA", MergeVertex(), "redA_pool", ra1, ra2)
+        x = "redA"
+
+        for i in range(self.blocks17):
+            x = self._block17(g, f"block17_{i}", x)
+
+        # reduction-B
+        g.add_layer("redB_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        rb1 = self._conv(g, "redB_b1b", self._conv(g, "redB_b1a", x, 256, (1, 1)),
+                         384, (3, 3), (2, 2))
+        rb2 = self._conv(g, "redB_b2b", self._conv(g, "redB_b2a", x, 256, (1, 1)),
+                         256, (3, 3), (2, 2))
+        rb3a = self._conv(g, "redB_b3a", x, 256, (1, 1))
+        rb3b = self._conv(g, "redB_b3b", rb3a, 256, (3, 3))
+        rb3 = self._conv(g, "redB_b3c", rb3b, 256, (3, 3), (2, 2))
+        g.add_vertex("redB", MergeVertex(), "redB_pool", rb1, rb2, rb3)
+        x = "redB"
+
+        for i in range(self.blocks8):
+            x = self._block8(g, f"block8_{i}", x)
+
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type=PoolingType.AVG), x)
+        g.add_layer("bottleneck", DenseLayer(
+            n_out=self.embedding_size, activation="identity", dropout=0.8), "avgpool")
+        g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.add_layer("output", CenterLossOutputLayer(
+            n_out=self.num_classes, activation="softmax", loss="mcxent",
+            alpha=0.9, lambda_=2e-4), "embeddings")
+        g.set_outputs("output")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init(self.seed)

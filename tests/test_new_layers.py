@@ -1,0 +1,382 @@
+"""Tests for the round-1 layer additions: VAE, RBM, FrozenLayer,
+CenterLoss, YOLOv2, dropout family, weight noise, constraints,
+Upsampling1D.
+
+Mirrors the reference test strategy (SURVEY.md §4): tiny real networks,
+numeric assertions, gradient checks where the math is deterministic
+(`VaeGradientCheckTests`, `YoloGradientCheckTests` analogues).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.updaters import Adam, Sgd
+from deeplearning4j_tpu.gradientcheck import check_gradients_fn
+from deeplearning4j_tpu.nn.conf import (
+    AlphaDropout,
+    Dropout,
+    DropConnect,
+    GaussianDropout,
+    GaussianNoise,
+    InputType,
+    MaxNormConstraint,
+    MinMaxNormConstraint,
+    NeuralNetConfiguration,
+    NonNegativeConstraint,
+    UnitNormConstraint,
+    WeightNoise,
+)
+from deeplearning4j_tpu.nn.layers import (
+    RBM,
+    CenterLossOutputLayer,
+    DenseLayer,
+    FrozenLayer,
+    GaussianReconstructionDistribution,
+    BernoulliReconstructionDistribution,
+    OutputLayer,
+    Upsampling1D,
+    VariationalAutoencoder,
+    Yolo2OutputLayer,
+)
+from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _mlp_conf(out_layer, n_in=8, hidden=12, **kw):
+    b = (NeuralNetConfiguration.builder().seed(42).updater(Adam(1e-2)).list()
+         .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="tanh", **kw))
+         .layer(out_layer))
+    return b.set_input_type(InputType.feed_forward(n_in)).build()
+
+
+# --------------------------------------------------------------------- VAE
+class TestVAE:
+    def _vae(self, recon=None):
+        return VariationalAutoencoder(
+            n_in=6, n_out=3, encoder_layer_sizes=(8,), decoder_layer_sizes=(8,),
+            reconstruction_distribution=recon, activation="tanh")
+
+    def test_param_names_match_reference(self):
+        vae = self._vae()
+        params = vae.init_params(KEY)
+        assert set(params) == {"e0W", "e0b", "pZXMeanW", "pZXMeanb",
+                               "pZXLogStd2W", "pZXLogStd2b", "d0W", "d0b",
+                               "pXZW", "pXZb"}
+        # gaussian recon → 2*n_in dist params
+        assert params["pXZW"].shape == (8, 12)
+
+    def test_forward_is_latent_mean(self):
+        vae = self._vae()
+        params = vae.init_params(KEY)
+        x = jax.random.normal(KEY, (5, 6))
+        y, _ = vae.forward(params, {}, x)
+        assert y.shape == (5, 3)
+
+    def test_elbo_gradcheck(self):
+        # VaeGradientCheckTests analogue: deterministic given fixed rng
+        vae = self._vae()
+        params = vae.init_params(KEY)
+        x = np.random.default_rng(0).standard_normal((4, 6))
+        rng = jax.random.PRNGKey(7)
+        ok, worst, fails = check_gradients_fn(
+            lambda p: vae.pretrain_loss(p, jnp.asarray(x), rng), params,
+            max_params_per_array=16, max_rel_error=1e-4)
+        assert ok, f"worst rel err {worst}: {fails[:3]}"
+
+    def test_pretrain_reduces_loss(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(5e-3)).list()
+                .layer(self._vae())
+                .layer(OutputLayer(n_in=3, n_out=2))
+                .set_input_type(InputType.feed_forward(6)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(1).standard_normal((64, 6)).astype(np.float32)
+        vae = net.layers[0]
+        l0 = float(vae.pretrain_loss(net.params["0"], jnp.asarray(x),
+                                     jax.random.PRNGKey(0)))
+        net.pretrain(x, epochs=30, batch_size=64)
+        l1 = float(vae.pretrain_loss(net.params["0"], jnp.asarray(x),
+                                     jax.random.PRNGKey(0)))
+        assert l1 < l0
+
+    def test_bernoulli_recon_and_serde(self):
+        vae = self._vae(recon=BernoulliReconstructionDistribution())
+        params = vae.init_params(KEY)
+        assert params["pXZW"].shape == (8, 6)
+        clone = layer_from_dict(vae.to_dict())
+        assert clone == vae
+        assert isinstance(clone.reconstruction_distribution,
+                          BernoulliReconstructionDistribution)
+
+    def test_reconstruction_probability(self):
+        vae = self._vae()
+        params = vae.init_params(KEY)
+        x = jax.random.normal(KEY, (3, 6))
+        lp = vae.reconstruction_probability(params, x, jax.random.PRNGKey(3),
+                                            num_samples=4)
+        assert lp.shape == (3,)
+        assert np.all(np.isfinite(np.asarray(lp)))
+
+
+# --------------------------------------------------------------------- RBM
+class TestRBM:
+    def test_cd1_learns_data(self):
+        rbm = RBM(n_in=6, n_out=10, k=1)
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1)).list()
+                .layer(rbm).layer(OutputLayer(n_in=10, n_out=2))
+                .set_input_type(InputType.feed_forward(6)).build())
+        net = MultiLayerNetwork(conf).init()
+        # two binary prototype patterns
+        rng = np.random.default_rng(0)
+        protos = np.array([[1, 1, 1, 0, 0, 0], [0, 0, 0, 1, 1, 1]], np.float32)
+        x = protos[rng.integers(0, 2, 128)]
+        fe0 = float(np.mean(np.asarray(rbm.free_energy(net.params["0"],
+                                                       jnp.asarray(x)))))
+        net.pretrain(x, epochs=20, batch_size=128)
+        fe1 = float(np.mean(np.asarray(rbm.free_energy(net.params["0"],
+                                                       jnp.asarray(x)))))
+        assert fe1 < fe0  # data free energy falls as the model learns it
+
+    def test_param_names(self):
+        params = RBM(n_in=4, n_out=3).init_params(KEY)
+        assert set(params) == {"W", "b", "vb"}
+
+    def test_serde(self):
+        rbm = RBM(n_in=4, n_out=3, hidden_unit="gaussian", k=2)
+        assert layer_from_dict(rbm.to_dict()) == rbm
+
+
+# ------------------------------------------------------------- FrozenLayer
+class TestFrozenLayer:
+    def test_frozen_params_do_not_change(self):
+        inner = DenseLayer(n_in=8, n_out=12, activation="tanh")
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2)).list()
+                .layer(FrozenLayer(layer=inner))
+                .layer(OutputLayer(n_in=12, n_out=3))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        w_before = np.asarray(net.params["0"]["W"]).copy()
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((32, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        net.fit(x, y, epochs=5, batch_size=32)
+        np.testing.assert_array_equal(w_before, np.asarray(net.params["0"]["W"]))
+        # the unfrozen head did train
+        assert float(net.score(DataSet(x, y))) < 1.2
+
+    def test_serde_roundtrip(self):
+        fl = FrozenLayer(layer=DenseLayer(n_in=4, n_out=5))
+        clone = layer_from_dict(fl.to_dict())
+        assert isinstance(clone.layer, DenseLayer)
+        assert clone.layer.n_out == 5
+
+
+# -------------------------------------------------------------- CenterLoss
+class TestCenterLoss:
+    def test_trains_and_moves_centers(self):
+        out = CenterLossOutputLayer(n_in=12, n_out=3, alpha=0.5, lambda_=0.1)
+        conf = _mlp_conf(out)
+        net = MultiLayerNetwork(conf).init()
+        assert net.params["1"]["cL"].shape == (3, 12)
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((48, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 48)]
+        s0 = float(net.score(DataSet(x, y)))
+        net.fit(x, y, epochs=20, batch_size=48)
+        assert float(net.score(DataSet(x, y))) < s0
+        assert float(np.abs(np.asarray(net.params["1"]["cL"])).sum()) > 0
+
+    def test_gradcheck(self):
+        out = CenterLossOutputLayer(n_in=5, n_out=3, alpha=0.3, lambda_=0.05)
+        params = out.init_params(KEY)
+        params["cL"] = jax.random.normal(KEY, (3, 5)) * 0.1
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 5))
+        y = np.eye(3)[rng.integers(0, 3, 4)]
+        ok, worst, fails = check_gradients_fn(
+            lambda p: out.compute_loss(p, {}, jnp.asarray(x), jnp.asarray(y),
+                                       train=False),
+            params, max_rel_error=1e-4)
+        assert ok, f"worst {worst}: {fails[:3]}"
+
+
+# ------------------------------------------------------------------- YOLO2
+class TestYolo2:
+    A = ((1.0, 1.0), (2.5, 1.5))
+    C = 4
+
+    def _make(self):
+        return Yolo2OutputLayer(anchors=self.A)
+
+    def _data(self, b=2, h=4, w=4):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((b, h, w, len(self.A) * (5 + self.C))) * 0.1
+        labels = np.zeros((b, h, w, 4 + self.C), np.float32)
+        # one object per image at cell (1,2), box in grid units
+        for i in range(b):
+            labels[i, 1, 2, 0:4] = [2.1, 1.2, 2.9, 1.8]  # x1,y1,x2,y2
+            labels[i, 1, 2, 4 + (i % self.C)] = 1.0
+        return jnp.asarray(x), jnp.asarray(labels)
+
+    def test_loss_finite_and_gradcheck(self):
+        yolo = self._make()
+        x, labels = self._data()
+        loss = yolo.compute_loss({}, {}, x, labels)
+        assert np.isfinite(float(loss))
+        # grad wrt input activations (layer has no params)
+        g = jax.grad(lambda xx: yolo.compute_loss({}, {}, xx, labels))(x)
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_forward_activation_ranges(self):
+        yolo = self._make()
+        x, _ = self._data()
+        y, _ = yolo.forward({}, {}, x)
+        b, h, w, _ = x.shape
+        y = y.reshape(b, h, w, len(self.A), 5 + self.C)
+        conf = np.asarray(y[..., 4])
+        cls = np.asarray(y[..., 5:])
+        assert conf.min() >= 0 and conf.max() <= 1
+        np.testing.assert_allclose(cls.sum(-1), 1.0, rtol=1e-5)
+
+    def test_training_reduces_loss(self):
+        from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+        yolo = self._make()
+        conf = (NeuralNetConfiguration.builder().seed(9).updater(Adam(1e-3)).list()
+                .layer(ConvolutionLayer(n_out=len(self.A) * (5 + self.C),
+                                        kernel_size=(1, 1), activation="identity"))
+                .layer(yolo)
+                .set_input_type(InputType.convolutional(4, 4, 3)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 4, 4, 3)).astype(np.float32)
+        _, labels = self._data()
+        s0 = float(net.score(DataSet(x, np.asarray(labels))))
+        net.fit(x, np.asarray(labels), epochs=30, batch_size=2)
+        assert float(net.score(DataSet(x, np.asarray(labels)))) < s0
+
+    def test_serde(self):
+        yolo = self._make()
+        clone = layer_from_dict(yolo.to_dict())
+        assert clone.anchors == yolo.anchors
+
+
+# ----------------------------------------------------------- dropout family
+class TestDropoutFamily:
+    def test_dropout_inverted_scaling(self):
+        d = Dropout(p=0.8)
+        x = jnp.ones((10_000,))
+        y = d.apply(jax.random.PRNGKey(0), x)
+        assert abs(float(y.mean()) - 1.0) < 0.05
+        assert set(np.unique(np.asarray(y))) <= {0.0, np.float32(1 / 0.8)}
+
+    def test_alpha_dropout_preserves_moments(self):
+        d = AlphaDropout(p=0.9)
+        x = jax.random.normal(jax.random.PRNGKey(1), (50_000,))
+        y = d.apply(jax.random.PRNGKey(2), x)
+        assert abs(float(y.mean())) < 0.05
+        assert abs(float(y.std()) - 1.0) < 0.1
+
+    def test_gaussian_dropout_mean(self):
+        d = GaussianDropout(rate=0.5)
+        x = jnp.ones((50_000,))
+        y = d.apply(jax.random.PRNGKey(3), x)
+        assert abs(float(y.mean()) - 1.0) < 0.05
+        assert float(y.std()) > 0.5
+
+    def test_gaussian_noise_additive(self):
+        d = GaussianNoise(stddev=0.3)
+        x = jnp.zeros((50_000,))
+        y = d.apply(jax.random.PRNGKey(4), x)
+        assert abs(float(y.std()) - 0.3) < 0.03
+
+    def test_idropout_in_network_and_serde(self):
+        conf = _mlp_conf(OutputLayer(n_in=12, n_out=3),
+                         **{"dropout": GaussianDropout(rate=0.3)})
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(x, y, epochs=2, batch_size=16)  # trains without error
+        clone = MultiLayerNetwork(
+            type(conf).from_json(conf.to_json())).init()
+        assert isinstance(clone.layers[0].dropout, GaussianDropout)
+        # inference is deterministic (no noise at test time)
+        o1, o2 = net.output(x), net.output(x)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+
+
+# ------------------------------------------------------------- weight noise
+class TestWeightNoise:
+    def test_dropconnect_zeroes_weights(self):
+        dc = DropConnect(p=0.5)
+        params = {"W": jnp.ones((50, 50)), "b": jnp.ones((50,))}
+        noised = dc.apply_params(jax.random.PRNGKey(0), params)
+        frac_zero = float((noised["W"] == 0).mean())
+        assert 0.3 < frac_zero < 0.7
+        np.testing.assert_array_equal(np.asarray(noised["b"]), 1.0)  # bias untouched
+
+    def test_weight_noise_training(self):
+        conf = _mlp_conf(OutputLayer(n_in=12, n_out=3),
+                         **{"weight_noise": WeightNoise(additive=True)})
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        s0 = float(net.score(DataSet(x, y)))
+        net.fit(x, y, epochs=10, batch_size=16)
+        assert float(net.score(DataSet(x, y))) < s0
+        clone_conf = type(conf).from_json(conf.to_json())
+        assert isinstance(clone_conf.layers[0].weight_noise, WeightNoise)
+
+
+# -------------------------------------------------------------- constraints
+class TestConstraints:
+    def _train(self, constraint):
+        conf = _mlp_conf(OutputLayer(n_in=12, n_out=3),
+                         **{"constraints": [constraint]})
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        net.fit(x, y, epochs=3, batch_size=32)
+        return np.asarray(net.params["0"]["W"])
+
+    def test_max_norm(self):
+        w = self._train(MaxNormConstraint(max_norm=0.5))
+        norms = np.linalg.norm(w, axis=0)
+        assert norms.max() <= 0.5 + 1e-4
+
+    def test_unit_norm(self):
+        w = self._train(UnitNormConstraint())
+        norms = np.linalg.norm(w, axis=0)
+        np.testing.assert_allclose(norms, 1.0, atol=1e-3)
+
+    def test_min_max_norm(self):
+        w = self._train(MinMaxNormConstraint(min_norm=0.3, max_norm=0.8))
+        norms = np.linalg.norm(w, axis=0)
+        assert norms.min() >= 0.3 - 1e-3 and norms.max() <= 0.8 + 1e-3
+
+    def test_non_negative(self):
+        w = self._train(NonNegativeConstraint())
+        assert w.min() >= 0.0
+
+    def test_serde(self):
+        c = MinMaxNormConstraint(min_norm=0.1, max_norm=2.0, rate=0.5)
+        layer = DenseLayer(n_in=3, n_out=4, constraints=[c])
+        clone = layer_from_dict(layer.to_dict())
+        assert clone.constraints == [c]
+
+
+# ------------------------------------------------------------- upsampling1d
+def test_upsampling1d():
+    up = Upsampling1D(size=3)
+    x = jnp.arange(2 * 4 * 5, dtype=jnp.float32).reshape(2, 4, 5)
+    y, _ = up.forward({}, {}, x)
+    assert y.shape == (2, 12, 5)
+    np.testing.assert_array_equal(np.asarray(y[0, 0]), np.asarray(y[0, 2]))
+    t = up.get_output_type(InputType.recurrent(5, 4))
+    assert t.timesteps == 12

@@ -1,0 +1,59 @@
+"""Zoo smoke tests (reference `deeplearning4j-zoo/src/test/java/...
+TestInstantiation.java`): instantiate each model at reduced input size,
+run a forward pass and/or one training step.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo import (
+    AlexNet,
+    FaceNetNN4Small2,
+    GoogLeNet,
+    InceptionResNetV1,
+    LeNet,
+    ResNet50,
+    SimpleCNN,
+    TextGenerationLSTM,
+    VGG16,
+    VGG19,
+)
+
+
+def _img(b, h, w, c=3, seed=0):
+    return np.random.default_rng(seed).standard_normal((b, h, w, c)).astype(np.float32)
+
+
+def _onehot(b, n, seed=0):
+    return np.eye(n, dtype=np.float32)[np.random.default_rng(seed).integers(0, n, b)]
+
+
+def test_googlenet_builds_and_forwards():
+    net = GoogLeNet(num_classes=10, height=64, width=64).init()
+    out = net.output(_img(2, 64, 64))
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_inception_resnet_v1_builds_and_forwards():
+    net = InceptionResNetV1(num_classes=8, height=80, width=80,
+                            blocks35=1, blocks17=1, blocks8=1).init()
+    out = net.output(_img(2, 80, 80))
+    assert out.shape == (2, 8)
+
+
+def test_facenet_nn4_small2_trains():
+    net = FaceNetNN4Small2(num_classes=6, height=64, width=64).init()
+    x, y = _img(2, 64, 64), _onehot(2, 6)
+    out = net.output(x)
+    assert out.shape == (2, 6)
+    net.fit(x, y, epochs=1, batch_size=2)
+    assert np.isfinite(net.score_value)
+
+
+def test_facenet_embeddings_are_l2_normalized():
+    net = FaceNetNN4Small2(num_classes=6, height=64, width=64).init()
+    acts, _, _, _ = net._forward_all(net.params, net.net_state,
+                                     [_img(2, 64, 64)], train=False, rng=None)
+    emb = np.asarray(acts["embeddings"])
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, rtol=1e-4)
