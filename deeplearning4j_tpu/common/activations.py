@@ -102,10 +102,18 @@ class Activation:
 
     def __init__(self, name: str):
         name = name.lower()
-        if name not in ACTIVATIONS:
+        # parameterized form "name:value" (e.g. "leakyrelu:0.3")
+        base, _, param = name.partition(":")
+        if base not in ACTIVATIONS:
             raise ValueError(f"Unknown activation: {name!r}. Known: {sorted(ACTIVATIONS)}")
         self.name = name
-        self.fn = ACTIVATIONS[name]
+        if param and base == "leakyrelu":
+            alpha = float(param)
+            self.fn = lambda x: _leakyrelu(x, alpha)
+        elif param:
+            raise ValueError(f"Activation {base!r} takes no parameter")
+        else:
+            self.fn = ACTIVATIONS[base]
 
     def __call__(self, x):
         return self.fn(x)

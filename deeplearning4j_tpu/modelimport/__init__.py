@@ -1,0 +1,9 @@
+"""Keras model import (reference: deeplearning4j-modelimport, SURVEY §2.8).
+
+Native HDF5 access goes through the C++ shim `native/hdf5/dl4j_hdf5.cpp`
+(the reference binds libhdf5 via JavaCPP in `Hdf5Archive.java`; here the
+binding is ctypes → our C++ lib → libhdf5_serial).
+"""
+
+from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport

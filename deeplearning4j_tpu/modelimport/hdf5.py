@@ -1,0 +1,139 @@
+"""Hdf5Archive — ctypes binding over the C++ HDF5 shim.
+
+Reference: `modelimport/keras/Hdf5Archive.java` (378 LoC) which walks
+HDF5 via JavaCPP's libhdf5 binding. Same shape here: the native library
+(native/hdf5/dl4j_hdf5.cpp, compiled on first use) exposes string-attr
+reads, dataset read/write and group creation; this class is the typed
+Python surface. Writing is included so tests can fabricate golden Keras
+.h5 files without h5py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native" / "hdf5"
+_SRC = _NATIVE_DIR / "dl4j_hdf5.cpp"
+_SO = _NATIVE_DIR / "libdl4j_hdf5.so"
+
+_lib = None
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", str(_SRC), "-o", str(_SO),
+             "-l:libhdf5_serial.so.103", "-L/lib/x86_64-linux-gnu"],
+            check=True, capture_output=True)
+    lib = ctypes.CDLL(str(_SO))
+    lib.dl4j_h5_open.restype = ctypes.c_int64
+    lib.dl4j_h5_open.argtypes = [ctypes.c_char_p]
+    lib.dl4j_h5_create.restype = ctypes.c_int64
+    lib.dl4j_h5_create.argtypes = [ctypes.c_char_p]
+    lib.dl4j_h5_close.argtypes = [ctypes.c_int64]
+    lib.dl4j_h5_exists.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.dl4j_h5_create_group.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.dl4j_h5_read_string_attr.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int64]
+    lib.dl4j_h5_write_string_attr.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.dl4j_h5_write_string_array_attr.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.dl4j_h5_dataset_ndim.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int]
+    lib.dl4j_h5_read_dataset_f32.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.POINTER(ctypes.c_float)]
+    lib.dl4j_h5_write_dataset_f32.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+    _lib = lib
+    return lib
+
+
+class Hdf5Archive:
+    def __init__(self, path, mode: str = "r"):
+        self._lib = _load_lib()
+        path = str(path)
+        if mode == "r":
+            self._f = self._lib.dl4j_h5_open(path.encode())
+        elif mode == "w":
+            self._f = self._lib.dl4j_h5_create(path.encode())
+        else:
+            raise ValueError(mode)
+        if self._f <= 0:
+            raise IOError(f"Cannot open HDF5 file {path} (mode={mode})")
+
+    def close(self):
+        if self._f > 0:
+            self._lib.dl4j_h5_close(self._f)
+            self._f = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    # ------------------------------------------------------------- reads
+    def exists(self, path: str) -> bool:
+        return bool(self._lib.dl4j_h5_exists(self._f, path.encode()))
+
+    def read_attr_string(self, attr: str, obj_path: str = "/") -> Optional[str]:
+        buf = ctypes.create_string_buffer(1 << 22)
+        n = self._lib.dl4j_h5_read_string_attr(
+            self._f, obj_path.encode(), attr.encode(), buf, len(buf))
+        return None if n < 0 else buf.value.decode("utf-8")
+
+    def read_attr_strings(self, attr: str, obj_path: str = "/") -> List[str]:
+        s = self.read_attr_string(attr, obj_path)
+        return [] if s is None else ([] if s == "" else s.split("\n"))
+
+    def read_dataset(self, path: str) -> np.ndarray:
+        dims = (ctypes.c_int64 * 16)()
+        nd = self._lib.dl4j_h5_dataset_ndim(self._f, path.encode(), dims, 16)
+        if nd < 0:
+            raise KeyError(f"No dataset {path}")
+        shape = tuple(int(dims[i]) for i in range(nd))
+        out = np.zeros(shape if shape else (1,), np.float32)
+        rc = self._lib.dl4j_h5_read_dataset_f32(
+            self._f, path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc < 0:
+            raise IOError(f"Read failed for {path}")
+        return out.reshape(shape) if shape else out[0]
+
+    # ------------------------------------------------------------ writes
+    def create_group(self, path: str):
+        self._lib.dl4j_h5_create_group(self._f, path.encode())
+
+    def write_attr_string(self, attr: str, value: str, obj_path: str = "/"):
+        rc = self._lib.dl4j_h5_write_string_attr(
+            self._f, obj_path.encode(), attr.encode(), value.encode())
+        if rc < 0:
+            raise IOError(f"Attr write failed: {obj_path}@{attr}")
+
+    def write_attr_strings(self, attr: str, values: Sequence[str],
+                           obj_path: str = "/"):
+        rc = self._lib.dl4j_h5_write_string_array_attr(
+            self._f, obj_path.encode(), attr.encode(),
+            "\n".join(values).encode())
+        if rc < 0:
+            raise IOError(f"Attr write failed: {obj_path}@{attr}")
+
+    def write_dataset(self, path: str, data: np.ndarray):
+        data = np.ascontiguousarray(data, np.float32)
+        dims = (ctypes.c_int64 * max(data.ndim, 1))(*data.shape)
+        rc = self._lib.dl4j_h5_write_dataset_f32(
+            self._f, path.encode(), dims, data.ndim,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc < 0:
+            raise IOError(f"Dataset write failed: {path}")

@@ -1,0 +1,474 @@
+"""KerasModelImport — Keras 1 & 2 .h5 → framework models.
+
+Reference: `modelimport/keras/KerasModelImport.java:50-194` (entry
+points), `KerasModel.java:57` (config parse :175, graph build :276,
+weight copy :364-380 → `KerasModelUtils.copyWeightsToModel:59`), dialect
+tables `config/Keras1LayerConfiguration.java` /
+`Keras2LayerConfiguration.java`, and the per-layer `layers/**` mapping
+classes.
+
+Layout notes (TPU-native NHWC):
+- Dense kernel [in, out] → "W" directly.
+- Conv2D kernel [kh, kw, in, out] (TF/Keras2) → HWIO "W" directly;
+  Keras 1 Theano kernels [out, in, kh, kw] are transposed + flipped.
+- LSTM kernels are gate-reordered Keras IFCO → framework IFOG
+  (`KerasLstm.java` does the same gate shuffling for DL4J's order).
+- BatchNorm gamma/beta are params; moving mean/var land in net state.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    LSTM,
+    ActivationLayer,
+    BatchNormalization,
+    Convolution1DLayer,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    LastTimeStep,
+    LossLayer,
+    OutputLayer,
+    SimpleRnn,
+    Subsampling1DLayer,
+    SubsamplingLayer,
+    Upsampling2D,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode, PoolingMode
+from deeplearning4j_tpu.nn.layers.pooling import PoolingType
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+_ACTIVATIONS = {
+    "relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh", "softmax": "softmax",
+    "linear": "identity", "softplus": "softplus", "softsign": "softsign",
+    "elu": "elu", "selu": "selu", "hard_sigmoid": "hardsigmoid",
+    "swish": "swish", "gelu": "gelu", "relu6": "relu6",
+}
+
+
+def _act(name):
+    if name is None:
+        return "identity"
+    return _ACTIVATIONS.get(name, name)
+
+
+def _conv_mode(cfg):
+    # Keras2 "padding" / Keras1 "border_mode"
+    pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+    return ConvolutionMode.SAME if pad == "same" else ConvolutionMode.TRUNCATE
+
+
+def _pair(v, default=(1, 1)):
+    if v is None:
+        return default
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+class KerasLayerMapper:
+    """One Keras layer dict → zero or more framework layers.
+
+    Handles both dialects: Keras 1 (`output_dim`, `nb_filter`,
+    `nb_row/nb_col`, `subsample`, `border_mode`, `init`) and Keras 2
+    (`units`, `filters`, `kernel_size`, `strides`, `padding`)."""
+
+    def map(self, class_name: str, cfg: dict) -> List:
+        m = getattr(self, f"_map_{class_name.lower()}", None)
+        if m is None:
+            raise ValueError(f"Unsupported Keras layer: {class_name}")
+        out = m(cfg)
+        return out if isinstance(out, list) else [out]
+
+    # ---- core ----
+    def _units(self, cfg):
+        return int(cfg.get("units", cfg.get("output_dim", 0)))
+
+    def _map_dense(self, cfg):
+        return DenseLayer(n_out=self._units(cfg),
+                          activation=_act(cfg.get("activation")),
+                          has_bias=cfg.get("use_bias", cfg.get("bias", True)),
+                          name=cfg.get("name"))
+
+    def _map_activation(self, cfg):
+        return ActivationLayer(activation=_act(cfg.get("activation")),
+                               name=cfg.get("name"))
+
+    def _map_leakyrelu(self, cfg):
+        alpha = cfg.get("alpha", 0.3)  # Keras default alpha is 0.3
+        return ActivationLayer(activation=f"leakyrelu:{alpha}",
+                               name=cfg.get("name"))
+
+    def _map_dropout(self, cfg):
+        # Keras rate = DROP fraction; framework dropout = RETAIN prob
+        rate = cfg.get("rate", cfg.get("p", 0.5))
+        return DropoutLayer(dropout=1.0 - float(rate), name=cfg.get("name"))
+
+    def _map_flatten(self, cfg):
+        return []  # automatic CNN→FF preprocessor insertion handles this
+
+    def _map_masking(self, cfg):
+        return []  # masks are explicit in this framework's fit/eval API
+
+    # ---- conv ----
+    def _map_conv2d(self, cfg):
+        kernel = _pair(cfg.get("kernel_size",
+                               (cfg.get("nb_row"), cfg.get("nb_col"))
+                               if cfg.get("nb_row") else None), (3, 3))
+        return ConvolutionLayer(
+            n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
+            kernel_size=kernel,
+            stride=_pair(cfg.get("strides", cfg.get("subsample"))),
+            convolution_mode=_conv_mode(cfg),
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", cfg.get("bias", True)),
+            name=cfg.get("name"))
+
+    _map_convolution2d = _map_conv2d  # Keras 1 name
+
+    def _map_conv1d(self, cfg):
+        k = cfg.get("kernel_size", cfg.get("filter_length", 3))
+        k = k[0] if isinstance(k, (list, tuple)) else k
+        s = cfg.get("strides", cfg.get("subsample_length", 1))
+        s = s[0] if isinstance(s, (list, tuple)) else s
+        return Convolution1DLayer(
+            n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
+            kernel_size=int(k), stride=int(s),
+            convolution_mode=_conv_mode(cfg),
+            activation=_act(cfg.get("activation")),
+            name=cfg.get("name"))
+
+    _map_convolution1d = _map_conv1d
+
+    def _map_maxpooling2d(self, cfg):
+        return SubsamplingLayer(
+            pooling_type=PoolingMode.MAX,
+            kernel_size=_pair(cfg.get("pool_size"), (2, 2)),
+            stride=_pair(cfg.get("strides", cfg.get("pool_size")), (2, 2)),
+            convolution_mode=_conv_mode(cfg), name=cfg.get("name"))
+
+    def _map_averagepooling2d(self, cfg):
+        layer = self._map_maxpooling2d(cfg)
+        layer.pooling_type = PoolingMode.AVG
+        return layer
+
+    def _map_maxpooling1d(self, cfg):
+        k = cfg.get("pool_size", cfg.get("pool_length", 2))
+        k = k[0] if isinstance(k, (list, tuple)) else k
+        s = cfg.get("strides", cfg.get("stride")) or k
+        s = s[0] if isinstance(s, (list, tuple)) else s
+        return Subsampling1DLayer(kernel_size=int(k), stride=int(s),
+                                  convolution_mode=_conv_mode(cfg),
+                                  name=cfg.get("name"))
+
+    def _map_averagepooling1d(self, cfg):
+        layer = self._map_maxpooling1d(cfg)
+        layer.pooling_type = PoolingMode.AVG
+        return layer
+
+    def _map_globalmaxpooling2d(self, cfg):
+        return GlobalPoolingLayer(pooling_type=PoolingType.MAX, name=cfg.get("name"))
+
+    def _map_globalaveragepooling2d(self, cfg):
+        return GlobalPoolingLayer(pooling_type=PoolingType.AVG, name=cfg.get("name"))
+
+    _map_globalmaxpooling1d = _map_globalmaxpooling2d
+    _map_globalaveragepooling1d = _map_globalaveragepooling2d
+
+    def _map_zeropadding2d(self, cfg):
+        pad = cfg.get("padding", 1)
+        return ZeroPaddingLayer(pad=pad if isinstance(pad, int) else tuple(
+            tuple(p) if isinstance(p, (list, tuple)) else (p, p) for p in pad),
+            name=cfg.get("name"))
+
+    def _map_upsampling2d(self, cfg):
+        return Upsampling2D(size=_pair(cfg.get("size"), (2, 2)),
+                            name=cfg.get("name"))
+
+    # ---- recurrent / embedding ----
+    def _map_embedding(self, cfg):
+        return EmbeddingLayer(n_in=int(cfg.get("input_dim", 0)),
+                              n_out=int(cfg.get("output_dim", 0)),
+                              has_bias=False, name=cfg.get("name"))
+
+    def _map_lstm(self, cfg):
+        layers = [LSTM(n_out=self._units(cfg),
+                       activation=_act(cfg.get("activation", "tanh")),
+                       gate_activation=_act(cfg.get("recurrent_activation",
+                                                    cfg.get("inner_activation",
+                                                            "hard_sigmoid"))),
+                       name=cfg.get("name"))]
+        if not cfg.get("return_sequences", False):
+            layers.append(LastTimeStep())
+        return layers
+
+    def _map_simplernn(self, cfg):
+        layers = [SimpleRnn(n_out=self._units(cfg),
+                            activation=_act(cfg.get("activation", "tanh")),
+                            name=cfg.get("name"))]
+        if not cfg.get("return_sequences", False):
+            layers.append(LastTimeStep())
+        return layers
+
+    # ---- normalization ----
+    def _map_batchnormalization(self, cfg):
+        return BatchNormalization(eps=float(cfg.get("epsilon", 1e-3)),
+                                  decay=float(cfg.get("momentum", 0.99)),
+                                  name=cfg.get("name"))
+
+
+class KerasModelImport:
+    """Entry points mirroring `KerasModelImport.java`."""
+
+    # ------------------------------------------------------------ public
+    @staticmethod
+    def import_keras_model_and_weights(path, enforce_training_config=False):
+        with Hdf5Archive(path) as h5:
+            config = h5.read_attr_string("model_config")
+            if config is None:
+                raise ValueError(f"{path}: no model_config attribute")
+            model_dict = json.loads(config)
+            if model_dict.get("class_name") == "Sequential":
+                return KerasModelImport._import_sequential(model_dict, h5)
+            return KerasModelImport._import_functional(model_dict, h5)
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(path, **kw):
+        model = KerasModelImport.import_keras_model_and_weights(path, **kw)
+        if not isinstance(model, MultiLayerNetwork):
+            raise ValueError("Not a Sequential model")
+        return model
+
+    # -------------------------------------------------------- sequential
+    @staticmethod
+    def _layer_list(model_dict):
+        cfg = model_dict["config"]
+        if isinstance(cfg, dict):   # Keras 2.2+: {"name":..., "layers":[...]}
+            return cfg["layers"]
+        return cfg                   # Keras 1 / early 2: [...]
+
+    @staticmethod
+    def _input_type_from(layer_cfgs):
+        first = layer_cfgs[0]["config"]
+        shape = first.get("batch_input_shape")
+        if shape is not None:
+            dims = [d for d in shape[1:]]
+            if len(dims) == 3:   # [H, W, C] (channels_last)
+                return InputType.convolutional(dims[0], dims[1], dims[2])
+            if len(dims) == 2:   # [T, F]
+                return InputType.recurrent(dims[1], dims[0])
+            if len(dims) == 1:
+                return InputType.feed_forward(dims[0])
+        if "input_dim" in first and first.get("input_length"):
+            return InputType.recurrent(first["input_dim"], first["input_length"])
+        if "input_dim" in first:
+            return InputType.feed_forward(first["input_dim"])
+        raise ValueError("Cannot infer input shape from Keras config")
+
+    @staticmethod
+    def _import_sequential(model_dict, h5) -> MultiLayerNetwork:
+        layer_cfgs = KerasModelImport._layer_list(model_dict)
+        mapper = KerasLayerMapper()
+        builder = (NeuralNetConfiguration.builder().updater(Adam(1e-3)).list())
+        keras_names: List[Tuple[str, int]] = []  # (keras layer name, our idx)
+        idx = 0
+        for lc in layer_cfgs:
+            cname = lc["class_name"]
+            if cname == "InputLayer":
+                continue
+            mapped = mapper.map(cname, lc["config"])
+            for mi, layer in enumerate(mapped):
+                if mi == 0 and layer.__class__.__name__ != "LastTimeStep":
+                    keras_names.append((lc["config"].get("name", cname), idx))
+                builder.layer(layer)
+                idx += 1
+        builder.set_input_type(KerasModelImport._input_type_from(layer_cfgs))
+        net = MultiLayerNetwork(builder.build()).init()
+        KerasModelImport._copy_weights_mln(net, h5, keras_names)
+        return net
+
+    # -------------------------------------------------------- functional
+    @staticmethod
+    def _import_functional(model_dict, h5) -> ComputationGraph:
+        cfg = model_dict["config"]
+        layer_cfgs = cfg["layers"]
+        mapper = KerasLayerMapper()
+        builder = NeuralNetConfiguration.builder().updater(Adam(1e-3))
+        g = ComputationGraphConfiguration.graph_builder(builder)
+        input_names = [l[0] if isinstance(l, list) else l
+                       for l in cfg.get("input_layers", [])]
+        output_names = [l[0] if isinstance(l, list) else l
+                        for l in cfg.get("output_layers", [])]
+        g.add_inputs(*[n for n in input_names])
+        input_types = []
+        keras_names: List[Tuple[str, str]] = []
+        alias: Dict[str, str] = {}  # keras layer name → node producing its output
+        for lc in layer_cfgs:
+            cname = lc["class_name"]
+            name = lc.get("name", lc["config"].get("name"))
+            inbound = lc.get("inbound_nodes", [])
+            srcs = []
+            if inbound:
+                node = inbound[0]
+                entries = node if isinstance(node, list) else node.get("args", [])
+                for e in entries:
+                    srcs.append(e[0] if isinstance(e, list) else e)
+            srcs = [alias.get(s, s) for s in srcs]
+            if cname == "InputLayer":
+                shape = lc["config"].get("batch_input_shape")
+                dims = shape[1:]
+                if len(dims) == 3:
+                    input_types.append(InputType.convolutional(*dims))
+                elif len(dims) == 2:
+                    input_types.append(InputType.recurrent(dims[1], dims[0]))
+                else:
+                    input_types.append(InputType.feed_forward(dims[0]))
+                alias[name] = name
+                continue
+            if cname == "Add" or (cname == "Merge" and
+                                  lc["config"].get("mode", "sum") in ("sum", None)):
+                g.add_vertex(name, ElementWiseVertex(op="add"), *srcs)
+                alias[name] = name
+                continue
+            if cname == "Concatenate" or (cname == "Merge" and
+                                          lc["config"].get("mode") == "concat"):
+                g.add_vertex(name, MergeVertex(), *srcs)
+                alias[name] = name
+                continue
+            mapped = mapper.map(cname, lc["config"])
+            if not mapped:  # Flatten/Masking: pass-through to the source
+                alias[name] = srcs[0]
+                continue
+            prev = srcs
+            for mi, layer in enumerate(mapped):
+                lname = name if mi == 0 else f"{name}_{mi}"
+                if mi == 0:
+                    keras_names.append((name, lname))
+                g.add_layer(lname, layer, *prev)
+                prev = [lname]
+            alias[name] = prev[0]  # downstream refs see the LAST mapped layer
+        g.set_input_types(*input_types)
+        g.set_outputs(*[alias.get(n, n) for n in output_names])
+        net = ComputationGraph(g.build()).init()
+        KerasModelImport._copy_weights_graph(net, h5, keras_names)
+        return net
+
+    # ----------------------------------------------------------- weights
+    @staticmethod
+    def _weights_root(h5) -> str:
+        return "/model_weights" if h5.exists("/model_weights") else "/"
+
+    @staticmethod
+    def _layer_weights(h5, root: str, lname: str) -> Dict[str, np.ndarray]:
+        gpath = f"{root}/{lname}".replace("//", "/")
+        names = h5.read_attr_strings("weight_names", gpath)
+        out = {}
+        for wn in names:
+            short = wn.split("/")[-1].split(":")[0]
+            out[short] = h5.read_dataset(f"{gpath}/{wn}".replace("//", "/"))
+        return out
+
+    @staticmethod
+    def _convert(layer, kw: Dict[str, np.ndarray]) -> Tuple[Dict, Dict]:
+        """Keras weights → (params, state) for one framework layer."""
+        params, state = {}, {}
+        cls = layer.__class__.__name__
+        if cls in ("DenseLayer", "OutputLayer"):
+            params["W"] = kw.get("kernel", kw.get("W"))
+            if "bias" in kw or "b" in kw:
+                params["b"] = kw.get("bias", kw.get("b"))
+        elif cls in ("ConvolutionLayer", "Convolution1DLayer"):
+            k = kw.get("kernel", kw.get("W"))
+            if k is not None and k.ndim == 3:
+                k = k[:, None, :, :]  # Keras Conv1D [k,in,out] → [k,1,in,out]
+            params["W"] = k
+            if "bias" in kw or "b" in kw:
+                params["b"] = kw.get("bias", kw.get("b"))
+        elif cls == "EmbeddingLayer":
+            params["W"] = kw.get("embeddings", kw.get("W"))
+        elif cls in ("LSTM", "GravesLSTM"):
+            K = kw.get("kernel"); R = kw.get("recurrent_kernel"); b = kw.get("bias")
+            if K is None and "W_i" in kw:  # Keras 1 per-gate weights
+                K = np.concatenate([kw["W_i"], kw["W_f"], kw["W_c"], kw["W_o"]], 1)
+                R = np.concatenate([kw["U_i"], kw["U_f"], kw["U_c"], kw["U_o"]], 1)
+                b = np.concatenate([kw["b_i"], kw["b_f"], kw["b_c"], kw["b_o"]])
+
+            def ifco_to_ifog(a, axis):
+                i, f, c, o = np.split(a, 4, axis=axis)
+                return np.concatenate([i, f, o, c], axis=axis)
+            params["W"] = ifco_to_ifog(K, 1)
+            params["RW"] = ifco_to_ifog(R, 1)
+            if b is not None:
+                params["b"] = ifco_to_ifog(b, 0)
+        elif cls == "SimpleRnn":
+            params["W"] = kw.get("kernel")
+            params["RW"] = kw.get("recurrent_kernel")
+            if "bias" in kw:
+                params["b"] = kw.get("bias")
+        elif cls == "BatchNormalization":
+            if "gamma" in kw:
+                params["gamma"] = kw["gamma"]
+            if "beta" in kw:
+                params["beta"] = kw["beta"]
+            if "moving_mean" in kw:
+                state["mean"] = kw["moving_mean"]
+            if "moving_variance" in kw:
+                state["var"] = kw["moving_variance"]
+        return params, state
+
+    @staticmethod
+    def _coerce(arr: np.ndarray, expect, kname: str, pn: str) -> np.ndarray:
+        """Shape-check against the initialized param; a 4-D mismatch that
+        matches after OIHW→HWIO transpose is a Theano-dialect kernel
+        (`KerasConvolution.java` dim-ordering handling) — transpose +
+        180° spatial flip."""
+        expect = tuple(expect)
+        if tuple(arr.shape) == expect:
+            return arr
+        if arr.ndim == 4 and np.transpose(arr, (2, 3, 1, 0)).shape == expect:
+            return np.ascontiguousarray(np.transpose(arr, (2, 3, 1, 0))[::-1, ::-1])
+        raise ValueError(f"layer {kname} param {pn}: {arr.shape} != {expect}")
+
+    @staticmethod
+    def _apply_weights(net, params_key, layer, kw, kname):
+        params, state = KerasModelImport._convert(layer, kw)
+        for pn, arr in params.items():
+            if arr is None:
+                continue
+            arr = KerasModelImport._coerce(np.asarray(arr),
+                                           net.params[params_key][pn].shape,
+                                           kname, pn)
+            net.params[params_key][pn] = np.asarray(arr, np.float32)
+        for sn, arr in state.items():
+            net.net_state[params_key][sn] = np.asarray(arr, np.float32)
+
+    @staticmethod
+    def _copy_weights_mln(net, h5, keras_names):
+        root = KerasModelImport._weights_root(h5)
+        for kname, idx in keras_names:
+            kw = KerasModelImport._layer_weights(h5, root, kname)
+            if kw:
+                KerasModelImport._apply_weights(net, str(idx), net.layers[idx],
+                                                kw, kname)
+
+    @staticmethod
+    def _copy_weights_graph(net, h5, keras_names):
+        root = KerasModelImport._weights_root(h5)
+        for kname, our_name in keras_names:
+            kw = KerasModelImport._layer_weights(h5, root, kname)
+            if kw:
+                KerasModelImport._apply_weights(
+                    net, our_name, net.conf.nodes[our_name].layer, kw, kname)

@@ -1,0 +1,38 @@
+"""NLP stack (reference: deeplearning4j-nlp-parent, SURVEY.md §2.5).
+
+SequenceVectors engine redesigned TPU-first: instead of the reference's
+Hogwild `VectorCalculationsThread`s doing lock-free scalar updates
+(`SequenceVectors.java:294-296`), training batches (center, context,
+negatives) pairs on the host and runs ONE jitted device step per batch
+— gathers + matmuls + scatter-adds that XLA fuses; same capability
+(skip-gram/CBOW, hierarchical softmax + negative sampling, subsampling,
+lr decay), a schedule that actually maps to the MXU.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    Tokenizer,
+    DefaultTokenizer,
+    NGramTokenizer,
+    TokenizerFactory,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+    CommonPreprocessor,
+    EndingPreProcessor,
+)
+from deeplearning4j_tpu.nlp.sentenceiterator import (
+    SentenceIterator,
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LabelledDocument,
+    LabelAwareIterator,
+    SimpleLabelAwareIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabWord, VocabCache, VocabConstructor
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors, SequenceVectorsConfig
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraphvectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+from deeplearning4j_tpu.nlp.bagofwords import CountVectorizer, TfidfVectorizer
+from deeplearning4j_tpu.nlp.iterator import CnnSentenceDataSetIterator

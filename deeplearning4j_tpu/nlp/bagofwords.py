@@ -1,0 +1,72 @@
+"""Bag-of-words vectorizers: counts and TF-IDF.
+
+Reference: `bagofwords/vectorizer/BagOfWordsVectorizer.java` and
+`TfidfVectorizer.java` — fit a vocabulary over a corpus, then transform
+documents to dense vocab-sized vectors.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+
+
+class CountVectorizer:
+    """Term-count vectors (reference BagOfWordsVectorizer)."""
+
+    def __init__(self, tokenizer_factory=None, min_word_frequency: int = 1):
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.min_word_frequency = min_word_frequency
+        self.vocab: Optional[VocabCache] = None
+
+    def _tokens(self, text: str) -> List[str]:
+        return self.tokenizer_factory.create(text).get_tokens()
+
+    def fit(self, corpus: Iterable[str]):
+        seqs = [self._tokens(t) for t in corpus]
+        self.vocab = VocabConstructor(
+            min_word_frequency=self.min_word_frequency,
+            build_huffman_tree=False).build(seqs)
+        return self
+
+    def transform(self, text: str) -> np.ndarray:
+        vec = np.zeros((self.vocab.num_words(),), np.float32)
+        for tok in self._tokens(text):
+            i = self.vocab.index_of(tok)
+            if i >= 0:
+                vec[i] += 1.0
+        return vec
+
+    def fit_transform(self, corpus: Iterable[str]) -> np.ndarray:
+        corpus = list(corpus)
+        self.fit(corpus)
+        return np.stack([self.transform(t) for t in corpus])
+
+
+class TfidfVectorizer(CountVectorizer):
+    """TF-IDF weighting (reference TfidfVectorizer: idf = log(N/df))."""
+
+    def fit(self, corpus: Iterable[str]):
+        corpus = list(corpus)
+        super().fit(corpus)
+        V = self.vocab.num_words()
+        df = np.zeros((V,), np.float64)
+        for text in corpus:
+            seen = {self.vocab.index_of(t) for t in self._tokens(text)}
+            for i in seen:
+                if i >= 0:
+                    df[i] += 1
+        n_docs = max(len(corpus), 1)
+        self.idf = np.log(n_docs / np.clip(df, 1.0, None)).astype(np.float32)
+        return self
+
+    def transform(self, text: str) -> np.ndarray:
+        counts = super().transform(text)
+        total = counts.sum()
+        tf = counts / total if total > 0 else counts
+        return tf * self.idf

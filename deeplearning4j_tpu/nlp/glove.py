@@ -1,0 +1,117 @@
+"""GloVe embeddings.
+
+Reference: `models/glove/Glove.java` + `AbstractCoOccurrences.java`
+(646 LoC): windowed co-occurrence counting pass, then AdaGrad descent
+on the weighted least-squares objective
+f(X_ij)(w_i·w̃_j + b_i + b̃_j − log X_ij)².
+
+TPU realisation: co-occurrence counting on host (sparse dict), then the
+whole optimisation runs as jitted minibatch AdaGrad steps over the
+non-zero entries — gathers + fused elementwise, scatter-add updates.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors, SequenceVectorsConfig
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(w, wt, b, bt, gw, gwt, gb, gbt, rows, cols, logx, weight, lr):
+    """One AdaGrad step on a batch of non-zero co-occurrence cells."""
+
+    def loss_fn(w_, wt_, b_, bt_):
+        wi = jnp.take(w_, rows, axis=0)
+        wj = jnp.take(wt_, cols, axis=0)
+        pred = jnp.sum(wi * wj, axis=-1) + jnp.take(b_, rows) + jnp.take(bt_, cols)
+        return jnp.sum(weight * (pred - logx) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3))(w, wt, b, bt)
+    outs = []
+    for p, g, acc in ((w, grads[0], gw), (wt, grads[1], gwt),
+                      (b, grads[2], gb), (bt, grads[3], gbt)):
+        acc = acc + g * g
+        p = p - lr * g / jnp.sqrt(acc + 1e-8)
+        outs.extend([p, acc])
+    w, gw, wt, gwt, b, gb, bt, gbt = outs
+    return w, wt, b, bt, gw, gwt, gb, gbt, loss
+
+
+class Glove(SequenceVectors):
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 min_word_frequency: int = 1, learning_rate: float = 0.05,
+                 epochs: int = 5, x_max: float = 100.0, alpha: float = 0.75,
+                 batch_size: int = 8192, symmetric: bool = True, seed: int = 42):
+        super().__init__(SequenceVectorsConfig(
+            vector_length=layer_size, window=window,
+            min_word_frequency=min_word_frequency,
+            learning_rate=learning_rate, epochs=epochs,
+            batch_size=batch_size, seed=seed))
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+
+    def _count_cooccurrences(self, sequences) -> Dict[Tuple[int, int], float]:
+        """Windowed 1/d-weighted counts (reference
+        `AbstractCoOccurrences.java`)."""
+        counts: Dict[Tuple[int, int], float] = {}
+        w = self.conf.window
+        for tokens in sequences:
+            idxs = [self.vocab.index_of(t) for t in tokens]
+            idxs = [i for i in idxs if i >= 0]
+            for pos, i in enumerate(idxs):
+                for off in range(1, w + 1):
+                    if pos + off >= len(idxs):
+                        break
+                    j = idxs[pos + off]
+                    inc = 1.0 / off
+                    counts[(i, j)] = counts.get((i, j), 0.0) + inc
+                    if self.symmetric:
+                        counts[(j, i)] = counts.get((j, i), 0.0) + inc
+        return counts
+
+    def fit(self, sequences, **_):
+        sequences = list(sequences)
+        self.build_vocab(sequences)
+        V, D = self.vocab.num_words(), self.conf.vector_length
+        rng = self._rng
+        counts = self._count_cooccurrences(sequences)
+        items = list(counts.items())
+        rows = np.array([ij[0] for ij, _ in items], np.int32)
+        cols = np.array([ij[1] for ij, _ in items], np.int32)
+        xs = np.array([x for _, x in items], np.float32)
+        logx = np.log(xs)
+        weight = np.minimum((xs / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+
+        scale = 0.5 / D
+        w = (rng.random((V, D), np.float32) - 0.5) * 2 * scale
+        wt = (rng.random((V, D), np.float32) - 0.5) * 2 * scale
+        b = np.zeros((V,), np.float32)
+        bt = np.zeros((V,), np.float32)
+        gw = np.ones_like(w); gwt = np.ones_like(wt)
+        gb = np.ones_like(b); gbt = np.ones_like(bt)
+
+        B = self.conf.batch_size
+        n = len(items)
+        self.last_loss = 0.0
+        for _ in range(self.conf.epochs):
+            order = rng.permutation(n)
+            for s in range(0, n, B):
+                sel = order[s:s + B]
+                (w, wt, b, bt, gw, gwt, gb, gbt, loss) = _glove_step(
+                    w, wt, b, bt, gw, gwt, gb, gbt,
+                    rows[sel], cols[sel], logx[sel], weight[sel],
+                    np.float32(self.conf.learning_rate))
+                self.last_loss = float(loss) / max(len(sel), 1)
+        # final embeddings = w + wt (GloVe paper / reference convention)
+        self.syn0 = np.asarray(w) + np.asarray(wt)
+        self.syn1neg = np.zeros_like(self.syn0)
+        self.syn1 = np.zeros_like(self.syn0)
+        return self

@@ -1,0 +1,399 @@
+"""SequenceVectors — the generic embedding-training engine, TPU-first.
+
+Reference: `models/sequencevectors/SequenceVectors.java:192` (`fit()`):
+vocab scan → AsyncSequencer prefetch thread → N Hogwild
+`VectorCalculationsThread`s doing per-pair scalar updates through the
+fused native `AggregateSkipGram` op (`SkipGram.java:224`,
+`iterateSample`).
+
+TPU redesign (same capability, device-friendly schedule): the host side
+streams sequences, applies frequent-word subsampling and the
+reduced-window trick, and packs (center, context, negatives) into
+fixed-shape batches; the device side runs ONE jitted step per batch —
+embedding gathers, a [B,K] dot-product block (MXU), log-sigmoid loss,
+and autodiff scatter-add updates. Batched minibatch SGD replaces
+Hogwild (which does not map to SPMD hardware); gradients are averaged
+over the batch (minibatch SGD), trading the reference's per-pair
+sequential updates for device-sized steps. Both learning regimes are kept: negative sampling and
+hierarchical softmax over Huffman codes (padded [B, C] with masks so
+shapes stay static for XLA).
+
+Skip-gram and CBOW both supported (`elements_learning_algorithm`);
+ParagraphVectors reuses this engine by extending the embedding table
+with label rows (see paragraphvectors.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+
+
+@dataclasses.dataclass
+class SequenceVectorsConfig:
+    vector_length: int = 100
+    window: int = 5
+    min_word_frequency: int = 1
+    negative: int = 5           # K negative samples; 0 → hierarchical softmax
+    use_hierarchic_softmax: bool = False
+    learning_rate: float = 0.025
+    min_learning_rate: float = 1e-4
+    epochs: int = 1
+    iterations: int = 1         # passes per batch (reference `iterations`)
+    batch_size: int = 2048      # pairs per device step
+    subsampling: float = 0.0    # frequent-word discard threshold (e.g. 1e-3)
+    seed: int = 42
+    cbow: bool = False          # elements learning algorithm: CBOW vs SkipGram
+    unigram_power: float = 0.75  # negative-table exponent (word2vec standard)
+
+
+# ------------------------------------------------------------ jitted steps
+@partial(jax.jit, static_argnums=(6,), donate_argnums=(0, 1))
+def _sg_neg_step(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
+    """Skip-gram negative-sampling step. trainable_from: row index from
+    which syn0 rows are trainable (0 = all; used by inferVector)."""
+
+    def loss_fn(s0, s1):
+        v = jnp.take(s0, centers, axis=0)                      # [B,D]
+        u_pos = jnp.take(s1, contexts, axis=0)                 # [B,D]
+        u_neg = jnp.take(s1, negs, axis=0)                     # [B,K,D]
+        pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, axis=-1))
+        neg = jnp.sum(jax.nn.log_sigmoid(
+            -jnp.einsum("bd,bkd->bk", v, u_neg)), axis=-1)
+        return -jnp.mean(pos + neg)
+
+    loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1neg)
+    if trainable_from > 0:
+        # inference mode: only rows >= trainable_from learn; the output
+        # table is frozen entirely (reference inferVector semantics)
+        row_ok = (jnp.arange(syn0.shape[0]) >= trainable_from)[:, None]
+        g0 = jnp.where(row_ok, g0, 0.0)
+        g1 = jnp.zeros_like(g1)
+    return syn0 - lr * g0, syn1neg - lr * g1, loss
+
+
+@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+def _cbow_neg_step(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr, trainable_from):
+    """CBOW negative-sampling step. ctx: [B, 2W] indices, ctx_mask 0/1."""
+
+    def loss_fn(s0, s1):
+        vecs = jnp.take(s0, ctx, axis=0)                       # [B,2W,D]
+        m = ctx_mask[..., None]
+        h = jnp.sum(vecs * m, axis=1) / jnp.clip(
+            jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0, None)
+        u_pos = jnp.take(s1, centers, axis=0)
+        u_neg = jnp.take(s1, negs, axis=0)
+        pos = jax.nn.log_sigmoid(jnp.sum(h * u_pos, axis=-1))
+        neg = jnp.sum(jax.nn.log_sigmoid(
+            -jnp.einsum("bd,bkd->bk", h, u_neg)), axis=-1)
+        return -jnp.mean(pos + neg)
+
+    loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1neg)
+    if trainable_from > 0:
+        row_ok = (jnp.arange(syn0.shape[0]) >= trainable_from)[:, None]
+        g0 = jnp.where(row_ok, g0, 0.0)
+        g1 = jnp.zeros_like(g1)
+    return syn0 - lr * g0, syn1neg - lr * g1, loss
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, centers, points, codes, code_mask, lr):
+    """CBOW + hierarchical softmax: context mean classified down the
+    center word's Huffman path (reference `CBOW.java` HS branch)."""
+
+    def loss_fn(s0, s1):
+        vecs = jnp.take(s0, ctx, axis=0)
+        m = ctx_mask[..., None]
+        h = jnp.sum(vecs * m, axis=1) / jnp.clip(
+            jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0, None)
+        u = jnp.take(s1, points, axis=0)                       # [B,C,D]
+        sign = 1.0 - 2.0 * codes
+        logits = jnp.einsum("bd,bcd->bc", h, u) * sign
+        return -jnp.sum(jax.nn.log_sigmoid(logits) * code_mask) / centers.shape[0]
+
+    loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1)
+    return syn0 - lr * g0, syn1 - lr * g1, loss
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
+    """Skip-gram hierarchical-softmax step over Huffman paths
+    (reference `SkipGram.iterateSample` HS branch, `SkipGram.java:224`)."""
+
+    def loss_fn(s0, s1):
+        v = jnp.take(s0, centers, axis=0)                      # [B,D]
+        u = jnp.take(s1, points, axis=0)                       # [B,C,D]
+        sign = 1.0 - 2.0 * codes                               # code 0 → +1
+        logits = jnp.einsum("bd,bcd->bc", v, u) * sign
+        return -jnp.sum(jax.nn.log_sigmoid(logits) * code_mask) / centers.shape[0]
+
+    loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1)
+    return syn0 - lr * g0, syn1 - lr * g1, loss
+
+
+class SequenceVectors:
+    """Trains an embedding table over token sequences."""
+
+    def __init__(self, config: Optional[SequenceVectorsConfig] = None, **kw):
+        if config is None:
+            config = SequenceVectorsConfig(**kw)
+        self.conf = config
+        self.vocab: Optional[VocabCache] = None
+        self.syn0 = None       # np.ndarray [V(+labels), D]
+        self.syn1 = None       # HS inner-node table
+        self.syn1neg = None    # negative-sampling output table
+        self._neg_table = None
+        self._rng = np.random.default_rng(config.seed)
+
+    # ------------------------------------------------------------- vocab
+    def build_vocab(self, sequences: Iterable[List[str]]):
+        self.vocab = VocabConstructor(
+            min_word_frequency=self.conf.min_word_frequency).build(sequences)
+        return self
+
+    def _init_tables(self, extra_rows: int = 0):
+        V = self.vocab.num_words()
+        D = self.conf.vector_length
+        # word2vec init: U(-0.5, 0.5)/D for syn0, zeros for output tables
+        self.syn0 = ((self._rng.random((V + extra_rows, D)) - 0.5) / D
+                     ).astype(np.float32)
+        self.syn1neg = np.zeros((V, D), np.float32)
+        max_inner = max(V, 2)
+        self.syn1 = np.zeros((max_inner, D), np.float32)
+        # unigram^0.75 negative-sampling table (word2vec standard);
+        # sampling = searchsorted over the CDF (fast host path)
+        self._freqs = np.array([self.vocab.element_at_index(i).frequency
+                                for i in range(V)])
+        probs = self._freqs ** self.conf.unigram_power
+        self._neg_cdf = np.cumsum(probs / probs.sum())
+        self._neg_cdf[-1] = 1.0
+        # Huffman paths as dense [V, C] tables → batch assembly is pure
+        # fancy indexing (fixed pad width keeps XLA shapes static)
+        C = max((len(self.vocab.element_at_index(i).codes)
+                 for i in range(V)), default=1) or 1
+        self._max_code = C
+        self._hs_points = np.zeros((V, C), np.int32)
+        self._hs_codes = np.zeros((V, C), np.float32)
+        self._hs_mask = np.zeros((V, C), np.float32)
+        for i in range(V):
+            vw = self.vocab.element_at_index(i)
+            L = len(vw.codes)
+            if L:
+                self._hs_points[i, :L] = vw.points
+                self._hs_codes[i, :L] = vw.codes
+                self._hs_mask[i, :L] = 1.0
+
+    # ------------------------------------------------------- pair batching
+    def _tokens_to_indices(self, tokens: Sequence[str]) -> np.ndarray:
+        """Vocab lookup + frequent-word subsampling, vectorised."""
+        conf = self.conf
+        idx_of = self.vocab.index_of
+        idxs = np.fromiter((idx_of(t) for t in tokens), np.int64, len(tokens))
+        idxs = idxs[idxs >= 0]
+        if conf.subsampling > 0 and self.vocab.total_word_count > 0 and len(idxs):
+            f = self._freqs[idxs] / self.vocab.total_word_count
+            keep_p = (np.sqrt(f / conf.subsampling) + 1) * conf.subsampling / f
+            idxs = idxs[self._rng.random(len(idxs)) < keep_p]
+        return idxs
+
+    def _sequence_to_pair_arrays(self, tokens: Sequence[str]):
+        """Skip-gram (center, context) arrays with the reduced-window
+        trick, fully vectorised (no per-position Python loop)."""
+        conf = self.conf
+        idxs = self._tokens_to_indices(tokens)
+        n = len(idxs)
+        if n < 2:
+            return None
+        b = self._rng.integers(1, conf.window + 1, n)
+        pos = np.arange(n)
+        cs, xs = [], []
+        for off in range(1, conf.window + 1):
+            ok = b >= off
+            left = np.nonzero(ok & (pos >= off))[0]
+            cs.append(idxs[left]); xs.append(idxs[left - off])
+            right = np.nonzero(ok & (pos + off < n))[0]
+            cs.append(idxs[right]); xs.append(idxs[right + off])
+        return (np.concatenate(cs).astype(np.int32),
+                np.concatenate(xs).astype(np.int32))
+
+    def _sequence_to_pairs(self, tokens: Sequence[str]):
+        """CBOW pair lists: (center, center, ctx_indices)."""
+        conf = self.conf
+        idxs = self._tokens_to_indices(tokens).tolist()
+        pairs = []
+        n = len(idxs)
+        for p, center in enumerate(idxs):
+            bb = int(self._rng.integers(1, conf.window + 1))
+            ctx = idxs[max(0, p - bb):p] + idxs[p + 1:p + bb + 1]
+            if ctx:
+                pairs.append((center, center, ctx))
+        return pairs
+
+    def _sample_negatives(self, B: int) -> np.ndarray:
+        K = max(self.conf.negative, 1)
+        u = self._rng.random((B, K))
+        return np.searchsorted(self._neg_cdf, u).astype(np.int32)
+
+    def _flush_sg_neg(self, centers, contexts, lr):
+        self.syn0, self.syn1neg, loss = _sg_neg_step(
+            self.syn0, self.syn1neg, centers, contexts,
+            self._sample_negatives(len(centers)),
+            np.float32(lr), self._trainable_from)
+        return float(loss)
+
+    def _pack_cbow(self, pairs):
+        # +1 slot so a DM label row fits even at the max reduced window
+        W2 = 2 * self.conf.window + 1
+        B = len(pairs)
+        ctx = np.zeros((B, W2), np.int32)
+        mask = np.zeros((B, W2), np.float32)
+        centers = np.zeros((B,), np.int32)
+        for i, (center, _, cs) in enumerate(pairs):
+            centers[i] = center
+            cs = cs[:W2]
+            ctx[i, :len(cs)] = cs
+            mask[i, :len(cs)] = 1.0
+        return ctx, mask, centers
+
+    def _flush_cbow_neg(self, pairs, lr):
+        ctx, mask, centers = self._pack_cbow(pairs)
+        self.syn0, self.syn1neg, loss = _cbow_neg_step(
+            self.syn0, self.syn1neg, ctx, mask, centers,
+            self._sample_negatives(len(pairs)),
+            np.float32(lr), self._trainable_from)
+        return float(loss)
+
+    def _flush_cbow_hs(self, pairs, lr):
+        ctx, mask, centers = self._pack_cbow(pairs)
+        self.syn0, self.syn1, loss = _cbow_hs_step(
+            self.syn0, self.syn1, ctx, mask, centers,
+            self._hs_points[centers], self._hs_codes[centers],
+            self._hs_mask[centers], np.float32(lr))
+        return float(loss)
+
+    def _flush_sg_hs(self, centers, contexts, lr):
+        # Huffman paths precomputed as [V, C] tables → pure array indexing
+        self.syn0, self.syn1, loss = _sg_hs_step(
+            self.syn0, self.syn1, centers,
+            self._hs_points[contexts], self._hs_codes[contexts],
+            self._hs_mask[contexts], np.float32(lr))
+        return float(loss)
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, sequences, extra_rows: int = 0, trainable_from: int = 0,
+            pair_hook=None):
+        """Train. `sequences`: iterable (re-iterable across epochs) of
+        token lists. Returns self."""
+        conf = self.conf
+        if self.vocab is None:
+            self.build_vocab(sequences)
+        if self.syn0 is None or (extra_rows and
+                                 self.syn0.shape[0] == self.vocab.num_words()):
+            self._init_tables(extra_rows)
+        self._trainable_from = trainable_from
+
+        use_hs = conf.use_hierarchic_softmax or conf.negative <= 0
+        array_path = not conf.cbow  # skip-gram variants carry index arrays
+        sg_flush = self._flush_sg_hs if use_hs else self._flush_sg_neg
+        cbow_flush = self._flush_cbow_hs if use_hs else self._flush_cbow_neg
+
+        total_words = max(self.vocab.total_word_count * conf.epochs, 1)
+        words_seen = 0
+        self.last_loss = 0.0
+        B = conf.batch_size
+        for epoch in range(conf.epochs):
+            abuf_c, abuf_x, abuf_n = [], [], 0   # array buffers (skip-gram)
+            lbuf = []                            # list buffer (CBOW)
+            for si, tokens in enumerate(sequences):
+                frac = words_seen / total_words
+                lr = max(conf.learning_rate * (1.0 - frac), conf.min_learning_rate)
+                words_seen += len(tokens)
+                if pair_hook is not None:
+                    new = pair_hook(self, si, tokens)
+                    if array_path and isinstance(new, list):
+                        if not new:
+                            continue
+                        new = (np.fromiter((p[0] for p in new), np.int32, len(new)),
+                               np.fromiter((p[1] for p in new), np.int32, len(new)))
+                elif array_path:
+                    new = self._sequence_to_pair_arrays(tokens)
+                else:
+                    new = self._sequence_to_pairs(tokens)
+                if not array_path:
+                    lbuf.extend(new)
+                    while len(lbuf) >= B:
+                        batch, lbuf = lbuf[:B], lbuf[B:]
+                        for _ in range(conf.iterations):
+                            self.last_loss = cbow_flush(batch, lr)
+                    continue
+                if new is None:
+                    continue
+                abuf_c.append(new[0]); abuf_x.append(new[1]); abuf_n += len(new[0])
+                while abuf_n >= B:
+                    cs = np.concatenate(abuf_c); xs = np.concatenate(abuf_x)
+                    batch_c, rest_c = cs[:B], cs[B:]
+                    batch_x, rest_x = xs[:B], xs[B:]
+                    abuf_c, abuf_x, abuf_n = [rest_c], [rest_x], len(rest_c)
+                    for _ in range(conf.iterations):
+                        self.last_loss = sg_flush(batch_c, batch_x, lr)
+            tail_lr = max(conf.learning_rate * (1 - words_seen / total_words),
+                          conf.min_learning_rate)
+            if array_path and abuf_n:
+                cs = np.concatenate(abuf_c); xs = np.concatenate(abuf_x)
+                for _ in range(conf.iterations):
+                    self.last_loss = sg_flush(cs, xs, tail_lr)
+            elif lbuf:
+                for _ in range(conf.iterations):
+                    self.last_loss = cbow_flush(lbuf, tail_lr)
+        self.syn0 = np.asarray(self.syn0)
+        self.syn1 = np.asarray(self.syn1)
+        self.syn1neg = np.asarray(self.syn1neg)
+        return self
+
+    # ------------------------------------------------------------- queries
+    def get_word_vector(self, word: str):
+        i = self.vocab.index_of(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    def _unit_table(self):
+        t = np.asarray(self.syn0[:self.vocab.num_words()])
+        norms = np.linalg.norm(t, axis=1, keepdims=True)
+        return t / np.clip(norms, 1e-9, None)
+
+    def similarity(self, w1: str, w2: str) -> float:
+        v1, v2 = self.get_word_vector(w1), self.get_word_vector(w2)
+        if v1 is None or v2 is None:
+            return float("nan")
+        denom = np.linalg.norm(v1) * np.linalg.norm(v2)
+        return float(np.dot(v1, v2) / denom) if denom > 0 else 0.0
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            vec = self.get_word_vector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            vec, exclude = np.asarray(word_or_vec), set()
+        if vec is None:
+            return []
+        unit = self._unit_table()
+        q = vec / max(np.linalg.norm(vec), 1e-9)
+        sims = unit @ q
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= top_n:
+                break
+        return out

@@ -1,0 +1,77 @@
+"""Word2Vec.
+
+Reference: `models/word2vec/Word2Vec.java:82` (Builder) — a thin,
+configured front-end over SequenceVectors with a tokenizer + sentence
+iterator pipeline. Same here: `fit()` tokenises the corpus once into
+token sequences and drives the TPU-batched SequenceVectors engine.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Union
+
+from deeplearning4j_tpu.nlp.sentenceiterator import (
+    CollectionSentenceIterator,
+    SentenceIterator,
+)
+from deeplearning4j_tpu.nlp.sequencevectors import (
+    SequenceVectors,
+    SequenceVectorsConfig,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+
+
+class Word2Vec(SequenceVectors):
+    """skip-gram / CBOW word embeddings (reference Word2Vec builder
+    options map 1:1 onto the constructor kwargs: layerSize→
+    vector_length, windowSize→window, minWordFrequency, negativeSample→
+    negative, useHierarchicSoftmax, sampling→subsampling, workers→
+    (absorbed by device batching), batchSize→batch_size)."""
+
+    def __init__(self,
+                 sentence_iterator: Union[SentenceIterator, Iterable[str], None] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 layer_size: int = 100,
+                 window_size: int = 5,
+                 min_word_frequency: int = 1,
+                 negative_sample: int = 5,
+                 use_hierarchic_softmax: bool = False,
+                 sampling: float = 0.0,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4,
+                 epochs: int = 1,
+                 iterations: int = 1,
+                 batch_size: int = 2048,
+                 seed: int = 42,
+                 cbow: bool = False):
+        super().__init__(SequenceVectorsConfig(
+            vector_length=layer_size, window=window_size,
+            min_word_frequency=min_word_frequency, negative=negative_sample,
+            use_hierarchic_softmax=use_hierarchic_softmax,
+            subsampling=sampling, learning_rate=learning_rate,
+            min_learning_rate=min_learning_rate, epochs=epochs,
+            iterations=iterations, batch_size=batch_size, seed=seed, cbow=cbow))
+        if sentence_iterator is not None and not isinstance(sentence_iterator,
+                                                            SentenceIterator):
+            sentence_iterator = CollectionSentenceIterator(sentence_iterator)
+        self.sentence_iterator = sentence_iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self._sequences: Optional[List[List[str]]] = None
+
+    def _tokenize_corpus(self) -> List[List[str]]:
+        if self._sequences is None:
+            if self.sentence_iterator is None:
+                raise ValueError("Word2Vec needs a sentence iterator / corpus")
+            self._sequences = [
+                self.tokenizer_factory.create(s).get_tokens()
+                for s in self.sentence_iterator
+            ]
+        return self._sequences
+
+    def fit(self, sequences=None, **kw):
+        if sequences is None:
+            sequences = self._tokenize_corpus()
+        return super().fit(sequences, **kw)

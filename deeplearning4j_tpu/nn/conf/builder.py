@@ -130,6 +130,8 @@ def _family(input_type: InputType) -> str:
 
 def _expected_family(layer: Layer) -> str:
     # which input family does this layer natively consume?
+    if layer.layer_name == "frozen" and getattr(layer, "layer", None) is not None:
+        return _expected_family(layer.layer)  # delegate through the wrapper
     name = layer.layer_name
     if name in ("convolution", "subsampling", "upsampling2d", "zeropadding",
                 "space_to_depth", "lrn", "yolo2_output"):

@@ -22,6 +22,14 @@ _CONSTRAINT_REGISTRY = {}
 _EPS = 1e-8
 
 
+def is_bias_param(name: str) -> bool:
+    """Bias-like param names across the whole layer catalog: "b",
+    suffixed variants ("vb", "e0b", "pXZb", "bF"/"bB" bidirectional),
+    and BN's beta. Weight-like names end in "W"/"RW" or are
+    gamma/cL-style matrices."""
+    return name == "beta" or name.endswith("b") or name.startswith("b")
+
+
 def register_constraint(cls):
     _CONSTRAINT_REGISTRY[cls.kind] = cls
     return cls
@@ -37,7 +45,7 @@ class LayerConstraint:
     def apply_params(self, params: dict) -> dict:
         out = {}
         for name, w in params.items():
-            is_bias = name == "b" or name.endswith("_b") or name in ("beta", "gamma")
+            is_bias = is_bias_param(name) or name == "gamma"
             if (is_bias and not self.apply_to_bias) or w.ndim < 1:
                 out[name] = w
             else:

@@ -23,6 +23,7 @@ from deeplearning4j_tpu.common.distributions import (
     NormalDistribution,
     distribution_from_dict,
 )
+from deeplearning4j_tpu.nn.conf.constraints import is_bias_param
 
 _WEIGHT_NOISE_REGISTRY = {}
 
@@ -42,8 +43,7 @@ class IWeightNoise:
     def apply_params(self, rng, params: dict) -> dict:
         out = {}
         for i, (name, w) in enumerate(sorted(params.items())):
-            is_bias = name == "b" or name.endswith("_b")
-            if is_bias and not self.apply_to_bias:
+            if is_bias_param(name) and not self.apply_to_bias:
                 out[name] = w
             else:
                 out[name] = self.apply(jax.random.fold_in(rng, i), name, w)

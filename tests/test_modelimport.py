@@ -1,0 +1,231 @@
+"""Keras import tests — golden-file style (reference
+`Keras2ModelConfigurationTest.java` + per-layer tests `layers/**`):
+synthetic Keras 1 & 2 .h5 files are fabricated with the C++ HDF5 writer
+and imported, then outputs/weights are asserted numerically.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import Hdf5Archive, KerasModelImport
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def write_keras_h5(path, model_config: dict, layer_weights: dict):
+    """layer_weights: {layer_name: {weight_name: array}} — writes the
+    Keras 2 on-disk layout (model_weights/<layer>/<layer>/<w>:0)."""
+    with Hdf5Archive(path, "w") as h5:
+        h5.write_attr_string("model_config", json.dumps(model_config))
+        h5.write_attr_string("keras_version", "2.1.6")
+        h5.write_attr_string("backend", "tensorflow")
+        h5.create_group("/model_weights")
+        h5.write_attr_strings("layer_names", list(layer_weights),
+                              "/model_weights")
+        for lname, weights in layer_weights.items():
+            h5.create_group(f"/model_weights/{lname}")
+            wnames = [f"{lname}/{wn}:0" for wn in weights]
+            h5.write_attr_strings("weight_names", wnames,
+                                  f"/model_weights/{lname}")
+            h5.create_group(f"/model_weights/{lname}/{lname}")
+            for wn, arr in weights.items():
+                h5.write_dataset(f"/model_weights/{lname}/{lname}/{wn}:0",
+                                 np.asarray(arr, np.float32))
+
+
+def dense_cfg(name, units, activation, input_shape=None, keras1=False):
+    cfg = {"name": name, "activation": activation, "use_bias": True}
+    if keras1:
+        cfg["output_dim"] = units
+    else:
+        cfg["units"] = units
+    if input_shape is not None:
+        cfg["batch_input_shape"] = [None] + list(input_shape)
+    return {"class_name": "Dense", "config": cfg}
+
+
+class TestHdf5Archive:
+    def test_roundtrip(self, tmp_path):
+        p = tmp_path / "t.h5"
+        with Hdf5Archive(p, "w") as h5:
+            h5.write_attr_string("model_config", '{"x": 1}')
+            h5.create_group("/g")
+            h5.write_attr_strings("names", ["a", "b"], "/g")
+            h5.write_dataset("/g/data", np.arange(6, np.float32).reshape(2, 3)
+                             if False else np.arange(6, dtype=np.float32).reshape(2, 3))
+        with Hdf5Archive(p) as h5:
+            assert h5.read_attr_string("model_config") == '{"x": 1}'
+            assert h5.read_attr_strings("names", "/g") == ["a", "b"]
+            np.testing.assert_array_equal(
+                h5.read_dataset("/g/data"),
+                np.arange(6, dtype=np.float32).reshape(2, 3))
+            assert h5.exists("/g/data") and not h5.exists("/nope")
+
+
+class TestSequentialImport:
+    def test_mlp_forward_matches_manual(self, tmp_path):
+        rng = np.random.default_rng(0)
+        W1 = rng.standard_normal((8, 16)).astype(np.float32)
+        b1 = rng.standard_normal(16).astype(np.float32)
+        W2 = rng.standard_normal((16, 4)).astype(np.float32)
+        b2 = rng.standard_normal(4).astype(np.float32)
+        config = {"class_name": "Sequential", "config": [
+            dense_cfg("dense_1", 16, "relu", input_shape=[8]),
+            dense_cfg("dense_2", 4, "softmax"),
+        ]}
+        p = tmp_path / "mlp.h5"
+        write_keras_h5(p, config, {
+            "dense_1": {"kernel": W1, "bias": b1},
+            "dense_2": {"kernel": W2, "bias": b2},
+        })
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        assert isinstance(net, MultiLayerNetwork)
+        x = rng.standard_normal((5, 8)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        h = np.maximum(x @ W1 + b1, 0.0)
+        logits = h @ W2 + b2
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        want = e / e.sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_keras1_dialect(self, tmp_path):
+        rng = np.random.default_rng(1)
+        W = rng.standard_normal((6, 3)).astype(np.float32)
+        b = np.zeros(3, np.float32)
+        config = {"class_name": "Sequential", "config": [
+            dense_cfg("d", 3, "sigmoid", input_shape=[6], keras1=True),
+        ]}
+        p = tmp_path / "k1.h5"
+        write_keras_h5(p, config, {"d": {"kernel": W, "bias": b}})
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        np.testing.assert_array_equal(np.asarray(net.params["0"]["W"]), W)
+
+    def test_cnn_with_flatten(self, tmp_path):
+        rng = np.random.default_rng(2)
+        K = rng.standard_normal((3, 3, 1, 4)).astype(np.float32) * 0.1
+        bK = np.zeros(4, np.float32)
+        W = rng.standard_normal((4 * 4 * 4, 2)).astype(np.float32) * 0.1
+        b = np.zeros(2, np.float32)
+        config = {"class_name": "Sequential", "config": [
+            {"class_name": "Conv2D", "config": {
+                "name": "conv", "filters": 4, "kernel_size": [3, 3],
+                "strides": [1, 1], "padding": "same", "activation": "relu",
+                "use_bias": True, "batch_input_shape": [None, 8, 8, 1]}},
+            {"class_name": "MaxPooling2D", "config": {
+                "name": "pool", "pool_size": [2, 2], "strides": [2, 2],
+                "padding": "valid"}},
+            {"class_name": "Flatten", "config": {"name": "flat"}},
+            dense_cfg("out", 2, "softmax"),
+        ]}
+        p = tmp_path / "cnn.h5"
+        write_keras_h5(p, config, {
+            "conv": {"kernel": K, "bias": bK},
+            "out": {"kernel": W, "bias": b},
+        })
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        np.testing.assert_array_equal(np.asarray(net.params["0"]["W"]), K)
+        x = rng.standard_normal((2, 8, 8, 1)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 2)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_lstm_gate_reorder(self, tmp_path):
+        H, F = 3, 2
+        # blocks tagged by constant value: i=1, f=2, c=3, o=4
+        K = np.concatenate([np.full((F, H), v, np.float32) for v in (1, 2, 3, 4)], 1)
+        R = np.concatenate([np.full((H, H), v, np.float32) for v in (1, 2, 3, 4)], 1)
+        b = np.concatenate([np.full((H,), v, np.float32) for v in (1, 2, 3, 4)])
+        config = {"class_name": "Sequential", "config": [
+            {"class_name": "LSTM", "config": {
+                "name": "lstm", "units": H, "activation": "tanh",
+                "recurrent_activation": "sigmoid", "return_sequences": False,
+                "batch_input_shape": [None, 5, F]}},
+            dense_cfg("out", 2, "softmax"),
+        ]}
+        p = tmp_path / "lstm.h5"
+        rng = np.random.default_rng(3)
+        write_keras_h5(p, config, {
+            "lstm": {"kernel": K, "recurrent_kernel": R, "bias": b},
+            "out": {"kernel": rng.standard_normal((H, 2)).astype(np.float32),
+                    "bias": np.zeros(2, np.float32)},
+        })
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        W = np.asarray(net.params["0"]["W"])
+        # our IFOG order: blocks must read i=1, f=2, o=4, g(c)=3
+        assert W[0, 0] == 1 and W[0, H] == 2 and W[0, 2 * H] == 4 and W[0, 3 * H] == 3
+        # LastTimeStep inserted for return_sequences=False
+        x = rng.standard_normal((2, 5, F)).astype(np.float32)
+        assert np.asarray(net.output(x)).shape == (2, 2)
+
+    def test_batchnorm_state(self, tmp_path):
+        F = 4
+        gamma = np.full(F, 1.5, np.float32)
+        beta = np.full(F, -0.5, np.float32)
+        mean = np.full(F, 2.0, np.float32)
+        var = np.full(F, 4.0, np.float32)
+        config = {"class_name": "Sequential", "config": [
+            {"class_name": "BatchNormalization", "config": {
+                "name": "bn", "epsilon": 1e-3, "momentum": 0.99,
+                "batch_input_shape": [None, F]}},
+        ]}
+        p = tmp_path / "bn.h5"
+        write_keras_h5(p, config, {"bn": {
+            "gamma": gamma, "beta": beta, "moving_mean": mean,
+            "moving_variance": var}})
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        x = np.random.default_rng(4).standard_normal((6, F)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        want = gamma * (x - mean) / np.sqrt(var + 1e-3) + beta
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+class TestFunctionalImport:
+    def test_two_branch_model(self, tmp_path):
+        rng = np.random.default_rng(5)
+        W1 = rng.standard_normal((6, 8)).astype(np.float32)
+        W2 = rng.standard_normal((6, 8)).astype(np.float32)
+        W3 = rng.standard_normal((16, 3)).astype(np.float32)
+        config = {"class_name": "Model", "config": {
+            "name": "branchy",
+            "layers": [
+                {"class_name": "InputLayer", "name": "input_1",
+                 "config": {"name": "input_1",
+                            "batch_input_shape": [None, 6]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "a",
+                 "config": {"name": "a", "units": 8, "activation": "relu",
+                            "use_bias": True},
+                 "inbound_nodes": [[["input_1", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "b",
+                 "config": {"name": "b", "units": 8, "activation": "relu",
+                            "use_bias": True},
+                 "inbound_nodes": [[["input_1", 0, 0, {}]]]},
+                {"class_name": "Concatenate", "name": "merge",
+                 "config": {"name": "merge"},
+                 "inbound_nodes": [[["a", 0, 0, {}], ["b", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "out",
+                 "config": {"name": "out", "units": 3,
+                            "activation": "softmax", "use_bias": True},
+                 "inbound_nodes": [[["merge", 0, 0, {}]]]},
+            ],
+            "input_layers": [["input_1", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        }}
+        p = tmp_path / "func.h5"
+        write_keras_h5(p, config, {
+            "a": {"kernel": W1, "bias": np.zeros(8, np.float32)},
+            "b": {"kernel": W2, "bias": np.zeros(8, np.float32)},
+            "out": {"kernel": W3, "bias": np.zeros(3, np.float32)},
+        })
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        assert isinstance(net, ComputationGraph)
+        x = rng.standard_normal((4, 6)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        ha = np.maximum(x @ W1, 0)
+        hb = np.maximum(x @ W2, 0)
+        logits = np.concatenate([ha, hb], 1) @ W3
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(1, keepdims=True),
+                                   rtol=1e-4, atol=1e-5)

@@ -1,0 +1,239 @@
+"""NLP stack tests.
+
+Mirrors the reference suite (SURVEY.md §4 NLP row): Word2Vec
+nearest-neighbor sanity (`Word2VecTests`-style: topically related words
+end up close), serialization round-trips, tokenizer/iterator unit
+tests, doc2vec + GloVe + TF-IDF behavior.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    CountVectorizer,
+    DefaultTokenizerFactory,
+    Glove,
+    LabelledDocument,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    SequenceVectors,
+    TfidfVectorizer,
+    VocabConstructor,
+    Word2Vec,
+    WordVectorSerializer,
+)
+from deeplearning4j_tpu.nlp.vocab import build_huffman
+
+
+def synthetic_corpus(n=400, seed=0):
+    """Two-topic corpus: weather words co-occur, finance words co-occur."""
+    rng = np.random.default_rng(seed)
+    weather = ["rain", "snow", "storm", "cloud", "wind", "sun"]
+    finance = ["bank", "money", "stock", "market", "trade", "price"]
+    shared = ["the", "a", "and", "of", "in"]
+    sentences = []
+    for _ in range(n):
+        topic = weather if rng.random() < 0.5 else finance
+        words = [topic[rng.integers(len(topic))] for _ in range(8)]
+        # sprinkle stopwords
+        for i in sorted(rng.integers(0, len(words), 2))[::-1]:
+            words.insert(i, shared[rng.integers(len(shared))])
+        sentences.append(" ".join(words))
+    return sentences
+
+
+class TestTokenization:
+    def test_default_tokenizer_and_preprocessor(self):
+        fac = DefaultTokenizerFactory(CommonPreprocessor())
+        toks = fac.create("Hello, World! 42 times").get_tokens()
+        assert toks == ["hello", "world", "time"] or toks == ["hello", "world", "times"]
+
+    def test_ngram_tokenizer(self):
+        fac = NGramTokenizerFactory(min_n=1, max_n=2)
+        toks = fac.create("a b c").get_tokens()
+        assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+class TestSentenceIterators:
+    def test_collection_iterator_resets(self):
+        it = CollectionSentenceIterator(["one", "two"])
+        assert list(it) == ["one", "two"]
+        assert list(it) == ["one", "two"]
+
+    def test_line_iterator(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("first line\nsecond line\n")
+        it = BasicLineIterator(p)
+        assert list(it) == ["first line", "second line"]
+
+
+class TestVocab:
+    def test_construction_and_frequency_order(self):
+        cache = VocabConstructor().build([["b", "a", "a"], ["a", "c"]])
+        assert cache.num_words() == 3
+        assert cache.word_at_index(0) == "a"  # most frequent first
+        assert cache.word_frequency("a") == 3
+
+    def test_min_frequency_pruning(self):
+        cache = VocabConstructor(min_word_frequency=2).build(
+            [["a", "a", "b"], ["c", "a"]])
+        assert cache.contains_word("a") and not cache.contains_word("b")
+
+    def test_huffman_codes_prefix_free(self):
+        cache = VocabConstructor().build(
+            [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]])
+        codes = {w: "".join(map(str, cache.word_for(w).codes))
+                 for w in ["a", "b", "c", "d"]}
+        # prefix-free and frequent words get shorter codes
+        vals = list(codes.values())
+        for i, c1 in enumerate(vals):
+            for j, c2 in enumerate(vals):
+                if i != j:
+                    assert not c2.startswith(c1)
+        assert len(codes["a"]) <= len(codes["d"])
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("mode", ["sg_neg", "cbow", "hs", "cbow_hs"])
+    def test_topic_clustering(self, mode):
+        w2v = Word2Vec(
+            sentence_iterator=synthetic_corpus(),
+            layer_size=24, window_size=4, min_word_frequency=2,
+            negative_sample=0 if mode in ("hs", "cbow_hs") else 5,
+            use_hierarchic_softmax=mode in ("hs", "cbow_hs"),
+            cbow=mode in ("cbow", "cbow_hs"),
+            learning_rate=0.05, epochs=3, batch_size=512, seed=7)
+        w2v.fit()
+        # in-topic similarity must beat cross-topic similarity
+        in_topic = w2v.similarity("rain", "snow")
+        cross = w2v.similarity("rain", "money")
+        assert in_topic > cross, f"{mode}: {in_topic} <= {cross}"
+        near = w2v.words_nearest("stock", top_n=4)
+        finance = {"bank", "money", "market", "trade", "price"}
+        assert len(finance.intersection(near)) >= 2, near
+
+    def test_word_vector_api(self):
+        w2v = Word2Vec(sentence_iterator=["a b c", "a c"], layer_size=8,
+                       epochs=1, min_word_frequency=1)
+        w2v.fit()
+        assert w2v.has_word("a") and not w2v.has_word("zzz")
+        assert w2v.get_word_vector("a").shape == (8,)
+        assert w2v.get_word_vector("zzz") is None
+
+
+class TestSerialization:
+    def _small_model(self):
+        w2v = Word2Vec(sentence_iterator=["alpha beta gamma", "alpha gamma"],
+                       layer_size=6, epochs=1)
+        return w2v.fit()
+
+    def test_binary_roundtrip(self, tmp_path):
+        w2v = self._small_model()
+        path = tmp_path / "vecs.bin"
+        WordVectorSerializer.write_binary(w2v, path)
+        loaded = WordVectorSerializer.read_binary(path)
+        for w in ["alpha", "beta", "gamma"]:
+            np.testing.assert_allclose(loaded.get_word_vector(w),
+                                       w2v.get_word_vector(w), rtol=1e-6)
+
+    def test_text_roundtrip(self, tmp_path):
+        w2v = self._small_model()
+        path = tmp_path / "vecs.txt"
+        WordVectorSerializer.write_text(w2v, path)
+        loaded = WordVectorSerializer.read_text(path)
+        for w in ["alpha", "beta", "gamma"]:
+            np.testing.assert_allclose(loaded.get_word_vector(w),
+                                       w2v.get_word_vector(w), atol=1e-5)
+
+
+class TestParagraphVectors:
+    def _docs(self):
+        corpus = synthetic_corpus(200)
+        return [LabelledDocument(s, [f"DOC_{i}"]) for i, s in enumerate(corpus)], corpus
+
+    @pytest.mark.parametrize("dm", [False, True])
+    def test_doc_vectors_cluster_by_topic(self, dm):
+        docs, corpus = self._docs()
+        pv = ParagraphVectors(documents=docs, layer_size=16, epochs=3,
+                              min_word_frequency=2, dm=dm, seed=3)
+        pv.fit()
+        weather_docs = [i for i, s in enumerate(corpus) if "rain" in s and "bank" not in s]
+        finance_docs = [i for i, s in enumerate(corpus) if "bank" in s and "rain" not in s]
+        if len(weather_docs) >= 2 and len(finance_docs) >= 2:
+            same = pv.similarity_doc(f"DOC_{weather_docs[0]}", f"DOC_{weather_docs[1]}")
+            diff = pv.similarity_doc(f"DOC_{weather_docs[0]}", f"DOC_{finance_docs[0]}")
+            assert same > diff
+
+    def test_infer_vector(self):
+        docs, _ = self._docs()
+        pv = ParagraphVectors(documents=docs, layer_size=16, epochs=2,
+                              min_word_frequency=2, seed=3)
+        pv.fit()
+        rows_before = pv.syn0.shape[0]
+        vec = pv.infer_vector("rain snow storm wind")
+        assert vec.shape == (16,)
+        assert pv.syn0.shape[0] == rows_before  # scratch row popped
+        # inferred weather doc is closer to weather words than finance words
+        def cos(a, b):
+            return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+        weather_sim = cos(vec, pv.get_word_vector("rain"))
+        finance_sim = cos(vec, pv.get_word_vector("bank"))
+        assert weather_sim > finance_sim
+        # inference must not mutate the trained model (frozen tables)
+        syn1neg_before = pv.syn1neg.copy()
+        pv.infer_vector("rain snow storm wind")
+        np.testing.assert_array_equal(syn1neg_before, np.asarray(pv.syn1neg))
+
+    def test_duplicate_labels_share_one_row(self):
+        docs = [LabelledDocument("rain snow storm", ["weather"]),
+                LabelledDocument("wind cloud sun rain", ["weather"]),
+                LabelledDocument("bank money stock", ["finance"])]
+        pv = ParagraphVectors(documents=docs, layer_size=8, epochs=2,
+                              min_word_frequency=1, seed=1)
+        pv.fit()
+        assert pv.labels == ["weather", "finance"]
+        assert pv.syn0.shape[0] == pv.vocab.num_words() + 2
+        assert np.isnan(pv.similarity_doc("weather", "nope"))
+
+
+class TestGlove:
+    def test_topic_clustering(self):
+        g = Glove(layer_size=16, window=4, min_word_frequency=2,
+                  epochs=20, learning_rate=0.05, seed=5)
+        seqs = [s.split() for s in synthetic_corpus(300)]
+        g.fit(seqs)
+        assert g.similarity("rain", "snow") > g.similarity("rain", "money")
+
+
+class TestBagOfWords:
+    def test_count_vectorizer(self):
+        cv = CountVectorizer()
+        X = cv.fit_transform(["a b a", "b c"])
+        assert X.shape == (2, 3)
+        assert X[0, cv.vocab.index_of("a")] == 2
+
+    def test_tfidf_downweights_common_terms(self):
+        tv = TfidfVectorizer()
+        X = tv.fit_transform(["common rare1", "common rare2", "common rare3"])
+        ci = tv.vocab.index_of("common")
+        ri = tv.vocab.index_of("rare1")
+        assert X[0, ci] < X[0, ri]  # idf(common)=log(1)=0
+
+
+class TestCnnSentenceIterator:
+    def test_batch_shapes_and_mask(self):
+        from deeplearning4j_tpu.nlp import CnnSentenceDataSetIterator
+        w2v = Word2Vec(sentence_iterator=["deep learning rocks",
+                                          "learning is fun"],
+                       layer_size=4, epochs=1)
+        w2v.fit()
+        it = CnnSentenceDataSetIterator(
+            ["deep learning", "fun"], [0, 1], w2v, num_classes=2, batch_size=2)
+        ds = next(iter(it))
+        assert ds.features.shape[0] == 2
+        assert ds.features.shape[3] == 1
+        assert ds.labels.shape == (2, 2)
+        assert ds.features_mask[1].sum() == 1  # "fun" → one token
