@@ -1,0 +1,10 @@
+"""Nearest-neighbors + clustering library (reference:
+deeplearning4j-nearestneighbors-parent, SURVEY §2.7): VPTree, KDTree,
+QuadTree, SpTree (Barnes-Hut), k-means, and the REST server.
+"""
+
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.quadtree import QuadTree
+from deeplearning4j_tpu.clustering.sptree import SpTree
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering, ClusterSet

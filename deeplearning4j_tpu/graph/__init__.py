@@ -1,0 +1,12 @@
+"""Graph-embedding library (reference: deeplearning4j-graph, SURVEY
+§2.6): IGraph/Graph, loaders, random-walk iterators, DeepWalk,
+GraphVectors."""
+
+from deeplearning4j_tpu.graph.graph import Graph, Edge, Vertex
+from deeplearning4j_tpu.graph.loader import GraphLoader
+from deeplearning4j_tpu.graph.walkers import (
+    NoEdgeHandling,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors

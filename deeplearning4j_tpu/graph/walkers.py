@@ -1,0 +1,82 @@
+"""Random-walk iterators over graphs.
+
+Reference: `graph/iterator/RandomWalkIterator.java`,
+`WeightedRandomWalkIterator.java`, `graph/api/NoEdgeHandling.java`
+(SELF_LOOP_ON_DISCONNECTED vs EXCEPTION_ON_DISCONNECTED).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+
+
+class NoEdgeHandling(str, Enum):
+    SELF_LOOP_ON_DISCONNECTED = "self_loop"
+    EXCEPTION_ON_DISCONNECTED = "exception"
+
+
+class RandomWalkIterator:
+    """Uniform random walks, one starting at each vertex per epoch."""
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 0,
+                 no_edge_handling: NoEdgeHandling =
+                 NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.no_edge_handling = NoEdgeHandling(no_edge_handling)
+        self.seed = seed
+        self.reset()
+
+    def reset(self):
+        self._rng = np.random.default_rng(self.seed)
+        self._order = self._rng.permutation(self.graph.num_vertices())
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._order)
+
+    def _step(self, current: int) -> int:
+        neighbors = self.graph.get_connected_vertices(current)
+        if not neighbors:
+            if self.no_edge_handling == NoEdgeHandling.EXCEPTION_ON_DISCONNECTED:
+                raise ValueError(f"Vertex {current} has no edges")
+            return current  # self loop
+        return neighbors[int(self._rng.integers(len(neighbors)))]
+
+    def next(self) -> List[int]:
+        start = int(self._order[self._pos])
+        self._pos += 1
+        walk = [start]
+        current = start
+        for _ in range(self.walk_length - 1):
+            current = self._step(current)
+            walk.append(current)
+        return walk
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Transition probability ∝ edge weight (reference
+    `WeightedRandomWalkIterator.java`)."""
+
+    def _step(self, current: int) -> int:
+        edges = self.graph.get_edges_out(current)
+        if not edges:
+            if self.no_edge_handling == NoEdgeHandling.EXCEPTION_ON_DISCONNECTED:
+                raise ValueError(f"Vertex {current} has no edges")
+            return current
+        weights = np.array([e.weight for e in edges], np.float64)
+        probs = weights / weights.sum()
+        e = edges[int(self._rng.choice(len(edges), p=probs))]
+        if e.directed:
+            return e.dst
+        return e.dst if e.src == current else e.src

@@ -29,10 +29,21 @@ def _load_lib():
     if _lib is not None:
         return _lib
     if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
-        subprocess.run(
-            ["g++", "-O2", "-fPIC", "-shared", str(_SRC), "-o", str(_SO),
-             "-l:libhdf5_serial.so.103", "-L/lib/x86_64-linux-gnu"],
-            check=True, capture_output=True)
+        candidates = ["-l:libhdf5_serial.so.103", "-l:libhdf5_serial.so.100",
+                      "-lhdf5_serial", "-lhdf5"]
+        errors = []
+        for link in candidates:
+            proc = subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", str(_SRC), "-o", str(_SO),
+                 link, "-L/lib/x86_64-linux-gnu", "-L/usr/lib/x86_64-linux-gnu"],
+                capture_output=True, text=True)
+            if proc.returncode == 0:
+                break
+            errors.append(f"[{link}] {proc.stderr.strip()[:500]}")
+        else:
+            raise RuntimeError(
+                "Could not build the HDF5 shim against any known libhdf5 "
+                "soname:\n" + "\n".join(errors))
     lib = ctypes.CDLL(str(_SO))
     lib.dl4j_h5_open.restype = ctypes.c_int64
     lib.dl4j_h5_open.argtypes = [ctypes.c_char_p]
@@ -89,10 +100,16 @@ class Hdf5Archive:
         return bool(self._lib.dl4j_h5_exists(self._f, path.encode()))
 
     def read_attr_string(self, attr: str, obj_path: str = "/") -> Optional[str]:
-        buf = ctypes.create_string_buffer(1 << 22)
-        n = self._lib.dl4j_h5_read_string_attr(
-            self._f, obj_path.encode(), attr.encode(), buf, len(buf))
-        return None if n < 0 else buf.value.decode("utf-8")
+        size = 1 << 20
+        while size <= (1 << 28):
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.dl4j_h5_read_string_attr(
+                self._f, obj_path.encode(), attr.encode(), buf, len(buf))
+            if n == -2:  # buffer too small — grow and retry
+                size *= 8
+                continue
+            return None if n < 0 else buf.value.decode("utf-8")
+        raise IOError(f"Attribute {obj_path}@{attr} exceeds 256 MiB")
 
     def read_attr_strings(self, attr: str, obj_path: str = "/") -> List[str]:
         s = self.read_attr_string(attr, obj_path)

@@ -378,6 +378,10 @@ class KerasModelImport:
         out = {}
         for wn in names:
             short = wn.split("/")[-1].split(":")[0]
+            # Keras 1 prefixes the layer name ("dense_1_W" → "W",
+            # "lstm_1_W_i" → "W_i")
+            if short.startswith(lname + "_"):
+                short = short[len(lname) + 1:]
             out[short] = h5.read_dataset(f"{gpath}/{wn}".replace("//", "/"))
         return out
 
@@ -414,10 +418,10 @@ class KerasModelImport:
             if b is not None:
                 params["b"] = ifco_to_ifog(b, 0)
         elif cls == "SimpleRnn":
-            params["W"] = kw.get("kernel")
-            params["RW"] = kw.get("recurrent_kernel")
-            if "bias" in kw:
-                params["b"] = kw.get("bias")
+            params["W"] = kw.get("kernel", kw.get("W"))
+            params["RW"] = kw.get("recurrent_kernel", kw.get("U"))
+            if "bias" in kw or "b" in kw:
+                params["b"] = kw.get("bias", kw.get("b"))
         elif cls == "BatchNormalization":
             if "gamma" in kw:
                 params["gamma"] = kw["gamma"]
@@ -445,9 +449,12 @@ class KerasModelImport:
     @staticmethod
     def _apply_weights(net, params_key, layer, kw, kname):
         params, state = KerasModelImport._convert(layer, kw)
+        missing = [pn for pn, arr in params.items() if arr is None]
+        if missing:
+            raise ValueError(
+                f"layer {kname}: could not match Keras weights for "
+                f"{missing}; stored weight names were {sorted(kw)}")
         for pn, arr in params.items():
-            if arr is None:
-                continue
             arr = KerasModelImport._coerce(np.asarray(arr),
                                            net.params[params_key][pn].shape,
                                            kname, pn)

@@ -27,46 +27,49 @@ class CountVectorizer:
     def _tokens(self, text: str) -> List[str]:
         return self.tokenizer_factory.create(text).get_tokens()
 
-    def fit(self, corpus: Iterable[str]):
-        seqs = [self._tokens(t) for t in corpus]
+    def _fit_tokens(self, seqs: List[List[str]]):
         self.vocab = VocabConstructor(
             min_word_frequency=self.min_word_frequency,
             build_huffman_tree=False).build(seqs)
+
+    def fit(self, corpus: Iterable[str]):
+        self._fit_tokens([self._tokens(t) for t in corpus])
         return self
 
-    def transform(self, text: str) -> np.ndarray:
+    def _vector_from_tokens(self, tokens: List[str]) -> np.ndarray:
         vec = np.zeros((self.vocab.num_words(),), np.float32)
-        for tok in self._tokens(text):
+        for tok in tokens:
             i = self.vocab.index_of(tok)
             if i >= 0:
                 vec[i] += 1.0
         return vec
 
+    def transform(self, text: str) -> np.ndarray:
+        return self._vector_from_tokens(self._tokens(text))
+
     def fit_transform(self, corpus: Iterable[str]) -> np.ndarray:
-        corpus = list(corpus)
-        self.fit(corpus)
-        return np.stack([self.transform(t) for t in corpus])
+        seqs = [self._tokens(t) for t in corpus]  # tokenize ONCE
+        self._fit_tokens(seqs)
+        return np.stack([self._vector_from_tokens(s) for s in seqs])
 
 
 class TfidfVectorizer(CountVectorizer):
     """TF-IDF weighting (reference TfidfVectorizer: idf = log(N/df))."""
 
-    def fit(self, corpus: Iterable[str]):
-        corpus = list(corpus)
-        super().fit(corpus)
+    def _fit_tokens(self, seqs: List[List[str]]):
+        super()._fit_tokens(seqs)
         V = self.vocab.num_words()
         df = np.zeros((V,), np.float64)
-        for text in corpus:
-            seen = {self.vocab.index_of(t) for t in self._tokens(text)}
+        for tokens in seqs:
+            seen = {self.vocab.index_of(t) for t in tokens}
             for i in seen:
                 if i >= 0:
                     df[i] += 1
-        n_docs = max(len(corpus), 1)
+        n_docs = max(len(seqs), 1)
         self.idf = np.log(n_docs / np.clip(df, 1.0, None)).astype(np.float32)
-        return self
 
-    def transform(self, text: str) -> np.ndarray:
-        counts = super().transform(text)
+    def _vector_from_tokens(self, tokens: List[str]) -> np.ndarray:
+        counts = super()._vector_from_tokens(tokens)
         total = counts.sum()
         tf = counts / total if total > 0 else counts
         return tf * self.idf

@@ -21,6 +21,17 @@ from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors, SequenceVect
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
 
 
+def _check_word(word: str) -> str:
+    """Both interchange formats delimit words by whitespace; tokens with
+    spaces/newlines (e.g. n-grams) cannot round-trip — fail at write
+    time rather than corrupt the file."""
+    if any(c.isspace() for c in word):
+        raise ValueError(
+            f"word {word!r} contains whitespace — not representable in the "
+            "word2vec text/binary formats (join n-grams with '_' first)")
+    return word
+
+
 class WordVectorSerializer:
     # ----------------------------------------------------------- binary
     @staticmethod
@@ -31,7 +42,7 @@ class WordVectorSerializer:
         with open(path, "wb") as f:
             f.write(f"{V} {D}\n".encode())
             for i in range(V):
-                word = vectors.vocab.word_at_index(i)
+                word = _check_word(vectors.vocab.word_at_index(i))
                 f.write(word.encode("utf-8") + b" ")
                 f.write(np.asarray(vectors.syn0[i], np.float32).tobytes())
                 f.write(b"\n")
@@ -72,7 +83,7 @@ class WordVectorSerializer:
         with open(path, "w", encoding="utf-8") as f:
             for i in range(vectors.vocab.num_words()):
                 vec = " ".join(f"{v:.6f}" for v in np.asarray(vectors.syn0[i]))
-                f.write(f"{vectors.vocab.word_at_index(i)} {vec}\n")
+                f.write(f"{_check_word(vectors.vocab.word_at_index(i))} {vec}\n")
 
     @staticmethod
     def read_text(path) -> SequenceVectors:

@@ -138,7 +138,7 @@ def _expected_family(layer: Layer) -> str:
         return "cnn"
     if name in ("lstm", "graves_lstm", "graves_bidirectional_lstm", "simple_rnn",
                 "rnn_output", "convolution1d", "subsampling1d", "zeropadding1d",
-                "upsampling1d", "last_time_step"):
+                "upsampling1d", "last_time_step", "multi_head_attention"):
         return "rnn"
     if name in ("batchnorm", "activation", "dropout_layer", "global_pooling", "loss"):
         return "any"

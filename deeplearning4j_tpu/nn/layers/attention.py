@@ -1,0 +1,102 @@
+"""Multi-head attention layer.
+
+Not in the 2017 reference (its sequence scaling is TBPTT only —
+SURVEY §5); this layer is the long-context foundation the TPU rebuild
+treats as first-class. Param names follow the framework convention:
+"Wq", "Wk", "Wv", "Wo" (+ optional biases "bq".."bo").
+
+The single-device path is standard scaled dot-product attention (XLA
+fuses QK^T → softmax → PV into MXU-friendly blocks); the
+sequence-parallel path swaps in ring attention over a mesh axis
+(`parallel/ring.py`) with identical math.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType, InputTypeRecurrent
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class MultiHeadAttention(Layer):
+    layer_name = "multi_head_attention"
+
+    n_in: int = 0
+    n_out: int = 0          # model dim (defaults to n_in)
+    n_heads: int = 4
+    causal: bool = False
+    has_bias: bool = True
+    attention_dropout: Optional[float] = None  # retain prob on attn weights
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_in:
+            self.n_in = input_type.size
+        if not self.n_out:
+            self.n_out = self.n_in
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out or self.n_in,
+                                   getattr(input_type, "timesteps", None))
+
+    @property
+    def head_dim(self):
+        return (self.n_out or self.n_in) // self.n_heads
+
+    def init_params(self, rng, dtype=jnp.float32):
+        d = self.n_out or self.n_in
+        assert d % self.n_heads == 0, "n_out must divide n_heads"
+        params = {}
+        for i, name in enumerate(("Wq", "Wk", "Wv", "Wo")):
+            n_in = self.n_in if name != "Wo" else d
+            n_o = d if name != "Wo" else d
+            params[name] = init_weights(
+                jax.random.fold_in(rng, i), (n_in, n_o), self.weight_init,
+                fan_in=n_in, fan_out=n_o, distribution=self.dist, dtype=dtype)
+            if self.has_bias:
+                params["b" + name[1:]] = jnp.zeros((n_o,), dtype)
+        return params
+
+    def _project(self, params, x, name):
+        z = x @ params[name]
+        if self.has_bias:
+            z = z + params["b" + name[1:]]
+        return z
+
+    def heads(self, z):
+        b, t, d = z.shape
+        return z.reshape(b, t, self.n_heads, d // self.n_heads)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        q = self.heads(self._project(params, x, "Wq"))   # [B,T,H,Dh]
+        k = self.heads(self._project(params, x, "Wk"))
+        v = self.heads(self._project(params, x, "Wv"))
+        scale = 1.0 / jnp.sqrt(jnp.asarray(self.head_dim, x.dtype))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        T = x.shape[1]
+        if self.causal:
+            causal = jnp.tril(jnp.ones((T, T), bool))
+            scores = jnp.where(causal[None, None], scores, -jnp.inf)
+        if mask is not None:  # [B,T] padding mask on keys
+            scores = jnp.where(mask[:, None, None, :] > 0, scores, -jnp.inf)
+        w = jax.nn.softmax(scores, axis=-1)
+        if train and self.attention_dropout is not None and rng is not None:
+            keep = self.attention_dropout
+            w = jnp.where(jax.random.bernoulli(rng, keep, w.shape),
+                          w / keep, jnp.zeros_like(w))
+        o = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+        o = o.reshape(x.shape[0], T, -1)
+        return self.activation(self._project(params, o, "Wo")), state

@@ -19,3 +19,15 @@ pipeline parallelism are sharding specs, not new engines.
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh, device_mesh
 from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.ring import (
+    reference_attention,
+    ring_attention,
+    sequence_parallel_attention,
+)
+from deeplearning4j_tpu.parallel.tensor import ShardedParallelTrainer, tp_param_specs
+from deeplearning4j_tpu.parallel.multihost import (
+    initialize_multihost,
+    is_main_process,
+    process_count,
+    process_index,
+)

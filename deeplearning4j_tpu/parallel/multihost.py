@@ -1,0 +1,49 @@
+"""Multi-host initialization.
+
+Reference equivalence: the Spark driver/executor bootstrap +  Aeron
+parameter-server wiring (`SharedTrainingMaster.java:423-443`,
+`VoidConfiguration` unicast/shard config) collapse on TPU into ONE
+call: `jax.distributed.initialize` — after which every host sees the
+global device set, meshes span hosts, and the same pjit/shard_map
+programs run SPMD over ICI (intra-slice) and DCN (cross-slice) with
+XLA-inserted collectives replacing the PS gossip protocol.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Bring up the multi-host runtime (idempotent). On TPU pods with
+    standard env (TPU_WORKER_HOSTNAMES etc.) all args auto-detect; on
+    GPU/CPU clusters pass coordinator host:port + process counts
+    (the reference's `controller address` `SharedTrainingMaster.java:443`)."""
+    if getattr(initialize_multihost, "_done", False):
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    initialize_multihost._done = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0

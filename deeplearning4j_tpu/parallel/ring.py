@@ -1,0 +1,110 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+No reference equivalent (the 2017 codebase scales sequences with TBPTT
+only, SURVEY §5); this is new-design territory the TPU rebuild treats
+as first-class: the sequence axis is sharded across devices, K/V blocks
+rotate around the ICI ring via `ppermute`, and each device accumulates
+its queries' attention with the numerically-stable online-softmax
+(flash-attention style) running max/denominator. Math is EXACTLY
+standard attention; wall-clock is one ring rotation (P-1 ppermutes)
+with compute/communication overlap left to XLA.
+
+Use inside `shard_map` over a mesh with a "seq" axis, or through
+`sequence_parallel_attention` which wraps the shard_map for full
+[B, T, H, D] arrays.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Per-shard blocks: q, k, v [B, T_local, H, Dh] (this device's
+    sequence chunk). Returns o [B, T_local, H, Dh].
+
+    Must run inside shard_map/pmap with `axis_name` bound.
+    """
+    P_ = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Tl, H, Dh = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, q.dtype))
+
+    q_pos = idx * Tl + jnp.arange(Tl)                      # global positions
+
+    def attend(acc, k_blk, v_blk, step):
+        """Fold one K/V block into the online-softmax accumulator."""
+        m, l, o = acc
+        # the block currently held originated on device (idx + step) % P
+        src = (idx + step) % P_
+        k_pos = src * Tl + jnp.arange(Tl)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        if causal:
+            ok = k_pos[None, :] <= q_pos[:, None]          # [Tq, Tk]
+            scores = jnp.where(ok[None, None], scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1)                 # [B,H,Tq]
+        m_new = jnp.maximum(m, blk_max)
+        # guard -inf rows (no valid key yet): exp(-inf - -inf) → use where
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(jnp.where(jnp.isfinite(scores),
+                              scores - m_safe[..., None], -jnp.inf))
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+        return (m_new, l_new, o_new)
+
+    perm = [(j, (j - 1) % P_) for j in range(P_)]  # i receives from i+1
+
+    def block(carry, step):
+        k_blk, v_blk, acc = carry
+        acc = attend(acc, k_blk, v_blk, step)
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, acc), None
+
+    m0 = jnp.full((B, H, Tl), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((B, H, Tl), q.dtype)
+    o0 = jnp.zeros((B, H, Tl, Dh), q.dtype)
+    # P-1 (attend, rotate) steps, then fold the final block with no
+    # trailing rotate — exactly P-1 ppermute rounds
+    (k_f, v_f, acc), _ = lax.scan(block, (k, v, (m0, l0, o0)),
+                                  jnp.arange(P_ - 1))
+    m, l, o = attend(acc, k_f, v_f, P_ - 1)
+    o = o / jnp.clip(l[..., None], 1e-20, None)
+    return jnp.transpose(o, (0, 2, 1, 3))                  # [B,Tl,H,Dh]
+
+
+def sequence_parallel_attention(q, k, v, mesh: Mesh, *,
+                                seq_axis: str = "seq",
+                                causal: bool = False):
+    """Full arrays [B, T, H, Dh] → ring attention with T sharded over
+    `seq_axis` of `mesh`."""
+    spec = P(None, seq_axis)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(spec, spec, spec), out_specs=spec,
+             check_vma=False)
+    def run(ql, kl, vl):
+        return ring_attention(ql, kl, vl, seq_axis, causal=causal)
+
+    return run(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Single-device ground truth for parity tests."""
+    Dh = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, q.dtype))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[1]
+        scores = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None],
+                           scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)

@@ -1,0 +1,124 @@
+"""Tensor parallelism — param sharding specs + sharded trainer.
+
+No reference equivalent (SURVEY §2.13: the reference has no TP; its
+README's "model parallelism" is device data-parallelism). TPU-native
+TP is a *sharding annotation*, not an engine: weights get
+`PartitionSpec`s over the "model" mesh axis and GSPMD/XLA inserts the
+all-gathers/reduce-scatters. Semantics are unchanged (annotations never
+change math) — only layout/communication differ, which is exactly why
+this composes freely with the data axis.
+
+Default policy (Megatron-style for MLPs): every ≥2-D param is sharded
+on its LAST axis (the output-features axis for Dense "W" [in, out] and
+conv HWIO "W"), 1-D params follow on their only axis, and the model's
+FINAL output layer stays replicated so the loss computation does not
+gather logits across the mesh boundary.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.datasets.iterator import as_iterator
+from deeplearning4j_tpu.optimize.listeners import ComposedListeners
+
+
+def tp_param_specs(model, model_axis: str = "model",
+                   shard_output_layer: bool = False) -> Dict:
+    """PartitionSpec tree matching model.params (MultiLayerNetwork)."""
+    n_layers = len(model.layers)
+    specs: Dict[str, Dict] = {}
+    for lk, lparams in model.params.items():
+        is_output = int(lk) == n_layers - 1 and not shard_output_layer
+        lspec = {}
+        for pn, arr in lparams.items():
+            if is_output or np.ndim(arr) == 0:
+                lspec[pn] = P()
+            elif np.ndim(arr) == 1:
+                lspec[pn] = P(model_axis)
+            else:
+                lspec[pn] = P(*([None] * (np.ndim(arr) - 1) + [model_axis]))
+        specs[lk] = lspec
+    return specs
+
+
+class ShardedParallelTrainer:
+    """DP x TP training: batch sharded over `data_axis`, params sharded
+    by `tp_param_specs` over `model_axis`; XLA inserts all collectives
+    (gradient psum over data, activation gathers over model)."""
+
+    def __init__(self, model, mesh: Mesh, *, data_axis: str = "data",
+                 model_axis: str = "model", param_specs: Optional[Dict] = None):
+        self.model = model
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        if not model._initialized:
+            model.init()
+        self.param_specs = param_specs or tp_param_specs(model, model_axis)
+        self._step = None
+
+    def _sharding(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    def _param_shardings(self):
+        return jax.tree_util.tree_map(
+            self._sharding, self.param_specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def _build(self):
+        model = self.model
+        raw_step = model._make_train_step(tbptt=False)
+
+        def step(params, upd, state, it, x, y, rng):
+            return raw_step(params, upd, state, it, x, y, rng, None, None, None)
+
+        psh = self._param_shardings()
+        # updater state mirrors the param tree one level down (per-param
+        # dicts of updater slots) — replicate lookup by param name
+        ush = {lk: {pn: jax.tree_util.tree_map(lambda _: psh[lk][pn], slots)
+                    for pn, slots in lupd.items()}
+               for lk, lupd in model.updater_state.items()}
+        repl = self._sharding(P())
+        bsh = self._sharding(P(self.data_axis))
+        self._step = jax.jit(
+            step,
+            in_shardings=(psh, ush, repl, None, bsh, bsh, None),
+            out_shardings=(psh, ush, repl, None, None),
+            donate_argnums=(0, 1, 2))
+        self._psh, self._ush, self._repl, self._bsh = psh, ush, repl, bsh
+
+    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32):
+        model = self.model
+        if self._step is None:
+            self._build()
+        params = jax.device_put(model.params, self._psh)
+        upd = jax.device_put(model.updater_state, self._ush)
+        state = jax.device_put(model.net_state, self._repl)
+        iterator = as_iterator(data, labels, batch_size=batch_size)
+        listeners = ComposedListeners(model.listeners)
+        rng_root = jax.random.PRNGKey(model.conf.seed + 5)
+        for _ in range(epochs):
+            iterator.reset()
+            for ds in iterator:
+                x = jax.device_put(jnp.asarray(ds.features), self._bsh)
+                y = jax.device_put(jnp.asarray(ds.labels), self._bsh)
+                rng = jax.random.fold_in(rng_root, model.iteration_count)
+                params, upd, state, loss, _ = self._step(
+                    params, upd, state, model.iteration_count, x, y, rng)
+                model.score_value = float(loss)
+                listeners.iteration_done(model, model.iteration_count,
+                                         model.epoch_count, model.score_value,
+                                         batch_size=ds.num_examples())
+                model.iteration_count += 1
+            model.epoch_count += 1
+        model.params = jax.tree_util.tree_map(np.asarray, params)
+        model.updater_state = jax.tree_util.tree_map(np.asarray, upd)
+        model.net_state = jax.tree_util.tree_map(np.asarray, state)
+        return model

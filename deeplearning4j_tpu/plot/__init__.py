@@ -1,0 +1,3 @@
+"""Embedding visualization (reference: deeplearning4j-core `plot/`)."""
+
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne

@@ -1,0 +1,186 @@
+"""t-SNE embeddings.
+
+Reference: `plot/Tsne.java` (423 LoC, exact O(N^2)) and
+`plot/BarnesHutTsne.java` (868 LoC, O(N log N) with SpTree).
+
+TPU-first split: the exact variant runs FULLY jitted — the [N,N]
+affinity and gradient blocks are dense matmul/elementwise work that XLA
+maps straight onto the MXU, practical into the tens of thousands of
+points; Barnes-Hut remains a host (numpy + SpTree) algorithm because
+adaptive tree traversal does not map to static-shape XLA — same
+capability split the reference has (Java loops there, jit here).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.sptree import SpTree
+
+
+def _binary_search_perplexity(d2_row: np.ndarray, perplexity: float,
+                              tol: float = 1e-5, max_iter: int = 50):
+    """Find beta (1/2sigma^2) giving the target perplexity (reference
+    Tsne.hBeta / d2p binary search)."""
+    beta, beta_min, beta_max = 1.0, -np.inf, np.inf
+    target = np.log(perplexity)
+    p = np.zeros_like(d2_row)
+    for _ in range(max_iter):
+        p = np.exp(-d2_row * beta)
+        s = p.sum()
+        if s <= 0:
+            s = 1e-12
+        h = np.log(s) + beta * np.sum(d2_row * p) / s
+        p = p / s
+        diff = h - target
+        if abs(diff) < tol:
+            break
+        if diff > 0:
+            beta_min = beta
+            beta = beta * 2 if beta_max == np.inf else (beta + beta_max) / 2
+        else:
+            beta_max = beta
+            beta = beta / 2 if beta_min == -np.inf else (beta + beta_min) / 2
+    return p
+
+
+def _compute_p(x: np.ndarray, perplexity: float) -> np.ndarray:
+    n = len(x)
+    sum_x = np.sum(x * x, axis=1)
+    d2 = np.maximum(sum_x[:, None] - 2 * x @ x.T + sum_x[None, :], 0.0)
+    p = np.zeros((n, n))
+    for i in range(n):
+        row = np.delete(d2[i], i)
+        pi = _binary_search_perplexity(row, perplexity)
+        p[i, np.arange(n) != i] = pi
+    p = (p + p.T) / (2 * n)
+    return np.maximum(p, 1e-12)
+
+
+@partial(jax.jit, donate_argnums=(1, 2, 3))
+def _tsne_step(p, y, velocity, gains, momentum, lr):
+    """One exact t-SNE gradient step (jitted: [N,N] blocks on device)."""
+    sum_y = jnp.sum(y * y, axis=1)
+    num = 1.0 / (1.0 + sum_y[:, None] - 2.0 * y @ y.T + sum_y[None, :])
+    num = num * (1.0 - jnp.eye(y.shape[0], dtype=y.dtype))
+    q = jnp.maximum(num / jnp.sum(num), 1e-12)
+    pq = (p - q) * num
+    grad = 4.0 * ((jnp.diag(jnp.sum(pq, axis=1)) - pq) @ y)
+    gains = jnp.where(jnp.sign(grad) != jnp.sign(velocity),
+                      gains + 0.2, gains * 0.8)
+    gains = jnp.maximum(gains, 0.01)
+    velocity = momentum * velocity - lr * gains * grad
+    y = y + velocity
+    y = y - jnp.mean(y, axis=0, keepdims=True)
+    kl = jnp.sum(p * jnp.log(p / q))
+    return y, velocity, gains, kl
+
+
+class Tsne:
+    """Exact t-SNE (reference `Tsne.java`), jitted per-iteration."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 learning_rate: float = 200.0, n_iter: int = 500,
+                 momentum: float = 0.5, final_momentum: float = 0.8,
+                 early_exaggeration: float = 12.0, seed: int = 0):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.momentum = momentum
+        self.final_momentum = final_momentum
+        self.early_exaggeration = early_exaggeration
+        self.seed = seed
+        self.kl_divergence_: Optional[float] = None
+
+    def fit_transform(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        n = len(x)
+        perp = min(self.perplexity, max((n - 1) / 3.0, 1.0))
+        p = _compute_p(x, perp)
+        rng = np.random.default_rng(self.seed)
+        y = jnp.asarray(rng.standard_normal((n, self.n_components)) * 1e-4)
+        velocity = jnp.zeros_like(y)
+        gains = jnp.ones_like(y)
+        p_dev = jnp.asarray(p)
+        exag_end = min(100, self.n_iter // 4)
+        kl = None
+        for it in range(self.n_iter):
+            mom = self.momentum if it < 250 else self.final_momentum
+            p_it = p_dev * self.early_exaggeration if it < exag_end else p_dev
+            y, velocity, gains, kl = _tsne_step(
+                p_it, y, velocity, gains,
+                jnp.float64(mom) if y.dtype == jnp.float64 else np.float32(mom),
+                np.float32(self.learning_rate))
+        self.kl_divergence_ = float(kl)
+        return np.asarray(y)
+
+
+class BarnesHutTsne(Tsne):
+    """Barnes-Hut t-SNE (reference `BarnesHutTsne.java`): sparse input
+    affinities from a kNN graph, SpTree-approximated repulsive forces."""
+
+    def __init__(self, theta: float = 0.5, **kw):
+        super().__init__(**kw)
+        self.theta = theta
+
+    def fit_transform(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        n = len(x)
+        if n <= 512 or self.theta <= 0:
+            return super().fit_transform(x)  # exact is fine (and jitted)
+        perp = min(self.perplexity, (n - 1) / 3.0)
+        k = int(min(n - 1, 3 * perp))
+        # kNN graph via brute-force blocked distances (vectorised)
+        sum_x = np.sum(x * x, axis=1)
+        d2 = np.maximum(sum_x[:, None] - 2 * x @ x.T + sum_x[None, :], 0.0)
+        np.fill_diagonal(d2, np.inf)
+        nn_idx = np.argpartition(d2, k, axis=1)[:, :k]
+        rows = np.repeat(np.arange(n), k)
+        cols = nn_idx.ravel()
+        p_vals = np.zeros(n * k)
+        for i in range(n):
+            p_vals[i * k:(i + 1) * k] = _binary_search_perplexity(
+                d2[i, nn_idx[i]], perp)
+        # symmetrize the sparse P
+        pmat = {}
+        for r, c, v in zip(rows, cols, p_vals):
+            pmat[(r, c)] = pmat.get((r, c), 0.0) + v
+            pmat[(c, r)] = pmat.get((c, r), 0.0) + v
+        total = sum(pmat.values())
+        sp_rows = np.array([rc[0] for rc in pmat])
+        sp_cols = np.array([rc[1] for rc in pmat])
+        sp_vals = np.array(list(pmat.values())) / total
+
+        rng = np.random.default_rng(self.seed)
+        y = rng.standard_normal((n, self.n_components)) * 1e-4
+        velocity = np.zeros_like(y)
+        gains = np.ones_like(y)
+        exag_end = min(100, self.n_iter // 4)
+        for it in range(self.n_iter):
+            mom = self.momentum if it < 250 else self.final_momentum
+            exag = self.early_exaggeration if it < exag_end else 1.0
+            tree = SpTree.build(y)
+            neg = np.zeros_like(y)
+            z = 0.0
+            for i in range(n):
+                f = np.zeros(self.n_components)
+                z += tree.compute_non_edge_forces(y[i], self.theta, f)
+                neg[i] = f
+            diff = y[sp_rows] - y[sp_cols]
+            q_num = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+            attr = np.zeros_like(y)
+            np.add.at(attr, sp_rows, (exag * sp_vals * q_num)[:, None] * diff)
+            grad = attr - neg / max(z, 1e-12)
+            gains = np.where(np.sign(grad) != np.sign(velocity),
+                             gains + 0.2, gains * 0.8)
+            gains = np.maximum(gains, 0.01)
+            velocity = mom * velocity - self.learning_rate * gains * grad
+            y = y + velocity
+            y = y - y.mean(axis=0, keepdims=True)
+        return y

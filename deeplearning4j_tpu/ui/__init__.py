@@ -1,0 +1,11 @@
+"""Observability / training UI (reference: deeplearning4j-ui-parent,
+SURVEY §2.10): StatsListener → StatsStorage → web dashboard."""
+
+from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport
+from deeplearning4j_tpu.ui.storage import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    RemoteUIStatsStorageRouter,
+    StatsStorage,
+)
+from deeplearning4j_tpu.ui.server import UIServer

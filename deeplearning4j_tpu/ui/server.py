@@ -1,0 +1,181 @@
+"""Training UI web server.
+
+Reference: `play/PlayUIServer.java` (embedded Play/Netty server) with
+`module/train/TrainModule.java` routes `/train/overview|model|system`.
+Here: stdlib ThreadingHTTPServer (the embedded-server role), same
+routes serving a self-contained HTML dashboard (inline SVG charts, no
+external assets) plus JSON APIs and the /remote receiver endpoint
+(reference `RemoteReceiverModule`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deeplearning4j_tpu.ui.stats import StatsReport
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
+
+
+def _svg_line_chart(xs, ys, width=640, height=240, label="score"):
+    if not xs:
+        return "<svg/>"
+    xmin, xmax = min(xs), max(xs) or 1
+    ymin, ymax = min(ys), max(ys)
+    if ymax == ymin:
+        ymax = ymin + 1
+    pts = []
+    for x, y in zip(xs, ys):
+        px = 40 + (x - xmin) / max(xmax - xmin, 1e-9) * (width - 60)
+        py = height - 30 - (y - ymin) / (ymax - ymin) * (height - 50)
+        pts.append(f"{px:.1f},{py:.1f}")
+    return (f'<svg width="{width}" height="{height}">'
+            f'<rect width="{width}" height="{height}" fill="#fafafa"/>'
+            f'<polyline fill="none" stroke="#2a6fdb" stroke-width="1.5" '
+            f'points="{" ".join(pts)}"/>'
+            f'<text x="45" y="18" font-size="12">{label} '
+            f'(last: {ys[-1]:.5g})</text></svg>')
+
+
+class UIServer:
+    """`UIServer.getInstance().attach(storage)` equivalent."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 0):
+        self.storage: StatsStorage = InMemoryStatsStorage()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body, ctype="text/html"):
+                if isinstance(body, str):
+                    body = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path in ("/", "/train", "/train/overview"):
+                    self._send(200, outer._overview_html())
+                elif path == "/train/model":
+                    self._send(200, outer._model_html())
+                elif path == "/train/system":
+                    self._send(200, outer._system_html())
+                elif path == "/api/sessions":
+                    self._send(200, json.dumps(outer.storage.list_session_ids()),
+                               "application/json")
+                elif path.startswith("/api/reports/"):
+                    sid = path.rsplit("/", 1)[1]
+                    reports = outer.storage.get_reports(sid)
+                    self._send(200, json.dumps([{
+                        "iteration": r.iteration, "score": r.score,
+                        "examples_per_sec": r.examples_per_sec,
+                        "memory_rss_mb": r.memory_rss_mb,
+                    } for r in reports]), "application/json")
+                else:
+                    self._send(404, "not found")
+
+            def do_POST(self):
+                if self.path == "/remote":
+                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        report = StatsReport.decode(self.rfile.read(n))
+                        outer.storage.put_report(report)
+                        self._send(200, '{"status":"ok"}', "application/json")
+                    except Exception as e:  # noqa: BLE001 — server boundary
+                        self._send(400, json.dumps({"error": str(e)}),
+                                   "application/json")
+                else:
+                    self._send(404, "not found")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- pages
+    def _sessions(self):
+        return self.storage.list_session_ids()
+
+    def _nav(self, active):
+        links = "".join(
+            f'<a href="/train/{p}" style="margin-right:16px;'
+            f'{"font-weight:bold" if p == active else ""}">{p.title()}</a>'
+            for p in ("overview", "model", "system"))
+        return f'<div style="padding:8px;border-bottom:1px solid #ddd">{links}</div>'
+
+    def _overview_html(self):
+        body = [self._nav("overview")]
+        for sid in self._sessions():
+            reports = self.storage.get_reports(sid)
+            xs = [r.iteration for r in reports]
+            ys = [r.score for r in reports]
+            body.append(f"<h3>Session {sid}</h3>")
+            body.append(_svg_line_chart(xs, ys, label="score"))
+            if reports and reports[-1].examples_per_sec:
+                body.append(_svg_line_chart(
+                    xs, [r.examples_per_sec for r in reports],
+                    label="examples/sec"))
+        if len(body) == 1:
+            body.append("<p>No training sessions attached yet.</p>")
+        return self._page("Training Overview", "".join(body))
+
+    def _model_html(self):
+        body = [self._nav("model")]
+        for sid in self._sessions():
+            latest = self.storage.latest_report(sid)
+            if latest is None:
+                continue
+            body.append(f"<h3>Session {sid} — mean |param| by layer</h3><table border=1 cellpadding=4>")
+            body.append("<tr><th>param</th><th>mean magnitude</th></tr>")
+            for k, v in sorted(latest.param_mean_magnitudes.items()):
+                body.append(f"<tr><td>{k}</td><td>{v:.6g}</td></tr>")
+            body.append("</table>")
+        return self._page("Model", "".join(body))
+
+    def _system_html(self):
+        body = [self._nav("system")]
+        for sid in self._sessions():
+            reports = self.storage.get_reports(sid)
+            if not reports:
+                continue
+            body.append(f"<h3>Session {sid}</h3>")
+            body.append(_svg_line_chart([r.iteration for r in reports],
+                                        [r.memory_rss_mb for r in reports],
+                                        label="RSS MB"))
+        return self._page("System", "".join(body))
+
+    @staticmethod
+    def _page(title, body):
+        return (f"<!doctype html><html><head><title>{title}</title></head>"
+                f"<body style='font-family:sans-serif'>{body}</body></html>")
+
+    # --------------------------------------------------------------- api
+    @classmethod
+    def get_instance(cls, port: int = 0) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port).start()
+        return cls._instance
+
+    def attach(self, storage: StatsStorage):
+        self.storage = storage
+        return self
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None

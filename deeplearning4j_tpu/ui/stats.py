@@ -1,0 +1,170 @@
+"""Training stats collection.
+
+Reference: `ui/stats/BaseStatsListener.java:44` — per-iteration
+collection (`iterationDone` :286-544) of score, param/gradient/update
+histograms and mean magnitudes, memory and runtime info, written as a
+`StatsReport` to a `StatsStorageRouter`. The reference's SBE codecs
+(`stats/sbe/UpdateEncoder.java`) become a compact struct-packed binary
+codec here (same role: a stable, versioned wire format the UI and
+storage share).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import resource
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_MAGIC = b"DL4JSTAT"
+_VERSION = 1
+
+
+@dataclasses.dataclass
+class StatsReport:
+    session_id: str
+    worker_id: str
+    iteration: int
+    epoch: int
+    timestamp: float
+    score: float
+    iteration_time_ms: float = 0.0
+    examples_per_sec: float = 0.0
+    # per-param-name summaries
+    param_mean_magnitudes: Dict[str, float] = dataclasses.field(default_factory=dict)
+    update_mean_magnitudes: Dict[str, float] = dataclasses.field(default_factory=dict)
+    param_histograms: Dict[str, Tuple[List[float], List[int]]] = \
+        dataclasses.field(default_factory=dict)
+    # system
+    memory_rss_mb: float = 0.0
+
+    # ------------------------------------------------- binary wire format
+    def encode(self) -> bytes:
+        """Compact binary encoding (SBE-equivalent role)."""
+        def pack_str(s: str) -> bytes:
+            b = s.encode("utf-8")
+            return struct.pack("<H", len(b)) + b
+
+        out = [_MAGIC, struct.pack("<H", _VERSION)]
+        out.append(pack_str(self.session_id))
+        out.append(pack_str(self.worker_id))
+        out.append(struct.pack("<qqdddd", self.iteration, self.epoch,
+                               self.timestamp, self.score,
+                               self.iteration_time_ms, self.examples_per_sec))
+        out.append(struct.pack("<d", self.memory_rss_mb))
+        for table in (self.param_mean_magnitudes, self.update_mean_magnitudes):
+            out.append(struct.pack("<H", len(table)))
+            for k, v in table.items():
+                out.append(pack_str(k))
+                out.append(struct.pack("<d", v))
+        out.append(struct.pack("<H", len(self.param_histograms)))
+        for k, (edges, counts) in self.param_histograms.items():
+            out.append(pack_str(k))
+            out.append(struct.pack("<H", len(counts)))
+            out.append(np.asarray(edges, np.float64).tobytes())
+            out.append(np.asarray(counts, np.int64).tobytes())
+        return b"".join(out)
+
+    @staticmethod
+    def decode(data: bytes) -> "StatsReport":
+        assert data[:8] == _MAGIC, "bad magic"
+        pos = [10]
+
+        def unpack_str() -> str:
+            (n,) = struct.unpack_from("<H", data, pos[0])
+            pos[0] += 2
+            s = data[pos[0]:pos[0] + n].decode("utf-8")
+            pos[0] += n
+            return s
+
+        session_id = unpack_str()
+        worker_id = unpack_str()
+        it, ep, ts, score, itms, eps = struct.unpack_from("<qqdddd", data, pos[0])
+        pos[0] += struct.calcsize("<qqdddd")
+        (rss,) = struct.unpack_from("<d", data, pos[0])
+        pos[0] += 8
+        tables = []
+        for _ in range(2):
+            (n,) = struct.unpack_from("<H", data, pos[0])
+            pos[0] += 2
+            t = {}
+            for _ in range(n):
+                k = unpack_str()
+                (v,) = struct.unpack_from("<d", data, pos[0])
+                pos[0] += 8
+                t[k] = v
+            tables.append(t)
+        (nh,) = struct.unpack_from("<H", data, pos[0])
+        pos[0] += 2
+        hists = {}
+        for _ in range(nh):
+            k = unpack_str()
+            (nb,) = struct.unpack_from("<H", data, pos[0])
+            pos[0] += 2
+            edges = np.frombuffer(data, np.float64, nb + 1, pos[0]).tolist()
+            pos[0] += 8 * (nb + 1)
+            counts = np.frombuffer(data, np.int64, nb, pos[0]).tolist()
+            pos[0] += 8 * nb
+            hists[k] = (edges, counts)
+        return StatsReport(session_id, worker_id, it, ep, ts, score,
+                           itms, eps, tables[0], tables[1], hists, rss)
+
+
+class StatsListener:
+    """Reference `StatsListener` — collect + route to a StatsStorage.
+
+    `update_frequency`: collect every N iterations (reference
+    listenerFrequency). Histograms are optional (more device→host
+    traffic)."""
+
+    def __init__(self, storage, session_id: str = "default",
+                 worker_id: str = "worker0", update_frequency: int = 1,
+                 collect_histograms: bool = False, histogram_bins: int = 20):
+        self.storage = storage
+        self.session_id = session_id
+        self.worker_id = worker_id
+        self.update_frequency = max(1, update_frequency)
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
+        self._last_time = None
+
+    # TrainingListener protocol
+    def on_fit_start(self, model):
+        self._last_time = time.perf_counter()
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        if iteration % self.update_frequency != 0:
+            return
+        now = time.perf_counter()
+        dt_ms = 0.0 if self._last_time is None else (now - self._last_time) * 1e3
+        self._last_time = now
+        batch = info.get("batch_size", 0)
+        report = StatsReport(
+            session_id=self.session_id, worker_id=self.worker_id,
+            iteration=iteration, epoch=epoch, timestamp=time.time(),
+            score=float(score), iteration_time_ms=dt_ms,
+            examples_per_sec=(batch / (dt_ms / 1e3) if dt_ms > 0 and batch else 0.0),
+            memory_rss_mb=resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+        )
+        for lk, lparams in model.params.items():
+            for pn, arr in lparams.items():
+                a = np.asarray(arr)
+                key = f"{lk}_{pn}"
+                report.param_mean_magnitudes[key] = float(np.mean(np.abs(a)))
+                if self.collect_histograms:
+                    counts, edges = np.histogram(a, bins=self.histogram_bins)
+                    report.param_histograms[key] = (edges.tolist(),
+                                                    counts.tolist())
+        self.storage.put_report(report)
+
+    def on_epoch_start(self, model, epoch):
+        pass
+
+    def on_epoch_end(self, model, epoch):
+        pass
+
+    def on_fit_end(self, model):
+        pass

@@ -1,0 +1,106 @@
+"""StatsStorage backends.
+
+Reference: `api/storage/StatsStorage.java` (listener/router API),
+`storage/mapdb/MapDBStatsStorage.java` and
+`storage/sqlite/J7FileStatsStorage.java` (persistent), plus
+`RemoteUIStatsStorageRouter` (HTTP POST to a UI on another process).
+Here: in-memory, sqlite3 (stdlib), and an HTTP router posting the
+binary-encoded reports to a UIServer's /remote endpoint.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.ui.stats import StatsReport
+
+
+class StatsStorage:
+    def put_report(self, report: StatsReport) -> None:
+        raise NotImplementedError
+
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_reports(self, session_id: str) -> List[StatsReport]:
+        raise NotImplementedError
+
+    def latest_report(self, session_id: str) -> Optional[StatsReport]:
+        reports = self.get_reports(session_id)
+        return reports[-1] if reports else None
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self):
+        self._data: Dict[str, List[StatsReport]] = {}
+        self._lock = threading.Lock()
+
+    def put_report(self, report):
+        with self._lock:
+            self._data.setdefault(report.session_id, []).append(report)
+
+    def list_session_ids(self):
+        with self._lock:
+            return list(self._data)
+
+    def get_reports(self, session_id):
+        with self._lock:
+            return list(self._data.get(session_id, []))
+
+
+class FileStatsStorage(StatsStorage):
+    """sqlite-backed persistent storage (reference
+    `J7FileStatsStorage.java` role). Reports are stored in the compact
+    binary wire format."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        with self._conn() as c:
+            c.execute("""CREATE TABLE IF NOT EXISTS reports (
+                session_id TEXT, iteration INTEGER, payload BLOB,
+                PRIMARY KEY (session_id, iteration))""")
+
+    def _conn(self):
+        return sqlite3.connect(self.path)
+
+    def put_report(self, report):
+        with self._lock, self._conn() as c:
+            c.execute("INSERT OR REPLACE INTO reports VALUES (?, ?, ?)",
+                      (report.session_id, report.iteration, report.encode()))
+
+    def list_session_ids(self):
+        with self._lock, self._conn() as c:
+            rows = c.execute("SELECT DISTINCT session_id FROM reports").fetchall()
+        return [r[0] for r in rows]
+
+    def get_reports(self, session_id):
+        with self._lock, self._conn() as c:
+            rows = c.execute(
+                "SELECT payload FROM reports WHERE session_id=? ORDER BY iteration",
+                (session_id,)).fetchall()
+        return [StatsReport.decode(r[0]) for r in rows]
+
+
+class RemoteUIStatsStorageRouter(StatsStorage):
+    """Train here, view there (reference
+    `RemoteUIStatsStorageRouter.java`): POST binary reports to a
+    UIServer's /remote endpoint."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/") + "/remote"
+
+    def put_report(self, report):
+        from urllib import request as urlrequest
+        req = urlrequest.Request(
+            self.url, data=report.encode(),
+            headers={"Content-Type": "application/octet-stream"})
+        urlrequest.urlopen(req).read()  # noqa: S310 — user-configured UI host
+
+    def list_session_ids(self):
+        return []
+
+    def get_reports(self, session_id):
+        return []

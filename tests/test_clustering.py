@@ -1,0 +1,149 @@
+"""Nearest-neighbors / clustering / t-SNE tests (reference strategy:
+VPTree/KDTree correctness vs brute force, k-means convergence, t-SNE
+cluster preservation)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree,
+    KMeansClustering,
+    QuadTree,
+    SpTree,
+    VPTree,
+)
+from deeplearning4j_tpu.clustering.server import (
+    NearestNeighborsClient,
+    NearestNeighborsServer,
+)
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def brute_knn(points, query, k):
+    d = np.sqrt(np.sum((points - query[None, :]) ** 2, axis=1))
+    order = np.argsort(d)
+    return list(order[:k]), list(d[order[:k]])
+
+
+@pytest.fixture(scope="module")
+def points():
+    return np.random.default_rng(0).standard_normal((300, 8))
+
+
+class TestVPTree:
+    def test_matches_brute_force(self, points):
+        tree = VPTree(points)
+        for qi in (0, 7, 123):
+            q = points[qi] + 0.01
+            got_i, got_d = tree.knn(q, 10)
+            want_i, want_d = brute_knn(points, q, 10)
+            assert got_i == want_i
+            np.testing.assert_allclose(got_d, want_d, rtol=1e-9)
+
+    def test_cosine_distance(self, points):
+        tree = VPTree(points, distance="cosine")
+        q = points[5]
+        got_i, _ = tree.knn(q, 1)
+        assert got_i[0] == 5
+
+
+class TestKDTree:
+    def test_matches_brute_force(self, points):
+        tree = KDTree(points)
+        q = np.random.default_rng(1).standard_normal(8)
+        got_i, got_d = tree.knn(q, 15)
+        want_i, want_d = brute_knn(points, q, 15)
+        assert got_i == want_i
+
+    def test_range_query(self):
+        pts = np.array([[0.0, 0.0], [1.0, 1.0], [2.0, 2.0], [0.5, 0.6]])
+        tree = KDTree(pts)
+        inside = tree.range([0.0, 0.0], [1.0, 1.0])
+        assert sorted(inside) == [0, 1, 3]
+
+
+class TestTrees:
+    def test_quadtree_mass_conservation(self):
+        pts = np.random.default_rng(2).standard_normal((100, 2))
+        tree = QuadTree.build(pts)
+        assert tree.size == 100
+        np.testing.assert_allclose(tree.com, pts.mean(axis=0), atol=1e-9)
+
+    def test_sptree_matches_exact_forces_at_theta0(self):
+        pts = np.random.default_rng(3).standard_normal((50, 3))
+        tree = SpTree.build(pts)
+        assert tree.size == 50
+        i = 7
+        neg = np.zeros(3)
+        z = tree.compute_non_edge_forces(pts[i], 0.0, neg)  # theta=0 → exact
+        diff = pts[i] - np.delete(pts, i, axis=0)
+        q = 1.0 / (1.0 + np.sum(diff ** 2, axis=1))
+        np.testing.assert_allclose(z, q.sum(), rtol=1e-6)
+        np.testing.assert_allclose(neg, (q ** 2)[:, None] * diff, atol=1e-6,
+                                   rtol=1e-5) if False else \
+            np.testing.assert_allclose(neg, ((q ** 2)[:, None] * diff).sum(0),
+                                       rtol=1e-6)
+
+
+class TestKMeans:
+    def test_recovers_separated_clusters(self):
+        rng = np.random.default_rng(4)
+        c1 = rng.standard_normal((80, 4)) * 0.2 + 5
+        c2 = rng.standard_normal((80, 4)) * 0.2 - 5
+        c3 = rng.standard_normal((80, 4)) * 0.2
+        pts = np.concatenate([c1, c2, c3])
+        cs = KMeansClustering(k=3, max_iterations=50).apply_to(pts)
+        labels = cs.assignments
+        # every true cluster is one predicted cluster
+        for block in (labels[:80], labels[80:160], labels[160:]):
+            assert len(set(block.tolist())) == 1
+        assert len({labels[0], labels[80], labels[160]}) == 3
+        assert cs.nearest_cluster(np.full(4, 5.0)) == labels[0]
+
+    def test_cluster_set_api(self):
+        pts = np.random.default_rng(5).standard_normal((30, 2))
+        cs = KMeansClustering(k=4).apply_to(pts)
+        clusters = cs.get_clusters()
+        assert len(clusters) == 4
+        assert sum(len(c.points) for c in clusters) == 30
+
+
+class TestTsne:
+    def _clustered(self, n=60, d=10):
+        rng = np.random.default_rng(6)
+        a = rng.standard_normal((n, d)) * 0.3 + 4
+        b = rng.standard_normal((n, d)) * 0.3 - 4
+        return np.concatenate([a, b])
+
+    def test_exact_separates_clusters(self):
+        x = self._clustered()
+        y = Tsne(perplexity=15.0, n_iter=250, seed=0).fit_transform(x)
+        assert y.shape == (120, 2)
+        ca, cb = y[:60].mean(0), y[60:].mean(0)
+        spread = max(y[:60].std(), y[60:].std())
+        assert np.linalg.norm(ca - cb) > 2 * spread
+
+    def test_barnes_hut_runs_large(self):
+        rng = np.random.default_rng(7)
+        x = np.concatenate([rng.standard_normal((300, 5)) + 3,
+                            rng.standard_normal((300, 5)) - 3])
+        y = BarnesHutTsne(theta=0.8, n_iter=60, seed=0).fit_transform(x)
+        assert y.shape == (600, 2)
+        assert np.all(np.isfinite(y))
+        ca, cb = y[:300].mean(0), y[300:].mean(0)
+        assert np.linalg.norm(ca - cb) > 1e-2
+
+
+class TestServer:
+    def test_rest_roundtrip(self, points):
+        server = NearestNeighborsServer(points).start()
+        try:
+            client = NearestNeighborsClient(f"http://127.0.0.1:{server.port}")
+            res = client.knn(index=3, k=5)
+            assert res["results"][0]["index"] == 3
+            q = points[10] + 0.001
+            res2 = client.knn_new(q.astype(np.float32), 4)
+            want_i, _ = brute_knn(points, q, 4)
+            assert [r["index"] for r in res2["results"]] == want_i
+        finally:
+            server.stop()

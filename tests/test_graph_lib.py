@@ -1,0 +1,116 @@
+"""Graph library tests (reference: deeplearning4j-graph test suite —
+walk determinism, DeepWalk embedding sanity)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk,
+    Graph,
+    GraphLoader,
+    NoEdgeHandling,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+
+
+def barbell_graph():
+    """Two 6-cliques joined by a single bridge edge."""
+    g = Graph(12)
+    for base in (0, 6):
+        for i in range(6):
+            for j in range(i + 1, 6):
+                g.add_edge(base + i, base + j)
+    g.add_edge(5, 6)
+    return g
+
+
+class TestGraph:
+    def test_adjacency(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2, directed=True)
+        assert set(g.get_connected_vertices(0)) == {1}
+        assert set(g.get_connected_vertices(1)) == {0, 2}
+        assert g.get_connected_vertices(2) == []  # directed edge not reversed
+        assert g.degree(1) == 2
+
+    def test_loader_edge_list(self, tmp_path):
+        p = tmp_path / "edges.txt"
+        p.write_text("0 1\n1 2\n# comment\n2 3\n")
+        g = GraphLoader.load_edge_list(p, 4)
+        assert g.degree(1) == 2
+
+    def test_loader_weighted(self, tmp_path):
+        p = tmp_path / "wedges.txt"
+        p.write_text("0 1 0.5\n1 2 2.0\n")
+        g = GraphLoader.load_weighted_edge_list(p, 3)
+        assert g.get_edges_out(1)[1].weight == 2.0
+
+    def test_loader_adjacency(self, tmp_path):
+        p = tmp_path / "adj.txt"
+        p.write_text("0 1 2\n1 0\n2\n")
+        g = GraphLoader.load_adjacency_list(p)
+        assert g.num_vertices() == 3
+        assert set(g.get_connected_vertices(0)) == {1, 2}
+
+
+class TestWalks:
+    def test_deterministic_given_seed(self):
+        g = barbell_graph()
+        w1 = [w for w in RandomWalkIterator(g, 10, seed=3)]
+        w2 = [w for w in RandomWalkIterator(g, 10, seed=3)]
+        assert w1 == w2
+        assert len(w1) == 12 and all(len(w) == 10 for w in w1)
+
+    def test_walk_follows_edges(self):
+        g = barbell_graph()
+        for walk in RandomWalkIterator(g, 8, seed=1):
+            for a, b in zip(walk, walk[1:]):
+                assert b in g.get_connected_vertices(a) or b == a
+
+    def test_disconnected_self_loop_vs_exception(self):
+        g = Graph(2)
+        g.add_edge(0, 0)
+        it = RandomWalkIterator(g, 5, seed=0,
+                                no_edge_handling=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED)
+        walks = list(it)
+        assert all(set(w) == {w[0]} for w in walks)
+        it2 = RandomWalkIterator(g, 5, seed=0,
+                                 no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+        with pytest.raises(ValueError):
+            list(it2)
+
+    def test_weighted_walk_prefers_heavy_edges(self):
+        g = Graph(3)
+        g.add_edge(0, 1, weight=100.0)
+        g.add_edge(0, 2, weight=0.01)
+        counts = {1: 0, 2: 0}
+        it = WeightedRandomWalkIterator(g, 2, seed=0)
+        for _ in range(50):
+            it.reset()
+            for w in it:
+                if w[0] == 0:
+                    counts[w[1]] += 1
+        assert counts[1] > counts[2]
+
+
+class TestDeepWalk:
+    def test_embeddings_cluster_by_community(self):
+        g = barbell_graph()
+        dw = DeepWalk(vector_size=16, window_size=3, walk_length=20,
+                      walks_per_vertex=8, epochs=2, learning_rate=0.05,
+                      seed=11)
+        dw.fit_graph(g)
+        # same-clique similarity should beat cross-clique
+        same = dw.similarity_vertices(0, 3)
+        cross = dw.similarity_vertices(0, 9)
+        assert same > cross
+        near = dw.vertices_nearest(1, 4)
+        assert len(set(near) & {0, 2, 3, 4, 5}) >= 2
+
+    def test_vertex_vector_api(self):
+        g = barbell_graph()
+        dw = DeepWalk(vector_size=8, walk_length=10, epochs=1)
+        dw.fit_graph(g)
+        assert dw.get_vertex_vector(0).shape == (8,)

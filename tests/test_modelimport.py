@@ -91,6 +91,7 @@ class TestSequentialImport:
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
     def test_keras1_dialect(self, tmp_path):
+        # Keras 1 config fields AND Keras 1 weight names ("<layer>_W")
         rng = np.random.default_rng(1)
         W = rng.standard_normal((6, 3)).astype(np.float32)
         b = np.zeros(3, np.float32)
@@ -98,9 +99,18 @@ class TestSequentialImport:
             dense_cfg("d", 3, "sigmoid", input_shape=[6], keras1=True),
         ]}
         p = tmp_path / "k1.h5"
-        write_keras_h5(p, config, {"d": {"kernel": W, "bias": b}})
+        write_keras_h5(p, config, {"d": {"d_W": W, "d_b": b}})
         net = KerasModelImport.import_keras_model_and_weights(p)
         np.testing.assert_array_equal(np.asarray(net.params["0"]["W"]), W)
+
+    def test_unmatched_weight_names_raise(self, tmp_path):
+        config = {"class_name": "Sequential", "config": [
+            dense_cfg("d", 3, "sigmoid", input_shape=[6]),
+        ]}
+        p = tmp_path / "bad.h5"
+        write_keras_h5(p, config, {"d": {"mystery": np.zeros((6, 3), np.float32)}})
+        with pytest.raises(ValueError, match="could not match"):
+            KerasModelImport.import_keras_model_and_weights(p)
 
     def test_cnn_with_flatten(self, tmp_path):
         rng = np.random.default_rng(2)

@@ -1,0 +1,111 @@
+"""UI/observability tests (reference: UI server smoke tests, storage
+round-trips, SBE encode/decode tests)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    RemoteUIStatsStorageRouter,
+    StatsListener,
+    StatsReport,
+    UIServer,
+)
+
+
+def make_report(it=3, score=0.5):
+    return StatsReport(
+        session_id="s1", worker_id="w0", iteration=it, epoch=0,
+        timestamp=123.0, score=score, iteration_time_ms=10.0,
+        examples_per_sec=100.0,
+        param_mean_magnitudes={"0_W": 0.12, "0_b": 0.01},
+        update_mean_magnitudes={"0_W": 0.001},
+        param_histograms={"0_W": ([0.0, 0.5, 1.0], [3, 7])},
+        memory_rss_mb=256.0)
+
+
+class TestWireFormat:
+    def test_roundtrip(self):
+        r = make_report()
+        back = StatsReport.decode(r.encode())
+        assert back.session_id == "s1" and back.iteration == 3
+        assert back.param_mean_magnitudes == r.param_mean_magnitudes
+        assert back.param_histograms["0_W"][1] == [3, 7]
+        assert back.memory_rss_mb == 256.0
+
+
+class TestStorage:
+    def test_in_memory(self):
+        st = InMemoryStatsStorage()
+        st.put_report(make_report(1))
+        st.put_report(make_report(2))
+        assert st.list_session_ids() == ["s1"]
+        assert [r.iteration for r in st.get_reports("s1")] == [1, 2]
+        assert st.latest_report("s1").iteration == 2
+
+    def test_sqlite_roundtrip(self, tmp_path):
+        st = FileStatsStorage(tmp_path / "stats.db")
+        st.put_report(make_report(1, 0.9))
+        st.put_report(make_report(5, 0.4))
+        st2 = FileStatsStorage(tmp_path / "stats.db")  # reopen
+        reports = st2.get_reports("s1")
+        assert [r.iteration for r in reports] == [1, 5]
+        assert reports[1].score == 0.4
+
+
+class TestListenerAndServer:
+    def _train_with(self, listener):
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=6, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .set_input_type(InputType.feed_forward(6)).build())
+        net = MultiLayerNetwork(conf).init().set_listeners(listener)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 6)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+        net.fit(x, y, epochs=3, batch_size=32)
+
+    def test_stats_listener_collects(self):
+        storage = InMemoryStatsStorage()
+        self._train_with(StatsListener(storage, session_id="train1",
+                                       collect_histograms=True))
+        reports = storage.get_reports("train1")
+        assert len(reports) == 6  # 3 epochs x 2 batches
+        assert "0_W" in reports[0].param_mean_magnitudes
+        assert "1_W" in reports[0].param_histograms
+        assert reports[-1].memory_rss_mb > 0
+
+    def test_server_pages_and_api(self):
+        storage = InMemoryStatsStorage()
+        self._train_with(StatsListener(storage, session_id="ui_sess"))
+        server = UIServer().attach(storage).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            for page in ("/train/overview", "/train/model", "/train/system"):
+                html = urllib.request.urlopen(base + page).read().decode()
+                assert "ui_sess" in html
+            api = json.loads(urllib.request.urlopen(
+                base + "/api/reports/ui_sess").read())
+            assert len(api) == 6 and "score" in api[0]
+        finally:
+            server.stop()
+
+    def test_remote_router(self):
+        server = UIServer().start()  # own in-memory storage
+        try:
+            router = RemoteUIStatsStorageRouter(
+                f"http://127.0.0.1:{server.port}")
+            router.put_report(make_report(7))
+            reports = server.storage.get_reports("s1")
+            assert len(reports) == 1 and reports[0].iteration == 7
+        finally:
+            server.stop()
