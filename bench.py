@@ -32,7 +32,13 @@ def main():
     steps = 20 if on_tpu else 3
 
     model = ResNet50(num_classes=1000, height=size, width=size, channels=3)
-    net = model.init()
+    if on_tpu:
+        # fp32 params, bf16 compute — convs hit the MXU at full rate
+        from deeplearning4j_tpu.nd.dtype import bf16_policy
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        net = ComputationGraph(model.conf(), dtype_policy=bf16_policy()).init(model.seed)
+    else:
+        net = model.init()
 
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((batch, size, size, 3)), jnp.bfloat16 if on_tpu else jnp.float32)

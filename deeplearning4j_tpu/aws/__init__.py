@@ -1,0 +1,10 @@
+"""Cloud storage/provisioning adapters (reference: deeplearning4j-aws —
+EC2 provisioning + S3 up/down, `aws/s3/uploader/S3Uploader.java`,
+`BaseS3DataSetIterator`).
+
+boto3 is not bundled in this image; the classes gate on it with a clear
+error, and `S3DataSetIterator` accepts any fsspec-style fetch function
+so the iterator logic is testable without AWS.
+"""
+
+from deeplearning4j_tpu.aws.s3 import S3DataSetIterator, S3Downloader, S3Uploader

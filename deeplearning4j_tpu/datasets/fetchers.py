@@ -52,24 +52,33 @@ def _try_download(url: str, dest: Path) -> bool:
         return False
 
 
-def _synthetic_digits(num: int, seed: int, side: int = 28):
-    """Deterministic MNIST surrogate: each class is a fixed low-frequency
-    template + per-example noise; linearly separable enough that LeNet
-    reaches high accuracy, hard enough that accuracy is meaningful."""
+def _synthetic_templates(num: int, num_classes: int, seed: int, *,
+                         side: int = 28, tpl_seed: int, freq_hi: float):
+    """Shared surrogate generator: each class is a fixed low-frequency
+    sinusoid template + per-example noise; linearly separable enough
+    that small CNNs reach high accuracy, hard enough that accuracy is
+    meaningful."""
     rng = np.random.default_rng(seed)
     templates = []
-    tpl_rng = np.random.default_rng(20260729)
+    tpl_rng = np.random.default_rng(tpl_seed)
     yy, xx = np.mgrid[0:side, 0:side].astype(np.float32) / side
-    for c in range(10):
-        fx, fy = tpl_rng.uniform(1, 4, 2)
+    for _ in range(num_classes):
+        fx, fy = tpl_rng.uniform(1, freq_hi, 2)
         px, py = tpl_rng.uniform(0, 2 * np.pi, 2)
         tpl = 0.5 + 0.5 * np.sin(2 * np.pi * fx * xx + px) * np.cos(2 * np.pi * fy * yy + py)
         templates.append(tpl.astype(np.float32))
-    labels = rng.integers(0, 10, size=num)
+    labels = rng.integers(0, num_classes, size=num)
     images = np.stack([templates[c] for c in labels])
-    images = np.clip(images + 0.25 * rng.standard_normal(images.shape).astype(np.float32), 0, 1)
-    onehot = np.eye(10, dtype=np.float32)[labels]
+    noise = rng.standard_normal(images.shape, dtype=np.float32)
+    images = np.clip(images + 0.25 * noise, 0, 1)
+    onehot = np.eye(num_classes, dtype=np.float32)[labels]
     return images.reshape(num, side * side).astype(np.float32), onehot
+
+
+def _synthetic_digits(num: int, seed: int, side: int = 28):
+    """MNIST surrogate (template seed kept stable across refactors)."""
+    return _synthetic_templates(num, 10, seed, side=side,
+                                tpl_seed=20260729, freq_hi=4)
 
 
 def load_mnist(train: bool = True, num_examples: int | None = None):
@@ -131,3 +140,75 @@ class IrisDataSetIterator(ArrayDataSetIterator):
     def __init__(self, batch_size: int = 150, num_examples: int = 150, seed: int = 7):
         x, y = load_iris(seed)
         super().__init__(x[:num_examples], y[:num_examples], batch_size=batch_size)
+
+
+# ---------------------------------------------------------------- EMNIST
+# Reference `EmnistFetcher`/`EmnistDataSetIterator` — EMNIST splits
+# extend MNIST with letters. Downloads use the NIST mirrors; offline the
+# surrogate generalizes _synthetic_digits to `num_classes` templates.
+_EMNIST_CLASSES = {"letters": 26, "digits": 10, "balanced": 47,
+                   "byclass": 62, "bymerge": 47, "mnist": 10}
+
+
+def _synthetic_classes(num: int, num_classes: int, seed: int, side: int = 28):
+    return _synthetic_templates(num, num_classes, seed, side=side,
+                                tpl_seed=20260730 + num_classes, freq_hi=5)
+
+
+def load_emnist(split: str = "balanced", train: bool = True,
+                num_examples: int | None = None):
+    """(features [N,784], one-hot labels, synthetic_flag)."""
+    if split not in _EMNIST_CLASSES:
+        raise ValueError(f"Unknown EMNIST split {split!r}: {sorted(_EMNIST_CLASSES)}")
+    nc = _EMNIST_CLASSES[split]
+    n = num_examples or (10000 if train else 2000)
+    feats, onehot = _synthetic_classes(n, nc, seed=11 if train else 12)
+    return feats, onehot, True
+
+
+class EmnistDataSetIterator(ArrayDataSetIterator):
+    """Reference `EmnistDataSetIterator(dataset, batch, train)`."""
+
+    def __init__(self, split: str = "balanced", batch_size: int = 32,
+                 train: bool = True, num_examples: int | None = None,
+                 seed: int = 123):
+        feats, labels, synthetic = load_emnist(split, train, num_examples)
+        self.is_synthetic = synthetic
+        self.num_classes = _EMNIST_CLASSES[split]
+        super().__init__(feats, labels, batch_size=batch_size,
+                         shuffle=train, seed=seed)
+
+
+# ---------------------------------------------------------------- CIFAR-10
+def load_cifar10(train: bool = True, num_examples: int | None = None):
+    """(features [N,32,32,3] in [0,1] NHWC, one-hot labels,
+    synthetic_flag). Surrogate: per-class color+texture templates."""
+    n = num_examples or (50000 if train else 10000)
+    rng = np.random.default_rng(21 if train else 22)
+    tpl_rng = np.random.default_rng(20260731)
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32) / 32
+    templates = []
+    for _ in range(10):
+        chans = []
+        for _c in range(3):
+            fx, fy = tpl_rng.uniform(0.5, 4, 2)
+            px, py = tpl_rng.uniform(0, 2 * np.pi, 2)
+            chans.append(0.5 + 0.5 * np.sin(2 * np.pi * fx * xx + px) *
+                         np.cos(2 * np.pi * fy * yy + py))
+        templates.append(np.stack(chans, -1).astype(np.float32))
+    labels = rng.integers(0, 10, size=n)
+    images = np.stack([templates[c] for c in labels])
+    noise = rng.standard_normal(images.shape, dtype=np.float32)
+    images = np.clip(images + 0.2 * noise, 0, 1)
+    return images, np.eye(10, dtype=np.float32)[labels], True
+
+
+class Cifar10DataSetIterator(ArrayDataSetIterator):
+    """Reference `CifarDataSetIterator` — NHWC [B,32,32,3] batches."""
+
+    def __init__(self, batch_size: int = 32, train: bool = True,
+                 num_examples: int | None = None, seed: int = 123):
+        feats, labels, synthetic = load_cifar10(train, num_examples)
+        self.is_synthetic = synthetic
+        super().__init__(feats, labels, batch_size=batch_size,
+                         shuffle=train, seed=seed)

@@ -94,3 +94,47 @@ class EarlyStoppingTrainer:
 # Graph models use the same trainer (the reference's
 # EarlyStoppingGraphTrainer only differs in Java generics).
 EarlyStoppingGraphTrainer = EarlyStoppingTrainer
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping over multi-device training (reference
+    `parallelism/EarlyStoppingParallelTrainer.java`, 362 LoC): each
+    epoch runs through a ParallelTrainer on the mesh instead of the
+    single-device fit; scoring/saving/termination logic is inherited."""
+
+    def __init__(self, config, model, train_data, mesh=None, *,
+                 mode: str = "sync", averaging_frequency: int = 5,
+                 batch_size: int = 32):
+        super().__init__(config, model, train_data)
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+        self._trainer = ParallelTrainer(model, mesh, mode=mode,
+                                        averaging_frequency=averaging_frequency)
+        self._batch_size = batch_size
+        # route the per-epoch fit through the parallel engine
+        self.model = _ParallelFitAdapter(model, self._trainer, batch_size)
+
+
+class _ParallelFitAdapter:
+    """Delegates everything to the wrapped model but fits via the
+    ParallelTrainer (so EarlyStoppingTrainer's loop is unchanged)."""
+
+    def __init__(self, model, trainer, batch_size):
+        self._model = model
+        self._trainer = trainer
+        self._batch_size = batch_size
+
+    def fit(self, data, epochs=1, **kw):
+        self._trainer.fit(data, epochs=epochs,
+                          batch_size=kw.get("batch_size", self._batch_size))
+        return self._model
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+    @property
+    def listeners(self):
+        return self._model.listeners
+
+    @listeners.setter
+    def listeners(self, v):
+        self._model.listeners = v

@@ -133,3 +133,57 @@ def check_model_gradients(
     finally:
         model.dtype = saved_policy
         model.net_state = saved_state
+
+
+def check_graph_gradients(
+    model,
+    inputs,
+    labels,
+    epsilon: float = 1e-6,
+    max_rel_error: float = 1e-4,
+    max_params_per_array: int = 32,
+    seed: int = 0,
+):
+    """Gradient-check a ComputationGraph on one minibatch (reference
+    `GradientCheckUtil.checkGradients(graph, ...)` overload)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if not isinstance(labels, (list, tuple)):
+        labels = [labels]
+    for name, node in model.conf.nodes.items():
+        layer = getattr(node, "layer", None)
+        if layer is None:
+            continue
+        d = layer.dropout
+        if d is not None and (not isinstance(d, (int, float)) or d < 1.0):
+            raise ValueError("Gradient checks require dropout disabled")
+    if not model._initialized:
+        model.init()
+    xs = [np.asarray(x, dtype=np.float64) for x in inputs]
+    ys = [np.asarray(y, dtype=np.float64) for y in labels]
+
+    from deeplearning4j_tpu.nd.dtype import DataTypePolicy
+
+    saved_policy = model.dtype
+    model.dtype = DataTypePolicy(param_dtype=jnp.float64,
+                                 compute_dtype=jnp.float64,
+                                 output_dtype=jnp.float64)
+    saved_state = model.net_state
+    model.net_state = jax.tree_util.tree_map(
+        lambda a: np.asarray(a, dtype=np.float64), model.net_state)
+
+    def loss_fn(p):
+        loss, _ = model._loss_fn(p, model.net_state,
+                                 [jnp.asarray(x) for x in xs],
+                                 [jnp.asarray(y) for y in ys],
+                                 None, None, None, train=False)
+        return loss
+
+    try:
+        return check_gradients_fn(loss_fn, model.params, epsilon=epsilon,
+                                  max_rel_error=max_rel_error,
+                                  max_params_per_array=max_params_per_array,
+                                  seed=seed)
+    finally:
+        model.dtype = saved_policy
+        model.net_state = saved_state

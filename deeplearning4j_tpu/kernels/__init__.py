@@ -1,0 +1,5 @@
+"""Pallas TPU kernels — custom fast paths for ops XLA doesn't fuse
+optimally (the deeplearning4j-cuda role: hand-tuned kernels behind the
+same layer API, SURVEY §2.2)."""
+
+from deeplearning4j_tpu.kernels.flash_attention import flash_attention

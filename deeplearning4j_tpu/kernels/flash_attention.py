@@ -1,0 +1,144 @@
+"""Flash attention — Pallas TPU kernel.
+
+Plays the role the cuDNN fused kernels play in the reference
+(`deeplearning4j-cuda`, SURVEY §2.2): a hand-scheduled fast path behind
+the same layer API, with the pure-XLA implementation as the reference
+path for parity tests (the `ValidateCudnnLSTM` pattern).
+
+Design (standard flash-attention blocking, sized for VMEM):
+- grid over (batch, heads, Q blocks); each program holds one Q block
+  [BQ, D] in VMEM and loops over K/V blocks with `fori_loop`,
+  maintaining the online-softmax running max m, denominator l, and
+  output accumulator in fp32.
+- matmuls ([BQ, D] x [D, BK] and [BQ, BK] x [BK, D]) hit the MXU;
+  elementwise exp/max on the VPU.
+- backward: recompute strategy (memory-efficient forward + standard
+  XLA backward) via `jax.custom_vjp` — the usual TPU trade of FLOPs
+  for HBM.
+
+Runs in Pallas interpret mode on CPU (how the tests validate parity);
+compiled mode on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      seq_len: int, causal: bool, scale: float):
+    """One (batch, head, q-block) program."""
+    q = q_ref[...].astype(jnp.float32) * scale          # [BQ, D]
+    bq = q.shape[0]
+    q_block = pl.program_id(2)
+    n_kblocks = pl.cdiv(seq_len, block_k)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BQ, BK]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        valid = k_pos < seq_len          # mask the padded tail block
+        if causal:
+            q_pos = q_block * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot(p, v)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
+
+    if causal:
+        # only K blocks up to (and including) this Q block's diagonal
+        upper = jnp.minimum(((q_block + 1) * bq + block_k - 1) // block_k,
+                            n_kblocks)
+    else:
+        upper = n_kblocks
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[...] = (acc / jnp.clip(l, 1e-20, None)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, block_q: int, block_k: int, causal: bool,
+                   interpret: bool):
+    B, T, H, D = q.shape
+    scale = 1.0 / float(np.sqrt(D))
+    # [B, T, H, D] → [B, H, T, D] for blocked layout
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    bq = min(block_q, T)
+    grid = (B, H, pl.cdiv(T, bq))
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_k=min(block_k, T),
+                          seq_len=T, causal=causal, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((pl.squeezed, pl.squeezed, bq, D),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((pl.squeezed, pl.squeezed, T, D),
+                         lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((pl.squeezed, pl.squeezed, T, D),
+                         lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((pl.squeezed, pl.squeezed, bq, D),
+                               lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def _xla_attention(q, k, v, causal):
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(D, q.dtype))
+    if causal:
+        T = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s,
+                      _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """[B, T, H, D] x3 → [B, T, H, D]. Pallas forward; recompute-based
+    XLA backward. `interpret=None` auto-selects (compiled on TPU,
+    interpret elsewhere)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, block_q=block_q, block_k=block_k,
+                          causal=causal, interpret=interpret)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # recompute backward through the XLA reference (identical math)
+    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

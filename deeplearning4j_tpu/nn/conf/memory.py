@@ -1,0 +1,100 @@
+"""Pre-training memory estimation.
+
+Reference: `nn/conf/memory/LayerMemoryReport.java` /
+`NetworkMemoryReport.java`: per-layer + whole-network estimates of
+parameter, activation, updater-state and gradient memory for a given
+minibatch size, BEFORE allocating anything.
+
+TPU adaptation: bytes are computed from the config alone (params via
+`init_params` shapes on the meta device would be exact; here analytic
+shape math), with dtype width from the dtype policy. Working/XLA
+temporary memory is not modeled (fusion makes it compile-dependent);
+the report covers the persistent arrays the framework itself owns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+@dataclasses.dataclass
+class LayerMemoryReport:
+    layer_name: str
+    layer_type: str
+    parameter_bytes: int
+    updater_state_bytes: int
+    activation_bytes_per_example: int
+
+    def total_fixed(self) -> int:
+        # params + grads (same size) + updater state
+        return 2 * self.parameter_bytes + self.updater_state_bytes
+
+
+@dataclasses.dataclass
+class NetworkMemoryReport:
+    layer_reports: List[LayerMemoryReport]
+    input_type: Optional[InputType]
+
+    def total_parameter_bytes(self) -> int:
+        return sum(r.parameter_bytes for r in self.layer_reports)
+
+    def total_fixed_bytes(self) -> int:
+        return sum(r.total_fixed() for r in self.layer_reports)
+
+    def total_activation_bytes(self, batch_size: int) -> int:
+        return batch_size * sum(r.activation_bytes_per_example
+                                for r in self.layer_reports)
+
+    def total_bytes(self, batch_size: int) -> int:
+        return self.total_fixed_bytes() + self.total_activation_bytes(batch_size)
+
+    def summary(self, batch_size: int = 32) -> str:
+        lines = [f"{'layer':<24}{'type':<22}{'params MB':>12}{'acts MB':>12}"]
+        for r in self.layer_reports:
+            lines.append(
+                f"{r.layer_name:<24}{r.layer_type:<22}"
+                f"{r.parameter_bytes / 2**20:>12.3f}"
+                f"{batch_size * r.activation_bytes_per_example / 2**20:>12.3f}")
+        lines.append(f"TOTAL (batch {batch_size}): "
+                     f"{self.total_bytes(batch_size) / 2**20:.2f} MB "
+                     f"(fixed {self.total_fixed_bytes() / 2**20:.2f} MB)")
+        return "\n".join(lines)
+
+
+def _updater_slots(updater) -> int:
+    """How many param-sized state arrays the updater keeps."""
+    name = type(updater).__name__.lower() if updater is not None else "sgd"
+    return {"sgd": 0, "noop": 0, "nesterovs": 1, "adagrad": 1, "rmsprop": 1,
+            "adadelta": 2, "adam": 2, "adamax": 2, "nadam": 2}.get(name, 2)
+
+
+def memory_report(conf, dtype_bytes: int = 4) -> NetworkMemoryReport:
+    """Build a NetworkMemoryReport from a MultiLayerConfiguration
+    (reference `MultiLayerConfiguration.getMemoryReport`)."""
+    reports = []
+    current = conf.input_type
+    for i, layer in enumerate(conf.layers):
+        # eval_shape: shape inference only, nothing is allocated
+        params = jax.eval_shape(layer.init_params, jax.random.PRNGKey(0))
+        p_bytes = int(sum(np.prod(p.shape) for p in params.values())) * dtype_bytes
+        slots = _updater_slots(layer.updater)
+        u_bytes = p_bytes * slots
+        out_type = layer.get_output_type(current) if current is not None else None
+        try:
+            act = int(out_type.arity()) * dtype_bytes if out_type is not None else 0
+        except Exception:
+            act = 0
+        reports.append(LayerMemoryReport(
+            layer_name=layer.name or str(i),
+            layer_type=type(layer).__name__,
+            parameter_bytes=p_bytes,
+            updater_state_bytes=u_bytes,
+            activation_bytes_per_example=act))
+        current = out_type
+    return NetworkMemoryReport(reports, conf.input_type)

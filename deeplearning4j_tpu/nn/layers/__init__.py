@@ -51,3 +51,4 @@ from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
 from deeplearning4j_tpu.nn.layers.training import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
 from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
+from deeplearning4j_tpu.nn.layers.moe import MixtureOfExperts

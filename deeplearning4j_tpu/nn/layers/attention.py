@@ -35,6 +35,7 @@ class MultiHeadAttention(Layer):
     causal: bool = False
     has_bias: bool = True
     attention_dropout: Optional[float] = None  # retain prob on attn weights
+    use_flash: Optional[bool] = None  # Pallas kernel; None → auto (TPU only)
 
     def __post_init__(self):
         if self.activation is None:
@@ -84,6 +85,16 @@ class MultiHeadAttention(Layer):
         q = self.heads(self._project(params, x, "Wq"))   # [B,T,H,Dh]
         k = self.heads(self._project(params, x, "Wk"))
         v = self.heads(self._project(params, x, "Wv"))
+        use_flash = self.use_flash
+        if use_flash is None:
+            use_flash = jax.default_backend() == "tpu"
+        if (use_flash and mask is None
+                and (not train or self.attention_dropout is None)):
+            # Pallas fused fast path (the cuDNN-helper role)
+            from deeplearning4j_tpu.kernels import flash_attention
+            o = flash_attention(q, k, v, self.causal)
+            o = o.reshape(x.shape[0], x.shape[1], -1)
+            return self.activation(self._project(params, o, "Wo")), state
         scale = 1.0 / jnp.sqrt(jnp.asarray(self.head_dim, x.dtype))
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
         T = x.shape[1]

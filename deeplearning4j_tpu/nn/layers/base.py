@@ -205,12 +205,13 @@ class Layer:
         """L1/L2 penalty for this layer's params (reference
         `calcL1`/`calcL2`). Weight-like params get l1/l2; bias gets
         l1_bias/l2_bias."""
+        from deeplearning4j_tpu.nn.conf.constraints import is_bias_param
         score = 0.0
         for key, value in params.items():
-            if key == "b" or key.endswith("_b") or key in ("beta",):
-                l1c, l2c = self.l1_bias, self.l2_bias
-            elif key in ("gamma", "mean", "var"):
+            if key in ("gamma", "mean", "var"):
                 continue
+            if is_bias_param(key):
+                l1c, l2c = self.l1_bias, self.l2_bias
             else:
                 l1c, l2c = self.l1, self.l2
             if l1c:

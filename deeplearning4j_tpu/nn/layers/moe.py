@@ -1,0 +1,117 @@
+"""Mixture-of-Experts layer.
+
+No reference equivalent (SURVEY §2.13: expert parallelism ❌ in the
+2017 codebase); first-class here because the mesh design reserves an
+"expert" axis. Dense dispatch formulation: router softmax over E
+experts, top-k gating renormalised, expert FFNs applied via a single
+einsum over stacked expert params — no capacity/overflow logic, so the
+whole layer is static-shape XLA. Expert parallelism = sharding the
+leading expert axis of "We1"/"We2" over the "expert" mesh axis (see
+`parallel.tensor.moe_param_specs`); GSPMD turns the einsum into
+all-to-all style collectives without changing the math.
+
+Param names: "Wg" router [F, E]; experts "We1" [E, F, H], "be1" [E, H],
+"We2" [E, H, F], "be2" [E, F].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType, InputTypeRecurrent
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class MixtureOfExperts(Layer):
+    layer_name = "mixture_of_experts"
+
+    n_in: int = 0
+    n_out: int = 0          # defaults to n_in
+    n_experts: int = 4
+    hidden_size: int = 0    # expert FFN hidden dim (defaults to 4*n_in)
+    top_k: int = 2
+    load_balance_coef: float = 0.01
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "relu"  # expert hidden activation
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        size = input_type.size if isinstance(input_type, InputTypeRecurrent) \
+            else input_type.arity()
+        if override or not self.n_in:
+            self.n_in = size
+        if not self.n_out:
+            self.n_out = self.n_in
+        if not self.hidden_size:
+            self.hidden_size = 4 * self.n_in
+
+    def get_output_type(self, input_type):
+        if isinstance(input_type, InputTypeRecurrent):
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, rng, dtype=jnp.float32):
+        E, F, H, O = self.n_experts, self.n_in, self.hidden_size, self.n_out
+        ks = jax.random.split(rng, 3)
+        we1 = jnp.stack([init_weights(jax.random.fold_in(ks[1], e), (F, H),
+                                      self.weight_init, fan_in=F, fan_out=H,
+                                      distribution=self.dist, dtype=dtype)
+                         for e in range(E)])
+        we2 = jnp.stack([init_weights(jax.random.fold_in(ks[2], e), (H, O),
+                                      self.weight_init, fan_in=H, fan_out=O,
+                                      distribution=self.dist, dtype=dtype)
+                         for e in range(E)])
+        return {
+            "Wg": init_weights(ks[0], (F, E), self.weight_init, fan_in=F,
+                               fan_out=E, distribution=self.dist, dtype=dtype),
+            "We1": we1, "be1": jnp.zeros((E, H), dtype),
+            "We2": we2, "be2": jnp.zeros((E, O), dtype),
+        }
+
+    def _gate(self, params, x):
+        """Top-k renormalised gates [..., E] + load-balance aux loss."""
+        logits = x @ params["Wg"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        if self.top_k < self.n_experts:
+            kth = jnp.sort(probs, axis=-1)[..., -self.top_k][..., None]
+            gates = jnp.where(probs >= kth, probs, 0.0)
+            gates = gates / jnp.clip(jnp.sum(gates, axis=-1, keepdims=True),
+                                     1e-9, None)
+        else:
+            gates = probs
+        # Switch-style load balance: E * sum_e fraction_e * prob_e
+        flat = probs.reshape(-1, self.n_experts)
+        frac = jnp.mean((gates.reshape(-1, self.n_experts) > 0).astype(x.dtype),
+                        axis=0)
+        aux = self.n_experts * jnp.sum(frac * jnp.mean(flat, axis=0))
+        return gates, aux
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        gates, aux = self._gate(params, x)                 # [..., E]
+        # all experts on all tokens (dense dispatch), combine by gate
+        h = self.activation(jnp.einsum("...f,efh->...eh", x, params["We1"])
+                            + params["be1"])
+        y = jnp.einsum("...eh,eho->...eo", h, params["We2"]) + params["be2"]
+        out = jnp.einsum("...eo,...e->...o", y, gates)
+        if train:
+            # stash the aux loss for the container's regularization hook
+            self._last_aux = aux
+        return out, state
+
+    def regularization_score(self, params):
+        base = super().regularization_score(params)
+        aux = getattr(self, "_last_aux", None)
+        if aux is not None and self.load_balance_coef:
+            base = base + self.load_balance_coef * aux
+            self._last_aux = None
+        return base

@@ -262,7 +262,8 @@ class MultiLayerNetwork:
                 listeners.iteration_done(self, self.iteration_count, self.epoch_count,
                                          self.score_value,
                                          batch_size=int(np.shape(ds.features)[0]),
-                                         etl_ms=etl_ms)
+                                         etl_ms=etl_ms,
+                                         batch=(x, y, fmask, lmask))
                 self.iteration_count += 1
                 etl_start = time.perf_counter()
             listeners.on_epoch_end(self, self.epoch_count)

@@ -162,3 +162,61 @@ class ComposedListeners(TrainingListener):
     def on_fit_end(self, *a, **k):
         for l in self.listeners:
             l.on_fit_end(*a, **k)
+
+
+class SleepyTrainingListener(TrainingListener):
+    """Artificial delays per training phase for debugging schedulers —
+    "not for production" (reference
+    `optimize/listeners/SleepyTrainingListener.java`)."""
+
+    def __init__(self, timer_iteration_ms: float = 0.0,
+                 timer_epoch_ms: float = 0.0):
+        self.timer_iteration_ms = timer_iteration_ms
+        self.timer_epoch_ms = timer_epoch_ms
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        if self.timer_iteration_ms > 0:
+            time.sleep(self.timer_iteration_ms / 1e3)
+
+    def on_epoch_end(self, model, epoch):
+        if self.timer_epoch_ms > 0:
+            time.sleep(self.timer_epoch_ms / 1e3)
+
+
+class ParamAndGradientIterationListener(TrainingListener):
+    """Per-iteration param AND gradient magnitude summaries (reference
+    `ParamAndGradientIterationListener.java`). Gradients are recomputed
+    from the iteration's batch (passed via `info["batch"]`) only on
+    print iterations — off-cadence iterations pay nothing."""
+
+    def __init__(self, print_iterations: int = 1, printer=None,
+                 print_gradients: bool = True):
+        import numpy as _np
+        self._np = _np
+        self.print_iterations = max(1, print_iterations)
+        self.print_gradients = print_gradients
+        self.printer = printer or (lambda s: log.info(s))
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        if iteration % self.print_iterations != 0:
+            return
+        np = self._np
+        grads = None
+        batch = info.get("batch")
+        if self.print_gradients and batch is not None:
+            import jax as _jax
+            x, y, fmask, lmask = batch
+            grads = _jax.grad(
+                lambda p: model._loss_fn(p, model.net_state, x, y, None,
+                                         fmask, lmask, train=False)[0]
+            )(model.params)
+        parts = [f"iter {iteration} score {score:.6g}"]
+        for lk, lparams in model.params.items():
+            for pn, arr in lparams.items():
+                a = np.asarray(arr)
+                msg = f"{lk}_{pn}: |p|={np.abs(a).mean():.4g}"
+                if grads is not None:
+                    g = np.asarray(grads[lk][pn])
+                    msg += f" |g|={np.abs(g).mean():.4g}"
+                parts.append(msg)
+        self.printer(" | ".join(parts))

@@ -24,7 +24,17 @@ from deeplearning4j_tpu.parallel.ring import (
     ring_attention,
     sequence_parallel_attention,
 )
-from deeplearning4j_tpu.parallel.tensor import ShardedParallelTrainer, tp_param_specs
+from deeplearning4j_tpu.parallel.tensor import (
+    ShardedParallelTrainer,
+    moe_param_specs,
+    tp_param_specs,
+)
+from deeplearning4j_tpu.parallel.pipeline import pipeline_apply, pipeline_forward
+from deeplearning4j_tpu.parallel.master import (
+    ParameterAveragingTrainingMaster,
+    SharedTrainingMaster,
+    TrainingMaster,
+)
 from deeplearning4j_tpu.parallel.multihost import (
     initialize_multihost,
     is_main_process,

@@ -1,0 +1,89 @@
+"""TrainingMaster — the multi-node training strategy surface.
+
+Reference: `spark/api/TrainingMaster.java:28` with its two
+implementations, `ParameterAveragingTrainingMaster.java` (sync rounds:
+split data, fit locally, tree-aggregate + average params each round)
+and `SharedTrainingMaster.java` (Aeron parameter server streaming
+threshold-compressed updates).
+
+TPU mapping: both collapse onto mesh programs (SURVEY §2.13 / §5):
+- ParameterAveragingTrainingMaster → local-SGD mode: k local steps per
+  replica, then `pmean` over the data axis — `averaging_frequency` is
+  the reference's same-named knob (and `batch_size_per_worker` its
+  `batchSizePerWorker`).
+- SharedTrainingMaster → per-step synchronous gradient all-reduce
+  (ICI bandwidth removes the need for the threshold compression the
+  Aeron design required; the knobs that configured compression are
+  accepted and ignored with a log note, so reference configs port).
+
+Multi-host: call `parallel.initialize_multihost()` first; the mesh then
+spans all hosts and the same masters drive DCN-wide training — the
+Spark driver/executor split disappears into SPMD.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from deeplearning4j_tpu.parallel.mesh import device_mesh
+from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+log = logging.getLogger(__name__)
+
+
+class TrainingMaster:
+    """`executeTraining(model, data)` contract. `data` is anything the
+    trainers accept: a DataSetIterator, a DataSet, or an (x, y) pair."""
+
+    def execute_training(self, model, data, *, epochs: int = 1):
+        raise NotImplementedError
+
+    @staticmethod
+    def _split(data):
+        if (isinstance(data, tuple) and len(data) == 2
+                and not hasattr(data[0], "features")):
+            return data[0], data[1]
+        return data, None
+
+
+class ParameterAveragingTrainingMaster(TrainingMaster):
+    def __init__(self, *, batch_size_per_worker: int = 32,
+                 averaging_frequency: int = 5,
+                 average_updater_state: bool = True, mesh=None):
+        self.batch_size_per_worker = batch_size_per_worker
+        self.averaging_frequency = averaging_frequency
+        self.average_updater_state = average_updater_state
+        self.mesh = mesh
+
+    def execute_training(self, model, data, *, epochs: int = 1):
+        mesh = self.mesh or device_mesh()
+        n_workers = mesh.shape["data"]
+        trainer = ParallelTrainer(
+            model, mesh, mode="averaging",
+            averaging_frequency=self.averaging_frequency,
+            average_updater_state=self.average_updater_state)
+        x, y = self._split(data)
+        return trainer.fit(x, y, epochs=epochs,
+                           batch_size=self.batch_size_per_worker * n_workers)
+
+
+class SharedTrainingMaster(TrainingMaster):
+    def __init__(self, *, batch_size_per_worker: int = 32, mesh=None,
+                 threshold: Optional[float] = None, **compression_knobs):
+        self.batch_size_per_worker = batch_size_per_worker
+        self.mesh = mesh
+        if threshold is not None or compression_knobs:
+            log.info(
+                "SharedTrainingMaster: threshold-compression knobs %s are "
+                "accepted for config compatibility but unused — synchronous "
+                "all-reduce over ICI/DCN replaces the compressed Aeron path",
+                {"threshold": threshold, **compression_knobs})
+
+    def execute_training(self, model, data, *, epochs: int = 1):
+        mesh = self.mesh or device_mesh()
+        n_workers = mesh.shape["data"]
+        trainer = ParallelTrainer(model, mesh, mode="sync")
+        x, y = self._split(data)
+        return trainer.fit(x, y, epochs=epochs,
+                           batch_size=self.batch_size_per_worker * n_workers)

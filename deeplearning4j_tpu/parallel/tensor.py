@@ -48,6 +48,32 @@ def tp_param_specs(model, model_axis: str = "model",
     return specs
 
 
+def moe_param_specs(model, expert_axis: str = "expert",
+                    model_axis: Optional[str] = None) -> Dict:
+    """Expert parallelism: MixtureOfExperts params get their leading
+    expert axis sharded over `expert_axis`; other params replicated (or
+    TP-sharded over `model_axis` when given). GSPMD inserts the
+    dispatch/combine collectives."""
+    specs: Dict[str, Dict] = {}
+    for lk, lparams in model.params.items():
+        layer = model.layers[int(lk)]
+        lspec = {}
+        is_moe = layer.layer_name == "mixture_of_experts"
+        for pn, arr in lparams.items():
+            if is_moe and pn.startswith(("We", "be")):
+                lspec[pn] = P(*([expert_axis] + [None] * (np.ndim(arr) - 1)))
+            else:
+                lspec[pn] = P()
+        specs[lk] = lspec
+    if model_axis is not None:
+        tp = tp_param_specs(model, model_axis)
+        for lk in specs:
+            for pn in specs[lk]:
+                if specs[lk][pn] == P():
+                    specs[lk][pn] = tp[lk][pn]
+    return specs
+
+
 class ShardedParallelTrainer:
     """DP x TP training: batch sharded over `data_axis`, params sharded
     by `tp_param_specs` over `model_axis`; XLA inserts all collectives

@@ -1,0 +1,18 @@
+"""NDArray streaming (reference: dl4j-streaming — Kafka+Camel NDArray
+pub/sub + CSV→DataSet conversion, `streaming/kafka/NDArrayKafkaClient.java`).
+
+The transport is pluggable: `LocalQueueTransport` is the in-process
+implementation (and the test double); `KafkaTransport` gates on the
+optional kafka-python dependency, which is not bundled in this image —
+the wire format (ndarray → bytes) is transport-independent.
+"""
+
+from deeplearning4j_tpu.streaming.ndarray import (
+    KafkaTransport,
+    LocalQueueTransport,
+    NDArrayConsumer,
+    NDArrayPublisher,
+    deserialize_ndarray,
+    serialize_ndarray,
+)
+from deeplearning4j_tpu.streaming.records import csv_to_dataset

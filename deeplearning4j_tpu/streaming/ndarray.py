@@ -1,0 +1,114 @@
+"""NDArray pub/sub.
+
+Reference: `streaming/kafka/NDArrayKafkaClient.java` +
+`NDArrayPublisher`/`NDArrayConsumer` (Camel routes). Wire format here:
+little-endian header (magic, dtype code, ndim, dims) + raw buffer —
+transport-independent, so the local queue and Kafka carry identical
+bytes.
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+from typing import Dict, Optional
+
+import numpy as np
+
+_MAGIC = b"ND4T"
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def serialize_ndarray(arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    code = _DTYPE_CODES.get(arr.dtype)
+    if code is None:
+        raise TypeError(f"Unsupported dtype {arr.dtype}")
+    header = _MAGIC + struct.pack("<BB", code, arr.ndim)
+    header += struct.pack(f"<{arr.ndim}q", *arr.shape)
+    return header + arr.tobytes()
+
+
+def deserialize_ndarray(data: bytes) -> np.ndarray:
+    if data[:4] != _MAGIC:
+        raise ValueError("Not an ND4T payload (bad magic)")
+    code, ndim = struct.unpack_from("<BB", data, 4)
+    dims = struct.unpack_from(f"<{ndim}q", data, 6)
+    off = 6 + 8 * ndim
+    return np.frombuffer(data, _DTYPES[code], int(np.prod(dims)),
+                         off).reshape(dims).copy()
+
+
+class Transport:
+    def send(self, topic: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def receive(self, topic: str, timeout: Optional[float] = None) -> bytes:
+        raise NotImplementedError
+
+
+class LocalQueueTransport(Transport):
+    """In-process transport (test double for the Kafka/Camel route)."""
+
+    def __init__(self):
+        self._queues: Dict[str, queue.Queue] = {}
+
+    def _q(self, topic):
+        return self._queues.setdefault(topic, queue.Queue())
+
+    def send(self, topic, payload):
+        self._q(topic).put(payload)
+
+    def receive(self, topic, timeout=None):
+        return self._q(topic).get(timeout=timeout)
+
+
+class KafkaTransport(Transport):
+    """Kafka-backed transport; requires kafka-python (not bundled)."""
+
+    def __init__(self, bootstrap_servers: str):
+        try:
+            from kafka import KafkaConsumer, KafkaProducer  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "KafkaTransport needs the kafka-python package; install it "
+                "or use LocalQueueTransport") from e
+        from kafka import KafkaProducer
+        self._producer = KafkaProducer(bootstrap_servers=bootstrap_servers)
+        self._bootstrap = bootstrap_servers
+        self._consumers: Dict[str, object] = {}
+
+    def send(self, topic, payload):
+        self._producer.send(topic, payload)
+        self._producer.flush()
+
+    def receive(self, topic, timeout=None):
+        from kafka import KafkaConsumer
+        if topic not in self._consumers:
+            self._consumers[topic] = KafkaConsumer(
+                topic, bootstrap_servers=self._bootstrap,
+                auto_offset_reset="earliest")
+        ms = int((timeout or 10) * 1000)
+        batch = self._consumers[topic].poll(timeout_ms=ms, max_records=1)
+        for records in batch.values():
+            return records[0].value
+        raise TimeoutError(f"No message on {topic}")
+
+
+class NDArrayPublisher:
+    def __init__(self, transport: Transport, topic: str):
+        self.transport = transport
+        self.topic = topic
+
+    def publish(self, arr: np.ndarray):
+        self.transport.send(self.topic, serialize_ndarray(arr))
+
+
+class NDArrayConsumer:
+    def __init__(self, transport: Transport, topic: str):
+        self.transport = transport
+        self.topic = topic
+
+    def consume(self, timeout: Optional[float] = None) -> np.ndarray:
+        return deserialize_ndarray(self.transport.receive(self.topic, timeout))

@@ -1,0 +1,31 @@
+"""CSV → DataSet conversion for streams (reference dl4j-streaming's
+Camel CSV route feeding DataSets)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+def csv_to_dataset(lines, label_index: int = -1,
+                   num_classes: Optional[int] = None,
+                   delimiter: str = ",") -> DataSet:
+    feats, labels = [], []
+    for line in lines:
+        if not line.strip():
+            continue
+        vals = [float(p) for p in line.strip().split(delimiter)]
+        li = label_index if label_index >= 0 else len(vals) - 1
+        label = vals[li]
+        feats.append([v for i, v in enumerate(vals) if i != li])
+        if num_classes:
+            oh = np.zeros(num_classes, np.float32)
+            oh[int(label)] = 1.0
+            labels.append(oh)
+        else:
+            labels.append([label])
+    return DataSet(np.asarray(feats, np.float32),
+                   np.asarray(labels, np.float32))

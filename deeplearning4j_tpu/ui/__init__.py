@@ -9,3 +9,4 @@ from deeplearning4j_tpu.ui.storage import (
     StatsStorage,
 )
 from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.convolutional import ConvolutionalIterationListener

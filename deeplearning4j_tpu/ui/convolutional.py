@@ -1,0 +1,62 @@
+"""ConvolutionalIterationListener — render conv activations as image
+grids.
+
+Reference: `ui/ConvolutionalIterationListener.java` (621 LoC): every N
+iterations, run the current minibatch's first example through the
+network and save each convolutional layer's activation channels as one
+tiled grayscale image.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+def activations_to_grid(act: np.ndarray, pad: int = 1) -> np.ndarray:
+    """[H, W, C] activations → one tiled uint8 grayscale image."""
+    h, w, c = act.shape
+    cols = int(np.ceil(np.sqrt(c)))
+    rows = int(np.ceil(c / cols))
+    grid = np.zeros((rows * (h + pad) - pad, cols * (w + pad) - pad),
+                    np.float32)
+    for i in range(c):
+        r, col = divmod(i, cols)
+        ch = act[:, :, i]
+        lo, hi = float(ch.min()), float(ch.max())
+        norm = (ch - lo) / (hi - lo) if hi > lo else np.zeros_like(ch)
+        grid[r * (h + pad):r * (h + pad) + h,
+             col * (w + pad):col * (w + pad) + w] = norm
+    return (grid * 255).astype(np.uint8)
+
+
+class ConvolutionalIterationListener(TrainingListener):
+    def __init__(self, output_dir, frequency: int = 10):
+        self.output_dir = Path(output_dir)
+        self.output_dir.mkdir(parents=True, exist_ok=True)
+        self.frequency = max(1, frequency)
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        if iteration % self.frequency != 0:
+            return
+        batch = info.get("batch")
+        if batch is None:
+            return
+        x = np.asarray(batch[0])[:1]  # first example only
+        try:
+            h, _, _, acts, _ = model._forward_core(
+                model.params, model.net_state, x, train=False, rng=None,
+                collect=True)
+        except Exception:
+            return
+        from PIL import Image
+        for li, act in enumerate(acts):
+            a = np.asarray(act)
+            if a.ndim != 4:  # NHWC conv activations only
+                continue
+            grid = activations_to_grid(a[0])
+            Image.fromarray(grid).save(
+                self.output_dir / f"iter{iteration:06d}_layer{li}.png")

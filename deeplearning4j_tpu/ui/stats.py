@@ -70,7 +70,8 @@ class StatsReport:
 
     @staticmethod
     def decode(data: bytes) -> "StatsReport":
-        assert data[:8] == _MAGIC, "bad magic"
+        if data[:8] != _MAGIC:
+            raise ValueError("Not a DL4JSTAT payload (bad magic)")
         pos = [10]
 
         def unpack_str() -> str:

@@ -1,0 +1,28 @@
+"""ModelGuesser — sniff a file and load the right model type.
+
+Reference: `deeplearning4j-core/util/ModelGuesser.java` (194 LoC):
+tries MultiLayerNetwork / ComputationGraph checkpoint formats, then
+Keras .h5.
+"""
+
+from __future__ import annotations
+
+import zipfile
+from pathlib import Path
+
+
+class ModelGuesser:
+    @staticmethod
+    def load_model_guess(path):
+        path = Path(path)
+        if zipfile.is_zipfile(path):
+            from deeplearning4j_tpu.util.serializer import ModelSerializer
+            return ModelSerializer.restore_model(path)
+        # HDF5 magic: \x89HDF\r\n\x1a\n
+        with open(path, "rb") as f:
+            magic = f.read(8)
+        if magic == b"\x89HDF\r\n\x1a\n":
+            from deeplearning4j_tpu.modelimport import KerasModelImport
+            return KerasModelImport.import_keras_model_and_weights(path)
+        raise ValueError(
+            f"{path}: not a framework checkpoint (zip) or Keras HDF5 file")

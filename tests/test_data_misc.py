@@ -1,0 +1,216 @@
+"""Tests: record readers, new fetchers, memory reports, ModelGuesser,
+new listeners."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.updaters import Adam, Nesterovs
+from deeplearning4j_tpu.datasets.fetchers import (
+    Cifar10DataSetIterator,
+    EmnistDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.records import (
+    CollectionRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.memory import memory_report
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import (
+    ParamAndGradientIterationListener,
+    SleepyTrainingListener,
+)
+from deeplearning4j_tpu.util.guesser import ModelGuesser
+from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+
+class TestRecordReaders:
+    def test_csv_reader_and_iterator(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n")
+        reader = CSVRecordReader(p)
+        it = RecordReaderDataSetIterator(reader, batch_size=2,
+                                         label_index=-1, num_classes=3)
+        ds = next(iter(it))
+        np.testing.assert_array_equal(ds.features, [[1, 2], [3, 4]])
+        np.testing.assert_array_equal(ds.labels, [[1, 0, 0], [0, 1, 0]])
+        ds2 = it.next()
+        assert ds2.features.shape == (1, 2)
+
+    def test_regression_mode(self):
+        reader = CollectionRecordReader([[1.0, 2.0, 0.5], [3.0, 4.0, 1.5]])
+        it = RecordReaderDataSetIterator(reader, 2, label_index=-1,
+                                         regression=True)
+        ds = it.next()
+        np.testing.assert_array_equal(ds.labels, [[0.5], [1.5]])
+
+    def test_sequence_reader_with_masking(self, tmp_path):
+        # two sequences, lengths 3 and 2, label column last
+        s1 = tmp_path / "s1.csv"
+        s1.write_text("0.1,0.2,0\n0.3,0.4,1\n0.5,0.6,0\n")
+        s2 = tmp_path / "s2.csv"
+        s2.write_text("0.7,0.8,1\n0.9,1.0,1\n")
+        reader = CSVSequenceRecordReader([s1, s2])
+        it = SequenceRecordReaderDataSetIterator(reader, None, batch_size=2,
+                                                 num_classes=2)
+        ds = it.next()
+        assert ds.features.shape == (2, 3, 2)
+        assert ds.labels.shape == (2, 3, 2)
+        np.testing.assert_array_equal(ds.features_mask, [[1, 1, 1], [1, 1, 0]])
+        assert ds.labels[0, 1, 1] == 1.0  # t=1 label 1 one-hot
+
+
+class TestFetchers:
+    def test_emnist_letters(self):
+        it = EmnistDataSetIterator("letters", 16, num_examples=64)
+        ds = next(iter(it))
+        assert ds.features.shape == (16, 784)
+        assert ds.labels.shape == (16, 26)
+
+    def test_cifar10_nhwc(self):
+        it = Cifar10DataSetIterator(8, num_examples=32)
+        ds = next(iter(it))
+        assert ds.features.shape == (8, 32, 32, 3)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+
+    def test_unknown_emnist_split_raises(self):
+        with pytest.raises(ValueError):
+            EmnistDataSetIterator("nope", 8)
+
+
+class TestMemoryReport:
+    def test_lenet_style_report(self):
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5)))
+                .layer(DenseLayer(n_out=100, activation="relu"))
+                .layer(OutputLayer(n_out=10))
+                .set_input_type(InputType.convolutional(28, 28, 1)).build())
+        report = memory_report(conf)
+        assert len(report.layer_reports) == 3
+        # conv params: 5*5*1*20 + 20 = 520 floats
+        assert report.layer_reports[0].parameter_bytes == 520 * 4
+        # Adam keeps 2 param-sized slots
+        assert report.layer_reports[0].updater_state_bytes == 2 * 520 * 4
+        assert report.total_bytes(32) > report.total_fixed_bytes()
+        assert "TOTAL" in report.summary(32)
+
+    def test_sgd_has_no_updater_state(self):
+        from deeplearning4j_tpu.common.updaters import Sgd
+        conf = (NeuralNetConfiguration.builder().updater(Sgd(0.1)).list()
+                .layer(DenseLayer(n_in=4, n_out=8))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+        report = memory_report(conf)
+        assert all(r.updater_state_bytes == 0 for r in report.layer_reports)
+
+
+class TestModelGuesser:
+    def test_guesses_checkpoint_and_keras(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        ckpt = tmp_path / "model.zip"
+        ModelSerializer.write_model(net, ckpt)
+        loaded = ModelGuesser.load_model_guess(ckpt)
+        assert isinstance(loaded, MultiLayerNetwork)
+
+        # Keras h5 path
+        import json
+        from deeplearning4j_tpu.modelimport import Hdf5Archive
+        h5p = tmp_path / "m.h5"
+        config = {"class_name": "Sequential", "config": [
+            {"class_name": "Dense", "config": {
+                "name": "d", "units": 3, "activation": "relu",
+                "use_bias": True, "batch_input_shape": [None, 4]}}]}
+        with Hdf5Archive(h5p, "w") as h5:
+            h5.write_attr_string("model_config", json.dumps(config))
+        guessed = ModelGuesser.load_model_guess(h5p)
+        assert isinstance(guessed, MultiLayerNetwork)
+
+        bad = tmp_path / "junk.bin"
+        bad.write_bytes(b"garbage")
+        with pytest.raises(ValueError):
+            ModelGuesser.load_model_guess(bad)
+
+
+class TestNewListeners:
+    def test_sleepy_and_param_listeners(self):
+        lines = []
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init().set_listeners(
+            SleepyTrainingListener(timer_iteration_ms=1),
+            ParamAndGradientIterationListener(printer=lines.append))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        net.fit(x, y, epochs=2, batch_size=8)
+        assert len(lines) == 2
+        assert "0_W" in lines[0]
+
+
+class TestEvalTools:
+    def test_roc_html_export(self, tmp_path):
+        from deeplearning4j_tpu.eval import ROC
+        from deeplearning4j_tpu.eval.tools import EvaluationTools
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 2, 200)
+        probs = np.clip(labels * 0.6 + rng.random(200) * 0.5, 0, 1)
+        roc = ROC()
+        roc.eval(np.eye(2)[labels], np.stack([1 - probs, probs], 1))
+        html = EvaluationTools.roc_chart_html(roc)
+        assert "AUC" in html and "<svg" in html
+        out = tmp_path / "roc.html"
+        EvaluationTools.export_roc_charts_to_html_file(roc, out)
+        assert out.read_text().startswith("<!doctype html>")
+
+    def test_calibration_html(self):
+        from deeplearning4j_tpu.eval import EvaluationCalibration
+        from deeplearning4j_tpu.eval.tools import EvaluationTools
+        rng = np.random.default_rng(1)
+        labels = np.eye(2)[rng.integers(0, 2, 100)]
+        preds = rng.dirichlet((1, 1), 100)
+        cal = EvaluationCalibration()
+        cal.eval(labels, preds)
+        html = EvaluationTools.calibration_chart_html(cal, 2)
+        assert "reliability" in html
+
+
+class TestGraphGradientCheck:
+    def test_small_graph_passes(self):
+        from deeplearning4j_tpu.gradientcheck import check_graph_gradients
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        b = NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+        g = ComputationGraphConfiguration.graph_builder(b)
+        g.add_inputs("in")
+        g.set_input_types(InputType.feed_forward(5))
+        g.add_layer("a", DenseLayer(n_out=7, activation="tanh"), "in")
+        g.add_layer("b", DenseLayer(n_out=7, activation="sigmoid"), "in")
+        g.add_vertex("m", MergeVertex(), "a", "b")
+        g.add_layer("out", OutputLayer(n_out=3), "m")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 5))
+        y = np.eye(3)[rng.integers(0, 3, 4)]
+        ok, worst, fails = check_graph_gradients(net, x, y)
+        assert ok, f"worst {worst}: {fails[:3]}"

@@ -1,0 +1,136 @@
+"""MoE (expert parallelism) + pipeline parallelism tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, MixtureOfExperts, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import (
+    MeshSpec,
+    ShardedParallelTrainer,
+    make_mesh,
+    moe_param_specs,
+    pipeline_forward,
+)
+
+requires_8dev = pytest.mark.skipif(len(jax.devices()) < 8,
+                                   reason="needs 8 virtual devices")
+
+
+class TestMoE:
+    def _conf(self, top_k=2):
+        return (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(MixtureOfExperts(n_experts=4, hidden_size=16,
+                                        top_k=top_k))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(8)).build())
+
+    def test_param_shapes(self):
+        net = MultiLayerNetwork(self._conf()).init()
+        p = net.params["0"]
+        assert p["Wg"].shape == (8, 4)
+        assert p["We1"].shape == (4, 8, 16)
+        assert p["We2"].shape == (4, 16, 8)
+
+    def test_gates_renormalised_topk(self):
+        layer = MixtureOfExperts(n_in=8, n_out=8, n_experts=4, hidden_size=8,
+                                 top_k=2)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (5, 8))
+        gates, aux = layer._gate(params, x)
+        g = np.asarray(gates)
+        assert ((g > 0).sum(axis=-1) <= 2).all()
+        np.testing.assert_allclose(g.sum(axis=-1), 1.0, rtol=1e-5)
+        assert np.isfinite(float(aux))
+
+    def test_training_decreases_loss(self):
+        net = MultiLayerNetwork(self._conf()).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        s0 = float(net.score(DataSet(x, y)))
+        net.fit(x, y, epochs=20, batch_size=64)
+        assert float(net.score(DataSet(x, y))) < s0
+
+    @requires_8dev
+    def test_expert_parallel_training(self):
+        net = MultiLayerNetwork(self._conf()).init()
+        mesh = make_mesh(MeshSpec.of(data=2, expert=4))
+        specs = moe_param_specs(net, "expert")
+        assert specs["0"]["We1"] == P("expert", None, None)
+        assert specs["0"]["Wg"] == P()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((64, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        s0 = float(net.score(DataSet(x, y)))
+        ShardedParallelTrainer(net, mesh, param_specs=specs).fit(
+            x, y, epochs=5, batch_size=64)
+        assert float(net.score(DataSet(x, y))) < s0
+
+
+class TestPipeline:
+    def _block(self, params, x):
+        return jnp.tanh(x @ params["W"] + params["b"])
+
+    def _stacked_params(self, S, F, seed=0):
+        rng = np.random.default_rng(seed)
+        return {
+            "W": jnp.asarray(rng.standard_normal((S, F, F)) * 0.3, jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((S, F)) * 0.1, jnp.float32),
+        }
+
+    def _sequential(self, params, x, S):
+        for s in range(S):
+            x = self._block(jax.tree_util.tree_map(lambda a: a[s], params), x)
+        return x
+
+    @requires_8dev
+    @pytest.mark.parametrize("S", [2, 4, 8])
+    def test_matches_sequential(self, S):
+        F = 6
+        params = self._stacked_params(S, F)
+        mesh = make_mesh(MeshSpec.of(pipe=S))
+        x = jnp.asarray(np.random.default_rng(1).standard_normal((8, F)),
+                        jnp.float32)
+        got = pipeline_forward(self._block, params, x, mesh, microbatches=4)
+        want = self._sequential(params, x, S)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    @requires_8dev
+    def test_differentiable_and_trains(self):
+        S, F = 4, 6
+        params = self._stacked_params(S, F)
+        mesh = make_mesh(MeshSpec.of(pipe=S))
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((8, F)), jnp.float32)
+        target = jnp.asarray(rng.standard_normal((8, F)), jnp.float32)
+
+        def loss(p):
+            out = pipeline_forward(self._block, p, x, mesh, microbatches=4)
+            return jnp.mean((out - target) ** 2)
+
+        # gradient parity with the sequential computation
+        def loss_seq(p):
+            return jnp.mean((self._sequential(p, x, S) - target) ** 2)
+
+        g_pipe = jax.grad(loss)(params)
+        g_seq = jax.grad(loss_seq)(params)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(g_pipe[k]),
+                                       np.asarray(g_seq[k]),
+                                       rtol=1e-4, atol=1e-6)
+        # a few SGD steps reduce the loss
+        l0 = float(loss(params))
+        for _ in range(10):
+            g = jax.grad(loss)(params)
+            params = jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg,
+                                            params, g)
+        assert float(loss(params)) < l0

@@ -162,3 +162,58 @@ class TestTensorParallel:
                 np.testing.assert_allclose(
                     np.asarray(single.params[lk][pn]),
                     np.asarray(sharded.params[lk][pn]), rtol=1e-4, atol=1e-5)
+
+
+@requires_8dev
+def test_early_stopping_parallel_trainer():
+    from deeplearning4j_tpu.earlystopping.config import EarlyStoppingConfiguration
+    from deeplearning4j_tpu.earlystopping.conditions import MaxEpochsTerminationCondition
+    from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingParallelTrainer
+    from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_in=6, n_out=12, activation="relu"))
+            .layer(OutputLayer(n_in=12, n_out=2))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+    es_conf = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)])
+    mesh = make_mesh(MeshSpec.of(data=4))
+    trainer = EarlyStoppingParallelTrainer(
+        es_conf, net, ArrayDataSetIterator(x, y, batch_size=32),
+        mesh=mesh, batch_size=32)
+    result = trainer.fit()
+    assert result.total_epochs == 3  # MaxEpochs(3)
+    assert np.isfinite(result.best_model_score)
+
+
+@requires_8dev
+def test_training_masters():
+    from deeplearning4j_tpu.parallel import (
+        ParameterAveragingTrainingMaster, SharedTrainingMaster)
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=6, n_out=12, activation="relu"))
+                .layer(OutputLayer(n_in=12, n_out=2))
+                .set_input_type(InputType.feed_forward(6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((128, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 128)]
+    mesh = make_mesh(MeshSpec.of(data=4))
+
+    for master in (ParameterAveragingTrainingMaster(
+                       batch_size_per_worker=8, averaging_frequency=2,
+                       mesh=mesh),
+                   SharedTrainingMaster(batch_size_per_worker=8, mesh=mesh,
+                                        threshold=1e-3)):
+        net = build()
+        s0 = float(net.score(DataSet(x, y)))
+        master.execute_training(net, (x, y), epochs=4)
+        assert float(net.score(DataSet(x, y))) < s0, type(master).__name__

@@ -1,0 +1,63 @@
+"""Streaming + cloud adapter tests (local transports / injected fetch)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.aws import S3DataSetIterator
+from deeplearning4j_tpu.streaming import (
+    LocalQueueTransport,
+    NDArrayConsumer,
+    NDArrayPublisher,
+    csv_to_dataset,
+    deserialize_ndarray,
+    serialize_ndarray,
+)
+
+
+class TestNDArrayWire:
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32, np.int64])
+    def test_roundtrip(self, dtype):
+        arr = (np.random.default_rng(0).standard_normal((3, 4, 5)) * 10).astype(dtype)
+        back = deserialize_ndarray(serialize_ndarray(arr))
+        np.testing.assert_array_equal(arr, back)
+        assert back.dtype == dtype
+
+    def test_pub_sub(self):
+        transport = LocalQueueTransport()
+        pub = NDArrayPublisher(transport, "grads")
+        sub = NDArrayConsumer(transport, "grads")
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        pub.publish(arr)
+        np.testing.assert_array_equal(sub.consume(timeout=1), arr)
+
+    def test_kafka_gated(self):
+        from deeplearning4j_tpu.streaming import KafkaTransport
+        with pytest.raises(ImportError, match="kafka"):
+            KafkaTransport("localhost:9092")
+
+
+def test_csv_to_dataset():
+    ds = csv_to_dataset(["1,2,0", "3,4,1"], num_classes=2)
+    np.testing.assert_array_equal(ds.features, [[1, 2], [3, 4]])
+    np.testing.assert_array_equal(ds.labels, [[1, 0], [0, 1]])
+
+
+class TestS3:
+    def test_iterator_with_injected_fetch(self):
+        blobs = {}
+        for i in range(2):
+            buf = io.BytesIO()
+            np.savez(buf, features=np.full((4, 3), i, np.float32),
+                     labels=np.eye(2, dtype=np.float32)[[i % 2] * 4])
+            blobs[f"part{i}.npz"] = buf.getvalue()
+        it = S3DataSetIterator(sorted(blobs), blobs.__getitem__)
+        out = list(it)
+        assert len(out) == 2
+        assert out[1].features[0, 0] == 1.0
+
+    def test_uploader_gated_without_boto3(self):
+        from deeplearning4j_tpu.aws import S3Uploader
+        with pytest.raises(ImportError, match="boto3"):
+            S3Uploader("bucket")

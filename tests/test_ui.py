@@ -109,3 +109,25 @@ class TestListenerAndServer:
             assert len(reports) == 1 and reports[0].iteration == 7
         finally:
             server.stop()
+
+
+class TestConvListener:
+    def test_saves_activation_grids(self, tmp_path):
+        from deeplearning4j_tpu.ui import ConvolutionalIterationListener
+        from deeplearning4j_tpu.nn.layers import ConvolutionLayer, SubsamplingLayer
+
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional(8, 8, 1)).build())
+        net = MultiLayerNetwork(conf).init().set_listeners(
+            ConvolutionalIterationListener(tmp_path, frequency=1))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 8, 8, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        net.fit(x, y, epochs=1, batch_size=4)
+        pngs = list(tmp_path.glob("*.png"))
+        assert len(pngs) >= 1  # at least the conv layer grid
