@@ -78,30 +78,43 @@ def _flash_forward(q, k, v, *, block_q: int, block_k: int, causal: bool,
                    interpret: bool):
     B, T, H, D = q.shape
     scale = 1.0 / float(np.sqrt(D))
-    # [B, T, H, D] → [B, H, T, D] for blocked layout
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    # Pad the time axis so the kernel's `pl.dslice(kb * block_k, block_k)`
+    # reads never run past the buffer (an out-of-bounds start is clamped,
+    # which would silently misalign the tail block against its position
+    # mask). Tp must (a) cover the last K-block read: ≥ ceil(T/bk)*bk,
+    # and (b) divide into Q blocks: multiple of bq — NOT lcm(bq, bk),
+    # which can balloon the buffers for unequal block sizes. The
+    # `k_pos < seq_len` mask zeroes attention to padded keys; padded
+    # query rows are sliced off below.
+    Tp = -(-(-(-T // bk) * bk) // bq) * bq
+    if Tp != T:
+        pad = [(0, 0), (0, Tp - T), (0, 0), (0, 0)]
+        q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
+    # [B, Tp, H, D] → [B, H, Tp, D] for blocked layout
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    bq = min(block_q, T)
-    grid = (B, H, pl.cdiv(T, bq))
+    grid = (B, H, Tp // bq)
     out = pl.pallas_call(
-        functools.partial(_flash_fwd_kernel, block_k=min(block_k, T),
+        functools.partial(_flash_fwd_kernel, block_k=bk,
                           seq_len=T, causal=causal, scale=scale),
         grid=grid,
         in_specs=[
             pl.BlockSpec((pl.squeezed, pl.squeezed, bq, D),
                          lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((pl.squeezed, pl.squeezed, T, D),
+            pl.BlockSpec((pl.squeezed, pl.squeezed, Tp, D),
                          lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((pl.squeezed, pl.squeezed, T, D),
+            pl.BlockSpec((pl.squeezed, pl.squeezed, Tp, D),
                          lambda b, h, i: (b, h, 0, 0)),
         ],
         out_specs=pl.BlockSpec((pl.squeezed, pl.squeezed, bq, D),
                                lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tp, D), q.dtype),
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    return jnp.transpose(out, (0, 2, 1, 3))[:, :T]
 
 
 def _xla_attention(q, k, v, causal):

@@ -38,7 +38,13 @@ from deeplearning4j_tpu.nn.conf.preprocessors import (
     RnnToFeedForwardPreProcessor,
     preprocessor_from_dict,
 )
-from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # import-time cycle guard: layers.base imports conf.*
+    # submodules, and importing any of those runs this package's
+    # __init__ → builder. `Layer` is only needed as an annotation
+    # (PEP 563 strings); `layer_from_dict` is imported lazily where used.
+    from deeplearning4j_tpu.nn.layers.base import Layer
 
 
 class GradientNormalization(str, Enum):
@@ -76,6 +82,8 @@ class MultiLayerConfiguration:
     gradient_normalization_threshold: float = 1.0
     max_norm: Optional[float] = None  # constraint applied post-update
     pretrain: bool = False
+    optimization_algo: str = "sgd"  # OptimizationAlgorithm value
+    max_iterations: int = 5  # line-search solver iterations per batch
 
     def to_dict(self):
         return {
@@ -91,6 +99,8 @@ class MultiLayerConfiguration:
             "gradient_normalization_threshold": self.gradient_normalization_threshold,
             "max_norm": self.max_norm,
             "pretrain": self.pretrain,
+            "optimization_algo": self.optimization_algo,
+            "max_iterations": self.max_iterations,
         }
 
     def to_json(self, **kw):
@@ -98,6 +108,7 @@ class MultiLayerConfiguration:
 
     @staticmethod
     def from_dict(d: dict) -> "MultiLayerConfiguration":
+        from deeplearning4j_tpu.nn.layers.base import layer_from_dict
         return MultiLayerConfiguration(
             layers=[layer_from_dict(ld) for ld in d["layers"]],
             input_preprocessors={int(i): preprocessor_from_dict(p)
@@ -111,6 +122,8 @@ class MultiLayerConfiguration:
             gradient_normalization_threshold=d.get("gradient_normalization_threshold", 1.0),
             max_norm=d.get("max_norm"),
             pretrain=d.get("pretrain", False),
+            optimization_algo=d.get("optimization_algo", "sgd"),
+            max_iterations=d.get("max_iterations", 5),
         )
 
     @staticmethod
@@ -247,6 +260,8 @@ class ListBuilder:
             gradient_normalization_threshold=g.gradient_normalization_threshold_value,
             max_norm=g.max_norm_value,
             pretrain=self._pretrain,
+            optimization_algo=g.optimization_algo_value,
+            max_iterations=g.max_iterations_value,
         )
 
 
@@ -272,6 +287,8 @@ class NeuralNetConfiguration:
         self.gradient_normalization_threshold_value = 1.0
         self.max_norm_value: Optional[float] = None
         self.activation_value = None
+        self.optimization_algo_value = "sgd"
+        self.max_iterations_value = 5
         self.mini_batch = True
 
     @staticmethod
@@ -324,6 +341,19 @@ class NeuralNetConfiguration:
     def gradient_normalization(self, gn, threshold: float = 1.0):
         self.gradient_normalization_value = GradientNormalization(gn)
         self.gradient_normalization_threshold_value = threshold
+        return self
+
+    def optimization_algo(self, algo):
+        """Reference `NeuralNetConfiguration.Builder.optimizationAlgo`
+        (`nn/api/OptimizationAlgorithm.java`): sgd runs the jitted
+        train step; the line-search family routes fit() batches through
+        `optimize.solvers.Solver`."""
+        from deeplearning4j_tpu.optimize.solvers import OptimizationAlgorithm
+        self.optimization_algo_value = OptimizationAlgorithm(algo).value
+        return self
+
+    def max_iterations(self, n: int):
+        self.max_iterations_value = int(n)
         return self
 
     def constrain_max_norm(self, v: float):

@@ -34,6 +34,7 @@ from deeplearning4j_tpu.nn.conf.graph import GraphVertex, vertex_from_dict
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
 from deeplearning4j_tpu.nn.layers.feedforward import BaseOutputLayerMixin
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
 from deeplearning4j_tpu.optimize.gradients import (
     apply_gradient_normalization,
     apply_max_norm_constraint,
@@ -66,6 +67,8 @@ class ComputationGraphConfiguration:
         self.gradient_normalization = GradientNormalization.NONE
         self.gradient_normalization_threshold = 1.0
         self.max_norm: Optional[float] = None
+        self.optimization_algo: str = "sgd"
+        self.max_iterations: int = 5
         self.topo_order: List[str] = []
 
     # ------------------------------------------------------------- builder
@@ -113,6 +116,8 @@ class ComputationGraphConfiguration:
             "gradient_normalization": self.gradient_normalization.value,
             "gradient_normalization_threshold": self.gradient_normalization_threshold,
             "max_norm": self.max_norm,
+            "optimization_algo": self.optimization_algo,
+            "max_iterations": self.max_iterations,
             "input_types": {k: v.to_dict() for k, v in self.input_types.items()},
             "nodes": [
                 {
@@ -144,6 +149,8 @@ class ComputationGraphConfiguration:
             d.get("gradient_normalization", "none"))
         conf.gradient_normalization_threshold = d.get("gradient_normalization_threshold", 1.0)
         conf.max_norm = d.get("max_norm")
+        conf.optimization_algo = d.get("optimization_algo", "sgd")
+        conf.max_iterations = d.get("max_iterations", 5)
         conf.input_types = {k: InputType.from_dict(v)
                             for k, v in d.get("input_types", {}).items()}
         for nd in d["nodes"]:
@@ -208,6 +215,8 @@ class GraphBuilder:
         conf.gradient_normalization = self._g.gradient_normalization_value
         conf.gradient_normalization_threshold = self._g.gradient_normalization_threshold_value
         conf.max_norm = self._g.max_norm_value
+        conf.optimization_algo = self._g.optimization_algo_value
+        conf.max_iterations = self._g.max_iterations_value
         conf.topo_order = conf.topological_sort()
         # shape inference + automatic preprocessors (reference
         # GraphBuilder.build → addPreProcessors)
@@ -249,7 +258,11 @@ class ComputationGraph:
         self.score_value = float("nan")
         self._initialized = False
         self._jit_train_step = None
+        self._jit_tbptt_step = None
         self._jit_output = None
+        self._jit_rnn_step = None
+        self._solver = None
+        self._rnn_carries: Dict[str, Any] = {}
         self.output_layer_names = [
             n for n in conf.network_outputs
             if conf.nodes[n].kind == "layer"
@@ -283,9 +296,14 @@ class ComputationGraph:
 
     # --------------------------------------------------------------- forward
     def _forward_all(self, params, state, inputs: Sequence, *, train, rng,
-                     masks: Optional[Sequence] = None, stop_at_loss: bool = False):
+                     masks: Optional[Sequence] = None, stop_at_loss: bool = False,
+                     carries: Optional[Dict] = None):
         """Walk topo order. Returns (activations dict, preout dict,
-        new_state, mask dict)."""
+        new_state, mask dict). When `carries` is given (a dict keyed by
+        node name), recurrent layers run `forward_with_carry` and the
+        updated carries are written back into it (TBPTT / rnn_time_step
+        state threading, reference ComputationGraph rnnTimeStep /
+        rnnActivateUsingStoredState)."""
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         masks = list(masks) if masks else [None] * len(inputs)
@@ -320,21 +338,32 @@ class ComputationGraph:
             lparams = layer.apply_weight_noise(
                 params.get(name, {}), train,
                 None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
-            h, st = layer.forward(lparams, state.get(name, {}), h,
-                                  train=train, rng=lrng, mask=mask)
+            if carries is not None and isinstance(layer, BaseRecurrentLayer):
+                carry_in = carries.get(name)
+                if carry_in is None:
+                    carry_in = layer.init_carry(h.shape[0], h.dtype)
+                h, st, carry_out = layer.forward_with_carry(
+                    lparams, state.get(name, {}), h, carry_in,
+                    train=train, rng=lrng, mask=mask)
+                carries[name] = carry_out
+            else:
+                h, st = layer.forward(lparams, state.get(name, {}), h,
+                                      train=train, rng=lrng, mask=mask)
             if st:
                 new_state[name] = st
             acts[name] = h
             mask_map[name] = layer.forward_mask(mask, None)
         return acts, preouts, new_state, mask_map
 
-    def _loss_fn(self, params, state, inputs, labels, rng, fmasks, lmasks, *, train):
+    def _loss_fn(self, params, state, inputs, labels, rng, fmasks, lmasks, *,
+                 train, carries=None):
         if not isinstance(labels, (list, tuple)):
             labels = [labels]
         lmasks = list(lmasks) if lmasks else [None] * len(labels)
+        out_carries = None if carries is None else dict(carries)
         acts, preouts, new_state, _ = self._forward_all(
             params, state, inputs, train=train, rng=rng, masks=fmasks,
-            stop_at_loss=True)
+            stop_at_loss=True, carries=out_carries)
         total = 0.0
         for oi, name in enumerate(self.output_layer_names):
             layer = self.conf.nodes[name].layer
@@ -349,7 +378,12 @@ class ComputationGraph:
         for name, node in self.conf.nodes.items():
             if node.kind == "layer" and name in params:
                 total = total + node.layer.regularization_score(params[name])
-        return self.dtype.cast_output(total), new_state
+        # auxiliary losses threaded through layer state (e.g. MoE load
+        # balance) — consumed here, not persisted across steps
+        for st in new_state.values():
+            if "aux_loss" in st:
+                total = total + st.pop("aux_loss")
+        return self.dtype.cast_output(total), (new_state, out_carries)
 
     # ------------------------------------------------------------ train step
     def _apply_updates(self, params, grads, upd_state, step):
@@ -368,18 +402,25 @@ class ComputationGraph:
             new_params = apply_max_norm_constraint(new_params, self.conf.max_norm)
         return new_params, new_upd
 
-    def _make_train_step(self):
+    def _make_train_step(self, tbptt: bool = False):
         gn = self.conf.gradient_normalization
         gn_t = self.conf.gradient_normalization_threshold
 
-        def step_fn(params, upd_state, state, it, xs, ys, rng, fmasks, lmasks):
+        def step_fn(params, upd_state, state, it, xs, ys, rng, fmasks, lmasks,
+                    carries=None):
             def lf(p):
-                return self._loss_fn(p, state, xs, ys, rng, fmasks, lmasks, train=True)
+                if tbptt and carries is not None:
+                    stopped = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
+                else:
+                    stopped = carries
+                return self._loss_fn(p, state, xs, ys, rng, fmasks, lmasks,
+                                     train=True, carries=stopped)
 
-            (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd_state, it)
-            return new_params, new_upd, new_state, loss
+            return new_params, new_upd, new_state, loss, new_carries
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
@@ -396,8 +437,24 @@ class ComputationGraph:
             batches = [data]
         else:
             batches = None
+        tbptt = self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
+        if tbptt and self._jit_tbptt_step is None:
+            self._jit_tbptt_step = self._make_train_step(tbptt=True)
+        solver = None
+        if getattr(self.conf, "optimization_algo", "sgd") != "sgd":
+            if tbptt:
+                raise ValueError(
+                    "optimization_algo=%r cannot be combined with truncated "
+                    "BPTT: the line-search solvers optimize the full-sequence "
+                    "loss and would ignore tbptt_fwd_length. Use SGD, or "
+                    "standard backprop_type." % self.conf.optimization_algo)
+            if self._solver is None:
+                from deeplearning4j_tpu.optimize.solvers import Solver
+                self._solver = Solver(self, self.conf.optimization_algo,
+                                      max_iterations=self.conf.max_iterations)
+            solver = self._solver
         listeners = ComposedListeners(self.listeners)
         rng_root = jax.random.PRNGKey(self.conf.seed + 1)
         iterator = batches if batches is not None else as_iterator(
@@ -423,10 +480,17 @@ class ComputationGraph:
                     lmasks = (None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),)
                     n_examples = ds.num_examples()
                 rng = jax.random.fold_in(rng_root, self.iteration_count)
-                (self.params, self.updater_state, new_state, loss) = self._jit_train_step(
-                    self.params, self.updater_state, self.net_state,
-                    self.iteration_count, xs, ys, rng, fmasks, lmasks)
-                self.net_state = {**self.net_state, **new_state}
+                if solver is not None:
+                    loss = solver.optimize(list(xs), list(ys), list(fmasks),
+                                           list(lmasks))
+                elif tbptt and any(x.ndim == 3 for x in xs):
+                    loss = self._fit_tbptt(xs, ys, fmasks, lmasks, rng)
+                else:
+                    (self.params, self.updater_state, new_state, loss, _) = \
+                        self._jit_train_step(
+                            self.params, self.updater_state, self.net_state,
+                            self.iteration_count, xs, ys, rng, fmasks, lmasks)
+                    self.net_state = {**self.net_state, **new_state}
                 self.score_value = float(loss)
                 listeners.iteration_done(self, self.iteration_count, self.epoch_count,
                                          self.score_value, batch_size=n_examples)
@@ -434,6 +498,165 @@ class ComputationGraph:
             listeners.on_epoch_end(self, self.epoch_count)
             self.epoch_count += 1
         listeners.on_fit_end(self)
+        return self
+
+    def _recurrent_nodes(self):
+        return [(n, node.layer) for n, node in self.conf.nodes.items()
+                if node.kind == "layer"
+                and isinstance(node.layer, BaseRecurrentLayer)]
+
+    def _fit_tbptt(self, xs, ys, fmasks, lmasks, rng):
+        """Truncated BPTT over the DAG: chunk every time axis, carry RNN
+        state across chunks with stop_gradient (reference
+        `ComputationGraph.doTruncatedBPTT`)."""
+        T = max(x.shape[1] for x in xs if x.ndim == 3)
+        L = self.conf.tbptt_fwd_length
+        batch = xs[0].shape[0]
+        carries = {n: layer.init_carry(batch, self.dtype.compute_dtype)
+                   for n, layer in self._recurrent_nodes()}
+
+        def chunk(a, s):
+            # only rank-3 [B, T, F] time series are chunked (a 4D conv
+            # input in a multi-input graph must pass through untouched)
+            return a if (a is None or a.ndim != 3) else a[:, s:s + L]
+
+        total_loss, nchunks = 0.0, 0
+        for s in range(0, T, L):
+            xc = tuple(chunk(x, s) for x in xs)
+            yc = tuple(y[:, s:s + L] if y.ndim == 3 else y for y in ys)
+            fm = tuple(None if m is None else m[:, s:s + L] for m in fmasks)
+            lm = tuple(None if m is None else
+                       (m[:, s:s + L] if m.ndim >= 2 else m) for m in lmasks)
+            crng = jax.random.fold_in(rng, s)
+            (self.params, self.updater_state, new_state, loss, carries) = \
+                self._jit_tbptt_step(self.params, self.updater_state,
+                                     self.net_state, self.iteration_count,
+                                     xc, yc, crng, fm, lm, carries)
+            self.net_state = {**self.net_state, **new_state}
+            total_loss += float(loss)
+            nchunks += 1
+        return total_loss / max(nchunks, 1)
+
+    # ------------------------------------------------------ rnn streaming
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = {}
+
+    def rnn_time_step(self, *inputs, masks=None):
+        """Streaming inference carrying RNN state across calls
+        (reference `ComputationGraph.rnnTimeStep`). Each input may be
+        [B, F] (single step) or [B, T, F]. Jitted with the carries as
+        arguments so per-token streaming is one compiled dispatch."""
+        xs = [jnp.asarray(x) for x in inputs]
+        squeeze = all(x.ndim == 2 for x in xs)
+        if squeeze:
+            xs = [x[:, None, :] for x in xs]
+        carries = dict(self._rnn_carries)
+        batch = xs[0].shape[0]
+        for n, layer in self._recurrent_nodes():
+            if n not in carries:
+                carries[n] = layer.init_carry(batch, self.dtype.compute_dtype)
+        if self._jit_rnn_step is None:
+            def rnn_fwd(params, state, xs, masks, carries):
+                c = dict(carries)
+                acts, _, _, _ = self._forward_all(params, state, list(xs),
+                                                  train=False, rng=None,
+                                                  masks=masks, carries=c)
+                return {n: acts[n] for n in self.conf.network_outputs}, c
+            self._jit_rnn_step = jax.jit(rnn_fwd)
+        acts, carries = self._jit_rnn_step(self.params, self.net_state,
+                                           tuple(xs), masks, carries)
+        self._rnn_carries.update(carries)
+        outs = []
+        for n in self.conf.network_outputs:
+            h = acts[n]
+            outs.append(h[:, -1, :] if squeeze and h.ndim == 3 else h)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    # ------------------------------------------------------------ pretrain
+    def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):
+        """Greedy layerwise pretraining of AutoEncoder-style layer nodes
+        in topological order (reference `ComputationGraph.pretrain`)."""
+        from deeplearning4j_tpu.datasets.iterator import as_iterator
+
+        if not self._initialized:
+            self.init()
+        iterator = as_iterator(data, batch_size=batch_size)
+        rng_root = jax.random.PRNGKey(self.conf.seed + 2)
+        for li, name in enumerate(self.conf.topo_order):
+            node = self.conf.nodes[name]
+            if node.kind != "layer" or not hasattr(node.layer, "pretrain_loss"):
+                continue
+            layer = node.layer
+            updater = layer.updater or Sgd(1e-3)
+
+            @jax.jit
+            def pt_step(lparams, upd_state, h, rng, it, layer=layer,
+                        updater=updater):
+                def lf(p):
+                    return layer.pretrain_loss(p, h, rng)
+                loss, grads = jax.value_and_grad(lf)(lparams)
+                new_p, new_u = {}, {}
+                for pk, g in grads.items():
+                    delta, ns = updater.apply(g, upd_state[pk], it)
+                    new_p[pk] = lparams[pk] - delta
+                    new_u[pk] = ns
+                return new_p, new_u, loss
+
+            # jitted featurizer walking only the ancestors of this node
+            # (the downstream graph and output heads are never computed)
+            target = node.inputs[0]
+            ancestors = {target}
+            changed = True
+            while changed:
+                changed = False
+                for n in self.conf.topo_order:
+                    if n in ancestors:
+                        for src in self.conf.nodes[n].inputs:
+                            if src not in ancestors:
+                                ancestors.add(src)
+                                changed = True
+            sub_order = [n for n in self.conf.topo_order if n in ancestors]
+
+            def featurize(params, state, xs, node=node, sub_order=sub_order,
+                          target=target):
+                acts = {n: self.dtype.cast_compute(x)
+                        for n, x in zip(self.conf.network_inputs, xs)}
+                for n in sub_order:
+                    sub = self.conf.nodes[n]
+                    if sub.kind == "input":
+                        continue
+                    ins = [acts[s] for s in sub.inputs]
+                    if sub.kind == "vertex":
+                        acts[n] = sub.vertex.forward(ins, masks=[None] * len(ins),
+                                                     train=False)
+                        continue
+                    h = ins[0]
+                    if sub.preprocessor is not None:
+                        h = sub.preprocessor.pre_process(h, None)
+                    h, _ = sub.layer.forward(params.get(n, {}),
+                                             state.get(n, {}), h,
+                                             train=False, rng=None)
+                    acts[n] = h
+                h = acts[target]
+                if node.preprocessor is not None:
+                    h = node.preprocessor.pre_process(h, None)
+                return h
+
+            featurize = jax.jit(featurize)
+            lparams = self.params[name]
+            upd_state = {pk: updater.init_state(v) for pk, v in lparams.items()}
+            it = 0
+            for _ in range(epochs):
+                iterator.reset()
+                for ds in iterator:
+                    feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                        else [ds.features]
+                    h = featurize(self.params, self.net_state,
+                                  tuple(jnp.asarray(f) for f in feats))
+                    rng = jax.random.fold_in(rng_root, it * 997 + li)
+                    lparams, upd_state, _ = pt_step(lparams, upd_state, h, rng, it)
+                    it += 1
+            self.params[name] = lparams
         return self
 
     # ------------------------------------------------------------- inference

@@ -103,15 +103,9 @@ class MixtureOfExperts(Layer):
                             + params["be1"])
         y = jnp.einsum("...eh,eho->...eo", h, params["We2"]) + params["be2"]
         out = jnp.einsum("...eo,...e->...o", y, gates)
-        if train:
-            # stash the aux loss for the container's regularization hook
-            self._last_aux = aux
+        if train and self.load_balance_coef:
+            # thread the aux loss functionally through the returned state;
+            # the container's loss fn pops "aux_loss" entries and adds
+            # them to the objective (no Python-object mutation under jit)
+            state = {**state, "aux_loss": self.load_balance_coef * aux}
         return out, state
-
-    def regularization_score(self, params):
-        base = super().regularization_score(params)
-        aux = getattr(self, "_last_aux", None)
-        if aux is not None and self.load_balance_coef:
-            base = base + self.load_balance_coef * aux
-            self._last_aux = None
-        return base

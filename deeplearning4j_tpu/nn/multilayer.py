@@ -85,6 +85,8 @@ class MultiLayerNetwork:
         self._jit_train_step = None
         self._jit_tbptt_step = None
         self._jit_output = None
+        self._jit_rnn_step = None
+        self._solver = None
         self._initialized = False
         out = self.layers[-1] if self.layers else None
         if out is not None and not isinstance(out, BaseOutputLayerMixin):
@@ -183,6 +185,11 @@ class MultiLayerNetwork:
             p = params.get(str(i))
             if p:
                 reg = reg + layer.regularization_score(p)
+        # auxiliary losses threaded through layer state (e.g. MoE load
+        # balance) — consumed here, not persisted across steps
+        for st in new_state.values():
+            if "aux_loss" in st:
+                reg = reg + st.pop("aux_loss")
         return self.dtype.cast_output(loss) + reg, (new_state, new_carries)
 
     # ---------------------------------------------------------- train step
@@ -234,6 +241,22 @@ class MultiLayerNetwork:
         listeners = ComposedListeners(self.listeners)
         rng_root = jax.random.PRNGKey(self.conf.seed + 1)
         tbptt = self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+        solver = None
+        if getattr(self.conf, "optimization_algo", "sgd") != "sgd":
+            if tbptt:
+                raise ValueError(
+                    "optimization_algo=%r cannot be combined with truncated "
+                    "BPTT: the line-search solvers optimize the full-sequence "
+                    "loss and would ignore tbptt_fwd_length. Use SGD, or "
+                    "standard backprop_type." % self.conf.optimization_algo)
+            # line-search family (reference OptimizationAlgorithm enum):
+            # each minibatch is optimized for max_iterations by the solver.
+            # Cached on self so repeated fit() calls reuse the jitted loss.
+            if self._solver is None:
+                from deeplearning4j_tpu.optimize.solvers import Solver
+                self._solver = Solver(self, self.conf.optimization_algo,
+                                      max_iterations=self.conf.max_iterations)
+            solver = self._solver
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step(tbptt=False)
         if tbptt and self._jit_tbptt_step is None:
@@ -250,7 +273,9 @@ class MultiLayerNetwork:
                 fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
                 lmask = None if ds.labels_mask is None else _convert_labels(ds.labels_mask, data_format)
                 rng = jax.random.fold_in(rng_root, self.iteration_count)
-                if tbptt and x.ndim == 3:
+                if solver is not None:
+                    loss = solver.optimize(x, y, fmask, lmask)
+                elif tbptt and x.ndim == 3:
                     loss = self._fit_tbptt(x, y, fmask, lmask, rng)
                 else:
                     (self.params, self.updater_state, new_state, loss, _) = \
@@ -372,8 +397,14 @@ class MultiLayerNetwork:
         for i, layer in enumerate(self.layers):
             if isinstance(layer, BaseRecurrentLayer) and str(i) not in carries:
                 carries[str(i)] = layer.init_carry(x.shape[0], self.dtype.compute_dtype)
-        h, _, new_carries, _, _ = self._forward_core(
-            self.params, self.net_state, x, train=False, rng=None, carries=carries)
+        if self._jit_rnn_step is None:
+            def rnn_fwd(params, state, x, carries):
+                h, _, new_carries, _, _ = self._forward_core(
+                    params, state, x, train=False, rng=None, carries=carries)
+                return h, new_carries
+            self._jit_rnn_step = jax.jit(rnn_fwd)
+        h, new_carries = self._jit_rnn_step(self.params, self.net_state, x,
+                                            carries)
         self._rnn_carries.update(new_carries)
         return h[:, -1, :] if squeeze and h.ndim == 3 else h
 

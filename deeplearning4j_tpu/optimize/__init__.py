@@ -8,6 +8,18 @@ reference effectively uses for NN training — line-search variants are
 legacy), with updaters from `common.updaters`.
 """
 
+from deeplearning4j_tpu.optimize.solvers import (
+    OptimizationAlgorithm,
+    BackTrackLineSearch,
+    ConjugateGradient,
+    LBFGS,
+    LineGradientDescent,
+    Solver,
+    DefaultStepFunction,
+    NegativeDefaultStepFunction,
+    GradientStepFunction,
+    NegativeGradientStepFunction,
+)
 from deeplearning4j_tpu.optimize.listeners import (
     TrainingListener,
     ScoreIterationListener,

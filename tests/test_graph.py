@@ -211,3 +211,92 @@ class TestGraphContainer:
 
         ok, worst, fails = check_gradients_fn(loss_fn, net.params)
         assert ok, f"worst {worst} {fails[:3]}"
+
+
+class TestGraphRnnParity:
+    """ComputationGraph TBPTT / rnn_time_step / pretrain — MLN parity
+    (reference ComputationGraph.java:863 fit w/ doTruncatedBPTT,
+    rnnTimeStep, pretrain)."""
+
+    def _rnn_graph(self, tbptt=False):
+        from deeplearning4j_tpu.nn.conf.builder import BackpropType
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2)))
+        g.add_inputs("seq")
+        g.add_layer("lstm", LSTM(n_in=5, n_out=8), "seq")
+        g.add_layer("out", RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                          loss="mcxent"), "lstm")
+        g.set_outputs("out")
+        if tbptt:
+            g.backprop_type(BackpropType.TRUNCATED_BPTT, 4)
+        return g.build()
+
+    def test_graph_tbptt_fit(self):
+        net = ComputationGraph(self._rnn_graph(tbptt=True)).init()
+        x = np.random.randn(2, 12, 5).astype(np.float32)
+        y = np.eye(3)[np.random.randint(0, 3, (2, 12))].astype(np.float32)
+        net.fit(x, y, epochs=2, batch_size=2)
+        assert np.isfinite(net.score())
+
+    def test_graph_tbptt_learns(self):
+        # the TBPTT path must actually reduce loss on a memorizable batch
+        net = ComputationGraph(self._rnn_graph(tbptt=True)).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 8, 5)).astype(np.float32)
+        y = np.eye(3)[rng.integers(0, 3, (4, 8))].astype(np.float32)
+        net.fit(x, y, epochs=1, batch_size=4)
+        first = net.score()
+        net.fit(x, y, epochs=10, batch_size=4)
+        assert net.score() < first
+
+    def test_graph_rnn_time_step_matches_full_forward(self):
+        net = ComputationGraph(self._rnn_graph()).init()
+        x = np.random.randn(2, 6, 5).astype(np.float32)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        stream = []
+        for t in range(6):
+            stream.append(np.asarray(net.rnn_time_step(x[:, t, :])))
+        stream = np.stack(stream, axis=1)
+        np.testing.assert_allclose(full, stream, atol=1e-5)
+
+    def test_graph_tbptt_gradcheck(self):
+        """Gradient-check one TBPTT chunk's loss (carries stopped)."""
+        import jax
+        net = ComputationGraph(self._rnn_graph(tbptt=True)).init()
+        from deeplearning4j_tpu.nd.dtype import DataTypePolicy
+        net.dtype = DataTypePolicy(jnp.float64, jnp.float64, jnp.float64)
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 4, 5))
+        y = np.eye(3)[rng.integers(0, 3, (2, 4))]
+        def loss_fn(p):
+            # carries built inside so they pick up float64 under enable_x64
+            carries = {"lstm": net.conf.nodes["lstm"].layer.init_carry(
+                2, jnp.float64)}
+            stopped = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
+            loss, _ = net._loss_fn(p, net.net_state, [jnp.asarray(x)],
+                                   [jnp.asarray(y)], None, None, None,
+                                   train=False, carries=stopped)
+            return loss
+
+        ok, worst, fails = check_gradients_fn(loss_fn, net.params)
+        assert ok, f"worst {worst} {fails[:3]}"
+
+    def test_graph_pretrain(self):
+        from deeplearning4j_tpu.nn.layers import AutoEncoder
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(9).updater(Adam(1e-2)))
+        g.add_inputs("in")
+        g.add_layer("ae", AutoEncoder(n_in=6, n_out=4), "in")
+        g.add_layer("out", OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                       loss="mcxent"), "ae")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((16, 6)).astype(np.float32)
+        before = {k: np.asarray(v) for k, v in net.params["ae"].items()}
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        net.pretrain(DataSet(x, x), epochs=3, batch_size=8)
+        changed = any(not np.allclose(before[k], np.asarray(net.params["ae"][k]))
+                      for k in before)
+        assert changed

@@ -1,0 +1,210 @@
+"""Line-search solver family tests (reference
+`optimize/solvers/BaseOptimizer.java`, `BackTrackLineSearch.java`:
+convex convergence + small-MLP fit through the builder selector)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.solvers import (
+    BackTrackLineSearch,
+    ConjugateGradient,
+    LBFGS,
+    LineGradientDescent,
+    NegativeDefaultStepFunction,
+    OptimizationAlgorithm,
+    Solver,
+    step_function_from_dict,
+)
+
+SOLVERS = [LineGradientDescent, ConjugateGradient, LBFGS]
+
+
+def rosenbrock(x):
+    return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1 - x[:-1]) ** 2)
+
+
+class TestSolversConvex:
+    @pytest.mark.parametrize("cls", SOLVERS)
+    def test_quadratic_converges(self, cls):
+        # f(x) = 0.5 xᵀAx - bᵀx, A SPD — unique minimum at A⁻¹b
+        rng = np.random.default_rng(0)
+        M = rng.standard_normal((6, 6))
+        A = jnp.asarray(M @ M.T + 6 * np.eye(6), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(6), jnp.float32)
+
+        def f(x):
+            return 0.5 * x @ A @ x - b @ x
+
+        opt = cls(max_iterations=200, tolerance=1e-12)
+        x = opt.optimize(f, jnp.zeros(6))
+        x_star = jnp.linalg.solve(A, b)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(x_star),
+                                   rtol=1e-3, atol=1e-3)
+        # scores strictly decrease overall
+        assert opt.scores[-1] < opt.scores[0]
+
+    @pytest.mark.parametrize("cls", [ConjugateGradient, LBFGS])
+    def test_rosenbrock_progress(self, cls):
+        opt = cls(max_iterations=300, tolerance=1e-14)
+        x = opt.optimize(rosenbrock, jnp.zeros(4))
+        assert float(rosenbrock(x)) < 1e-2
+
+    def test_lbfgs_beats_gd_on_illconditioned(self):
+        # ill-conditioned quadratic: curvature memory must pay off
+        d = jnp.asarray(np.logspace(0, 3, 10), jnp.float32)
+
+        def f(x):
+            return 0.5 * jnp.sum(d * x ** 2)
+
+        x0 = jnp.ones(10)
+        gd = LineGradientDescent(max_iterations=25, tolerance=0)
+        lb = LBFGS(max_iterations=25, tolerance=0)
+        f_gd = float(f(gd.optimize(f, x0)))
+        f_lb = float(f(lb.optimize(f, x0)))
+        assert f_lb < f_gd
+
+
+class TestBackTrackLineSearch:
+    def test_accepts_descent_step(self):
+        f = lambda x: jnp.sum(x ** 2)
+        x = jnp.asarray([3.0])
+        g = jax.grad(lambda x: jnp.sum(x ** 2))(x)
+        ls = BackTrackLineSearch()
+        alpha, f_new = ls.optimize(f, x, float(f(x)), g, -g)
+        assert alpha > 0
+        assert f_new < float(f(x))
+
+    def test_rejects_ascent_direction(self):
+        f = lambda x: jnp.sum(x ** 2)
+        x = jnp.asarray([3.0])
+        g = jax.grad(lambda x: jnp.sum(x ** 2))(x)
+        ls = BackTrackLineSearch()
+        alpha, f_new = ls.optimize(f, x, float(f(x)), g, g)  # uphill
+        assert alpha == 0.0
+
+    def test_step_function_serde(self):
+        sf = NegativeDefaultStepFunction()
+        rt = step_function_from_dict(sf.to_dict())
+        assert type(rt) is NegativeDefaultStepFunction
+        x = jnp.asarray([1.0])
+        np.testing.assert_allclose(np.asarray(rt.step(x, jnp.asarray([2.0]), 0.5)),
+                                   [0.0])
+
+
+class TestSolverOnModel:
+    def _net(self, algo=None, max_iter=5):
+        b = NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+        if algo is not None:
+            b = b.optimization_algo(algo).max_iterations(max_iter)
+        conf = (b.list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def _data(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.eye(3)[rng.integers(0, 3, 32)].astype(np.float32)
+        return x, y
+
+    @pytest.mark.parametrize("algo", [OptimizationAlgorithm.CONJUGATE_GRADIENT,
+                                      OptimizationAlgorithm.LBFGS,
+                                      OptimizationAlgorithm.LINE_GRADIENT_DESCENT])
+    def test_solver_reduces_model_loss(self, algo):
+        net = self._net()
+        x, y = self._data()
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        before = net.score(DataSet(x, y))
+        s = Solver(net, algo, max_iterations=20)
+        after = s.optimize(x, y)
+        assert after < before
+        assert net.score(DataSet(x, y)) == pytest.approx(after, rel=1e-4)
+
+    def test_builder_selector_routes_fit(self):
+        net = self._net(algo="lbfgs", max_iter=10)
+        assert net.conf.optimization_algo == "lbfgs"
+        x, y = self._data()
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        before = net.score(DataSet(x, y))
+        net.fit(x, y, epochs=2, batch_size=32)
+        assert net.score(DataSet(x, y)) < before
+
+    def test_conf_serde_roundtrip(self):
+        net = self._net(algo="conjugate_gradient", max_iter=7)
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        assert conf2.optimization_algo == "conjugate_gradient"
+        assert conf2.max_iterations == 7
+
+    def test_solver_on_computation_graph(self):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration,
+        )
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(5))
+        g.add_inputs("in")
+        g.add_layer("fc", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                       loss="mcxent"), "fc")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        x, y = self._data()
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        before = net.score(DataSet(x, y))
+        after = Solver(net, "lbfgs", max_iterations=20).optimize(x, y)
+        assert after < before
+
+    def test_graph_builder_selector_routes_fit(self):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration,
+        )
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(5)
+            .optimization_algo("conjugate_gradient").max_iterations(10))
+        g.add_inputs("in")
+        g.add_layer("fc", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                       loss="mcxent"), "fc")
+        g.set_outputs("out")
+        conf = g.build()
+        assert conf.optimization_algo == "conjugate_gradient"
+        # serde keeps it
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert conf2.optimization_algo == "conjugate_gradient"
+        net = ComputationGraph(conf).init()
+        x, y = self._data()
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        before = net.score(DataSet(x, y))
+        net.fit(x, y, epochs=2, batch_size=32)
+        assert net.score(DataSet(x, y)) < before
+
+    def test_solver_respects_masks(self):
+        # masked-out padded timesteps must not affect the solved loss
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        conf = (NeuralNetConfiguration.builder().seed(11).list()
+                .layer(LSTM(n_in=3, n_out=6))
+                .layer(RnnOutputLayer(n_in=6, n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(4)
+        x_short = rng.standard_normal((2, 3, 3)).astype(np.float32)
+        y_short = np.eye(2)[rng.integers(0, 2, (2, 3))].astype(np.float32)
+        x_pad = np.concatenate([x_short, 99 * np.ones((2, 2, 3), np.float32)], 1)
+        y_pad = np.concatenate([y_short, np.zeros((2, 2, 2), np.float32)], 1)
+        mask = np.concatenate([np.ones((2, 3)), np.zeros((2, 2))], 1).astype(np.float32)
+
+        s1 = Solver(net, "lbfgs", max_iterations=0)
+        loss_short = s1.optimize(x_short, y_short)
+        net2 = MultiLayerNetwork(conf).init()
+        s2 = Solver(net2, "lbfgs", max_iterations=0)
+        loss_pad = s2.optimize(x_pad, y_pad, fmask=mask, lmask=mask)
+        np.testing.assert_allclose(loss_short, loss_pad, rtol=1e-5)
