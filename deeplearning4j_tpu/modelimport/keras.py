@@ -39,12 +39,19 @@ from deeplearning4j_tpu.nn.layers import (
     EmbeddingLayer,
     GlobalPoolingLayer,
     LastTimeStep,
+    LocalResponseNormalization,
     LossLayer,
     OutputLayer,
+    PermuteLayer,
+    PoolHelperLayer,
+    ReshapeLayer,
+    SeparableConvolution2D,
     SimpleRnn,
     Subsampling1DLayer,
     SubsamplingLayer,
+    Upsampling1D,
     Upsampling2D,
+    ZeroPadding1DLayer,
     ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode, PoolingMode
@@ -132,6 +139,8 @@ class KerasLayerMapper:
             n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
             kernel_size=kernel,
             stride=_pair(cfg.get("strides", cfg.get("subsample"))),
+            dilation=_pair(cfg.get("dilation_rate",
+                                   cfg.get("atrous_rate", (1, 1)))),
             convolution_mode=_conv_mode(cfg),
             activation=_act(cfg.get("activation")),
             has_bias=cfg.get("use_bias", cfg.get("bias", True)),
@@ -144,9 +153,11 @@ class KerasLayerMapper:
         k = k[0] if isinstance(k, (list, tuple)) else k
         s = cfg.get("strides", cfg.get("subsample_length", 1))
         s = s[0] if isinstance(s, (list, tuple)) else s
+        d = cfg.get("dilation_rate", cfg.get("atrous_rate", 1))
+        d = d[0] if isinstance(d, (list, tuple)) else d
         return Convolution1DLayer(
             n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
-            kernel_size=int(k), stride=int(s),
+            kernel_size=int(k), stride=int(s), dilation=(int(d), 1),
             convolution_mode=_conv_mode(cfg),
             activation=_act(cfg.get("activation")),
             name=cfg.get("name"))
@@ -229,6 +240,63 @@ class KerasLayerMapper:
                                   decay=float(cfg.get("momentum", 0.99)),
                                   name=cfg.get("name"))
 
+    def _map_lrn(self, cfg):
+        # custom layer in Theano-era zoo files (reference KerasLRN)
+        return LocalResponseNormalization(
+            k=float(cfg.get("k", 2.0)), n=int(cfg.get("n", 5)),
+            alpha=float(cfg.get("alpha", 1e-4)),
+            beta=float(cfg.get("beta", 0.75)), name=cfg.get("name"))
+
+    _map_localresponsenormalization = _map_lrn
+
+    # ---- shape ops ----
+    def _map_reshape(self, cfg):
+        return ReshapeLayer(target_shape=tuple(cfg.get("target_shape", ())),
+                            name=cfg.get("name"))
+
+    def _map_permute(self, cfg):
+        return PermuteLayer(dims=tuple(cfg.get("dims", ())),
+                            name=cfg.get("name"))
+
+    def _map_poolhelper(self, cfg):
+        # custom layer in Theano-era GoogLeNet files (reference KerasPoolHelper)
+        return PoolHelperLayer(name=cfg.get("name"))
+
+    def _map_zeropadding1d(self, cfg):
+        pad = cfg.get("padding", 1)
+        if isinstance(pad, (list, tuple)):
+            pad = tuple(int(p) for p in pad)
+        return ZeroPadding1DLayer(pad=pad, name=cfg.get("name"))
+
+    def _map_upsampling1d(self, cfg):
+        s = cfg.get("size", cfg.get("length", 2))
+        return Upsampling1D(size=int(s[0] if isinstance(s, (list, tuple)) else s),
+                            name=cfg.get("name"))
+
+    # ---- dilated + separable conv ----
+    # Keras 1 Atrous* classes: dilation comes from atrous_rate, which
+    # the base conv mappers already read
+    _map_atrousconvolution2d = _map_conv2d
+    _map_atrousconvolution1d = _map_conv1d
+
+    def _map_separableconv2d(self, cfg):
+        kernel = _pair(cfg.get("kernel_size",
+                               (cfg.get("nb_row"), cfg.get("nb_col"))
+                               if cfg.get("nb_row") else None), (3, 3))
+        d = cfg.get("dilation_rate", (1, 1))
+        return SeparableConvolution2D(
+            n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
+            kernel_size=kernel,
+            stride=_pair(cfg.get("strides", cfg.get("subsample"))),
+            dilation=_pair(d),
+            depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+            convolution_mode=_conv_mode(cfg),
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", cfg.get("bias", True)),
+            name=cfg.get("name"))
+
+    _map_separableconvolution2d = _map_separableconv2d  # Keras 1 name
+
 
 class KerasModelImport:
     """Entry points mirroring `KerasModelImport.java`."""
@@ -279,6 +347,35 @@ class KerasModelImport:
         raise ValueError("Cannot infer input shape from Keras config")
 
     @staticmethod
+    def _channels_last(model_dict, h5) -> bool:
+        """TF-backend Keras flattens NHWC; Theano-era (Keras 1) files
+        flatten channel-major (the reference's dim-ordering handling,
+        `KerasLayer.java` dimOrder). Priority: explicit per-layer
+        dim_ordering (Keras 1 stores "th"/"tf") > backend attr >
+        config-shape heuristic (Keras 1 Sequential config is a list)."""
+        cfg = model_dict.get("config")
+        layer_list = cfg.get("layers", []) if isinstance(cfg, dict) else cfg
+        for lc in layer_list or []:
+            ordering = (lc.get("config") or {}).get("dim_ordering")
+            if ordering in ("th", "tf"):
+                return ordering == "tf"
+        backend = h5.read_attr_string("backend")
+        if backend:
+            return backend == "tensorflow"
+        return (model_dict.get("class_name") != "Sequential"
+                or isinstance(cfg, dict))
+
+    @staticmethod
+    def _fix_flatten_order(preprocessors, channels_last: bool):
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            CnnToFeedForwardPreProcessor,
+        )
+        if channels_last:
+            for pp in preprocessors:
+                if isinstance(pp, CnnToFeedForwardPreProcessor):
+                    pp.data_format = "nhwc"
+
+    @staticmethod
     def _import_sequential(model_dict, h5) -> MultiLayerNetwork:
         layer_cfgs = KerasModelImport._layer_list(model_dict)
         mapper = KerasLayerMapper()
@@ -296,7 +393,11 @@ class KerasModelImport:
                 builder.layer(layer)
                 idx += 1
         builder.set_input_type(KerasModelImport._input_type_from(layer_cfgs))
-        net = MultiLayerNetwork(builder.build()).init()
+        conf = builder.build()
+        KerasModelImport._fix_flatten_order(
+            conf.input_preprocessors.values(),
+            KerasModelImport._channels_last(model_dict, h5))
+        net = MultiLayerNetwork(conf).init()
         KerasModelImport._copy_weights_mln(net, h5, keras_names)
         return net
 
@@ -362,8 +463,48 @@ class KerasModelImport:
             alias[name] = prev[0]  # downstream refs see the LAST mapped layer
         g.set_input_types(*input_types)
         g.set_outputs(*[alias.get(n, n) for n in output_names])
-        net = ComputationGraph(g.build()).init()
+        conf = g.build()
+        KerasModelImport._fix_flatten_order(
+            [n.preprocessor for n in conf.nodes.values()
+             if n.preprocessor is not None],
+            KerasModelImport._channels_last(model_dict, h5))
+        net = ComputationGraph(conf).init()
         KerasModelImport._copy_weights_graph(net, h5, keras_names)
+        return net
+
+    # ----------------------------------------------------- weights-only h5
+    @staticmethod
+    def load_weights_into(net, path):
+        """Copy a weights-only Keras .h5 (model.save_weights output — no
+        model_config attr; the keras-applications distribution format)
+        into an already-built network.
+
+        Keras stores layers in creation order under `layer_names`;
+        weighted layers are matched IN ORDER against this network's
+        weighted layers, with every tensor shape-checked (`_coerce`
+        raises on any mismatch, so a topology drift fails loudly instead
+        of silently corrupting params). Reference parallel:
+        `KerasModelUtils.copyWeightsToModel:59`."""
+        with Hdf5Archive(path) as h5:
+            root = KerasModelImport._weights_root(h5)
+            lnames = h5.read_attr_strings("layer_names", root) or []
+            keras_weighted = []
+            for ln in lnames:
+                kw = KerasModelImport._layer_weights(h5, root, ln)
+                if kw:
+                    keras_weighted.append((ln, kw))
+            if hasattr(net, "layers"):  # MultiLayerNetwork
+                ours = [(str(i), l) for i, l in enumerate(net.layers)
+                        if net.params.get(str(i))]
+            else:  # ComputationGraph
+                ours = [(n, net.conf.nodes[n].layer)
+                        for n in net.conf.topo_order if net.params.get(n)]
+            if len(keras_weighted) != len(ours):
+                raise ValueError(
+                    f"{path}: {len(keras_weighted)} weighted Keras layers vs "
+                    f"{len(ours)} in the target network — topologies differ")
+            for (kname, kw), (key, layer) in zip(keras_weighted, ours):
+                KerasModelImport._apply_weights(net, key, layer, kw, kname)
         return net
 
     # ----------------------------------------------------------- weights
@@ -399,6 +540,11 @@ class KerasModelImport:
             if k is not None and k.ndim == 3:
                 k = k[:, None, :, :]  # Keras Conv1D [k,in,out] → [k,1,in,out]
             params["W"] = k
+            if "bias" in kw or "b" in kw:
+                params["b"] = kw.get("bias", kw.get("b"))
+        elif cls == "SeparableConvolution2D":
+            params["dW"] = kw.get("depthwise_kernel")
+            params["pW"] = kw.get("pointwise_kernel")
             if "bias" in kw or "b" in kw:
                 params["b"] = kw.get("bias", kw.get("b"))
         elif cls == "EmbeddingLayer":

@@ -147,13 +147,15 @@ def _expected_family(layer: Layer) -> str:
         return _expected_family(layer.layer)  # delegate through the wrapper
     name = layer.layer_name
     if name in ("convolution", "subsampling", "upsampling2d", "zeropadding",
-                "space_to_depth", "lrn", "yolo2_output"):
+                "space_to_depth", "lrn", "yolo2_output",
+                "separable_convolution2d", "pool_helper"):
         return "cnn"
     if name in ("lstm", "graves_lstm", "graves_bidirectional_lstm", "simple_rnn",
                 "rnn_output", "convolution1d", "subsampling1d", "zeropadding1d",
                 "upsampling1d", "last_time_step", "multi_head_attention"):
         return "rnn"
-    if name in ("batchnorm", "activation", "dropout_layer", "global_pooling", "loss"):
+    if name in ("batchnorm", "activation", "dropout_layer", "global_pooling",
+                "loss", "reshape", "permute"):
         return "any"
     return "ff"
 

@@ -313,3 +313,24 @@ class PreprocessorVertex(GraphVertex):
 
     def to_dict(self):
         return {"vertex": self.vertex_name, "preprocessor": self.preprocessor.to_dict()}
+
+
+@register_vertex
+@dataclasses.dataclass(eq=False)
+class PoolHelperVertex(GraphVertex):
+    """Strip the first row+column of CNN activations (reference
+    `nn/conf/graph/PoolHelperVertex.java`). Delegates to
+    `nn.layers.misc.PoolHelperLayer` — single implementation of the
+    Theano-era GoogLeNet shim."""
+
+    vertex_name = "pool_helper"
+
+    def _layer(self):
+        from deeplearning4j_tpu.nn.layers.misc import PoolHelperLayer
+        return PoolHelperLayer()
+
+    def forward(self, inputs, masks=None, train=False):
+        return self._layer().forward({}, {}, inputs[0])[0]
+
+    def get_output_type(self, input_types):
+        return self._layer().get_output_type(input_types[0])

@@ -68,11 +68,17 @@ class CnnToFeedForwardPreProcessor(InputPreProcessor):
     height: int = 0
     width: int = 0
     channels: int = 0
+    # "nchw" = reference flatten order (DL4J / Keras-theano dense
+    # weights); "nhwc" = TF-dialect Keras flatten order (set by the
+    # Keras importer for tensorflow-backend files)
+    data_format: str = "nchw"
     preproc_name = "cnn_to_ff"
 
     def pre_process(self, x, mask=None):
-        # NHWC → NCHW → flatten (reference flatten order, ConvolutionUtils)
         n = x.shape[0]
+        if self.data_format == "nhwc":
+            return x.reshape(n, -1)
+        # NHWC → NCHW → flatten (reference flatten order, ConvolutionUtils)
         return jnp.transpose(x, (0, 3, 1, 2)).reshape(n, -1)
 
     def get_output_type(self, input_type):

@@ -26,6 +26,7 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     ZeroPaddingLayer,
     ZeroPadding1DLayer,
     SpaceToDepthLayer,
+    SeparableConvolution2D,
 )
 from deeplearning4j_tpu.nn.layers.normalization import (
     BatchNormalization,
@@ -47,7 +48,12 @@ from deeplearning4j_tpu.nn.layers.variational import (
     ExponentialReconstructionDistribution,
 )
 from deeplearning4j_tpu.nn.layers.rbm import RBM, HiddenUnit, VisibleUnit
-from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
+from deeplearning4j_tpu.nn.layers.misc import (
+    FrozenLayer,
+    PermuteLayer,
+    PoolHelperLayer,
+    ReshapeLayer,
+)
 from deeplearning4j_tpu.nn.layers.training import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
 from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention

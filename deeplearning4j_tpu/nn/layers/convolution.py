@@ -422,3 +422,91 @@ class Upsampling1D(Layer):
         if mask is None:
             return None
         return jnp.repeat(mask, self.size, axis=1)
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class SeparableConvolution2D(Layer):
+    """Depthwise-separable conv (reference
+    `nn/conf/layers/SeparableConvolution2D.java`; Keras SeparableConv2D).
+
+    Depthwise stage = grouped `lax.conv_general_dilated` with
+    `feature_group_count=n_in` (one MXU conv, no per-channel loop);
+    pointwise stage is an ordinary 1x1 conv. Param names: "dW"
+    [kh, kw, n_in, depth_multiplier] (Keras depthwise layout), "pW"
+    [1, 1, n_in*depth_multiplier, n_out], "b" [n_out].
+    """
+
+    layer_name = "separable_convolution2d"
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    padding: Any = (0, 0)
+    dilation: Any = (1, 1)
+    depth_multiplier: int = 1
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    has_bias: bool = True
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.dilation = _pair(self.dilation)
+        self.convolution_mode = ConvolutionMode(self.convolution_mode)
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if not isinstance(input_type, InputTypeConvolutional):
+            raise ValueError(
+                f"SeparableConvolution2D expects convolutional input, got {input_type}")
+        if override or not self.n_in:
+            self.n_in = input_type.channels
+
+    def get_output_type(self, input_type):
+        h = conv_out_size(input_type.height, self.kernel_size[0], self.stride[0],
+                          self.padding[0], self.dilation[0], self.convolution_mode)
+        w = conv_out_size(input_type.width, self.kernel_size[1], self.stride[1],
+                          self.padding[1], self.dilation[1], self.convolution_mode)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        dm = self.depth_multiplier
+        k1, k2 = jax.random.split(rng)
+        dw = init_weights(k1, (kh, kw, self.n_in, dm), self.weight_init,
+                          fan_in=kh * kw, fan_out=kh * kw * dm,
+                          distribution=self.dist, dtype=dtype)
+        pw = init_weights(k2, (1, 1, self.n_in * dm, self.n_out), self.weight_init,
+                          fan_in=self.n_in * dm, fan_out=self.n_out,
+                          distribution=self.dist, dtype=dtype)
+        params = {"dW": dw, "pW": pw}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, train, rng)
+        kh, kw = self.kernel_size
+        dm = self.depth_multiplier
+        pads = _explicit_padding(self.convolution_mode, self.padding,
+                                 self.kernel_size, self.dilation, self.stride,
+                                 x.shape[1:3])
+        # [kh, kw, in, dm] → [kh, kw, 1, in*dm], in-major (matches the
+        # feature_group_count output-channel grouping)
+        dw = params["dW"].astype(x.dtype).reshape(kh, kw, 1, self.n_in * dm)
+        z = lax.conv_general_dilated(
+            x, dw, window_strides=self.stride, padding=pads,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_in)
+        z = lax.conv_general_dilated(
+            z, params["pW"].astype(x.dtype), window_strides=(1, 1),
+            padding=[(0, 0), (0, 0)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"].astype(z.dtype)
+        return self.activation(z), state

@@ -14,11 +14,13 @@ through the frozen block), and the updater is NoOp.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
 from deeplearning4j_tpu.common.updaters import NoOp
+from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict, register_layer
 
 
@@ -60,3 +62,88 @@ class FrozenLayer(Layer):
 
     def regularization_score(self, params):
         return 0.0
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class ReshapeLayer(Layer):
+    """Static reshape of the non-batch axes (reference
+    `modelimport/keras/preprocessors/ReshapePreprocessor.java` via
+    KerasReshape; usable directly in both containers). `target_shape`
+    follows this framework's layouts: len 1 → [F], len 2 → [T, F]
+    recurrent, len 3 → [H, W, C] convolutional."""
+
+    layer_name = "reshape"
+    target_shape: Any = ()
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        self.target_shape = tuple(int(d) for d in self.target_shape)
+        super().__post_init__()
+
+    def get_output_type(self, input_type):
+        s = self.target_shape
+        if len(s) == 1:
+            return InputType.feed_forward(s[0])
+        if len(s) == 2:
+            return InputType.recurrent(s[1], s[0])
+        if len(s) == 3:
+            return InputType.convolutional(s[0], s[1], s[2])
+        raise ValueError(f"Unsupported reshape target {s}")
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x.reshape((x.shape[0],) + self.target_shape), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class PermuteLayer(Layer):
+    """Permute the non-batch axes; `dims` are 1-indexed positions of the
+    input axes (Keras Permute semantics, reference KerasPermute)."""
+
+    layer_name = "permute"
+    dims: Any = ()
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        self.dims = tuple(int(d) for d in self.dims)
+        super().__post_init__()
+
+    def get_output_type(self, input_type):
+        shape = input_type.shape()
+        new = tuple(shape[d - 1] for d in self.dims)
+        if len(new) == 1:
+            return InputType.feed_forward(new[0])
+        if len(new) == 2:
+            return InputType.recurrent(new[1], new[0])
+        if len(new) == 3:
+            return InputType.convolutional(new[0], new[1], new[2])
+        raise ValueError(f"Unsupported permute rank {len(new)}")
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.transpose(x, (0,) + self.dims), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class PoolHelperLayer(Layer):
+    """Strip the first row+column of CNN activations — compatibility
+    shim for Theano-era GoogLeNet Keras files (reference
+    `modelimport/keras/layers/custom/KerasPoolHelper.java`)."""
+
+    layer_name = "pool_helper"
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        super().__post_init__()
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(input_type.height - 1,
+                                       input_type.width - 1,
+                                       input_type.channels)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x[:, 1:, 1:, :], state

@@ -45,20 +45,43 @@ class ZooModel:
 
     def init_pretrained(self, ptype: PretrainedType = PretrainedType.IMAGENET):
         """Download + verify + load a pretrained checkpoint
-        (reference `ZooModel.initPretrained` with checksum check :81)."""
+        (reference `ZooModel.initPretrained` with checksum check :81).
+
+        Supports two payloads: this framework's ModelSerializer zip, or
+        a Keras .h5 weights file (the reference's "Keras modelimport and
+        zoo models load unchanged" north star) — routed by file magic.
+        Checksum algorithm is inferred from hex length (32 → md5, the
+        hash format keras-applications publishes; 64 → sha256)."""
         url = self.pretrained_url(ptype)
         if url is None:
             raise ValueError(f"{type(self).__name__} has no pretrained weights for {ptype}")
-        dest = CACHE_DIR / "zoo" / f"{type(self).__name__}_{ptype.value}.zip"
+        suffix = ".h5" if url.endswith(".h5") else ".zip"
+        tag = hashlib.sha256(url.encode()).hexdigest()[:8]  # distinct URLs
+        dest = CACHE_DIR / "zoo" / (
+            f"{type(self).__name__}_{ptype.value}_{tag}{suffix}")
         if not dest.exists():
             import urllib.request
             dest.parent.mkdir(parents=True, exist_ok=True)
             urllib.request.urlretrieve(url, dest)  # noqa: S310
         expected = self.pretrained_checksum(ptype)
         if expected:
-            h = hashlib.sha256(dest.read_bytes()).hexdigest()
+            algo = hashlib.md5 if len(expected) == 32 else hashlib.sha256
+            h = algo(dest.read_bytes()).hexdigest()
             if h != expected:
                 dest.unlink()
                 raise IOError(f"Checksum mismatch for {dest}: {h} != {expected}")
+        with open(dest, "rb") as f:
+            magic = f.read(8)
+        if magic[:4] == b"\x89HDF":
+            from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
+            from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+            with Hdf5Archive(str(dest)) as h5:
+                full_model = h5.read_attr_string("model_config") is not None
+            if full_model:
+                return KerasModelImport.import_keras_model_and_weights(str(dest))
+            # weights-only file (keras-applications format): build this
+            # zoo model's own architecture and order-match the weights
+            net = self.init()
+            return KerasModelImport.load_weights_into(net, str(dest))
         from deeplearning4j_tpu.util.serializer import ModelSerializer
         return ModelSerializer.restore_model(dest)

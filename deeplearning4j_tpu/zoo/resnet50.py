@@ -29,7 +29,7 @@ from deeplearning4j_tpu.nn.layers import (
 )
 from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode
 from deeplearning4j_tpu.nn.layers.pooling import PoolingType
-from deeplearning4j_tpu.zoo.base import ZooModel
+from deeplearning4j_tpu.zoo.base import PretrainedType, ZooModel
 
 
 class ResNet50(ZooModel):
@@ -95,3 +95,17 @@ class ResNet50(ZooModel):
 
     def init(self) -> ComputationGraph:
         return ComputationGraph(self.conf()).init(self.seed)
+
+    # Keras-applications hosted weights (reference `ZooModel.java:52-81`
+    # pretrainedUrl + checksum pattern); md5 from keras-applications.
+    def pretrained_url(self, ptype):
+        if ptype == PretrainedType.IMAGENET:
+            return ("https://storage.googleapis.com/tensorflow/"
+                    "keras-applications/resnet/"
+                    "resnet50_weights_tf_dim_ordering_tf_kernels.h5")
+        return None
+
+    def pretrained_checksum(self, ptype):
+        if ptype == PretrainedType.IMAGENET:
+            return "2cb95161c43110f7111970584f804107"
+        return None

@@ -15,7 +15,7 @@ from deeplearning4j_tpu.nn.layers import (
 )
 from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-from deeplearning4j_tpu.zoo.base import ZooModel
+from deeplearning4j_tpu.zoo.base import PretrainedType, ZooModel
 
 
 def _vgg_conf(block_sizes, num_classes, seed, height, width, channels):
@@ -55,6 +55,33 @@ class VGG16(ZooModel):
     def init(self) -> MultiLayerNetwork:
         return MultiLayerNetwork(self.conf()).init(self.seed)
 
+    # Keras-applications hosted weights (reference `ZooModel.java:52-81`
+    # pretrainedUrl + checksum pattern; the h5 loads through the Keras
+    # importer). Hash is the md5 keras-applications publishes.
+    def pretrained_url(self, ptype):
+        if ptype == PretrainedType.IMAGENET:
+            return ("https://storage.googleapis.com/tensorflow/"
+                    "keras-applications/vgg16/"
+                    "vgg16_weights_tf_dim_ordering_tf_kernels.h5")
+        return None
+
+    def pretrained_checksum(self, ptype):
+        if ptype == PretrainedType.IMAGENET:
+            return "64373286793e3c8b2b4e3219cbf3544b"
+        return None
+
 
 class VGG19(VGG16):
     BLOCKS = [(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)]
+
+    def pretrained_url(self, ptype):
+        if ptype == PretrainedType.IMAGENET:
+            return ("https://storage.googleapis.com/tensorflow/"
+                    "keras-applications/vgg19/"
+                    "vgg19_weights_tf_dim_ordering_tf_kernels.h5")
+        return None
+
+    def pretrained_checksum(self, ptype):
+        if ptype == PretrainedType.IMAGENET:
+            return "cbe5617147190e668d6c5d5026f83318"
+        return None

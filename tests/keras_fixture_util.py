@@ -1,0 +1,121 @@
+"""Build Keras-dialect .h5 fixtures with h5py — independent of the
+framework's own C++ HDF5 shim, so import tests exercise a real
+third-party-written file (the reference vendors actual Keras files:
+`deeplearning4j-modelimport/src/test/resources/configs/`).
+
+Layouts reproduced byte-for-byte from real Keras output:
+- Keras 2: root attr `model_config` (JSON); `/model_weights` group with
+  `layer_names` attr; per-layer group attrs `weight_names` =
+  [b"{lname}/kernel:0", ...]; datasets at
+  `/model_weights/{lname}/{lname}/kernel:0`.
+- Keras 1: weights at root `/{lname}` groups, weight names
+  `{lname}_W` style (no nested scope, no ":0" suffix).
+"""
+
+import json
+
+import h5py
+import numpy as np
+
+
+def write_keras2_h5(path, model_config: dict, layer_weights):
+    """layer_weights: list of (layer_name, [(weight_name, array), ...]).
+    weight_name is the short Keras name ("kernel", "bias", ...)."""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode()
+        f.attrs["keras_version"] = b"2.2.4"
+        f.attrs["backend"] = b"tensorflow"
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = np.array(
+            [ln.encode() for ln, _ in layer_weights], dtype="S64")
+        mw.attrs["keras_version"] = b"2.2.4"
+        mw.attrs["backend"] = b"tensorflow"
+        for lname, weights in layer_weights:
+            g = mw.create_group(lname)
+            wnames = [f"{lname}/{wn}:0" for wn, _ in weights]
+            g.attrs["weight_names"] = np.array(
+                [w.encode() for w in wnames], dtype="S128")
+            for (wn, arr), full in zip(weights, wnames):
+                g.create_dataset(full, data=np.asarray(arr, np.float32))
+
+
+def write_keras1_h5(path, model_config: dict, layer_weights):
+    """Keras 1 dialect: weights at root, `{lname}_W`-style names."""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode()
+        f.attrs["keras_version"] = b"1.2.2"
+        f.attrs["layer_names"] = np.array(
+            [ln.encode() for ln, _ in layer_weights], dtype="S64")
+        for lname, weights in layer_weights:
+            g = f.create_group(lname)
+            wnames = [f"{lname}_{wn}" for wn, _ in weights]
+            g.attrs["weight_names"] = np.array(
+                [w.encode() for w in wnames], dtype="S128")
+            for (wn, arr), full in zip(weights, wnames):
+                g.create_dataset(full, data=np.asarray(arr, np.float32))
+
+
+# ------------------------------------------------- numpy reference math
+def np_conv2d_same(x, k, b, stride=1):
+    """NHWC conv, 'same' padding, odd kernels — pure numpy oracle."""
+    kh, kw, cin, cout = k.shape
+    ph, pw = kh // 2, kw // 2
+    xp = np.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    B, H, W, _ = x.shape
+    out = np.zeros((B, -(-H // stride), -(-W // stride), cout), np.float32)
+    for i in range(out.shape[1]):
+        for j in range(out.shape[2]):
+            patch = xp[:, i * stride:i * stride + kh, j * stride:j * stride + kw, :]
+            out[:, i, j, :] = np.tensordot(patch, k, axes=([1, 2, 3], [0, 1, 2]))
+    return out + b
+
+
+def np_maxpool2d(x, size=2):
+    B, H, W, C = x.shape
+    h, w = H // size, W // size
+    return x[:, :h * size, :w * size, :].reshape(
+        B, h, size, w, size, C).max(axis=(2, 4))
+
+
+def np_hard_sigmoid(x):
+    return np.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def np_lstm(x, K, R, b):
+    """Keras-2 LSTM (IFCO kernels, hard_sigmoid gates, tanh): returns
+    final hidden state [B, U]."""
+    B, T, F = x.shape
+    U = R.shape[0]
+    h = np.zeros((B, U), np.float32)
+    c = np.zeros((B, U), np.float32)
+    for t in range(T):
+        z = x[:, t, :] @ K + h @ R + b
+        i = np_hard_sigmoid(z[:, :U])
+        f = np_hard_sigmoid(z[:, U:2 * U])
+        cc = np.tanh(z[:, 2 * U:3 * U])
+        o = np_hard_sigmoid(z[:, 3 * U:])
+        c = f * c + i * cc
+        h = o * np.tanh(c)
+    return h
+
+
+def np_softmax(z):
+    e = np.exp(z - z.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def np_separable_conv2d_valid(x, dk, pk, b):
+    """Depthwise-separable conv, 'valid' padding, stride 1 — numpy oracle.
+    dk [kh,kw,cin,dm], pk [1,1,cin*dm,cout]."""
+    kh, kw, cin, dm = dk.shape
+    B, H, W, _ = x.shape
+    oh, ow = H - kh + 1, W - kw + 1
+    mid = np.zeros((B, oh, ow, cin * dm), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i:i + kh, j:j + kw, :]          # [B, kh, kw, cin]
+            # depthwise: per input channel, dm outputs (in-major layout)
+            prod = np.einsum("bhwc,hwcd->bcd", patch, dk)  # [B, cin, dm]
+            mid[:, i, j, :] = prod.reshape(B, cin * dm)
+    out = mid @ pk[0, 0]                                  # [B, oh, ow, cout]
+    return out + b

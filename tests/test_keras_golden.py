@@ -1,0 +1,396 @@
+"""Golden-file Keras import tests against h5py-written fixtures in the
+real Keras 1/2 on-disk layouts, with numpy-computed expected outputs —
+output parity, not just shape equality (reference pattern:
+`modelimport/src/test/resources/configs/` golden files +
+`Keras2ModelConfigurationTest`)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+from tests.keras_fixture_util import (
+    np_conv2d_same,
+    np_lstm,
+    np_maxpool2d,
+    np_separable_conv2d_valid,
+    np_softmax,
+    write_keras1_h5,
+    write_keras2_h5,
+)
+
+
+def _seq_config(layers):
+    return {"class_name": "Sequential",
+            "config": {"name": "sequential", "layers": layers}}
+
+
+class TestKeras2Golden:
+    def test_cnn_output_parity(self, tmp_path):
+        rng = np.random.default_rng(0)
+        kconv = rng.standard_normal((3, 3, 1, 4)).astype(np.float32) * 0.3
+        bconv = rng.standard_normal(4).astype(np.float32) * 0.1
+        kd = rng.standard_normal((4 * 4 * 4, 10)).astype(np.float32) * 0.2
+        bd = rng.standard_normal(10).astype(np.float32) * 0.1
+        cfg = _seq_config([
+            {"class_name": "Conv2D",
+             "config": {"name": "conv", "filters": 4, "kernel_size": [3, 3],
+                        "strides": [1, 1], "padding": "same",
+                        "activation": "relu", "use_bias": True,
+                        "batch_input_shape": [None, 8, 8, 1]}},
+            {"class_name": "MaxPooling2D",
+             "config": {"name": "pool", "pool_size": [2, 2],
+                        "strides": [2, 2], "padding": "valid"}},
+            {"class_name": "Flatten", "config": {"name": "flatten"}},
+            {"class_name": "Dense",
+             "config": {"name": "fc", "units": 10, "activation": "softmax",
+                        "use_bias": True}},
+        ])
+        path = tmp_path / "cnn.h5"
+        write_keras2_h5(path, cfg, [
+            ("conv", [("kernel", kconv), ("bias", bconv)]),
+            ("pool", []), ("flatten", []),
+            ("fc", [("kernel", kd), ("bias", bd)]),
+        ])
+        net = KerasModelImport.import_keras_model_and_weights(str(path))
+        x = rng.standard_normal((2, 8, 8, 1)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        h = np.maximum(np_conv2d_same(x, kconv, bconv), 0.0)
+        h = np_maxpool2d(h, 2)
+        want = np_softmax(h.reshape(2, -1) @ kd + bd)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_lstm_output_parity(self, tmp_path):
+        rng = np.random.default_rng(1)
+        U, F, T = 5, 3, 4
+        K = rng.standard_normal((F, 4 * U)).astype(np.float32) * 0.4
+        R = rng.standard_normal((U, 4 * U)).astype(np.float32) * 0.4
+        b = rng.standard_normal(4 * U).astype(np.float32) * 0.1
+        kd = rng.standard_normal((U, 2)).astype(np.float32)
+        bd = np.zeros(2, np.float32)
+        cfg = _seq_config([
+            {"class_name": "LSTM",
+             "config": {"name": "lstm", "units": U, "activation": "tanh",
+                        "recurrent_activation": "hard_sigmoid",
+                        "return_sequences": False,
+                        "batch_input_shape": [None, T, F]}},
+            {"class_name": "Dense",
+             "config": {"name": "fc", "units": 2, "activation": "softmax"}},
+        ])
+        path = tmp_path / "lstm.h5"
+        write_keras2_h5(path, cfg, [
+            ("lstm", [("kernel", K), ("recurrent_kernel", R), ("bias", b)]),
+            ("fc", [("kernel", kd), ("bias", bd)]),
+        ])
+        net = KerasModelImport.import_keras_model_and_weights(str(path))
+        x = rng.standard_normal((2, T, F)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        want = np_softmax(np_lstm(x, K, R, b) @ kd + bd)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_separable_conv_output_parity(self, tmp_path):
+        rng = np.random.default_rng(2)
+        dk = rng.standard_normal((3, 3, 2, 2)).astype(np.float32) * 0.3
+        pk = rng.standard_normal((1, 1, 4, 5)).astype(np.float32) * 0.3
+        b = rng.standard_normal(5).astype(np.float32) * 0.1
+        cfg = _seq_config([
+            {"class_name": "SeparableConv2D",
+             "config": {"name": "sep", "filters": 5, "kernel_size": [3, 3],
+                        "strides": [1, 1], "padding": "valid",
+                        "depth_multiplier": 2, "activation": "linear",
+                        "use_bias": True,
+                        "batch_input_shape": [None, 6, 6, 2]}},
+            {"class_name": "Flatten", "config": {"name": "flatten"}},
+            {"class_name": "Dense",
+             "config": {"name": "fc", "units": 3, "activation": "softmax"}},
+        ])
+        kd = rng.standard_normal((4 * 4 * 5, 3)).astype(np.float32) * 0.1
+        bd = np.zeros(3, np.float32)
+        path = tmp_path / "sep.h5"
+        write_keras2_h5(path, cfg, [
+            ("sep", [("depthwise_kernel", dk), ("pointwise_kernel", pk),
+                     ("bias", b)]),
+            ("flatten", []),
+            ("fc", [("kernel", kd), ("bias", bd)]),
+        ])
+        net = KerasModelImport.import_keras_model_and_weights(str(path))
+        x = rng.standard_normal((2, 6, 6, 2)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        h = np_separable_conv2d_valid(x, dk, pk, b)
+        want = np_softmax(h.reshape(2, -1) @ kd + bd)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_shape_op_layers_import(self, tmp_path):
+        # Reshape / Permute / ZeroPadding1D / Upsampling1D / dilated conv
+        rng = np.random.default_rng(3)
+        cfg = _seq_config([
+            {"class_name": "Reshape",
+             "config": {"name": "rs", "target_shape": [4, 6],
+                        "batch_input_shape": [None, 24]}},
+            {"class_name": "Permute", "config": {"name": "pm", "dims": [2, 1]}},
+            {"class_name": "ZeroPadding1D",
+             "config": {"name": "zp", "padding": [1, 1]}},
+            {"class_name": "UpSampling1D", "config": {"name": "up", "size": 2}},
+            {"class_name": "Conv1D",
+             "config": {"name": "conv", "filters": 3, "kernel_size": [3],
+                        "strides": [1], "padding": "valid",
+                        "dilation_rate": [2], "activation": "relu"}},
+        ])
+        kc = rng.standard_normal((3, 4, 3)).astype(np.float32) * 0.3
+        bc = np.zeros(3, np.float32)
+        path = tmp_path / "shapes.h5"
+        write_keras2_h5(path, cfg, [
+            ("rs", []), ("pm", []), ("zp", []), ("up", []),
+            ("conv", [("kernel", kc), ("bias", bc)]),
+        ])
+        net = KerasModelImport.import_keras_model_and_weights(str(path))
+        x = rng.standard_normal((2, 24)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        # 24 → [4,6] → permute [6,4] → pad T 6+2=8 → upsample T=16 →
+        # dilated k=3 d=2 valid: 16 - (3 + 2*1 - 1) + 1 = 12
+        assert out.shape == (2, 12, 3)
+
+    def test_upsampling1d_keras_name_variant(self, tmp_path):
+        # Keras 1 spells it "UpSampling1D" too but with length= key
+        cfg = _seq_config([
+            {"class_name": "UpSampling1D",
+             "config": {"name": "up", "length": 3,
+                        "batch_input_shape": [None, 4, 2]}},
+        ])
+        path = tmp_path / "up1.h5"
+        write_keras2_h5(path, cfg, [("up", [])])
+        net = KerasModelImport.import_keras_model_and_weights(str(path))
+        x = np.random.randn(1, 4, 2).astype(np.float32)
+        assert np.asarray(net.output(x)).shape == (1, 12, 2)
+
+
+class TestKeras1Golden:
+    def test_dense_mlp_keras1_dialect(self, tmp_path):
+        rng = np.random.default_rng(4)
+        W1 = rng.standard_normal((6, 8)).astype(np.float32) * 0.4
+        b1 = rng.standard_normal(8).astype(np.float32) * 0.1
+        W2 = rng.standard_normal((8, 3)).astype(np.float32) * 0.4
+        b2 = np.zeros(3, np.float32)
+        cfg = {"class_name": "Sequential", "config": [
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "output_dim": 8,
+                        "activation": "tanh", "input_dim": 6}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_2", "output_dim": 3,
+                        "activation": "softmax"}},
+        ]}
+        path = tmp_path / "k1.h5"
+        write_keras1_h5(path, cfg, [
+            ("dense_1", [("W", W1), ("b", b1)]),
+            ("dense_2", [("W", W2), ("b", b2)]),
+        ])
+        net = KerasModelImport.import_keras_model_and_weights(str(path))
+        x = rng.standard_normal((3, 6)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        want = np_softmax(np.tanh(x @ W1 + b1) @ W2 + b2)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestZooPretrained:
+    def test_init_pretrained_roundtrip_via_file_url(self, tmp_path):
+        """init_pretrained: URL → cache → checksum → Keras import →
+        working model (reference ZooModel.initPretrained :52-81),
+        driven by a file:// URL so it runs offline."""
+        import hashlib
+
+        from deeplearning4j_tpu.zoo.base import PretrainedType, ZooModel
+
+        rng = np.random.default_rng(5)
+        W = rng.standard_normal((4, 2)).astype(np.float32)
+        b = np.zeros(2, np.float32)
+        cfg = _seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "fc", "units": 2, "activation": "softmax",
+                        "batch_input_shape": [None, 4]}},
+        ])
+        h5path = tmp_path / "tiny_pretrained.h5"
+        write_keras2_h5(h5path, cfg, [("fc", [("kernel", W), ("bias", b)])])
+        checksum = hashlib.sha256(h5path.read_bytes()).hexdigest()
+
+        class TinyZoo(ZooModel):
+            def pretrained_url(self, ptype):
+                return h5path.as_uri()
+
+            def pretrained_checksum(self, ptype):
+                return checksum
+
+        net = TinyZoo().init_pretrained(PretrainedType.IMAGENET)
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, np_softmax(x @ W + b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_vgg16_resnet50_urls_wired(self):
+        from deeplearning4j_tpu.zoo.base import PretrainedType
+        from deeplearning4j_tpu.zoo.resnet50 import ResNet50
+        from deeplearning4j_tpu.zoo.vgg import VGG16
+
+        for cls in (VGG16, ResNet50):
+            m = cls()
+            url = m.pretrained_url(PretrainedType.IMAGENET)
+            assert url and url.endswith(".h5")
+            assert m.pretrained_checksum(PretrainedType.IMAGENET)
+
+
+class TestWeightsOnlyH5:
+    """keras-applications distribution format: no model_config attr —
+    weights are order-matched into an already-built network."""
+
+    def _write_weights_only(self, path, layer_weights):
+        import h5py
+        with h5py.File(path, "w") as f:
+            f.attrs["layer_names"] = np.array(
+                [ln.encode() for ln, _ in layer_weights], dtype="S64")
+            f.attrs["backend"] = b"tensorflow"
+            for lname, weights in layer_weights:
+                g = f.create_group(lname)
+                wnames = [f"{lname}/{wn}:0" for wn, _ in weights]
+                g.attrs["weight_names"] = np.array(
+                    [w.encode() for w in wnames], dtype="S128")
+                for (wn, arr), full in zip(weights, wnames):
+                    g.create_dataset(full, data=np.asarray(arr, np.float32))
+
+    def _tiny_net(self):
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.layers import (
+            ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-3))
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        convolution_mode=ConvolutionMode.SAME,
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=6, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 1)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_load_weights_into_order_matched(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        rng = np.random.default_rng(7)
+        net = self._tiny_net()
+        kc = rng.standard_normal((3, 3, 1, 4)).astype(np.float32)
+        bc = rng.standard_normal(4).astype(np.float32)
+        kd = rng.standard_normal((4 * 4 * 4, 6)).astype(np.float32)
+        bd = rng.standard_normal(6).astype(np.float32)
+        ko = rng.standard_normal((6, 2)).astype(np.float32)
+        bo = np.zeros(2, np.float32)
+        path = tmp_path / "weights_only.h5"
+        self._write_weights_only(path, [
+            ("block1_conv1", [("kernel", kc), ("bias", bc)]),
+            ("pool", []),
+            ("fc1", [("kernel", kd), ("bias", bd)]),
+            ("predictions", [("kernel", ko), ("bias", bo)]),
+        ])
+        KerasModelImport.load_weights_into(net, str(path))
+        np.testing.assert_allclose(np.asarray(net.params["0"]["W"]), kc)
+        np.testing.assert_allclose(np.asarray(net.params["2"]["W"]), kd)
+        np.testing.assert_allclose(np.asarray(net.params["3"]["W"]), ko)
+
+    def test_load_weights_into_topology_mismatch_raises(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        net = self._tiny_net()
+        path = tmp_path / "short.h5"
+        self._write_weights_only(path, [
+            ("only_one", [("kernel", np.zeros((3, 3, 1, 4), np.float32))]),
+        ])
+        with pytest.raises(ValueError, match="topologies differ"):
+            KerasModelImport.load_weights_into(net, str(path))
+
+    def test_init_pretrained_weights_only_route(self, tmp_path):
+        import hashlib
+        from deeplearning4j_tpu.zoo.base import PretrainedType, ZooModel
+        rng = np.random.default_rng(8)
+        outer = self
+
+        kc = rng.standard_normal((3, 3, 1, 4)).astype(np.float32)
+        bc = np.zeros(4, np.float32)
+        kd = rng.standard_normal((4 * 4 * 4, 6)).astype(np.float32)
+        bd = np.zeros(6, np.float32)
+        ko = rng.standard_normal((6, 2)).astype(np.float32)
+        bo = np.zeros(2, np.float32)
+        path = tmp_path / "zoo_weights.h5"
+        self._write_weights_only(path, [
+            ("c", [("kernel", kc), ("bias", bc)]),
+            ("d", [("kernel", kd), ("bias", bd)]),
+            ("o", [("kernel", ko), ("bias", bo)]),
+        ])
+        checksum = hashlib.sha256(path.read_bytes()).hexdigest()
+
+        class TinyZoo(ZooModel):
+            def init(self):
+                return outer._tiny_net()
+
+            def pretrained_url(self, ptype):
+                return path.as_uri()
+
+            def pretrained_checksum(self, ptype):
+                return checksum
+
+        net = TinyZoo().init_pretrained(PretrainedType.IMAGENET)
+        np.testing.assert_allclose(np.asarray(net.params["0"]["W"]), kc)
+
+
+class TestDimOrderingDetection:
+    def test_keras1_th_dim_ordering_keeps_nchw_flatten(self, tmp_path):
+        """A Theano-ordering file must flatten channel-major even when
+        the config shape heuristic would guess channels_last."""
+        rng = np.random.default_rng(9)
+        # input 4x4x2 NHWC; conv 1x1 identity-ish; flatten; dense
+        kconv = rng.standard_normal((1, 1, 2, 2)).astype(np.float32)
+        bconv = np.zeros(2, np.float32)
+        kd = rng.standard_normal((32, 3)).astype(np.float32)
+        bd = np.zeros(3, np.float32)
+        cfg = {"class_name": "Model",  # functional dict config, Keras 1
+               "config": {"name": "m", "layers": [
+                   {"class_name": "InputLayer", "name": "in",
+                    "config": {"name": "in",
+                               "batch_input_shape": [None, 4, 4, 2]},
+                    "inbound_nodes": []},
+                   {"class_name": "Convolution2D", "name": "conv",
+                    "config": {"name": "conv", "nb_filter": 2, "nb_row": 1,
+                               "nb_col": 1, "dim_ordering": "th",
+                               "border_mode": "valid",
+                               "activation": "linear"},
+                    "inbound_nodes": [[["in", 0, 0]]]},
+                   {"class_name": "Flatten", "name": "flat",
+                    "config": {"name": "flat"},
+                    "inbound_nodes": [[["conv", 0, 0]]]},
+                   {"class_name": "Dense", "name": "fc",
+                    "config": {"name": "fc", "output_dim": 3,
+                               "activation": "softmax"},
+                    "inbound_nodes": [[["flat", 0, 0]]]},
+               ], "input_layers": [["in", 0, 0]],
+                   "output_layers": [["fc", 0, 0]]}}
+        from tests.keras_fixture_util import write_keras2_h5
+        import h5py
+        path = tmp_path / "th.h5"
+        write_keras2_h5(path, cfg, [
+            ("conv", [("kernel", kconv), ("bias", bconv)]),
+            ("fc", [("kernel", kd), ("bias", bd)]),
+        ])
+        with h5py.File(path, "a") as f:  # strip the backend attr
+            del f.attrs["backend"]
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        net = KerasModelImport.import_keras_model_and_weights(str(path))
+        pp = [n.preprocessor for n in net.conf.nodes.values()
+              if n.preprocessor is not None]
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            CnnToFeedForwardPreProcessor,
+        )
+        flat_pps = [p for p in pp
+                    if isinstance(p, CnnToFeedForwardPreProcessor)]
+        assert flat_pps and all(p.data_format == "nchw" for p in flat_pps)

@@ -380,3 +380,59 @@ def test_upsampling1d():
     np.testing.assert_array_equal(np.asarray(y[0, 0]), np.asarray(y[0, 2]))
     t = up.get_output_type(InputType.recurrent(5, 4))
     assert t.timesteps == 12
+
+
+# ------------------------------- shape-op + separable layers (Keras import)
+class TestShapeOpLayers:
+    def test_reshape_permute_poolhelper_forward(self):
+        from deeplearning4j_tpu.nn.layers import (
+            PermuteLayer, PoolHelperLayer, ReshapeLayer,
+        )
+        x = jnp.arange(2 * 24, dtype=jnp.float32).reshape(2, 24)
+        r = ReshapeLayer(target_shape=(4, 6))
+        y, _ = r.forward({}, {}, x)
+        assert y.shape == (2, 4, 6)
+        assert r.get_output_type(InputType.feed_forward(24)).size == 6
+
+        p = PermuteLayer(dims=(2, 1))
+        z, _ = p.forward({}, {}, y)
+        np.testing.assert_array_equal(np.asarray(z),
+                                      np.asarray(jnp.transpose(y, (0, 2, 1))))
+
+        c = jnp.arange(1 * 5 * 5 * 2, dtype=jnp.float32).reshape(1, 5, 5, 2)
+        ph = PoolHelperLayer()
+        out, _ = ph.forward({}, {}, c)
+        assert out.shape == (1, 4, 4, 2)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(c[:, 1:, 1:, :]))
+
+    def test_separable_conv_gradcheck(self):
+        import jax
+        from deeplearning4j_tpu.gradientcheck import check_gradients_fn
+        from deeplearning4j_tpu.nn.layers import SeparableConvolution2D
+
+        layer = SeparableConvolution2D(n_in=2, n_out=3, kernel_size=(3, 3),
+                                       depth_multiplier=2, activation="tanh")
+        params = layer.init_params(jax.random.PRNGKey(0), jnp.float64)
+        x = np.random.default_rng(0).standard_normal((2, 5, 5, 2))
+
+        def loss_fn(p):
+            y, _ = layer.forward(p, {}, jnp.asarray(x))
+            return jnp.sum(y ** 2)
+
+        ok, worst, fails = check_gradients_fn(loss_fn, params)
+        assert ok, f"worst {worst} {fails[:3]}"
+
+    def test_separable_conv_same_padding_shape(self):
+        import jax
+        from deeplearning4j_tpu.nn.layers import SeparableConvolution2D
+        from deeplearning4j_tpu.nn.layers.convolution import ConvolutionMode
+
+        layer = SeparableConvolution2D(n_in=3, n_out=4, kernel_size=(3, 3),
+                                       stride=(2, 2),
+                                       convolution_mode=ConvolutionMode.SAME)
+        params = layer.init_params(jax.random.PRNGKey(1))
+        x = jnp.zeros((1, 7, 7, 3))
+        y, _ = layer.forward(params, {}, x)
+        assert y.shape == (1, 4, 4, 4)
+        t = layer.get_output_type(InputType.convolutional(7, 7, 3))
+        assert (t.height, t.width, t.channels) == (4, 4, 4)
