@@ -6,7 +6,9 @@ from deeplearning4j_tpu.graph.graph import Graph, Edge, Vertex
 from deeplearning4j_tpu.graph.loader import GraphLoader
 from deeplearning4j_tpu.graph.walkers import (
     NoEdgeHandling,
+    Node2VecWalkIterator,
     RandomWalkIterator,
     WeightedRandomWalkIterator,
 )
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
+from deeplearning4j_tpu.graph.node2vec import Node2Vec

@@ -26,7 +26,12 @@ from deeplearning4j_tpu.nlp.sequencevectors import (
 
 class GraphVectors(SequenceVectors):
     """Vertex-embedding query surface (reference `GraphVectors.java`:
-    getVertexVector, verticesNearest, similarity)."""
+    getVertexVector, verticesNearest, similarity) + the shared
+    walk-collection/vocab-bootstrap loop; subclasses provide the walker
+    via `_make_walker`."""
+
+    walk_length: int = 40
+    walks_per_vertex: int = 1
 
     def get_vertex_vector(self, idx: int) -> Optional[np.ndarray]:
         return self.get_word_vector(str(idx))
@@ -36,6 +41,31 @@ class GraphVectors(SequenceVectors):
 
     def similarity_vertices(self, a: int, b: int) -> float:
         return self.similarity(str(a), str(b))
+
+    def _make_walker(self, graph: Graph, rep: int):
+        raise NotImplementedError
+
+    def initialize(self, graph: Graph):
+        """Pre-build vocab over all vertices (reference
+        `DeepWalk.initialize(graph)` builds the GraphHuffman tree from
+        vertex degrees)."""
+        sequences = [[str(v)] * max(graph.degree(v), 1)
+                     for v in range(graph.num_vertices())]
+        self.build_vocab(sequences)
+        return self
+
+    def fit_graph(self, graph: Graph, walk_iterator=None):
+        if self.vocab is None:
+            self.initialize(graph)
+        walks: List[List[str]] = []
+        for rep in range(self.walks_per_vertex):
+            it = walk_iterator or self._make_walker(graph, rep)
+            it.reset()
+            for walk in it:
+                walks.append([str(v) for v in walk])
+            walk_iterator = None  # only reuse the custom iterator once
+        return super().fit(walks,
+                           total_words=sum(len(w) for w in walks))
 
 
 class DeepWalk(GraphVectors):
@@ -54,24 +84,6 @@ class DeepWalk(GraphVectors):
         self.walk_length = walk_length
         self.walks_per_vertex = walks_per_vertex
 
-    def initialize(self, graph: Graph):
-        """Pre-build vocab over all vertices (reference
-        `DeepWalk.initialize(graph)` builds the GraphHuffman tree from
-        vertex degrees)."""
-        sequences = [[str(v)] * max(graph.degree(v), 1)
-                     for v in range(graph.num_vertices())]
-        self.build_vocab(sequences)
-        return self
-
-    def fit_graph(self, graph: Graph, walk_iterator: Optional[RandomWalkIterator] = None):
-        if self.vocab is None:
-            self.initialize(graph)
-        walks: List[List[str]] = []
-        for rep in range(self.walks_per_vertex):
-            it = walk_iterator or RandomWalkIterator(
-                graph, self.walk_length, seed=self.conf.seed + rep)
-            it.reset()
-            for walk in it:
-                walks.append([str(v) for v in walk])
-            walk_iterator = None  # only reuse the custom iterator once
-        return super().fit(walks)
+    def _make_walker(self, graph: Graph, rep: int):
+        return RandomWalkIterator(graph, self.walk_length,
+                                  seed=self.conf.seed + rep)

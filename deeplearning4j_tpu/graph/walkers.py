@@ -80,3 +80,56 @@ class WeightedRandomWalkIterator(RandomWalkIterator):
         if e.directed:
             return e.dst
         return e.dst if e.src == current else e.src
+
+
+class Node2VecWalkIterator(RandomWalkIterator):
+    """Second-order biased walks (node2vec, Grover & Leskovec 2016;
+    reference module `deeplearning4j-nlp/.../models/node2vec/`).
+
+    Transition from current v (having arrived from t) to neighbor x is
+    weighted by: 1/p if x == t (return), 1 if x is adjacent to t
+    (BFS-ish stay-local), 1/q otherwise (DFS-ish explore). p is the
+    return parameter, q the in-out parameter."""
+
+    def __init__(self, graph: Graph, walk_length: int, p: float = 1.0,
+                 q: float = 1.0, seed: int = 0,
+                 no_edge_handling: NoEdgeHandling =
+                 NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED):
+        self.p = float(p)
+        self.q = float(q)
+        self._adj = [set(graph.get_connected_vertices(v))
+                     for v in range(graph.num_vertices())]
+        super().__init__(graph, walk_length, seed=seed,
+                         no_edge_handling=no_edge_handling)
+
+    def _biased_step(self, prev: int, current: int) -> int:
+        neighbors = self.graph.get_connected_vertices(current)
+        if not neighbors:
+            if self.no_edge_handling == NoEdgeHandling.EXCEPTION_ON_DISCONNECTED:
+                raise ValueError(f"Vertex {current} has no edges")
+            return current
+        w = np.empty(len(neighbors), np.float64)
+        prev_adj = self._adj[prev]
+        for i, x in enumerate(neighbors):
+            if x == prev:
+                w[i] = 1.0 / self.p
+            elif x in prev_adj:
+                w[i] = 1.0
+            else:
+                w[i] = 1.0 / self.q
+        w /= w.sum()
+        return neighbors[int(self._rng.choice(len(neighbors), p=w))]
+
+    def next(self) -> List[int]:
+        start = int(self._order[self._pos])
+        self._pos += 1
+        walk = [start]
+        if self.walk_length < 2:
+            return walk
+        current = self._step(start)  # first hop is unbiased (no prev)
+        walk.append(current)
+        for _ in range(self.walk_length - 2):
+            nxt = self._biased_step(walk[-2], current)
+            walk.append(nxt)
+            current = nxt
+        return walk

@@ -55,6 +55,26 @@ class SequenceVectorsConfig:
 
 
 # ------------------------------------------------------------ jitted steps
+def _row_counts(n_rows, *index_sets):
+    """How many times each table row is touched in the batch. Each
+    entry is an index array, or (indices, weights) for masked refs."""
+    c = jnp.zeros((n_rows,), jnp.float32)
+    for s in index_sets:
+        if isinstance(s, tuple):
+            idx, w = s
+            c = c.at[idx.reshape(-1)].add(w.reshape(-1).astype(jnp.float32))
+        else:
+            c = c.at[s.reshape(-1)].add(1.0)
+    return jnp.clip(c, 1.0, None)[:, None]
+
+
+# Batched treatment of word2vec's sequential per-pair updates: the
+# scatter-added (sum) row gradient is divided by the row's occurrence
+# count, so every touched row moves ~one per-pair step per flush
+# regardless of batch size. A plain batch mean shrinks steps by 1/B and
+# stalls small corpora; a plain sum diverges for frequent rows.
+
+
 @partial(jax.jit, static_argnums=(6,), donate_argnums=(0, 1))
 def _sg_neg_step(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
     """Skip-gram negative-sampling step. trainable_from: row index from
@@ -67,16 +87,19 @@ def _sg_neg_step(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
         pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, axis=-1))
         neg = jnp.sum(jax.nn.log_sigmoid(
             -jnp.einsum("bd,bkd->bk", v, u_neg)), axis=-1)
-        return -jnp.mean(pos + neg)
+        return -jnp.sum(pos + neg)
 
     loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1neg)
+    g0 = g0 / _row_counts(syn0.shape[0], centers)
+    g1 = g1 / _row_counts(syn1neg.shape[0], contexts, negs)
     if trainable_from > 0:
         # inference mode: only rows >= trainable_from learn; the output
         # table is frozen entirely (reference inferVector semantics)
         row_ok = (jnp.arange(syn0.shape[0]) >= trainable_from)[:, None]
         g0 = jnp.where(row_ok, g0, 0.0)
         g1 = jnp.zeros_like(g1)
-    return syn0 - lr * g0, syn1neg - lr * g1, loss
+    return (syn0 - lr * g0, syn1neg - lr * g1,
+            loss / centers.shape[0])
 
 
 @partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
@@ -93,14 +116,17 @@ def _cbow_neg_step(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr, trainable_fr
         pos = jax.nn.log_sigmoid(jnp.sum(h * u_pos, axis=-1))
         neg = jnp.sum(jax.nn.log_sigmoid(
             -jnp.einsum("bd,bkd->bk", h, u_neg)), axis=-1)
-        return -jnp.mean(pos + neg)
+        return -jnp.sum(pos + neg)
 
     loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1neg)
+    g0 = g0 / _row_counts(syn0.shape[0], (ctx, ctx_mask))
+    g1 = g1 / _row_counts(syn1neg.shape[0], centers, negs)
     if trainable_from > 0:
         row_ok = (jnp.arange(syn0.shape[0]) >= trainable_from)[:, None]
         g0 = jnp.where(row_ok, g0, 0.0)
         g1 = jnp.zeros_like(g1)
-    return syn0 - lr * g0, syn1neg - lr * g1, loss
+    return (syn0 - lr * g0, syn1neg - lr * g1,
+            loss / centers.shape[0])
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
@@ -116,10 +142,12 @@ def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, centers, points, codes, code_mask, 
         u = jnp.take(s1, points, axis=0)                       # [B,C,D]
         sign = 1.0 - 2.0 * codes
         logits = jnp.einsum("bd,bcd->bc", h, u) * sign
-        return -jnp.sum(jax.nn.log_sigmoid(logits) * code_mask) / centers.shape[0]
+        return -jnp.sum(jax.nn.log_sigmoid(logits) * code_mask)
 
     loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1)
-    return syn0 - lr * g0, syn1 - lr * g1, loss
+    g0 = g0 / _row_counts(syn0.shape[0], (ctx, ctx_mask))
+    g1 = g1 / _row_counts(syn1.shape[0], (points, code_mask))
+    return syn0 - lr * g0, syn1 - lr * g1, loss / centers.shape[0]
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
@@ -132,10 +160,12 @@ def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
         u = jnp.take(s1, points, axis=0)                       # [B,C,D]
         sign = 1.0 - 2.0 * codes                               # code 0 → +1
         logits = jnp.einsum("bd,bcd->bc", v, u) * sign
-        return -jnp.sum(jax.nn.log_sigmoid(logits) * code_mask) / centers.shape[0]
+        return -jnp.sum(jax.nn.log_sigmoid(logits) * code_mask)
 
     loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1)
-    return syn0 - lr * g0, syn1 - lr * g1, loss
+    g0 = g0 / _row_counts(syn0.shape[0], centers)
+    g1 = g1 / _row_counts(syn1.shape[0], (points, code_mask))
+    return syn0 - lr * g0, syn1 - lr * g1, loss / centers.shape[0]
 
 
 class SequenceVectors:
@@ -288,7 +318,7 @@ class SequenceVectors:
 
     # ----------------------------------------------------------------- fit
     def fit(self, sequences, extra_rows: int = 0, trainable_from: int = 0,
-            pair_hook=None):
+            pair_hook=None, total_words: Optional[int] = None):
         """Train. `sequences`: iterable (re-iterable across epochs) of
         token lists. Returns self."""
         conf = self.conf
@@ -304,7 +334,12 @@ class SequenceVectors:
         sg_flush = self._flush_sg_hs if use_hs else self._flush_sg_neg
         cbow_flush = self._flush_cbow_hs if use_hs else self._flush_cbow_neg
 
-        total_words = max(self.vocab.total_word_count * conf.epochs, 1)
+        # lr decays linearly over the full corpus; when the training
+        # corpus differs from the vocab-construction corpus (graph
+        # walks vs degree sequences), the caller passes the real size
+        if total_words is None:
+            total_words = self.vocab.total_word_count
+        total_words = max(total_words * conf.epochs, 1)
         words_seen = 0
         self.last_loss = 0.0
         B = conf.batch_size

@@ -114,3 +114,80 @@ class TestDeepWalk:
         dw = DeepWalk(vector_size=8, walk_length=10, epochs=1)
         dw.fit_graph(g)
         assert dw.get_vertex_vector(0).shape == (8,)
+
+
+class TestNode2Vec:
+    """Node2Vec (reference `models/node2vec/`): p/q-biased walks +
+    negative-sampling skip-gram."""
+
+    def _two_communities(self, n_per=8, seed=0):
+        """Two dense cliques joined by a single bridge edge."""
+        from deeplearning4j_tpu.graph.graph import Graph
+        g = Graph(2 * n_per)
+        for base in (0, n_per):
+            for i in range(n_per):
+                for j in range(i + 1, n_per):
+                    g.add_edge(base + i, base + j, directed=False)
+        g.add_edge(n_per - 1, n_per, directed=False)  # bridge
+        labels = [0] * n_per + [1] * n_per
+        return g, labels
+
+    def test_biased_walks_stay_local_with_high_q(self):
+        from deeplearning4j_tpu.graph.walkers import (
+            Node2VecWalkIterator, RandomWalkIterator,
+        )
+        g, labels = self._two_communities()
+
+        def cross_fraction(it):
+            crosses = total = 0
+            it.reset()
+            for walk in it:
+                for a, b in zip(walk, walk[1:]):
+                    crosses += labels[a] != labels[b]
+                    total += 1
+            return crosses / total
+
+        uniform = cross_fraction(RandomWalkIterator(g, 20, seed=1))
+        local = np.mean([cross_fraction(
+            Node2VecWalkIterator(g, 20, p=1.0, q=8.0, seed=s))
+            for s in (1, 2, 3)])
+        assert local <= uniform * 1.05
+
+    def test_node2vec_walk_determinism(self):
+        from deeplearning4j_tpu.graph.walkers import Node2VecWalkIterator
+        g, _ = self._two_communities()
+        w1 = list(Node2VecWalkIterator(g, 10, p=0.5, q=2.0, seed=7))
+        w2 = list(Node2VecWalkIterator(g, 10, p=0.5, q=2.0, seed=7))
+        assert w1 == w2
+
+    def test_node2vec_separates_communities_and_beats_deepwalk(self):
+        from deeplearning4j_tpu.graph import DeepWalk, Node2Vec
+        g, labels = self._two_communities()
+
+        def community_score(model):
+            import numpy as np
+            vecs = np.stack([np.asarray(model.get_word_vector(str(v)))
+                             for v in range(g.num_vertices())])
+            vecs = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+            sims = vecs @ vecs.T
+            n = len(labels)
+            same = [sims[i, j] for i in range(n) for j in range(n)
+                    if i < j and labels[i] == labels[j]]
+            diff = [sims[i, j] for i in range(n) for j in range(n)
+                    if i < j and labels[i] != labels[j]]
+            return float(np.mean(same) - np.mean(diff))
+
+        n2v = Node2Vec(vector_size=16, window_size=4, walk_length=20,
+                       walks_per_vertex=6, p=1.0, q=4.0, epochs=15,
+                       learning_rate=0.25, batch_size=128, seed=11)
+        n2v.fit_graph(g)
+        n2v_score = community_score(n2v)
+        assert n2v_score > 0.5  # communities clearly separated
+
+        dw = DeepWalk(vector_size=16, window_size=4, walk_length=20,
+                      walks_per_vertex=6, epochs=15, learning_rate=0.25,
+                      batch_size=128, seed=11)
+        dw.fit_graph(g)
+        # the community-biased (q>1) walks must do at least as well as
+        # uniform DeepWalk walks on a community-structured graph
+        assert n2v_score >= community_score(dw) - 0.05
