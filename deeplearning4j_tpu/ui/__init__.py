@@ -10,3 +10,14 @@ from deeplearning4j_tpu.ui.storage import (
 )
 from deeplearning4j_tpu.ui.server import UIServer
 from deeplearning4j_tpu.ui.convolutional import ConvolutionalIterationListener
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartLine,
+    ChartScatter,
+    ChartStyle,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    component_from_dict,
+    component_from_json,
+)

@@ -34,9 +34,16 @@ def activations_to_grid(act: np.ndarray, pad: int = 1) -> np.ndarray:
 
 
 class ConvolutionalIterationListener(TrainingListener):
-    def __init__(self, output_dir, frequency: int = 10):
-        self.output_dir = Path(output_dir)
-        self.output_dir.mkdir(parents=True, exist_ok=True)
+    def __init__(self, output_dir=None, frequency: int = 10, ui_server=None):
+        """`output_dir`: save tiled grids as PNG files; `ui_server`:
+        also feed the UIServer's /activations module (reference play
+        `module/convolutional/`). At least one sink must be given."""
+        if output_dir is None and ui_server is None:
+            raise ValueError("need output_dir and/or ui_server")
+        self.output_dir = None if output_dir is None else Path(output_dir)
+        if self.output_dir is not None:
+            self.output_dir.mkdir(parents=True, exist_ok=True)
+        self.ui_server = ui_server
         self.frequency = max(1, frequency)
 
     def iteration_done(self, model, iteration, epoch, score, **info):
@@ -52,11 +59,14 @@ class ConvolutionalIterationListener(TrainingListener):
                 collect=True)
         except Exception:
             return
-        from PIL import Image
         for li, act in enumerate(acts):
             a = np.asarray(act)
             if a.ndim != 4:  # NHWC conv activations only
                 continue
             grid = activations_to_grid(a[0])
-            Image.fromarray(grid).save(
-                self.output_dir / f"iter{iteration:06d}_layer{li}.png")
+            if self.output_dir is not None:
+                from PIL import Image
+                Image.fromarray(grid).save(
+                    self.output_dir / f"iter{iteration:06d}_layer{li}.png")
+            if self.ui_server is not None:
+                self.ui_server.post_activation_grid(f"layer{li}", grid)

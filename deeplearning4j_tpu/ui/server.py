@@ -1,42 +1,36 @@
 """Training UI web server.
 
 Reference: `play/PlayUIServer.java` (embedded Play/Netty server) with
-`module/train/TrainModule.java` routes `/train/overview|model|system`.
-Here: stdlib ThreadingHTTPServer (the embedded-server role), same
-routes serving a self-contained HTML dashboard (inline SVG charts, no
-external assets) plus JSON APIs and the /remote receiver endpoint
-(reference `RemoteReceiverModule`).
+pluggable UIModules: `module/train/TrainModule.java` routes
+`/train/overview|model|system` (:93-105), `module/tsne/` (t-SNE
+visualization), `module/convolutional/` (activation grids), and
+`module/remote/RemoteReceiverModule` (train-here-view-there POST
+receiver). Here: stdlib ThreadingHTTPServer serving the same route
+surface with self-contained pages built from the declarative component
+library (`ui/components.py` — the ui-components equivalent), plus JSON
+APIs.
 """
 
 from __future__ import annotations
 
+import base64
+import html as _html
+import io
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, List, Optional
 
+import numpy as np
+
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartLine,
+    ChartScatter,
+    ComponentTable,
+)
 from deeplearning4j_tpu.ui.stats import StatsReport
 from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
-
-
-def _svg_line_chart(xs, ys, width=640, height=240, label="score"):
-    if not xs:
-        return "<svg/>"
-    xmin, xmax = min(xs), max(xs) or 1
-    ymin, ymax = min(ys), max(ys)
-    if ymax == ymin:
-        ymax = ymin + 1
-    pts = []
-    for x, y in zip(xs, ys):
-        px = 40 + (x - xmin) / max(xmax - xmin, 1e-9) * (width - 60)
-        py = height - 30 - (y - ymin) / (ymax - ymin) * (height - 50)
-        pts.append(f"{px:.1f},{py:.1f}")
-    return (f'<svg width="{width}" height="{height}">'
-            f'<rect width="{width}" height="{height}" fill="#fafafa"/>'
-            f'<polyline fill="none" stroke="#2a6fdb" stroke-width="1.5" '
-            f'points="{" ".join(pts)}"/>'
-            f'<text x="45" y="18" font-size="12">{label} '
-            f'(last: {ys[-1]:.5g})</text></svg>')
 
 
 class UIServer:
@@ -46,13 +40,16 @@ class UIServer:
 
     def __init__(self, port: int = 0):
         self.storage: StatsStorage = InMemoryStatsStorage()
+        self._tsne: Dict[str, dict] = {}          # session → {coords, labels}
+        self._activations: Dict[str, bytes] = {}  # name → PNG bytes
+        self._module_lock = threading.Lock()      # guards the two dicts
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
 
-            def _send(self, code, body, ctype="text/html"):
+            def _send(self, code, body, ctype="text/html; charset=utf-8"):
                 if isinstance(body, str):
                     body = body.encode()
                 self.send_response(code)
@@ -69,6 +66,18 @@ class UIServer:
                     self._send(200, outer._model_html())
                 elif path == "/train/system":
                     self._send(200, outer._system_html())
+                elif path == "/tsne":
+                    self._send(200, outer._tsne_html())
+                elif path == "/activations":
+                    self._send(200, outer._activations_html())
+                elif path.startswith("/activations/img/"):
+                    name = path.rsplit("/", 1)[1]
+                    with outer._module_lock:
+                        png = outer._activations.get(name)
+                    if png is None:
+                        self._send(404, "not found")
+                    else:
+                        self._send(200, png, "image/png")
                 elif path == "/api/sessions":
                     self._send(200, json.dumps(outer.storage.list_session_ids()),
                                "application/json")
@@ -80,17 +89,32 @@ class UIServer:
                         "examples_per_sec": r.examples_per_sec,
                         "memory_rss_mb": r.memory_rss_mb,
                     } for r in reports]), "application/json")
+                elif path.startswith("/api/components/"):
+                    # declarative-component JSON for custom frontends
+                    sid = path.rsplit("/", 1)[1]
+                    chart = outer._score_chart(sid)
+                    self._send(200, chart.to_json(), "application/json")
                 else:
                     self._send(404, "not found")
 
             def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
                 if self.path == "/remote":
-                    n = int(self.headers.get("Content-Length", 0))
                     try:
-                        report = StatsReport.decode(self.rfile.read(n))
+                        report = StatsReport.decode(body)
                         outer.storage.put_report(report)
                         self._send(200, '{"status":"ok"}', "application/json")
                     except Exception as e:  # noqa: BLE001 — server boundary
+                        self._send(400, json.dumps({"error": str(e)}),
+                                   "application/json")
+                elif self.path == "/tsne/upload":
+                    try:
+                        d = json.loads(body)
+                        outer.post_tsne(d.get("session", "default"),
+                                        d["coords"], d.get("labels"))
+                        self._send(200, '{"status":"ok"}', "application/json")
+                    except Exception as e:  # noqa: BLE001
                         self._send(400, json.dumps({"error": str(e)}),
                                    "application/json")
                 else:
@@ -100,44 +124,112 @@ class UIServer:
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    # --------------------------------------------------------- module data
+    def post_tsne(self, session: str, coords, labels=None):
+        """t-SNE module upload (reference play `module/tsne/`)."""
+        coords = np.asarray(coords, np.float64)
+        if coords.ndim != 2 or coords.shape[1] != 2 or coords.shape[0] == 0:
+            raise ValueError(f"coords must be non-empty [N, 2], got {coords.shape}")
+        with self._module_lock:
+            self._tsne[session] = {
+                "coords": coords.tolist(),
+                "labels": [str(l) for l in labels] if labels is not None else None,
+            }
+        return self
+
+    def post_activation_grid(self, name: str, grid: np.ndarray):
+        """Activations module feed (reference `module/convolutional/`):
+        a [H, W] uint8 grid from `activations_to_grid`."""
+        from PIL import Image
+        buf = io.BytesIO()
+        Image.fromarray(np.asarray(grid, np.uint8)).save(buf, format="PNG")
+        with self._module_lock:
+            self._activations[name] = buf.getvalue()
+        return self
+
     # ------------------------------------------------------------- pages
     def _sessions(self):
         return self.storage.list_session_ids()
 
     def _nav(self, active):
+        pages = [("overview", "/train/overview"), ("model", "/train/model"),
+                 ("system", "/train/system"), ("tsne", "/tsne"),
+                 ("activations", "/activations")]
         links = "".join(
-            f'<a href="/train/{p}" style="margin-right:16px;'
+            f'<a href="{url}" style="margin-right:16px;'
             f'{"font-weight:bold" if p == active else ""}">{p.title()}</a>'
-            for p in ("overview", "model", "system"))
+            for p, url in pages)
         return f'<div style="padding:8px;border-bottom:1px solid #ddd">{links}</div>'
+
+    def _score_chart(self, sid, reports=None) -> ChartLine:
+        if reports is None:
+            reports = self.storage.get_reports(sid)
+        chart = ChartLine(title=f"score — {sid}")
+        chart.add_series("score", [r.iteration for r in reports],
+                         [r.score for r in reports])
+        return chart
 
     def _overview_html(self):
         body = [self._nav("overview")]
         for sid in self._sessions():
             reports = self.storage.get_reports(sid)
             xs = [r.iteration for r in reports]
-            ys = [r.score for r in reports]
-            body.append(f"<h3>Session {sid}</h3>")
-            body.append(_svg_line_chart(xs, ys, label="score"))
-            if reports and reports[-1].examples_per_sec:
-                body.append(_svg_line_chart(
-                    xs, [r.examples_per_sec for r in reports],
-                    label="examples/sec"))
+            body.append(f"<h3>Session {_html.escape(str(sid))}</h3>")
+            body.append(self._score_chart(sid, reports).render())
+            if reports and any(r.examples_per_sec for r in reports):
+                perf = ChartLine(title="throughput")
+                perf.add_series("examples/sec", xs,
+                                [r.examples_per_sec for r in reports])
+                body.append(perf.render())
         if len(body) == 1:
             body.append("<p>No training sessions attached yet.</p>")
         return self._page("Training Overview", "".join(body))
 
     def _model_html(self):
+        """Per-layer drill-down: mean-magnitude timelines for params and
+        updates + latest histograms (reference TrainModule model view)."""
         body = [self._nav("model")]
         for sid in self._sessions():
+            reports = self.storage.get_reports(sid)
             latest = self.storage.latest_report(sid)
             if latest is None:
                 continue
-            body.append(f"<h3>Session {sid} — mean |param| by layer</h3><table border=1 cellpadding=4>")
-            body.append("<tr><th>param</th><th>mean magnitude</th></tr>")
-            for k, v in sorted(latest.param_mean_magnitudes.items()):
-                body.append(f"<tr><td>{k}</td><td>{v:.6g}</td></tr>")
-            body.append("</table>")
+            body.append(f"<h3>Session {_html.escape(str(sid))}</h3>")
+            xs = [r.iteration for r in reports]
+            by_layer: Dict[str, List[str]] = {}
+            for key in latest.param_mean_magnitudes:
+                lk = key.split("_", 1)[0]
+                by_layer.setdefault(lk, []).append(key)
+            for lk in sorted(by_layer, key=str):
+                chart = ChartLine(title=f"layer {lk} — mean |param|")
+                for key in sorted(by_layer[lk]):
+                    chart.add_series(
+                        key, xs,
+                        [r.param_mean_magnitudes.get(key, 0.0)
+                         for r in reports])
+                upd_keys = [k for k in latest.update_mean_magnitudes
+                            if k.split("_", 1)[0] == lk]
+                for key in sorted(upd_keys):
+                    chart.add_series(
+                        f"Δ{key}", xs,
+                        [r.update_mean_magnitudes.get(key, 0.0)
+                         for r in reports])
+                body.append(chart.render())
+                for key in sorted(by_layer[lk]):
+                    hist = latest.param_histograms.get(key)
+                    if hist:
+                        edges, counts = hist
+                        h = ChartHistogram(title=f"{key} distribution")
+                        for lo, hi, c in zip(edges[:-1], edges[1:], counts):
+                            h.add_bin(lo, hi, c)
+                        body.append(h.render())
+            body.append(ComponentTable(
+                ["param", "mean |value|"],
+                [(k, f"{v:.6g}")
+                 for k, v in sorted(latest.param_mean_magnitudes.items())],
+                title="latest parameter magnitudes").render())
+        if len(body) == 1:
+            body.append("<p>No model stats yet.</p>")
         return self._page("Model", "".join(body))
 
     def _system_html(self):
@@ -146,11 +238,47 @@ class UIServer:
             reports = self.storage.get_reports(sid)
             if not reports:
                 continue
-            body.append(f"<h3>Session {sid}</h3>")
-            body.append(_svg_line_chart([r.iteration for r in reports],
-                                        [r.memory_rss_mb for r in reports],
-                                        label="RSS MB"))
+            xs = [r.iteration for r in reports]
+            body.append(f"<h3>Session {_html.escape(str(sid))}</h3>")
+            mem = ChartLine(title="memory")
+            mem.add_series("RSS MB", xs, [r.memory_rss_mb for r in reports])
+            body.append(mem.render())
+            t = ChartLine(title="iteration time")
+            t.add_series("ms/iter", xs,
+                         [r.iteration_time_ms for r in reports])
+            body.append(t.render())
         return self._page("System", "".join(body))
+
+    def _tsne_html(self):
+        body = [self._nav("tsne")]
+        with self._module_lock:
+            tsne = dict(self._tsne)
+        for session, d in tsne.items():
+            coords = np.asarray(d["coords"])
+            chart = ChartScatter(title=f"t-SNE — {session}")
+            chart.add_series("points", coords[:, 0].tolist(),
+                             coords[:, 1].tolist(), d.get("labels"))
+            chart.style.width, chart.style.height = 720, 540
+            body.append(chart.render())
+        if len(body) == 1:
+            body.append("<p>No t-SNE coordinates uploaded. POST JSON "
+                        '{"coords": [[x,y],...], "labels": [...]} '
+                        "to /tsne/upload.</p>")
+        return self._page("t-SNE", "".join(body))
+
+    def _activations_html(self):
+        body = [self._nav("activations")]
+        with self._module_lock:
+            grids = sorted(self._activations.items())
+        for name, png in grids:
+            b64 = base64.b64encode(png).decode()
+            name = _html.escape(name)
+            body.append(f"<h4>{name}</h4>"
+                        f'<img src="data:image/png;base64,{b64}" '
+                        f'style="image-rendering:pixelated;min-width:160px"/>')
+        if len(body) == 1:
+            body.append("<p>No activation grids posted yet.</p>")
+        return self._page("Activations", "".join(body))
 
     @staticmethod
     def _page(title, body):

@@ -131,6 +131,7 @@ class StatsListener:
         self.collect_histograms = collect_histograms
         self.histogram_bins = histogram_bins
         self._last_time = None
+        self._prev_params: Dict[str, np.ndarray] = {}
 
     # TrainingListener protocol
     def on_fit_start(self, model):
@@ -155,6 +156,13 @@ class StatsListener:
                 a = np.asarray(arr)
                 key = f"{lk}_{pn}"
                 report.param_mean_magnitudes[key] = float(np.mean(np.abs(a)))
+                prev = self._prev_params.get(key)
+                if prev is not None and prev.shape == a.shape:
+                    # update magnitude = |param delta| since last report
+                    # (reference BaseStatsListener update stats)
+                    report.update_mean_magnitudes[key] = float(
+                        np.mean(np.abs(a - prev)))
+                self._prev_params[key] = a
                 if self.collect_histograms:
                     counts, edges = np.histogram(a, bins=self.histogram_bins)
                     report.param_histograms[key] = (edges.tolist(),

@@ -131,3 +131,197 @@ class TestConvListener:
         net.fit(x, y, epochs=1, batch_size=4)
         pngs = list(tmp_path.glob("*.png"))
         assert len(pngs) >= 1  # at least the conv layer grid
+
+
+class TestComponents:
+    """ui-components equivalent (reference `components/chart/Chart.java`
+    family): JSON round-trip + self-contained rendering."""
+
+    def test_chart_line_roundtrip_and_render(self):
+        from deeplearning4j_tpu.ui import ChartLine, component_from_json
+        c = ChartLine(title="loss")
+        c.add_series("train", [0, 1, 2], [3.0, 2.0, 1.5])
+        c.add_series("val", [0, 1, 2], [3.2, 2.5, 2.0])
+        rt = component_from_json(c.to_json())
+        assert rt.to_dict() == c.to_dict()
+        svg = rt.render()
+        assert svg.count("<polyline") == 2 and "loss" in svg
+
+    def test_chart_histogram_roundtrip_and_render(self):
+        from deeplearning4j_tpu.ui import ChartHistogram, component_from_dict
+        h = ChartHistogram(title="weights")
+        for i in range(5):
+            h.add_bin(i, i + 1, 10 * i)
+        rt = component_from_dict(h.to_dict())
+        assert rt.to_dict() == h.to_dict()
+        assert rt.render().count("<rect") >= 5  # bg + bins
+
+    def test_chart_scatter_labels(self):
+        from deeplearning4j_tpu.ui import ChartScatter, component_from_dict
+        s = ChartScatter(title="tsne")
+        s.add_series("pts", [0.0, 1.0], [0.0, 1.0], ["a", "b"])
+        rt = component_from_dict(s.to_dict())
+        svg = rt.render()
+        assert svg.count("<circle") == 2 and ">a</text>" in svg
+
+    def test_table_text_div(self):
+        from deeplearning4j_tpu.ui import (
+            ComponentDiv, ComponentTable, ComponentText, component_from_dict,
+        )
+        div = ComponentDiv(ComponentText("hello"),
+                           ComponentTable(["k", "v"], [["a", 1]], title="t"))
+        rt = component_from_dict(div.to_dict())
+        html = rt.render()
+        assert "hello" in html and "<table" in html and "<h4>t</h4>" in html
+
+
+class TestUIModules:
+    def _train_with_stats(self, server):
+        storage = server.storage
+        listener = StatsListener(storage, session_id="s-mod",
+                                 collect_histograms=True)
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init().set_listeners(listener)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        net.fit(x, y, epochs=3, batch_size=8)
+
+    def test_model_drilldown_page(self):
+        import urllib.request
+        server = UIServer().start()
+        try:
+            self._train_with_stats(server)
+            html = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/train/model").read().decode()
+            # per-layer timeline charts + histograms + table
+            assert "mean |param|" in html
+            assert "<polyline" in html
+            assert "distribution" in html and "<rect" in html
+            assert "latest parameter magnitudes" in html
+            # update magnitudes appear after the first report
+            assert "Δ" in html
+        finally:
+            server.stop()
+
+    def test_system_page_has_timing(self):
+        import urllib.request
+        server = UIServer().start()
+        try:
+            self._train_with_stats(server)
+            html = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/train/system").read().decode()
+            assert "RSS MB" in html and "ms/iter" in html
+        finally:
+            server.stop()
+
+    def test_tsne_module_upload_and_render(self):
+        import urllib.request
+        server = UIServer().start()
+        try:
+            payload = json.dumps({
+                "session": "emb", "coords": [[0.0, 0.0], [1.0, 2.0]],
+                "labels": ["cat", "dog"]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/tsne/upload", data=payload,
+                method="POST")
+            assert json.loads(urllib.request.urlopen(req).read())["status"] == "ok"
+            html = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/tsne").read().decode()
+            assert "t-SNE — emb" in html and "cat" in html and "<circle" in html
+        finally:
+            server.stop()
+
+    def test_tsne_rejects_bad_coords(self):
+        import urllib.error
+        import urllib.request
+        server = UIServer().start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/tsne/upload",
+                data=json.dumps({"coords": [1, 2, 3]}).encode(),
+                method="POST")
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            server.stop()
+
+    def test_activations_module(self):
+        import urllib.request
+        server = UIServer().start()
+        try:
+            grid = (np.arange(64).reshape(8, 8) * 3).astype(np.uint8)
+            server.post_activation_grid("layer0", grid)
+            html = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/activations").read().decode()
+            assert "layer0" in html and "data:image/png;base64," in html
+            png = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/activations/img/layer0").read()
+            assert png[:8] == b"\x89PNG\r\n\x1a\n"
+        finally:
+            server.stop()
+
+    def test_conv_listener_feeds_ui_server(self):
+        from deeplearning4j_tpu.ui import ConvolutionalIterationListener
+        from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+        server = UIServer().start()
+        try:
+            conf = (NeuralNetConfiguration.builder().seed(0)
+                    .updater(Adam(1e-2)).list()
+                    .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                            activation="relu"))
+                    .layer(OutputLayer(n_out=2))
+                    .set_input_type(InputType.convolutional(8, 8, 1)).build())
+            net = MultiLayerNetwork(conf).init().set_listeners(
+                ConvolutionalIterationListener(frequency=1, ui_server=server))
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((4, 8, 8, 1)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+            net.fit(x, y, epochs=1, batch_size=4)
+            assert "layer0" in server._activations
+        finally:
+            server.stop()
+
+    def test_components_api_json(self):
+        import urllib.request
+        from deeplearning4j_tpu.ui import component_from_json
+        server = UIServer().start()
+        try:
+            self._train_with_stats(server)
+            raw = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/api/components/s-mod"
+            ).read().decode()
+            chart = component_from_json(raw)
+            assert chart.series and chart.series[0][0] == "score"
+        finally:
+            server.stop()
+
+    def test_tsne_labels_are_escaped(self):
+        import urllib.request
+        server = UIServer().start()
+        try:
+            payload = json.dumps({
+                "session": "x", "coords": [[0.0, 0.0]],
+                "labels": ["</text><script>alert(1)</script>"]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/tsne/upload", data=payload,
+                method="POST")
+            urllib.request.urlopen(req)
+            html = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/tsne").read().decode()
+            assert "<script>" not in html
+            assert "&lt;script&gt;" in html
+        finally:
+            server.stop()
+
+    def test_tsne_rejects_empty_coords(self):
+        server = UIServer()
+        with pytest.raises(ValueError):
+            server.post_tsne("s", np.zeros((0, 2)))
