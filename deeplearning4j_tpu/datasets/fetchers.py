@@ -212,3 +212,47 @@ class Cifar10DataSetIterator(ArrayDataSetIterator):
         self.is_synthetic = synthetic
         super().__init__(feats, labels, batch_size=batch_size,
                          shuffle=train, seed=seed)
+
+
+def load_lfw(num_examples: int | None = None, num_labels: int = 5749,
+             use_subset: bool = True, image_size: int = 64):
+    """LFW faces (reference `LFWDataSetIterator.java` / `LFWFetcher`:
+    13,233 images, 5,749 people; `use_subset` = the "lfw-a" subset).
+    Returns ([N, S, S, 3] float32 NHWC, one-hot labels, synthetic_flag).
+    Surrogate when offline: per-identity face-like templates (oval +
+    eye/mouth blobs at identity-specific offsets) + noise."""
+    n_ids = min(num_labels, 40 if use_subset else 5749)
+    n = num_examples or (1054 if use_subset else 13233)
+    rng = np.random.default_rng(31)
+    tpl_rng = np.random.default_rng(20260801)
+    s = image_size
+    yy, xx = np.mgrid[0:s, 0:s].astype(np.float32) / s
+    templates = []
+    for _ in range(n_ids):
+        cx, cy = tpl_rng.uniform(0.4, 0.6, 2)
+        rx, ry = tpl_rng.uniform(0.22, 0.3, 2)
+        face = np.clip(1.2 - (((xx - cx) / rx) ** 2 + ((yy - cy) / ry) ** 2), 0, 1)
+        for bx, by in ((cx - rx / 2, cy - ry / 3), (cx + rx / 2, cy - ry / 3),
+                       (cx, cy + ry / 2)):
+            face -= 0.5 * np.exp(-(((xx - bx) ** 2 + (yy - by) ** 2)
+                                   / tpl_rng.uniform(0.001, 0.004)))
+        tone = tpl_rng.uniform(0.5, 1.0, 3).astype(np.float32)
+        templates.append(np.clip(face[..., None] * tone, 0, 1).astype(np.float32))
+    labels = rng.integers(0, n_ids, size=n)
+    images = np.stack([templates[c] for c in labels])
+    noise = rng.standard_normal(images.shape, dtype=np.float32)
+    images = np.clip(images + 0.1 * noise, 0, 1)
+    return images, np.eye(n_ids, dtype=np.float32)[labels], True
+
+
+class LFWDataSetIterator(ArrayDataSetIterator):
+    """Reference `datasets/iterator/impl/LFWDataSetIterator.java`."""
+
+    def __init__(self, batch_size: int = 32, num_examples: int | None = None,
+                 num_labels: int = 5749, use_subset: bool = True,
+                 image_size: int = 64, train: bool = True, seed: int = 123):
+        feats, labels, synthetic = load_lfw(num_examples, num_labels,
+                                            use_subset, image_size)
+        self.is_synthetic = synthetic
+        super().__init__(feats, labels, batch_size=batch_size,
+                         shuffle=train, seed=seed)
