@@ -5,9 +5,14 @@ GraphVectors."""
 from deeplearning4j_tpu.graph.graph import Graph, Edge, Vertex
 from deeplearning4j_tpu.graph.loader import GraphLoader
 from deeplearning4j_tpu.graph.walkers import (
+    NearestVertexSamplingMode,
+    NearestVertexWalkIterator,
     NoEdgeHandling,
     Node2VecWalkIterator,
+    PopularityMode,
+    PopularityWalkIterator,
     RandomWalkIterator,
+    SpreadSpectrum,
     WeightedRandomWalkIterator,
 )
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors

@@ -133,3 +133,153 @@ class Node2VecWalkIterator(RandomWalkIterator):
             walk.append(nxt)
             current = nxt
         return walk
+
+
+class PopularityMode(str, Enum):
+    MAXIMUM = "maximum"
+    MINIMUM = "minimum"
+    AVERAGE = "average"
+
+
+class SpreadSpectrum(str, Enum):
+    PLAIN = "plain"               # uniform within the spread window
+    PROPORTIONAL = "proportional"  # degree-proportional within the window
+
+
+class PopularityWalkIterator(RandomWalkIterator):
+    """Degree-biased walks (reference
+    `graph/walkers/impl/PopularityWalker.java`): at each hop the
+    UNVISITED neighbors are ranked by their connection count, a window
+    of `spread` candidates is cut per `popularity_mode`
+    (MAXIMUM = most-connected end, MINIMUM = least-connected end,
+    AVERAGE = middle), and the next hop is drawn from that window —
+    uniformly (PLAIN) or degree-proportionally (PROPORTIONAL)."""
+
+    def __init__(self, graph: Graph, walk_length: int,
+                 popularity_mode: PopularityMode = PopularityMode.MAXIMUM,
+                 spread: int = 10,
+                 spectrum: SpreadSpectrum = SpreadSpectrum.PLAIN,
+                 seed: int = 0,
+                 no_edge_handling: NoEdgeHandling =
+                 NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED):
+        self.popularity_mode = PopularityMode(popularity_mode)
+        self.spread = max(1, spread)
+        self.spectrum = SpreadSpectrum(spectrum)
+        super().__init__(graph, walk_length, seed=seed,
+                         no_edge_handling=no_edge_handling)
+
+    def next(self) -> List[int]:
+        start = int(self._order[self._pos])
+        self._pos += 1
+        walk = [start]
+        visited = {start}
+        current = start
+        for _ in range(self.walk_length - 1):
+            neighbors = [v for v in self.graph.get_connected_vertices(current)
+                         if v not in visited]
+            if not neighbors:
+                if (self.no_edge_handling ==
+                        NoEdgeHandling.EXCEPTION_ON_DISCONNECTED):
+                    raise ValueError(f"Vertex {current} has no unvisited edges")
+                walk.append(current)       # self loop, like the base walker
+                continue
+            degrees = np.array(
+                [len(self.graph.get_connected_vertices(v)) for v in neighbors])
+            order = np.argsort(-degrees)   # most-popular first
+            w = min(self.spread, len(neighbors))
+            if self.popularity_mode == PopularityMode.MAXIMUM:
+                window = order[:w]
+            elif self.popularity_mode == PopularityMode.MINIMUM:
+                window = order[len(order) - w:]
+            else:  # AVERAGE: centered window
+                mid = len(order) // 2
+                lo = max(0, mid - w // 2)
+                window = order[lo:lo + w]
+            if self.spectrum == SpreadSpectrum.PROPORTIONAL:
+                p = degrees[window].astype(np.float64)
+                p = p / p.sum() if p.sum() > 0 else None
+                pick = int(self._rng.choice(window, p=p))
+            else:
+                pick = int(window[int(self._rng.integers(len(window)))])
+            current = neighbors[pick]
+            visited.add(current)
+            walk.append(current)
+        return walk
+
+
+class NearestVertexSamplingMode(str, Enum):
+    RANDOM = "random"
+    MAX_POPULARITY = "max_popularity"
+    MEDIAN_POPULARITY = "median_popularity"
+    MIN_POPULARITY = "min_popularity"
+
+
+class NearestVertexWalkIterator:
+    """Neighborhood sequences rather than walks (reference
+    `graph/walkers/impl/NearestVertexWalker.java`): for each vertex,
+    emit its connected vertices — all of them when `walk_length == 0`,
+    else `walk_length` of them chosen by `sampling_mode` over the
+    degree ranking; `depth > 1` recursively merges the neighbors'
+    neighborhoods (deduplicated)."""
+
+    def __init__(self, graph: Graph, walk_length: int = 0,
+                 sampling_mode: NearestVertexSamplingMode =
+                 NearestVertexSamplingMode.RANDOM,
+                 depth: int = 1, seed: int = 0, shuffle: bool = True):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.sampling_mode = NearestVertexSamplingMode(sampling_mode)
+        self.depth = max(1, depth)
+        self.seed = seed
+        self.shuffle = shuffle
+        self.reset()
+
+    def reset(self):
+        self._rng = np.random.default_rng(self.seed)
+        self._order = (self._rng.permutation(self.graph.num_vertices())
+                       if self.shuffle
+                       else np.arange(self.graph.num_vertices()))
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._order)
+
+    def _pick(self, neighbors: List[int]) -> List[int]:
+        if self.walk_length == 0 or len(neighbors) <= self.walk_length:
+            return list(neighbors)
+        L = self.walk_length
+        if self.sampling_mode == NearestVertexSamplingMode.RANDOM:
+            return [neighbors[i] for i in
+                    self._rng.choice(len(neighbors), L, replace=False)]
+        degrees = np.array(
+            [len(self.graph.get_connected_vertices(v)) for v in neighbors])
+        ranked = [neighbors[i] for i in np.argsort(-degrees)]
+        if self.sampling_mode == NearestVertexSamplingMode.MAX_POPULARITY:
+            return ranked[:L]
+        if self.sampling_mode == NearestVertexSamplingMode.MIN_POPULARITY:
+            return ranked[-L:]
+        lo = max(0, len(ranked) // 2 - L // 2)          # MEDIAN
+        return ranked[lo:lo + L]
+
+    def _walk(self, vertex: int, c_depth: int, seen) -> List[int]:
+        out = []
+        for v in self._pick(self.graph.get_connected_vertices(vertex)):
+            if v in seen:
+                continue       # dedup bounds the recursion: each vertex
+            seen.add(v)        # is expanded at most once
+            out.append(v)
+            if c_depth < self.depth:
+                out.extend(self._walk(v, c_depth + 1, seen))
+        return out
+
+    def next(self):
+        """Returns (label_vertex, neighbor_sequence) — the label is the
+        center vertex (reference sets it as the sequence label)."""
+        center = int(self._order[self._pos])
+        self._pos += 1
+        return center, self._walk(center, 1, {center})
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next()

@@ -1,0 +1,5 @@
+"""ML-pipeline adapters (the dl4j-spark-ml role, SURVEY §2.4): sklearn-
+style Estimator/Transformer wrappers around networks so they slot into
+sklearn Pipelines and model-selection tooling."""
+
+from deeplearning4j_tpu.ml.estimator import AutoEncoderEstimator, NetworkEstimator

@@ -36,3 +36,12 @@ from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 from deeplearning4j_tpu.nlp.bagofwords import CountVectorizer, TfidfVectorizer
 from deeplearning4j_tpu.nlp.iterator import CnnSentenceDataSetIterator
+from deeplearning4j_tpu.nlp.stopwords import StopWords, StopWordsRemover
+from deeplearning4j_tpu.nlp.invertedindex import InvertedIndex
+from deeplearning4j_tpu.nlp.documentiterator import (
+    CollectionDocumentIterator,
+    DocumentIterator,
+    FileDocumentIterator,
+    FileLabelAwareIterator,
+    FilenamesLabelAwareIterator,
+)

@@ -48,6 +48,7 @@ class SequenceVectorsConfig:
     epochs: int = 1
     iterations: int = 1         # passes per batch (reference `iterations`)
     batch_size: int = 2048      # pairs per device step
+    steps_per_flush: int = 8    # skip-gram batches fused into one scan dispatch
     subsampling: float = 0.0    # frequent-word discard threshold (e.g. 1e-3)
     seed: int = 42
     cbow: bool = False          # elements learning algorithm: CBOW vs SkipGram
@@ -75,10 +76,10 @@ def _row_counts(n_rows, *index_sets):
 # stalls small corpora; a plain sum diverges for frequent rows.
 
 
-@partial(jax.jit, static_argnums=(6,), donate_argnums=(0, 1))
-def _sg_neg_step(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
-    """Skip-gram negative-sampling step. trainable_from: row index from
-    which syn0 rows are trainable (0 = all; used by inferVector)."""
+def _sg_neg_math(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
+    """Skip-gram negative-sampling update math (shared by the single-step
+    jit and the fused scan). trainable_from: row index from which syn0
+    rows are trainable (0 = all; used by inferVector)."""
 
     def loss_fn(s0, s1):
         v = jnp.take(s0, centers, axis=0)                      # [B,D]
@@ -100,6 +101,39 @@ def _sg_neg_step(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
         g1 = jnp.zeros_like(g1)
     return (syn0 - lr * g0, syn1neg - lr * g1,
             loss / centers.shape[0])
+
+
+@partial(jax.jit, static_argnums=(6,), donate_argnums=(0, 1))
+def _sg_neg_step(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
+    return _sg_neg_math(syn0, syn1neg, centers, contexts, negs, lr,
+                        trainable_from)
+
+
+def _sg_neg_scan(syn0, syn1neg, centers, contexts, negs, lrs, trainable_from):
+    """k fused skip-gram batches in ONE dispatch (`lax.scan` over the
+    per-batch update). The reference amortizes its per-pair update cost
+    across Hogwild threads (`SequenceVectors.java:294`); on TPU the
+    equivalent lever is fewer, bigger dispatches — the host packs k
+    [B]-shaped batches while the device drains the previous group
+    (async dispatch, no host sync in between).
+
+    centers/contexts: [k,B]; negs: [k,B,K]; lrs: [k]. This is the one
+    copy of the fused math; it gets jitted twice — plain and
+    mesh-sharded (`_mesh_steps`)."""
+
+    def body(carry, inp):
+        s0, s1 = carry
+        c, x, n, lr = inp
+        s0, s1, loss = _sg_neg_math(s0, s1, c, x, n, lr, trainable_from)
+        return (s0, s1), loss
+
+    (syn0, syn1neg), losses = jax.lax.scan(
+        body, (syn0, syn1neg), (centers, contexts, negs, lrs))
+    return syn0, syn1neg, losses[-1]
+
+
+_sg_neg_multi = jax.jit(_sg_neg_scan, static_argnums=(6,),
+                        donate_argnums=(0, 1))
 
 
 @partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
@@ -171,7 +205,8 @@ def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
 class SequenceVectors:
     """Trains an embedding table over token sequences."""
 
-    def __init__(self, config: Optional[SequenceVectorsConfig] = None, **kw):
+    def __init__(self, config: Optional[SequenceVectorsConfig] = None, *,
+                 mesh=None, data_axis: str = "data", **kw):
         if config is None:
             config = SequenceVectorsConfig(**kw)
         self.conf = config
@@ -181,6 +216,17 @@ class SequenceVectors:
         self.syn1neg = None    # negative-sampling output table
         self._neg_table = None
         self._rng = np.random.default_rng(config.seed)
+        # mesh-sharded training (the dl4j-spark-nlp distributed Word2Vec
+        # capability, `spark/models/embeddings/word2vec/Word2Vec.java`):
+        # the pair batch shards over `data_axis`, tables stay replicated,
+        # and XLA inserts the grad all-reduce. Global-view jit semantics
+        # make the result bitwise-equivalent (up to reduction order) to
+        # single-device training. Covers the skip-gram paths; CBOW/HS
+        # fall back to unsharded steps.
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self._sharded_step = None
+        self._sharded_multi = None
 
     # ------------------------------------------------------------- vocab
     def build_vocab(self, sequences: Iterable[List[str]]):
@@ -271,12 +317,51 @@ class SequenceVectors:
         u = self._rng.random((B, K))
         return np.searchsorted(self._neg_cdf, u).astype(np.int32)
 
+    def _mesh_steps(self):
+        """Sharded jit variants of the skip-gram/neg steps (built lazily:
+        batch dims shard over `data_axis`, tables replicate)."""
+        if self._sharded_step is None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            mesh = self.mesh
+            repl = NamedSharding(mesh, P())
+            b1 = NamedSharding(mesh, P(self.data_axis))
+            b2 = NamedSharding(mesh, P(None, self.data_axis))
+            bk = NamedSharding(mesh, P(self.data_axis, None))
+            b3 = NamedSharding(mesh, P(None, self.data_axis, None))
+            self._sharded_step = jax.jit(
+                _sg_neg_math, static_argnums=(6,), donate_argnums=(0, 1),
+                in_shardings=(repl, repl, b1, b1, bk, None),
+                out_shardings=(repl, repl, None))
+            self._sharded_multi = jax.jit(
+                _sg_neg_scan, static_argnums=(6,), donate_argnums=(0, 1),
+                in_shardings=(repl, repl, b2, b2, b3, None),
+                out_shardings=(repl, repl, None))
+        return self._sharded_step, self._sharded_multi
+
     def _flush_sg_neg(self, centers, contexts, lr):
-        self.syn0, self.syn1neg, loss = _sg_neg_step(
+        step = _sg_neg_step
+        if self.mesh is not None and len(centers) % self.mesh.size == 0:
+            # ragged tails (not divisible by the mesh) run unsharded —
+            # replicated tables make that transparently correct
+            step, _ = self._mesh_steps()
+        self.syn0, self.syn1neg, loss = step(
             self.syn0, self.syn1neg, centers, contexts,
             self._sample_negatives(len(centers)),
             np.float32(lr), self._trainable_from)
-        return float(loss)
+        return loss
+
+    def _flush_sg_neg_multi(self, centers, contexts, lrs):
+        """centers/contexts: [k,B]; lrs: [k]. One fused dispatch, no
+        host sync — the loss comes back as a device array."""
+        multi = _sg_neg_multi
+        if self.mesh is not None and centers.shape[1] % self.mesh.size == 0:
+            _, multi = self._mesh_steps()
+        k, B = centers.shape
+        negs = self._sample_negatives(k * B).reshape(k, B, -1)
+        self.syn0, self.syn1neg, loss = multi(
+            self.syn0, self.syn1neg, centers, contexts, negs,
+            lrs.astype(np.float32), self._trainable_from)
+        return loss
 
     def _pack_cbow(self, pairs):
         # +1 slot so a DM label row fits even at the max reduced window
@@ -298,7 +383,7 @@ class SequenceVectors:
             self.syn0, self.syn1neg, ctx, mask, centers,
             self._sample_negatives(len(pairs)),
             np.float32(lr), self._trainable_from)
-        return float(loss)
+        return loss
 
     def _flush_cbow_hs(self, pairs, lr):
         ctx, mask, centers = self._pack_cbow(pairs)
@@ -306,7 +391,7 @@ class SequenceVectors:
             self.syn0, self.syn1, ctx, mask, centers,
             self._hs_points[centers], self._hs_codes[centers],
             self._hs_mask[centers], np.float32(lr))
-        return float(loss)
+        return loss
 
     def _flush_sg_hs(self, centers, contexts, lr):
         # Huffman paths precomputed as [V, C] tables → pure array indexing
@@ -314,7 +399,7 @@ class SequenceVectors:
             self.syn0, self.syn1, centers,
             self._hs_points[contexts], self._hs_codes[contexts],
             self._hs_mask[contexts], np.float32(lr))
-        return float(loss)
+        return loss
 
     # ----------------------------------------------------------------- fit
     def fit(self, sequences, extra_rows: int = 0, trainable_from: int = 0,
@@ -342,7 +427,14 @@ class SequenceVectors:
         total_words = max(total_words * conf.epochs, 1)
         words_seen = 0
         self.last_loss = 0.0
+        loss_dev = None      # device-side last loss — read ONCE after fit
         B = conf.batch_size
+        # fused flush group: skip-gram/neg drains k batches per dispatch;
+        # HS and iterations>1 keep per-batch flushes
+        k_group = (max(1, conf.steps_per_flush)
+                   if (array_path and not use_hs and conf.iterations == 1)
+                   else 1)
+        lr_prev = conf.learning_rate
         for epoch in range(conf.epochs):
             abuf_c, abuf_x, abuf_n = [], [], 0   # array buffers (skip-gram)
             lbuf = []                            # list buffer (CBOW)
@@ -366,30 +458,50 @@ class SequenceVectors:
                     while len(lbuf) >= B:
                         batch, lbuf = lbuf[:B], lbuf[B:]
                         for _ in range(conf.iterations):
-                            self.last_loss = cbow_flush(batch, lr)
+                            loss_dev = cbow_flush(batch, lr)
                     continue
                 if new is None:
                     continue
                 abuf_c.append(new[0]); abuf_x.append(new[1]); abuf_n += len(new[0])
-                while abuf_n >= B:
+                while abuf_n >= k_group * B:
                     cs = np.concatenate(abuf_c); xs = np.concatenate(abuf_x)
-                    batch_c, rest_c = cs[:B], cs[B:]
-                    batch_x, rest_x = xs[:B], xs[B:]
+                    take = k_group * B
+                    batch_c, rest_c = cs[:take], cs[take:]
+                    batch_x, rest_x = xs[:take], xs[take:]
                     abuf_c, abuf_x, abuf_n = [rest_c], [rest_x], len(rest_c)
-                    for _ in range(conf.iterations):
-                        self.last_loss = sg_flush(batch_c, batch_x, lr)
+                    if k_group > 1:
+                        # lr interpolated across the group — same decay
+                        # granularity the per-batch path would apply
+                        lrs = np.linspace(lr_prev, lr, k_group,
+                                          dtype=np.float32)
+                        loss_dev = self._flush_sg_neg_multi(
+                            batch_c.reshape(k_group, B),
+                            batch_x.reshape(k_group, B), lrs)
+                    else:
+                        for _ in range(conf.iterations):
+                            loss_dev = sg_flush(batch_c, batch_x, lr)
+                    lr_prev = lr
             tail_lr = max(conf.learning_rate * (1 - words_seen / total_words),
                           conf.min_learning_rate)
             if array_path and abuf_n:
                 cs = np.concatenate(abuf_c); xs = np.concatenate(abuf_x)
-                for _ in range(conf.iterations):
-                    self.last_loss = sg_flush(cs, xs, tail_lr)
+                # drain full-B batches at the compiled shape, then one
+                # ragged tail flush
+                while len(cs) >= B:
+                    for _ in range(conf.iterations):
+                        loss_dev = sg_flush(cs[:B], xs[:B], tail_lr)
+                    cs, xs = cs[B:], xs[B:]
+                if len(cs):
+                    for _ in range(conf.iterations):
+                        loss_dev = sg_flush(cs, xs, tail_lr)
             elif lbuf:
                 for _ in range(conf.iterations):
-                    self.last_loss = cbow_flush(lbuf, tail_lr)
+                    loss_dev = cbow_flush(lbuf, tail_lr)
         self.syn0 = np.asarray(self.syn0)
         self.syn1 = np.asarray(self.syn1)
         self.syn1neg = np.asarray(self.syn1neg)
+        if loss_dev is not None:
+            self.last_loss = float(loss_dev)
         return self
 
     # ------------------------------------------------------------- queries

@@ -28,4 +28,5 @@ from deeplearning4j_tpu.optimize.listeners import (
     TimeIterationListener,
     EvaluativeListener,
     ComposedListeners,
+    ProfilerListener,
 )

@@ -220,3 +220,70 @@ class ParamAndGradientIterationListener(TrainingListener):
                     msg += f" |g|={np.abs(g).mean():.4g}"
                 parts.append(msg)
         self.printer(" | ".join(parts))
+
+
+class ProfilerListener(TrainingListener):
+    """Wraps chosen training iterations in `jax.profiler` traces.
+
+    The reference's profiling story is PerformanceListener's wall-clock
+    sampling; SURVEY.md §5 maps the TPU equivalent to XLA traces: this
+    listener starts `jax.profiler.start_trace(log_dir)` at iteration
+    `start_iteration` and stops after `num_iterations`, producing a
+    TensorBoard-loadable trace directory (XLA op timeline, HBM usage,
+    host/device overlap). One trace window per fit() by default;
+    `trace_every_epoch` re-arms at each epoch start."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 1,
+                 num_iterations: int = 3, trace_every_epoch: bool = False):
+        import os
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.num_iterations = max(1, num_iterations)
+        self.trace_every_epoch = trace_every_epoch
+        self._active = False
+        self._armed = True
+        self._seen = 0
+        self._epoch_dir = None
+        os.makedirs(log_dir, exist_ok=True)
+
+    def _start(self, tag: str):
+        import os
+        import jax
+        self._epoch_dir = os.path.join(self.log_dir, tag)
+        jax.profiler.start_trace(self._epoch_dir)
+        self._active = True
+        self._seen = 0
+
+    def _stop(self):
+        import jax
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._armed = False
+
+    def on_epoch_start(self, model, epoch: int):
+        if self.trace_every_epoch:
+            self._armed = True
+
+    def iteration_done(self, model, iteration, epoch, score, **info):
+        if self._active:
+            self._seen += 1
+            if self._seen >= self.num_iterations:
+                self._stop()
+        elif self._armed and iteration + 1 >= self.start_iteration:
+            # start AFTER the compile-heavy first iterations so the trace
+            # shows steady-state device work, not tracing/compilation
+            self._start(f"epoch{epoch}_iter{iteration + 1}")
+
+    def on_fit_end(self, model):
+        self._stop()
+
+    def trace_dirs(self):
+        """Paths that contain profile data (for tooling/tests)."""
+        import os
+        out = []
+        for root, dirs, files in os.walk(self.log_dir):
+            if any(f.endswith((".pb", ".json.gz", ".trace.json.gz"))
+                   or "xplane" in f for f in files):
+                out.append(root)
+        return out

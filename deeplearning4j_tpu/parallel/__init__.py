@@ -35,6 +35,7 @@ from deeplearning4j_tpu.parallel.master import (
     SharedTrainingMaster,
     TrainingMaster,
 )
+from deeplearning4j_tpu.parallel.stats import TrainingMasterStats
 from deeplearning4j_tpu.parallel.multihost import (
     initialize_multihost,
     is_main_process,

@@ -27,6 +27,7 @@ import logging
 from typing import Optional
 
 from deeplearning4j_tpu.parallel.mesh import device_mesh
+from deeplearning4j_tpu.parallel.stats import TrainingMasterStats
 from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
 
 log = logging.getLogger(__name__)
@@ -50,29 +51,47 @@ class TrainingMaster:
 class ParameterAveragingTrainingMaster(TrainingMaster):
     def __init__(self, *, batch_size_per_worker: int = 32,
                  averaging_frequency: int = 5,
-                 average_updater_state: bool = True, mesh=None):
+                 average_updater_state: bool = True, mesh=None,
+                 collect_training_stats: bool = False):
         self.batch_size_per_worker = batch_size_per_worker
         self.averaging_frequency = averaging_frequency
         self.average_updater_state = average_updater_state
         self.mesh = mesh
+        # per-round phase timing + timeline export, the
+        # `ParameterAveragingTrainingMasterStats` role; opt-in like the
+        # reference's setCollectTrainingStats — it adds one device sync
+        # per timed phase
+        self.collect_training_stats = collect_training_stats
+        self.stats: TrainingMasterStats = None
 
     def execute_training(self, model, data, *, epochs: int = 1):
         mesh = self.mesh or device_mesh()
         n_workers = mesh.shape["data"]
+        self.stats = (TrainingMasterStats()
+                      if self.collect_training_stats else None)
         trainer = ParallelTrainer(
             model, mesh, mode="averaging",
             averaging_frequency=self.averaging_frequency,
-            average_updater_state=self.average_updater_state)
+            average_updater_state=self.average_updater_state,
+            stats=self.stats)
         x, y = self._split(data)
         return trainer.fit(x, y, epochs=epochs,
                            batch_size=self.batch_size_per_worker * n_workers)
 
+    def get_training_stats(self) -> TrainingMasterStats:
+        """Reference `getTrainingStats()` — per-round timeline; use
+        `.export_html(path)` / `.export_json(path)` (StatsUtils role)."""
+        return self.stats
+
 
 class SharedTrainingMaster(TrainingMaster):
     def __init__(self, *, batch_size_per_worker: int = 32, mesh=None,
-                 threshold: Optional[float] = None, **compression_knobs):
+                 threshold: Optional[float] = None,
+                 collect_training_stats: bool = False, **compression_knobs):
         self.batch_size_per_worker = batch_size_per_worker
         self.mesh = mesh
+        self.collect_training_stats = collect_training_stats
+        self.stats: TrainingMasterStats = None
         if threshold is not None or compression_knobs:
             log.info(
                 "SharedTrainingMaster: threshold-compression knobs %s are "
@@ -83,7 +102,13 @@ class SharedTrainingMaster(TrainingMaster):
     def execute_training(self, model, data, *, epochs: int = 1):
         mesh = self.mesh or device_mesh()
         n_workers = mesh.shape["data"]
-        trainer = ParallelTrainer(model, mesh, mode="sync")
+        self.stats = (TrainingMasterStats()
+                      if self.collect_training_stats else None)
+        trainer = ParallelTrainer(model, mesh, mode="sync",
+                                  stats=self.stats)
         x, y = self._split(data)
         return trainer.fit(x, y, epochs=epochs,
                            batch_size=self.batch_size_per_worker * n_workers)
+
+    def get_training_stats(self) -> TrainingMasterStats:
+        return self.stats

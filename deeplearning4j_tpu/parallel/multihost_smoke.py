@@ -1,0 +1,189 @@
+"""Multi-process distributed-training smoke proof.
+
+The reference proves its distributed path in-process on every CI run
+(`dl4j-spark/src/test/java/.../BaseSparkTest.java:89` — Spark
+`local[N]`). The TPU-native equivalent: N OS processes around a
+`jax.distributed` coordinator on the CPU backend, each owning 2 virtual
+local devices, all running the SAME global-view `ParallelTrainer` sync
+program over one global mesh. XLA's collectives ride the distributed
+runtime exactly as they would across TPU hosts over DCN.
+
+Usage (also wired into `__graft_entry__.dryrun_multichip` and
+`tests/test_multihost.py`):
+
+    python -m deeplearning4j_tpu.parallel.multihost_smoke --n 2
+
+Exit 0 iff (a) both processes see the 4-device global mesh, (b) sync
+training runs, and (c) the loss trajectory matches a single-process run
+on the same 4-device mesh (same global batch, same seeds) to float
+tolerance — proving the multi-process path computes the same global
+program.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+_LOCAL_DEVICES = 2   # virtual CPU devices per process
+
+
+def _build_model():
+    from deeplearning4j_tpu.common.updaters import Adam
+    from deeplearning4j_tpu.common.weights import WeightInit
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(21).updater(Adam(5e-2)).weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _run_training():
+    """Global-view sync training on whatever global mesh exists; returns
+    the per-iteration loss trajectory."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    model = _build_model()
+    listener = CollectScoresListener()
+    model.set_listeners(listener)
+    rng = np.random.default_rng(0)
+    B = 16
+    x = rng.standard_normal((B, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, B)]
+    ParallelTrainer(model, mesh, mode="sync").fit(x, y, epochs=5,
+                                                  batch_size=B)
+    return [s for _, s in listener.scores]
+
+
+def _worker_main(coordinator: str, n: int, i: int):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from deeplearning4j_tpu.parallel.multihost import initialize_multihost
+
+    initialize_multihost(coordinator, n, i)
+    assert jax.process_count() == n, jax.process_count()
+    assert len(jax.devices()) == n * _LOCAL_DEVICES, len(jax.devices())
+    losses = _run_training()
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+def _single_main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    losses = _run_training()
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(args, n_local_devices):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_local_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return subprocess.Popen(
+        [sys.executable, "-m", "deeplearning4j_tpu.parallel.multihost_smoke",
+         *args],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))))
+
+
+def _parse_losses(out: str):
+    for line in out.splitlines():
+        if line.startswith("LOSSES "):
+            return json.loads(line[len("LOSSES "):])
+    return None
+
+
+def run_smoke(n: int = 2, timeout: int = 420) -> dict:
+    """Orchestrate: n distributed workers + 1 single-process reference,
+    compare loss trajectories. Returns a report dict; raises on fail."""
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    procs = []
+    try:
+        workers = [_spawn(["--worker", str(i), "--n", str(n),
+                           "--coordinator", coord], _LOCAL_DEVICES)
+                   for i in range(n)]
+        procs.extend(workers)
+        single = _spawn(["--single"], n * _LOCAL_DEVICES)
+        procs.append(single)
+
+        results = []
+        for w in workers:
+            out, err = w.communicate(timeout=timeout)
+            if w.returncode != 0:
+                raise RuntimeError(
+                    f"worker failed rc={w.returncode}: {err[-800:]}")
+            results.append(_parse_losses(out))
+        sout, serr = single.communicate(timeout=timeout)
+        if single.returncode != 0:
+            raise RuntimeError(f"single-proc run failed: {serr[-800:]}")
+        ref = _parse_losses(sout)
+    finally:
+        # a dead worker leaves its peer blocked at the coordinator
+        # barrier forever — never leak the siblings
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    if any(r is None for r in results) or ref is None:
+        raise RuntimeError("missing LOSSES output")
+    for i, r in enumerate(results):
+        if len(r) != len(ref):
+            raise RuntimeError(f"worker {i} trajectory length {len(r)} != {len(ref)}")
+        for a, b in zip(r, ref):
+            if abs(a - b) > 1e-4 * max(1.0, abs(b)):
+                raise RuntimeError(
+                    f"worker {i} loss diverged from single-process run: "
+                    f"{r} vs {ref}")
+    return {"n_processes": n, "losses": results[0], "single_process": ref,
+            "match": True}
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    args = {argv[i]: argv[i + 1] if i + 1 < len(argv) else None
+            for i in range(len(argv)) if argv[i].startswith("--")}
+    if "--worker" in args:
+        _worker_main(args["--coordinator"], int(args["--n"]),
+                     int(args["--worker"]))
+    elif "--single" in args:
+        _single_main()
+    else:
+        report = run_smoke(int(args.get("--n", 2) or 2))
+        print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ Both modes reuse the model's own loss/updater machinery — no separate
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional
 
@@ -38,12 +39,36 @@ from deeplearning4j_tpu.optimize.listeners import ComposedListeners
 from deeplearning4j_tpu.parallel.mesh import device_mesh
 
 
+def _gput(arr, sharding):
+    """Place a host array under `sharding`. Single-process: device_put.
+    Multi-process (jax.distributed): every process holds the same host
+    value and contributes its addressable shards via
+    `make_array_from_callback` — device_put cannot address remote
+    devices. This is what lets the SAME global-view fit() run unchanged
+    under 1 or N processes (the Spark-RDD partition feed of
+    `ParameterAveragingTrainingMaster` collapses into the sharding)."""
+    a = np.asarray(arr)
+    if jax.process_count() > 1:
+        return jax.make_array_from_callback(a.shape, sharding,
+                                            lambda idx: a[idx])
+    return jax.device_put(a, sharding)
+
+
+def _gput_tree(tree, sharding):
+    return jax.tree_util.tree_map(lambda a: _gput(a, sharding), tree)
+
+
 class ParallelTrainer:
     def __init__(self, model, mesh: Optional[Mesh] = None, *,
                  mode: str = "sync", averaging_frequency: int = 5,
-                 average_updater_state: bool = True, data_axis: str = "data"):
+                 average_updater_state: bool = True, data_axis: str = "data",
+                 stats=None):
         if mode not in ("sync", "averaging"):
             raise ValueError(f"mode must be sync|averaging, got {mode}")
+        # stats: optional TrainingMasterStats — per-phase round timing
+        # (broadcast / local_fit / average / sync_step) at the cost of a
+        # device sync per timed phase (reference stats semantics)
+        self.stats = stats
         self.model = model
         self.mesh = mesh if mesh is not None else device_mesh()
         self.mode = mode
@@ -125,9 +150,10 @@ class ParallelTrainer:
         """Stack n_workers copies along a new leading axis, shard over data."""
         n = self.n_workers
         stacked = jax.tree_util.tree_map(
-            lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), tree)
+            lambda a: np.broadcast_to(np.asarray(a)[None], (n,) + np.shape(a)),
+            tree)
         sharding = NamedSharding(self.mesh, P(self.data_axis))
-        return jax.device_put(stacked, sharding)
+        return _gput_tree(stacked, sharding)
 
     def _unreplicate_tree(self, tree):
         return jax.tree_util.tree_map(lambda a: np.asarray(a[0]), tree)
@@ -147,19 +173,33 @@ class ParallelTrainer:
             if self._sync_step is None:
                 self._build_sync_step()
             repl = NamedSharding(self.mesh, P())
-            params = jax.device_put(model.params, repl)
-            upd = jax.device_put(model.updater_state, repl)
-            state = jax.device_put(model.net_state, repl)
+            if self.stats is not None:
+                with self.stats.time_phase("broadcast"):
+                    params = _gput_tree(model.params, repl)
+                    upd = _gput_tree(model.updater_state, repl)
+                    state = _gput_tree(model.net_state, repl)
+                    jax.block_until_ready(params)
+            else:
+                params = _gput_tree(model.params, repl)
+                upd = _gput_tree(model.updater_state, repl)
+                state = _gput_tree(model.net_state, repl)
             batch_sh = NamedSharding(self.mesh, P(self.data_axis))
             for _ in range(epochs):
                 iterator.reset()
                 for ds in iterator:
-                    x = jax.device_put(jnp.asarray(ds.features), batch_sh)
-                    y = jax.device_put(jnp.asarray(ds.labels), batch_sh)
+                    x = _gput(ds.features, batch_sh)
+                    y = _gput(ds.labels, batch_sh)
                     rng = jax.random.fold_in(rng_root, model.iteration_count)
+                    t0 = time.perf_counter()
                     params, upd, state, loss, _ = self._sync_step(
                         params, upd, state, model.iteration_count, x, y, rng)
                     model.score_value = float(loss)
+                    if self.stats is not None:
+                        # float(loss) above already synced the step
+                        self.stats.record("sync_step",
+                                          time.perf_counter() - t0,
+                                          iteration=model.iteration_count)
+                        self.stats.next_round()
                     listeners.iteration_done(model, model.iteration_count,
                                              model.epoch_count, model.score_value,
                                              batch_size=ds.num_examples())
@@ -173,26 +213,43 @@ class ParallelTrainer:
         # averaging (local SGD) mode
         if self._local_step is None:
             self._build_averaging()
-        params_r = self._replicate_tree(model.params)
-        upd_r = self._replicate_tree(model.updater_state)
-        state_r = self._replicate_tree(model.net_state)
+        if self.stats is not None:
+            with self.stats.time_phase("broadcast"):
+                params_r = self._replicate_tree(model.params)
+                upd_r = self._replicate_tree(model.updater_state)
+                state_r = self._replicate_tree(model.net_state)
+                jax.block_until_ready(params_r)
+        else:
+            params_r = self._replicate_tree(model.params)
+            upd_r = self._replicate_tree(model.updater_state)
+            state_r = self._replicate_tree(model.net_state)
         batch_sh = NamedSharding(self.mesh, P(self.data_axis))
         since_avg = 0
         for _ in range(epochs):
             iterator.reset()
             for ds in iterator:
-                x = jax.device_put(jnp.asarray(ds.features), batch_sh)
-                y = jax.device_put(jnp.asarray(ds.labels), batch_sh)
+                x = _gput(ds.features, batch_sh)
+                y = _gput(ds.labels, batch_sh)
                 rng = jax.random.fold_in(rng_root, model.iteration_count)
+                t0 = time.perf_counter()
                 params_r, upd_r, state_r, losses = self._local_step(
                     params_r, upd_r, state_r, model.iteration_count, x, y, rng)
                 model.score_value = float(jnp.mean(losses))
+                if self.stats is not None:
+                    self.stats.record("local_fit", time.perf_counter() - t0,
+                                      iteration=model.iteration_count)
                 since_avg += 1
                 if since_avg >= self.averaging_frequency:
+                    t0 = time.perf_counter()
                     params_r = self._average_fn(params_r)
                     state_r = self._average_fn(state_r)
                     if self.average_updater_state:
                         upd_r = self._average_fn(upd_r)
+                    if self.stats is not None:
+                        jax.block_until_ready(params_r)
+                        self.stats.record("average",
+                                          time.perf_counter() - t0,
+                                          round=self.stats.next_round())
                     since_avg = 0
                 listeners.iteration_done(model, model.iteration_count,
                                          model.epoch_count, model.score_value,

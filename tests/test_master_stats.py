@@ -1,0 +1,106 @@
+"""TrainingMaster round stats + timeline export, and the ProfilerListener
+trace hook (reference: `ParameterAveragingTrainingMasterStats.java`,
+`spark/stats/StatsUtils.java`; SURVEY §5 tracing row)."""
+
+import os
+import tempfile
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import ProfilerListener
+from deeplearning4j_tpu.parallel import (
+    ParameterAveragingTrainingMaster,
+    SharedTrainingMaster,
+    TrainingMasterStats,
+)
+
+
+def _model():
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=5, n_out=12, activation="relu"))
+            .layer(OutputLayer(n_in=12, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+class TestTrainingMasterStats:
+    def test_param_averaging_collects_round_timeline(self):
+        mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+        master = ParameterAveragingTrainingMaster(
+            batch_size_per_worker=8, averaging_frequency=2, mesh=mesh,
+            collect_training_stats=True)
+        master.execute_training(_model(), _data(), epochs=2)
+        stats = master.get_training_stats()
+        assert stats is not None
+        counts = stats.phase_counts()
+        assert counts.get("broadcast") == 1
+        assert counts.get("local_fit", 0) >= 2
+        assert counts.get("average", 0) >= 1
+        assert stats.round_count >= 1
+        totals = stats.phase_totals_ms()
+        assert all(v >= 0 for v in totals.values())
+
+    def test_shared_master_sync_steps_recorded(self):
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        master = SharedTrainingMaster(batch_size_per_worker=16, mesh=mesh,
+                                      collect_training_stats=True)
+        master.execute_training(_model(), _data(), epochs=1)
+        stats = master.get_training_stats()
+        assert stats.phase_counts().get("sync_step", 0) >= 1
+
+    def test_exports_and_listener_hook(self):
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        master = ParameterAveragingTrainingMaster(
+            batch_size_per_worker=8, averaging_frequency=1, mesh=mesh,
+            collect_training_stats=True)
+        events = []
+        master.stats = None
+        master.execute_training(_model(), _data(32), epochs=1)
+        stats = master.get_training_stats()
+        stats.add_listener(events.append)
+        stats.record("average", 0.001, round=99)
+        assert events and events[0]["phase"] == "average"
+        with tempfile.TemporaryDirectory() as d:
+            hp = stats.export_html(os.path.join(d, "timeline.html"))
+            jp = stats.export_json(os.path.join(d, "timeline.json"))
+            html = open(hp).read()
+            assert "TrainingMaster timeline" in html and "local_fit" in html
+            import json
+            data = json.loads(open(jp).read())
+            assert data["summary"]["events"] == len(data["timeline"])
+
+    def test_stats_off_by_default(self):
+        # opt-in like the reference's setCollectTrainingStats
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        master = SharedTrainingMaster(batch_size_per_worker=16, mesh=mesh)
+        master.execute_training(_model(), _data(32), epochs=1)
+        assert master.get_training_stats() is None
+
+
+class TestProfilerListener:
+    def test_trace_files_written(self):
+        net = _model()
+        x, y = _data(48)
+        with tempfile.TemporaryDirectory() as d:
+            pl = ProfilerListener(d, start_iteration=2, num_iterations=2)
+            net.set_listeners(pl)
+            net.fit(x, y, epochs=2, batch_size=16)
+            dirs = pl.trace_dirs()
+            assert dirs, "no profiler trace output written"
+            assert any("epoch0" in p for p in dirs)

@@ -1,0 +1,88 @@
+"""sklearn-style Estimator/Transformer adapters (reference
+`dl4j-spark-ml SparkDl4jNetwork.scala` / `AutoEncoder.scala`)."""
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.fetchers import load_iris
+from deeplearning4j_tpu.ml import AutoEncoderEstimator, NetworkEstimator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import SharedTrainingMaster
+
+
+def iris_conf():
+    return (NeuralNetConfiguration.builder().seed(42).updater(Adam(0.02))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+class TestNetworkEstimator:
+    def test_fit_predict_score(self):
+        x, y = load_iris()
+        est = NetworkEstimator(iris_conf, epochs=30, batch_size=50)
+        est.fit(x, y.argmax(axis=1))
+        assert est.score(x, y.argmax(axis=1)) > 0.9
+        proba = est.predict_proba(x[:5])
+        assert proba.shape == (5, 3)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_accepts_one_hot_labels_and_transform(self):
+        x, y = load_iris()
+        est = NetworkEstimator(iris_conf, epochs=5)
+        est.fit(x, y)           # already one-hot
+        assert est.transform(x).shape == (150, 3)
+
+    def test_params_roundtrip_sklearn_contract(self):
+        est = NetworkEstimator(iris_conf, epochs=3)
+        params = est.get_params()
+        assert params["epochs"] == 3
+        est.set_params(epochs=7, batch_size=16)
+        assert est.epochs == 7 and est.batch_size == 16
+
+    def test_distributed_fit_via_training_master(self):
+        x, y = load_iris()
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        master = SharedTrainingMaster(batch_size_per_worker=25, mesh=mesh,
+                                      collect_training_stats=False)
+        est = NetworkEstimator(iris_conf, epochs=20, training_master=master)
+        est.fit(x, y.argmax(axis=1))
+        assert est.score(x, y.argmax(axis=1)) > 0.85
+
+    def test_works_in_sklearn_pipeline_if_available(self):
+        try:
+            from sklearn.pipeline import Pipeline
+            from sklearn.preprocessing import StandardScaler
+        except ImportError:
+            return
+        x, y = load_iris()
+        pipe = Pipeline([("scale", StandardScaler()),
+                         ("net", NetworkEstimator(iris_conf, epochs=20))])
+        pipe.fit(x, y.argmax(axis=1))
+        assert pipe.score(x, y.argmax(axis=1)) > 0.9
+
+
+class TestAutoEncoderEstimator:
+    def test_codes_and_reconstruction(self):
+        rng = np.random.default_rng(0)
+        # two well-separated blobs in 8-d
+        a = rng.normal(0.2, 0.05, (40, 8))
+        b = rng.normal(0.8, 0.05, (40, 8))
+        X = np.vstack([a, b]).astype(np.float32)
+        est = AutoEncoderEstimator(n_hidden=3, epochs=60, batch_size=20,
+                                   learning_rate=5e-2, corruption_level=0.0)
+        codes = est.fit_transform(X)
+        assert codes.shape == (80, 3)
+        est.output = "reconstruction"
+        recon = est.transform(X)
+        assert recon.shape == X.shape
+        # reconstruction error must beat predicting the global mean
+        mse = float(((recon - X) ** 2).mean())
+        base = float(((X.mean(axis=0) - X) ** 2).mean())
+        assert mse < base

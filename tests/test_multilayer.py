@@ -204,3 +204,53 @@ class TestConfSerde:
         o1 = np.asarray(net.output(x))
         o2 = np.asarray(net.output(x))
         np.testing.assert_allclose(o1, o2)
+
+
+class TestStepsPerExecution:
+    """steps_per_execution fuses k steps into one lax.scan dispatch —
+    the loss trajectory must be bit-comparable to per-step dispatch."""
+
+    def _trajectory(self, spe, with_bn=False):
+        x, y = load_iris()
+        layers = [DenseLayer(n_in=4, n_out=16, activation="relu")]
+        if with_bn:
+            layers.append(BatchNormalization(n_out=16))
+        layers.append(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                  loss="mcxent"))
+        b = (NeuralNetConfiguration.builder().seed(42).updater(Adam(0.02))
+             .list())
+        for l in layers:
+            b.layer(l)
+        net = MultiLayerNetwork(b.build()).init()
+        listener = CollectScoresListener()
+        net.set_listeners(listener)
+        net.fit(x, y, epochs=4, batch_size=50, shuffle=False,
+                steps_per_execution=spe)
+        return [s for _, s in listener.scores], net
+
+    def test_fused_matches_per_step(self):
+        ref, net1 = self._trajectory(1)
+        fused, net4 = self._trajectory(4)
+        assert len(ref) == len(fused) == 12
+        np.testing.assert_allclose(fused, ref, rtol=2e-5, atol=1e-6)
+        for k in net1.param_table():
+            np.testing.assert_allclose(np.asarray(net4.param_table()[k]),
+                                       np.asarray(net1.param_table()[k]),
+                                       rtol=2e-4, atol=1e-5)
+
+    def test_fused_with_batchnorm_state(self):
+        ref, _ = self._trajectory(1, with_bn=True)
+        fused, _ = self._trajectory(3, with_bn=True)
+        np.testing.assert_allclose(fused, ref, rtol=2e-5, atol=1e-6)
+
+    def test_ragged_tail_and_shape_change(self):
+        # 150 examples / batch 40 -> 3 full + 1 ragged batch per epoch;
+        # fused path must flush the ragged tail through the single-step path
+        x, y = load_iris()
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        listener = CollectScoresListener()
+        net.set_listeners(listener)
+        net.fit(x, y, epochs=2, batch_size=40, shuffle=False,
+                steps_per_execution=4)
+        assert len(listener.scores) == 8
+        assert net.iteration_count == 8

@@ -237,3 +237,55 @@ class TestCnnSentenceIterator:
         assert ds.features.shape[3] == 1
         assert ds.labels.shape == (2, 2)
         assert ds.features_mask[1].sum() == 1  # "fun" → one token
+
+
+class TestDistributedSequenceVectors:
+    """Mesh-sharded embedding training (the dl4j-spark-nlp distributed
+    Word2Vec capability): pair batches shard over the data axis, tables
+    replicate, XLA inserts the grad all-reduce. Global-view jit
+    semantics mean the sharded run must match the single-device run."""
+
+    def _corpus(self):
+        rng = np.random.default_rng(11)
+        vocab = [f"w{i}" for i in range(50)]
+        return [[vocab[t] for t in rng.integers(0, 50, 60)]
+                for _ in range(30)]
+
+    def test_mesh_matches_single_device(self):
+        import jax
+        from jax.sharding import Mesh
+
+        seqs = self._corpus()
+        kw = dict(layer_size=16, window_size=3, negative_sample=3,
+                  min_word_frequency=1, epochs=2, batch_size=64, seed=5)
+        single = Word2Vec(**kw)
+        single.build_vocab(seqs)
+        single.fit(seqs)
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        sharded = Word2Vec(**kw)
+        sharded.mesh = mesh
+        sharded.build_vocab(seqs)
+        sharded.fit(seqs)
+
+        np.testing.assert_allclose(sharded.syn0, single.syn0,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(sharded.syn1neg, single.syn1neg,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_flush_trains(self):
+        # steps_per_flush>1 path must still learn co-occurrence structure
+        rng = np.random.default_rng(3)
+        seqs = []
+        for _ in range(120):
+            s = []
+            for _ in range(30):
+                s.extend(["sun", "moon"] if rng.random() < 0.5
+                         else ["cat", "dog"])
+            seqs.append(s)
+        w2v = Word2Vec(layer_size=24, window_size=2, negative_sample=4,
+                       epochs=3, batch_size=256, seed=1)
+        w2v.conf.steps_per_flush = 4
+        w2v.build_vocab(seqs)
+        w2v.fit(seqs)
+        assert w2v.similarity("sun", "moon") > w2v.similarity("sun", "dog")

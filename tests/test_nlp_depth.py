@@ -1,0 +1,183 @@
+"""NLP pipeline depth: stopwords, inverted index, document iterators,
+Popularity/NearestVertex graph walkers (reference: StopWords.java,
+InvertedIndex.java, text/documentiterator/, graph/walkers/impl/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    Graph,
+    NearestVertexSamplingMode,
+    NearestVertexWalkIterator,
+    PopularityMode,
+    PopularityWalkIterator,
+)
+from deeplearning4j_tpu.nlp import (
+    CollectionDocumentIterator,
+    FileDocumentIterator,
+    FileLabelAwareIterator,
+    FilenamesLabelAwareIterator,
+    InvertedIndex,
+    StopWords,
+    StopWordsRemover,
+    Word2Vec,
+)
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+
+class TestStopWords:
+    def test_default_list_filters(self):
+        sw = StopWords.default()
+        assert "the" in sw and "and" in sw
+        assert "tensor" not in sw
+        assert sw.filter(["the", "quick", "fox", "and", "hound"]) == \
+            ["quick", "fox", "hound"]
+
+    def test_case_insensitive_by_default(self):
+        assert StopWords.default().is_stop_word("The")
+
+    def test_custom_list_and_file(self, tmp_path):
+        p = tmp_path / "sw.txt"
+        p.write_text("foo\nbar\n")
+        sw = StopWords.from_file(str(p))
+        assert sw.is_stop_word("foo") and not sw.is_stop_word("the")
+
+    def test_remover_in_tokenizer_factory(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(StopWordsRemover())
+        toks = tf.create("the quick brown fox").get_tokens()
+        assert toks == ["quick", "brown", "fox"]
+
+
+class TestInvertedIndex:
+    def test_postings_and_frequencies(self):
+        idx = InvertedIndex()
+        idx.add_doc("the cat sat".split())
+        idx.add_doc("the cat ran".split())
+        idx.add_doc("dogs run".split())
+        assert idx.documents("cat") == [0, 1]
+        assert idx.documents("dogs") == [2]
+        assert idx.document_frequency("the") == 2
+        assert idx.term_frequency("the", 0) == 1
+        assert idx.total_words() == 8
+        assert idx.num_documents() == 3
+        assert idx.document(1) == ["the", "cat", "ran"]
+
+    def test_add_word_to_doc_and_batches(self):
+        idx = InvertedIndex()
+        for w in ["a", "b", "a"]:
+            idx.add_word_to_doc(0, w)
+        assert idx.term_frequency("a", 0) == 2
+        idx.add_doc(["c"], labels=["doc1"])
+        assert idx.doc_labels(1) == ["doc1"]
+        batches = list(idx.batch_doc_ids(1))
+        assert batches == [[0], [1]]
+
+
+class TestDocumentIterators:
+    def _tree(self, tmp_path):
+        (tmp_path / "pos").mkdir()
+        (tmp_path / "neg").mkdir()
+        (tmp_path / "pos" / "a.txt").write_text("good movie")
+        (tmp_path / "pos" / "b.txt").write_text("great film")
+        (tmp_path / "neg" / "c.txt").write_text("bad plot")
+        return tmp_path
+
+    def test_collection_iterator(self):
+        it = CollectionDocumentIterator(["doc one", "doc two"])
+        assert list(it) == ["doc one", "doc two"]
+        assert list(it) == ["doc one", "doc two"]  # reset works
+
+    def test_file_document_iterator(self, tmp_path):
+        self._tree(tmp_path)
+        docs = list(FileDocumentIterator(str(tmp_path)))
+        assert sorted(docs) == ["bad plot", "good movie", "great film"]
+
+    def test_file_label_aware(self, tmp_path):
+        self._tree(tmp_path)
+        docs = list(FileLabelAwareIterator(str(tmp_path)))
+        labels = {d.labels[0] for d in docs}
+        assert labels == {"pos", "neg"}
+        by_label = {d.content: d.labels[0] for d in docs}
+        assert by_label["bad plot"] == "neg"
+
+    def test_filenames_label_aware(self, tmp_path):
+        self._tree(tmp_path)
+        docs = list(FilenamesLabelAwareIterator(str(tmp_path)))
+        assert {d.labels[0] for d in docs} == {"a", "b", "c"}
+
+
+def _star_graph():
+    """Vertex 0 is a hub (degree 5); 1..5 are spokes; 5-6-7 a tail."""
+    g = Graph(8)
+    for v in range(1, 6):
+        g.add_edge(0, v, directed=False)
+    g.add_edge(5, 6, directed=False)
+    g.add_edge(6, 7, directed=False)
+    return g
+
+
+class TestPopularityWalker:
+    def test_walks_prefer_popular_nodes(self):
+        g = _star_graph()
+        it = PopularityWalkIterator(g, walk_length=4, spread=1,
+                                    popularity_mode=PopularityMode.MAXIMUM,
+                                    seed=0)
+        walks = list(it)
+        assert len(walks) == g.num_vertices()
+        for w in walks:
+            assert len(w) == 4
+        # from a spoke with spread=1/MAXIMUM the first hop must be the hub
+        by_start = {w[0]: w for w in walks}
+        assert by_start[1][1] == 0
+        assert by_start[2][1] == 0
+
+    def test_minimum_mode_avoids_hub(self):
+        g = _star_graph()
+        it = PopularityWalkIterator(g, walk_length=2, spread=1,
+                                    popularity_mode=PopularityMode.MINIMUM,
+                                    seed=0)
+        w = {w[0]: w for w in it}
+        # vertex 6's neighbors: 5 (degree 2) and 7 (degree 1) → 7 is least popular
+        assert w[6][1] == 7
+
+
+class TestNearestVertexWalker:
+    def test_unlimited_walk_is_full_neighborhood(self):
+        g = _star_graph()
+        it = NearestVertexWalkIterator(g, walk_length=0, shuffle=False)
+        seqs = dict(iter(it))
+        assert sorted(seqs[0]) == [1, 2, 3, 4, 5]
+        assert sorted(seqs[6]) == [5, 7]
+
+    def test_max_popularity_sampling(self):
+        g = _star_graph()
+        it = NearestVertexWalkIterator(
+            g, walk_length=1, shuffle=False,
+            sampling_mode=NearestVertexSamplingMode.MAX_POPULARITY)
+        seqs = dict(iter(it))
+        # vertex 5 connects to hub 0 (deg 5) and 6 (deg 2): top-1 is the hub
+        assert seqs[5] == [0]
+
+    def test_depth_two_merges_neighbors(self):
+        g = _star_graph()
+        it = NearestVertexWalkIterator(g, walk_length=0, depth=2,
+                                       shuffle=False)
+        seqs = dict(iter(it))
+        assert 6 in seqs[0]  # reached through spoke 5
+
+
+class TestStopwordsInWord2VecPipeline:
+    def test_stopwords_never_enter_vocab(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(StopWordsRemover())
+        w2v = Word2Vec(sentence_iterator=["the cat and the hat",
+                                          "a cat for the hat"],
+                       tokenizer_factory=tf, layer_size=8, epochs=1,
+                       min_word_frequency=1)
+        w2v.fit()
+        assert w2v.has_word("cat") and w2v.has_word("hat")
+        assert not w2v.has_word("the")
+        assert not w2v.has_word("and")
