@@ -20,3 +20,10 @@ from deeplearning4j_tpu.datasets.fetchers import (
     IrisDataSetIterator,
     MnistDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.parallel import (
+    BaseParallelDataSetIterator,
+    DevicePrefetchIterator,
+    FileSplitParallelDataSetIterator,
+    InequalityHandling,
+    JointParallelDataSetIterator,
+)

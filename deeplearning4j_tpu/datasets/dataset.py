@@ -19,22 +19,28 @@ class DataSet:
     labels: Optional[np.ndarray] = None
     features_mask: Optional[np.ndarray] = None
     labels_mask: Optional[np.ndarray] = None
+    # per-example record metadata (reference RecordMetaData carried by
+    # DataSet.getExampleMetaData) — list of len == num_examples, or None
+    example_metadata: Optional[list] = None
 
     def num_examples(self) -> int:
         return int(np.shape(self.features)[0])
 
     def split_test_and_train(self, num_train: int):
+        md = self.example_metadata
         train = DataSet(
             self.features[:num_train],
             None if self.labels is None else self.labels[:num_train],
             None if self.features_mask is None else self.features_mask[:num_train],
             None if self.labels_mask is None else self.labels_mask[:num_train],
+            None if md is None else md[:num_train],
         )
         test = DataSet(
             self.features[num_train:],
             None if self.labels is None else self.labels[num_train:],
             None if self.features_mask is None else self.features_mask[num_train:],
             None if self.labels_mask is None else self.labels_mask[num_train:],
+            None if md is None else md[num_train:],
         )
         return train, test
 
@@ -48,17 +54,21 @@ class DataSet:
             self.features_mask = self.features_mask[perm]
         if self.labels_mask is not None:
             self.labels_mask = self.labels_mask[perm]
+        if self.example_metadata is not None:
+            self.example_metadata = [self.example_metadata[i] for i in perm]
         return self
 
     def batch_by(self, batch_size: int):
         n = self.num_examples()
         out = []
+        md = self.example_metadata
         for i in range(0, n, batch_size):
             out.append(DataSet(
                 self.features[i:i + batch_size],
                 None if self.labels is None else self.labels[i:i + batch_size],
                 None if self.features_mask is None else self.features_mask[i:i + batch_size],
                 None if self.labels_mask is None else self.labels_mask[i:i + batch_size],
+                None if md is None else md[i:i + batch_size],
             ))
         return out
 

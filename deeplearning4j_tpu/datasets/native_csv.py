@@ -1,0 +1,121 @@
+"""Native CSV loader — ctypes binding over native/csv/dl4j_csv.cpp.
+
+Reference parity: DataVec's `CSVRecordReader` feeding
+`RecordReaderDataSetIterator` runs on the JVM with native-speed IO; the
+TPU framework's bulk-numeric path is the C++ single-pass parser
+(compiled on first use, like the HDF5 shim), with a NumPy fallback when
+no toolchain is available. Returns float32 matrices ready for
+`DataSet`/device upload; non-numeric fields parse as NaN so the caller
+chooses a policy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native" / "csv"
+_SRC = _NATIVE_DIR / "dl4j_csv.cpp"
+_SO = _NATIVE_DIR / "libdl4j_csv.so"
+
+_lib = None
+_lib_failed = False
+
+
+def _load_lib():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    try:
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            subprocess.run(
+                ["g++", "-O3", "-fPIC", "-shared", str(_SRC), "-o", str(_SO)],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(str(_SO))
+        lib.dl4j_csv_shape.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]
+        lib.dl4j_csv_shape.restype = ctypes.c_int
+        lib.dl4j_csv_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long]
+        lib.dl4j_csv_parse.restype = ctypes.c_long
+        _lib = lib
+    except Exception:
+        _lib_failed = True
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+def load_csv_matrix(path: str, *, delimiter: str = ",",
+                    skip_header: int = 0) -> np.ndarray:
+    """Parse a numeric CSV file into a float32 [rows, cols] matrix.
+    Unparseable fields become NaN."""
+    lib = _load_lib()
+    if lib is None:
+        return _numpy_fallback(path, delimiter, skip_header)
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    rc = lib.dl4j_csv_shape(str(path).encode(), delimiter.encode(),
+                            skip_header, ctypes.byref(rows),
+                            ctypes.byref(cols))
+    if rc != 0:
+        raise FileNotFoundError(path)
+    out = np.empty((rows.value, cols.value), np.float32)
+    got = lib.dl4j_csv_parse(
+        str(path).encode(), delimiter.encode(), skip_header,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        rows.value, cols.value)
+    if got < 0:
+        raise IOError(f"native CSV parse failed for {path}")
+    return out[:got]
+
+
+def _numpy_fallback(path, delimiter, skip_header) -> np.ndarray:
+    arr = np.genfromtxt(path, delimiter=delimiter, skip_header=skip_header,
+                        dtype=np.float32, comments="#")
+    if arr.ndim == 1:
+        arr = arr[None, :] if arr.size else arr.reshape(0, 0)
+    return arr
+
+
+def load_csv_dataset(path: str, *, label_index: int = -1,
+                     num_classes: Optional[int] = None,
+                     delimiter: str = ",", skip_header: int = 0,
+                     regression: bool = False):
+    """CSV file → DataSet (the `CSVRecordReader` +
+    `RecordReaderDataSetIterator(label_index, num_classes)` composition).
+    Classification labels one-hot by default."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    m = load_csv_matrix(path, delimiter=delimiter, skip_header=skip_header)
+    if label_index < 0:
+        label_index = m.shape[1] + label_index
+    features = np.delete(m, label_index, axis=1)
+    raw = m[:, label_index]
+    if regression:
+        labels = raw[:, None].astype(np.float32)
+    else:
+        if len(raw) and not np.all(np.isfinite(raw)):
+            bad = np.nonzero(~np.isfinite(raw))[0][:5].tolist()
+            raise ValueError(
+                f"non-numeric class labels at rows {bad} in {path}")
+        idx = np.rint(raw).astype(np.int64)
+        if len(idx) and (idx.min() < 0
+                         or np.abs(raw - idx).max() > 1e-6):
+            raise ValueError(
+                f"class labels in {path} must be non-negative integers")
+        n = num_classes or (int(idx.max()) + 1 if len(idx) else 0)
+        if len(idx) and idx.max() >= n:
+            raise ValueError(
+                f"label {int(idx.max())} >= num_classes {n} in {path}")
+        labels = np.eye(n, dtype=np.float32)[idx]
+    return DataSet(features.astype(np.float32), labels)

@@ -1,0 +1,208 @@
+"""Parallel data iterators + device-transfer overlap.
+
+Reference: `datasets/iterator/parallel/` — `BaseParallelDataSetIterator`
+(round-robin over N producers with `InequalityHandling` when they
+deplete unevenly), `JointParallelDataSetIterator.java` (N independent
+iterators, each async-buffered), `FileSplitParallelDataSetIterator.java`
+(files under a root matching a pattern, split across N virtual
+producers, each file turned into a DataSet by a callback).
+
+`DevicePrefetchIterator` is the TPU-side half the reference implements
+with its per-device `MagicQueue`: JAX transfers are asynchronous, so
+issuing `device_put` for the next batches while the consumer computes
+on the current one overlaps H2D DMA with device compute — `fit()`
+consumes device-resident DataSets transparently (jnp.asarray on a
+committed device array is a no-op).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+from collections import deque
+from enum import Enum
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+)
+
+
+class InequalityHandling(str, Enum):
+    """What to do when producers deplete unevenly (reference
+    `nd4j ...iterator.enums.InequalityHandling`)."""
+
+    STOP_EVERYONE = "stop_everyone"   # first depleted producer ends it all
+    RELOCATE = "relocate"             # skip depleted, drain the rest
+    RESET = "reset"                   # restart depleted until all have wrapped
+    PASS_NULL = "pass_null"           # yield None for depleted producers
+
+
+class BaseParallelDataSetIterator(DataSetIterator):
+    """Round-robin over N producers with inequality handling
+    (reference `BaseParallelDataSetIterator.java` hasNext switch)."""
+
+    def __init__(self, producers: Sequence[DataSetIterator],
+                 inequality_handling: InequalityHandling =
+                 InequalityHandling.STOP_EVERYONE,
+                 prefetch: int = 2):
+        if not producers:
+            raise ValueError("need at least one producer iterator")
+        self.producers = list(producers)
+        self.inequality_handling = InequalityHandling(inequality_handling)
+        self.prefetch = prefetch
+
+    def _wrapped(self) -> List[DataSetIterator]:
+        if self.prefetch > 0:
+            return [AsyncDataSetIterator(p, prefetch=self.prefetch)
+                    for p in self.producers]
+        return list(self.producers)
+
+    def __iter__(self):
+        mode = self.inequality_handling
+        its = [iter(p) for p in self._wrapped()]
+        n = len(its)
+        active = [True] * n
+        wrapped_once = [False] * n   # RESET: stop after every producer wrapped
+
+        def pull(i):
+            try:
+                return next(its[i]), True
+            except StopIteration:
+                return None, False
+
+        i = 0
+        while any(active):
+            if active[i]:
+                ds, ok = pull(i)
+                if ok:
+                    yield ds
+                    i = (i + 1) % n
+                    continue
+                # producer i just depleted
+                if mode == InequalityHandling.STOP_EVERYONE:
+                    return
+                if mode == InequalityHandling.RESET:
+                    wrapped_once[i] = True
+                    if all(wrapped_once):
+                        return
+                    self.producers[i].reset()
+                    its[i] = iter(AsyncDataSetIterator(
+                        self.producers[i], prefetch=self.prefetch)
+                        if self.prefetch > 0 else self.producers[i])
+                    ds, ok = pull(i)       # retry the producer ONCE
+                    if ok:
+                        yield ds
+                        i = (i + 1) % n
+                    else:
+                        # empty even after reset: drop it or a zero-batch
+                        # producer would busy-loop forever
+                        active[i] = False
+                        i = (i + 1) % n
+                    continue
+                active[i] = False          # RELOCATE / PASS_NULL
+                if mode == InequalityHandling.PASS_NULL:
+                    if not any(active):
+                        return
+                    yield None
+                    i = (i + 1) % n
+                    continue
+            else:
+                if mode == InequalityHandling.PASS_NULL:
+                    yield None
+                i = (i + 1) % n
+
+    def reset(self):
+        for p in self.producers:
+            p.reset()
+
+
+class JointParallelDataSetIterator(BaseParallelDataSetIterator):
+    """N independent source iterators interleaved round-robin, each with
+    its own async prefetch buffer (reference
+    `JointParallelDataSetIterator.java`)."""
+
+
+class FileSplitParallelDataSetIterator(BaseParallelDataSetIterator):
+    """Files under `root` matching `pattern`, dealt round-robin across
+    `num_producers` file lists; `callback(path) -> DataSet` loads one
+    file per batch (reference `FileSplitParallelDataSetIterator.java`
+    with its `FileCallback`)."""
+
+    def __init__(self, root: str, pattern: str,
+                 callback: Callable[[str], DataSet],
+                 num_producers: int = 2,
+                 inequality_handling: InequalityHandling =
+                 InequalityHandling.STOP_EVERYONE,
+                 prefetch: int = 2):
+        paths: List[str] = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                if fnmatch.fnmatch(f, pattern):
+                    paths.append(os.path.join(dirpath, f))
+        if not paths:
+            raise ValueError(f"no files under {root} match {pattern!r}")
+        self.paths = paths
+        num_producers = max(1, min(num_producers, len(paths)))
+        splits = [paths[i::num_producers] for i in range(num_producers)]
+        producers = [_FileListIterator(split, callback) for split in splits]
+        super().__init__(producers, inequality_handling, prefetch)
+
+
+class _FileListIterator(DataSetIterator):
+    def __init__(self, paths: List[str], callback: Callable[[str], DataSet]):
+        self.paths = paths
+        self.callback = callback
+
+    def __iter__(self):
+        for p in self.paths:
+            yield self.callback(p)
+
+    def reset(self):
+        pass
+
+
+class DevicePrefetchIterator(DataSetIterator):
+    """Keeps `depth` batches in flight to the device: `device_put` is
+    async, so the next batches' H2D transfers run while the consumer
+    computes on the current batch. Pass a `sharding`
+    (e.g. NamedSharding(mesh, P("data"))) to land batches pre-sharded
+    for a ParallelTrainer."""
+
+    def __init__(self, base: DataSetIterator, depth: int = 2, sharding=None):
+        self.base = base
+        self.depth = max(1, depth)
+        self.sharding = sharding
+
+    def _put(self, ds: DataSet) -> DataSet:
+        import jax
+
+        def dev(a):
+            if a is None:
+                return None
+            if self.sharding is not None:
+                return jax.device_put(np.asarray(a), self.sharding)
+            return jax.device_put(np.asarray(a))
+
+        return DataSet(dev(ds.features), dev(ds.labels),
+                       dev(ds.features_mask), dev(ds.labels_mask),
+                       ds.example_metadata)
+
+    def __iter__(self):
+        buf: deque = deque()
+        for ds in self.base:
+            buf.append(self._put(ds))
+            if len(buf) >= self.depth:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()

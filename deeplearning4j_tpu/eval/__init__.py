@@ -9,3 +9,10 @@ from deeplearning4j_tpu.eval.regression import RegressionEvaluation
 from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass
 from deeplearning4j_tpu.eval.binary import EvaluationBinary
 from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+from deeplearning4j_tpu.eval.curves import (
+    Histogram,
+    PrecisionRecallCurve,
+    ReliabilityDiagram,
+    RocCurve,
+)
+from deeplearning4j_tpu.eval.meta import Prediction

@@ -62,3 +62,14 @@ class EvaluationCalibration:
 
     def probability_histogram(self, cls: int):
         return self._prob_hist[cls].copy()
+
+    # ---- serializable curve objects (reference getReliabilityDiagram /
+    # getProbabilityHistogram return eval/curves types)
+    def get_reliability_diagram(self, cls: int):
+        from deeplearning4j_tpu.eval.curves import ReliabilityDiagram
+        mean_p, obs = self.reliability_diagram(cls)
+        return ReliabilityDiagram(f"class {cls}", mean_p, obs)
+
+    def get_probability_histogram(self, cls: int):
+        from deeplearning4j_tpu.eval.curves import Histogram
+        return Histogram(f"P(class {cls})", 0.0, 1.0, self._prob_hist[cls])

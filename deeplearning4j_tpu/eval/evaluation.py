@@ -52,17 +52,31 @@ class Evaluation:
         self.confusion: Optional[ConfusionMatrix] = None
         self.top_n_correct = 0
         self.total = 0
+        # per-example Prediction tracking, populated only when eval() is
+        # given record metadata (reference eval/meta/Prediction.java)
+        from deeplearning4j_tpu.eval.meta import PredictionLedger
+        self._ledger = PredictionLedger()
 
     def _ensure(self, c):
         if self.confusion is None:
             self.num_classes = self.num_classes or c
             self.confusion = ConfusionMatrix(self.num_classes)
 
-    def eval(self, labels, predictions, mask=None):
+    def eval(self, labels, predictions, mask=None, record_metadata=None):
         labels, predictions = _flatten_time_series(labels, predictions, mask)
         self._ensure(labels.shape[-1])
         actual = np.argmax(labels, axis=-1)
         pred = np.argmax(predictions, axis=-1)
+        if record_metadata is not None:
+            # time-series flattening / masking can change the row count;
+            # silently misaligned attribution would be worse than failing
+            if len(record_metadata) != len(actual):
+                raise ValueError(
+                    f"record_metadata has {len(record_metadata)} entries but "
+                    f"evaluation flattened/masked to {len(actual)} rows; "
+                    "per-example metadata tracking supports 2-d labels (or "
+                    "pre-flattened metadata aligned with kept rows)")
+            self._ledger.record(actual, pred, record_metadata)
         self.confusion.add(actual, pred)
         self.total += len(actual)
         if self.top_n > 1:
@@ -88,6 +102,19 @@ class Evaluation:
         return {i: int(total - self.confusion.matrix[i, :].sum()
                        - self.confusion.matrix[:, i].sum() + self.confusion.matrix[i, i])
                 for i in range(self.num_classes)}
+
+    # ---- per-example metadata (reference Evaluation.java meta overloads)
+    def get_prediction_errors(self):
+        return self._ledger.get_prediction_errors()
+
+    def get_predictions_by_actual_class(self, cls: int):
+        return self._ledger.get_predictions_by_actual_class(cls)
+
+    def get_predictions_by_predicted_class(self, cls: int):
+        return self._ledger.get_predictions_by_predicted_class(cls)
+
+    def get_predictions(self, actual: int, predicted: int):
+        return self._ledger.get_predictions(actual, predicted)
 
     # ---- metrics ---------------------------------------------------------
     def accuracy(self) -> float:

@@ -80,6 +80,34 @@ class ROC:
         labels, probs = self._collect()
         return _binary_roc_points(labels, probs)
 
+    def get_roc_curve_object(self):
+        """Serializable curve (reference `ROC.getRocCurve()` ->
+        `RocCurve.java`): thresholds descending with the (0,0) anchor at
+        threshold 1+max."""
+        from deeplearning4j_tpu.eval.curves import RocCurve
+        labels, probs = self._collect()
+        fpr, tpr = _binary_roc_points(labels, probs)
+        order = np.argsort(-probs, kind="stable")
+        thresholds = np.concatenate([[1.0 if len(probs) == 0
+                                      else float(probs[order[0]]) + 1.0],
+                                     probs[order]])
+        return RocCurve(thresholds, fpr, tpr)
+
+    def get_precision_recall_curve(self):
+        """Reference `ROC.getPrecisionRecallCurve()` ->
+        `PrecisionRecallCurve.java`."""
+        from deeplearning4j_tpu.eval.curves import PrecisionRecallCurve
+        labels, probs = self._collect()
+        order = np.argsort(-probs, kind="stable")
+        lab = labels[order]
+        tp = np.cumsum(lab)
+        n = np.arange(1, len(lab) + 1)
+        precision = tp / n
+        total_pos = tp[-1] if len(tp) else 0
+        recall = (tp / total_pos if total_pos
+                  else np.zeros_like(tp, dtype=np.float64))
+        return PrecisionRecallCurve(probs[order], precision, recall)
+
 
 class ROCBinary:
     """Independent binary ROC per output column (reference

@@ -259,6 +259,7 @@ class ComputationGraph:
         self._initialized = False
         self._jit_train_step = None
         self._jit_tbptt_step = None
+        self._jit_multi_step = None
         self._jit_output = None
         self._jit_rnn_step = None
         self._solver = None
@@ -424,10 +425,58 @@ class ComputationGraph:
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
+    def _make_multi_step(self):
+        """k fused train steps in one `lax.scan` dispatch — same design
+        (and numerics contract) as MultiLayerNetwork._make_multi_step;
+        the DAG container shares the dispatch-amortization lever."""
+        gn = self.conf.gradient_normalization
+        gn_t = self.conf.gradient_normalization_threshold
+
+        def one(carry, inp):
+            params, upd, state, it = carry
+            xs, ys, rng = inp
+
+            def lf(p):
+                return self._loss_fn(p, state, xs, ys, rng, None, None,
+                                     train=True)
+
+            (loss, (new_state, _)), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            grads = apply_gradient_normalization(grads, gn, gn_t)
+            new_params, new_upd = self._apply_updates(params, grads, upd, it)
+            state = {**state, **new_state}
+            return (new_params, new_upd, state, it + 1), loss
+
+        def multi(params, upd, state, it0, xs_stack, ys_stack, rngs):
+            (params, upd, state, _), losses = jax.lax.scan(
+                one, (params, upd, state, jnp.asarray(it0, jnp.int32)),
+                (xs_stack, ys_stack, rngs))
+            return params, upd, state, losses
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def _run_multi_step(self, xs_stack, ys_stack, it0):
+        """xs_stack/ys_stack: tuples of [k, B, ...] arrays (one per
+        graph input/output). Returns per-step losses."""
+        if self._jit_multi_step is None:
+            self._jit_multi_step = self._make_multi_step()
+        rng_root = jax.random.PRNGKey(self.conf.seed + 1)
+        k = xs_stack[0].shape[0]
+        its = jnp.arange(it0, it0 + k)
+        rngs = jax.vmap(lambda i: jax.random.fold_in(rng_root, i))(its)
+        (self.params, self.updater_state, self.net_state, losses) = \
+            self._jit_multi_step(self.params, self.updater_state,
+                                 self.net_state, it0, xs_stack, ys_stack,
+                                 rngs)
+        return losses
+
     # ------------------------------------------------------------------- fit
-    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32):
+    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
+            steps_per_execution: int = 1):
         """Train. `data`: DataSetIterator / DataSet / MultiDataSet /
-        (features, labels) arrays."""
+        (features, labels) arrays. `steps_per_execution > 1` fuses that
+        many unmasked minibatch steps into one scan dispatch (see
+        MultiLayerNetwork.fit)."""
         from deeplearning4j_tpu.datasets.iterator import as_iterator
         from deeplearning4j_tpu.datasets.multidataset import MultiDataSet
 
@@ -459,11 +508,54 @@ class ComputationGraph:
         rng_root = jax.random.PRNGKey(self.conf.seed + 1)
         iterator = batches if batches is not None else as_iterator(
             data, labels, batch_size=batch_size)
+        spe = max(1, int(steps_per_execution))
+        fused_ok = spe > 1 and solver is None and not tbptt
+
+        def flush(pending):
+            if not pending:
+                return
+            if len(pending) == 1:
+                xs, ys, n_examples = pending[0]
+                run_one(xs, ys, (None,) * len(xs), (None,) * len(ys),
+                        n_examples)
+                return
+            xs_stack = tuple(jnp.stack([p[0][i] for p in pending])
+                             for i in range(len(pending[0][0])))
+            ys_stack = tuple(jnp.stack([p[1][i] for p in pending])
+                             for i in range(len(pending[0][1])))
+            losses = np.asarray(self._run_multi_step(xs_stack, ys_stack,
+                                                     self.iteration_count))
+            for j, (_, _, n_examples) in enumerate(pending):
+                self.score_value = float(losses[j])
+                listeners.iteration_done(self, self.iteration_count,
+                                         self.epoch_count, self.score_value,
+                                         batch_size=n_examples)
+                self.iteration_count += 1
+
+        def run_one(xs, ys, fmasks, lmasks, n_examples):
+            rng = jax.random.fold_in(rng_root, self.iteration_count)
+            if solver is not None:
+                loss = solver.optimize(list(xs), list(ys), list(fmasks),
+                                       list(lmasks))
+            elif tbptt and any(x.ndim == 3 for x in xs):
+                loss = self._fit_tbptt(xs, ys, fmasks, lmasks, rng)
+            else:
+                (self.params, self.updater_state, new_state, loss, _) = \
+                    self._jit_train_step(
+                        self.params, self.updater_state, self.net_state,
+                        self.iteration_count, xs, ys, rng, fmasks, lmasks)
+                self.net_state = {**self.net_state, **new_state}
+            self.score_value = float(loss)
+            listeners.iteration_done(self, self.iteration_count, self.epoch_count,
+                                     self.score_value, batch_size=n_examples)
+            self.iteration_count += 1
+
         listeners.on_fit_start(self)
         for _ in range(epochs):
             listeners.on_epoch_start(self, self.epoch_count)
             if hasattr(iterator, "reset"):
                 iterator.reset()
+            pending = []
             for ds in iterator:
                 if isinstance(ds, MultiDataSet):
                     xs = tuple(jnp.asarray(f) for f in ds.features)
@@ -479,22 +571,24 @@ class ComputationGraph:
                     fmasks = (None if ds.features_mask is None else jnp.asarray(ds.features_mask),)
                     lmasks = (None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),)
                     n_examples = ds.num_examples()
-                rng = jax.random.fold_in(rng_root, self.iteration_count)
-                if solver is not None:
-                    loss = solver.optimize(list(xs), list(ys), list(fmasks),
-                                           list(lmasks))
-                elif tbptt and any(x.ndim == 3 for x in xs):
-                    loss = self._fit_tbptt(xs, ys, fmasks, lmasks, rng)
+                masked = (any(m is not None for m in fmasks)
+                          or any(m is not None for m in lmasks))
+                if not fused_ok or masked:
+                    flush(pending)
+                    pending = []
+                    run_one(xs, ys, fmasks, lmasks, n_examples)
                 else:
-                    (self.params, self.updater_state, new_state, loss, _) = \
-                        self._jit_train_step(
-                            self.params, self.updater_state, self.net_state,
-                            self.iteration_count, xs, ys, rng, fmasks, lmasks)
-                    self.net_state = {**self.net_state, **new_state}
-                self.score_value = float(loss)
-                listeners.iteration_done(self, self.iteration_count, self.epoch_count,
-                                         self.score_value, batch_size=n_examples)
-                self.iteration_count += 1
+                    if pending and any(
+                            a.shape != b.shape
+                            for a, b in zip(pending[0][0] + pending[0][1],
+                                            xs + ys)):
+                        flush(pending)
+                        pending = []
+                    pending.append((xs, ys, n_examples))
+                    if len(pending) == spe:
+                        flush(pending)
+                        pending = []
+            flush(pending)
             listeners.on_epoch_end(self, self.epoch_count)
             self.epoch_count += 1
         listeners.on_fit_end(self)

@@ -463,7 +463,8 @@ class MultiLayerNetwork:
             out = self.output(ds.features, data_format=data_format,
                               mask=None if ds.features_mask is None else jnp.asarray(ds.features_mask))
             e.eval(ds.labels, np.asarray(out),
-                   mask=ds.labels_mask)
+                   mask=ds.labels_mask,
+                   record_metadata=getattr(ds, "example_metadata", None))
         return e
 
     def evaluate_regression(self, iterator, data_format=None):

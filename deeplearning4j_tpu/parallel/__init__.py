@@ -19,6 +19,10 @@ pipeline parallelism are sharding specs, not new engines.
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh, device_mesh
 from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_parallel_attention,
+)
 from deeplearning4j_tpu.parallel.ring import (
     reference_attention,
     ring_attention,

@@ -47,16 +47,96 @@ class TrainingMaster:
             return data[0], data[1]
         return data, None
 
+    # -------------------------------------------------- fault tolerance
+    # The reference's fault story is Spark re-running failed executors;
+    # the TPU-era equivalent is checkpoint/restore (preempted TPU jobs
+    # resume from the last checkpoint). Both masters share this driver:
+    # one trainer.fit() per epoch, a checkpoint every
+    # `checkpoint_every` epochs, a retry budget that restores the last
+    # checkpoint on failure, and resume-from-latest on start.
+    def _run_epochs(self, model, trainer, x, y, *, epochs, batch_size):
+        import glob
+        import os
+
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        ckpt_dir = getattr(self, "checkpoint_dir", None)
+        every = max(0, getattr(self, "checkpoint_every", 0))
+        retries = max(0, getattr(self, "max_retries", 0))
+
+        if not ckpt_dir and not retries:
+            # no fault tolerance configured: one fit() for all epochs —
+            # avoids per-epoch param re-broadcast round-trips
+            return trainer.fit(x, y, epochs=epochs, batch_size=batch_size)
+
+        start_epoch = 0
+        if ckpt_dir:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            existing = sorted(glob.glob(os.path.join(ckpt_dir, "epoch*.zip")))
+            if existing and getattr(self, "resume", True):
+                latest = existing[-1]
+                restored = ModelSerializer.restore_model(latest)
+                model.params = restored.params
+                model.net_state = restored.net_state
+                model.updater_state = restored.updater_state
+                model._initialized = True
+                start_epoch = int(os.path.basename(latest)[5:-4]) + 1
+                log.info("resuming from %s (epoch %d)", latest, start_epoch)
+
+        def save(epoch):
+            if ckpt_dir and every and (epoch + 1) % every == 0:
+                ModelSerializer.write_model(
+                    model, os.path.join(ckpt_dir, f"epoch{epoch:05d}.zip"))
+
+        epoch = start_epoch
+        budget = retries
+        while epoch < epochs:
+            try:
+                trainer.fit(x, y, epochs=1, batch_size=batch_size)
+                save(epoch)
+                epoch += 1
+            except Exception:
+                if budget <= 0 or not ckpt_dir:
+                    raise
+                budget -= 1
+                existing = sorted(glob.glob(
+                    os.path.join(ckpt_dir, "epoch*.zip")))
+                if existing:
+                    restored = ModelSerializer.restore_model(existing[-1])
+                    model.params = restored.params
+                    model.net_state = restored.net_state
+                    model.updater_state = restored.updater_state
+                    # rewind to just after the restored checkpoint —
+                    # params are from that epoch, so later epochs must
+                    # re-run or training would silently lose progress
+                    epoch = int(os.path.basename(existing[-1])[5:-4]) + 1
+                    log.warning("failure; restored %s, resuming at epoch "
+                                "%d (%d retries left)", existing[-1],
+                                epoch, budget)
+                else:
+                    epoch = 0
+                    log.warning("failure with no checkpoint yet; "
+                                "restarting from epoch 0 "
+                                "(%d retries left)", budget)
+        return model
+
 
 class ParameterAveragingTrainingMaster(TrainingMaster):
     def __init__(self, *, batch_size_per_worker: int = 32,
                  averaging_frequency: int = 5,
                  average_updater_state: bool = True, mesh=None,
-                 collect_training_stats: bool = False):
+                 collect_training_stats: bool = False,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1, max_retries: int = 0,
+                 resume: bool = True):
         self.batch_size_per_worker = batch_size_per_worker
         self.averaging_frequency = averaging_frequency
         self.average_updater_state = average_updater_state
         self.mesh = mesh
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        self.max_retries = max_retries
+        self.resume = resume
         # per-round phase timing + timeline export, the
         # `ParameterAveragingTrainingMasterStats` role; opt-in like the
         # reference's setCollectTrainingStats — it adds one device sync
@@ -75,8 +155,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             average_updater_state=self.average_updater_state,
             stats=self.stats)
         x, y = self._split(data)
-        return trainer.fit(x, y, epochs=epochs,
-                           batch_size=self.batch_size_per_worker * n_workers)
+        return self._run_epochs(
+            model, trainer, x, y, epochs=epochs,
+            batch_size=self.batch_size_per_worker * n_workers)
 
     def get_training_stats(self) -> TrainingMasterStats:
         """Reference `getTrainingStats()` — per-round timeline; use
@@ -87,11 +168,18 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 class SharedTrainingMaster(TrainingMaster):
     def __init__(self, *, batch_size_per_worker: int = 32, mesh=None,
                  threshold: Optional[float] = None,
-                 collect_training_stats: bool = False, **compression_knobs):
+                 collect_training_stats: bool = False,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1, max_retries: int = 0,
+                 resume: bool = True, **compression_knobs):
         self.batch_size_per_worker = batch_size_per_worker
         self.mesh = mesh
         self.collect_training_stats = collect_training_stats
         self.stats: TrainingMasterStats = None
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        self.max_retries = max_retries
+        self.resume = resume
         if threshold is not None or compression_knobs:
             log.info(
                 "SharedTrainingMaster: threshold-compression knobs %s are "
@@ -107,8 +195,9 @@ class SharedTrainingMaster(TrainingMaster):
         trainer = ParallelTrainer(model, mesh, mode="sync",
                                   stats=self.stats)
         x, y = self._split(data)
-        return trainer.fit(x, y, epochs=epochs,
-                           batch_size=self.batch_size_per_worker * n_workers)
+        return self._run_epochs(
+            model, trainer, x, y, epochs=epochs,
+            batch_size=self.batch_size_per_worker * n_workers)
 
     def get_training_stats(self) -> TrainingMasterStats:
         return self.stats

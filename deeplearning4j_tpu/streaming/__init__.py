@@ -16,3 +16,4 @@ from deeplearning4j_tpu.streaming.ndarray import (
     serialize_ndarray,
 )
 from deeplearning4j_tpu.streaming.records import csv_to_dataset
+from deeplearning4j_tpu.streaming.routes import RecordPublishRoute, ServingRoute

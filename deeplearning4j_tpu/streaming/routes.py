@@ -1,0 +1,121 @@
+"""Streaming serve/publish routes.
+
+Reference: `dl4j-streaming/.../routes/DL4jServeRouteBuilder.java` (a
+Camel route: consume serialized NDArrays from a Kafka topic → optional
+pre-processor → restore model → `output()` → optional final processor →
+publish to the output URI) and `CamelKafkaRouteBuilder.java` (records →
+serialized arrays → topic). Camel's role — wiring transports to
+processors — is plain composition here over the same `Transport`
+abstraction (`streaming/ndarray.py`: LocalQueue or Kafka), so the
+routes run identically on the in-memory transport in tests and on a
+real broker in production.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.streaming.ndarray import (
+    NDArrayConsumer,
+    NDArrayPublisher,
+    Transport,
+)
+
+
+class ServingRoute:
+    """consume(topic) → before → model.output → final → publish(topic).
+
+    `model`: anything with `.output(x)` (MultiLayerNetwork or
+    ComputationGraph — pass `model_uri` instead to lazy-restore from a
+    checkpoint zip, the reference's `modelUri` mode)."""
+
+    def __init__(self, transport: Transport, consuming_topic: str,
+                 output_topic: str, model=None, model_uri: Optional[str] = None,
+                 before: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+                 final: Optional[Callable[[np.ndarray], np.ndarray]] = None):
+        if model is None and model_uri is None:
+            raise ValueError("need model or model_uri")
+        self.transport = transport
+        self.consuming_topic = consuming_topic
+        self.output_topic = output_topic
+        self._model = model
+        self.model_uri = model_uri
+        self.before = before
+        self.final = final
+        self._consumer = NDArrayConsumer(transport, consuming_topic)
+        self._publisher = NDArrayPublisher(transport, output_topic)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def model(self):
+        if self._model is None:
+            from deeplearning4j_tpu.util.serializer import ModelSerializer
+            self._model = ModelSerializer.restore_model(self.model_uri)
+        return self._model
+
+    # ---------------------------------------------------------- processing
+    def process_one(self, timeout: Optional[float] = None) -> bool:
+        """One exchange through the route; False on consume timeout."""
+        try:
+            x = self._consumer.consume(timeout=timeout)
+        except Exception:
+            return False
+        if x is None:
+            return False
+        if self.before is not None:
+            x = self.before(x)
+        out = np.asarray(self.model.output(x))
+        if self.final is not None:
+            out = self.final(out)
+        self._publisher.publish(np.asarray(out))
+        return True
+
+    def run(self, max_messages: Optional[int] = None,
+            timeout: Optional[float] = 1.0) -> int:
+        """Drain the topic (until timeout or max_messages). Returns the
+        number of messages served."""
+        served = 0
+        while max_messages is None or served < max_messages:
+            if self._stop.is_set() or not self.process_one(timeout=timeout):
+                break
+            served += 1
+        return served
+
+    # ------------------------------------------------------- background run
+    def start(self, poll_timeout: float = 0.2):
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, args=(poll_timeout,), daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self, poll_timeout):
+        while not self._stop.is_set():
+            self.process_one(timeout=poll_timeout)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class RecordPublishRoute:
+    """records → feature arrays → topic (reference
+    `CamelKafkaRouteBuilder` record-serialize-publish leg)."""
+
+    def __init__(self, transport: Transport, topic: str,
+                 extractor: Optional[Callable] = None):
+        self.publisher = NDArrayPublisher(transport, topic)
+        self.extractor = extractor or (lambda r: np.asarray(r, np.float32))
+
+    def publish(self, records: Iterable) -> int:
+        n = 0
+        for rec in records:
+            self.publisher.publish(np.asarray(self.extractor(rec)))
+            n += 1
+        return n

@@ -1,3 +1,6 @@
-"""Utilities: model serialization, model guessing."""
+"""Utilities: model serialization, model guessing, Viterbi decoding,
+disk-backed queueing (reference `deeplearning4j-nn/.../util/`)."""
 
 from deeplearning4j_tpu.util.serializer import ModelSerializer
+from deeplearning4j_tpu.util.viterbi import Viterbi, viterbi_decode
+from deeplearning4j_tpu.util.diskqueue import DiskBasedQueue

@@ -1,0 +1,104 @@
+"""Viterbi sequence decoder.
+
+Reference: `deeplearning4j-nn/.../util/Viterbi.java` — smooths a
+sequence of (possibly noisy) label observations with an HMM whose
+emission model is "observed label is correct with pCorrect" and whose
+transition model is metastable (stay in the current state with
+probability `meta_stability`, hop uniformly otherwise). decode()
+returns the most likely hidden label sequence.
+
+TPU-first: the dynamic program is a `lax.scan` over time of a
+[states]-vector max-product recursion (all-states-in-parallel on
+device, no Python loop over time), with the argmax backtrace done as a
+second reverse scan. Also accepts a full emission log-prob matrix for
+general HMM decoding beyond the reference's noisy-label special case.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnums=())
+def _viterbi_core(log_emissions, log_trans, log_prior):
+    """log_emissions: [T, S]; log_trans: [S, S] (row=from, col=to);
+    log_prior: [S]. Returns (best_log_prob, path [T])."""
+
+    def forward(carry, emit_t):
+        prev = carry                                     # [S] best-so-far
+        scores = prev[:, None] + log_trans               # [S, S]
+        best_prev = jnp.argmax(scores, axis=0)           # [S]
+        cur = jnp.max(scores, axis=0) + emit_t
+        return cur, best_prev
+
+    first = log_prior + log_emissions[0]
+    last, backptrs = jax.lax.scan(forward, first, log_emissions[1:])
+
+    end_state = jnp.argmax(last)
+
+    def backward(state, ptr_t):
+        prev_state = ptr_t[state]
+        return prev_state, state
+
+    # reverse scan emits the state at t for t=1..T-1 (stacked in forward
+    # order); the final carry is the state at t=0
+    first_state, path_tail = jax.lax.scan(backward, end_state, backptrs,
+                                          reverse=True)
+    path = jnp.concatenate([first_state[None], path_tail])
+    return jnp.max(last), path
+
+
+class Viterbi:
+    """Noisy-label smoothing decoder (reference `Viterbi.java`
+    parameterization)."""
+
+    def __init__(self, num_states: int, p_correct: float = 0.99,
+                 meta_stability: float = 0.9):
+        if num_states < 2:
+            raise ValueError("need at least 2 states")
+        self.num_states = int(num_states)
+        self.p_correct = float(p_correct)
+        self.meta_stability = float(meta_stability)
+        S = self.num_states
+        # emission: observed == hidden with p_correct, else uniform leak
+        emit = np.full((S, S), (1.0 - self.p_correct) / (S - 1))
+        np.fill_diagonal(emit, self.p_correct)
+        self._log_emit = np.log(emit)                    # [hidden, observed]
+        # transition: metastable diagonal
+        trans = np.full((S, S), (1.0 - self.meta_stability) / (S - 1))
+        np.fill_diagonal(trans, self.meta_stability)
+        self._log_trans = np.log(trans)
+        self._log_prior = np.full((S,), -np.log(S))
+
+    def decode(self, labels) -> Tuple[float, np.ndarray]:
+        """`labels`: [T] int observations or [T, S] one-hot/prob matrix
+        (reference's binary label matrix form). Returns
+        (best_path_log_prob, smoothed labels [T])."""
+        labels = np.asarray(labels)
+        if labels.ndim == 2:                             # binary label matrix
+            labels = labels.argmax(axis=-1)
+        obs = labels.astype(np.int32)
+        log_em = self._log_emit[:, obs].T                # [T, S]
+        score, path = _viterbi_core(jnp.asarray(log_em),
+                                    jnp.asarray(self._log_trans),
+                                    jnp.asarray(self._log_prior))
+        return float(score), np.asarray(path)
+
+
+def viterbi_decode(log_emissions, log_transitions,
+                   log_prior: Optional[np.ndarray] = None):
+    """General HMM max-product decoding: log_emissions [T,S],
+    log_transitions [S,S], optional log_prior [S]. Returns
+    (best_log_prob, path)."""
+    log_emissions = jnp.asarray(log_emissions)
+    S = log_emissions.shape[-1]
+    if log_prior is None:
+        log_prior = jnp.full((S,), -jnp.log(S))
+    score, path = _viterbi_core(log_emissions, jnp.asarray(log_transitions),
+                                jnp.asarray(log_prior))
+    return float(score), np.asarray(path)

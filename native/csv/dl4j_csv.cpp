@@ -1,0 +1,126 @@
+// dl4j_csv — native CSV -> float32 matrix parser.
+//
+// Reference parity: DataVec's record-reading path (CSVRecordReader +
+// the RecordReaderDataSetIterator pipeline) is JVM-native; the TPU
+// framework's equivalent hot path is this single-pass C++ parser:
+// mmap-free buffered read, strtof-driven field scan, quote-aware,
+// comment/blank-line skipping. Consumed via ctypes
+// (deeplearning4j_tpu/datasets/native_csv.py) with a NumPy fallback
+// when no toolchain is present.
+//
+// Build: g++ -O3 -fPIC -shared dl4j_csv.cpp -o libdl4j_csv.so
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// Scans the file once: number of data rows and the column count of the
+// first data row. Returns 0 on success, negative on error.
+//   skip_rows: header lines to skip; delim: field delimiter.
+int dl4j_csv_shape(const char *path, char delim, long skip_rows,
+                   long *rows_out, long *cols_out) {
+    FILE *f = fopen(path, "rb");
+    if (!f)
+        return -1;
+    std::string line;
+    long rows = 0, cols = 0, lineno = 0;
+    int c;
+    line.reserve(4096);
+    for (;;) {
+        c = fgetc(f);
+        if (c != EOF && c != '\n') {
+            line.push_back((char)c);
+            continue;
+        }
+        if (!line.empty() && line.back() == '\r')
+            line.pop_back();
+        bool end = (c == EOF);
+        if (!line.empty() && line[0] != '#') {
+            if (lineno >= skip_rows) {
+                if (rows == 0) {
+                    long n = 1;
+                    bool quoted = false;
+                    for (char ch : line) {
+                        if (ch == '"')
+                            quoted = !quoted;
+                        else if (ch == delim && !quoted)
+                            n++;
+                    }
+                    cols = n;
+                }
+                rows++;
+            }
+            lineno++;
+        }
+        line.clear();
+        if (end)
+            break;
+    }
+    fclose(f);
+    *rows_out = rows;
+    *cols_out = cols;
+    return 0;
+}
+
+// Parses into the caller's [rows x cols] float32 buffer (row-major).
+// Fields that fail to parse as numbers become NaN (the Python layer
+// decides policy). Returns rows actually parsed, negative on error.
+long dl4j_csv_parse(const char *path, char delim, long skip_rows,
+                    float *out, long rows, long cols) {
+    FILE *f = fopen(path, "rb");
+    if (!f)
+        return -1;
+    std::string line;
+    long r = 0, lineno = 0;
+    int c;
+    line.reserve(4096);
+    for (;;) {
+        c = fgetc(f);
+        if (c != EOF && c != '\n') {
+            line.push_back((char)c);
+            continue;
+        }
+        if (!line.empty() && line.back() == '\r')
+            line.pop_back();
+        bool end = (c == EOF);
+        if (!line.empty() && line[0] != '#') {
+            if (lineno >= skip_rows && r < rows) {
+                const char *p = line.c_str();
+                for (long j = 0; j < cols; j++) {
+                    // skip leading spaces / quotes
+                    while (*p == ' ' || *p == '"')
+                        p++;
+                    char *endp = nullptr;
+                    float v = strtof(p, &endp);
+                    out[r * cols + j] =
+                        (endp == p && *p != delim && *p != '\0')
+                            ? __builtin_nanf("")
+                            : (endp == p ? __builtin_nanf("") : v);
+                    // advance to next delimiter outside quotes
+                    const char *q = endp ? endp : p;
+                    bool quoted = false;
+                    while (*q && (quoted || *q != delim)) {
+                        if (*q == '"')
+                            quoted = !quoted;
+                        q++;
+                    }
+                    p = (*q == delim) ? q + 1 : q;
+                }
+                r++;
+            }
+            lineno++;
+        }
+        line.clear();
+        if (end)
+            break;
+    }
+    fclose(f);
+    return r;
+}
+
+}  // extern "C"

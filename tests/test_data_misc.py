@@ -214,3 +214,62 @@ class TestGraphGradientCheck:
         y = np.eye(3)[rng.integers(0, 3, 4)]
         ok, worst, fails = check_graph_gradients(net, x, y)
         assert ok, f"worst {worst}: {fails[:3]}"
+
+
+class TestNativeCsv:
+    """Native C++ CSV parser (native/csv/dl4j_csv.cpp) with NumPy
+    fallback — DataVec CSVRecordReader bulk-numeric role."""
+
+    def _write(self, tmp_path, text, name="data.csv"):
+        p = tmp_path / name
+        p.write_text(text)
+        return str(p)
+
+    def test_matrix_parse_matches_numpy(self, tmp_path):
+        from deeplearning4j_tpu.datasets.native_csv import (
+            load_csv_matrix, native_available)
+        rng = np.random.default_rng(0)
+        m = rng.standard_normal((50, 7)).astype(np.float32)
+        path = self._write(tmp_path, "\n".join(
+            ",".join(f"{v:.6g}" for v in row) for row in m))
+        got = load_csv_matrix(path)
+        assert got.shape == (50, 7)
+        np.testing.assert_allclose(got, m, rtol=1e-5)
+        assert native_available()  # g++ is baked into this image
+
+    def test_header_comments_crlf_and_nan(self, tmp_path):
+        from deeplearning4j_tpu.datasets.native_csv import load_csv_matrix
+        path = self._write(
+            tmp_path,
+            "a,b,c\r\n# comment line\r\n1,2,3\r\n4,oops,6\r\n")
+        got = load_csv_matrix(path, skip_header=1)
+        assert got.shape == (2, 3)
+        assert got[0].tolist() == [1.0, 2.0, 3.0]
+        assert np.isnan(got[1, 1]) and got[1, 2] == 6.0
+
+    def test_csv_dataset_classification(self, tmp_path):
+        from deeplearning4j_tpu.datasets.native_csv import load_csv_dataset
+        path = self._write(tmp_path, "1.0,2.0,0\n3.0,4.0,2\n5.0,6.0,1\n")
+        ds = load_csv_dataset(path, label_index=-1)
+        assert ds.features.shape == (3, 2)
+        assert ds.labels.shape == (3, 3)
+        assert ds.labels.argmax(axis=1).tolist() == [0, 2, 1]
+
+    def test_csv_dataset_regression_and_delimiter(self, tmp_path):
+        from deeplearning4j_tpu.datasets.native_csv import load_csv_dataset
+        path = self._write(tmp_path, "1.0;2.0;0.5\n3.0;4.0;1.5\n")
+        ds = load_csv_dataset(path, label_index=-1, regression=True,
+                              delimiter=";")
+        assert ds.labels.ravel().tolist() == [0.5, 1.5]
+
+    def test_bad_class_labels_raise(self, tmp_path):
+        import pytest
+        from deeplearning4j_tpu.datasets.native_csv import load_csv_dataset
+        p = tmp_path / "bad.csv"
+        p.write_text("1.0,2.0,cat\n3.0,4.0,1\n")
+        with pytest.raises(ValueError, match="non-numeric"):
+            load_csv_dataset(str(p))
+        p2 = tmp_path / "frac.csv"
+        p2.write_text("1.0,2.0,0.5\n")
+        with pytest.raises(ValueError, match="integers"):
+            load_csv_dataset(str(p2))

@@ -1,0 +1,149 @@
+"""Per-example prediction metadata + serializable curves (reference
+`eval/meta/Prediction.java`, `eval/curves/`)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.eval import (
+    Evaluation,
+    Histogram,
+    PrecisionRecallCurve,
+    ReliabilityDiagram,
+    ROC,
+    RocCurve,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class TestPredictionMetadata:
+    def test_errors_traceable_to_records(self):
+        e = Evaluation()
+        labels = np.eye(3)[[0, 1, 2, 0]]
+        preds = np.eye(3)[[0, 2, 2, 0]] * 0.9 + 0.03   # example 1 wrong
+        meta = [f"file.csv:line{i}" for i in range(4)]
+        e.eval(labels, preds, record_metadata=meta)
+        errs = e.get_prediction_errors()
+        assert len(errs) == 1
+        assert errs[0].actual_class == 1
+        assert errs[0].predicted_class == 2
+        assert errs[0].record_metadata == "file.csv:line1"
+
+    def test_cell_and_class_queries(self):
+        e = Evaluation()
+        labels = np.eye(2)[[0, 0, 1, 1, 1]]
+        preds = np.eye(2)[[0, 1, 1, 0, 1]] * 0.8 + 0.1
+        e.eval(labels, preds, record_metadata=list(range(5)))
+        assert [p.record_metadata
+                for p in e.get_predictions(0, 1)] == [1]
+        assert len(e.get_predictions_by_actual_class(1)) == 3
+        assert len(e.get_predictions_by_predicted_class(0)) == 2
+
+    def test_no_metadata_no_tracking(self):
+        e = Evaluation()
+        e.eval(np.eye(2)[[0, 1]], np.eye(2)[[1, 0]] * 0.9 + 0.05)
+        assert e.get_prediction_errors() == []
+
+    def test_through_evaluate_with_dataset_metadata(self):
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(0.05))
+                .list()
+                .layer(DenseLayer(n_in=2, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((40, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+        net.fit(x, y, epochs=40, batch_size=20)
+        ds = DataSet(x, y, example_metadata=[f"rec{i}" for i in range(40)])
+        e = net.evaluate(ListDataSetIterator([ds]))
+        total_tracked = sum(len(e.get_predictions_by_actual_class(c))
+                            for c in (0, 1))
+        assert total_tracked == 40
+        for p in e.get_prediction_errors():
+            assert p.record_metadata.startswith("rec")
+
+
+class TestCurves:
+    def _roc(self):
+        r = ROC()
+        labels = np.array([0, 0, 1, 1, 1, 0, 1, 0])
+        probs = np.array([0.1, 0.4, 0.35, 0.8, 0.7, 0.2, 0.9, 0.6])
+        r.eval(labels, probs)
+        return r, labels, probs
+
+    def test_roc_curve_object_roundtrip(self):
+        r, _, _ = self._roc()
+        curve = r.get_roc_curve_object()
+        assert isinstance(curve, RocCurve)
+        assert curve.num_points() == 9
+        assert abs(curve.calculate_auc() - r.calculate_auc()) < 1e-9
+        clone = RocCurve.from_json(curve.to_json())
+        assert clone == curve
+        assert clone.get_true_positive_rate(curve.num_points() - 1) == 1.0
+
+    def test_precision_recall_curve_and_points(self):
+        r, _, _ = self._roc()
+        pr = r.get_precision_recall_curve()
+        assert isinstance(pr, PrecisionRecallCurve)
+        # highest-scored example is positive → precision 1 at recall 1/4
+        t, p, rec = pr.get_point_at_recall(0.25)
+        assert p == 1.0
+        t, p, rec = pr.get_point_at_precision(0.7)
+        assert p >= 0.7
+        clone = PrecisionRecallCurve.from_json(pr.to_json())
+        assert clone == pr
+        assert abs(clone.calculate_auprc() - pr.calculate_auprc()) < 1e-12
+
+    def test_histogram_and_reliability_serde(self):
+        h = Histogram("residuals", -1.0, 1.0, [1, 5, 9, 5, 1])
+        h2 = Histogram.from_json(h.to_json())
+        assert h2 == h
+        assert h2.num_bins() == 5
+        assert len(h2.bin_edges()) == 6
+        rd = ReliabilityDiagram("calib", [0.1, 0.5, 0.9], [0.15, 0.48, 0.88])
+        rd2 = ReliabilityDiagram.from_json(rd.to_json())
+        assert rd2 == rd and rd2.num_points() == 3
+
+
+def test_metadata_survives_batching_and_shuffle():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1, 0, 1]]
+    ds = DataSet(x, y, example_metadata=[f"r{i}" for i in range(6)])
+    a, b = ds.split_test_and_train(4)
+    assert a.example_metadata == ["r0", "r1", "r2", "r3"]
+    assert b.example_metadata == ["r4", "r5"]
+    batches = ds.batch_by(4)
+    assert batches[1].example_metadata == ["r4", "r5"]
+    ds.shuffle(seed=0)
+    # metadata rides the same permutation as features
+    for i in range(6):
+        assert ds.example_metadata[i] == f"r{int(ds.features[i, 0]) // 2}"
+
+
+def test_misaligned_metadata_raises():
+    import pytest
+    e = Evaluation()
+    labels = np.eye(2)[[0, 1, 0]]
+    preds = np.eye(2)[[0, 1, 1]] * 0.9 + 0.05
+    with pytest.raises(ValueError):
+        e.eval(labels, preds, record_metadata=["only-one"])
+
+
+def test_calibration_returns_curve_objects():
+    from deeplearning4j_tpu.eval import EvaluationCalibration
+    rng = np.random.default_rng(0)
+    probs = rng.random((200, 1))
+    labels = (rng.random((200, 1)) < probs).astype(np.float64)
+    ec = EvaluationCalibration()
+    ec.eval(np.hstack([1 - labels, labels]), np.hstack([1 - probs, probs]))
+    rd = ec.get_reliability_diagram(1)
+    assert rd.num_points() == 10
+    rd2 = ReliabilityDiagram.from_json(rd.to_json())
+    assert rd2 == rd
+    h = ec.get_probability_histogram(1)
+    assert int(h.bin_counts.sum()) == 200

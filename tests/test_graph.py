@@ -300,3 +300,36 @@ class TestGraphRnnParity:
         changed = any(not np.allclose(before[k], np.asarray(net.params["ae"][k]))
                       for k in before)
         assert changed
+
+
+class TestGraphStepsPerExecution:
+    """CG fused scan drain must match per-step dispatch numerics."""
+
+    def _trajectory(self, spe):
+        import numpy as np
+        from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((60, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 60)]
+        b = NeuralNetConfiguration.builder().seed(5).updater(Adam(0.02))
+        g = ComputationGraphConfiguration.graph_builder(b)
+        g.add_inputs("in")
+        g.set_input_types(InputType.feed_forward(4))
+        g.add_layer("d", DenseLayer(n_out=12, activation="relu"), "in")
+        g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "d")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        listener = CollectScoresListener()
+        net.set_listeners(listener)
+        net.fit(x, y, epochs=4, batch_size=20,
+                steps_per_execution=spe)
+        return [s for _, s in listener.scores]
+
+    def test_fused_matches_per_step(self):
+        import numpy as np
+        ref = self._trajectory(1)
+        fused = self._trajectory(3)
+        assert len(ref) == len(fused) == 12
+        np.testing.assert_allclose(fused, ref, rtol=2e-5, atol=1e-6)

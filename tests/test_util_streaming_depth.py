@@ -1,0 +1,152 @@
+"""Viterbi decoder, DiskBasedQueue, and streaming routes (reference
+`util/Viterbi.java`, `util/DiskBasedQueue.java`,
+`streaming/routes/DL4jServeRouteBuilder.java`)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.streaming import (
+    LocalQueueTransport,
+    NDArrayConsumer,
+    NDArrayPublisher,
+    RecordPublishRoute,
+    ServingRoute,
+)
+from deeplearning4j_tpu.util import DiskBasedQueue, Viterbi, viterbi_decode
+
+
+class TestViterbi:
+    def test_smooths_isolated_flips(self):
+        # metastable truth: 0 x10, then 1 x10, with two isolated flips
+        obs = np.array([0] * 10 + [1] * 10)
+        obs[4] = 1
+        obs[14] = 0
+        v = Viterbi(num_states=2, p_correct=0.9, meta_stability=0.95)
+        score, path = v.decode(obs)
+        assert path.tolist() == [0] * 10 + [1] * 10
+        assert score < 0  # log prob
+
+    def test_binary_label_matrix_input(self):
+        obs = np.eye(3)[[2, 2, 0, 2, 2]]
+        v = Viterbi(num_states=3, p_correct=0.8, meta_stability=0.9)
+        _, path = v.decode(obs)
+        assert path.tolist() == [2, 2, 2, 2, 2]
+
+    def test_trusts_observations_when_emission_sharp(self):
+        obs = np.array([0, 1, 0, 1, 0])
+        v = Viterbi(num_states=2, p_correct=0.9999, meta_stability=0.6)
+        _, path = v.decode(obs)
+        assert path.tolist() == obs.tolist()
+
+    def test_general_hmm_decode(self):
+        # 2-state HMM where the sharp middle emission outweighs the two
+        # transitions it costs (0.9*0.2*0.98*0.2*0.9 > 0.9*0.8*0.02*0.8*0.9)
+        log_em = np.log(np.array([[0.9, 0.1], [0.02, 0.98], [0.9, 0.1]]))
+        log_tr = np.log(np.array([[0.8, 0.2], [0.2, 0.8]]))
+        score, path = viterbi_decode(log_em, log_tr)
+        assert path.tolist() == [0, 1, 0]
+
+
+class TestDiskBasedQueue:
+    def test_fifo_spill_and_restore(self):
+        with DiskBasedQueue() as q:
+            for i in range(5):
+                q.add({"i": i, "arr": np.arange(i)})
+            assert q.size() == 5
+            assert q.peek()["i"] == 0
+            out = [q.poll()["i"] for _ in range(5)]
+            assert out == [0, 1, 2, 3, 4]
+            assert q.poll() is None
+            assert q.is_empty()
+
+    def test_memory_window(self, tmp_path):
+        import os
+        q = DiskBasedQueue(str(tmp_path), memory_window=2)
+        q.add_all([1, 2, 3, 4])
+        assert len(os.listdir(tmp_path)) == 2   # only 3,4 spilled
+        assert list(q) == [1, 2, 3, 4]
+
+    def test_remove_raises_on_empty(self, tmp_path):
+        import pytest
+        q = DiskBasedQueue(str(tmp_path))
+        with pytest.raises(IndexError):
+            q.remove()
+
+
+def _trained_xor_net():
+    x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+    y = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.1)).list()
+            .layer(DenseLayer(n_in=2, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(x, y, epochs=150, batch_size=4, shuffle=False)
+    return net, x
+
+
+class TestServingRoute:
+    def test_end_to_end_local_transport(self):
+        net, x = _trained_xor_net()
+        tr = LocalQueueTransport()
+        route = ServingRoute(tr, "in", "out", model=net)
+        pub = NDArrayPublisher(tr, "in")
+        sub = NDArrayConsumer(tr, "out")
+        for row in x:
+            pub.publish(row[None, :])
+        served = route.run(max_messages=10, timeout=0.1)
+        assert served == 4
+        outs = [sub.consume(timeout=0.5) for _ in range(4)]
+        preds = [int(np.argmax(o)) for o in outs]
+        assert preds == [0, 1, 1, 0]
+
+    def test_before_and_final_processors(self):
+        net, x = _trained_xor_net()
+        tr = LocalQueueTransport()
+        route = ServingRoute(
+            tr, "in", "out", model=net,
+            before=lambda a: a.reshape(1, -1),
+            final=lambda a: np.argmax(a, axis=-1).astype(np.float32))
+        NDArrayPublisher(tr, "in").publish(x[1])    # 1-d record
+        assert route.run(max_messages=1, timeout=0.1) == 1
+        out = NDArrayConsumer(tr, "out").consume(timeout=0.5)
+        assert out.tolist() == [1.0]
+
+    def test_model_uri_lazy_restore(self, tmp_path):
+        from deeplearning4j_tpu.util import ModelSerializer
+        net, x = _trained_xor_net()
+        path = str(tmp_path / "model.zip")
+        ModelSerializer.write_model(net, path)
+        tr = LocalQueueTransport()
+        route = ServingRoute(tr, "in", "out", model_uri=path)
+        NDArrayPublisher(tr, "in").publish(x)
+        assert route.run(max_messages=1, timeout=0.1) == 1
+        out = NDArrayConsumer(tr, "out").consume(timeout=0.5)
+        assert out.shape == (4, 2)
+
+    def test_background_thread_serving(self):
+        net, x = _trained_xor_net()
+        tr = LocalQueueTransport()
+        route = ServingRoute(tr, "in", "out", model=net).start(
+            poll_timeout=0.05)
+        try:
+            pub = NDArrayPublisher(tr, "in")
+            sub = NDArrayConsumer(tr, "out")
+            pub.publish(x)
+            out = sub.consume(timeout=5.0)
+            assert out.shape == (4, 2)
+        finally:
+            route.stop()
+
+    def test_record_publish_route(self):
+        tr = LocalQueueTransport()
+        rp = RecordPublishRoute(tr, "records")
+        n = rp.publish([[1.0, 2.0], [3.0, 4.0]])
+        assert n == 2
+        sub = NDArrayConsumer(tr, "records")
+        a = sub.consume(timeout=0.5)
+        assert a.tolist() == [1.0, 2.0]
