@@ -80,11 +80,30 @@ def load_csv_matrix(path: str, *, delimiter: str = ",",
 
 
 def _numpy_fallback(path, delimiter, skip_header) -> np.ndarray:
-    arr = np.genfromtxt(path, delimiter=delimiter, skip_header=skip_header,
-                        dtype=np.float32, comments="#")
-    if arr.ndim == 1:
-        arr = arr[None, :] if arr.size else arr.reshape(0, 0)
-    return arr
+    """Pure-Python fallback with EXACTLY the native parser's semantics:
+    comment (#) and blank lines are dropped BEFORE skip_header counts,
+    unparseable fields become NaN (genfromtxt counts comments toward
+    skip_header, which would desync the two paths)."""
+    rows = []
+    with open(path) as f:
+        data_line = 0
+        for line in f:
+            line = line.rstrip("\r\n")
+            if not line or line.startswith("#"):
+                continue
+            if data_line >= skip_header:
+                fields = line.split(delimiter)
+                row = []
+                for field in fields:
+                    try:
+                        row.append(float(field.strip().strip('"')))
+                    except ValueError:
+                        row.append(float("nan"))
+                rows.append(row)
+            data_line += 1
+    if not rows:
+        return np.zeros((0, 0), np.float32)
+    return np.asarray(rows, np.float32)
 
 
 def load_csv_dataset(path: str, *, label_index: int = -1,

@@ -72,4 +72,5 @@ class EvaluationCalibration:
 
     def get_probability_histogram(self, cls: int):
         from deeplearning4j_tpu.eval.curves import Histogram
-        return Histogram(f"P(class {cls})", 0.0, 1.0, self._prob_hist[cls])
+        return Histogram(f"P(class {cls})", 0.0, 1.0,
+                         self._prob_hist[cls].copy())

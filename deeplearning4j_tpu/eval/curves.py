@@ -94,9 +94,15 @@ class PrecisionRecallCurve(BaseCurve):
         return float(np.trapezoid(self.precision[order], self.recall[order]))
 
     def get_point_at_threshold(self, threshold: float):
-        """(threshold, precision, recall) at the closest threshold ≥
-        requested (reference `getPointAtThreshold`)."""
-        i = int(np.argmin(np.abs(self.thresholds - threshold)))
+        """(threshold, precision, recall) at the smallest stored
+        threshold ≥ requested — never an operating point below the
+        requested threshold (reference `getPointAtThreshold`); falls
+        back to the highest stored threshold when none qualifies."""
+        ok = np.nonzero(self.thresholds >= threshold)[0]
+        if len(ok) == 0:
+            i = int(np.argmax(self.thresholds))
+        else:
+            i = ok[int(np.argmin(self.thresholds[ok]))]
         return (float(self.thresholds[i]), float(self.precision[i]),
                 float(self.recall[i]))
 

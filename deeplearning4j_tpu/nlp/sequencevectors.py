@@ -167,68 +167,117 @@ _sg_neg_multi = jax.jit(_sg_neg_scan, static_argnums=(6,),
 
 @partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
 def _cbow_neg_step(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr, trainable_from):
-    """CBOW negative-sampling step. ctx: [B, 2W] indices, ctx_mask 0/1."""
+    """CBOW negative-sampling step (sparse closed form, same reasoning
+    as `_sg_neg_math`). ctx: [B, 2W] indices, ctx_mask 0/1."""
+    f32 = jnp.float32
+    vecs = jnp.take(syn0, ctx, axis=0)                         # [B,W2,D]
+    m = ctx_mask[..., None]
+    M = jnp.clip(jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0, None)
+    h = jnp.sum(vecs * m, axis=1) / M                          # [B,D]
+    u_pos = jnp.take(syn1neg, centers, axis=0)
+    u_neg = jnp.take(syn1neg, negs, axis=0)                    # [B,K,D]
+    s_pos = jnp.sum(h * u_pos, axis=-1)
+    s_neg = jnp.einsum("bd,bkd->bk", h, u_neg)
+    loss = -(jnp.sum(jax.nn.log_sigmoid(s_pos))
+             + jnp.sum(jax.nn.log_sigmoid(-s_neg)))
+    c_pos = -jax.nn.sigmoid(-s_pos)                            # [B]
+    c_neg = jax.nn.sigmoid(s_neg)                              # [B,K]
+    dh = c_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", c_neg, u_neg)
+    # dL/dv_slot = (mask/M) * dh, per context slot
+    dctx = (m / M[..., None]) * dh[:, None, :]                 # [B,W2,D]
+    du_pos = c_pos[:, None] * h
+    du_neg = c_neg[..., None] * h[:, None, :]
 
-    def loss_fn(s0, s1):
-        vecs = jnp.take(s0, ctx, axis=0)                       # [B,2W,D]
-        m = ctx_mask[..., None]
-        h = jnp.sum(vecs * m, axis=1) / jnp.clip(
-            jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0, None)
-        u_pos = jnp.take(s1, centers, axis=0)
-        u_neg = jnp.take(s1, negs, axis=0)
-        pos = jax.nn.log_sigmoid(jnp.sum(h * u_pos, axis=-1))
-        neg = jnp.sum(jax.nn.log_sigmoid(
-            -jnp.einsum("bd,bkd->bk", h, u_neg)), axis=-1)
-        return -jnp.sum(pos + neg)
+    counts0 = (jnp.zeros((syn0.shape[0],), f32)
+               .at[ctx.reshape(-1)].add(ctx_mask.reshape(-1)))
+    counts0 = jnp.clip(counts0, 1.0, None)
+    counts1 = (jnp.zeros((syn1neg.shape[0],), f32)
+               .at[centers].add(1.0).at[negs.reshape(-1)].add(1.0))
+    counts1 = jnp.clip(counts1, 1.0, None)
 
-    loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1neg)
-    g0 = g0 / _row_counts(syn0.shape[0], (ctx, ctx_mask))
-    g1 = g1 / _row_counts(syn1neg.shape[0], centers, negs)
+    scale0 = (lr / counts0[ctx])[..., None] * m                # [B,W2,1]
     if trainable_from > 0:
-        row_ok = (jnp.arange(syn0.shape[0]) >= trainable_from)[:, None]
-        g0 = jnp.where(row_ok, g0, 0.0)
-        g1 = jnp.zeros_like(g1)
-    return (syn0 - lr * g0, syn1neg - lr * g1,
-            loss / centers.shape[0])
+        scale0 = scale0 * (ctx >= trainable_from)[..., None]
+        new_syn1neg = syn1neg
+    else:
+        s_ctr = (lr / counts1[centers])[:, None]
+        s_negs = (lr / counts1[negs])[..., None]
+        new_syn1neg = (syn1neg
+                       .at[centers].add(-(du_pos * s_ctr)
+                                        .astype(syn1neg.dtype))
+                       .at[negs.reshape(-1)].add(
+                           -(du_neg * s_negs)
+                           .reshape(-1, syn1neg.shape[1])
+                           .astype(syn1neg.dtype)))
+    new_syn0 = syn0.at[ctx.reshape(-1)].add(
+        -(dctx * scale0).reshape(-1, syn0.shape[1]).astype(syn0.dtype))
+    return new_syn0, new_syn1neg, loss / centers.shape[0]
+
+
+def _hs_path_grads(h, syn1, points, codes, code_mask):
+    """Shared HS math: dL/dh and the per-path-node output deltas for a
+    batch of hidden vectors classified down Huffman paths."""
+    u = jnp.take(syn1, points, axis=0)                         # [B,C,D]
+    sign = 1.0 - 2.0 * codes
+    logits = jnp.einsum("bd,bcd->bc", h, u) * sign
+    loss = -jnp.sum(jax.nn.log_sigmoid(logits) * code_mask)
+    dlogit = -jax.nn.sigmoid(-logits) * code_mask              # [B,C]
+    coef = dlogit * sign
+    dh = jnp.einsum("bc,bcd->bd", coef, u)
+    du = coef[..., None] * h[:, None, :]                       # [B,C,D]
+    return loss, dh, du
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
 def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, centers, points, codes, code_mask, lr):
     """CBOW + hierarchical softmax: context mean classified down the
-    center word's Huffman path (reference `CBOW.java` HS branch)."""
+    center word's Huffman path (reference `CBOW.java` HS branch).
+    Sparse closed form like the NS steps."""
+    f32 = jnp.float32
+    vecs = jnp.take(syn0, ctx, axis=0)
+    m = ctx_mask[..., None]
+    M = jnp.clip(jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0, None)
+    h = jnp.sum(vecs * m, axis=1) / M
+    loss, dh, du = _hs_path_grads(h, syn1, points, codes, code_mask)
+    dctx = (m / M[..., None]) * dh[:, None, :]
 
-    def loss_fn(s0, s1):
-        vecs = jnp.take(s0, ctx, axis=0)
-        m = ctx_mask[..., None]
-        h = jnp.sum(vecs * m, axis=1) / jnp.clip(
-            jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0, None)
-        u = jnp.take(s1, points, axis=0)                       # [B,C,D]
-        sign = 1.0 - 2.0 * codes
-        logits = jnp.einsum("bd,bcd->bc", h, u) * sign
-        return -jnp.sum(jax.nn.log_sigmoid(logits) * code_mask)
+    counts0 = (jnp.zeros((syn0.shape[0],), f32)
+               .at[ctx.reshape(-1)].add(ctx_mask.reshape(-1)))
+    counts0 = jnp.clip(counts0, 1.0, None)
+    counts1 = (jnp.zeros((syn1.shape[0],), f32)
+               .at[points.reshape(-1)].add(code_mask.reshape(-1)))
+    counts1 = jnp.clip(counts1, 1.0, None)
 
-    loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1)
-    g0 = g0 / _row_counts(syn0.shape[0], (ctx, ctx_mask))
-    g1 = g1 / _row_counts(syn1.shape[0], (points, code_mask))
-    return syn0 - lr * g0, syn1 - lr * g1, loss / centers.shape[0]
+    scale0 = (lr / counts0[ctx])[..., None] * m
+    scale1 = (lr / counts1[points])[..., None]
+    new_syn0 = syn0.at[ctx.reshape(-1)].add(
+        -(dctx * scale0).reshape(-1, syn0.shape[1]).astype(syn0.dtype))
+    new_syn1 = syn1.at[points.reshape(-1)].add(
+        -(du * scale1).reshape(-1, syn1.shape[1]).astype(syn1.dtype))
+    return new_syn0, new_syn1, loss / centers.shape[0]
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
 def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
     """Skip-gram hierarchical-softmax step over Huffman paths
-    (reference `SkipGram.iterateSample` HS branch, `SkipGram.java:224`)."""
+    (reference `SkipGram.iterateSample` HS branch, `SkipGram.java:224`).
+    Sparse closed form like the NS steps."""
+    f32 = jnp.float32
+    v = jnp.take(syn0, centers, axis=0)                        # [B,D]
+    loss, dv, du = _hs_path_grads(v, syn1, points, codes, code_mask)
 
-    def loss_fn(s0, s1):
-        v = jnp.take(s0, centers, axis=0)                      # [B,D]
-        u = jnp.take(s1, points, axis=0)                       # [B,C,D]
-        sign = 1.0 - 2.0 * codes                               # code 0 → +1
-        logits = jnp.einsum("bd,bcd->bc", v, u) * sign
-        return -jnp.sum(jax.nn.log_sigmoid(logits) * code_mask)
+    counts0 = jnp.clip(jnp.zeros((syn0.shape[0],), f32)
+                       .at[centers].add(1.0), 1.0, None)
+    counts1 = (jnp.zeros((syn1.shape[0],), f32)
+               .at[points.reshape(-1)].add(code_mask.reshape(-1)))
+    counts1 = jnp.clip(counts1, 1.0, None)
 
-    loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(syn0, syn1)
-    g0 = g0 / _row_counts(syn0.shape[0], centers)
-    g1 = g1 / _row_counts(syn1.shape[0], (points, code_mask))
-    return syn0 - lr * g0, syn1 - lr * g1, loss / centers.shape[0]
+    scale0 = (lr / counts0[centers])[:, None]
+    scale1 = (lr / counts1[points])[..., None]
+    new_syn0 = syn0.at[centers].add(-(dv * scale0).astype(syn0.dtype))
+    new_syn1 = syn1.at[points.reshape(-1)].add(
+        -(du * scale1).reshape(-1, syn1.shape[1]).astype(syn1.dtype))
+    return new_syn0, new_syn1, loss / centers.shape[0]
 
 
 class SequenceVectors:

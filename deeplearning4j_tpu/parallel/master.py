@@ -24,6 +24,8 @@ Spark driver/executor split disappears into SPMD.
 from __future__ import annotations
 
 import logging
+
+import numpy as np
 from typing import Optional
 
 from deeplearning4j_tpu.parallel.mesh import device_mesh
@@ -69,17 +71,31 @@ class TrainingMaster:
             # avoids per-epoch param re-broadcast round-trips
             return trainer.fit(x, y, epochs=epochs, batch_size=batch_size)
 
+        import jax as _jax
+
+        # in-memory epoch-0 snapshot: the restore target when a failure
+        # precedes the first on-disk checkpoint (restarting from trained
+        # params would silently over-train with a desynced LR schedule)
+        init_snap = (_jax.tree_util.tree_map(np.asarray, model.params),
+                     _jax.tree_util.tree_map(np.asarray, model.net_state),
+                     _jax.tree_util.tree_map(np.asarray, model.updater_state),
+                     model.iteration_count, model.epoch_count)
+
+        def restore_from(net):
+            model.params = net.params
+            model.net_state = net.net_state
+            model.updater_state = net.updater_state
+            model.iteration_count = net.iteration_count
+            model.epoch_count = net.epoch_count
+            model._initialized = True
+
         start_epoch = 0
         if ckpt_dir:
             os.makedirs(ckpt_dir, exist_ok=True)
             existing = sorted(glob.glob(os.path.join(ckpt_dir, "epoch*.zip")))
             if existing and getattr(self, "resume", True):
                 latest = existing[-1]
-                restored = ModelSerializer.restore_model(latest)
-                model.params = restored.params
-                model.net_state = restored.net_state
-                model.updater_state = restored.updater_state
-                model._initialized = True
+                restore_from(ModelSerializer.restore_model(latest))
                 start_epoch = int(os.path.basename(latest)[5:-4]) + 1
                 log.info("resuming from %s (epoch %d)", latest, start_epoch)
 
@@ -100,24 +116,27 @@ class TrainingMaster:
                     raise
                 budget -= 1
                 existing = sorted(glob.glob(
-                    os.path.join(ckpt_dir, "epoch*.zip")))
+                    os.path.join(ckpt_dir, "epoch*.zip"))) if ckpt_dir else []
                 if existing:
-                    restored = ModelSerializer.restore_model(existing[-1])
-                    model.params = restored.params
-                    model.net_state = restored.net_state
-                    model.updater_state = restored.updater_state
+                    restore_from(ModelSerializer.restore_model(existing[-1]))
                     # rewind to just after the restored checkpoint —
-                    # params are from that epoch, so later epochs must
-                    # re-run or training would silently lose progress
+                    # params (and iteration_count, for LR schedules) are
+                    # from that epoch, so later epochs must re-run
                     epoch = int(os.path.basename(existing[-1])[5:-4]) + 1
                     log.warning("failure; restored %s, resuming at epoch "
                                 "%d (%d retries left)", existing[-1],
                                 epoch, budget)
                 else:
+                    (model.params, model.net_state, model.updater_state,
+                     model.iteration_count, model.epoch_count) = (
+                        _jax.tree_util.tree_map(np.asarray, init_snap[0]),
+                        _jax.tree_util.tree_map(np.asarray, init_snap[1]),
+                        _jax.tree_util.tree_map(np.asarray, init_snap[2]),
+                        init_snap[3], init_snap[4])
                     epoch = 0
-                    log.warning("failure with no checkpoint yet; "
-                                "restarting from epoch 0 "
-                                "(%d retries left)", budget)
+                    log.warning("failure with no checkpoint yet; restored "
+                                "the initial state, restarting from epoch "
+                                "0 (%d retries left)", budget)
         return model
 
 

@@ -59,10 +59,13 @@ class ServingRoute:
 
     # ---------------------------------------------------------- processing
     def process_one(self, timeout: Optional[float] = None) -> bool:
-        """One exchange through the route; False on consume timeout."""
+        """One exchange through the route; False on consume timeout.
+        Transport/deserialization errors propagate — an empty topic and
+        a broken broker must not look the same."""
+        import queue as _queue
         try:
             x = self._consumer.consume(timeout=timeout)
-        except Exception:
+        except (TimeoutError, _queue.Empty):
             return False
         if x is None:
             return False
@@ -94,8 +97,17 @@ class ServingRoute:
         return self
 
     def _loop(self, poll_timeout):
+        import logging
         while not self._stop.is_set():
-            self.process_one(timeout=poll_timeout)
+            try:
+                self.process_one(timeout=poll_timeout)
+            except Exception:
+                # background serving must survive transient broker
+                # errors; log and keep polling (reference Camel route
+                # error-handler role)
+                logging.getLogger(__name__).exception(
+                    "serving route error (continuing)")
+                self._stop.wait(poll_timeout)
 
     def stop(self):
         self._stop.set()

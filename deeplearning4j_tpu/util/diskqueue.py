@@ -110,9 +110,12 @@ class DiskBasedQueue:
         return self.size()
 
     def __iter__(self):
+        # drain via remove() so a legitimately stored None payload is
+        # yielded, not mistaken for queue-empty
         while True:
-            item = self.poll()
-            if item is None and self.is_empty():
+            try:
+                item = self.remove()
+            except IndexError:
                 return
             yield item
 

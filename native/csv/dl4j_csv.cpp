@@ -92,23 +92,23 @@ long dl4j_csv_parse(const char *path, char delim, long skip_rows,
             if (lineno >= skip_rows && r < rows) {
                 const char *p = line.c_str();
                 for (long j = 0; j < cols; j++) {
-                    // skip leading spaces / quotes
-                    while (*p == ' ' || *p == '"')
-                        p++;
-                    char *endp = nullptr;
-                    float v = strtof(p, &endp);
-                    out[r * cols + j] =
-                        (endp == p && *p != delim && *p != '\0')
-                            ? __builtin_nanf("")
-                            : (endp == p ? __builtin_nanf("") : v);
-                    // advance to next delimiter outside quotes
-                    const char *q = endp ? endp : p;
+                    // field span first (quote-aware, starting from the
+                    // field head so quote state is always correct),
+                    // THEN parse the value inside the span
+                    const char *q = p;
                     bool quoted = false;
                     while (*q && (quoted || *q != delim)) {
                         if (*q == '"')
                             quoted = !quoted;
                         q++;
                     }
+                    const char *fs = p;
+                    while (*fs == ' ' || *fs == '"')
+                        fs++;
+                    char *endp = nullptr;
+                    float v = strtof(fs, &endp);
+                    out[r * cols + j] =
+                        (endp == fs) ? __builtin_nanf("") : v;
                     p = (*q == delim) ? q + 1 : q;
                 }
                 r++;

@@ -273,3 +273,24 @@ class TestNativeCsv:
         p2.write_text("1.0,2.0,0.5\n")
         with pytest.raises(ValueError, match="integers"):
             load_csv_dataset(str(p2))
+
+    def test_quoted_fields_keep_column_alignment(self, tmp_path):
+        # regression: a quoted field with an embedded delimiter must not
+        # shift subsequent columns
+        from deeplearning4j_tpu.datasets.native_csv import (
+            load_csv_matrix, native_available)
+        assert native_available()
+        p = tmp_path / "q.csv"
+        p.write_text('"1,234",5\n7,8\n')
+        got = load_csv_matrix(str(p))
+        assert got.shape == (2, 2)
+        assert got[0, 1] == 5.0 and got[1].tolist() == [7.0, 8.0]
+
+    def test_fallback_matches_native_comment_semantics(self, tmp_path):
+        from deeplearning4j_tpu.datasets import native_csv
+        p = tmp_path / "c.csv"
+        p.write_text("# generated\ncolA,colB\n1,2\n3,4\n")
+        native = native_csv.load_csv_matrix(str(p), skip_header=1)
+        fallback = native_csv._numpy_fallback(str(p), ",", 1)
+        np.testing.assert_array_equal(native, fallback)
+        assert native.shape == (2, 2)

@@ -147,3 +147,24 @@ def test_calibration_returns_curve_objects():
     assert rd2 == rd
     h = ec.get_probability_histogram(1)
     assert int(h.bin_counts.sum()) == 200
+
+
+def test_pr_point_at_threshold_never_below_requested():
+    pr = PrecisionRecallCurve([0.9, 0.5, 0.1], [0.9, 0.66, 0.4],
+                              [0.2, 0.5, 1.0])
+    t, p, r = pr.get_point_at_threshold(0.6)
+    assert t == 0.9          # smallest stored threshold >= 0.6
+    t, p, r = pr.get_point_at_threshold(0.95)
+    assert t == 0.9          # none qualify -> highest stored
+
+
+def test_probability_histogram_is_a_snapshot():
+    from deeplearning4j_tpu.eval import EvaluationCalibration
+    ec = EvaluationCalibration()
+    probs = np.array([[0.2, 0.8], [0.7, 0.3]])
+    labels = np.array([[0.0, 1.0], [1.0, 0.0]])
+    ec.eval(labels, probs)
+    h = ec.get_probability_histogram(1)
+    before = h.bin_counts.copy()
+    ec.eval(labels, probs)
+    np.testing.assert_array_equal(h.bin_counts, before)

@@ -175,3 +175,35 @@ class TestMasterFaultTolerance:
                 master.execute_training(model, _data(), epochs=2)
         finally:
             ParallelTrainer.fit = orig_fit
+
+
+def test_retry_before_first_checkpoint_restores_initial_state(tmp_path):
+    # failure before any checkpoint: restore the INITIAL params and
+    # iteration counter, not the partially-trained state
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    model = _model()
+    init_w = np.asarray(model.params["0"]["W"]).copy()
+    master = SharedTrainingMaster(
+        batch_size_per_worker=16, mesh=mesh,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=10,
+        max_retries=1)
+    x, y = _data()
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+    orig_fit = ParallelTrainer.fit
+    calls = {"n": 0}
+
+    def flaky(self, *a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("boom")
+        return orig_fit(self, *a, **k)
+
+    ParallelTrainer.fit = flaky
+    try:
+        master.execute_training(model, (x, y), epochs=2)
+    finally:
+        ParallelTrainer.fit = orig_fit
+    # epoch0 trained, epoch1 failed -> full restart -> 2 more epochs
+    assert calls["n"] == 4
+    assert model.iteration_count > 0
+    assert not np.allclose(np.asarray(model.params["0"]["W"]), init_w)

@@ -351,3 +351,119 @@ class TestSparseUpdateParity:
         np.testing.assert_array_equal(np.asarray(got0[:8]),
                                       np.asarray(syn0[:8]))
         assert not np.allclose(np.asarray(got0[8:]), np.asarray(syn0[8:]))
+
+
+class TestSparseCbowHsParity:
+    """CBOW-NS / SG-HS / CBOW-HS closed-form scatters vs autodiff."""
+
+    def _setup(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(2)
+        V, D, B, W2, K, C = 30, 6, 10, 4, 3, 5
+        return (rng, V, D, B, W2, K, C,
+                jnp.asarray(rng.standard_normal((V, D)), jnp.float32),
+                jnp.asarray(rng.standard_normal((V, D)), jnp.float32))
+
+    def test_cbow_neg_matches_autodiff(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            _cbow_neg_step, _row_counts)
+
+        rng, V, D, B, W2, K, C, syn0, syn1 = self._setup()
+        ctx = jnp.asarray(rng.integers(0, V, (B, W2)), jnp.int32)
+        mask = jnp.asarray(rng.random((B, W2)) < 0.8, jnp.float32)
+        mask = mask.at[:, 0].set(1.0)
+        centers = jnp.asarray(rng.integers(0, V, B), jnp.int32)
+        negs = jnp.asarray(rng.integers(0, V, (B, K)), jnp.int32)
+        lr = jnp.float32(0.07)
+
+        def loss_fn(s0, s1):
+            vecs = jnp.take(s0, ctx, axis=0)
+            m = mask[..., None]
+            h = jnp.sum(vecs * m, axis=1) / jnp.clip(
+                jnp.sum(mask, axis=1, keepdims=True), 1.0, None)
+            u_pos = jnp.take(s1, centers, axis=0)
+            u_neg = jnp.take(s1, negs, axis=0)
+            pos = jax.nn.log_sigmoid(jnp.sum(h * u_pos, axis=-1))
+            neg = jnp.sum(jax.nn.log_sigmoid(
+                -jnp.einsum("bd,bkd->bk", h, u_neg)), axis=-1)
+            return -jnp.sum(pos + neg)
+
+        g0, g1 = jax.grad(loss_fn, argnums=(0, 1))(syn0, syn1)
+        want0 = syn0 - lr * g0 / _row_counts(V, (ctx, mask))
+        want1 = syn1 - lr * g1 / _row_counts(V, centers, negs)
+        got0, got1, _ = _cbow_neg_step(syn0, syn1, ctx, mask, centers,
+                                       negs, lr, 0)
+        np.testing.assert_allclose(np.asarray(got0), np.asarray(want0),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got1), np.asarray(want1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_sg_hs_matches_autodiff(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            _row_counts, _sg_hs_step)
+
+        rng, V, D, B, W2, K, C, syn0, syn1 = self._setup()
+        centers = jnp.asarray(rng.integers(0, V, B), jnp.int32)
+        points = jnp.asarray(rng.integers(0, V, (B, C)), jnp.int32)
+        codes = jnp.asarray(rng.integers(0, 2, (B, C)), jnp.float32)
+        cmask = jnp.asarray(rng.random((B, C)) < 0.7, jnp.float32)
+        cmask = cmask.at[:, 0].set(1.0)
+        lr = jnp.float32(0.05)
+
+        def loss_fn(s0, s1):
+            v = jnp.take(s0, centers, axis=0)
+            u = jnp.take(s1, points, axis=0)
+            sign = 1.0 - 2.0 * codes
+            logits = jnp.einsum("bd,bcd->bc", v, u) * sign
+            return -jnp.sum(jax.nn.log_sigmoid(logits) * cmask)
+
+        g0, g1 = jax.grad(loss_fn, argnums=(0, 1))(syn0, syn1)
+        want0 = syn0 - lr * g0 / _row_counts(V, centers)
+        want1 = syn1 - lr * g1 / _row_counts(V, (points, cmask))
+        got0, got1, _ = _sg_hs_step(syn0, syn1, centers, points, codes,
+                                    cmask, lr)
+        np.testing.assert_allclose(np.asarray(got0), np.asarray(want0),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got1), np.asarray(want1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_cbow_hs_matches_autodiff(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            _cbow_hs_step, _row_counts)
+
+        rng, V, D, B, W2, K, C, syn0, syn1 = self._setup()
+        ctx = jnp.asarray(rng.integers(0, V, (B, W2)), jnp.int32)
+        mask = jnp.asarray(rng.random((B, W2)) < 0.8, jnp.float32)
+        mask = mask.at[:, 0].set(1.0)
+        centers = jnp.asarray(rng.integers(0, V, B), jnp.int32)
+        points = jnp.asarray(rng.integers(0, V, (B, C)), jnp.int32)
+        codes = jnp.asarray(rng.integers(0, 2, (B, C)), jnp.float32)
+        cmask = jnp.asarray(rng.random((B, C)) < 0.7, jnp.float32)
+        cmask = cmask.at[:, 0].set(1.0)
+        lr = jnp.float32(0.05)
+
+        def loss_fn(s0, s1):
+            vecs = jnp.take(s0, ctx, axis=0)
+            m = mask[..., None]
+            h = jnp.sum(vecs * m, axis=1) / jnp.clip(
+                jnp.sum(mask, axis=1, keepdims=True), 1.0, None)
+            u = jnp.take(s1, points, axis=0)
+            sign = 1.0 - 2.0 * codes
+            logits = jnp.einsum("bd,bcd->bc", h, u) * sign
+            return -jnp.sum(jax.nn.log_sigmoid(logits) * cmask)
+
+        g0, g1 = jax.grad(loss_fn, argnums=(0, 1))(syn0, syn1)
+        want0 = syn0 - lr * g0 / _row_counts(V, (ctx, mask))
+        want1 = syn1 - lr * g1 / _row_counts(V, (points, cmask))
+        got0, got1, _ = _cbow_hs_step(syn0, syn1, ctx, mask, centers,
+                                      points, codes, cmask, lr)
+        np.testing.assert_allclose(np.asarray(got0), np.asarray(want0),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got1), np.asarray(want1),
+                                   rtol=1e-5, atol=1e-6)

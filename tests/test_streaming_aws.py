@@ -61,3 +61,93 @@ class TestS3:
         from deeplearning4j_tpu.aws import S3Uploader
         with pytest.raises(ImportError, match="boto3"):
             S3Uploader("bucket")
+
+
+class TestKafkaTransportWithFakeBroker:
+    """Integration-tests KafkaTransport's send/flush/poll logic against a
+    faithful in-memory fake of the kafka-python API (no real broker in
+    this image; the fake preserves the client call contract —
+    send(topic, bytes) -> flush, poll(timeout_ms, max_records) ->
+    {tp: [records]})."""
+
+    def _install_fake_kafka(self, monkeypatch):
+        import sys
+        import types
+        from collections import defaultdict
+
+        broker = defaultdict(list)          # topic -> [bytes]
+        offsets = defaultdict(int)          # topic -> consumer offset
+
+        class FakeProducer:
+            def __init__(self, bootstrap_servers=None):
+                self.bootstrap = bootstrap_servers
+                self._pending = []
+
+            def send(self, topic, value):
+                self._pending.append((topic, value))
+
+            def flush(self):
+                for topic, value in self._pending:
+                    broker[topic].append(value)
+                self._pending = []
+
+        class _Record:
+            def __init__(self, value):
+                self.value = value
+
+        class FakeConsumer:
+            def __init__(self, topic, bootstrap_servers=None,
+                         auto_offset_reset="earliest"):
+                assert auto_offset_reset == "earliest"
+                self.topic = topic
+
+            def poll(self, timeout_ms=0, max_records=1):
+                t = self.topic
+                out = {}
+                avail = broker[t][offsets[t]:offsets[t] + max_records]
+                if avail:
+                    offsets[t] += len(avail)
+                    out[(t, 0)] = [_Record(v) for v in avail]
+                return out
+
+        fake = types.ModuleType("kafka")
+        fake.KafkaProducer = FakeProducer
+        fake.KafkaConsumer = FakeConsumer
+        monkeypatch.setitem(sys.modules, "kafka", fake)
+        return broker
+
+    def test_ndarray_roundtrip_over_kafka_contract(self, monkeypatch):
+        broker = self._install_fake_kafka(monkeypatch)
+        from deeplearning4j_tpu.streaming.ndarray import (
+            KafkaTransport, NDArrayConsumer, NDArrayPublisher)
+
+        tr = KafkaTransport("broker:9092")
+        pub = NDArrayPublisher(tr, "arrays")
+        sub = NDArrayConsumer(tr, "arrays")
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        pub.publish(a)
+        assert len(broker["arrays"]) == 1    # flushed to the broker
+        b = sub.consume(timeout=0.1)
+        np.testing.assert_array_equal(a, b)
+
+    def test_timeout_when_topic_empty(self, monkeypatch):
+        self._install_fake_kafka(monkeypatch)
+        from deeplearning4j_tpu.streaming.ndarray import KafkaTransport
+        tr = KafkaTransport("broker:9092")
+        with pytest.raises(TimeoutError):
+            tr.receive("empty-topic", timeout=0.05)
+
+    def test_serving_route_over_kafka_contract(self, monkeypatch):
+        self._install_fake_kafka(monkeypatch)
+        from deeplearning4j_tpu.streaming.ndarray import (
+            KafkaTransport, NDArrayConsumer, NDArrayPublisher)
+        from deeplearning4j_tpu.streaming.routes import ServingRoute
+        from tests.test_util_streaming_depth import _trained_xor_net
+
+        net, x = _trained_xor_net()
+        tr = KafkaTransport("broker:9092")
+        route = ServingRoute(tr, "in", "out", model=net)
+        NDArrayPublisher(tr, "in").publish(x)
+        assert route.run(max_messages=1, timeout=0.1) == 1
+        out = NDArrayConsumer(tr, "out").consume(timeout=0.5)
+        assert out.shape == (4, 2)

@@ -150,3 +150,28 @@ class TestServingRoute:
         sub = NDArrayConsumer(tr, "records")
         a = sub.consume(timeout=0.5)
         assert a.tolist() == [1.0, 2.0]
+
+
+def test_diskqueue_preserves_none_payload(tmp_path):
+    q = DiskBasedQueue(str(tmp_path))
+    q.add(1)
+    q.add(None)
+    q.add(2)
+    assert list(q) == [1, None, 2]
+
+
+def test_serving_route_propagates_transport_errors():
+    from deeplearning4j_tpu.streaming.ndarray import Transport
+
+    class BrokenTransport(Transport):
+        def send(self, topic, payload):
+            pass
+
+        def receive(self, topic, timeout=None):
+            raise ConnectionError("broker down")
+
+    net, _ = _trained_xor_net()
+    route = ServingRoute(BrokenTransport(), "in", "out", model=net)
+    import pytest
+    with pytest.raises(ConnectionError):
+        route.process_one(timeout=0.1)
