@@ -79,12 +79,28 @@ def load_csv_matrix(path: str, *, delimiter: str = ",",
     return out[:got]
 
 
+def _split_quoted(line: str, delimiter: str):
+    """Quote-aware field split (same rule as the native parser)."""
+    fields, cur, quoted = [], [], False
+    for ch in line:
+        if ch == '"':
+            quoted = not quoted
+        elif ch == delimiter and not quoted:
+            fields.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    fields.append("".join(cur))
+    return fields
+
+
 def _numpy_fallback(path, delimiter, skip_header) -> np.ndarray:
-    """Pure-Python fallback with EXACTLY the native parser's semantics:
-    comment (#) and blank lines are dropped BEFORE skip_header counts,
-    unparseable fields become NaN (genfromtxt counts comments toward
-    skip_header, which would desync the two paths)."""
+    """Pure-Python fallback with the native parser's semantics: comment
+    (#) and blank lines are dropped BEFORE skip_header counts, fields
+    split quote-aware, unparseable fields become NaN, ragged rows are
+    NaN-padded/truncated to the first data row's column count."""
     rows = []
+    cols = None
     with open(path) as f:
         data_line = 0
         for line in f:
@@ -92,13 +108,15 @@ def _numpy_fallback(path, delimiter, skip_header) -> np.ndarray:
             if not line or line.startswith("#"):
                 continue
             if data_line >= skip_header:
-                fields = line.split(delimiter)
                 row = []
-                for field in fields:
+                for field in _split_quoted(line, delimiter):
                     try:
-                        row.append(float(field.strip().strip('"')))
+                        row.append(float(field.strip()))
                     except ValueError:
                         row.append(float("nan"))
+                if cols is None:
+                    cols = len(row)
+                row = (row + [float("nan")] * cols)[:cols]
                 rows.append(row)
             data_line += 1
     if not rows:

@@ -155,7 +155,11 @@ def _expected_family(layer: Layer) -> str:
                 "upsampling1d", "last_time_step", "multi_head_attention"):
         return "rnn"
     if name in ("batchnorm", "activation", "dropout_layer", "global_pooling",
-                "loss", "reshape", "permute"):
+                "loss", "reshape", "permute", "layernorm",
+                # shape-agnostic sequence layers: embedding gathers per
+                # position; positional-encoding/transformer blocks keep
+                # [B,T,D] — none of them wants a time-flattening insert
+                "embedding", "positional_encoding", "transformer_encoder"):
         return "any"
     return "ff"
 

@@ -30,7 +30,12 @@ from deeplearning4j_tpu.nn.layers.convolution import (
 )
 from deeplearning4j_tpu.nn.layers.normalization import (
     BatchNormalization,
+    LayerNormalization,
     LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.layers.transformer import (
+    PositionalEncodingLayer,
+    TransformerEncoderBlock,
 )
 from deeplearning4j_tpu.nn.layers.recurrent import (
     LSTM,

@@ -172,10 +172,22 @@ class EmbeddingLayer(Layer):
         super().__post_init__()
 
     def set_n_in(self, input_type, override=True):
+        from deeplearning4j_tpu.nn.conf.inputs import InputTypeRecurrent
         if override or not self.n_in:
-            self.n_in = input_type.arity()
+            # recurrent input = [B, T] token ids: the vocab size is the
+            # type's feature size, NOT arity() (= size*timesteps)
+            if isinstance(input_type, InputTypeRecurrent):
+                self.n_in = input_type.size
+            else:
+                self.n_in = input_type.arity()
 
     def get_output_type(self, input_type):
+        from deeplearning4j_tpu.nn.conf.inputs import InputTypeRecurrent
+        if isinstance(input_type, InputTypeRecurrent):
+            # [B, T] token ids → [B, T, n_out]: keep the time axis so no
+            # RNN→FF preprocessor gets auto-inserted (sequence models)
+            return InputType.recurrent(self.n_out,
+                                       getattr(input_type, "timesteps", None))
         return InputType.feed_forward(self.n_out)
 
     def init_params(self, rng, dtype=jnp.float32):

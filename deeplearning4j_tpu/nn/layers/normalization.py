@@ -110,3 +110,43 @@ class LocalResponseNormalization(Layer):
         win = csum[..., self.n:] - csum[..., :-self.n]
         denom = (self.k + self.alpha * win) ** self.beta
         return x / denom, state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class LayerNormalization(Layer):
+    """Layer norm over the feature (last) axis — the normalization
+    transformers need (no 2017-reference equivalent; BatchNormalization
+    is the reference's only normalizer). gamma/beta like BN, but
+    statistics are per-example so there is no running state."""
+
+    layer_name = "layernorm"
+
+    n_out: int = 0
+    eps: float = 1e-5
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_out:
+            if isinstance(input_type, InputTypeConvolutional):
+                self.n_out = input_type.channels
+            else:
+                self.n_out = (input_type.size if hasattr(input_type, "size")
+                              else input_type.arity())
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return {"gamma": jnp.ones((self.n_out,), dtype),
+                "beta": jnp.zeros((self.n_out,), dtype)}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) / jnp.sqrt(var + self.eps)
+        return self.activation(y * params["gamma"] + params["beta"]), state

@@ -1,0 +1,155 @@
+"""Transformer encoder building blocks.
+
+Beyond-reference territory (the 2017 codebase predates transformers;
+SURVEY §5 long-context names ring/Ulysses SP as first-class new
+design): a pre-LN encoder block — x + MHA(LN(x)); x + FFN(LN(x)) —
+composed from the existing MultiHeadAttention (which carries the
+Pallas flash-attention fast path) and LayerNormalization layers, plus
+a parameter-free sinusoidal positional encoding. All shapes static,
+the whole block fuses under jit; long sequences shard over a mesh via
+ring/Ulysses attention (`parallel/ring.py`, `parallel/ulysses.py`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.normalization import LayerNormalization
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class PositionalEncodingLayer(Layer):
+    """Adds the sinusoidal position signal (parameter-free) to
+    [B, T, D] activations."""
+
+    layer_name = "positional_encoding"
+
+    n_out: int = 0
+    max_len: int = 2048
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        super().__post_init__()
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_out:
+            self.n_out = input_type.size
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def _table(self, T, D, dtype):
+        pos = np.arange(T)[:, None]
+        i = np.arange(D // 2)[None, :]
+        angles = pos / np.power(10000.0, 2.0 * i / D)
+        table = np.zeros((T, D), np.float32)
+        table[:, 0::2] = np.sin(angles)
+        table[:, 1::2] = np.cos(angles[:, : D - D // 2])
+        return jnp.asarray(table, dtype)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        T, D = x.shape[1], x.shape[2]
+        return x + self._table(T, D, x.dtype), state
+
+
+@register_layer
+@dataclasses.dataclass(eq=False)
+class TransformerEncoderBlock(Layer):
+    """Pre-LN transformer encoder block over [B, T, D]:
+    h = x + MHA(LN(x)); out = h + FFN(LN(h)). Dropout (the layer's
+    `dropout` retain-prob) applies to both sublayer outputs, attention
+    dropout via `attention_dropout`."""
+
+    layer_name = "transformer_encoder"
+
+    n_in: int = 0
+    n_heads: int = 8
+    ff_multiplier: int = 4
+    causal: bool = False
+    attention_dropout: Optional[float] = None
+    ff_activation: str = "gelu"
+    use_flash: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        super().__post_init__()
+        self._mha: Optional[MultiHeadAttention] = None
+
+    def set_n_in(self, input_type, override=True):
+        if override or not self.n_in:
+            self.n_in = input_type.size
+        self._build_sublayers()
+
+    def _build_sublayers(self):
+        self._mha = MultiHeadAttention(
+            n_in=self.n_in, n_out=self.n_in, n_heads=self.n_heads,
+            causal=self.causal, attention_dropout=self.attention_dropout,
+            use_flash=self.use_flash, weight_init=self.weight_init)
+        self._ln1 = LayerNormalization(n_out=self.n_in)
+        self._ln2 = LayerNormalization(n_out=self.n_in)
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_in,
+                                   getattr(input_type, "timesteps", None))
+
+    def init_params(self, rng, dtype=jnp.float32):
+        if self._mha is None:
+            self._build_sublayers()
+        d, ff = self.n_in, self.n_in * self.ff_multiplier
+        params = {}
+        for si, (name, sub) in enumerate((("attn", self._mha),
+                                          ("ln1", self._ln1),
+                                          ("ln2", self._ln2))):
+            for pk, arr in sub.init_params(
+                    jax.random.fold_in(rng, si), dtype).items():
+                params[f"{name}_{pk}"] = arr
+        params["ff_W1"] = init_weights(jax.random.fold_in(rng, 11),
+                                       (d, ff), self.weight_init,
+                                       fan_in=d, fan_out=ff,
+                                       distribution=self.dist, dtype=dtype)
+        params["ff_b1"] = jnp.zeros((ff,), dtype)
+        params["ff_W2"] = init_weights(jax.random.fold_in(rng, 12),
+                                       (ff, d), self.weight_init,
+                                       fan_in=ff, fan_out=d,
+                                       distribution=self.dist, dtype=dtype)
+        params["ff_b2"] = jnp.zeros((d,), dtype)
+        return params
+
+    def _sub(self, params, prefix):
+        n = len(prefix) + 1
+        return {k[n:]: v for k, v in params.items()
+                if k.startswith(prefix + "_")}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.common.activations import get_activation
+
+        if self._mha is None:
+            self._build_sublayers()
+        r1 = None if rng is None else jax.random.fold_in(rng, 1)
+        h, _ = self._ln1.forward(self._sub(params, "ln1"), {}, x)
+        h, _ = self._mha.forward(self._sub(params, "attn"), {}, h,
+                                 train=train, rng=r1, mask=mask)
+        h = self.apply_input_dropout(h, train,
+                                     None if rng is None
+                                     else jax.random.fold_in(rng, 2))
+        x = x + h
+        h, _ = self._ln2.forward(self._sub(params, "ln2"), {}, x)
+        act = get_activation(self.ff_activation)
+        h = act(h @ params["ff_W1"] + params["ff_b1"])
+        h = h @ params["ff_W2"] + params["ff_b2"]
+        h = self.apply_input_dropout(h, train,
+                                     None if rng is None
+                                     else jax.random.fold_in(rng, 3))
+        return x + h, state

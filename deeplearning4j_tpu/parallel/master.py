@@ -75,11 +75,15 @@ class TrainingMaster:
 
         # in-memory epoch-0 snapshot: the restore target when a failure
         # precedes the first on-disk checkpoint (restarting from trained
-        # params would silently over-train with a desynced LR schedule)
-        init_snap = (_jax.tree_util.tree_map(np.asarray, model.params),
-                     _jax.tree_util.tree_map(np.asarray, model.net_state),
-                     _jax.tree_util.tree_map(np.asarray, model.updater_state),
-                     model.iteration_count, model.epoch_count)
+        # params would silently over-train with a desynced LR schedule);
+        # only taken when retries can actually consume it
+        init_snap = None
+        if retries:
+            init_snap = (
+                _jax.tree_util.tree_map(np.asarray, model.params),
+                _jax.tree_util.tree_map(np.asarray, model.net_state),
+                _jax.tree_util.tree_map(np.asarray, model.updater_state),
+                model.iteration_count, model.epoch_count)
 
         def restore_from(net):
             model.params = net.params
@@ -112,7 +116,7 @@ class TrainingMaster:
                 save(epoch)
                 epoch += 1
             except Exception:
-                if budget <= 0 or not ckpt_dir:
+                if budget <= 0:
                     raise
                 budget -= 1
                 existing = sorted(glob.glob(

@@ -14,3 +14,4 @@ from deeplearning4j_tpu.zoo.textgenlstm import TextGenerationLSTM
 from deeplearning4j_tpu.zoo.googlenet import GoogLeNet
 from deeplearning4j_tpu.zoo.inceptionresnet import InceptionResNetV1
 from deeplearning4j_tpu.zoo.facenet import FaceNetNN4Small2
+from deeplearning4j_tpu.zoo.transformer import TransformerClassifier, TransformerLM

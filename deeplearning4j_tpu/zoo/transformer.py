@@ -1,0 +1,98 @@
+"""Transformer encoder zoo models (beyond-reference: the 2017 zoo tops
+out at InceptionResNet/LSTMs; this is the long-context flagship the TPU
+rebuild adds, riding the Pallas flash-attention fast path and — over a
+mesh — ring/Ulysses sequence parallelism).
+
+Two configurations:
+- `TransformerClassifier`: token ids → embedding + positions → N
+  encoder blocks → masked global average pool → softmax.
+- `TransformerLM`: causal blocks → per-position softmax over the
+  vocabulary (RnnOutputLayer), the TextGenerationLSTM successor.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.common.weights import WeightInit
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    OutputLayer,
+    PositionalEncodingLayer,
+    RnnOutputLayer,
+    TransformerEncoderBlock,
+)
+from deeplearning4j_tpu.nn.layers.pooling import PoolingType
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+class TransformerClassifier(ZooModel):
+    def __init__(self, vocab_size: int, num_classes: int, *,
+                 d_model: int = 128, n_layers: int = 2, n_heads: int = 8,
+                 ff_multiplier: int = 4, max_len: int = 512,
+                 dropout: float = None, pooling: PoolingType = PoolingType.AVG,
+                 seed: int = 123):
+        super().__init__(num_classes=num_classes, seed=seed)
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.ff_multiplier = ff_multiplier
+        self.max_len = max_len
+        self.dropout = dropout
+        self.pooling = pooling
+
+    def conf(self):
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(Adam(1e-3))
+             .weight_init(WeightInit.XAVIER)
+             .list()
+             .layer(EmbeddingLayer(n_in=self.vocab_size, n_out=self.d_model))
+             .layer(PositionalEncodingLayer(max_len=self.max_len)))
+        for _ in range(self.n_layers):
+            b.layer(TransformerEncoderBlock(
+                n_heads=self.n_heads, ff_multiplier=self.ff_multiplier,
+                dropout=self.dropout))
+        b.layer(GlobalPoolingLayer(pooling_type=self.pooling))
+        b.layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                            loss="mcxent"))
+        b.set_input_type(InputType.recurrent(self.vocab_size))
+        return b.build()
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init(self.seed)
+
+
+class TransformerLM(ZooModel):
+    def __init__(self, vocab_size: int, *, d_model: int = 128,
+                 n_layers: int = 2, n_heads: int = 8,
+                 ff_multiplier: int = 4, max_len: int = 512,
+                 seed: int = 123):
+        super().__init__(num_classes=vocab_size, seed=seed)
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.ff_multiplier = ff_multiplier
+        self.max_len = max_len
+
+    def conf(self):
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(Adam(1e-3))
+             .weight_init(WeightInit.XAVIER)
+             .list()
+             .layer(EmbeddingLayer(n_in=self.vocab_size, n_out=self.d_model))
+             .layer(PositionalEncodingLayer(max_len=self.max_len)))
+        for _ in range(self.n_layers):
+            b.layer(TransformerEncoderBlock(
+                n_heads=self.n_heads, ff_multiplier=self.ff_multiplier,
+                causal=True))
+        b.layer(RnnOutputLayer(n_out=self.vocab_size, activation="softmax",
+                               loss="mcxent"))
+        b.set_input_type(InputType.recurrent(self.vocab_size))
+        return b.build()
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init(self.seed)

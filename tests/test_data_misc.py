@@ -294,3 +294,12 @@ class TestNativeCsv:
         fallback = native_csv._numpy_fallback(str(p), ",", 1)
         np.testing.assert_array_equal(native, fallback)
         assert native.shape == (2, 2)
+
+    def test_fallback_quote_aware_and_ragged_padding(self, tmp_path):
+        from deeplearning4j_tpu.datasets import native_csv
+        p = tmp_path / "fq.csv"
+        p.write_text('"1,234",5\n7\n8,9\n')
+        got = native_csv._numpy_fallback(str(p), ",", 0)
+        assert got.shape == (3, 2)
+        assert got[0, 1] == 5.0
+        assert np.isnan(got[1, 1]) and got[1, 0] == 7.0

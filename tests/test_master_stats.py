@@ -207,3 +207,28 @@ def test_retry_before_first_checkpoint_restores_initial_state(tmp_path):
     assert calls["n"] == 4
     assert model.iteration_count > 0
     assert not np.allclose(np.asarray(model.params["0"]["W"]), init_w)
+
+
+def test_retry_without_checkpoint_dir_uses_snapshot():
+    # max_retries with NO checkpoint_dir must still retry from the
+    # initial in-memory snapshot (regression)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    model = _model()
+    master = SharedTrainingMaster(batch_size_per_worker=16, mesh=mesh,
+                                  max_retries=1)
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+    orig_fit = ParallelTrainer.fit
+    calls = {"n": 0}
+
+    def flaky(self, *a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient")
+        return orig_fit(self, *a, **k)
+
+    ParallelTrainer.fit = flaky
+    try:
+        master.execute_training(model, _data(), epochs=2)
+    finally:
+        ParallelTrainer.fit = orig_fit
+    assert calls["n"] == 3   # 1 failure + 2 successful epochs

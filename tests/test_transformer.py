@@ -1,0 +1,147 @@
+"""Transformer encoder stack: LayerNormalization, encoder block,
+positional encoding, zoo TransformerClassifier / TransformerLM
+(beyond-reference long-context models; SURVEY §5)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers import (
+    LayerNormalization,
+    PositionalEncodingLayer,
+    TransformerEncoderBlock,
+)
+from deeplearning4j_tpu.zoo import TransformerClassifier, TransformerLM
+
+
+class TestLayerNormalization:
+    def test_normalizes_last_axis(self):
+        ln = LayerNormalization(n_out=8)
+        p = ln.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((4, 6, 8)) * 5 + 3, jnp.float32)
+        y, _ = ln.forward(p, {}, x)
+        np.testing.assert_allclose(np.asarray(y.mean(-1)), 0.0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(y.std(-1)), 1.0, atol=1e-2)
+
+    def test_gamma_beta_applied(self):
+        ln = LayerNormalization(n_out=4)
+        p = {"gamma": jnp.full((4,), 2.0), "beta": jnp.full((4,), 1.0)}
+        x = jnp.asarray(np.random.default_rng(1)
+                        .standard_normal((3, 4)), jnp.float32)
+        y, _ = ln.forward(p, {}, x)
+        np.testing.assert_allclose(np.asarray(y.mean(-1)), 1.0, atol=1e-5)
+
+
+class TestPositionalEncoding:
+    def test_signal_added_and_distinct_positions(self):
+        pe = PositionalEncodingLayer(n_out=16)
+        x = jnp.zeros((2, 10, 16))
+        y, _ = pe.forward({}, {}, x)
+        y = np.asarray(y)
+        assert y.shape == (2, 10, 16)
+        # all positions get distinct encodings
+        assert len({tuple(np.round(y[0, t], 5)) for t in range(10)}) == 10
+        np.testing.assert_allclose(y[0], y[1])   # batch-independent
+
+
+class TestEncoderBlock:
+    def test_shape_preserved_and_grads_flow(self):
+        blk = TransformerEncoderBlock(n_in=32, n_heads=4)
+        p = blk.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((2, 7, 32)), jnp.float32)
+        y, _ = blk.forward(p, {}, x)
+        assert y.shape == x.shape
+
+        def loss(pp):
+            out, _ = blk.forward(pp, {}, x)
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(p)
+        for k, v in g.items():
+            assert np.isfinite(np.asarray(v)).all(), k
+        assert float(jnp.abs(g["attn_Wq"]).sum()) > 0
+        assert float(jnp.abs(g["ff_W1"]).sum()) > 0
+
+    def test_causal_blocks_no_future_leak(self):
+        blk = TransformerEncoderBlock(n_in=16, n_heads=2, causal=True,
+                                      use_flash=False)
+        p = blk.init_params(jax.random.PRNGKey(1))
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((1, 6, 16)), jnp.float32)
+        y1, _ = blk.forward(p, {}, x)
+        x2 = x.at[0, 4].set(99.0)     # perturb a LATER position
+        y2, _ = blk.forward(p, {}, x2)
+        np.testing.assert_allclose(np.asarray(y1[0, :4]),
+                                   np.asarray(y2[0, :4]), rtol=1e-5)
+
+
+class TestTransformerZoo:
+    def test_classifier_learns_token_presence(self):
+        # class 1 iff token 0 appears in the sequence
+        rng = np.random.default_rng(0)
+        n, T = 256, 12
+        ids = rng.integers(1, 30, (n, T))
+        has = rng.random(n) < 0.5
+        for i in np.nonzero(has)[0]:
+            ids[i, rng.integers(0, T)] = 0
+        y = np.eye(2, dtype=np.float32)[has.astype(int)]
+        from deeplearning4j_tpu.nn.layers.pooling import PoolingType
+        net = TransformerClassifier(vocab_size=30, num_classes=2,
+                                    d_model=32, n_layers=1, n_heads=4,
+                                    pooling=PoolingType.MAX, seed=7).init()
+        net.fit(ids.astype(np.float32), y, epochs=20, batch_size=64)
+        pred = np.asarray(net.output(ids.astype(np.float32))).argmax(1)
+        acc = (pred == has.astype(int)).mean()
+        assert acc > 0.9, acc
+
+    def test_lm_learns_deterministic_sequence(self):
+        # cyclic sequence: next token = (t + 1) % V — causal LM must nail it
+        V, T, B = 11, 16, 32
+        starts = np.arange(B) % V
+        ids = (starts[:, None] + np.arange(T)[None, :]) % V
+        x = ids.astype(np.float32)
+        y = np.eye(V, dtype=np.float32)[(ids + 1) % V]
+        lm = TransformerLM(vocab_size=V, d_model=32, n_layers=1,
+                           n_heads=4, seed=3).init()
+        lm.fit(x, y, epochs=60, batch_size=B, shuffle=False)
+        out = np.asarray(lm.output(x))
+        pred = out.argmax(-1)
+        acc = (pred == (ids + 1) % V).mean()
+        assert acc > 0.95, acc
+
+    def test_serde_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.util import ModelSerializer
+        net = TransformerClassifier(vocab_size=20, num_classes=3,
+                                    d_model=16, n_layers=1,
+                                    n_heads=2).init()
+        ids = np.random.default_rng(0).integers(0, 20, (4, 8)).astype(np.float32)
+        want = np.asarray(net.output(ids))
+        path = str(tmp_path / "tf.zip")
+        ModelSerializer.write_model(net, path)
+        clone = ModelSerializer.restore_model(path)
+        got = np.asarray(clone.output(ids))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_vocab_inferred_from_recurrent_input():
+    # regression: n_in must come from the recurrent type's feature size
+    from deeplearning4j_tpu.common.updaters import Adam
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import (
+        EmbeddingLayer, GlobalPoolingLayer, OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .list()
+            .layer(EmbeddingLayer(n_out=8))
+            .layer(GlobalPoolingLayer())
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(100))
+            .build())
+    assert conf.layers[0].n_in == 100
+    net = MultiLayerNetwork(conf).init()
+    assert net.params["0"]["W"].shape == (100, 8)
+    ids = np.random.default_rng(0).integers(0, 100, (3, 5)).astype(np.float32)
+    assert np.asarray(net.output(ids)).shape == (3, 2)
