@@ -112,8 +112,14 @@ class MnistDataSetIterator(ArrayDataSetIterator):
     flattened [batch, 784] features + one-hot labels."""
 
     def __init__(self, batch_size: int, train: bool = True, seed: int = 123,
-                 num_examples: int | None = None, shuffle: bool | None = None):
+                 num_examples: int | None = None, shuffle: bool | None = None,
+                 flatten: bool = True):
+        """`flatten=False` yields NHWC [B,28,28,1] for conv nets whose
+        config declares InputType.convolutional (the reference pairs
+        flat output with convolutionalFlat + an auto preprocessor)."""
         feats, labels, synthetic = load_mnist(train, num_examples)
+        if not flatten:
+            feats = feats.reshape(-1, 28, 28, 1)
         self.is_synthetic = synthetic
         super().__init__(feats, labels, batch_size=batch_size,
                          shuffle=train if shuffle is None else shuffle, seed=seed)

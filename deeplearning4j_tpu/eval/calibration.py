@@ -25,6 +25,8 @@ class EvaluationCalibration:
             self._bin_pos = np.zeros((c, self.reliability_bins), dtype=np.int64)
             self._bin_prob_sum = np.zeros((c, self.reliability_bins), dtype=np.float64)
             self._prob_hist = np.zeros((c, self.histogram_bins), dtype=np.int64)
+            self._residual_hist = np.zeros((c, self.histogram_bins),
+                                           dtype=np.int64)
 
     def eval(self, labels, predictions, mask=None):
         labels = np.asarray(labels)
@@ -36,16 +38,24 @@ class EvaluationCalibration:
             if mask is not None:
                 m = np.asarray(mask).reshape(-1).astype(bool)
                 labels, predictions = labels[m], predictions[m]
+        elif mask is not None:
+            # 2-d path: [N] example mask — masked rows must not be binned
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, predictions = labels[m], predictions[m]
         self._ensure(labels.shape[-1])
         bins = np.clip((predictions * self.reliability_bins).astype(int), 0,
                        self.reliability_bins - 1)
         hbins = np.clip((predictions * self.histogram_bins).astype(int), 0,
+                        self.histogram_bins - 1)
+        residuals = np.abs(labels - predictions)
+        rbins = np.clip((residuals * self.histogram_bins).astype(int), 0,
                         self.histogram_bins - 1)
         for c in range(labels.shape[-1]):
             np.add.at(self._bin_counts[c], bins[:, c], 1)
             np.add.at(self._bin_pos[c], bins[:, c], labels[:, c] >= 0.5)
             np.add.at(self._bin_prob_sum[c], bins[:, c], predictions[:, c])
             np.add.at(self._prob_hist[c], hbins[:, c], 1)
+            np.add.at(self._residual_hist[c], rbins[:, c], 1)
 
     def reliability_diagram(self, cls: int):
         """Returns (mean_predicted_prob, observed_fraction) per bin."""
@@ -74,3 +84,9 @@ class EvaluationCalibration:
         from deeplearning4j_tpu.eval.curves import Histogram
         return Histogram(f"P(class {cls})", 0.0, 1.0,
                          self._prob_hist[cls].copy())
+
+    def get_residual_plot(self, cls: int):
+        """|label − p| histogram (reference `getResidualPlot`)."""
+        from deeplearning4j_tpu.eval.curves import Histogram
+        return Histogram(f"|label - P| (class {cls})", 0.0, 1.0,
+                         self._residual_hist[cls].copy())

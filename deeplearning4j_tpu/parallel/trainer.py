@@ -169,6 +169,36 @@ class ParallelTrainer:
         listeners = ComposedListeners(model.listeners)
         rng_root = jax.random.PRNGKey(model.conf.seed + 3)
 
+        n_div = self.n_workers
+        batch_stats = {"trained": 0, "dropped": 0}
+
+        def divisible(ds):
+            # data-parallel shards need batch % devices == 0; ragged
+            # TAILS are dropped (TF drop_remainder semantics) with a
+            # warning — but a configuration where EVERY batch is
+            # indivisible must fail loudly, not no-op (see fit() end)
+            n = ds.num_examples()
+            if n % n_div == 0:
+                batch_stats["trained"] += 1
+                return True
+            batch_stats["dropped"] += 1
+            if not getattr(self, "_warned_ragged", False):
+                import logging
+                logging.getLogger(__name__).warning(
+                    "dropping ragged batch of %d examples (not divisible "
+                    "by %d-way data parallelism); pad the dataset or pick "
+                    "a divisible batch_size to train on every example",
+                    n, n_div)
+                self._warned_ragged = True
+            return False
+
+        def check_trained():
+            if batch_stats["dropped"] and not batch_stats["trained"]:
+                raise ValueError(
+                    f"every batch was indivisible by the {n_div}-way data "
+                    f"axis — fit() would be a silent no-op; use a "
+                    f"batch_size divisible by {n_div}")
+
         if self.mode == "sync":
             if self._sync_step is None:
                 self._build_sync_step()
@@ -187,6 +217,8 @@ class ParallelTrainer:
             for _ in range(epochs):
                 iterator.reset()
                 for ds in iterator:
+                    if not divisible(ds):
+                        continue
                     x = _gput(ds.features, batch_sh)
                     y = _gput(ds.labels, batch_sh)
                     rng = jax.random.fold_in(rng_root, model.iteration_count)
@@ -205,6 +237,7 @@ class ParallelTrainer:
                                              batch_size=ds.num_examples())
                     model.iteration_count += 1
                 model.epoch_count += 1
+            check_trained()
             model.params = jax.tree_util.tree_map(np.asarray, params)
             model.net_state = jax.tree_util.tree_map(np.asarray, state)
             model.updater_state = jax.tree_util.tree_map(np.asarray, upd)
@@ -228,6 +261,8 @@ class ParallelTrainer:
         for _ in range(epochs):
             iterator.reset()
             for ds in iterator:
+                if not divisible(ds):
+                    continue
                 x = _gput(ds.features, batch_sh)
                 y = _gput(ds.labels, batch_sh)
                 rng = jax.random.fold_in(rng_root, model.iteration_count)
@@ -261,6 +296,7 @@ class ParallelTrainer:
             state_r = self._average_fn(state_r)
             if self.average_updater_state:
                 upd_r = self._average_fn(upd_r)
+        check_trained()
         model.params = self._unreplicate_tree(params_r)
         model.net_state = self._unreplicate_tree(state_r)
         model.updater_state = self._unreplicate_tree(upd_r)

@@ -1,0 +1,37 @@
+"""Character-level LSTM text generation (GravesLSTM example role):
+train on a tiny corpus, then sample with rnn_time_step streaming."""
+import numpy as np
+
+from deeplearning4j_tpu.zoo.textgenlstm import TextGenerationLSTM
+
+CORPUS = ("the quick brown fox jumps over the lazy dog. "
+          "pack my box with five dozen liquor jugs. ") * 40
+
+
+def main():
+    chars = sorted(set(CORPUS))
+    idx = {c: i for i, c in enumerate(chars)}
+    V, T = len(chars), 40
+    ids = np.array([idx[c] for c in CORPUS])
+    starts = np.arange(0, len(ids) - T - 1, T // 2)
+    x = np.eye(V, dtype=np.float32)[np.stack([ids[s:s + T] for s in starts])]
+    y = np.eye(V, dtype=np.float32)[np.stack([ids[s + 1:s + T + 1] for s in starts])]
+
+    net = TextGenerationLSTM(vocab_size=V, hidden=128).init()
+    net.fit(x, y, epochs=20, batch_size=32, steps_per_execution=4)
+
+    # streaming sampling
+    net.rnn_clear_previous_state()
+    rng = np.random.default_rng(0)
+    cur = idx["t"]
+    out = ["t"]
+    for _ in range(120):
+        probs = np.asarray(net.rnn_time_step(
+            np.eye(V, dtype=np.float32)[[cur]]))[0]
+        cur = int(rng.choice(V, p=probs / probs.sum()))
+        out.append(chars[cur])
+    print("".join(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Data-parallel training over a device mesh with round stats + HTML
+timeline (ParallelWrapper / TrainingMaster example role). Runs on
+whatever devices exist — set XLA_FLAGS=--xla_force_host_platform_device_count=8
+to simulate a mesh on CPU."""
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.fetchers import load_iris
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import ParameterAveragingTrainingMaster
+
+
+def main():
+    x, y = load_iris()
+    conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(0.02))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("data",))
+    master = ParameterAveragingTrainingMaster(
+        batch_size_per_worker=max(1, 64 // len(devices)),
+        averaging_frequency=2, mesh=mesh, collect_training_stats=True)
+    master.execute_training(net, (x, y), epochs=30)
+    stats = master.get_training_stats()
+    print("phase totals (ms):", stats.phase_totals_ms())
+    print("timeline ->", stats.export_html("/tmp/training_timeline.html"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""LeNet on MNIST (falls back to synthetic digits offline) — the
+classic first example: build, fit with listeners, evaluate."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator
+from deeplearning4j_tpu.eval import Evaluation
+from deeplearning4j_tpu.optimize import PerformanceListener, ScoreIterationListener
+from deeplearning4j_tpu.zoo.lenet import LeNet
+
+
+def main():
+    train = MnistDataSetIterator(batch_size=128, train=True, num_examples=6000,
+                                 flatten=False)
+    test = MnistDataSetIterator(batch_size=256, train=False, num_examples=1000,
+                                flatten=False)
+    net = LeNet(num_classes=10).init()
+    net.set_listeners(ScoreIterationListener(10), PerformanceListener(10))
+    # steps_per_execution fuses minibatch steps into one device dispatch
+    net.fit(train, epochs=2, steps_per_execution=8)
+    e: Evaluation = net.evaluate(test)
+    print(e.stats())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Transfer learning + checkpoint + streaming serving: fine-tune a
+feature extractor, save, serve over an in-process route (dl4j-examples
+TransferLearning + streaming role)."""
+import numpy as np
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.fetchers import load_iris
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.streaming import (
+    LocalQueueTransport, NDArrayConsumer, NDArrayPublisher, ServingRoute)
+from deeplearning4j_tpu.transferlearning import TransferLearning
+from deeplearning4j_tpu.util import ModelSerializer
+
+
+def main():
+    x, y = load_iris()
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.02))
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    base = MultiLayerNetwork(conf).init()
+    base.fit(x, y, epochs=40, batch_size=50)
+
+    # freeze the trunk, replace the head, fine-tune
+    tuned = (TransferLearning.Builder(base)
+             .set_feature_extractor(1)
+             .n_out_replace(2, 3)
+             .build())
+    tuned.fit(x, y, epochs=10, batch_size=50)
+
+    ModelSerializer.write_model(tuned, "/tmp/iris_model.zip")
+
+    transport = LocalQueueTransport()
+    route = ServingRoute(transport, "in", "out",
+                         model_uri="/tmp/iris_model.zip")
+    NDArrayPublisher(transport, "in").publish(x[:5])
+    route.run(max_messages=1, timeout=0.5)
+    print("served:", np.asarray(
+        NDArrayConsumer(transport, "out").consume(timeout=1.0)).argmax(1))
+
+
+if __name__ == "__main__":
+    main()

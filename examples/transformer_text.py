@@ -1,0 +1,28 @@
+"""Transformer text classifier (beyond-reference long-context model):
+detect whether a keyword token appears anywhere in the sequence."""
+import numpy as np
+
+from deeplearning4j_tpu.nn.layers.pooling import PoolingType
+from deeplearning4j_tpu.zoo import TransformerClassifier
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, T, V = 512, 24, 40
+    ids = rng.integers(1, V, (n, T))
+    labels = rng.random(n) < 0.5
+    for i in np.nonzero(labels)[0]:
+        ids[i, rng.integers(0, T)] = 0           # plant the keyword
+    y = np.eye(2, dtype=np.float32)[labels.astype(int)]
+
+    net = TransformerClassifier(vocab_size=V, num_classes=2, d_model=48,
+                                n_layers=2, n_heads=4,
+                                pooling=PoolingType.MAX, seed=7).init()
+    net.fit(ids.astype(np.float32), y, epochs=15, batch_size=64,
+            steps_per_execution=4)
+    pred = np.asarray(net.output(ids.astype(np.float32))).argmax(1)
+    print("train accuracy:", (pred == labels.astype(int)).mean())
+
+
+if __name__ == "__main__":
+    main()

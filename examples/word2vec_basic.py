@@ -1,0 +1,29 @@
+"""Word2Vec skip-gram with stopword filtering and nearest-word queries
+(reference Word2VecRawTextExample role)."""
+from deeplearning4j_tpu.nlp import StopWordsRemover, Word2Vec
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+SENTENCES = [
+    "the king rules the kingdom",
+    "the queen rules the kingdom",
+    "a dog chases the cat",
+    "a cat chases the mouse",
+    "the king and the queen sit on thrones",
+    "dogs and cats are animals",
+] * 50
+
+
+def main():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(StopWordsRemover())
+    w2v = Word2Vec(sentence_iterator=SENTENCES, tokenizer_factory=tf,
+                   layer_size=32, window_size=3, negative_sample=5,
+                   epochs=5, min_word_frequency=2, seed=1)
+    w2v.fit()
+    print("king ~", w2v.words_nearest("king", 3))
+    print("sim(king, queen) =", round(w2v.similarity("king", "queen"), 3))
+    print("sim(king, mouse) =", round(w2v.similarity("king", "mouse"), 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -168,3 +168,24 @@ def test_probability_histogram_is_a_snapshot():
     before = h.bin_counts.copy()
     ec.eval(labels, probs)
     np.testing.assert_array_equal(h.bin_counts, before)
+
+
+def test_calibration_residual_plot():
+    from deeplearning4j_tpu.eval import EvaluationCalibration
+    ec = EvaluationCalibration()
+    probs = np.array([[0.1, 0.9], [0.8, 0.2]])
+    labels = np.array([[0.0, 1.0], [1.0, 0.0]])  # both well-calibrated
+    ec.eval(labels, probs)
+    h = ec.get_residual_plot(1)
+    assert int(h.bin_counts.sum()) == 2
+    # residuals are 0.1 and 0.2 -> low bins populated
+    assert h.bin_counts[:3].sum() == 2
+
+
+def test_calibration_respects_2d_mask():
+    from deeplearning4j_tpu.eval import EvaluationCalibration
+    ec = EvaluationCalibration()
+    probs = np.array([[0.1, 0.9], [0.8, 0.2], [0.5, 0.5]])
+    labels = np.array([[0.0, 1.0], [1.0, 0.0], [1.0, 0.0]])
+    ec.eval(labels, probs, mask=np.array([1, 1, 0]))
+    assert int(ec.get_probability_histogram(1).bin_counts.sum()) == 2

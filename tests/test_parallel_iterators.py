@@ -135,3 +135,33 @@ def test_reset_mode_tolerates_empty_producer():
         inequality_handling=InequalityHandling.RESET, prefetch=0)
     tags = [float(ds.features[0, 0]) for ds in it]
     assert 2.0 in tags and len(tags) >= 2
+
+
+def test_parallel_trainer_rejects_all_indivisible_batches():
+    import jax
+    import pytest
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.common.updaters import Adam
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((30, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 30)]
+    tr = ParallelTrainer(net, mesh, mode="sync")
+    with pytest.raises(ValueError, match="indivisible"):
+        tr.fit(x, y, epochs=1, batch_size=10)   # 10 % 4 != 0 for every batch
+    # divisible batches with a ragged tail still train (tail dropped)
+    tr2 = ParallelTrainer(net, mesh, mode="sync")
+    tr2.fit(x, y, epochs=1, batch_size=8)       # 8,8,8 train; tail 6 dropped
