@@ -271,10 +271,11 @@ class ComputationGraph:
         ]
 
     # ------------------------------------------------------------------ init
-    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
-        seed = self.conf.seed if seed is None else seed
+    def _init_trees(self, seed: int):
+        """Pure init (see MultiLayerNetwork._init_trees)."""
         root = jax.random.PRNGKey(seed)
         pdt = self.dtype.param_dtype
+        params, state, upd = {}, {}, {}
         for idx, name in enumerate(self.conf.topo_order):
             node = self.conf.nodes[name]
             if node.kind != "layer":
@@ -283,11 +284,17 @@ class ComputationGraph:
             p = node.layer.init_params(key, pdt)
             s = node.layer.init_state(pdt)
             if p:
-                self.params[name] = p
+                params[name] = p
                 updater = node.layer.updater or Sgd(1e-3)
-                self.updater_state[name] = {k: updater.init_state(a) for k, a in p.items()}
+                upd[name] = {k: updater.init_state(a) for k, a in p.items()}
             if s:
-                self.net_state[name] = s
+                state[name] = s
+        return params, state, upd
+
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        seed = self.conf.seed if seed is None else seed
+        (self.params, self.net_state, self.updater_state) = \
+            self._init_trees(seed)
         self._initialized = True
         return self
 

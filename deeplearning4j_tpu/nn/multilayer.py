@@ -96,8 +96,10 @@ class MultiLayerNetwork:
             self._has_loss = True
 
     # ------------------------------------------------------------------ init
-    def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
-        seed = self.conf.seed if seed is None else seed
+    def _init_trees(self, seed: int):
+        """Pure init: build (params, net_state, updater_state) without
+        touching self — also usable under `jax.eval_shape` to get the
+        tree SHAPES with zero allocation (sharded checkpointing)."""
         root = jax.random.PRNGKey(seed)
         pdt = self.dtype.param_dtype
         params, state, upd = {}, {}, {}
@@ -111,7 +113,12 @@ class MultiLayerNetwork:
                 upd[str(i)] = {name: updater.init_state(arr) for name, arr in p.items()}
             if s:
                 state[str(i)] = s
-        self.params, self.net_state, self.updater_state = params, state, upd
+        return params, state, upd
+
+    def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
+        seed = self.conf.seed if seed is None else seed
+        (self.params, self.net_state, self.updater_state) = \
+            self._init_trees(seed)
         self._initialized = True
         return self
 

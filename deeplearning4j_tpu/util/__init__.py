@@ -4,3 +4,4 @@ disk-backed queueing (reference `deeplearning4j-nn/.../util/`)."""
 from deeplearning4j_tpu.util.serializer import ModelSerializer
 from deeplearning4j_tpu.util.viterbi import Viterbi, viterbi_decode
 from deeplearning4j_tpu.util.diskqueue import DiskBasedQueue
+from deeplearning4j_tpu.util.sharded_checkpoint import ShardedCheckpoint
