@@ -330,6 +330,15 @@ def bench_resnet50(accel):
         "mfu_vs_effective_peak": (round(mfu_vs_eff, 4)
                                   if mfu_vs_eff is not None else None),
         "mfu_plausible": (mfu_vs_eff is None or mfu_vs_eff <= 1.0),
+        # achieved > what the silicon sustains on PURE matmul is
+        # physically impossible -> the step-loop timing under-measured
+        # (tunnel asynchrony), not a FLOP-count error; the timed window
+        # already ends with a value readback, so a remaining anomaly is
+        # platform-side and is flagged rather than hidden
+        "timing_anomaly_suspected": bool(
+            measured_peak
+            and next((a for a in (ach_analytic, ach_hlo)
+                      if a is not None), 0.0) > 1.1 * measured_peak),
         "mfu_note": ("mfu = analytic model FLOPs (2/MAC, conv+dot only, "
                      "counted from the train-step jaxpr) / nominal peak; "
                      "plausibility judged against effective peak = "
