@@ -93,20 +93,71 @@ class TrainingMaster:
             model.epoch_count = net.epoch_count
             model._initialized = True
 
+        def _ckpt_epoch(path):
+            #  .../epoch00042.zip  or  .../epoch00042.ckpt — parse ALL
+            # digits (epochs can widen past the 05d padding)
+            return int(os.path.splitext(os.path.basename(path))[0][5:])
+
+        def _list_ckpts():
+            return sorted(
+                glob.glob(os.path.join(ckpt_dir, "epoch*.zip"))
+                + glob.glob(os.path.join(ckpt_dir, "epoch*.ckpt")),
+                key=_ckpt_epoch)
+
+        def _restore_ckpt(path):
+            if path.endswith(".zip"):
+                restore_from(ModelSerializer.restore_model(path))
+            else:
+                from deeplearning4j_tpu.util.sharded_checkpoint import (
+                    ShardedCheckpoint)
+                # restore each array to the LIVE model's current
+                # sharding (multi-host: a process can only address its
+                # own shards; default placement would try to
+                # materialize full arrays everywhere)
+                shardings = {
+                    "params": _jax.tree_util.tree_map(
+                        lambda a: getattr(a, "sharding", None), model.params),
+                    "net_state": _jax.tree_util.tree_map(
+                        lambda a: getattr(a, "sharding", None),
+                        model.net_state),
+                    "updater_state": _jax.tree_util.tree_map(
+                        lambda a: getattr(a, "sharding", None),
+                        model.updater_state),
+                }
+                ShardedCheckpoint.restore(path, model=model,
+                                          shardings=shardings)
+
         start_epoch = 0
         if ckpt_dir:
             os.makedirs(ckpt_dir, exist_ok=True)
-            existing = sorted(glob.glob(os.path.join(ckpt_dir, "epoch*.zip")))
+            existing = _list_ckpts()
             if existing and getattr(self, "resume", True):
                 latest = existing[-1]
-                restore_from(ModelSerializer.restore_model(latest))
-                start_epoch = int(os.path.basename(latest)[5:-4]) + 1
+                _restore_ckpt(latest)
+                start_epoch = _ckpt_epoch(latest) + 1
                 log.info("resuming from %s (epoch %d)", latest, start_epoch)
 
         def save(epoch):
             if ckpt_dir and every and (epoch + 1) % every == 0:
-                ModelSerializer.write_model(
-                    model, os.path.join(ckpt_dir, f"epoch{epoch:05d}.zip"))
+                base = os.path.join(ckpt_dir, f"epoch{epoch:05d}")
+                tmp = base + ".zip.tmp"
+                try:
+                    # write-then-rename: a failed gather must not leave
+                    # a structurally-valid-but-empty zip that a later
+                    # resume would silently load as fresh-init weights
+                    ModelSerializer.write_model(model, tmp)
+                    os.replace(tmp, base + ".zip")
+                except Exception as e:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+                    # params sharded past host-gatherability (or other
+                    # zip failure — logged so the root cause survives):
+                    # fall back to the Orbax sharded format
+                    log.warning("zip checkpoint failed (%s: %s); saving "
+                                "sharded", type(e).__name__, e)
+                    from deeplearning4j_tpu.util.sharded_checkpoint import (
+                        ShardedCheckpoint)
+                    ShardedCheckpoint.save(base + ".ckpt", model)
 
         epoch = start_epoch
         budget = retries
@@ -119,14 +170,13 @@ class TrainingMaster:
                 if budget <= 0:
                     raise
                 budget -= 1
-                existing = sorted(glob.glob(
-                    os.path.join(ckpt_dir, "epoch*.zip"))) if ckpt_dir else []
+                existing = _list_ckpts() if ckpt_dir else []
                 if existing:
-                    restore_from(ModelSerializer.restore_model(existing[-1]))
+                    _restore_ckpt(existing[-1])
                     # rewind to just after the restored checkpoint —
                     # params (and iteration_count, for LR schedules) are
                     # from that epoch, so later epochs must re-run
-                    epoch = int(os.path.basename(existing[-1])[5:-4]) + 1
+                    epoch = _ckpt_epoch(existing[-1]) + 1
                     log.warning("failure; restored %s, resuming at epoch "
                                 "%d (%d retries left)", existing[-1],
                                 epoch, budget)
