@@ -498,6 +498,44 @@ def bench_lstm_charnn(accel):
     }
 
 
+# ------------------------------------------- Transformer LM (beyond-ref)
+def bench_transformer_lm(accel):
+    """Causal transformer LM training throughput (tokens/sec) — the
+    beyond-reference long-context flagship (the 2017 zoo tops out at
+    LSTMs). On TPU the encoder blocks ride the Pallas flash-attention
+    kernel (`kernels/flash_attention.py`); fused multi-step dispatch
+    like the other configs."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo.transformer import TransformerLM
+
+    V = 512
+    B, T = (16, 256) if accel else (4, 32)
+    steps = 30 if accel else 3
+    d_model, n_layers, n_heads = (256, 4, 8) if accel else (32, 2, 4)
+    lm = TransformerLM(vocab_size=V, d_model=d_model, n_layers=n_layers,
+                       n_heads=n_heads, max_len=T)
+    if accel:
+        from deeplearning4j_tpu.nd.dtype import bf16_policy
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(lm.conf(), dtype_policy=bf16_policy()).init(123)
+    else:
+        net = lm.init()
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, V, (B, T))
+    x = jnp.asarray(ids, jnp.float32)
+    y = jnp.asarray(np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)])
+    dt = _time_fused_steps(net, x, y, steps)
+    return {
+        "metric": "transformer_lm_tokens_per_sec",
+        "value": round(B * T * steps / dt, 1), "unit": "tokens/sec",
+        "batch": B, "seq_len": T, "d_model": d_model,
+        "n_layers": n_layers, "n_heads": n_heads,
+        "flash_attention": jax.default_backend() == "tpu",
+        "fused_dispatch": True,
+    }
+
+
 # --------------------------------------------------- Word2Vec (config 3)
 def bench_word2vec(accel):
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
@@ -710,6 +748,7 @@ def main():
     extras = {}
     for name, fn in (("lenet_mnist", bench_lenet),
                      ("lstm_char_rnn", bench_lstm_charnn),
+                     ("transformer_lm", bench_transformer_lm),
                      ("word2vec", bench_word2vec)):
         try:
             extras[name] = fn(accel)

@@ -76,7 +76,8 @@ def _row_counts(n_rows, *index_sets):
 # stalls small corpora; a plain sum diverges for frequent rows.
 
 
-def _sg_neg_math(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
+def _sg_neg_math(syn0, syn1neg, centers, contexts, negs, lr, trainable_from,
+                 valid=None):
     """Skip-gram negative-sampling update math (shared by the single-step
     jit and the fused scan). trainable_from: row index from which syn0
     rows are trainable (0 = all; used by inferVector).
@@ -89,27 +90,45 @@ def _sg_neg_math(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
     memory-bound garbage; this is the Pallas-guide "sparse-update"
     shape, expressed with XLA scatters (`.at[].add`). Row sums are
     divided by per-row occurrence counts (see note above) — identical
-    math to the autodiff version, verified by test."""
+    math to the autodiff version, verified by test.
+
+    `valid` (optional [B] 0/1 mask) lets ragged epoch-end tails run
+    padded to the full compiled batch shape: masked entries contribute
+    nothing to loss, counts, or updates — bitwise the same result as a
+    ragged-shape flush, without paying an XLA compile per distinct tail
+    length."""
     f32 = jnp.float32
     v = jnp.take(syn0, centers, axis=0)                        # [B,D]
     u_pos = jnp.take(syn1neg, contexts, axis=0)                # [B,D]
     u_neg = jnp.take(syn1neg, negs, axis=0)                    # [B,K,D]
     s_pos = jnp.sum(v * u_pos, axis=-1)                        # [B]
     s_neg = jnp.einsum("bd,bkd->bk", v, u_neg)                 # [B,K]
-    loss = -(jnp.sum(jax.nn.log_sigmoid(s_pos))
-             + jnp.sum(jax.nn.log_sigmoid(-s_neg)))
+    lp, ln = jax.nn.log_sigmoid(s_pos), jax.nn.log_sigmoid(-s_neg)
     # dL/ds: σ(s)-1 for the positive, σ(s) for negatives
     c_pos = -jax.nn.sigmoid(-s_pos)                            # [B]
     c_neg = jax.nn.sigmoid(s_neg)                              # [B,K]
+    if valid is None:
+        n_eff = centers.shape[0]
+        loss = -(jnp.sum(lp) + jnp.sum(ln))
+        one = None
+    else:
+        n_eff = jnp.clip(jnp.sum(valid), 1.0, None)
+        loss = -(jnp.sum(lp * valid) + jnp.sum(ln * valid[:, None]))
+        c_pos = c_pos * valid
+        c_neg = c_neg * valid[:, None]
+        one = valid
     dv = c_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", c_neg, u_neg)
     du_pos = c_pos[:, None] * v                                # [B,D]
     du_neg = c_neg[..., None] * v[:, None, :]                  # [B,K,D]
 
-    counts0 = jnp.zeros((syn0.shape[0],), f32).at[centers].add(1.0)
+    w1 = 1.0 if one is None else one
+    wk = 1.0 if one is None else jnp.broadcast_to(one[:, None], negs.shape)
+    counts0 = jnp.zeros((syn0.shape[0],), f32).at[centers].add(w1)
     counts0 = jnp.clip(counts0, 1.0, None)
     counts1 = (jnp.zeros((syn1neg.shape[0],), f32)
-               .at[contexts].add(1.0)
-               .at[negs.reshape(-1)].add(1.0))
+               .at[contexts].add(w1)
+               .at[negs.reshape(-1)].add(
+                   wk.reshape(-1) if one is not None else 1.0))
     counts1 = jnp.clip(counts1, 1.0, None)
 
     scale0 = (lr / counts0[centers])[:, None]                  # [B,1]
@@ -129,13 +148,22 @@ def _sg_neg_math(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
                            .reshape(-1, syn1neg.shape[1])
                            .astype(syn1neg.dtype)))
     new_syn0 = syn0.at[centers].add(-(dv * scale0).astype(syn0.dtype))
-    return new_syn0, new_syn1neg, loss / centers.shape[0]
+    return new_syn0, new_syn1neg, loss / n_eff
 
 
 @partial(jax.jit, static_argnums=(6,), donate_argnums=(0, 1))
 def _sg_neg_step(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
     return _sg_neg_math(syn0, syn1neg, centers, contexts, negs, lr,
                         trainable_from)
+
+
+@partial(jax.jit, static_argnums=(6,), donate_argnums=(0, 1))
+def _sg_neg_step_masked(syn0, syn1neg, centers, contexts, negs, lr,
+                        trainable_from, valid):
+    """Tail flush: ragged batch padded to the compiled [B] shape with a
+    validity mask — one compile serves every tail length."""
+    return _sg_neg_math(syn0, syn1neg, centers, contexts, negs, lr,
+                        trainable_from, valid)
 
 
 def _sg_neg_scan(syn0, syn1neg, centers, contexts, negs, lrs, trainable_from):
@@ -165,10 +193,12 @@ _sg_neg_multi = jax.jit(_sg_neg_scan, static_argnums=(6,),
                         donate_argnums=(0, 1))
 
 
-@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
-def _cbow_neg_step(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr, trainable_from):
+def _cbow_neg_math(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr,
+                   trainable_from, valid=None):
     """CBOW negative-sampling step (sparse closed form, same reasoning
-    as `_sg_neg_math`). ctx: [B, 2W] indices, ctx_mask 0/1."""
+    as `_sg_neg_math`). ctx: [B, 2W] indices, ctx_mask 0/1. `valid` as
+    in `_sg_neg_math` — padded tail rows (ctx_mask all zero) contribute
+    nothing to loss, counts, or either table."""
     f32 = jnp.float32
     vecs = jnp.take(syn0, ctx, axis=0)                         # [B,W2,D]
     m = ctx_mask[..., None]
@@ -178,10 +208,20 @@ def _cbow_neg_step(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr, trainable_fr
     u_neg = jnp.take(syn1neg, negs, axis=0)                    # [B,K,D]
     s_pos = jnp.sum(h * u_pos, axis=-1)
     s_neg = jnp.einsum("bd,bkd->bk", h, u_neg)
-    loss = -(jnp.sum(jax.nn.log_sigmoid(s_pos))
-             + jnp.sum(jax.nn.log_sigmoid(-s_neg)))
+    lp, ln = jax.nn.log_sigmoid(s_pos), jax.nn.log_sigmoid(-s_neg)
     c_pos = -jax.nn.sigmoid(-s_pos)                            # [B]
     c_neg = jax.nn.sigmoid(s_neg)                              # [B,K]
+    if valid is None:
+        n_eff = centers.shape[0]
+        loss = -(jnp.sum(lp) + jnp.sum(ln))
+        w1, wk = 1.0, 1.0
+    else:
+        n_eff = jnp.clip(jnp.sum(valid), 1.0, None)
+        loss = -(jnp.sum(lp * valid) + jnp.sum(ln * valid[:, None]))
+        c_pos = c_pos * valid
+        c_neg = c_neg * valid[:, None]
+        w1 = valid
+        wk = jnp.broadcast_to(valid[:, None], negs.shape)
     dh = c_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", c_neg, u_neg)
     # dL/dv_slot = (mask/M) * dh, per context slot
     dctx = (m / M[..., None]) * dh[:, None, :]                 # [B,W2,D]
@@ -192,7 +232,9 @@ def _cbow_neg_step(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr, trainable_fr
                .at[ctx.reshape(-1)].add(ctx_mask.reshape(-1)))
     counts0 = jnp.clip(counts0, 1.0, None)
     counts1 = (jnp.zeros((syn1neg.shape[0],), f32)
-               .at[centers].add(1.0).at[negs.reshape(-1)].add(1.0))
+               .at[centers].add(w1)
+               .at[negs.reshape(-1)].add(
+                   wk.reshape(-1) if valid is not None else 1.0))
     counts1 = jnp.clip(counts1, 1.0, None)
 
     scale0 = (lr / counts0[ctx])[..., None] * m                # [B,W2,1]
@@ -211,7 +253,21 @@ def _cbow_neg_step(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr, trainable_fr
                            .astype(syn1neg.dtype)))
     new_syn0 = syn0.at[ctx.reshape(-1)].add(
         -(dctx * scale0).reshape(-1, syn0.shape[1]).astype(syn0.dtype))
-    return new_syn0, new_syn1neg, loss / centers.shape[0]
+    return new_syn0, new_syn1neg, loss / n_eff
+
+
+@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+def _cbow_neg_step(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr,
+                   trainable_from):
+    return _cbow_neg_math(syn0, syn1neg, ctx, ctx_mask, centers, negs,
+                          lr, trainable_from)
+
+
+@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+def _cbow_neg_step_masked(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr,
+                          trainable_from, valid):
+    return _cbow_neg_math(syn0, syn1neg, ctx, ctx_mask, centers, negs,
+                          lr, trainable_from, valid)
 
 
 def _hs_path_grads(h, syn1, points, codes, code_mask):
@@ -228,12 +284,18 @@ def _hs_path_grads(h, syn1, points, codes, code_mask):
     return loss, dh, du
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, centers, points, codes, code_mask, lr):
+def _cbow_hs_math(syn0, syn1, ctx, ctx_mask, centers, points, codes,
+                  code_mask, lr, valid=None):
     """CBOW + hierarchical softmax: context mean classified down the
     center word's Huffman path (reference `CBOW.java` HS branch).
-    Sparse closed form like the NS steps."""
+    Sparse closed form like the NS steps. `valid` as in `_sg_hs_math`
+    (padded rows' path mask is neutralized here; their ctx_mask rows
+    are already all-zero)."""
     f32 = jnp.float32
+    if valid is not None:
+        code_mask = code_mask * valid[:, None]
+    n_eff = (centers.shape[0] if valid is None
+             else jnp.clip(jnp.sum(valid), 1.0, None))
     vecs = jnp.take(syn0, ctx, axis=0)
     m = ctx_mask[..., None]
     M = jnp.clip(jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0, None)
@@ -254,20 +316,42 @@ def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, centers, points, codes, code_mask, 
         -(dctx * scale0).reshape(-1, syn0.shape[1]).astype(syn0.dtype))
     new_syn1 = syn1.at[points.reshape(-1)].add(
         -(du * scale1).reshape(-1, syn1.shape[1]).astype(syn1.dtype))
-    return new_syn0, new_syn1, loss / centers.shape[0]
+    return new_syn0, new_syn1, loss / n_eff
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
-def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
+def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, centers, points, codes,
+                  code_mask, lr):
+    return _cbow_hs_math(syn0, syn1, ctx, ctx_mask, centers, points,
+                         codes, code_mask, lr)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_hs_step_masked(syn0, syn1, ctx, ctx_mask, centers, points, codes,
+                         code_mask, lr, valid):
+    return _cbow_hs_math(syn0, syn1, ctx, ctx_mask, centers, points,
+                         codes, code_mask, lr, valid)
+
+
+def _sg_hs_math(syn0, syn1, centers, points, codes, code_mask, lr,
+                valid=None):
     """Skip-gram hierarchical-softmax step over Huffman paths
     (reference `SkipGram.iterateSample` HS branch, `SkipGram.java:224`).
-    Sparse closed form like the NS steps."""
+    Sparse closed form like the NS steps. `valid` as in `_sg_neg_math`:
+    padded tail entries are masked out of the path mask here, so callers
+    only need to pad index arrays with zeros."""
     f32 = jnp.float32
+    if valid is not None:
+        # padded rows index word 0's Huffman path — neutralize it fully
+        code_mask = code_mask * valid[:, None]
     v = jnp.take(syn0, centers, axis=0)                        # [B,D]
     loss, dv, du = _hs_path_grads(v, syn1, points, codes, code_mask)
 
+    w1 = 1.0 if valid is None else valid
+    n_eff = (centers.shape[0] if valid is None
+             else jnp.clip(jnp.sum(valid), 1.0, None))
     counts0 = jnp.clip(jnp.zeros((syn0.shape[0],), f32)
-                       .at[centers].add(1.0), 1.0, None)
+                       .at[centers].add(w1), 1.0, None)
     counts1 = (jnp.zeros((syn1.shape[0],), f32)
                .at[points.reshape(-1)].add(code_mask.reshape(-1)))
     counts1 = jnp.clip(counts1, 1.0, None)
@@ -277,7 +361,19 @@ def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
     new_syn0 = syn0.at[centers].add(-(dv * scale0).astype(syn0.dtype))
     new_syn1 = syn1.at[points.reshape(-1)].add(
         -(du * scale1).reshape(-1, syn1.shape[1]).astype(syn1.dtype))
-    return new_syn0, new_syn1, loss / centers.shape[0]
+    return new_syn0, new_syn1, loss / n_eff
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
+    return _sg_hs_math(syn0, syn1, centers, points, codes, code_mask, lr)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _sg_hs_step_masked(syn0, syn1, centers, points, codes, code_mask, lr,
+                       valid):
+    return _sg_hs_math(syn0, syn1, centers, points, codes, code_mask, lr,
+                       valid)
 
 
 class SequenceVectors:
@@ -479,6 +575,77 @@ class SequenceVectors:
             self._hs_mask[contexts], np.float32(lr))
         return loss
 
+    def _flush_cbow_neg_tail(self, pairs, lr):
+        B = self.conf.batch_size
+        n = len(pairs)
+        if n == B:
+            return self._flush_cbow_neg(pairs, lr)
+        padded = pairs + [(0, 0, ())] * (B - n)   # empty ctx -> zero mask
+        ctx, mask, centers = self._pack_cbow(padded)
+        valid = self._valid_mask(B, n)
+        negs = np.zeros((B, max(self.conf.negative, 1)), np.int32)
+        negs[:n] = self._sample_negatives(n)      # rng stream == ragged path
+        self.syn0, self.syn1neg, loss = _cbow_neg_step_masked(
+            self.syn0, self.syn1neg, ctx, mask, centers, negs,
+            np.float32(lr), self._trainable_from, valid)
+        return loss
+
+    def _flush_cbow_hs_tail(self, pairs, lr):
+        B = self.conf.batch_size
+        n = len(pairs)
+        if n == B:
+            return self._flush_cbow_hs(pairs, lr)
+        padded = pairs + [(0, 0, ())] * (B - n)
+        ctx, mask, centers = self._pack_cbow(padded)
+        valid = self._valid_mask(B, n)
+        self.syn0, self.syn1, loss = _cbow_hs_step_masked(
+            self.syn0, self.syn1, ctx, mask, centers,
+            self._hs_points[centers], self._hs_codes[centers],
+            self._hs_mask[centers], np.float32(lr), valid)
+        return loss
+
+    # Ragged epoch-end tails run PADDED to the compiled [B] shape with a
+    # validity mask (exact math, see `_sg_neg_math`): without this,
+    # every distinct tail length costs a fresh XLA compile — measured at
+    # ~0.6 s per fit on the word2vec bench, since the reduced-window rng
+    # makes each epoch's tail length unique.
+    @staticmethod
+    def _valid_mask(B, n):
+        valid = np.zeros(B, np.float32)
+        valid[:n] = 1.0
+        return valid
+
+    def _pad_tail(self, centers, contexts):
+        B = self.conf.batch_size
+        n = len(centers)
+        pc = np.zeros(B, np.int32); pc[:n] = centers
+        px = np.zeros(B, np.int32); px[:n] = contexts
+        return pc, px, self._valid_mask(B, n)
+
+    def _flush_sg_neg_tail(self, centers, contexts, lr):
+        if len(centers) == self.conf.batch_size:
+            return self._flush_sg_neg(centers, contexts, lr)
+        pc, px, valid = self._pad_tail(centers, contexts)
+        # negatives drawn for the REAL entries only: the host rng stream
+        # stays identical to a ragged-shape flush, so results match the
+        # unpadded path exactly (padded rows are masked out anyway)
+        negs = np.zeros((len(pc), max(self.conf.negative, 1)), np.int32)
+        negs[:len(centers)] = self._sample_negatives(len(centers))
+        self.syn0, self.syn1neg, loss = _sg_neg_step_masked(
+            self.syn0, self.syn1neg, pc, px, negs, np.float32(lr),
+            self._trainable_from, valid)
+        return loss
+
+    def _flush_sg_hs_tail(self, centers, contexts, lr):
+        if len(centers) == self.conf.batch_size:
+            return self._flush_sg_hs(centers, contexts, lr)
+        pc, px, valid = self._pad_tail(centers, contexts)
+        self.syn0, self.syn1, loss = _sg_hs_step_masked(
+            self.syn0, self.syn1, pc, self._hs_points[px],
+            self._hs_codes[px], self._hs_mask[px],
+            np.float32(lr), valid)
+        return loss
+
     # ----------------------------------------------------------------- fit
     def fit(self, sequences, extra_rows: int = 0, trainable_from: int = 0,
             pair_hook=None, total_words: Optional[int] = None):
@@ -495,7 +662,11 @@ class SequenceVectors:
         use_hs = conf.use_hierarchic_softmax or conf.negative <= 0
         array_path = not conf.cbow  # skip-gram variants carry index arrays
         sg_flush = self._flush_sg_hs if use_hs else self._flush_sg_neg
+        sg_flush_tail = (self._flush_sg_hs_tail if use_hs
+                         else self._flush_sg_neg_tail)
         cbow_flush = self._flush_cbow_hs if use_hs else self._flush_cbow_neg
+        cbow_flush_tail = (self._flush_cbow_hs_tail if use_hs
+                           else self._flush_cbow_neg_tail)
 
         # lr decays linearly over the full corpus; when the training
         # corpus differs from the vocab-construction corpus (graph
@@ -571,10 +742,10 @@ class SequenceVectors:
                     cs, xs = cs[B:], xs[B:]
                 if len(cs):
                     for _ in range(conf.iterations):
-                        loss_dev = sg_flush(cs, xs, tail_lr)
+                        loss_dev = sg_flush_tail(cs, xs, tail_lr)
             elif lbuf:
                 for _ in range(conf.iterations):
-                    loss_dev = cbow_flush(lbuf, tail_lr)
+                    loss_dev = cbow_flush_tail(lbuf, tail_lr)
         self.syn0 = np.asarray(self.syn0)
         self.syn1 = np.asarray(self.syn1)
         self.syn1neg = np.asarray(self.syn1neg)

@@ -467,3 +467,159 @@ class TestSparseCbowHsParity:
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(np.asarray(got1), np.asarray(want1),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestMaskedTailParity:
+    """Padded-tail flushes must equal their ragged-shape equivalents:
+    the epoch-end tail runs padded to the compiled [B] shape with a
+    validity mask (one XLA compile for every tail length) and the masked
+    math must change nothing numerically."""
+
+    def _tables(self, rng, V, D):
+        # numpy (not device arrays): the jitted steps donate their table
+        # args, so each call must receive a fresh host->device copy
+        syn0 = (rng.standard_normal((V, D)) * 0.1).astype(np.float32)
+        syn1 = (rng.standard_normal((V, D)) * 0.1).astype(np.float32)
+        return syn0, syn1
+
+    def test_sg_neg_masked_equals_ragged(self):
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            _sg_neg_step, _sg_neg_step_masked)
+
+        rng = np.random.default_rng(0)
+        V, D, B, n, K = 50, 8, 16, 11, 5
+        syn0, syn1 = self._tables(rng, V, D)
+        centers = rng.integers(0, V, n).astype(np.int32)
+        contexts = rng.integers(0, V, n).astype(np.int32)
+        negs = rng.integers(0, V, (n, K)).astype(np.int32)
+        lr = np.float32(0.05)
+
+        want0, want1, wloss = _sg_neg_step(syn0, syn1, centers, contexts,
+                                           negs, lr, 0)
+        pc = np.zeros(B, np.int32); pc[:n] = centers
+        px = np.zeros(B, np.int32); px[:n] = contexts
+        pn = np.zeros((B, K), np.int32); pn[:n] = negs
+        valid = np.zeros(B, np.float32); valid[:n] = 1.0
+        got0, got1, gloss = _sg_neg_step_masked(syn0, syn1, pc, px, pn,
+                                                lr, 0, valid)
+        np.testing.assert_allclose(np.asarray(got0), np.asarray(want0),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(got1), np.asarray(want1),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(float(gloss), float(wloss), rtol=1e-5)
+
+    def test_sg_hs_masked_equals_ragged(self):
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            _sg_hs_step, _sg_hs_step_masked)
+
+        rng = np.random.default_rng(1)
+        V, D, B, n, C = 50, 8, 16, 9, 6
+        syn0, syn1 = self._tables(rng, V, D)
+        centers = rng.integers(0, V, n).astype(np.int32)
+        points = rng.integers(0, V, (n, C)).astype(np.int32)
+        codes = rng.integers(0, 2, (n, C)).astype(np.float32)
+        cmask = (rng.random((n, C)) < 0.7).astype(np.float32)
+        cmask[:, 0] = 1.0
+        lr = np.float32(0.05)
+
+        want0, want1, wloss = _sg_hs_step(syn0, syn1, centers, points,
+                                          codes, cmask, lr)
+        pc = np.zeros(B, np.int32); pc[:n] = centers
+        pp = np.zeros((B, C), np.int32); pp[:n] = points
+        pcd = np.zeros((B, C), np.float32); pcd[:n] = codes
+        pm = np.zeros((B, C), np.float32); pm[:n] = cmask
+        valid = np.zeros(B, np.float32); valid[:n] = 1.0
+        got0, got1, gloss = _sg_hs_step_masked(syn0, syn1, pc, pp, pcd,
+                                               pm, lr, valid)
+        np.testing.assert_allclose(np.asarray(got0), np.asarray(want0),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(got1), np.asarray(want1),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(float(gloss), float(wloss), rtol=1e-5)
+
+    def test_fit_compile_count_stable_across_refits(self):
+        """Refits over the same corpus draw different reduced windows,
+        so epoch-end tail lengths differ run to run — the padded-tail
+        path must absorb that with NO new XLA compile. Asserted via the
+        jit cache sizes of every flush step the skip-gram path uses."""
+        from deeplearning4j_tpu.nlp import sequencevectors as sv
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        rng = np.random.default_rng(2)
+        seqs = [[f"w{t}" for t in rng.integers(0, 40, 60)]
+                for _ in range(12)]
+        w2v = Word2Vec(layer_size=16, window_size=3, negative_sample=4,
+                       min_word_frequency=1, epochs=2, batch_size=128)
+        w2v.build_vocab(seqs)
+        w2v.fit(seqs)                  # warmup: compiles every shape once
+        steps = (sv._sg_neg_step, sv._sg_neg_step_masked, sv._sg_neg_multi)
+        sizes = [f._cache_size() for f in steps]
+        for _ in range(3):             # tail length varies per refit
+            w2v._init_tables()
+            w2v.fit(seqs)
+        assert [f._cache_size() for f in steps] == sizes, \
+            "refit with a different tail length triggered a recompile"
+        assert np.isfinite(w2v.get_word_vector("w1")).all()
+
+    def test_cbow_neg_masked_equals_ragged(self):
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            _cbow_neg_step, _cbow_neg_step_masked)
+
+        rng = np.random.default_rng(3)
+        V, D, B, n, K, W2 = 50, 8, 16, 10, 5, 6
+        syn0, syn1 = self._tables(rng, V, D)
+        ctx = rng.integers(0, V, (n, W2)).astype(np.int32)
+        mask = (rng.random((n, W2)) < 0.8).astype(np.float32)
+        mask[:, 0] = 1.0
+        centers = rng.integers(0, V, n).astype(np.int32)
+        negs = rng.integers(0, V, (n, K)).astype(np.int32)
+        lr = np.float32(0.05)
+
+        want0, want1, wloss = _cbow_neg_step(syn0, syn1, ctx, mask,
+                                             centers, negs, lr, 0)
+        pctx = np.zeros((B, W2), np.int32); pctx[:n] = ctx
+        pmask = np.zeros((B, W2), np.float32); pmask[:n] = mask
+        pc = np.zeros(B, np.int32); pc[:n] = centers
+        pn = np.zeros((B, K), np.int32); pn[:n] = negs
+        valid = np.zeros(B, np.float32); valid[:n] = 1.0
+        got0, got1, gloss = _cbow_neg_step_masked(
+            syn0, syn1, pctx, pmask, pc, pn, lr, 0, valid)
+        np.testing.assert_allclose(np.asarray(got0), np.asarray(want0),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(got1), np.asarray(want1),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(float(gloss), float(wloss), rtol=1e-5)
+
+    def test_cbow_hs_masked_equals_ragged(self):
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            _cbow_hs_step, _cbow_hs_step_masked)
+
+        rng = np.random.default_rng(4)
+        V, D, B, n, C, W2 = 50, 8, 16, 7, 6, 6
+        syn0, syn1 = self._tables(rng, V, D)
+        ctx = rng.integers(0, V, (n, W2)).astype(np.int32)
+        mask = (rng.random((n, W2)) < 0.8).astype(np.float32)
+        mask[:, 0] = 1.0
+        centers = rng.integers(0, V, n).astype(np.int32)
+        points = rng.integers(0, V, (n, C)).astype(np.int32)
+        codes = rng.integers(0, 2, (n, C)).astype(np.float32)
+        cmask = (rng.random((n, C)) < 0.7).astype(np.float32)
+        cmask[:, 0] = 1.0
+        lr = np.float32(0.05)
+
+        want0, want1, wloss = _cbow_hs_step(syn0, syn1, ctx, mask, centers,
+                                            points, codes, cmask, lr)
+        pctx = np.zeros((B, W2), np.int32); pctx[:n] = ctx
+        pmask = np.zeros((B, W2), np.float32); pmask[:n] = mask
+        pc = np.zeros(B, np.int32); pc[:n] = centers
+        pp = np.zeros((B, C), np.int32); pp[:n] = points
+        pcd = np.zeros((B, C), np.float32); pcd[:n] = codes
+        pcm = np.zeros((B, C), np.float32); pcm[:n] = cmask
+        valid = np.zeros(B, np.float32); valid[:n] = 1.0
+        got0, got1, gloss = _cbow_hs_step_masked(
+            syn0, syn1, pctx, pmask, pc, pp, pcd, pcm, lr, valid)
+        np.testing.assert_allclose(np.asarray(got0), np.asarray(want0),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(got1), np.asarray(want1),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(float(gloss), float(wloss), rtol=1e-5)
