@@ -417,6 +417,27 @@ class SequenceVectors:
         self.syn1neg = np.zeros((V, D), np.float32)
         max_inner = max(V, 2)
         self.syn1 = np.zeros((max_inner, D), np.float32)
+        self._init_aux_tables()
+
+    def _init_aux_tables(self):
+        """Sampler + Huffman lookup state derived from the vocab. Split
+        from `_init_tables` so a model warm-started from
+        `WordVectorSerializer` (which restores vocab + syn0 and zeroed
+        output tables, but none of this derived state) can resume
+        `fit()` without resetting its trained embeddings."""
+        V = self.vocab.num_words()
+        D = self.syn0.shape[1]
+        # guards for manually-assembled models (syn0/vocab set directly)
+        if self.syn1neg is None:
+            self.syn1neg = np.zeros((V, D), np.float32)
+        if self.syn1 is None:
+            self.syn1 = np.zeros((max(V, 2), D), np.float32)
+        # deserialized vocabs carry no Huffman codes — without this, HS
+        # warm-start training would be fully masked out (a silent no-op)
+        if V > 1 and all(not self.vocab.element_at_index(i).codes
+                         for i in range(V)):
+            from deeplearning4j_tpu.nlp.vocab import build_huffman
+            build_huffman(self.vocab)
         # unigram^0.75 negative-sampling distribution (word2vec standard)
         self._freqs = np.array([self.vocab.element_at_index(i).frequency
                                 for i in range(V)])
@@ -659,9 +680,21 @@ class SequenceVectors:
         conf = self.conf
         if self.vocab is None:
             self.build_vocab(sequences)
-        if self.syn0 is None or (extra_rows and
+        warm_start = self.syn0 is not None and self._neg_table is None
+        if self.syn0 is None or (not warm_start and extra_rows and
                                  self.syn0.shape[0] == self.vocab.num_words()):
             self._init_tables(extra_rows)
+        elif warm_start:
+            # warm start (deserialized model): vocab + syn0 exist but the
+            # sampler/Huffman state was never built. Keep the trained
+            # embeddings; label rows (ParagraphVectors) are appended, not
+            # re-randomized with the rest of the table.
+            if extra_rows and self.syn0.shape[0] == self.vocab.num_words():
+                D = self.syn0.shape[1]
+                new_rows = ((self._rng.random((extra_rows, D)) - 0.5) / D
+                            ).astype(np.float32)
+                self.syn0 = np.concatenate([np.asarray(self.syn0), new_rows])
+            self._init_aux_tables()
         self._trainable_from = trainable_from
 
         use_hs = conf.use_hierarchic_softmax or conf.negative <= 0
@@ -675,7 +708,12 @@ class SequenceVectors:
 
         # lr decays linearly over the full corpus; when the training
         # corpus differs from the vocab-construction corpus (graph
-        # walks vs degree sequences), the caller passes the real size
+        # walks vs degree sequences), the caller passes the real size.
+        # For in-memory corpora the exact size is one cheap pass — this
+        # also keeps warm-started models (whose deserialized vocab has
+        # no real counts) from collapsing the lr schedule immediately.
+        if total_words is None and isinstance(sequences, (list, tuple)):
+            total_words = sum(len(s) for s in sequences)
         if total_words is None:
             total_words = self.vocab.total_word_count
         total_words = max(total_words * conf.epochs, 1)

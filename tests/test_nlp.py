@@ -148,6 +148,64 @@ class TestSerialization:
             np.testing.assert_allclose(loaded.get_word_vector(w),
                                        w2v.get_word_vector(w), atol=1e-5)
 
+    def test_warm_start_training_after_load(self, tmp_path):
+        """A deserialized model (vocab + syn0 only) must be able to
+        resume fit(): sampler/Huffman/output tables rebuild lazily
+        instead of crashing, and trained vectors are kept (not reset)."""
+        w2v = self._small_model()
+        path = tmp_path / "vecs.bin"
+        WordVectorSerializer.write_binary(w2v, path)
+        loaded = WordVectorSerializer.read_binary(path)
+        assert loaded._neg_table is None        # nothing built yet
+        reinits = []
+        orig_init = loaded._init_tables
+        loaded._init_tables = lambda *a, **k: (reinits.append(1),
+                                               orig_init(*a, **k))
+        loaded.conf.epochs = 1
+        loaded.fit([["alpha", "beta"], ["gamma", "alpha"]])
+        assert not reinits, "warm start must not re-randomize syn0"
+        assert loaded._neg_table is not None    # aux state rebuilt lazily
+        assert loaded.syn1neg is not None
+        assert np.isfinite(loaded.get_word_vector("alpha")).all()
+
+    def test_warm_start_hs_actually_trains(self, tmp_path):
+        """Deserialized vocabs carry no Huffman codes; HS warm-start
+        must rebuild them (otherwise every update is masked to zero and
+        fit() is a silent no-op)."""
+        w2v = self._small_model()
+        path = tmp_path / "vecs.bin"
+        WordVectorSerializer.write_binary(w2v, path)
+        loaded = WordVectorSerializer.read_binary(path)
+        loaded.conf.use_hierarchic_softmax = True
+        loaded.conf.negative = 0
+        loaded.conf.epochs = 2
+        loaded.fit([["alpha", "beta", "gamma"], ["gamma", "alpha"]])
+        V = loaded.vocab.num_words()
+        assert any(len(loaded.vocab.element_at_index(i).codes)
+                   for i in range(V))
+        # a masked no-op would leave the (zero-initialized) inner-node
+        # table untouched; real HS updates write into syn1 immediately
+        assert np.abs(np.asarray(loaded.syn1)).max() > 0, \
+            "HS warm-start training changed nothing (masked no-op)"
+
+    def test_warm_start_with_extra_rows_keeps_vectors(self, tmp_path):
+        """ParagraphVectors-style warm start (extra label rows) must
+        append rows, not re-randomize the loaded embedding table."""
+        w2v = self._small_model()
+        path = tmp_path / "vecs.bin"
+        WordVectorSerializer.write_binary(w2v, path)
+        loaded = WordVectorSerializer.read_binary(path)
+        V = loaded.vocab.num_words()
+        before = np.asarray(loaded.syn0).copy()
+        # ~zero lr: any surviving difference would be re-randomization
+        loaded.conf.learning_rate = 1e-9
+        loaded.conf.min_learning_rate = 1e-12
+        loaded.conf.epochs = 1
+        loaded.fit([["alpha", "beta"]], extra_rows=2)
+        assert loaded.syn0.shape[0] == V + 2
+        np.testing.assert_allclose(np.asarray(loaded.syn0)[:V], before,
+                                   atol=1e-5)
+
 
 class TestParagraphVectors:
     def _docs(self):
