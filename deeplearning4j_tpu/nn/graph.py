@@ -432,10 +432,10 @@ class ComputationGraph:
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
-    def _make_multi_step(self):
-        """k fused train steps in one `lax.scan` dispatch — same design
-        (and numerics contract) as MultiLayerNetwork._make_multi_step;
-        the DAG container shares the dispatch-amortization lever."""
+    def _multi_step_fn(self):
+        """Unjitted k-fused-steps function — see
+        `MultiLayerNetwork._multi_step_fn` (same carry-structure rule:
+        only state keys present at init are carried across steps)."""
         gn = self.conf.gradient_normalization
         gn_t = self.conf.gradient_normalization_threshold
 
@@ -451,7 +451,7 @@ class ComputationGraph:
                 lf, has_aux=True)(params)
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd, it)
-            state = {**state, **new_state}
+            state = {k: new_state.get(k, v) for k, v in state.items()}
             return (new_params, new_upd, state, it + 1), loss
 
         def multi(params, upd, state, it0, xs_stack, ys_stack, rngs):
@@ -460,7 +460,13 @@ class ComputationGraph:
                 (xs_stack, ys_stack, rngs))
             return params, upd, state, losses
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return multi
+
+    def _make_multi_step(self):
+        """k fused train steps in one `lax.scan` dispatch — same design
+        (and numerics contract) as MultiLayerNetwork._make_multi_step;
+        the DAG container shares the dispatch-amortization lever."""
+        return jax.jit(self._multi_step_fn(), donate_argnums=(0, 1, 2))
 
     def _run_multi_step(self, xs_stack, ys_stack, it0):
         """xs_stack/ys_stack: tuples of [k, B, ...] arrays (one per

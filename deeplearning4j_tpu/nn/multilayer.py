@@ -238,17 +238,17 @@ class MultiLayerNetwork:
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
-    def _make_multi_step(self):
-        """k fused train steps in ONE device dispatch via `lax.scan`.
+    def _multi_step_fn(self):
+        """Unjitted k-fused-steps function (`lax.scan` over the step
+        body). Exposed separately so `ParallelTrainer` can re-jit the
+        SAME body with mesh shardings — one copy of the fused numerics.
 
-        Small models (LeNet-class) are dispatch-bound: a ~1ms TPU step
-        costs ~10ms of Python/runtime per call. Scanning the step body
-        over stacked minibatches amortizes that to one dispatch per k
-        steps — the reference has no analogue because its loop overhead
-        is native (`MultiLayerNetwork.java:1156` fit loop); ours is the
-        idiomatic XLA fix. Numerics are identical to k single steps:
-        same per-iteration RNG fold, same updater step counter.
-        """
+        The scan carry must keep a constant pytree structure, so state
+        keys a train-mode forward emits that were absent from
+        `init_state` (e.g. a MoE layer's popped-empty aux slot) are NOT
+        carried across fused steps; the per-step path merges them into
+        `net_state` outside jit, where growth is legal. Keys present at
+        init (batchnorm running stats, ...) update normally."""
         gn = self.conf.gradient_normalization
         gn_t = self.conf.gradient_normalization_threshold
 
@@ -264,7 +264,7 @@ class MultiLayerNetwork:
                 lf, has_aux=True)(params)
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd, it)
-            state = {**state, **new_state}
+            state = {k: new_state.get(k, v) for k, v in state.items()}
             return (new_params, new_upd, state, it + 1), loss
 
         def multi(params, upd, state, it0, xs, ys, rngs):
@@ -273,7 +273,20 @@ class MultiLayerNetwork:
                 (xs, ys, rngs))
             return params, upd, state, losses
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return multi
+
+    def _make_multi_step(self):
+        """k fused train steps in ONE device dispatch via `lax.scan`.
+
+        Small models (LeNet-class) are dispatch-bound: a ~1ms TPU step
+        costs ~10ms of Python/runtime per call. Scanning the step body
+        over stacked minibatches amortizes that to one dispatch per k
+        steps — the reference has no analogue because its loop overhead
+        is native (`MultiLayerNetwork.java:1156` fit loop); ours is the
+        idiomatic XLA fix. Numerics are identical to k single steps:
+        same per-iteration RNG fold, same updater step counter.
+        """
+        return jax.jit(self._multi_step_fn(), donate_argnums=(0, 1, 2))
 
     def _run_multi_step(self, xs, ys, it0):
         """Run len(xs) fused steps on stacked batches. Returns per-step

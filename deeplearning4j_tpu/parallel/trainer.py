@@ -77,6 +77,7 @@ class ParallelTrainer:
         self.data_axis = data_axis
         self.n_workers = int(np.prod([self.mesh.shape[a] for a in [data_axis]]))
         self._sync_step = None
+        self._sync_multi = None
         self._local_step = None
         self._average_fn = None
 
@@ -96,6 +97,22 @@ class ParallelTrainer:
             step,
             in_shardings=(repl, repl, repl, None, batch_sharded, batch_sharded, None),
             out_shardings=(repl, repl, repl, None, None),
+            donate_argnums=(0, 1, 2),
+        )
+
+    def _build_sync_multi(self):
+        """k fused sync steps in ONE dispatch — the model's own
+        `_multi_step_fn` body (one copy of the fused numerics), re-jit
+        with mesh shardings: batch stacks [k, B/d, ...] over the data
+        axis, everything else replicated; XLA inserts the per-step psum
+        exactly as in `_build_sync_step`."""
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        stack_sh = NamedSharding(mesh, P(None, self.data_axis))
+        self._sync_multi = jax.jit(
+            self.model._multi_step_fn(),
+            in_shardings=(repl, repl, repl, None, stack_sh, stack_sh, None),
+            out_shardings=(repl, repl, repl, None),
             donate_argnums=(0, 1, 2),
         )
 
@@ -159,9 +176,16 @@ class ParallelTrainer:
         return jax.tree_util.tree_map(lambda a: np.asarray(a[0]), tree)
 
     # ------------------------------------------------------------------- fit
-    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32):
+    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
+            steps_per_execution: int = 1):
         """Global-batch training over the mesh. `batch_size` is the GLOBAL
-        batch; it must divide by the data-axis size."""
+        batch; it must divide by the data-axis size.
+
+        `steps_per_execution > 1` (sync mode) fuses that many steps into
+        one `lax.scan` dispatch — numerics identical, host dispatch paid
+        once per group. The per-step loss device→host sync is also
+        skipped when no listeners/stats need it, so small-model
+        distributed training is not serialized on scalar readbacks."""
         model = self.model
         if not model._initialized:
             model.init()
@@ -202,6 +226,9 @@ class ParallelTrainer:
         if self.mode == "sync":
             if self._sync_step is None:
                 self._build_sync_step()
+            spe = max(1, int(steps_per_execution))
+            if spe > 1 and self._sync_multi is None:
+                self._build_sync_multi()
             repl = NamedSharding(self.mesh, P())
             if self.stats is not None:
                 with self.stats.time_phase("broadcast"):
@@ -214,30 +241,90 @@ class ParallelTrainer:
                 upd = _gput_tree(model.updater_state, repl)
                 state = _gput_tree(model.net_state, repl)
             batch_sh = NamedSharding(self.mesh, P(self.data_axis))
+            stack_sh = NamedSharding(self.mesh, P(None, self.data_axis))
+            # loss readback serializes host on device each step; only pay
+            # it when someone (listener/stats consumer) will look at it
+            eager_loss = bool(model.listeners) or self.stats is not None
+            last_loss = None
+
+            def run_single(ds):
+                nonlocal params, upd, state, last_loss
+                x = _gput(ds.features, batch_sh)
+                y = _gput(ds.labels, batch_sh)
+                rng = jax.random.fold_in(rng_root, model.iteration_count)
+                t0 = time.perf_counter()
+                params, upd, state, loss, _ = self._sync_step(
+                    params, upd, state, model.iteration_count, x, y, rng)
+                last_loss = loss
+                if eager_loss:
+                    model.score_value = float(loss)
+                if self.stats is not None:
+                    # float(loss) above already synced the step
+                    self.stats.record("sync_step",
+                                      time.perf_counter() - t0,
+                                      iteration=model.iteration_count)
+                    self.stats.next_round()
+                listeners.iteration_done(model, model.iteration_count,
+                                         model.epoch_count, model.score_value,
+                                         batch_size=ds.num_examples())
+                model.iteration_count += 1
+
+            def drain(pending):
+                nonlocal params, upd, state, last_loss
+                if not pending:
+                    return
+                if len(pending) == 1:
+                    run_single(pending[0])
+                    return
+                xs = _gput(np.stack([np.asarray(d.features) for d in pending]),
+                           stack_sh)
+                ys = _gput(np.stack([np.asarray(d.labels) for d in pending]),
+                           stack_sh)
+                it0 = model.iteration_count
+                rngs = jax.vmap(lambda i: jax.random.fold_in(rng_root, i))(
+                    jnp.arange(it0, it0 + len(pending)))
+                t0 = time.perf_counter()
+                params, upd, state, losses = self._sync_multi(
+                    params, upd, state, it0, xs, ys, rngs)
+                last_loss = losses
+                lv = np.asarray(losses) if eager_loss else None
+                if self.stats is not None:
+                    self.stats.record("sync_step",
+                                      time.perf_counter() - t0, iteration=it0,
+                                      fused_steps=len(pending))
+                    self.stats.next_round()
+                for j, d in enumerate(pending):
+                    if eager_loss:
+                        model.score_value = float(lv[j])
+                    listeners.iteration_done(model, model.iteration_count,
+                                             model.epoch_count,
+                                             model.score_value,
+                                             batch_size=d.num_examples())
+                    model.iteration_count += 1
+
             for _ in range(epochs):
                 iterator.reset()
+                pending = []
                 for ds in iterator:
                     if not divisible(ds):
                         continue
-                    x = _gput(ds.features, batch_sh)
-                    y = _gput(ds.labels, batch_sh)
-                    rng = jax.random.fold_in(rng_root, model.iteration_count)
-                    t0 = time.perf_counter()
-                    params, upd, state, loss, _ = self._sync_step(
-                        params, upd, state, model.iteration_count, x, y, rng)
-                    model.score_value = float(loss)
-                    if self.stats is not None:
-                        # float(loss) above already synced the step
-                        self.stats.record("sync_step",
-                                          time.perf_counter() - t0,
-                                          iteration=model.iteration_count)
-                        self.stats.next_round()
-                    listeners.iteration_done(model, model.iteration_count,
-                                             model.epoch_count, model.score_value,
-                                             batch_size=ds.num_examples())
-                    model.iteration_count += 1
+                    if spe == 1:
+                        run_single(ds)
+                        continue
+                    if pending and np.shape(ds.features) != np.shape(
+                            pending[0].features):
+                        drain(pending)   # shape change: close the group
+                        pending = []
+                    pending.append(ds)
+                    if len(pending) >= spe:
+                        drain(pending)
+                        pending = []
+                drain(pending)
                 model.epoch_count += 1
             check_trained()
+            if last_loss is not None and not eager_loss:
+                lv = np.asarray(last_loss)
+                model.score_value = float(lv[-1] if lv.ndim else lv)
             model.params = jax.tree_util.tree_map(np.asarray, params)
             model.net_state = jax.tree_util.tree_map(np.asarray, state)
             model.updater_state = jax.tree_util.tree_map(np.asarray, upd)

@@ -134,3 +134,53 @@ class TestPipeline:
             params = jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg,
                                             params, g)
         assert float(loss(params)) < l0
+
+
+class TestMoEFusedDispatch:
+    """Layers that emit train-only state (MoE aux_loss, popped by the
+    loss) must not break the fused `steps_per_execution` scan: the scan
+    carry keeps the init-time state structure."""
+
+    def _net(self):
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.common.weights import WeightInit
+        from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import MixtureOfExperts, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).updater(Adam(1e-2)).weight_init(WeightInit.XAVIER)
+                .list()
+                .layer(MixtureOfExperts(n_experts=4, hidden_size=16, top_k=2))
+                .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_container_fused_steps(self):
+        import numpy as np
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        net = self._net()
+        net.fit(x, y, epochs=2, batch_size=16, shuffle=False,
+                steps_per_execution=4)
+        assert net.iteration_count == 8
+        for v in net.param_table().values():
+            assert np.all(np.isfinite(np.asarray(v)))
+
+    def test_parallel_trainer_fused_steps(self):
+        import numpy as np
+        from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+        from deeplearning4j_tpu.parallel import ParallelTrainer
+        from deeplearning4j_tpu.parallel.mesh import device_mesh
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((64, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        net = self._net()
+        ParallelTrainer(net, device_mesh(), mode="sync").fit(
+            ArrayDataSetIterator(x, y, batch_size=32, shuffle=False),
+            epochs=2, steps_per_execution=2)
+        assert net.iteration_count == 4
+        for v in net.param_table().values():
+            assert np.all(np.isfinite(np.asarray(v)))
