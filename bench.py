@@ -741,8 +741,48 @@ def _scaling_child():
     print(json.dumps({"metric": "dataparallel_scaling_cpu8", **out}))
 
 
+def _probe_backend(timeout_s=180):
+    """Initialize the JAX backend with a watchdog. The axon plugin's
+    device init HANGS indefinitely when the TPU tunnel is down (observed
+    in round 3) — a bench that hangs tells the driver nothing, so probe
+    in a daemon thread and report a structured failure instead."""
+    import threading
+    box = {}
+
+    def probe():
+        try:
+            box["info"] = _device_info()
+        except Exception as e:
+            box["err"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "info" in box:
+        return box["info"]
+    err = box.get("err", f"backend did not initialize within {timeout_s}s "
+                         "(accelerator tunnel down?)")
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+        "error": err,
+    }))
+    return None
+
+
 def main():
-    plat, kind, accel, _ = _device_info()
+    info = _probe_backend()
+    if info is None:
+        return
+    plat, kind, accel, _ = info
+    try:
+        # persistent XLA cache: repeat bench runs skip the minutes-long
+        # ResNet compile (timed windows never include compiles anyway —
+        # the warmup dispatch absorbs them)
+        from deeplearning4j_tpu.nd import enable_compilation_cache
+        enable_compilation_cache()
+    except Exception:
+        pass
     primary = bench_resnet50(accel)
 
     extras = {}

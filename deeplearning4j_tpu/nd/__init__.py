@@ -6,6 +6,7 @@ XLA; this package only pins the few semantics the framework layers rely
 on: dtype policy, RNG key streams, and device placement helpers.
 """
 
+from deeplearning4j_tpu.nd.cache import enable_compilation_cache
 from deeplearning4j_tpu.nd.dtype import (
     DataTypePolicy,
     default_policy,

@@ -1,0 +1,45 @@
+"""Persistent XLA compilation cache.
+
+The reference pays no compile step (libnd4j kernels are prebuilt); the
+XLA equivalent cost is jit compilation — minutes for ResNet-class
+programs on a real TPU, paid again in every new process. Pointing JAX's
+persistent compilation cache at a directory makes that a one-time cost
+per (program, backend) pair: later processes deserialize the compiled
+executable instead of recompiling.
+
+This is the workspace-warmup analogue of the reference's ahead-of-time
+native kernels (SURVEY.md §0: libnd4j ships compiled; our compiles must
+be cached to compete on startup latency).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+_DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache", "dl4tpu-xla")
+
+
+def enable_compilation_cache(cache_dir: str | None = None,
+                             min_compile_time_secs: float = 1.0) -> str:
+    """Persist compiled XLA executables under `cache_dir` (created if
+    missing; default `~/.cache/dl4tpu-xla`). Programs whose compile took
+    at least `min_compile_time_secs` are cached — keep the threshold
+    above zero in production so trivial compiles don't churn the disk;
+    tests pass 0 to observe the cache deterministically.
+
+    Returns the cache directory path. Safe to call more than once."""
+    import jax
+
+    path = Path(cache_dir or _DEFAULT_DIR).expanduser()
+    path.mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_secs))
+    # cache everything the backend supports serializing, not just
+    # autotuned programs
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # older jax: option absent, defaults are fine
+    return str(path)

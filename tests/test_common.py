@@ -236,3 +236,26 @@ class TestDistributions:
         d = NormalDistribution(1.0, 2.0)
         d2 = distribution_from_dict(d.to_dict())
         assert d2 == d
+
+
+class TestCompilationCache:
+    def test_enable_populates_cache_dir(self, tmp_path):
+        """enable_compilation_cache points JAX's persistent cache at the
+        dir; a fresh jitted program writes an entry there."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nd import enable_compilation_cache
+
+        d = enable_compilation_cache(tmp_path / "xla", min_compile_time_secs=0)
+        try:
+            @jax.jit
+            def f(a, b):
+                return jnp.tanh(a @ b) + a.sum()
+
+            f(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+            import os
+            assert os.path.isdir(d)
+            assert len(os.listdir(d)) >= 1, "no cache entry written"
+        finally:
+            # don't leak the tmp dir into later tests' jit calls
+            jax.config.update("jax_compilation_cache_dir", None)
