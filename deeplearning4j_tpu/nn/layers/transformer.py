@@ -80,6 +80,15 @@ class TransformerEncoderBlock(Layer):
     attention_dropout: Optional[float] = None
     ff_activation: str = "gelu"
     use_flash: Optional[bool] = None
+    # rematerialization: recompute this block's intra-block activations
+    # (attention internals, the O(T * ff) hidden) in the backward pass
+    # instead of storing them. One block-input residual per layer is
+    # still saved, so activation memory scales with depth as
+    # O(layers * T * D) + O(one block's internals) rather than
+    # O(layers * block internals) — the standard lever for long-context
+    # training on HBM-limited chips. FLOPs grow by ~1 extra forward;
+    # numerics are identical.
+    remat: bool = False
 
     def __post_init__(self):
         if self.activation is None:
@@ -133,6 +142,18 @@ class TransformerEncoderBlock(Layer):
                 if k.startswith(prefix + "_")}
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        if self.remat and train:
+            # mask rides the closure (no grad needed); params/x/rng are
+            # the differentiated/recomputed arguments
+            def body(p, xx, r):
+                return self._forward_impl(p, xx, train=True, rng=r,
+                                          mask=mask)
+
+            return jax.checkpoint(body)(params, x, rng), state
+        return self._forward_impl(params, x, train=train, rng=rng,
+                                  mask=mask), state
+
+    def _forward_impl(self, params, x, *, train, rng, mask):
         from deeplearning4j_tpu.common.activations import get_activation
 
         if self._mha is None:
@@ -152,4 +173,4 @@ class TransformerEncoderBlock(Layer):
         h = self.apply_input_dropout(h, train,
                                      None if rng is None
                                      else jax.random.fold_in(rng, 3))
-        return x + h, state
+        return x + h

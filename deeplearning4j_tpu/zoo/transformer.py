@@ -33,7 +33,7 @@ class TransformerClassifier(ZooModel):
                  d_model: int = 128, n_layers: int = 2, n_heads: int = 8,
                  ff_multiplier: int = 4, max_len: int = 512,
                  dropout: float = None, pooling: PoolingType = PoolingType.AVG,
-                 seed: int = 123):
+                 remat: bool = False, seed: int = 123):
         super().__init__(num_classes=num_classes, seed=seed)
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -43,6 +43,7 @@ class TransformerClassifier(ZooModel):
         self.max_len = max_len
         self.dropout = dropout
         self.pooling = pooling
+        self.remat = remat
 
     def conf(self):
         b = (NeuralNetConfiguration.builder()
@@ -54,7 +55,7 @@ class TransformerClassifier(ZooModel):
         for _ in range(self.n_layers):
             b.layer(TransformerEncoderBlock(
                 n_heads=self.n_heads, ff_multiplier=self.ff_multiplier,
-                dropout=self.dropout))
+                dropout=self.dropout, remat=self.remat))
         b.layer(GlobalPoolingLayer(pooling_type=self.pooling))
         b.layer(OutputLayer(n_out=self.num_classes, activation="softmax",
                             loss="mcxent"))
@@ -69,7 +70,7 @@ class TransformerLM(ZooModel):
     def __init__(self, vocab_size: int, *, d_model: int = 128,
                  n_layers: int = 2, n_heads: int = 8,
                  ff_multiplier: int = 4, max_len: int = 512,
-                 seed: int = 123):
+                 remat: bool = False, seed: int = 123):
         super().__init__(num_classes=vocab_size, seed=seed)
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -77,6 +78,7 @@ class TransformerLM(ZooModel):
         self.n_heads = n_heads
         self.ff_multiplier = ff_multiplier
         self.max_len = max_len
+        self.remat = remat
 
     def conf(self):
         b = (NeuralNetConfiguration.builder()
@@ -88,7 +90,7 @@ class TransformerLM(ZooModel):
         for _ in range(self.n_layers):
             b.layer(TransformerEncoderBlock(
                 n_heads=self.n_heads, ff_multiplier=self.ff_multiplier,
-                causal=True))
+                causal=True, remat=self.remat))
         b.layer(RnnOutputLayer(n_out=self.vocab_size, activation="softmax",
                                loss="mcxent"))
         b.set_input_type(InputType.recurrent(self.vocab_size))

@@ -145,3 +145,64 @@ def test_embedding_vocab_inferred_from_recurrent_input():
     assert net.params["0"]["W"].shape == (100, 8)
     ids = np.random.default_rng(0).integers(0, 100, (3, 5)).astype(np.float32)
     assert np.asarray(net.output(ids)).shape == (3, 2)
+
+
+class TestRematParity:
+    """`remat=True` recomputes block activations in backward — loss,
+    gradients, and the training trajectory must be identical to the
+    stored-activation path (jax.checkpoint changes memory, not math)."""
+
+    def test_lm_training_trajectory_identical(self):
+        import numpy as np
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+
+        V, B, T = 20, 4, 12
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, V, (B, T))
+        x = ids.astype(np.float32)
+        y = np.eye(V, dtype=np.float32)[(ids + 1) % V]
+
+        losses = {}
+        for remat in (False, True):
+            lm = TransformerLM(vocab_size=V, d_model=16, n_layers=2,
+                               n_heads=4, max_len=T, remat=remat)
+            net = lm.init()
+            net.fit(x, y, epochs=3, batch_size=B, shuffle=False)
+            losses[remat] = net.score_value
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_parity_holds_with_dropout(self):
+        """rng rides through jax.checkpoint as an explicit argument, so
+        the backward-pass recompute draws the SAME dropout masks — with
+        dropout enabled, remat on/off must still match exactly."""
+        import numpy as np
+        from deeplearning4j_tpu.zoo.transformer import TransformerClassifier
+
+        V, B, T = 16, 8, 10
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, V, (B, T)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, B)]
+
+        losses = {}
+        for remat in (False, True):
+            clf = TransformerClassifier(vocab_size=V, num_classes=3,
+                                        d_model=16, n_layers=2, n_heads=4,
+                                        max_len=T, dropout=0.8, remat=remat)
+            net = clf.init()
+            net.fit(ids, y, epochs=3, batch_size=B, shuffle=False)
+            losses[remat] = net.score_value
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_remat_survives_config_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+
+        conf = TransformerLM(vocab_size=10, d_model=8, n_layers=1,
+                             n_heads=2, max_len=8, remat=True).conf()
+        js = conf.to_json()
+        clone = MultiLayerConfiguration.from_json(js)
+        blocks = [l for l in clone.layers
+                  if getattr(l, "layer_name", "") == "transformer_encoder"]
+        assert blocks and all(b.remat for b in blocks)
