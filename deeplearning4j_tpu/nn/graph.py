@@ -263,12 +263,34 @@ class ComputationGraph:
         self._jit_output = None
         self._jit_rnn_step = None
         self._solver = None
+        self._ambient_seq_ctx = None
+        self._uses_seq_parallel = any(
+            getattr(n.layer, "sequence_parallel", None)
+            for n in conf.nodes.values() if n.layer is not None)
         self._rnn_carries: Dict[str, Any] = {}
         self.output_layer_names = [
             n for n in conf.network_outputs
             if conf.nodes[n].kind == "layer"
             and isinstance(conf.nodes[n].layer, BaseOutputLayerMixin)
         ]
+
+    def _sync_ambient_context(self):
+        """See `MultiLayerNetwork._sync_ambient_context` — drop cached
+        jitted programs when the ambient sequence-parallel (mesh, axis)
+        changes, so trace-time schedule selection stays current."""
+        if not self._uses_seq_parallel:
+            return
+        from deeplearning4j_tpu.parallel.context import current_sequence_mesh
+        ctx = current_sequence_mesh()
+        if ctx == self._ambient_seq_ctx:
+            return
+        self._ambient_seq_ctx = ctx
+        self._jit_train_step = None
+        self._jit_tbptt_step = None
+        self._jit_multi_step = None
+        self._jit_output = None
+        self._jit_rnn_step = None
+        self._solver = None
 
     # ------------------------------------------------------------------ init
     def _init_trees(self, seed: int):
@@ -495,6 +517,7 @@ class ComputationGraph:
 
         if not self._initialized:
             self.init()
+        self._sync_ambient_context()
         if isinstance(data, MultiDataSet):
             batches = [data]
         else:
@@ -770,6 +793,7 @@ class ComputationGraph:
     def output(self, *inputs, train: bool = False, masks=None):
         if not self._initialized:
             self.init()
+        self._sync_ambient_context()
         if self._jit_output is None:
             def fwd(params, state, xs, masks):
                 acts, _, _, _ = self._forward_all(params, state, xs, train=False,

@@ -36,10 +36,21 @@ class MultiHeadAttention(Layer):
     has_bias: bool = True
     attention_dropout: Optional[float] = None  # retain prob on attn weights
     use_flash: Optional[bool] = None  # Pallas kernel; None → auto (TPU only)
+    # long-context: "ring" (ppermute K/V rotation) or "ulysses"
+    # (all-to-all head sharding) over the ambient mesh installed by
+    # `parallel.sequence_sharding(mesh, axis)`. The config carries only
+    # the strategy name (serializable); the mesh is runtime state. Falls
+    # back to the local path when no mesh is active or a padding mask /
+    # attention dropout is in play.
+    sequence_parallel: Optional[str] = None
 
     def __post_init__(self):
         if self.activation is None:
             self.activation = "identity"
+        if self.sequence_parallel not in (None, "ring", "ulysses"):
+            raise ValueError(
+                f"sequence_parallel must be None, 'ring' or 'ulysses'; "
+                f"got {self.sequence_parallel!r}")
         super().__post_init__()
 
     def set_n_in(self, input_type, override=True):
@@ -85,11 +96,34 @@ class MultiHeadAttention(Layer):
         q = self.heads(self._project(params, x, "Wq"))   # [B,T,H,Dh]
         k = self.heads(self._project(params, x, "Wk"))
         v = self.heads(self._project(params, x, "Wv"))
+        plain = mask is None and (not train or self.attention_dropout is None)
+        if self.sequence_parallel and plain:
+            from deeplearning4j_tpu.parallel.context import current_sequence_mesh
+            ctx = current_sequence_mesh()
+            if ctx is not None:
+                mesh, axis = ctx
+                if self.sequence_parallel == "ring":
+                    from deeplearning4j_tpu.parallel import (
+                        sequence_parallel_attention)
+                    o = sequence_parallel_attention(q, k, v, mesh,
+                                                    seq_axis=axis,
+                                                    causal=self.causal)
+                elif self.sequence_parallel == "ulysses":
+                    from deeplearning4j_tpu.parallel import (
+                        ulysses_parallel_attention)
+                    o = ulysses_parallel_attention(q, k, v, mesh,
+                                                   axis_name=axis,
+                                                   causal=self.causal)
+                else:
+                    raise ValueError(
+                        f"sequence_parallel must be 'ring'|'ulysses', "
+                        f"got {self.sequence_parallel!r}")
+                o = o.reshape(x.shape[0], x.shape[1], -1)
+                return self.activation(self._project(params, o, "Wo")), state
         use_flash = self.use_flash
         if use_flash is None:
             use_flash = jax.default_backend() == "tpu"
-        if (use_flash and mask is None
-                and (not train or self.attention_dropout is None)):
+        if (use_flash and plain):
             # Pallas fused fast path (the cuDNN-helper role)
             from deeplearning4j_tpu.kernels import flash_attention
             o = flash_attention(q, k, v, self.causal)

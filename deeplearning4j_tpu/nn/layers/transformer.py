@@ -80,6 +80,7 @@ class TransformerEncoderBlock(Layer):
     attention_dropout: Optional[float] = None
     ff_activation: str = "gelu"
     use_flash: Optional[bool] = None
+    sequence_parallel: Optional[str] = None  # "ring"|"ulysses", see MHA
     # rematerialization: recompute this block's intra-block activations
     # (attention internals, the O(T * ff) hidden) in the backward pass
     # instead of storing them. One block-input residual per layer is
@@ -93,6 +94,10 @@ class TransformerEncoderBlock(Layer):
     def __post_init__(self):
         if self.activation is None:
             self.activation = "identity"
+        if self.sequence_parallel not in (None, "ring", "ulysses"):
+            raise ValueError(
+                f"sequence_parallel must be None, 'ring' or 'ulysses'; "
+                f"got {self.sequence_parallel!r}")
         super().__post_init__()
         self._mha: Optional[MultiHeadAttention] = None
 
@@ -105,7 +110,8 @@ class TransformerEncoderBlock(Layer):
         self._mha = MultiHeadAttention(
             n_in=self.n_in, n_out=self.n_in, n_heads=self.n_heads,
             causal=self.causal, attention_dropout=self.attention_dropout,
-            use_flash=self.use_flash, weight_init=self.weight_init)
+            use_flash=self.use_flash, weight_init=self.weight_init,
+            sequence_parallel=self.sequence_parallel)
         self._ln1 = LayerNormalization(n_out=self.n_in)
         self._ln2 = LayerNormalization(n_out=self.n_in)
 

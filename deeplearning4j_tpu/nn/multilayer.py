@@ -88,12 +88,37 @@ class MultiLayerNetwork:
         self._jit_output = None
         self._jit_rnn_step = None
         self._solver = None
+        self._ambient_seq_ctx = None
+        self._uses_seq_parallel = any(
+            getattr(l, "sequence_parallel", None) for l in self.layers)
         self._initialized = False
         out = self.layers[-1] if self.layers else None
         if out is not None and not isinstance(out, BaseOutputLayerMixin):
             self._has_loss = False
         else:
             self._has_loss = True
+
+    def _sync_ambient_context(self):
+        """Cached jitted steps bake in trace-time decisions — including
+        which attention schedule the ambient `sequence_sharding` context
+        selected. If the active (mesh, axis) differs from the one the
+        cached programs were traced under, drop them so the next call
+        re-traces; otherwise a step compiled outside the context would
+        silently keep running local attention inside it (and vice
+        versa). No-op for models with no sequence-parallel layers."""
+        if not self._uses_seq_parallel:
+            return
+        from deeplearning4j_tpu.parallel.context import current_sequence_mesh
+        ctx = current_sequence_mesh()
+        if ctx == self._ambient_seq_ctx:
+            return
+        self._ambient_seq_ctx = ctx
+        self._jit_train_step = None
+        self._jit_tbptt_step = None
+        self._jit_multi_step = None
+        self._jit_output = None
+        self._jit_rnn_step = None
+        self._solver = None
 
     # ------------------------------------------------------------------ init
     def _init_trees(self, seed: int):
@@ -315,6 +340,7 @@ class MultiLayerNetwork:
         batches, and ragged tails."""
         if not self._initialized:
             self.init()
+        self._sync_ambient_context()
         iterator = as_iterator(data, labels, batch_size=batch_size, shuffle=shuffle)
         listeners = ComposedListeners(self.listeners)
         rng_root = jax.random.PRNGKey(self.conf.seed + 1)
@@ -444,6 +470,7 @@ class MultiLayerNetwork:
         `MultiLayerNetwork.output` :1866)."""
         if not self._initialized:
             self.init()
+        self._sync_ambient_context()
         x = _convert_features(x, data_format)
         if self._jit_output is None:
             def fwd(params, state, x, mask):

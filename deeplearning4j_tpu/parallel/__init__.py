@@ -39,6 +39,10 @@ from deeplearning4j_tpu.parallel.master import (
     SharedTrainingMaster,
     TrainingMaster,
 )
+from deeplearning4j_tpu.parallel.context import (
+    current_sequence_mesh,
+    sequence_sharding,
+)
 from deeplearning4j_tpu.parallel.stats import TrainingMasterStats
 from deeplearning4j_tpu.parallel.multihost import (
     initialize_multihost,

@@ -1,0 +1,39 @@
+"""Ambient sequence-parallel context.
+
+Configs are serializable data (a layer can *request*
+`sequence_parallel="ring"`), while meshes are runtime hardware state —
+so the mesh rides a context manager instead of the config:
+
+    mesh = make_mesh(MeshSpec.of(seq=8))
+    with sequence_sharding(mesh, axis="seq"):
+        net.fit(x, y, ...)        # attention layers with
+                                  # sequence_parallel set now run
+                                  # ring/Ulysses over the mesh
+
+The lookup happens at trace time (inside jit tracing, not per step), so
+there is no runtime overhead. Thread-local, like the reference's
+per-thread workspace configuration.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Tuple
+
+_state = threading.local()
+
+
+def current_sequence_mesh() -> Optional[Tuple[object, str]]:
+    """The active (mesh, seq_axis) pair, or None."""
+    return getattr(_state, "mesh_axis", None)
+
+
+@contextlib.contextmanager
+def sequence_sharding(mesh, axis: str = "seq"):
+    prev = getattr(_state, "mesh_axis", None)
+    _state.mesh_axis = (mesh, axis)
+    try:
+        yield mesh
+    finally:
+        _state.mesh_axis = prev

@@ -33,7 +33,8 @@ class TransformerClassifier(ZooModel):
                  d_model: int = 128, n_layers: int = 2, n_heads: int = 8,
                  ff_multiplier: int = 4, max_len: int = 512,
                  dropout: float = None, pooling: PoolingType = PoolingType.AVG,
-                 remat: bool = False, seed: int = 123):
+                 remat: bool = False, sequence_parallel: str = None,
+                 seed: int = 123):
         super().__init__(num_classes=num_classes, seed=seed)
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -44,6 +45,7 @@ class TransformerClassifier(ZooModel):
         self.dropout = dropout
         self.pooling = pooling
         self.remat = remat
+        self.sequence_parallel = sequence_parallel
 
     def conf(self):
         b = (NeuralNetConfiguration.builder()
@@ -55,7 +57,8 @@ class TransformerClassifier(ZooModel):
         for _ in range(self.n_layers):
             b.layer(TransformerEncoderBlock(
                 n_heads=self.n_heads, ff_multiplier=self.ff_multiplier,
-                dropout=self.dropout, remat=self.remat))
+                dropout=self.dropout, remat=self.remat,
+                sequence_parallel=self.sequence_parallel))
         b.layer(GlobalPoolingLayer(pooling_type=self.pooling))
         b.layer(OutputLayer(n_out=self.num_classes, activation="softmax",
                             loss="mcxent"))
@@ -70,7 +73,8 @@ class TransformerLM(ZooModel):
     def __init__(self, vocab_size: int, *, d_model: int = 128,
                  n_layers: int = 2, n_heads: int = 8,
                  ff_multiplier: int = 4, max_len: int = 512,
-                 remat: bool = False, seed: int = 123):
+                 remat: bool = False, sequence_parallel: str = None,
+                 seed: int = 123):
         super().__init__(num_classes=vocab_size, seed=seed)
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -79,6 +83,7 @@ class TransformerLM(ZooModel):
         self.ff_multiplier = ff_multiplier
         self.max_len = max_len
         self.remat = remat
+        self.sequence_parallel = sequence_parallel
 
     def conf(self):
         b = (NeuralNetConfiguration.builder()
@@ -90,7 +95,8 @@ class TransformerLM(ZooModel):
         for _ in range(self.n_layers):
             b.layer(TransformerEncoderBlock(
                 n_heads=self.n_heads, ff_multiplier=self.ff_multiplier,
-                causal=True, remat=self.remat))
+                causal=True, remat=self.remat,
+                sequence_parallel=self.sequence_parallel))
         b.layer(RnnOutputLayer(n_out=self.vocab_size, activation="softmax",
                                loss="mcxent"))
         b.set_input_type(InputType.recurrent(self.vocab_size))

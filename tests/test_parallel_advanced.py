@@ -266,3 +266,97 @@ class TestUlyssesAttention:
         mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
         with pytest.raises(ValueError):
             ulysses_parallel_attention(q, k, v, mesh)
+
+
+class TestLayerSequenceParallel:
+    """`sequence_parallel="ring"|"ulysses"` on the attention layer /
+    encoder block: under an ambient `sequence_sharding(mesh)` the layer
+    runs the distributed schedule; outputs must match the local path."""
+
+    def _mha_out(self, sp, mesh=None, n_heads=8):
+        from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
+        from deeplearning4j_tpu.parallel import sequence_sharding
+
+        layer = MultiHeadAttention(n_in=16, n_out=16, n_heads=n_heads,
+                                   causal=True, sequence_parallel=sp,
+                                   use_flash=False)
+        layer.set_n_in(InputType.recurrent(16))
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16))
+        if mesh is None:
+            out, _ = layer.forward(params, {}, x)
+        else:
+            with sequence_sharding(mesh, axis="seq"):
+                out, _ = layer.forward(params, {}, x)
+        return np.asarray(out)
+
+    @pytest.mark.parametrize("sp", ["ring", "ulysses"])
+    def test_matches_local_attention(self, sp):
+        from deeplearning4j_tpu.parallel import MeshSpec, make_mesh
+
+        mesh = make_mesh(MeshSpec.of(seq=8))
+        want = self._mha_out(None)
+        got = self._mha_out(sp, mesh)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_no_ambient_mesh_falls_back(self):
+        # sequence_parallel set but no sequence_sharding context: the
+        # local path runs and the answer is unchanged
+        want = self._mha_out(None)
+        got = self._mha_out("ring", mesh=None)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_zoo_lm_trains_under_seq_mesh(self):
+        from deeplearning4j_tpu.parallel import (
+            MeshSpec, make_mesh, sequence_sharding)
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+
+        V, B, T = 16, 2, 16
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, V, (B, T))
+        x = ids.astype(np.float32)
+        y = np.eye(V, dtype=np.float32)[(ids + 1) % V]
+
+        lm = TransformerLM(vocab_size=V, d_model=16, n_layers=1, n_heads=8,
+                           max_len=T, sequence_parallel="ring")
+        net = lm.init()
+        mesh = make_mesh(MeshSpec.of(seq=8))
+        with sequence_sharding(mesh, axis="seq"):
+            net.fit(x, y, epochs=2, batch_size=B, shuffle=False)
+        assert np.isfinite(net.score_value)
+
+    def test_invalid_strategy_rejected_at_construction(self):
+        from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
+        from deeplearning4j_tpu.nn.layers.transformer import (
+            TransformerEncoderBlock)
+
+        with pytest.raises(ValueError, match="sequence_parallel"):
+            MultiHeadAttention(n_in=8, sequence_parallel="ulyses")
+        with pytest.raises(ValueError, match="sequence_parallel"):
+            TransformerEncoderBlock(n_in=8, sequence_parallel="rng")
+
+    def test_cached_jit_invalidated_on_context_change(self):
+        """A step traced OUTSIDE sequence_sharding must not be silently
+        reused inside it (and vice versa): entering/leaving the context
+        drops the container's cached jitted programs."""
+        from deeplearning4j_tpu.parallel import (
+            MeshSpec, make_mesh, sequence_sharding)
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+
+        V, B, T = 16, 2, 16
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, V, (B, T)).astype(np.float32)
+
+        net = TransformerLM(vocab_size=V, d_model=16, n_layers=1, n_heads=8,
+                            max_len=T, sequence_parallel="ring").init()
+        out_local = np.asarray(net.output(x))
+        jit_before = net._jit_output
+        mesh = make_mesh(MeshSpec.of(seq=8))
+        with sequence_sharding(mesh, axis="seq"):
+            out_sp = np.asarray(net.output(x))
+            assert net._jit_output is not jit_before, \
+                "cached jit survived a sequence-sharding context change"
+        np.testing.assert_allclose(out_sp, out_local, rtol=2e-4, atol=2e-5)
+        # leaving the context invalidates again
+        net.output(x)
+        assert net._ambient_seq_ctx is None
