@@ -1,0 +1,44 @@
+"""Long-context training recipe: sequence parallelism + remat.
+
+The three levers for sequences that don't fit one chip's HBM:
+1. `sequence_parallel="ring"` (or "ulysses") on the transformer blocks —
+   the time axis shards over a mesh "seq" axis; K/V blocks rotate over
+   ICI (ring) or heads redistribute via all-to-all (Ulysses).
+2. `remat=True` — intra-block activations are recomputed in backward
+   instead of stored (one extra forward of FLOPs, big memory cut).
+3. The mesh rides the `sequence_sharding` context; the config carries
+   only the strategy name, so checkpoints stay portable.
+
+Runs on anything: 8 virtual CPU devices here, a real TPU pod slice in
+production (same code, bigger mesh).
+"""
+import numpy as np
+
+from deeplearning4j_tpu.parallel import MeshSpec, make_mesh, sequence_sharding
+from deeplearning4j_tpu.zoo import TransformerLM
+
+
+def main():
+    rng = np.random.default_rng(0)
+    V, B, T = 64, 4, 256                     # T shards 8-ways -> 32/device
+    ids = rng.integers(0, V, (B, T))
+    x = ids.astype(np.float32)
+    y = np.eye(V, dtype=np.float32)[(ids + 1) % V]   # next-token targets
+
+    lm = TransformerLM(vocab_size=V, d_model=32, n_layers=2, n_heads=8,
+                       max_len=T, sequence_parallel="ring", remat=True)
+    net = lm.init()
+
+    mesh = make_mesh(MeshSpec.of(seq=8))
+    with sequence_sharding(mesh, axis="seq"):
+        net.fit(x, y, epochs=3, batch_size=B, shuffle=False)
+    print("loss after 3 epochs:", round(net.score_value, 4))
+
+    # inference outside the context falls back to the local path —
+    # same numerics, no mesh needed
+    out = np.asarray(net.output(x))
+    print("output shape:", out.shape)
+
+
+if __name__ == "__main__":
+    main()
