@@ -57,6 +57,7 @@ class TrainingMaster:
     # `checkpoint_every` epochs, a retry budget that restores the last
     # checkpoint on failure, and resume-from-latest on start.
     def _run_epochs(self, model, trainer, x, y, *, epochs, batch_size):
+        spe = max(1, getattr(self, "steps_per_execution", 1))
         import glob
         import os
 
@@ -69,7 +70,8 @@ class TrainingMaster:
         if not ckpt_dir and not retries:
             # no fault tolerance configured: one fit() for all epochs —
             # avoids per-epoch param re-broadcast round-trips
-            return trainer.fit(x, y, epochs=epochs, batch_size=batch_size)
+            return trainer.fit(x, y, epochs=epochs, batch_size=batch_size,
+                               steps_per_execution=spe)
 
         import jax as _jax
 
@@ -163,7 +165,8 @@ class TrainingMaster:
         budget = retries
         while epoch < epochs:
             try:
-                trainer.fit(x, y, epochs=1, batch_size=batch_size)
+                trainer.fit(x, y, epochs=1, batch_size=batch_size,
+                            steps_per_execution=spe)
                 save(epoch)
                 epoch += 1
             except Exception:
@@ -244,8 +247,10 @@ class SharedTrainingMaster(TrainingMaster):
                  collect_training_stats: bool = False,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1, max_retries: int = 0,
-                 resume: bool = True, **compression_knobs):
+                 resume: bool = True, steps_per_execution: int = 1,
+                 **compression_knobs):
         self.batch_size_per_worker = batch_size_per_worker
+        self.steps_per_execution = steps_per_execution
         self.mesh = mesh
         self.collect_training_stats = collect_training_stats
         self.stats: TrainingMasterStats = None

@@ -50,13 +50,17 @@ def _build_model():
 
 
 def _run_training():
-    """Global-view sync training on whatever global mesh exists; returns
-    the per-iteration loss trajectory."""
+    """Global-view training on whatever global mesh exists: (a) DP sync
+    (ParallelTrainer), then (b) DP x TP (ShardedParallelTrainer —
+    params sharded over "model" ACROSS processes). Returns one loss
+    trajectory covering both phases."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
 
     from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.parallel.tensor import ShardedParallelTrainer
     from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
 
     devs = np.array(jax.devices())
@@ -70,7 +74,22 @@ def _run_training():
     y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, B)]
     ParallelTrainer(model, mesh, mode="sync").fit(x, y, epochs=5,
                                                   batch_size=B)
-    return [s for _, s in listener.scores]
+    losses = [s for _, s in listener.scores]
+
+    # DP x TP across the same global devices. "model" is the OUTERMOST
+    # mesh axis: jax.devices() is process-major and make_mesh reshapes
+    # row-major, so the model-axis pairs straddle the process boundary
+    # and every TP activation gather crosses the distributed runtime
+    # (innermost "model" would keep TP intra-process and prove nothing)
+    n_dev = len(devs)
+    tp_mesh = make_mesh(MeshSpec.of(model=2, data=max(n_dev // 2, 1)),
+                        devices=devs.tolist())
+    tp_model = _build_model()
+    tp_listener = CollectScoresListener()
+    tp_model.set_listeners(tp_listener)
+    ShardedParallelTrainer(tp_model, tp_mesh).fit(x, y, epochs=3,
+                                                  batch_size=B)
+    return losses + [s for _, s in tp_listener.scores]
 
 
 def _worker_main(coordinator: str, n: int, i: int):

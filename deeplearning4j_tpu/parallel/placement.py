@@ -1,0 +1,54 @@
+"""Global-array placement helpers shared by the distributed trainers.
+
+`gput` places a host array under a sharding in a way that works in BOTH
+runtime shapes:
+- single process: plain `jax.device_put`;
+- multi process (`jax.distributed`): every process holds the same host
+  value and contributes its addressable shards via
+  `make_array_from_callback` — `device_put` cannot address remote
+  devices. This is what lets the same global-view `fit()` run unchanged
+  under 1 or N processes (the Spark-RDD partition feed of
+  `ParameterAveragingTrainingMaster` collapses into the sharding).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Sharding
+
+
+def gput(arr, sharding):
+    # a leaf can already be a global array spanning non-addressable
+    # devices (e.g. TP-sharded params kept on-device by host_view_tree
+    # after a previous fit) — np.asarray on it would raise; pass it
+    # through or let device_put reshard global->global
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        if arr.sharding == sharding:
+            return arr
+        return jax.device_put(arr, sharding)
+    a = np.asarray(arr)
+    if jax.process_count() > 1:
+        return jax.make_array_from_callback(a.shape, sharding,
+                                            lambda idx: a[idx])
+    return jax.device_put(a, sharding)
+
+
+def gput_tree(tree, sharding):
+    """Place every leaf. `sharding` is either one Sharding applied to
+    all leaves, or a pytree of Shardings matching `tree`."""
+    if isinstance(sharding, Sharding):
+        return jax.tree_util.tree_map(lambda a: gput(a, sharding), tree)
+    return jax.tree_util.tree_map(gput, tree, sharding)
+
+
+def host_view_tree(tree):
+    """Bring leaves back to host numpy where legal. Under multi-process,
+    a model/tensor-sharded leaf is not fully addressable from any one
+    process — those stay as global device arrays (every consumer in
+    this framework accepts either)."""
+    def to_host(a):
+        if getattr(a, "is_fully_replicated", True) or jax.process_count() == 1:
+            return np.asarray(a)
+        return a
+    return jax.tree_util.tree_map(to_host, tree)

@@ -121,30 +121,45 @@ class ShardedParallelTrainer:
         self._psh, self._ush, self._repl, self._bsh = psh, ush, repl, bsh
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32):
+        from deeplearning4j_tpu.parallel.placement import (
+            gput, gput_tree, host_view_tree)
+
         model = self.model
         if self._step is None:
             self._build()
-        params = jax.device_put(model.params, self._psh)
-        upd = jax.device_put(model.updater_state, self._ush)
-        state = jax.device_put(model.net_state, self._repl)
+        # multi-process aware placement: each process contributes only
+        # its addressable shards of the TP-sharded param tree
+        params = gput_tree(model.params, self._psh)
+        upd = gput_tree(model.updater_state, self._ush)
+        state = gput_tree(model.net_state, self._repl)
         iterator = as_iterator(data, labels, batch_size=batch_size)
         listeners = ComposedListeners(model.listeners)
         rng_root = jax.random.PRNGKey(model.conf.seed + 5)
+        # per-step scalar readback serializes host on device; only pay
+        # it when a listener will look at the score (same gate as
+        # ParallelTrainer's sync path)
+        eager_loss = bool(model.listeners)
+        loss = None
         for _ in range(epochs):
             iterator.reset()
             for ds in iterator:
-                x = jax.device_put(jnp.asarray(ds.features), self._bsh)
-                y = jax.device_put(jnp.asarray(ds.labels), self._bsh)
+                x = gput(ds.features, self._bsh)
+                y = gput(ds.labels, self._bsh)
                 rng = jax.random.fold_in(rng_root, model.iteration_count)
                 params, upd, state, loss, _ = self._step(
                     params, upd, state, model.iteration_count, x, y, rng)
-                model.score_value = float(loss)
+                if eager_loss:
+                    model.score_value = float(loss)
                 listeners.iteration_done(model, model.iteration_count,
                                          model.epoch_count, model.score_value,
                                          batch_size=ds.num_examples())
                 model.iteration_count += 1
             model.epoch_count += 1
-        model.params = jax.tree_util.tree_map(np.asarray, params)
-        model.updater_state = jax.tree_util.tree_map(np.asarray, upd)
-        model.net_state = jax.tree_util.tree_map(np.asarray, state)
+        if loss is not None and not eager_loss:
+            model.score_value = float(loss)
+        # model-sharded leaves are not host-gatherable from one process
+        # under multi-process execution; those stay as global arrays
+        model.params = host_view_tree(params)
+        model.updater_state = host_view_tree(upd)
+        model.net_state = host_view_tree(state)
         return model

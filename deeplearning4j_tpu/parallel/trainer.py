@@ -39,23 +39,11 @@ from deeplearning4j_tpu.optimize.listeners import ComposedListeners
 from deeplearning4j_tpu.parallel.mesh import device_mesh
 
 
-def _gput(arr, sharding):
-    """Place a host array under `sharding`. Single-process: device_put.
-    Multi-process (jax.distributed): every process holds the same host
-    value and contributes its addressable shards via
-    `make_array_from_callback` — device_put cannot address remote
-    devices. This is what lets the SAME global-view fit() run unchanged
-    under 1 or N processes (the Spark-RDD partition feed of
-    `ParameterAveragingTrainingMaster` collapses into the sharding)."""
-    a = np.asarray(arr)
-    if jax.process_count() > 1:
-        return jax.make_array_from_callback(a.shape, sharding,
-                                            lambda idx: a[idx])
-    return jax.device_put(a, sharding)
-
-
-def _gput_tree(tree, sharding):
-    return jax.tree_util.tree_map(lambda a: _gput(a, sharding), tree)
+# shared with ShardedParallelTrainer — see parallel/placement.py
+from deeplearning4j_tpu.parallel.placement import (  # noqa: E402
+    gput as _gput,
+    gput_tree as _gput_tree,
+)
 
 
 class ParallelTrainer:

@@ -12,6 +12,20 @@ The three levers for sequences that don't fit one chip's HBM:
 Runs on anything: 8 virtual CPU devices here, a real TPU pod slice in
 production (same code, bigger mesh).
 """
+import os
+
+if not os.environ.get("DL4TPU_REAL_DEVICES"):
+    # self-contained CPU demo: give the process 8 virtual devices
+    # (must happen before jax initializes its backend). Set
+    # DL4TPU_REAL_DEVICES=1 to run on the machine's real accelerators.
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+if not os.environ.get("DL4TPU_REAL_DEVICES"):
+    # in-process override beats plugin sitecustomize platform forcing
+    jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
 from deeplearning4j_tpu.parallel import MeshSpec, make_mesh, sequence_sharding
@@ -20,7 +34,8 @@ from deeplearning4j_tpu.zoo import TransformerLM
 
 def main():
     rng = np.random.default_rng(0)
-    V, B, T = 64, 4, 256                     # T shards 8-ways -> 32/device
+    n_seq = len(jax.devices())               # mesh sized to what exists
+    V, B, T = 64, 4, 32 * n_seq              # T shards n_seq-ways
     ids = rng.integers(0, V, (B, T))
     x = ids.astype(np.float32)
     y = np.eye(V, dtype=np.float32)[(ids + 1) % V]   # next-token targets
@@ -29,7 +44,7 @@ def main():
                        max_len=T, sequence_parallel="ring", remat=True)
     net = lm.init()
 
-    mesh = make_mesh(MeshSpec.of(seq=8))
+    mesh = make_mesh(MeshSpec.of(seq=n_seq))
     with sequence_sharding(mesh, axis="seq"):
         net.fit(x, y, epochs=3, batch_size=B, shuffle=False)
     print("loss after 3 epochs:", round(net.score_value, 4))

@@ -292,3 +292,16 @@ def test_torn_zip_checkpoint_not_left_behind(tmp_path):
     assert not glob.glob(d + "/epoch*.zip")
     assert not glob.glob(d + "/*.tmp")
     assert len(glob.glob(d + "/epoch*.ckpt")) == 1
+
+
+def test_shared_master_fused_steps():
+    """SharedTrainingMaster(steps_per_execution=k) drains k-step groups
+    through one dispatch and still trains every batch."""
+    net = _model()
+    x, y = _data()
+    master = SharedTrainingMaster(batch_size_per_worker=4,
+                                  steps_per_execution=2)
+    master.execute_training(net, (x, y), epochs=2)
+    assert net.epoch_count == 2
+    for v in net.param_table().values():
+        assert np.all(np.isfinite(np.asarray(v)))
