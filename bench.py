@@ -668,12 +668,23 @@ def _scaling_child():
     steps = 5 if host_cores >= 4 else 3
 
     def timed_fit(trainer_fit, x, y, B, warmup_epochs=1):
-        # warmup must exercise every jitted path the timed window hits
-        # (incl. the averaging collective), or the window pays compiles
-        trainer_fit(x, y, epochs=warmup_epochs, batch_size=B)
-        t0 = time.perf_counter()
-        trainer_fit(x, y, epochs=steps, batch_size=B)
-        return time.perf_counter() - t0
+        # `steps` batches tiled into ONE epoch drained through the fused
+        # steps_per_execution scan — the timed window is one dispatch,
+        # so the ratios measure partitioning, not Python dispatch.
+        # Warmup exercises every jitted path the window hits (incl. the
+        # averaging collective), or the window pays compiles.
+        xt = np.tile(x, (steps,) + (1,) * (x.ndim - 1))
+        yt = np.tile(y, (steps,) + (1,) * (y.ndim - 1))
+        for _ in range(warmup_epochs):
+            trainer_fit(xt, yt, epochs=1, batch_size=B,
+                        steps_per_execution=steps)
+        best = float("inf")
+        for _ in range(2):           # best-of-2: the sandbox host is shared
+            t0 = time.perf_counter()
+            trainer_fit(xt, yt, epochs=1, batch_size=B,
+                        steps_per_execution=steps)
+            best = min(best, time.perf_counter() - t0)
+        return best
 
     def make_data(B):
         x = rng.standard_normal((B, 28, 28, 1)).astype(np.float32)
@@ -685,9 +696,8 @@ def _scaling_child():
     # slower than the framework's own best 1-device path.
     plain = build()
     x1, y1 = make_data(per_dev)
-    dt = timed_fit(lambda x, y, epochs, batch_size: plain.fit(
-        x, y, epochs=epochs, batch_size=batch_size, shuffle=False),
-        x1, y1, per_dev)
+    dt = timed_fit(lambda x, y, **kw: plain.fit(x, y, shuffle=False, **kw),
+                   x1, y1, per_dev)
     thr_plain = per_dev * steps / dt
 
     out = {"host_cores": host_cores, "per_device_batch": per_dev,
@@ -723,11 +733,17 @@ def _scaling_child():
     G = per_dev * 8 if host_cores >= 4 else per_dev * 4
     xg, yg = make_data(G)
     plain2 = build()
-    dt1 = timed_fit(lambda x, y, epochs, batch_size: plain2.fit(
-        x, y, epochs=epochs, batch_size=batch_size, shuffle=False),
-        xg, yg, G)
+    dt1_plain = timed_fit(
+        lambda x, y, **kw: plain2.fit(x, y, shuffle=False, **kw), xg, yg, G)
+    # the efficiency denominator is the FASTEST 1-device configuration
+    # (plain jit fit or the trainer at n=1) so a slow baseline can't
+    # manufacture superlinear "efficiency"
+    tr1 = ParallelTrainer(build(), Mesh(np.array(jax.devices()[:1]),
+                                        ("data",)), mode="sync")
+    dt1 = min(dt1_plain, timed_fit(tr1.fit, xg, yg, G))
     strong = {"global_batch": G,
-              "plain_1dev_seconds": round(dt1, 3)}
+              "plain_1dev_seconds": round(dt1_plain, 3),
+              "best_1dev_seconds": round(dt1, 3)}
     for n in (2, 4, 8):
         mesh = Mesh(np.array(jax.devices()[:n]), ("data",))
         tr = ParallelTrainer(build(), mesh, mode="sync")
@@ -737,6 +753,18 @@ def _scaling_child():
             "speedup": round(dt1 / dtn, 3),
             "strong_scaling_efficiency": round(dt1 / dtn / n, 3),
         }
+    if any(strong[str(n)]["strong_scaling_efficiency"] > 1.0
+           for n in (2, 4, 8)):
+        # measured repeatedly on the 1-core sandbox: the UNPARTITIONED
+        # 1-device XLA-CPU program is ~2x slower than the same work
+        # partitioned 2-ways on the same single core (conv kernel /
+        # blocking selection at the larger per-call batch). Efficiency
+        # vs an anomalously slow baseline is not evidence of scaling —
+        # flag it rather than publish a >1 number silently.
+        strong["baseline_anomaly_suspected"] = (
+            "1-device program slower than partitioned equivalents on the "
+            "same core count; XLA-CPU kernel-selection artifact, ratios "
+            "not meaningful beyond partitioning overhead")
     out["strong_sync"] = strong
     print(json.dumps({"metric": "dataparallel_scaling_cpu8", **out}))
 

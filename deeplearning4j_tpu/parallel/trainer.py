@@ -67,6 +67,7 @@ class ParallelTrainer:
         self._sync_step = None
         self._sync_multi = None
         self._local_step = None
+        self._local_multi = None
         self._average_fn = None
 
     # ------------------------------------------------------------- sync mode
@@ -105,10 +106,8 @@ class ParallelTrainer:
         )
 
     # -------------------------------------------------------- averaging mode
-    def _build_averaging(self):
+    def _make_local_one_step(self):
         model = self.model
-        mesh = self.mesh
-        axis = self.data_axis
         gn = model.conf.gradient_normalization
         gn_t = model.conf.gradient_normalization_threshold
 
@@ -120,6 +119,13 @@ class ParallelTrainer:
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = model._apply_updates(params, grads, upd, it)
             return new_params, new_upd, new_state, loss
+
+        return local_one_step
+
+    def _build_averaging(self):
+        mesh = self.mesh
+        axis = self.data_axis
+        local_one_step = self._make_local_one_step()
 
         from jax import shard_map
 
@@ -151,6 +157,89 @@ class ParallelTrainer:
         self._local_step = jax.jit(local_step, donate_argnums=(0, 1, 2))
         self._average_fn = jax.jit(average, donate_argnums=(0,))
 
+    def _build_averaging_multi(self):
+        """k fused local-SGD steps in ONE dispatch: the scan lives
+        INSIDE shard_map, and the pmean averaging round fires at its
+        `averaging_frequency` cadence via `lax.cond` — numerics
+        identical to the per-step path (same rng folds, same iteration
+        counters, same averaging boundaries), dispatch paid once per
+        group."""
+        mesh = self.mesh
+        axis = self.data_axis
+        freq = self.averaging_frequency
+        avg_upd = self.average_updater_state
+        local_one_step = self._make_local_one_step()
+
+        from jax import shard_map
+        from jax import lax
+
+        rep_spec = P(axis)
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(rep_spec, rep_spec, rep_spec, None, None,
+                           P(None, axis), P(None, axis), None),
+                 out_specs=(rep_spec, rep_spec, rep_spec, P(None, axis)),
+                 check_vma=False)
+        def local_multi(params_r, upd_r, state_r, it0, since0, xs, ys, rngs):
+            params = jax.tree_util.tree_map(lambda a: a[0], params_r)
+            upd = jax.tree_util.tree_map(lambda a: a[0], upd_r)
+            state = jax.tree_util.tree_map(lambda a: a[0], state_r)
+            axis_idx = jax.lax.axis_index(axis)
+
+            def avg(tree):
+                return jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, axis), tree)
+
+            def body(carry, inp):
+                params, upd, state, it, since = carry
+                x, y, rng = inp
+                rng = jax.random.fold_in(rng, axis_idx)
+                params, upd, state, loss = local_one_step(
+                    params, upd, state, it, x, y, rng)
+                do = since + 1 >= freq
+                params = lax.cond(do, avg, lambda t: t, params)
+                state = lax.cond(do, avg, lambda t: t, state)
+                if avg_upd:
+                    upd = lax.cond(do, avg, lambda t: t, upd)
+                since = jnp.where(do, 0, since + 1)
+                return (params, upd, state, it + 1, since), loss
+
+            (params, upd, state, _, _), losses = lax.scan(
+                body,
+                (params, upd, state, jnp.asarray(it0, jnp.int32),
+                 jnp.asarray(since0, jnp.int32)),
+                (xs, ys, rngs))
+            expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+            return expand(params), expand(upd), expand(state), losses[:, None]
+
+        self._local_multi = jax.jit(local_multi, donate_argnums=(0, 1, 2))
+
+    @staticmethod
+    def _run_grouped(iterator, epochs, spe, divisible, run_single, drain,
+                     model):
+        """Shared epoch/grouping loop for both modes: accumulate up to
+        `spe` same-shape batches, drain each full group (and the epoch
+        tail) through one fused dispatch; spe == 1 runs per-step."""
+        for _ in range(epochs):
+            iterator.reset()
+            pending = []
+            for ds in iterator:
+                if not divisible(ds):
+                    continue
+                if spe == 1:
+                    run_single(ds)
+                    continue
+                if pending and np.shape(ds.features) != np.shape(
+                        pending[0].features):
+                    drain(pending)   # shape change: close the group
+                    pending = []
+                pending.append(ds)
+                if len(pending) >= spe:
+                    drain(pending)
+                    pending = []
+            drain(pending)
+            model.epoch_count += 1
+
     def _replicate_tree(self, tree):
         """Stack n_workers copies along a new leading axis, shard over data."""
         n = self.n_workers
@@ -169,11 +258,15 @@ class ParallelTrainer:
         """Global-batch training over the mesh. `batch_size` is the GLOBAL
         batch; it must divide by the data-axis size.
 
-        `steps_per_execution > 1` (sync mode) fuses that many steps into
-        one `lax.scan` dispatch — numerics identical, host dispatch paid
-        once per group. The per-step loss device→host sync is also
-        skipped when no listeners/stats need it, so small-model
-        distributed training is not serialized on scalar readbacks."""
+        `steps_per_execution > 1` fuses that many steps into one
+        `lax.scan` dispatch — numerics identical, host dispatch paid
+        once per group. Both modes honor it (sync: scan over sharded
+        batch stacks; averaging: the pmean round fires in-scan at its
+        cadence); stats collection forces per-step execution in
+        averaging mode because fused dispatch has no observable phase
+        boundaries. The per-step loss device→host sync is also skipped
+        when no listeners/stats need it, so small-model distributed
+        training is not serialized on scalar readbacks."""
         model = self.model
         if not model._initialized:
             model.init()
@@ -290,25 +383,8 @@ class ParallelTrainer:
                                              batch_size=d.num_examples())
                     model.iteration_count += 1
 
-            for _ in range(epochs):
-                iterator.reset()
-                pending = []
-                for ds in iterator:
-                    if not divisible(ds):
-                        continue
-                    if spe == 1:
-                        run_single(ds)
-                        continue
-                    if pending and np.shape(ds.features) != np.shape(
-                            pending[0].features):
-                        drain(pending)   # shape change: close the group
-                        pending = []
-                    pending.append(ds)
-                    if len(pending) >= spe:
-                        drain(pending)
-                        pending = []
-                drain(pending)
-                model.epoch_count += 1
+            self._run_grouped(iterator, epochs, spe, divisible,
+                              run_single, drain, model)
             check_trained()
             if last_loss is not None and not eager_loss:
                 lv = np.asarray(last_loss)
@@ -318,9 +394,19 @@ class ParallelTrainer:
             model.updater_state = jax.tree_util.tree_map(np.asarray, upd)
             return model
 
-        # averaging (local SGD) mode
+        # averaging (local SGD) mode. `steps_per_execution > 1` drains
+        # k-batch groups through ONE shard_map dispatch whose scan fires
+        # the pmean round at the averaging_frequency cadence — numerics
+        # identical to per-step. Per-phase stats need the per-step path
+        # (fused dispatch has no observable phase boundaries), so stats
+        # collection forces spe=1.
         if self._local_step is None:
             self._build_averaging()
+        spe = max(1, int(steps_per_execution))
+        if self.stats is not None:
+            spe = 1
+        if spe > 1 and self._local_multi is None:
+            self._build_averaging_multi()
         if self.stats is not None:
             with self.stats.time_phase("broadcast"):
                 params_r = self._replicate_tree(model.params)
@@ -332,45 +418,83 @@ class ParallelTrainer:
             upd_r = self._replicate_tree(model.updater_state)
             state_r = self._replicate_tree(model.net_state)
         batch_sh = NamedSharding(self.mesh, P(self.data_axis))
+        stack_sh = NamedSharding(self.mesh, P(None, self.data_axis))
         since_avg = 0
-        for _ in range(epochs):
-            iterator.reset()
-            for ds in iterator:
-                if not divisible(ds):
-                    continue
-                x = _gput(ds.features, batch_sh)
-                y = _gput(ds.labels, batch_sh)
-                rng = jax.random.fold_in(rng_root, model.iteration_count)
-                t0 = time.perf_counter()
-                params_r, upd_r, state_r, losses = self._local_step(
-                    params_r, upd_r, state_r, model.iteration_count, x, y, rng)
+        # same lazy-readback gate as sync mode: the per-step scalar sync
+        # is only paid when a listener/stats consumer will look at it
+        eager_loss = bool(model.listeners) or self.stats is not None
+        last_losses = None
+
+        def run_single(ds):
+            nonlocal params_r, upd_r, state_r, since_avg, last_losses
+            x = _gput(ds.features, batch_sh)
+            y = _gput(ds.labels, batch_sh)
+            rng = jax.random.fold_in(rng_root, model.iteration_count)
+            t0 = time.perf_counter()
+            params_r, upd_r, state_r, losses = self._local_step(
+                params_r, upd_r, state_r, model.iteration_count, x, y, rng)
+            last_losses = losses
+            if eager_loss:
                 model.score_value = float(jnp.mean(losses))
+            if self.stats is not None:
+                self.stats.record("local_fit", time.perf_counter() - t0,
+                                  iteration=model.iteration_count)
+            since_avg += 1
+            if since_avg >= self.averaging_frequency:
+                t0 = time.perf_counter()
+                params_r = self._average_fn(params_r)
+                state_r = self._average_fn(state_r)
+                if self.average_updater_state:
+                    upd_r = self._average_fn(upd_r)
                 if self.stats is not None:
-                    self.stats.record("local_fit", time.perf_counter() - t0,
-                                      iteration=model.iteration_count)
-                since_avg += 1
-                if since_avg >= self.averaging_frequency:
-                    t0 = time.perf_counter()
-                    params_r = self._average_fn(params_r)
-                    state_r = self._average_fn(state_r)
-                    if self.average_updater_state:
-                        upd_r = self._average_fn(upd_r)
-                    if self.stats is not None:
-                        jax.block_until_ready(params_r)
-                        self.stats.record("average",
-                                          time.perf_counter() - t0,
-                                          round=self.stats.next_round())
-                    since_avg = 0
+                    jax.block_until_ready(params_r)
+                    self.stats.record("average",
+                                      time.perf_counter() - t0,
+                                      round=self.stats.next_round())
+                since_avg = 0
+            listeners.iteration_done(model, model.iteration_count,
+                                     model.epoch_count, model.score_value,
+                                     batch_size=ds.num_examples())
+            model.iteration_count += 1
+
+        def drain(pending):
+            nonlocal params_r, upd_r, state_r, since_avg, last_losses
+            if not pending:
+                return
+            if len(pending) == 1:
+                run_single(pending[0])
+                return
+            xs = _gput(np.stack([np.asarray(d.features) for d in pending]),
+                       stack_sh)
+            ys = _gput(np.stack([np.asarray(d.labels) for d in pending]),
+                       stack_sh)
+            it0 = model.iteration_count
+            rngs = jax.vmap(lambda i: jax.random.fold_in(rng_root, i))(
+                jnp.arange(it0, it0 + len(pending)))
+            params_r, upd_r, state_r, losses = self._local_multi(
+                params_r, upd_r, state_r, it0, since_avg, xs, ys, rngs)
+            last_losses = losses[-1]
+            # cadence advances deterministically (since_avg < freq is
+            # invariant) — host mirror of the in-scan update, no sync
+            since_avg = (since_avg + len(pending)) % self.averaging_frequency
+            lv = np.asarray(losses) if eager_loss else None
+            for j, d in enumerate(pending):
+                if eager_loss:
+                    model.score_value = float(lv[j].mean())
                 listeners.iteration_done(model, model.iteration_count,
                                          model.epoch_count, model.score_value,
-                                         batch_size=ds.num_examples())
+                                         batch_size=d.num_examples())
                 model.iteration_count += 1
-            model.epoch_count += 1
+
+        self._run_grouped(iterator, epochs, spe, divisible,
+                          run_single, drain, model)
         if since_avg:
             params_r = self._average_fn(params_r)
             state_r = self._average_fn(state_r)
             if self.average_updater_state:
                 upd_r = self._average_fn(upd_r)
+        if last_losses is not None and not eager_loss:
+            model.score_value = float(jnp.mean(last_losses))
         check_trained()
         model.params = self._unreplicate_tree(params_r)
         model.net_state = self._unreplicate_tree(state_r)

@@ -105,6 +105,29 @@ class TestParallelTrainer:
         for v in net.param_table().values():
             assert np.all(np.isfinite(np.asarray(v)))
 
+    def test_averaging_fused_drain_matches_per_step(self):
+        """Averaging mode with steps_per_execution: the in-scan pmean
+        cadence must reproduce the per-step path exactly (same rng
+        folds, same averaging boundaries)."""
+        x, y = load_iris()
+        x, y = x[:96], y[:96]
+
+        def run(spe):
+            net = MultiLayerNetwork(mlp_conf(updater=Sgd(0.05))).init()
+            ParallelTrainer(net, device_mesh(), mode="averaging",
+                            averaging_frequency=3).fit(
+                ArrayDataSetIterator(x, y, batch_size=24, shuffle=False),
+                epochs=2, steps_per_execution=spe)
+            return net
+
+        net1, net2 = run(1), run(4)
+        assert net2.iteration_count == net1.iteration_count
+        for k in net1.param_table():
+            np.testing.assert_allclose(np.asarray(net1.param_table()[k]),
+                                       np.asarray(net2.param_table()[k]),
+                                       atol=2e-5,
+                                       err_msg=f"param {k} diverged")
+
     def test_averaging_mode_learns(self):
         x, y = load_iris()
         net = MultiLayerNetwork(mlp_conf()).init()
