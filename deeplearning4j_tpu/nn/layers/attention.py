@@ -23,6 +23,35 @@ from deeplearning4j_tpu.common.weights import init_weights
 from deeplearning4j_tpu.nn.conf.inputs import InputType, InputTypeRecurrent
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
 
+_FLASH_OK: dict = {}   # backend name -> probe verdict (once per backend)
+
+
+def _flash_available() -> bool:
+    """Eagerly compile-and-run the Pallas flash kernel once on tiny
+    shapes for the current backend. This is the helper seam's
+    availability check (reference `ConvolutionLayer.java:76-80` probes
+    for the cuDNN helper class): a kernel that fails to COMPILE would
+    otherwise only surface at jit-compile time of the whole train step —
+    outside any try/except a traced forward could place — so auto mode
+    must decide eagerly, before tracing."""
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    if backend not in _FLASH_OK:
+        try:
+            from deeplearning4j_tpu.kernels import flash_attention
+            q = jnp.zeros((1, 128, 1, 8), jnp.float32)
+            jax.block_until_ready(flash_attention(q, q, q, False))
+            _FLASH_OK[backend] = True
+        except Exception as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "flash attention kernel unavailable on %s (%s: %s); "
+                "auto mode will use the XLA attention path",
+                backend, type(e).__name__, e)
+            _FLASH_OK[backend] = False
+    return _FLASH_OK[backend]
+
 
 @register_layer
 @dataclasses.dataclass(eq=False)
@@ -122,7 +151,11 @@ class MultiHeadAttention(Layer):
                 return self.activation(self._project(params, o, "Wo")), state
         use_flash = self.use_flash
         if use_flash is None:
-            use_flash = jax.default_backend() == "tpu"
+            # auto mode probes kernel availability eagerly (a compile
+            # failure inside a jitted train step could not be caught);
+            # use_flash=True skips the probe so a forced-but-broken
+            # kernel surfaces its real error
+            use_flash = jax.default_backend() == "tpu" and _flash_available()
         if (use_flash and plain):
             # Pallas fused fast path (the cuDNN-helper role)
             from deeplearning4j_tpu.kernels import flash_attention

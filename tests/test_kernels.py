@@ -110,3 +110,57 @@ class TestFlashAttention:
         y2, _ = layer_xla.forward(params, {}, x)
         np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestFlashFallbackSeam:
+    """The helper seam degrades like the reference's cuDNN fallback
+    (`ConvolutionLayer.java:76-80`): auto mode probes the kernel
+    eagerly once per backend — a probe failure routes attention through
+    the XLA path with one warning — while an explicit use_flash=True
+    surfaces the real kernel error."""
+
+    def _layer(self, use_flash):
+        import jax
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
+
+        layer = MultiHeadAttention(n_in=8, n_out=8, n_heads=2,
+                                   use_flash=use_flash)
+        layer.set_n_in(InputType.recurrent(8))
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 8))
+        return layer, params, x
+
+    def test_auto_mode_probe_failure_falls_back(self, monkeypatch):
+        import numpy as np
+        import jax
+        import deeplearning4j_tpu.kernels as kmod
+        from deeplearning4j_tpu.nn.layers import attention as attn_mod
+
+        # true XLA reference first (no patches)
+        layer, params, x = self._layer(False)
+        want = np.asarray(layer.forward(params, {}, x)[0])
+
+        def boom(*a, **k):
+            raise RuntimeError("kernel exploded")
+
+        monkeypatch.setattr(kmod, "flash_attention", boom)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        attn_mod._FLASH_OK.clear()
+        layer, params, x = self._layer(None)       # auto
+        got = np.asarray(layer.forward(params, {}, x)[0])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert attn_mod._FLASH_OK.get("tpu") is False
+        attn_mod._FLASH_OK.clear()                 # don't poison later tests
+
+    def test_forced_flash_failure_surfaces(self, monkeypatch):
+        import pytest
+        import deeplearning4j_tpu.kernels as kmod
+
+        def boom(*a, **k):
+            raise RuntimeError("kernel exploded")
+
+        monkeypatch.setattr(kmod, "flash_attention", boom)
+        layer, params, x = self._layer(True)
+        with pytest.raises(RuntimeError, match="kernel exploded"):
+            layer.forward(params, {}, x)
