@@ -202,19 +202,40 @@ class RecordReaderDataSetIterator(DataSetIterator):
 class SequenceRecordReaderDataSetIterator(DataSetIterator):
     """Aligned feature/label sequence readers → padded+masked RNN
     minibatches [B, T, F] (reference
-    `SequenceRecordReaderDataSetIterator.java` ALIGN_END semantics)."""
+    `SequenceRecordReaderDataSetIterator.java` ALIGN_END semantics).
+
+    `bucket_boundaries` (TPU-first knob, SURVEY §7 "dynamic shapes"):
+    per-batch max-length padding gives every distinct T its own XLA
+    compile; with boundaries, T pads UP to the smallest bucket ≥ the
+    batch max (last bucket = hard cap, longer sequences truncated), so
+    the number of compiled programs is bounded by len(boundaries). The
+    masks already make the extra padding a numeric no-op."""
 
     def __init__(self, feature_reader: CSVSequenceRecordReader,
                  label_reader: Optional[CSVSequenceRecordReader],
                  batch_size: int, num_classes: Optional[int] = None,
-                 regression: bool = False, label_index: int = -1):
+                 regression: bool = False, label_index: int = -1,
+                 bucket_boundaries: Optional[Sequence[int]] = None):
         self.feature_reader = feature_reader
         self.label_reader = label_reader
         self.batch_size = batch_size
         self.num_classes = num_classes
         self.regression = regression
         self.label_index = label_index
+        if bucket_boundaries and any(b <= 0 for b in bucket_boundaries):
+            raise ValueError(
+                f"bucket_boundaries must be positive, got {bucket_boundaries}")
+        self.bucket_boundaries = (sorted(bucket_boundaries)
+                                  if bucket_boundaries else None)
         self.reset()
+
+    def _bucket_len(self, T: int) -> int:
+        if self.bucket_boundaries is None:
+            return T
+        for b in self.bucket_boundaries:
+            if T <= b:
+                return b
+        return self.bucket_boundaries[-1]     # hard cap: truncate
 
     def reset(self):
         self.feature_reader.reset()
@@ -238,7 +259,7 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
             seqs.append(np.asarray(fseq, np.float32))
             label_seqs.append(np.asarray(lseq, np.float32))
         B = len(seqs)
-        T = max(s.shape[0] for s in seqs)
+        T = self._bucket_len(max(s.shape[0] for s in seqs))
         F = seqs[0].shape[1]
         if self.regression or self.num_classes is None:
             L = label_seqs[0].shape[1]
@@ -249,6 +270,14 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
         mask = np.zeros((B, T), np.float32)
         for i, (s, l) in enumerate(zip(seqs, label_seqs)):
             t = s.shape[0]
+            if t > T:
+                # hard-cap truncation (bucketing only) keeps the TAIL:
+                # ALIGN_END semantics put the informative final steps
+                # (and sequence-classification targets) at the end
+                t = T
+                s, l = s[-T:], l[-T:]
+            # (a label sequence misaligned with its features still
+            # raises below — truncation never masks corrupted data)
             x[i, :t] = s
             if self.regression or self.num_classes is None:
                 y[i, :t] = l

@@ -69,6 +69,42 @@ class TestRecordReaders:
         np.testing.assert_array_equal(ds.features_mask, [[1, 1, 1], [1, 1, 0]])
         assert ds.labels[0, 1, 1] == 1.0  # t=1 label 1 one-hot
 
+    def test_sequence_reader_bucketing(self, tmp_path):
+        """bucket_boundaries pads T up to a fixed bucket (bounded XLA
+        compile count) and hard-caps at the last boundary."""
+        s1 = tmp_path / "s1.csv"
+        s1.write_text("0.1,0.2,0\n0.3,0.4,1\n0.5,0.6,0\n")  # len 3
+        s2 = tmp_path / "s2.csv"
+        s2.write_text("0.7,0.8,1\n0.9,1.0,1\n")              # len 2
+        reader = CSVSequenceRecordReader([s1, s2])
+        it = SequenceRecordReaderDataSetIterator(
+            reader, None, batch_size=2, num_classes=2,
+            bucket_boundaries=[4, 8])
+        ds = it.next()
+        assert ds.features.shape == (2, 4, 2)     # bucketed up to 4
+        np.testing.assert_array_equal(ds.features_mask,
+                                      [[1, 1, 1, 0], [1, 1, 0, 0]])
+
+        # hard cap: sequences longer than the last boundary truncate,
+        # keeping the TAIL (ALIGN_END: final steps carry the targets)
+        reader.reset()
+        it2 = SequenceRecordReaderDataSetIterator(
+            reader, None, batch_size=2, num_classes=2,
+            bucket_boundaries=[2])
+        ds2 = it2.next()
+        assert ds2.features.shape == (2, 2, 2)
+        np.testing.assert_array_equal(ds2.features_mask, [[1, 1], [1, 1]])
+        # seq 1 (len 3) kept its LAST two steps: features 0.3..0.6
+        np.testing.assert_allclose(ds2.features[0],
+                                   [[0.3, 0.4], [0.5, 0.6]], rtol=1e-6)
+
+        # non-positive boundaries are rejected at construction
+        import pytest
+        with pytest.raises(ValueError, match="positive"):
+            SequenceRecordReaderDataSetIterator(
+                reader, None, batch_size=2, num_classes=2,
+                bucket_boundaries=[0])
+
 
 class TestFetchers:
     def test_emnist_letters(self):
