@@ -87,8 +87,13 @@ def _run_training():
     tp_model = _build_model()
     tp_listener = CollectScoresListener()
     tp_model.set_listeners(tp_listener)
-    ShardedParallelTrainer(tp_model, tp_mesh).fit(x, y, epochs=3,
-                                                  batch_size=B)
+    tp_trainer = ShardedParallelTrainer(tp_model, tp_mesh)
+    tp_trainer.fit(x, y, epochs=2, batch_size=B)
+    # second fit: model.params now holds TP-sharded GLOBAL arrays (not
+    # host-gatherable from one process) — placement must pass them
+    # through instead of np.asarray-ing them (regression: resumed/
+    # multi-call training under multi-process TP)
+    tp_trainer.fit(x, y, epochs=1, batch_size=B)
     return losses + [s for _, s in tp_listener.scores]
 
 
