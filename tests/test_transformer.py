@@ -206,3 +206,48 @@ class TestRematParity:
         blocks = [l for l in clone.layers
                   if getattr(l, "layer_name", "") == "transformer_encoder"]
         assert blocks and all(b.remat for b in blocks)
+
+
+class TestTransformerTransferLearning:
+    """Fine-tune a 'pretrained' TransformerClassifier on a new label
+    set: freeze the encoder stack, replace the head — the reference
+    transfer-learning workflow applied to the beyond-reference model
+    family."""
+
+    def test_freeze_encoder_swap_head(self):
+        from deeplearning4j_tpu.transferlearning import TransferLearning
+
+        V, B, T = 20, 16, 10
+        rng = np.random.default_rng(0)
+        ids = rng.integers(1, V, (B, T)).astype(np.float32)
+        y2 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, B)]
+
+        base = TransformerClassifier(vocab_size=V, num_classes=4,
+                                     d_model=16, n_layers=1, n_heads=4,
+                                     max_len=T).init()
+        base.fit(ids, np.eye(4, dtype=np.float32)[rng.integers(0, 4, B)],
+                 epochs=1, batch_size=B)
+
+        # freeze through the pooling layer (index of last non-output
+        # layer), re-head for 2 classes
+        n_layers = len(base.layers)
+        tuned = (TransferLearning.Builder(base)
+                 .set_feature_extractor(n_layers - 2)
+                 .n_out_replace(n_layers - 1, 2)
+                 .build())
+        before = {k: np.asarray(v).copy()
+                  for k, v in tuned.param_table().items()}
+        head = str(n_layers - 1)
+        tuned.fit(ids, y2, epochs=2, batch_size=B)
+        out = np.asarray(tuned.output(ids))
+        assert out.shape == (B, 2)
+        # frozen encoder params unchanged; the head must actually move
+        head_moved = False
+        for k, v in tuned.param_table().items():
+            if k.startswith(head):
+                head_moved = head_moved or not np.allclose(
+                    np.asarray(v), before[k], atol=1e-7)
+            else:
+                np.testing.assert_allclose(np.asarray(v), before[k],
+                                           atol=1e-7, err_msg=k)
+        assert head_moved, "output layer params did not train"
