@@ -11,15 +11,14 @@ Python surface. Writing is included so tests can fabricate golden Keras
 from __future__ import annotations
 
 import ctypes
-import subprocess
 from pathlib import Path
 from typing import List, Optional, Sequence
 
 import numpy as np
 
-_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native" / "hdf5"
-_SRC = _NATIVE_DIR / "dl4j_hdf5.cpp"
-_SO = _NATIVE_DIR / "libdl4j_hdf5.so"
+from deeplearning4j_tpu.util.native_build import NATIVE_ROOT, build
+
+_SRC = NATIVE_ROOT / "hdf5" / "dl4j_hdf5.cpp"
 
 _lib = None
 
@@ -28,23 +27,11 @@ def _load_lib():
     global _lib
     if _lib is not None:
         return _lib
-    if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
-        candidates = ["-l:libhdf5_serial.so.103", "-l:libhdf5_serial.so.100",
-                      "-lhdf5_serial", "-lhdf5"]
-        errors = []
-        for link in candidates:
-            proc = subprocess.run(
-                ["g++", "-O2", "-fPIC", "-shared", str(_SRC), "-o", str(_SO),
-                 link, "-L/lib/x86_64-linux-gnu", "-L/usr/lib/x86_64-linux-gnu"],
-                capture_output=True, text=True)
-            if proc.returncode == 0:
-                break
-            errors.append(f"[{link}] {proc.stderr.strip()[:500]}")
-        else:
-            raise RuntimeError(
-                "Could not build the HDF5 shim against any known libhdf5 "
-                "soname:\n" + "\n".join(errors))
-    lib = ctypes.CDLL(str(_SO))
+    so = build(_SRC, "libdl4j_hdf5.so",
+               link_candidates=["-l:libhdf5_serial.so.103",
+                                "-l:libhdf5_serial.so.100",
+                                "-lhdf5_serial", "-lhdf5"])
+    lib = ctypes.CDLL(str(so))
     lib.dl4j_h5_open.restype = ctypes.c_int64
     lib.dl4j_h5_open.argtypes = [ctypes.c_char_p]
     lib.dl4j_h5_create.restype = ctypes.c_int64
@@ -59,6 +46,8 @@ def _load_lib():
         ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
     lib.dl4j_h5_write_string_array_attr.argtypes = [
         ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.dl4j_h5_list_children.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64]
     lib.dl4j_h5_dataset_ndim.argtypes = [
         ctypes.c_int64, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int]
@@ -101,12 +90,12 @@ class Hdf5Archive:
 
     def read_attr_string(self, attr: str, obj_path: str = "/") -> Optional[str]:
         size = 1 << 20
-        while size <= (1 << 28):
+        while size <= (1 << 28):  # last size tried: 256 MiB
             buf = ctypes.create_string_buffer(size)
             n = self._lib.dl4j_h5_read_string_attr(
                 self._f, obj_path.encode(), attr.encode(), buf, len(buf))
             if n == -2:  # buffer too small — grow and retry
-                size *= 8
+                size *= 4
                 continue
             return None if n < 0 else buf.value.decode("utf-8")
         raise IOError(f"Attribute {obj_path}@{attr} exceeds 256 MiB")
@@ -114,6 +103,22 @@ class Hdf5Archive:
     def read_attr_strings(self, attr: str, obj_path: str = "/") -> List[str]:
         s = self.read_attr_string(attr, obj_path)
         return [] if s is None else ([] if s == "" else s.split("\n"))
+
+    def list_children(self, path: str = "/") -> List[str]:
+        """Immediate child link names of a group (name-ascending)."""
+        size = 1 << 16
+        while size <= (1 << 24):  # last size tried: 16 MiB
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.dl4j_h5_list_children(
+                self._f, path.encode(), buf, len(buf))
+            if n == -2:
+                size *= 4
+                continue
+            if n < 0:
+                raise KeyError(f"No group {path}")
+            s = buf.value.decode("utf-8")
+            return [] if s == "" else s.split("\n")
+        raise IOError(f"Group listing for {path} exceeds 16 MiB")
 
     def read_dataset(self, path: str) -> np.ndarray:
         dims = (ctypes.c_int64 * 16)()

@@ -115,7 +115,8 @@ class KerasLayerMapper:
                                name=cfg.get("name"))
 
     def _map_leakyrelu(self, cfg):
-        alpha = cfg.get("alpha", 0.3)  # Keras default alpha is 0.3
+        # Keras 1/2 "alpha", Keras 3 "negative_slope"; default 0.3
+        alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
         return ActivationLayer(activation=f"leakyrelu:{alpha}",
                                name=cfg.get("name"))
 
@@ -298,6 +299,46 @@ class KerasLayerMapper:
     _map_separableconvolution2d = _map_separableconv2d  # Keras 1 name
 
 
+# Keras loss identifier → framework loss name (KerasLoss.java mapping).
+_KERAS_LOSSES = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mae", "mae": "mae",
+    "mean_squared_logarithmic_error": "msle", "msle": "msle",
+    "kullback_leibler_divergence": "kl_divergence", "kld": "kl_divergence",
+    "kl_divergence": "kl_divergence", "kldivergence": "kl_divergence",
+    "poisson": "poisson",
+    "cosine_similarity": "cosine_proximity",
+    "cosine_proximity": "cosine_proximity",
+    "hinge": "hinge", "squared_hinge": "squaredhinge",
+}
+
+
+def _updater_from_training_config(tc: dict):
+    """Keras optimizer_config → framework updater (KerasModel's
+    optimizer import role). Unknown optimizers fall back to Adam."""
+    from deeplearning4j_tpu.common.updaters import (
+        AdaGrad, Adam, Nesterovs, RmsProp, Sgd,
+    )
+    oc = tc.get("optimizer_config") or {}
+    cname = oc.get("class_name", "")
+    cfg = oc.get("config", {})
+    lr = float(cfg.get("learning_rate", cfg.get("lr", 1e-3)))
+    if cname in ("SGD", "Sgd"):
+        mom = float(cfg.get("momentum", 0.0))
+        return Nesterovs(lr, momentum=mom) if mom else Sgd(lr)
+    if cname in ("RMSprop", "RMSProp"):
+        return RmsProp(lr, rho=float(cfg.get("rho", 0.9)))
+    if cname == "Adagrad":
+        return AdaGrad(lr)
+    if cname == "Adam":
+        return Adam(lr, beta1=float(cfg.get("beta_1", 0.9)),
+                    beta2=float(cfg.get("beta_2", 0.999)))
+    return Adam(lr)
+
+
 class KerasModelImport:
     """Entry points mirroring `KerasModelImport.java`."""
 
@@ -309,9 +350,17 @@ class KerasModelImport:
             if config is None:
                 raise ValueError(f"{path}: no model_config attribute")
             model_dict = json.loads(config)
+            tc_str = h5.read_attr_string("training_config")
+            training_config = json.loads(tc_str) if tc_str else None
+            if (enforce_training_config and training_config is None):
+                raise ValueError(
+                    f"{path}: model was saved uncompiled (no "
+                    f"training_config) but enforce_training_config=True")
             if model_dict.get("class_name") == "Sequential":
-                return KerasModelImport._import_sequential(model_dict, h5)
-            return KerasModelImport._import_functional(model_dict, h5)
+                return KerasModelImport._import_sequential(
+                    model_dict, h5, training_config)
+            return KerasModelImport._import_functional(
+                model_dict, h5, training_config)
 
     @staticmethod
     def import_keras_sequential_model_and_weights(path, **kw):
@@ -331,7 +380,8 @@ class KerasModelImport:
     @staticmethod
     def _input_type_from(layer_cfgs):
         first = layer_cfgs[0]["config"]
-        shape = first.get("batch_input_shape")
+        # Keras 1/2: batch_input_shape; Keras 3 InputLayer: batch_shape
+        shape = first.get("batch_input_shape", first.get("batch_shape"))
         if shape is not None:
             dims = [d for d in shape[1:]]
             if len(dims) == 3:   # [H, W, C] (channels_last)
@@ -376,12 +426,45 @@ class KerasModelImport:
                     pp.data_format = "nhwc"
 
     @staticmethod
-    def _import_sequential(model_dict, h5) -> MultiLayerNetwork:
+    def _loss_name(training_config) -> Optional[str]:
+        if not training_config:
+            return None
+        loss = training_config.get("loss")
+        if isinstance(loss, (list, tuple)) and loss:
+            loss = loss[0]
+        elif (isinstance(loss, dict) and loss
+              and "class_name" not in loss):  # {output_name: loss} map
+            loss = next(iter(loss.values()))
+        if isinstance(loss, (list, tuple)) and loss:
+            loss = loss[0]
+        if isinstance(loss, dict):  # serialized loss object
+            loss = (loss.get("config") or {}).get("name") or loss.get("class_name")
+        if not isinstance(loss, str):
+            return None
+        # normalize CamelCase class names → snake identifiers
+        # (CategoricalCrossentropy → categorical_crossentropy)
+        key = loss.lower()
+        if key not in _KERAS_LOSSES:
+            import re
+            key = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", loss).lower()
+        return _KERAS_LOSSES.get(key)
+
+    @staticmethod
+    def _to_output_layer(dense, loss) -> "OutputLayer":
+        return OutputLayer(n_out=dense.n_out, activation=dense.activation,
+                           has_bias=dense.has_bias, name=dense.name,
+                           loss=loss)
+
+    @staticmethod
+    def _import_sequential(model_dict, h5,
+                           training_config=None) -> MultiLayerNetwork:
         layer_cfgs = KerasModelImport._layer_list(model_dict)
         mapper = KerasLayerMapper()
-        builder = (NeuralNetConfiguration.builder().updater(Adam(1e-3)).list())
+        updater = (_updater_from_training_config(training_config)
+                   if training_config else Adam(1e-3))
+        loss = KerasModelImport._loss_name(training_config)
         keras_names: List[Tuple[str, int]] = []  # (keras layer name, our idx)
-        idx = 0
+        mapped_all: List = []
         for lc in layer_cfgs:
             cname = lc["class_name"]
             if cname == "InputLayer":
@@ -389,9 +472,21 @@ class KerasModelImport:
             mapped = mapper.map(cname, lc["config"])
             for mi, layer in enumerate(mapped):
                 if mi == 0 and layer.__class__.__name__ != "LastTimeStep":
-                    keras_names.append((lc["config"].get("name", cname), idx))
-                builder.layer(layer)
-                idx += 1
+                    keras_names.append((lc["config"].get("name", cname),
+                                        len(mapped_all)))
+                mapped_all.append(layer)
+        # A compiled Keras model carries its loss in training_config; a
+        # trailing Dense becomes an OutputLayer so the import can fit()
+        # (KerasModel attaches KerasLoss the same way).
+        if loss is not None and mapped_all:
+            if mapped_all[-1].__class__.__name__ == "DenseLayer":
+                mapped_all[-1] = KerasModelImport._to_output_layer(
+                    mapped_all[-1], loss)
+            else:
+                mapped_all.append(LossLayer(loss=loss))
+        builder = (NeuralNetConfiguration.builder().updater(updater).list())
+        for layer in mapped_all:
+            builder.layer(layer)
         builder.set_input_type(KerasModelImport._input_type_from(layer_cfgs))
         conf = builder.build()
         KerasModelImport._fix_flatten_order(
@@ -403,16 +498,59 @@ class KerasModelImport:
 
     # -------------------------------------------------------- functional
     @staticmethod
-    def _import_functional(model_dict, h5) -> ComputationGraph:
+    def _boundary_names(spec) -> List[str]:
+        """input_layers/output_layers → layer names. Formats:
+        Keras 1/2: [["name", 0, 0], ...]; Keras 3 single-tensor models
+        flatten to one ["name", 0, 0]; plain strings pass through."""
+        if not spec:
+            return []
+        if (isinstance(spec, list) and spec
+                and isinstance(spec[0], str)
+                and any(isinstance(x, int) for x in spec)):
+            return [spec[0]]  # flat ["name", 0, 0]
+        return [l[0] if isinstance(l, list) else l for l in spec]
+
+    @staticmethod
+    def _inbound_sources(inbound) -> List[str]:
+        """First inbound node → source layer names. Formats:
+        Keras 1/2: [[["src", 0, 0, {}], ...]]; Keras 3:
+        [{"args": [<__keras_tensor__> | [<__keras_tensor__>, ...]],
+          "kwargs": {}}] with keras_history carrying the source name."""
+        if not inbound:
+            return []
+        node = inbound[0]
+        entries = node if isinstance(node, list) else node.get("args", [])
+        srcs: List[str] = []
+
+        def walk(e):
+            if isinstance(e, dict):
+                if e.get("class_name") == "__keras_tensor__":
+                    srcs.append(e["config"]["keras_history"][0])
+            elif isinstance(e, list):
+                if e and isinstance(e[0], str):
+                    srcs.append(e[0])      # ["src", 0, 0, {...}]
+                else:
+                    for x in e:
+                        walk(x)
+            elif isinstance(e, str):
+                srcs.append(e)
+        for e in entries:
+            walk(e)
+        return srcs
+
+    @staticmethod
+    def _import_functional(model_dict, h5,
+                           training_config=None) -> ComputationGraph:
         cfg = model_dict["config"]
         layer_cfgs = cfg["layers"]
         mapper = KerasLayerMapper()
-        builder = NeuralNetConfiguration.builder().updater(Adam(1e-3))
+        updater = (_updater_from_training_config(training_config)
+                   if training_config else Adam(1e-3))
+        loss = KerasModelImport._loss_name(training_config)
+        builder = NeuralNetConfiguration.builder().updater(updater)
         g = ComputationGraphConfiguration.graph_builder(builder)
-        input_names = [l[0] if isinstance(l, list) else l
-                       for l in cfg.get("input_layers", [])]
-        output_names = [l[0] if isinstance(l, list) else l
-                        for l in cfg.get("output_layers", [])]
+        input_names = KerasModelImport._boundary_names(cfg.get("input_layers", []))
+        output_names = KerasModelImport._boundary_names(cfg.get("output_layers", []))
         g.add_inputs(*[n for n in input_names])
         input_types = []
         keras_names: List[Tuple[str, str]] = []
@@ -420,16 +558,11 @@ class KerasModelImport:
         for lc in layer_cfgs:
             cname = lc["class_name"]
             name = lc.get("name", lc["config"].get("name"))
-            inbound = lc.get("inbound_nodes", [])
-            srcs = []
-            if inbound:
-                node = inbound[0]
-                entries = node if isinstance(node, list) else node.get("args", [])
-                for e in entries:
-                    srcs.append(e[0] if isinstance(e, list) else e)
+            srcs = KerasModelImport._inbound_sources(lc.get("inbound_nodes", []))
             srcs = [alias.get(s, s) for s in srcs]
             if cname == "InputLayer":
-                shape = lc["config"].get("batch_input_shape")
+                shape = lc["config"].get("batch_input_shape",
+                                         lc["config"].get("batch_shape"))
                 dims = shape[1:]
                 if len(dims) == 3:
                     input_types.append(InputType.convolutional(*dims))
@@ -453,6 +586,10 @@ class KerasModelImport:
             if not mapped:  # Flatten/Masking: pass-through to the source
                 alias[name] = srcs[0]
                 continue
+            if (loss is not None and name in output_names
+                    and mapped[-1].__class__.__name__ == "DenseLayer"):
+                mapped[-1] = KerasModelImport._to_output_layer(
+                    mapped[-1], loss)
             prev = srcs
             for mi, layer in enumerate(mapped):
                 lname = name if mi == 0 else f"{name}_{mi}"
@@ -486,6 +623,8 @@ class KerasModelImport:
         of silently corrupting params). Reference parallel:
         `KerasModelUtils.copyWeightsToModel:59`."""
         with Hdf5Archive(path) as h5:
+            if h5.exists("/layers") and not h5.read_attr_strings("layer_names"):
+                return KerasModelImport._load_weights_into_k3(net, h5, path)
             root = KerasModelImport._weights_root(h5)
             lnames = h5.read_attr_strings("layer_names", root) or []
             keras_weighted = []
@@ -493,12 +632,7 @@ class KerasModelImport:
                 kw = KerasModelImport._layer_weights(h5, root, ln)
                 if kw:
                     keras_weighted.append((ln, kw))
-            if hasattr(net, "layers"):  # MultiLayerNetwork
-                ours = [(str(i), l) for i, l in enumerate(net.layers)
-                        if net.params.get(str(i))]
-            else:  # ComputationGraph
-                ours = [(n, net.conf.nodes[n].layer)
-                        for n in net.conf.topo_order if net.params.get(n)]
+            ours = KerasModelImport._weighted_layers(net)
             if len(keras_weighted) != len(ours):
                 raise ValueError(
                     f"{path}: {len(keras_weighted)} weighted Keras layers vs "
@@ -506,6 +640,77 @@ class KerasModelImport:
             for (kname, kw), (key, layer) in zip(keras_weighted, ours):
                 KerasModelImport._apply_weights(net, key, layer, kw, kname)
         return net
+
+    # Positional var→semantic-name tables for the Keras 3 .weights.h5
+    # layout (layers/<slug>/vars/<i>; order = keras layer.weights order).
+    _K3_VAR_NAMES = {
+        "DenseLayer": ("kernel", "bias"),
+        "OutputLayer": ("kernel", "bias"),
+        "ConvolutionLayer": ("kernel", "bias"),
+        "Convolution1DLayer": ("kernel", "bias"),
+        "SeparableConvolution2D": ("depthwise_kernel", "pointwise_kernel",
+                                   "bias"),
+        "EmbeddingLayer": ("embeddings",),
+        "LSTM": ("kernel", "recurrent_kernel", "bias"),
+        "GravesLSTM": ("kernel", "recurrent_kernel", "bias"),
+        "SimpleRnn": ("kernel", "recurrent_kernel", "bias"),
+        "BatchNormalization": ("gamma", "beta", "moving_mean",
+                               "moving_variance"),
+    }
+
+    @staticmethod
+    def _load_weights_into_k3(net, h5, path):
+        """Keras 3 .weights.h5: datasets at layers/<slug>/vars/<i>, layer
+        name stored as the vars-group `name` attr. Creation order is NOT
+        tracked in the file, so layers are matched BY NAME (our imported
+        nets keep Keras layer names)."""
+        by_name: Dict[str, List[np.ndarray]] = {}
+        for slug in h5.list_children("/layers"):
+            vpath = f"/layers/{slug}/vars"
+            if not h5.exists(vpath):
+                continue
+            idxs = sorted((c for c in h5.list_children(vpath)), key=int)
+            if not idxs:
+                continue
+            lname = h5.read_attr_string("name", vpath) or slug
+            by_name[lname] = [h5.read_dataset(f"{vpath}/{i}") for i in idxs]
+        ours = KerasModelImport._weighted_layers(net)
+        unmatched = [getattr(l, "name", None) for _, l in ours
+                     if getattr(l, "name", None) not in by_name]
+        if unmatched:
+            raise ValueError(
+                f"{path}: weighted layers {unmatched} have no same-named "
+                f"entry in the file (stored: {sorted(by_name)}) — "
+                f"topologies differ")
+        for key, layer in ours:
+            arrays = by_name[layer.name]
+            names = KerasModelImport._K3_VAR_NAMES.get(layer.__class__.__name__)
+            if names is None:
+                raise ValueError(
+                    f"{path}: no Keras-3 var-name table for "
+                    f"{layer.__class__.__name__}")
+            if len(arrays) != len(names):
+                # Positional assignment is only safe when counts agree —
+                # e.g. BatchNorm(scale=False) stores 3 vars, and zipping
+                # those against the 4-name table would silently shift
+                # every tensor into the wrong slot.
+                raise ValueError(
+                    f"{path}: layer {layer.name} stores {len(arrays)} "
+                    f"variables but {layer.__class__.__name__} expects "
+                    f"{len(names)} ({names}) — cannot match positionally")
+            kw = dict(zip(names, arrays))
+            KerasModelImport._apply_weights(net, key, layer, kw, layer.name)
+        return net
+
+    @staticmethod
+    def _weighted_layers(net):
+        """(params_key, layer) for every layer holding params, in
+        network order — shared by both weights-only loaders."""
+        if hasattr(net, "layers"):  # MultiLayerNetwork
+            return [(str(i), l) for i, l in enumerate(net.layers)
+                    if net.params.get(str(i))]
+        return [(n, net.conf.nodes[n].layer)
+                for n in net.conf.topo_order if net.params.get(n)]
 
     # ----------------------------------------------------------- weights
     @staticmethod

@@ -70,6 +70,16 @@ htri_t H5Tis_variable_str(hid_t);
 herr_t H5Tclose(hid_t);
 htri_t H5Lexists(hid_t, const char *, hid_t);
 herr_t H5Eset_auto2(hid_t, void *, void *);
+hid_t H5Gopen2(hid_t, const char *, hid_t);
+typedef struct H5L_info_t H5L_info_t;
+typedef herr_t (*H5L_iterate_t)(hid_t, const char *, const H5L_info_t *,
+                                void *);
+// H5Literate is a macro in 1.14 (symbol H5Literate1); weak-declare both
+// spellings and pick whichever the loaded libhdf5 exports.
+extern herr_t H5Literate(hid_t, int, int, hsize_t *, H5L_iterate_t, void *)
+    __attribute__((weak));
+extern herr_t H5Literate1(hid_t, int, int, hsize_t *, H5L_iterate_t, void *)
+    __attribute__((weak));
 
 // global type ids (the H5T_NATIVE_* macros resolve to these globals)
 extern hid_t H5T_C_S1_g;
@@ -239,6 +249,40 @@ int dl4j_h5_write_string_array_attr(int64_t file, const char *obj_path,
   H5Tclose(type);
   H5Oclose(obj);
   return rc;
+}
+
+// ---------------------------------------------------------------- listing
+static herr_t dl4j_list_cb(hid_t, const char *name, const H5L_info_t *,
+                           void *op) {
+  auto *s = (std::string *)op;
+  if (!s->empty()) *s += "\n";
+  *s += name;
+  return 0;
+}
+
+// List immediate child link names of the group at `path`, '\n'-joined,
+// in ascending name order. Returns #children, -1 on error, -2 if the
+// caller buffer is too small.
+int dl4j_h5_list_children(int64_t file, const char *path, char *out,
+                          int64_t out_len) {
+  ensure_init();
+  hid_t g = H5Gopen2((hid_t)file, path, H5P_DEFAULT);
+  if (g < 0) return -1;
+  std::string names;
+  herr_t rc = -1;
+  // H5_INDEX_NAME = 0, H5_ITER_INC = 0
+  if (&H5Literate != nullptr)
+    rc = H5Literate(g, 0, 0, nullptr, dl4j_list_cb, &names);
+  else if (&H5Literate1 != nullptr)
+    rc = H5Literate1(g, 0, 0, nullptr, dl4j_list_cb, &names);
+  H5Gclose(g);
+  if (rc < 0) return -1;
+  if ((int64_t)names.size() + 1 > out_len) return -2;
+  memcpy(out, names.c_str(), names.size() + 1);
+  int count = names.empty() ? 0 : 1;
+  for (char c : names)
+    if (c == '\n') count++;
+  return count;
 }
 
 // -------------------------------------------------------------- datasets

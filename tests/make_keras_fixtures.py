@@ -1,0 +1,109 @@
+"""Generate REAL Keras-produced .h5 fixtures + recorded predictions.
+
+Run offline (needs the keras pip package) to (re)build
+`tests/fixtures/keras/`; the committed artifacts are genuine Keras
+output, so `tests/test_keras_real_golden.py` would fail if our model of
+Keras's on-disk layout drifted from what Keras actually writes — the
+gap the fabricated-fixture tests in `test_keras_golden.py` cannot close
+(reference vendors actual Keras files the same way:
+`deeplearning4j-modelimport/src/test/resources/configs/`).
+
+    python tests/make_keras_fixtures.py
+
+Provenance is stamped into fixtures/keras/MANIFEST.json.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("KERAS_BACKEND", "tensorflow")
+
+import numpy as np
+
+FIXDIR = Path(__file__).parent / "fixtures" / "keras"
+
+
+def main():
+    import keras
+    from keras import layers
+
+    FIXDIR.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(42)
+    preds = {}
+
+    # 1. Sequential CNN (conv same + pool + flatten + dense softmax)
+    cnn = keras.Sequential([
+        keras.Input(shape=(8, 8, 1)),
+        layers.Conv2D(4, 3, padding="same", activation="relu", name="conv"),
+        layers.MaxPooling2D(2, name="pool"),
+        layers.Flatten(name="flatten"),
+        layers.Dense(10, activation="softmax", name="fc"),
+    ], name="seq_cnn")
+    x_cnn = rng.standard_normal((2, 8, 8, 1)).astype(np.float32)
+    preds["cnn_x"] = x_cnn
+    preds["cnn_y"] = cnn.predict(x_cnn, verbose=0)
+    cnn.save(FIXDIR / "real_cnn.h5")
+
+    # 2. Sequential LSTM (sigmoid recurrent activation — Keras 3 default)
+    lstm = keras.Sequential([
+        keras.Input(shape=(4, 3)),
+        layers.LSTM(5, name="lstm"),
+        layers.Dense(2, activation="softmax", name="fc"),
+    ], name="seq_lstm")
+    x_lstm = rng.standard_normal((2, 4, 3)).astype(np.float32)
+    preds["lstm_x"] = x_lstm
+    preds["lstm_y"] = lstm.predict(x_lstm, verbose=0)
+    lstm.save(FIXDIR / "real_lstm.h5")
+
+    # 3. Functional branch/merge MLP (Add + Concatenate)
+    inp = keras.Input(shape=(8,), name="in")
+    a = layers.Dense(6, activation="relu", name="d1")(inp)
+    b = layers.Dense(6, activation="tanh", name="d2")(inp)
+    s = layers.Add(name="add")([a, b])
+    c = layers.Concatenate(name="cat")([s, a])
+    out = layers.Dense(3, activation="softmax", name="out")(c)
+    func = keras.Model(inp, out, name="func_mlp")
+    x_f = rng.standard_normal((3, 8)).astype(np.float32)
+    preds["func_x"] = x_f
+    preds["func_y"] = func.predict(x_f, verbose=0)
+    func.save(FIXDIR / "real_func.h5")
+
+    # 4. Sequential with BatchNorm (inference uses moving stats) +
+    #    SeparableConv2D. Train one step so moving stats are non-trivial.
+    bn = keras.Sequential([
+        keras.Input(shape=(6, 6, 2)),
+        layers.SeparableConv2D(5, 3, padding="valid", activation="relu",
+                               depth_multiplier=2, name="sep"),
+        layers.BatchNormalization(name="bn"),
+        layers.Flatten(name="flatten"),
+        layers.Dense(3, activation="softmax", name="fc"),
+    ], name="seq_bn")
+    bn.compile(optimizer="sgd", loss="categorical_crossentropy")
+    xtr = rng.standard_normal((16, 6, 6, 2)).astype(np.float32)
+    ytr = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    bn.fit(xtr, ytr, epochs=1, verbose=0)
+    x_bn = rng.standard_normal((2, 6, 6, 2)).astype(np.float32)
+    preds["bn_x"] = x_bn
+    preds["bn_y"] = bn.predict(x_bn, verbose=0)
+    bn.save(FIXDIR / "real_bn.h5")
+
+    # 5. Weights-only file (keras-applications distribution format)
+    cnn.save_weights(FIXDIR / "real_cnn.weights.h5")
+
+    np.savez(FIXDIR / "predictions.npz", **preds)
+
+    manifest = {
+        "generator": "tests/make_keras_fixtures.py",
+        "keras_version": keras.__version__,
+        "backend": keras.backend.backend(),
+        "python": sys.version.split()[0],
+        "files": sorted(p.name for p in FIXDIR.glob("*.h5")),
+    }
+    (FIXDIR / "MANIFEST.json").write_text(json.dumps(manifest, indent=2))
+    print(json.dumps(manifest, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""Golden tests against GENUINELY Keras-produced .h5 artifacts.
+
+The fixtures under tests/fixtures/keras/ were written by the real keras
+package (see MANIFEST.json for provenance and make_keras_fixtures.py for
+the generator); predictions.npz stores Keras's own outputs on fixed
+inputs. If our model of Keras's on-disk layout or numerics is wrong, the
+parity assertions here fail — the authenticity gap fabricated fixtures
+can't close (reference pattern: real Keras files vendored under
+`deeplearning4j-modelimport/src/test/resources/configs/`).
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+FIXDIR = Path(__file__).parent / "fixtures" / "keras"
+
+pytestmark = pytest.mark.skipif(
+    not (FIXDIR / "predictions.npz").exists(),
+    reason="keras fixtures not generated")
+
+
+@pytest.fixture(scope="module")
+def preds():
+    return np.load(FIXDIR / "predictions.npz")
+
+
+def test_manifest_provenance():
+    m = json.loads((FIXDIR / "MANIFEST.json").read_text())
+    assert m["keras_version"].startswith("3.")
+    assert m["backend"] == "tensorflow"
+
+
+def test_real_cnn_sequential_parity(preds):
+    net = KerasModelImport.import_keras_model_and_weights(
+        str(FIXDIR / "real_cnn.h5"))
+    got = np.asarray(net.output(preds["cnn_x"]))
+    np.testing.assert_allclose(got, preds["cnn_y"], rtol=1e-4, atol=1e-5)
+
+
+def test_real_lstm_sequential_parity(preds):
+    net = KerasModelImport.import_keras_model_and_weights(
+        str(FIXDIR / "real_lstm.h5"))
+    got = np.asarray(net.output(preds["lstm_x"]))
+    np.testing.assert_allclose(got, preds["lstm_y"], rtol=1e-4, atol=1e-5)
+
+
+def test_real_functional_parity(preds):
+    net = KerasModelImport.import_keras_model_and_weights(
+        str(FIXDIR / "real_func.h5"))
+    out = net.output(preds["func_x"])
+    got = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+    np.testing.assert_allclose(got, preds["func_y"], rtol=1e-4, atol=1e-5)
+
+
+def test_real_batchnorm_sepconv_parity(preds):
+    """BatchNorm inference must use the trained moving statistics from
+    the file, and SeparableConv2D kernels must land unpermuted."""
+    net = KerasModelImport.import_keras_model_and_weights(
+        str(FIXDIR / "real_bn.h5"))
+    got = np.asarray(net.output(preds["bn_x"]))
+    np.testing.assert_allclose(got, preds["bn_y"], rtol=1e-4, atol=1e-5)
+
+
+def test_real_compiled_model_fits(preds):
+    """A COMPILED Keras model carries training_config (loss+optimizer);
+    the import must map it so fit() works out of the box — the north
+    star's 'Keras models load unchanged and fit() on TPU' clause
+    (reference: KerasModel training-config import + KerasLoss)."""
+    from deeplearning4j_tpu.datasets import DataSet
+    net = KerasModelImport.import_keras_model_and_weights(
+        str(FIXDIR / "real_bn.h5"))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 6, 6, 2)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    ds = DataSet(x, y)
+    s0 = float(net.score(ds))
+    for _ in range(6):
+        net.fit(x, y)
+    assert float(net.score(ds)) < s0
+
+
+def test_enforce_training_config_rejects_uncompiled():
+    with pytest.raises(ValueError, match="uncompiled"):
+        KerasModelImport.import_keras_model_and_weights(
+            str(FIXDIR / "real_cnn.h5"), enforce_training_config=True)
+
+
+def test_lenet_packaged_pretrained():
+    """LeNet ships a genuine pretrained checkpoint inside the package
+    (zoo/weights/, trained on real sklearn digits): init_pretrained must
+    run its full URL → cache → checksum → restore path and yield a
+    model that actually classifies."""
+    from deeplearning4j_tpu.eval import Evaluation
+    from deeplearning4j_tpu.zoo.base import PretrainedType
+    from deeplearning4j_tpu.zoo.lenet import LeNet
+    from sklearn.datasets import load_digits
+    import jax
+    import jax.numpy as jnp
+
+    net = LeNet().init_pretrained(PretrainedType.MNIST)
+    d = load_digits()
+    x = d.images.astype(np.float32) / 16.0
+    x = np.asarray(jax.image.resize(jnp.asarray(x), (x.shape[0], 28, 28),
+                                    "bilinear"))[..., None]
+    y = np.eye(10, dtype=np.float32)[d.target]
+    # same held-out slice the generator used (seed-0 permutation head)
+    order = np.random.default_rng(0).permutation(len(x))
+    xte, yte = x[order][:297], y[order][:297]
+    ev = Evaluation(10)
+    ev.eval(yte, np.asarray(net.output(xte)))
+    assert ev.accuracy() > 0.93
+
+
+def test_real_weights_only_by_name(preds):
+    """Keras 3 .weights.h5 (layers/<slug>/vars/<i> layout, no config):
+    weights matched by layer name into a net imported from the full
+    file, then parity re-asserted."""
+    net = KerasModelImport.import_keras_model_and_weights(
+        str(FIXDIR / "real_cnn.h5"))
+    # scramble params so a no-op load would be caught
+    for key in net.params:
+        for pn in net.params[key]:
+            net.params[key][pn] = np.zeros_like(net.params[key][pn])
+    KerasModelImport.load_weights_into(net, str(FIXDIR / "real_cnn.weights.h5"))
+    got = np.asarray(net.output(preds["cnn_x"]))
+    np.testing.assert_allclose(got, preds["cnn_y"], rtol=1e-4, atol=1e-5)
