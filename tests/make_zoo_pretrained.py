@@ -1,0 +1,85 @@
+"""Produce the packaged LeNet pretrained checkpoint.
+
+Trains the zoo LeNet on the real sklearn handwritten-digits corpus
+(1797 8x8 grayscale digits, bilinearly upscaled to LeNet's 28x28 input)
+and writes a ModelSerializer zip into the package at
+`deeplearning4j_tpu/zoo/weights/` — the artifact `LeNet.pretrained_url`
+points at, so `init_pretrained()` executes its full download → checksum
+→ restore path end-to-end (reference `ZooModel.initPretrained:52-81`).
+
+    python tests/make_zoo_pretrained.py
+"""
+
+import hashlib
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parents[1]))
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+WEIGHTS_DIR = (Path(__file__).parents[1] / "deeplearning4j_tpu" / "zoo"
+               / "weights")
+
+
+def load_digits_28x28():
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    x = d.images.astype(np.float32) / 16.0          # [N, 8, 8] in [0,1]
+    # bilinear 8x8 -> 28x28 via jax.image to avoid a scipy dependency
+    import jax.image
+    import jax.numpy as jnp
+    x = np.asarray(jax.image.resize(jnp.asarray(x), (x.shape[0], 28, 28),
+                                    "bilinear"))
+    y = np.eye(10, dtype=np.float32)[d.target]
+    return x[..., None], y
+
+
+def main():
+    from deeplearning4j_tpu.eval import Evaluation
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+    from deeplearning4j_tpu.zoo.lenet import LeNet
+
+    x, y = load_digits_28x28()
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(x))
+    x, y = x[order], y[order]
+    n_test = 297
+    xtr, ytr, xte, yte = x[n_test:], y[n_test:], x[:n_test], y[:n_test]
+
+    net = LeNet(num_classes=10).init()
+    net.fit(xtr, ytr, epochs=8, batch_size=100)
+
+    ev = Evaluation(10)
+    ev.eval(yte, np.asarray(net.output(xte)))
+    acc = ev.accuracy()
+    print(f"held-out accuracy: {acc:.4f}")
+    assert acc > 0.93, "pretrained artifact would be junk — not saving"
+
+    WEIGHTS_DIR.mkdir(parents=True, exist_ok=True)
+    dest = WEIGHTS_DIR / "lenet_mnist.zip"
+    ModelSerializer.write_model(net, dest, save_updater=False)
+    checksum = hashlib.sha256(dest.read_bytes()).hexdigest()
+    manifest = {
+        "file": dest.name,
+        "sha256": checksum,
+        "holdout_accuracy": round(float(acc), 4),
+        "train_corpus": "sklearn load_digits (1797 real 8x8 digits) "
+                        "upscaled bilinear to 28x28",
+        "generator": "tests/make_zoo_pretrained.py",
+    }
+    (WEIGHTS_DIR / "MANIFEST.json").write_text(json.dumps(manifest, indent=2))
+    print(json.dumps(manifest, indent=2))
+
+
+if __name__ == "__main__":
+    main()
