@@ -1,0 +1,14 @@
+"""Driver entry point — the benchmark implementation lives in the
+package (`deeplearning4j_tpu/bench.py`, also exposed as the
+`dl4j-tpu-bench` console script) so it ships with the wheel; this shim
+keeps the repo-root `python bench.py` contract."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from deeplearning4j_tpu.bench import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()

@@ -613,10 +613,15 @@ def bench_scaling_subprocess():
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=8").strip()
-    proc = subprocess.run([sys.executable, os.path.abspath(__file__),
+    # -m so the child resolves the package from site-packages or the
+    # repo root alike (bench.py now lives inside the package)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_root, env.get("PYTHONPATH", "")) if p)
+    proc = subprocess.run([sys.executable, "-m", "deeplearning4j_tpu.bench",
                            "--scaling-child"],
                           capture_output=True, text=True, timeout=1200,
-                          env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+                          env=env)
     if proc.returncode != 0:
         return {"error": (proc.stderr or proc.stdout)[-500:]}
     return json.loads(proc.stdout.strip().splitlines()[-1])

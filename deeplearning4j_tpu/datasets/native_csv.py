@@ -12,15 +12,14 @@ chooses a policy.
 from __future__ import annotations
 
 import ctypes
-import subprocess
 from pathlib import Path
 from typing import Optional, Tuple
 
 import numpy as np
 
-_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native" / "csv"
-_SRC = _NATIVE_DIR / "dl4j_csv.cpp"
-_SO = _NATIVE_DIR / "libdl4j_csv.so"
+from deeplearning4j_tpu.util.native_build import NATIVE_ROOT, build
+
+_SRC = NATIVE_ROOT / "csv" / "dl4j_csv.cpp"
 
 _lib = None
 _lib_failed = False
@@ -31,11 +30,8 @@ def _load_lib():
     if _lib is not None or _lib_failed:
         return _lib
     try:
-        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
-            subprocess.run(
-                ["g++", "-O3", "-fPIC", "-shared", str(_SRC), "-o", str(_SO)],
-                check=True, capture_output=True)
-        lib = ctypes.CDLL(str(_SO))
+        so = build(_SRC, "libdl4j_csv.so", extra_flags=["-O3"])
+        lib = ctypes.CDLL(str(so))
         lib.dl4j_csv_shape.argtypes = [
             ctypes.c_char_p, ctypes.c_char, ctypes.c_long,
             ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]

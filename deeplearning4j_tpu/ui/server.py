@@ -307,3 +307,27 @@ class UIServer:
         self._httpd.server_close()
         if UIServer._instance is self:
             UIServer._instance = None
+
+
+def main(argv=None):
+    """`dl4j-tpu-ui` console entry (reference: PlayUIServer's JCommander
+    CLI, `ui/play/PlayUIServer.java`): standalone dashboard process;
+    training processes push stats to its /remote route."""
+    import argparse
+    import time
+
+    ap = argparse.ArgumentParser(prog="dl4j-tpu-ui")
+    ap.add_argument("--port", type=int, default=9000)
+    args = ap.parse_args(argv)
+    server = UIServer(args.port).start()
+    print(f"dl4j-tpu UI listening on http://127.0.0.1:{server.port} "
+          f"(POST stats to /remote)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

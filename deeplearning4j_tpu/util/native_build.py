@@ -1,0 +1,54 @@
+"""Lazy native-shim builder shared by the C++ IO components.
+
+The shims (`deeplearning4j_tpu/native/*/dl4j_*.cpp` — HDF5 reader for
+Keras import, CSV parser for bulk ingest) compile on first use, mirroring
+how the reference resolves its JavaCPP-bound natives at runtime rather
+than at install time. An installed site-packages tree may be read-only,
+so the .so lands next to the source when that directory is writable and
+under `~/.cache/dl4j_tpu/native/` otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+NATIVE_ROOT = Path(__file__).resolve().parents[1] / "native"
+_CACHE_ROOT = Path(os.environ.get(
+    "DL4J_TPU_NATIVE_CACHE",
+    Path.home() / ".cache" / "dl4j_tpu" / "native"))
+
+
+def so_path(src: Path, soname: str) -> Path:
+    """Where the built library for `src` should live: beside the source
+    if that directory is writable, else in the user cache."""
+    native_dir = src.parent
+    if os.access(native_dir, os.W_OK):
+        return native_dir / soname
+    return _CACHE_ROOT / src.parent.name / soname
+
+
+def build(src: Path, soname: str,
+          link_candidates: Optional[Sequence[str]] = None,
+          extra_flags: Sequence[str] = ()) -> Path:
+    """Compile `src` into `soname` (skipping if fresh). When
+    `link_candidates` is given, each linker arg is tried in order until
+    one succeeds (the image ships libhdf5 under several sonames)."""
+    so = so_path(src, soname)
+    if so.exists() and so.stat().st_mtime >= src.stat().st_mtime:
+        return so
+    so.parent.mkdir(parents=True, exist_ok=True)
+    base = ["g++", "-O2", "-fPIC", "-shared", str(src), "-o", str(so),
+            *extra_flags]
+    errors: List[str] = []
+    for link in (link_candidates or [None]):
+        cmd = base + ([link, "-L/lib/x86_64-linux-gnu",
+                       "-L/usr/lib/x86_64-linux-gnu"] if link else [])
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode == 0:
+            return so
+        errors.append(f"[{link}] {proc.stderr.strip()[:500]}")
+    raise RuntimeError(
+        f"Could not build {soname} from {src}:\n" + "\n".join(errors))
