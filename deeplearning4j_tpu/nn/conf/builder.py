@@ -19,6 +19,26 @@ import json
 from enum import Enum
 from typing import Any, Dict, List, Optional
 
+# Serialized-config format version (reference role: the legacy-format
+# migration deserializers, `nn/conf/serde/MultiLayerConfigurationDeserializer
+# .java:36,67` — DL4J migrates old enum-style JSON on read; stamping a
+# version NOW is what makes such migrations possible later). Bump when
+# the on-disk layout changes incompatibly; from_dict accepts <= current
+# (older payloads migrate forward) and rejects newer-than-current.
+CONFIG_FORMAT_VERSION = 1
+
+
+def check_format_version(d: dict, what: str):
+    v = d.get("format_version", 1)  # pre-versioning payloads are v1
+    if not isinstance(v, int) or v < 1:
+        raise ValueError(f"{what}: invalid format_version {v!r}")
+    if v > CONFIG_FORMAT_VERSION:
+        raise ValueError(
+            f"{what}: payload format_version {v} is newer than this "
+            f"build's {CONFIG_FORMAT_VERSION} — upgrade the library to "
+            f"load it")
+
+
 from deeplearning4j_tpu.common.updaters import Sgd, Updater, get_updater
 from deeplearning4j_tpu.common.weights import WeightInit
 from deeplearning4j_tpu.nn.conf.inputs import (
@@ -88,6 +108,7 @@ class MultiLayerConfiguration:
     def to_dict(self):
         return {
             "format": "deeplearning4j_tpu.MultiLayerConfiguration",
+            "format_version": CONFIG_FORMAT_VERSION,
             "layers": [l.to_dict() for l in self.layers],
             "input_preprocessors": {str(i): p.to_dict() for i, p in self.input_preprocessors.items()},
             "input_type": None if self.input_type is None else self.input_type.to_dict(),
@@ -109,6 +130,7 @@ class MultiLayerConfiguration:
     @staticmethod
     def from_dict(d: dict) -> "MultiLayerConfiguration":
         from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+        check_format_version(d, "MultiLayerConfiguration")
         return MultiLayerConfiguration(
             layers=[layer_from_dict(ld) for ld in d["layers"]],
             input_preprocessors={int(i): preprocessor_from_dict(p)
@@ -240,6 +262,12 @@ class ListBuilder:
 
         preprocessors = dict(self._preprocessors)
         current = self._input_type
+        if (current is None and layers and _has_explicit_n_in(layers[0])
+                and _expected_family(layers[0]) in ("ff", "any")):
+            # DL4J-style config: nIn on the first layer, no input type —
+            # synthesize the feed-forward InputType so the n_in chain
+            # resolves (reference: LayerValidation + builder nIn plumb)
+            current = InputType.feed_forward(layers[0].n_in)
         if current is not None:
             for i, l in enumerate(layers):
                 if i in preprocessors:

@@ -25,6 +25,8 @@ import numpy as np
 from deeplearning4j_tpu.common.updaters import Sgd
 from deeplearning4j_tpu.nd.dtype import DataTypePolicy, default_policy
 from deeplearning4j_tpu.nn.conf.builder import (
+    CONFIG_FORMAT_VERSION,
+    check_format_version,
     BackpropType,
     GradientNormalization,
     NeuralNetConfiguration,
@@ -108,6 +110,7 @@ class ComputationGraphConfiguration:
     def to_dict(self):
         return {
             "format": "deeplearning4j_tpu.ComputationGraphConfiguration",
+            "format_version": CONFIG_FORMAT_VERSION,
             "network_inputs": self.network_inputs,
             "network_outputs": self.network_outputs,
             "seed": self.seed,
@@ -139,6 +142,7 @@ class ComputationGraphConfiguration:
     @staticmethod
     def from_dict(d: dict) -> "ComputationGraphConfiguration":
         from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_from_dict
+        check_format_version(d, "ComputationGraphConfiguration")
         conf = ComputationGraphConfiguration()
         conf.network_inputs = list(d["network_inputs"])
         conf.network_outputs = list(d["network_outputs"])
@@ -317,6 +321,8 @@ class ComputationGraph:
         seed = self.conf.seed if seed is None else seed
         (self.params, self.net_state, self.updater_state) = \
             self._init_trees(seed)
+        from deeplearning4j_tpu.nn.multilayer import validate_param_widths
+        validate_param_widths(self.params)
         self._initialized = True
         return self
 

@@ -69,6 +69,19 @@ def _convert_labels(y, data_format):
     return y
 
 
+def validate_param_widths(params):
+    """Unresolved n_in produces zero-width weights that only explode at
+    first forward — fail at init instead (reference LayerValidation
+    role). Shared by MultiLayerNetwork and ComputationGraph."""
+    for key, ps in params.items():
+        for pn, arr in ps.items():
+            if 0 in np.shape(arr):
+                raise ValueError(
+                    f"layer {key} param {pn} has shape {np.shape(arr)} — "
+                    f"input width unresolved; set n_in on the layer or "
+                    f"set_input_type() on the builder")
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration, dtype_policy: DataTypePolicy = None):
         self.conf = conf
@@ -144,6 +157,7 @@ class MultiLayerNetwork:
         seed = self.conf.seed if seed is None else seed
         (self.params, self.net_state, self.updater_state) = \
             self._init_trees(seed)
+        validate_param_widths(self.params)
         self._initialized = True
         return self
 

@@ -218,8 +218,18 @@ class ParallelTrainer:
     def _run_grouped(iterator, epochs, spe, divisible, run_single, drain,
                      model):
         """Shared epoch/grouping loop for both modes: accumulate up to
-        `spe` same-shape batches, drain each full group (and the epoch
-        tail) through one fused dispatch; spe == 1 runs per-step."""
+        `spe` same-shape batches, drain each FULL group through one
+        fused dispatch; spe == 1 runs per-step. Partial groups (epoch
+        tails, shape changes) go through run_single so only ONE fused
+        shape [spe, ...] ever compiles — a distinct executable per tail
+        length would cost minutes of XLA compile each on a real TPU."""
+        def flush(pending):
+            if len(pending) == spe:
+                drain(pending)
+            else:
+                for d in pending:
+                    run_single(d)
+
         for _ in range(epochs):
             iterator.reset()
             pending = []
@@ -231,13 +241,13 @@ class ParallelTrainer:
                     continue
                 if pending and np.shape(ds.features) != np.shape(
                         pending[0].features):
-                    drain(pending)   # shape change: close the group
+                    flush(pending)   # shape change: close the group
                     pending = []
                 pending.append(ds)
                 if len(pending) >= spe:
                     drain(pending)
                     pending = []
-            drain(pending)
+            flush(pending)
             model.epoch_count += 1
 
     def _replicate_tree(self, tree):
@@ -251,6 +261,52 @@ class ParallelTrainer:
 
     def _unreplicate_tree(self, tree):
         return jax.tree_util.tree_map(lambda a: np.asarray(a[0]), tree)
+
+    # -------------------------------------------------------------- evaluate
+    def evaluate(self, data, labels=None, *, batch_size: int = 32,
+                 evaluation=None):
+        """Mesh-wide evaluation (reference: the Spark eval functions,
+        `spark/impl/multilayer/scoring/` — workers score their shard,
+        results merged via `Evaluation.merge`). Each batch's forward
+        runs ONCE over the mesh with the batch sharded over the data
+        axis; per-shard Evaluation objects are then merged, so the
+        result is bit-identical to a single-device evaluation while the
+        compute scales with the mesh."""
+        from deeplearning4j_tpu.eval import Evaluation
+
+        model = self.model
+        if not model._initialized:
+            model.init()
+        iterator = as_iterator(data, labels, batch_size=batch_size)
+        repl = NamedSharding(self.mesh, P())
+        batch_sh = NamedSharding(self.mesh, P(self.data_axis))
+        params = _gput_tree(model.params, repl)
+        state = _gput_tree(model.net_state, repl)
+
+        if getattr(self, "_eval_forward", None) is None:
+            def fwd(params, state, x):
+                h, _, _, _, _ = model._forward_core(params, state, x,
+                                                    train=False, rng=None)
+                return h
+            self._eval_forward = jax.jit(
+                fwd, in_shardings=(repl, repl, batch_sh),
+                out_shardings=batch_sh)
+
+        merged = evaluation if evaluation is not None else Evaluation()
+        n = self.n_workers
+        for ds in iterator:
+            if ds.num_examples() % n != 0:
+                # evaluation must not silently skip examples: ragged
+                # tails are scored on the host replica instead
+                merged.eval(ds.labels, np.asarray(model.output(ds.features)))
+                continue
+            x = _gput(ds.features, batch_sh)
+            out = np.asarray(self._eval_forward(params, state, x))
+            # accumulating into `merged` directly keeps its top_n /
+            # labels / threshold settings; `Evaluation.merge` remains
+            # the cross-process combiner (masters / multihost)
+            merged.eval(ds.labels, out)
+        return merged
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
