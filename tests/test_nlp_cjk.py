@@ -1,0 +1,126 @@
+"""CJK segmentation through the TokenizerFactory seam (reference role:
+deeplearning4j-nlp-chinese / -japanese bundle real segmenters behind
+TokenizerFactory). The segmenter here is the in-repo dictionary-DP one
+(`nlp/cjk.py`); these tests prove a NON-whitespace tokenizer actually
+drives vocabulary construction and Word2Vec training end-to-end —
+whitespace splitting would yield whole-sentence "words" and no
+co-occurrence structure at all."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.cjk import (
+    CJKTokenizerFactory,
+    DictionarySegmenter,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+# Small real-Chinese lexicon: animals / food / finance topic words +
+# function words, with frequencies favoring multi-char dictionary words.
+LEXICON = {
+    "猫": 50, "狗": 50, "兔子": 30, "动物": 40, "宠物": 30,
+    "吃": 60, "喜欢": 60, "鱼": 40, "肉": 40, "米饭": 30, "苹果": 30,
+    "银行": 40, "股票": 40, "市场": 40, "价格": 30, "经济": 30,
+    "上涨": 20, "下跌": 20, "投资": 25,
+    "我": 80, "的": 100, "在": 60, "和": 60, "了": 60, "很": 40,
+    "今天": 30, "可爱": 25, "跑": 20, "玩": 25, "公园": 20,
+}
+
+
+def corpus():
+    animals = [
+        "我的猫喜欢吃鱼",
+        "狗在公园跑和玩",
+        "兔子很可爱",
+        "猫和狗是宠物动物" if False else "猫和狗很可爱",
+        "我喜欢我的狗",
+        "宠物猫吃鱼和肉",
+        "兔子吃苹果",
+        "狗喜欢吃肉",
+        "可爱的猫在玩",
+        "动物喜欢在公园玩",
+    ]
+    finance = [
+        "股票价格上涨了",
+        "银行和市场的经济",
+        "投资股票的价格",
+        "市场价格下跌了",
+        "经济和银行的投资",
+        "今天股票上涨",
+        "银行投资市场",
+        "价格在市场上涨",
+    ]
+    return (animals + finance) * 6
+
+
+class TestDictionarySegmenter:
+    def test_segments_known_words(self):
+        seg = DictionarySegmenter(LEXICON)
+        assert seg.segment("我的猫喜欢吃鱼") == ["我", "的", "猫", "喜欢", "吃", "鱼"]
+        assert seg.segment("股票价格上涨了") == ["股票", "价格", "上涨", "了"]
+
+    def test_prefers_dictionary_words_over_chars(self):
+        seg = DictionarySegmenter(LEXICON)
+        toks = seg.segment("兔子吃米饭")
+        assert "兔子" in toks and "米饭" in toks
+
+    def test_unknown_chars_fall_back_to_singles(self):
+        seg = DictionarySegmenter(LEXICON)
+        toks = seg.segment("猫写字")  # 写/字 are OOV
+        assert toks == ["猫", "写", "字"]
+
+    def test_punctuation_splits_runs(self):
+        seg = DictionarySegmenter(LEXICON)
+        toks = seg.segment("猫喜欢鱼，狗喜欢肉。")
+        assert "，" not in toks and "。" not in toks
+        assert toks.count("喜欢") == 2
+
+    def test_latin_runs_pass_through(self):
+        seg = DictionarySegmenter(LEXICON)
+        assert seg.segment("GPU和TPU") == ["GPU", "和", "TPU"]
+
+    def test_from_word_list(self):
+        seg = DictionarySegmenter.from_word_list(["深度", "学习"])
+        assert seg.segment("深度学习") == ["深度", "学习"]
+
+
+class TestCJKTokenizerFactory:
+    def test_seam_contract(self):
+        tf = CJKTokenizerFactory(LEXICON)
+        tok = tf.create("我的猫喜欢吃鱼")
+        assert tok.count_tokens() == 6
+        assert tok.has_more_tokens()
+        assert tok.next_token() == "我"
+
+    def test_preprocessor_applied(self):
+        from deeplearning4j_tpu.nlp.tokenization import TokenPreProcess
+
+        class Tag(TokenPreProcess):
+            def pre_process(self, t):
+                return f"<{t}>"
+
+        tf = CJKTokenizerFactory(LEXICON).set_token_pre_processor(Tag())
+        assert tf.create("猫吃鱼").get_tokens() == ["<猫>", "<吃>", "<鱼>"]
+
+
+class TestChineseWord2Vec:
+    def test_cjk_corpus_trains_with_topic_structure(self):
+        """Word2Vec over raw (unspaced) Chinese sentences via the CJK
+        factory: animal words must cluster away from finance words —
+        impossible unless the segmenter actually produced words."""
+        w2v = Word2Vec(
+            sentence_iterator=corpus(),
+            tokenizer_factory=CJKTokenizerFactory(LEXICON),
+            layer_size=24, window_size=3, min_word_frequency=2,
+            negative_sample=5, learning_rate=0.05, epochs=4,
+            batch_size=512, seed=11)
+        w2v.fit()
+        assert w2v.has_word("股票") and w2v.has_word("猫")
+        # no whole-sentence tokens leaked into the vocab
+        assert not w2v.has_word("我的猫喜欢吃鱼")
+        in_topic = w2v.similarity("猫", "狗")
+        cross = w2v.similarity("猫", "股票")
+        assert in_topic > cross, f"{in_topic} <= {cross}"
+        near = w2v.words_nearest("银行", top_n=5)
+        finance = {"股票", "市场", "价格", "经济", "投资", "上涨", "下跌"}
+        assert len(finance.intersection(near)) >= 2, near
