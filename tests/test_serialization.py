@@ -80,3 +80,72 @@ def test_graph_roundtrip(tmp_path):
     assert isinstance(net2, ComputationGraph)
     np.testing.assert_allclose(np.asarray(net.output(x[:5])),
                                np.asarray(net2.output(x[:5])), atol=1e-6)
+
+
+class TestConfigFormatVersion:
+    """format_version stamping (reference role: the legacy-migration
+    deserializers `MultiLayerConfigurationDeserializer.java:36` — a
+    version field is what makes future migrations possible)."""
+
+    def _conf(self):
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        return (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+
+    def test_round_trip_carries_version(self):
+        import json
+        from deeplearning4j_tpu.nn.conf.builder import (
+            CONFIG_FORMAT_VERSION, MultiLayerConfiguration,
+        )
+        s = self._conf().to_json()
+        assert json.loads(s)["format_version"] == CONFIG_FORMAT_VERSION
+        conf2 = MultiLayerConfiguration.from_json(s)
+        assert conf2.to_dict()["format_version"] == CONFIG_FORMAT_VERSION
+
+    def test_future_version_rejected(self):
+        import json
+        import pytest
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        d = json.loads(self._conf().to_json())
+        d["format_version"] = 999
+        with pytest.raises(ValueError, match="newer than this build"):
+            MultiLayerConfiguration.from_dict(d)
+
+    def test_missing_version_treated_as_v1(self):
+        import json
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        d = json.loads(self._conf().to_json())
+        del d["format_version"]
+        conf = MultiLayerConfiguration.from_dict(d)  # pre-versioning payload
+        assert len(conf.layers) == 2
+
+    def test_graph_config_versioned(self):
+        import json
+        import pytest
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+        b = NeuralNetConfiguration.builder().updater(Adam(1e-3))
+        g = ComputationGraphConfiguration.graph_builder(b)
+        g.add_inputs("in")
+        g.add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+        g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"), "d")
+        g.set_input_types(InputType.feed_forward(4))
+        g.set_outputs("out")
+        conf = g.build()
+        d = json.loads(conf.to_json())
+        assert d["format_version"] >= 1
+        d["format_version"] = 999
+        with pytest.raises(ValueError, match="newer than this build"):
+            ComputationGraphConfiguration.from_dict(d)
