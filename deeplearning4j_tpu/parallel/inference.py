@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+from concurrent.futures import Future
 from typing import List, Optional
 
 import jax
@@ -36,6 +38,15 @@ class ParallelInference:
         self._fwd = None
         self._lock = threading.Lock()
         self._buckets = [1, 2, 4, 8, 16, 32, 64, 128, 256]
+        # background coalescing loop (ObservablesProvider role)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._collector: Optional[threading.Thread] = None
+        self._running = False
+        # executed device-batch sizes — the observable proof that
+        # concurrent callers were actually coalesced (bounded: a
+        # long-lived server must not leak one int per batch forever)
+        from collections import deque
+        self.batch_size_history = deque(maxlen=1024)
 
     def _build(self):
         model = self.model
@@ -73,6 +84,95 @@ class ParallelInference:
             x = np.concatenate([x, pad], axis=0)
         out = self._fwd(model.params, model.net_state, jnp.asarray(x))
         return np.asarray(out)[:n]
+
+    # -------------------------------------------- background batching loop
+    def start(self) -> "ParallelInference":
+        """Start the collector thread: concurrent `output()` callers are
+        coalesced into one device batch within `queue_limit_ms`
+        (reference `ObservablesProvider` :84 — requests observable until
+        the batch fires)."""
+        if self._running:
+            return self
+        if self._fwd is None:
+            self._build()
+        if not self.model._initialized:
+            self.model.init()
+        self._running = True
+        self._collector = threading.Thread(target=self._collect_loop,
+                                           daemon=True)
+        self._collector.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        if self._collector is not None:
+            self._queue.put(None)  # wake the collector
+            self._collector.join(timeout=5)
+            self._collector = None
+        # drain requests that never made it into a batch: leaving their
+        # Futures unresolved would hang callers blocked in .result()
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and not item[1].done():
+                item[1].set_exception(
+                    RuntimeError("ParallelInference stopped before this "
+                                 "request was executed"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+    def output_async(self, x) -> Future:
+        """Enqueue one request; the Future resolves with this request's
+        rows once the coalesced batch it joined has executed."""
+        if not self._running:
+            raise RuntimeError("call start() before output_async()")
+        fut: Future = Future()
+        self._queue.put((np.asarray(x), fut))
+        return fut
+
+    def _collect_loop(self):
+        while self._running:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if first is None:
+                continue
+            batch = [first]
+            total = first[0].shape[0]
+            deadline = time.monotonic() + self.queue_limit_ms / 1000.0
+            while total < self.batch_limit:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                batch.append(nxt)
+                total += nxt[0].shape[0]
+            self._execute(batch)
+
+    def _execute(self, batch):
+        futs = [f for _, f in batch]
+        try:
+            self.batch_size_history.append(
+                sum(x.shape[0] for x, _ in batch))
+            outs = self.output_batched([x for x, _ in batch])
+            for (_, f), o in zip(batch, outs):
+                f.set_result(o)
+        except Exception as e:  # propagate to every waiting caller
+            for f in futs:
+                if not f.done():
+                    f.set_exception(e)
 
     def output_batched(self, requests: List[np.ndarray]):
         """Coalesce many requests into one device batch (ObservablesProvider

@@ -165,3 +165,68 @@ class TestParallelInference:
         assert [o.shape[0] for o in outs] == [1, 3, 5]
         for r, o in zip(reqs, outs):
             np.testing.assert_allclose(o, np.asarray(net.output(r)), atol=1e-5)
+
+
+class TestParallelInferenceCoalescing:
+    """The background batching loop under concurrent load — the
+    ObservablesProvider contract (`ParallelInference.java:84`): many
+    small concurrent requests must execute as FEW large device batches,
+    observable in the executed-batch-size histogram."""
+
+    def test_concurrent_callers_coalesced(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        pi = ParallelInference(net, device_mesh(),
+                               batch_limit=64, queue_limit_ms=60.0)
+        n_callers, rows = 24, 2
+        xs = [np.random.randn(rows, 4).astype(np.float32)
+              for _ in range(n_callers)]
+        with pi:
+            # warm the compile so the first batch doesn't fire alone
+            pi.output(np.zeros((8, 4), np.float32))
+            import threading
+            futs = [None] * n_callers
+            barrier = threading.Barrier(n_callers)
+
+            def call(i):
+                barrier.wait()          # all callers submit at once
+                futs[i] = pi.output_async(xs[i])
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(n_callers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            outs = [futs[i].result(timeout=30) for i in range(n_callers)]
+        # correctness: each caller got ITS rows back
+        for x, o in zip(xs, outs):
+            np.testing.assert_allclose(o, np.asarray(net.output(x)),
+                                       atol=1e-5)
+        # coalescing: 24 requests must have run in far fewer device
+        # batches, with at least one genuinely multi-request batch
+        executed = pi.batch_size_history
+        multi = [b for b in executed if b > rows]
+        assert multi, f"no coalesced batch ever executed: {executed}"
+        n_batches = sum(1 for b in executed if b >= rows)
+        assert n_batches < n_callers / 2, (
+            f"{n_callers} requests ran as {n_batches} batches "
+            f"(histogram {executed}) — coalescing did not happen")
+
+    def test_async_error_propagates_to_callers(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        pi = ParallelInference(net, device_mesh(), queue_limit_ms=20.0)
+        with pi:
+            bad = pi.output_async(np.zeros((2, 7), np.float32))  # wrong width
+            with pytest.raises(Exception):
+                bad.result(timeout=30)
+        # the collector must survive a poisoned batch
+        pi2 = ParallelInference(net, device_mesh(), queue_limit_ms=20.0)
+        with pi2:
+            good = pi2.output_async(np.zeros((2, 4), np.float32))
+            assert good.result(timeout=30).shape == (2, 3)
+
+    def test_output_async_requires_start(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        pi = ParallelInference(net, device_mesh())
+        with pytest.raises(RuntimeError, match="start"):
+            pi.output_async(np.zeros((1, 4), np.float32))
