@@ -326,10 +326,18 @@ def bench_resnet50(accel):
         def run(st, it0, rngs):
             out = compiled_multi(*st, it0, (xs_stack,), (ys_stack,), rngs)
             return (out[0], out[1], out[2]), out[3]
+
+        def run_x(st, it0, xs, ys, rngs):
+            out = compiled_multi(*st, it0, (xs,), (ys,), rngs)
+            return (out[0], out[1], out[2]), out[3]
     except Exception:
         def run(st, it0, rngs):
             out = net._jit_multi_step(*st, it0, (xs_stack,), (ys_stack,),
                                       rngs)
+            return (out[0], out[1], out[2]), out[3]
+
+        def run_x(st, it0, xs, ys, rngs):
+            out = net._jit_multi_step(*st, it0, (xs,), (ys,), rngs)
             return (out[0], out[1], out[2]), out[3]
 
     st, losses = run(st, 0, make_rngs(0))  # warmup (no recompile: AOT above)
@@ -367,6 +375,19 @@ def bench_resnet50(accel):
             return None, None
         ach = flops * steps / dt / 1e12
         return ach, ach / nominal_peak
+
+    # ETL-inclusive window (reference PerformanceListener tracks ETL ms
+    # per iteration, `PerformanceListener.java:87-88`; AsyncDataSetIterator
+    # overlaps host feed with compute): distinct HOST-resident batches
+    # are stacked + device_put by a producer thread while the device
+    # crunches the previous fused window — the SAME executable as the
+    # headline, so the delta is purely the input pipeline.
+    try:
+        etl = _resnet_etl_window(run_x, st, make_rngs, x, y, batch, steps,
+                                 compute_ips=ips)
+        st = etl.pop("_st")
+    except Exception as e:
+        etl = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     ach_analytic, mfu_analytic = _mfu(analytic_flops)
     ach_hlo, mfu_hlo = _mfu(hlo_flops)
@@ -411,6 +432,7 @@ def bench_resnet50(accel):
                      "max(nominal, measured matmul probe) because the "
                      "tunneled device_kind label may not match the "
                      "executing silicon"),
+        "with_etl": etl,
         "loss_first": losses[0], "loss_last": losses[-1],
         "loss_after_timed_windows": loss_last,
         "train_signal_ok": losses[-1] < losses[0],
@@ -419,6 +441,73 @@ def bench_resnet50(accel):
                               "0.9) because the zoo lr=0.1 recipe diverges "
                               "when one batch is re-fit dozens of times "
                               "(identical FLOPs, stable signal)"),
+    }
+
+
+def _resnet_etl_window(run_x, st, make_rngs, x, y, batch, steps, *,
+                       compute_ips, rounds=3, pool_size=None):
+    """Sustained throughput WITH the input pipeline: a producer thread
+    stacks `steps` distinct host batches and starts their (async)
+    device transfer while the device runs the previous fused window.
+    `etl_wait_ms` is the consumer time blocked waiting on the producer —
+    the reference's per-iteration ETL time, aggregated per window."""
+    import concurrent.futures
+    import jax
+    import jax.numpy as jnp
+
+    dtype = np.asarray(jax.device_get(x[:1])).dtype  # match exec avals
+    pool_size = pool_size or steps
+    rng = np.random.default_rng(7)
+    # distinct HOST batches (the headline's broadcast stack never moves
+    # host data; this pool is what a real pipeline would feed)
+    pool_x = [rng.standard_normal(x.shape).astype(dtype)
+              for _ in range(pool_size)]
+    y_host = np.asarray(jax.device_get(y))
+
+    def produce(r):
+        idx = [(r * steps + i) % pool_size for i in range(steps)]
+        xs = np.stack([pool_x[i] for i in idx])
+        ys = np.broadcast_to(y_host[None], (steps,) + y_host.shape)
+        return jax.device_put(jnp.asarray(xs)), jax.device_put(jnp.asarray(ys))
+
+    ex = concurrent.futures.ThreadPoolExecutor(1)
+    try:
+        # round 0 is WARMUP: its produce has nothing to overlap with, so
+        # timing it would charge the steady-state pipeline for a cold
+        # start (round 1's produce is submitted before round 0's compute,
+        # so the timed rounds measure genuine overlap)
+        fut = ex.submit(produce, 0)
+        xs_d, ys_d = fut.result()
+        fut = ex.submit(produce, 1)
+        st, losses = run_x(st, 10 * steps, xs_d, ys_d, make_rngs(10 * steps))
+        np.asarray(losses)
+        etl_wait = 0.0
+        t0 = time.perf_counter()
+        for r in range(1, rounds + 1):
+            tw = time.perf_counter()
+            xs_d, ys_d = fut.result()
+            etl_wait += time.perf_counter() - tw
+            if r < rounds:
+                fut = ex.submit(produce, r + 1)
+            st, losses = run_x(st, (10 + r) * steps, xs_d, ys_d,
+                               make_rngs((10 + r) * steps))
+            np.asarray(losses)  # value readback ends each window
+        total = time.perf_counter() - t0
+    finally:
+        ex.shutdown(wait=False)
+    ips_etl = batch * steps * rounds / total
+    return {
+        "_st": st,
+        "images_per_sec_with_etl": round(ips_etl, 2),
+        "etl_wait_ms_per_window": round(etl_wait * 1000 / rounds, 2),
+        "rounds": rounds, "distinct_host_batches": pool_size,
+        "vs_compute_only": (round(ips_etl / compute_ips, 4)
+                            if compute_ips else None),
+        "etl_overlap_ok": bool(compute_ips and ips_etl >= 0.9 * compute_ips),
+        "note": ("producer thread stacks+transfers the next fused "
+                 "window while the device runs the current one "
+                 "(AsyncDataSetIterator role); same AOT executable as "
+                 "the compute-only number"),
     }
 
 
@@ -774,12 +863,60 @@ def _scaling_child():
     print(json.dumps({"metric": "dataparallel_scaling_cpu8", **out}))
 
 
+def _probe_tunnel_subprocess(timeout_s=60) -> bool:
+    """One tunnel-health probe in a FRESH interpreter. A retry must use
+    a subprocess: once this process's backend init hangs on a dead
+    tunnel, every later jax call in the same process waits on the same
+    stuck init — only a new interpreter can re-attempt."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+    except Exception:
+        return False
+
+
 def _probe_backend(timeout_s=180):
-    """Initialize the JAX backend with a watchdog. The axon plugin's
-    device init HANGS indefinitely when the TPU tunnel is down (observed
-    in round 3) — a bench that hangs tells the driver nothing, so probe
-    in a daemon thread and report a structured failure instead."""
+    """Initialize the JAX backend with a watchdog and RETRY window. The
+    axon plugin's device init HANGS indefinitely when the TPU tunnel is
+    down (observed in round 3, which lost its end-of-round number to a
+    single blip) — so: (1) subprocess probes retry with backoff across
+    DL4J_BENCH_RETRY_WINDOW_S (default 600s) until one succeeds; (2)
+    only then does THIS process initialize, still under a watchdog
+    thread; (3) failure emits a structured error JSON, never a hang."""
     import threading
+
+    window_s = float(os.environ.get("DL4J_BENCH_RETRY_WINDOW_S", "600"))
+    # CPU-forced runs (tests / sandbox drives set jax_platforms=cpu
+    # in-process, which a subprocess would NOT inherit) skip the tunnel
+    # probe — there is no tunnel to wait for
+    try:
+        import jax
+        if "cpu" == str(getattr(jax.config, "jax_platforms", "") or ""):
+            window_s = 0.0
+    except Exception:
+        pass
+    deadline = time.monotonic() + window_s
+    attempts = 0
+    while window_s > 0:
+        attempts += 1
+        if _probe_tunnel_subprocess():
+            break
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            print(json.dumps({
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+                "error": (f"accelerator tunnel unreachable after "
+                          f"{attempts} probes over {window_s:.0f}s"),
+                "probe_attempts": attempts,
+            }))
+            return None
+        time.sleep(min(45.0, remaining))
+
     box = {}
 
     def probe():
@@ -798,7 +935,7 @@ def _probe_backend(timeout_s=180):
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
-        "error": err,
+        "error": err, "probe_attempts": attempts,
     }))
     return None
 
