@@ -227,7 +227,14 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                 f"bucket_boundaries must be positive, got {bucket_boundaries}")
         self.bucket_boundaries = (sorted(bucket_boundaries)
                                   if bucket_boundaries else None)
+        self._truncated_count = 0
+        self._warned_truncation = False
         self.reset()
+
+    @property
+    def truncated_count(self) -> int:
+        """#sequences tail-truncated by the last bucket boundary."""
+        return self._truncated_count
 
     def _bucket_len(self, T: int) -> int:
         if self.bucket_boundaries is None:
@@ -274,6 +281,17 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                 # hard-cap truncation (bucketing only) keeps the TAIL:
                 # ALIGN_END semantics put the informative final steps
                 # (and sequence-classification targets) at the end
+                self._truncated_count += 1
+                if not self._warned_truncation:
+                    self._warned_truncation = True
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "sequence of length %d exceeds the last bucket "
+                        "boundary %d and was TAIL-truncated (keeping the "
+                        "final %d steps); further truncations are counted "
+                        "silently — see .truncated_count. Raise the last "
+                        "bucket_boundaries entry to keep full sequences",
+                        s.shape[0], T, T)
                 t = T
                 s, l = s[-T:], l[-T:]
             # (a label sequence misaligned with its features still

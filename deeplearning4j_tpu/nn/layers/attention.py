@@ -53,6 +53,25 @@ def _flash_available() -> bool:
     return _FLASH_OK[backend]
 
 
+_SP_FALLBACK_WARNED = set()
+
+
+def _warn_sp_fallback(layer_name, reason):
+    """One-time notice when a layer CONFIGURED for sequence parallelism
+    takes the local-attention path — exactly the long-context cases the
+    user enabled SP for, so silence would read as 'SP is on' while
+    memory/perf stay unchanged (same pattern as _flash_available)."""
+    key = (layer_name, reason)
+    if key not in _SP_FALLBACK_WARNED:
+        _SP_FALLBACK_WARNED.add(key)
+        import logging
+        logging.getLogger(__name__).warning(
+            "layer %s has sequence_parallel configured but fell back to "
+            "local attention: %s — sequence-parallel memory/perf benefits "
+            "do NOT apply to this forward",
+            layer_name, reason)
+
+
 @register_layer
 @dataclasses.dataclass(eq=False)
 class MultiHeadAttention(Layer):
@@ -129,6 +148,11 @@ class MultiHeadAttention(Layer):
         if self.sequence_parallel and plain:
             from deeplearning4j_tpu.parallel.context import current_sequence_mesh
             ctx = current_sequence_mesh()
+            if ctx is None:
+                _warn_sp_fallback(
+                    self.name or type(self).__name__,
+                    "no sequence_sharding(mesh) context active — wrap "
+                    "fit/output in `with sequence_sharding(mesh):`")
             if ctx is not None:
                 mesh, axis = ctx
                 if self.sequence_parallel == "ring":
@@ -149,6 +173,15 @@ class MultiHeadAttention(Layer):
                         f"got {self.sequence_parallel!r}")
                 o = o.reshape(x.shape[0], x.shape[1], -1)
                 return self.activation(self._project(params, o, "Wo")), state
+        if self.sequence_parallel and not plain:
+            reasons = []
+            if mask is not None:
+                reasons.append("padding mask present (ring/ulysses paths "
+                               "are mask-free)")
+            if train and self.attention_dropout is not None:
+                reasons.append("attention_dropout active in training")
+            _warn_sp_fallback(self.name or type(self).__name__,
+                              "; ".join(reasons))
         use_flash = self.use_flash
         if use_flash is None:
             # auto mode probes kernel availability eagerly (a compile
