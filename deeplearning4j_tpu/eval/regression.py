@@ -87,6 +87,42 @@ class RegressionEvaluation:
     def average_root_mean_squared_error(self) -> float:
         return float(np.mean(np.sqrt(self._sum_err2 / self._count)))
 
+
+    # ---- serde (reference BaseEvaluation.toJson/fromJson) ----------------
+    _SUM_FIELDS = ("_sum_err2", "_sum_abs", "_sum_label", "_sum_label2",
+                   "_sum_pred", "_sum_pred2", "_sum_label_pred", "_count")
+
+    def to_json(self) -> str:
+        import json
+        d = {"format_version": 1, "type": "RegressionEvaluation",
+             "num_columns": self.num_columns,
+             "column_names": self.column_names}
+        for f in self._SUM_FIELDS:
+            v = getattr(self, f)
+            d[f] = None if v is None else v.tolist()
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "RegressionEvaluation":
+        import json
+        d = json.loads(s)
+        if d.get("type") != "RegressionEvaluation":
+            raise ValueError(f"Not a RegressionEvaluation payload: {d.get('type')}")
+        ev = cls(num_columns=d["num_columns"], column_names=d.get("column_names"))
+        for f in cls._SUM_FIELDS:
+            if d.get(f) is not None:
+                setattr(ev, f, np.asarray(d[f], np.float64))
+        return ev
+
+    def merge(self, other: "RegressionEvaluation") -> "RegressionEvaluation":
+        """Accumulator merge (the Spark tree-aggregate role)."""
+        if other._sum_err2 is None:
+            return self
+        self._ensure(other.num_columns)
+        for f in self._SUM_FIELDS:
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+        return self
+
     def stats(self) -> str:
         lines = ["Column    MSE            MAE            RMSE           RSE            R^2"]
         for c in range(self.num_columns):

@@ -109,6 +109,36 @@ class ROC:
         return PrecisionRecallCurve(probs[order], precision, recall)
 
 
+
+    # ---- serde + merge (exact mode stores raw scores, so serialization
+    # carries them — the reference's exact-mode ROC does the same via
+    # its stored prediction arrays)
+    def to_json(self) -> str:
+        import json
+        labels, probs = (self._collect() if self._labels
+                         else (np.zeros(0), np.zeros(0)))
+        return json.dumps({"format_version": 1, "type": "ROC",
+                           "threshold_steps": self.threshold_steps,
+                           "labels": labels.tolist(),
+                           "probs": probs.tolist()})
+
+    @classmethod
+    def from_json(cls, s: str) -> "ROC":
+        import json
+        d = json.loads(s)
+        if d.get("type") != "ROC":
+            raise ValueError(f"Not a ROC payload: {d.get('type')}")
+        roc = cls(threshold_steps=d.get("threshold_steps", 0))
+        if d["labels"]:
+            roc._labels.append(np.asarray(d["labels"], np.float64))
+            roc._probs.append(np.asarray(d["probs"], np.float64))
+        return roc
+
+    def merge(self, other: "ROC") -> "ROC":
+        self._labels.extend(other._labels)
+        self._probs.extend(other._probs)
+        return self
+
 class ROCBinary:
     """Independent binary ROC per output column (reference
     `ROCBinary.java` for multi-label sigmoid outputs)."""

@@ -297,3 +297,39 @@ class TestMeshEvaluate:
         y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 37)]
         ev = ParallelTrainer(net).evaluate(x, y, batch_size=16)
         assert ev.total == 37  # no example silently skipped
+
+
+class TestRocRegressionSerde:
+    def test_roc_json_round_trip_and_merge(self):
+        from deeplearning4j_tpu.eval.roc import ROC
+        rng = np.random.default_rng(3)
+        y = (rng.random(100) > 0.5).astype(np.float64)
+        p = np.clip(y * 0.6 + rng.random(100) * 0.4, 0, 1)
+        roc = ROC()
+        roc.eval(y[:50], p[:50])
+        roc2 = ROC()
+        roc2.eval(y[50:], p[50:])
+        merged = ROC().merge(roc).merge(roc2)
+        full = ROC()
+        full.eval(y, p)
+        assert merged.calculate_auc() == pytest.approx(full.calculate_auc())
+        rt = ROC.from_json(full.to_json())
+        assert rt.calculate_auc() == pytest.approx(full.calculate_auc())
+
+    def test_regression_json_round_trip_and_merge(self):
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        rng = np.random.default_rng(4)
+        y = rng.standard_normal((60, 3))
+        p = y + 0.1 * rng.standard_normal((60, 3))
+        a, b, full = (RegressionEvaluation() for _ in range(3))
+        a.eval(y[:30], p[:30])
+        b.eval(y[30:], p[30:])
+        a.merge(b)
+        full.eval(y, p)
+        for c in range(3):
+            assert a.mean_squared_error(c) == pytest.approx(
+                full.mean_squared_error(c))
+        rt = RegressionEvaluation.from_json(full.to_json())
+        for c in range(3):
+            assert rt.correlation_r2(c) == pytest.approx(
+                full.correlation_r2(c))
