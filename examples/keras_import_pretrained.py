@@ -1,0 +1,55 @@
+"""Keras model import + packaged pretrained zoo weights.
+
+Two migration paths a DL4J user relies on (reference:
+`KerasModelImport.java`, `ZooModel.initPretrained`):
+
+1. import a Keras .h5 (any of the Keras 1/2/3 dialects) — a COMPILED
+   model keeps its loss/optimizer and can keep training here;
+2. load a zoo model's pretrained checkpoint and use/fine-tune it.
+"""
+from pathlib import Path
+
+import numpy as np
+
+from deeplearning4j_tpu.eval import Evaluation
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+from deeplearning4j_tpu.zoo.base import PretrainedType
+from deeplearning4j_tpu.zoo.lenet import LeNet
+
+FIXTURES = Path(__file__).parents[1] / "tests" / "fixtures" / "keras"
+
+
+def import_and_finetune():
+    # real_bn.h5 was saved by genuine Keras after model.compile(...):
+    # the import maps its loss + optimizer, so fit() works immediately
+    net = KerasModelImport.import_keras_model_and_weights(
+        str(FIXTURES / "real_bn.h5"))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 6, 6, 2)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    print("imported output:", np.asarray(net.output(x[:2])).round(3))
+    net.fit(x, y, epochs=3)
+    print("fine-tuned score:", net.score_value)
+
+
+def pretrained_zoo():
+    # ships inside the package (zoo/weights/); trained on the real
+    # sklearn handwritten-digits corpus — no network needed
+    net = LeNet().init_pretrained(PretrainedType.MNIST)
+    from sklearn.datasets import load_digits
+    import jax
+    import jax.numpy as jnp
+
+    d = load_digits()
+    x = d.images.astype(np.float32) / 16.0
+    x = np.asarray(jax.image.resize(jnp.asarray(x), (len(x), 28, 28),
+                                    "bilinear"))[..., None]
+    y = np.eye(10, dtype=np.float32)[d.target]
+    ev = Evaluation(10)
+    ev.eval(y[:300], np.asarray(net.output(x[:300])))
+    print(ev.stats(include_per_class=False))
+
+
+if __name__ == "__main__":
+    import_and_finetune()
+    pretrained_zoo()
