@@ -1,0 +1,67 @@
+"""Dashboard i18n (reference role: the Play UI's i18n resource bundles,
+`deeplearning4j-ui-parent/deeplearning4j-play` i18n/ dir). Pages take a
+`?lang=` query parameter; unknown languages and missing keys fall back
+to English."""
+
+from __future__ import annotations
+
+_MESSAGES = {
+    "en": {
+        "overview": "Overview", "model": "Model", "system": "System",
+        "tsne": "t-SNE", "activations": "Activations",
+        "title.overview": "Training Overview", "title.model": "Model",
+        "title.system": "System", "title.tsne": "t-SNE",
+        "title.activations": "Activations",
+        "session": "Session", "score": "score", "throughput": "throughput",
+        "examples_per_sec": "examples/sec", "memory": "memory",
+        "iteration_time": "iteration time",
+        "mean_param": "mean |param|",
+        "update_ratio": "log10 update : param ratio",
+        "distribution": "distribution",
+        "latest_magnitudes": "latest parameter magnitudes",
+        "param": "param", "mean_value": "mean |value|",
+        "no_sessions": "No training sessions attached yet.",
+        "no_model_stats": "No model stats yet.",
+    },
+    "ja": {
+        "overview": "概要", "model": "モデル", "system": "システム",
+        "tsne": "t-SNE", "activations": "活性化",
+        "title.overview": "学習の概要", "title.model": "モデル",
+        "title.system": "システム", "title.tsne": "t-SNE",
+        "title.activations": "活性化",
+        "session": "セッション", "score": "スコア",
+        "throughput": "スループット", "examples_per_sec": "サンプル/秒",
+        "memory": "メモリ", "iteration_time": "イテレーション時間",
+        "mean_param": "平均 |パラメータ|",
+        "update_ratio": "log10 更新:パラメータ比",
+        "distribution": "分布",
+        "latest_magnitudes": "最新のパラメータ値",
+        "param": "パラメータ", "mean_value": "平均 |値|",
+        "no_sessions": "学習セッションがまだ接続されていません。",
+        "no_model_stats": "モデル統計はまだありません。",
+    },
+    "zh": {
+        "overview": "概览", "model": "模型", "system": "系统",
+        "tsne": "t-SNE", "activations": "激活",
+        "title.overview": "训练概览", "title.model": "模型",
+        "title.system": "系统", "title.tsne": "t-SNE",
+        "title.activations": "激活",
+        "session": "会话", "score": "得分", "throughput": "吞吐量",
+        "examples_per_sec": "样本/秒", "memory": "内存",
+        "iteration_time": "迭代时间",
+        "mean_param": "平均 |参数|",
+        "update_ratio": "log10 更新:参数比",
+        "distribution": "分布",
+        "latest_magnitudes": "最新参数值",
+        "param": "参数", "mean_value": "平均 |值|",
+        "no_sessions": "尚未连接任何训练会话。",
+        "no_model_stats": "尚无模型统计。",
+    },
+}
+
+LANGUAGES = tuple(_MESSAGES)
+
+
+def tr(lang: str, key: str) -> str:
+    table = _MESSAGES.get(lang) or _MESSAGES["en"]
+    return table.get(key) or _MESSAGES["en"].get(key, key)

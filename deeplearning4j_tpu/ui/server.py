@@ -17,8 +17,10 @@ import base64
 import html as _html
 import io
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -30,6 +32,7 @@ from deeplearning4j_tpu.ui.components import (
     ComponentTable,
 )
 from deeplearning4j_tpu.ui.stats import StatsReport
+from deeplearning4j_tpu.ui.i18n import LANGUAGES, tr as _tr_i18n
 from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
 
 
@@ -43,6 +46,10 @@ class UIServer:
         self._tsne: Dict[str, dict] = {}          # session → {coords, labels}
         self._activations: Dict[str, bytes] = {}  # name → PNG bytes
         self._module_lock = threading.Lock()      # guards the two dicts
+        # per-REQUEST view options (lang/refresh): thread-local because
+        # ThreadingHTTPServer handles concurrent requests on separate
+        # threads — instance attributes would race between them
+        self._req = threading.local()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -59,7 +66,19 @@ class UIServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                path = self.path.split("?")[0]
+                parsed = urlparse(self.path)
+                path = parsed.path
+                q = parse_qs(parsed.query)
+                # reference play UI: i18n bundles + live-updating views;
+                # here: ?lang=en|ja|zh and ?refresh=<seconds>. lang is
+                # WHITELISTED (it is echoed into hrefs — arbitrary
+                # values would be a reflected-XSS vector)
+                lang = q.get("lang", ["en"])[0]
+                outer._req.lang = lang if lang in LANGUAGES else "en"
+                try:
+                    outer._req.refresh = max(0, int(q.get("refresh", ["0"])[0]))
+                except ValueError:
+                    outer._req.refresh = 0
                 if path in ("/", "/train", "/train/overview"):
                     self._send(200, outer._overview_html())
                 elif path == "/train/model":
@@ -151,21 +170,34 @@ class UIServer:
     def _sessions(self):
         return self.storage.list_session_ids()
 
+    def _qs(self):
+        parts = []
+        if getattr(self._req, "lang", "en") != "en":
+            parts.append(f"lang={self._req.lang}")
+        if getattr(self._req, "refresh", 0):
+            parts.append(f"refresh={self._req.refresh}")
+        return ("?" + "&".join(parts)) if parts else ""
+
+    def _tr(self, key):
+        return _tr_i18n(getattr(self._req, "lang", "en"), key)
+
     def _nav(self, active):
+        qs = self._qs()
         pages = [("overview", "/train/overview"), ("model", "/train/model"),
                  ("system", "/train/system"), ("tsne", "/tsne"),
                  ("activations", "/activations")]
         links = "".join(
-            f'<a href="{url}" style="margin-right:16px;'
-            f'{"font-weight:bold" if p == active else ""}">{p.title()}</a>'
+            f'<a href="{url}{qs}" style="margin-right:16px;'
+            f'{"font-weight:bold" if p == active else ""}">'
+            f'{_html.escape(self._tr(p))}</a>'
             for p, url in pages)
         return f'<div style="padding:8px;border-bottom:1px solid #ddd">{links}</div>'
 
     def _score_chart(self, sid, reports=None) -> ChartLine:
         if reports is None:
             reports = self.storage.get_reports(sid)
-        chart = ChartLine(title=f"score — {sid}")
-        chart.add_series("score", [r.iteration for r in reports],
+        chart = ChartLine(title=f"{self._tr('score')} — {sid}")
+        chart.add_series(self._tr("score"), [r.iteration for r in reports],
                          [r.score for r in reports])
         return chart
 
@@ -174,16 +206,16 @@ class UIServer:
         for sid in self._sessions():
             reports = self.storage.get_reports(sid)
             xs = [r.iteration for r in reports]
-            body.append(f"<h3>Session {_html.escape(str(sid))}</h3>")
+            body.append(f"<h3>{self._tr('session')} {_html.escape(str(sid))}</h3>")
             body.append(self._score_chart(sid, reports).render())
             if reports and any(r.examples_per_sec for r in reports):
-                perf = ChartLine(title="throughput")
-                perf.add_series("examples/sec", xs,
+                perf = ChartLine(title=self._tr("throughput"))
+                perf.add_series(self._tr("examples_per_sec"), xs,
                                 [r.examples_per_sec for r in reports])
                 body.append(perf.render())
         if len(body) == 1:
-            body.append("<p>No training sessions attached yet.</p>")
-        return self._page("Training Overview", "".join(body))
+            body.append(f"<p>{self._tr('no_sessions')}</p>")
+        return self._page(self._tr("title.overview"), "".join(body))
 
     def _model_html(self):
         """Per-layer drill-down: mean-magnitude timelines for params and
@@ -194,14 +226,14 @@ class UIServer:
             latest = self.storage.latest_report(sid)
             if latest is None:
                 continue
-            body.append(f"<h3>Session {_html.escape(str(sid))}</h3>")
+            body.append(f"<h3>{self._tr('session')} {_html.escape(str(sid))}</h3>")
             xs = [r.iteration for r in reports]
             by_layer: Dict[str, List[str]] = {}
             for key in latest.param_mean_magnitudes:
                 lk = key.split("_", 1)[0]
                 by_layer.setdefault(lk, []).append(key)
             for lk in sorted(by_layer, key=str):
-                chart = ChartLine(title=f"layer {lk} — mean |param|")
+                chart = ChartLine(title=f"layer {lk} — {self._tr('mean_param')}")
                 for key in sorted(by_layer[lk]):
                     chart.add_series(
                         key, xs,
@@ -215,22 +247,44 @@ class UIServer:
                         [r.update_mean_magnitudes.get(key, 0.0)
                          for r in reports])
                 body.append(chart.render())
+                # update:param ratio — THE canonical training-health
+                # diagnostic (reference TrainModule "Update:Parameter
+                # Ratios" chart; healthy training sits around 1e-3)
+                ratio_keys = [k for k in sorted(by_layer[lk])
+                              if k in latest.update_mean_magnitudes]
+                if ratio_keys:
+                    rchart = ChartLine(
+                        title=f"layer {lk} — {self._tr('update_ratio')}")
+                    for key in ratio_keys:
+                        ys = []
+                        for r in reports:
+                            u = r.update_mean_magnitudes.get(key, 0.0)
+                            pm = r.param_mean_magnitudes.get(key, 0.0)
+                            ys.append(math.log10(u / pm)
+                                      if u > 0 and pm > 0 else float("nan"))
+                        pts = [(x, y) for x, y in zip(xs, ys)
+                               if y == y]  # drop NaN (no update yet)
+                        if pts:
+                            rchart.add_series(key, [p_[0] for p_ in pts],
+                                              [p_[1] for p_ in pts])
+                    if rchart.series:
+                        body.append(rchart.render())
                 for key in sorted(by_layer[lk]):
                     hist = latest.param_histograms.get(key)
                     if hist:
                         edges, counts = hist
-                        h = ChartHistogram(title=f"{key} distribution")
+                        h = ChartHistogram(title=f"{key} {self._tr('distribution')}")
                         for lo, hi, c in zip(edges[:-1], edges[1:], counts):
                             h.add_bin(lo, hi, c)
                         body.append(h.render())
             body.append(ComponentTable(
-                ["param", "mean |value|"],
+                [self._tr("param"), self._tr("mean_value")],
                 [(k, f"{v:.6g}")
                  for k, v in sorted(latest.param_mean_magnitudes.items())],
-                title="latest parameter magnitudes").render())
+                title=self._tr("latest_magnitudes")).render())
         if len(body) == 1:
-            body.append("<p>No model stats yet.</p>")
-        return self._page("Model", "".join(body))
+            body.append(f"<p>{self._tr('no_model_stats')}</p>")
+        return self._page(self._tr("title.model"), "".join(body))
 
     def _system_html(self):
         body = [self._nav("system")]
@@ -239,15 +293,15 @@ class UIServer:
             if not reports:
                 continue
             xs = [r.iteration for r in reports]
-            body.append(f"<h3>Session {_html.escape(str(sid))}</h3>")
-            mem = ChartLine(title="memory")
+            body.append(f"<h3>{self._tr('session')} {_html.escape(str(sid))}</h3>")
+            mem = ChartLine(title=self._tr("memory"))
             mem.add_series("RSS MB", xs, [r.memory_rss_mb for r in reports])
             body.append(mem.render())
-            t = ChartLine(title="iteration time")
+            t = ChartLine(title=self._tr("iteration_time"))
             t.add_series("ms/iter", xs,
                          [r.iteration_time_ms for r in reports])
             body.append(t.render())
-        return self._page("System", "".join(body))
+        return self._page(self._tr("title.system"), "".join(body))
 
     def _tsne_html(self):
         body = [self._nav("tsne")]
@@ -264,7 +318,7 @@ class UIServer:
             body.append("<p>No t-SNE coordinates uploaded. POST JSON "
                         '{"coords": [[x,y],...], "labels": [...]} '
                         "to /tsne/upload.</p>")
-        return self._page("t-SNE", "".join(body))
+        return self._page(self._tr("title.tsne"), "".join(body))
 
     def _activations_html(self):
         body = [self._nav("activations")]
@@ -278,11 +332,14 @@ class UIServer:
                         f'style="image-rendering:pixelated;min-width:160px"/>')
         if len(body) == 1:
             body.append("<p>No activation grids posted yet.</p>")
-        return self._page("Activations", "".join(body))
+        return self._page(self._tr("title.activations"), "".join(body))
 
-    @staticmethod
-    def _page(title, body):
-        return (f"<!doctype html><html><head><title>{title}</title></head>"
+    def _page(self, title, body):
+        refresh = getattr(self._req, "refresh", 0)
+        meta = (f'<meta http-equiv="refresh" content="{refresh}">'
+                if refresh else "")
+        return (f"<!doctype html><html><head><title>{title}</title>{meta}"
+                f"</head>"
                 f"<body style='font-family:sans-serif'>{body}</body></html>")
 
     # --------------------------------------------------------------- api

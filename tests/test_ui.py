@@ -325,3 +325,83 @@ class TestUIModules:
         server = UIServer()
         with pytest.raises(ValueError):
             server.post_tsne("s", np.zeros((0, 2)))
+
+
+class TestDashboardDepth:
+    """Round-4 TrainModule-depth features: update:param ratio chart,
+    i18n (?lang=), auto-refresh (?refresh=) — reference
+    `module/train/TrainModule.java:93-105` + play i18n bundles."""
+
+    def _server_with_data(self):
+        import numpy as np
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.stats import StatsReport
+
+        server = UIServer(0).start()
+        for it in (0, 10, 20):
+            server.storage.put_report(StatsReport(
+                session_id="s1", worker_id="w0", iteration=it, epoch=0,
+                timestamp=float(it), score=1.0 / (it + 1),
+                examples_per_sec=100.0,
+                param_mean_magnitudes={"0_W": 0.5},
+                update_mean_magnitudes=({"0_W": 0.005} if it else {}),
+            ))
+        return server
+
+    def _get(self, server, path):
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}{path}") as r:
+            return r.read().decode()
+
+    def test_update_param_ratio_chart_rendered(self):
+        server = self._server_with_data()
+        try:
+            html = self._get(server, "/train/model")
+            assert "update : param ratio" in html
+            # log10(0.005/0.5) = -2 must appear as a plotted series
+            assert "0_W" in html
+        finally:
+            server.stop()
+
+    def test_lang_parameter_localizes_and_propagates(self):
+        server = self._server_with_data()
+        try:
+            html = self._get(server, "/train/overview?lang=ja")
+            assert "学習の概要" in html           # localized title
+            assert 'href="/train/model?lang=ja"' in html  # nav keeps lang
+            html_zh = self._get(server, "/train/model?lang=zh")
+            assert "更新:参数比" in html_zh
+        finally:
+            server.stop()
+
+    def test_refresh_parameter_adds_meta_tag(self):
+        server = self._server_with_data()
+        try:
+            html = self._get(server, "/train/overview?refresh=5")
+            assert '<meta http-equiv="refresh" content="5">' in html
+            plain = self._get(server, "/train/overview")
+            assert "http-equiv" not in plain
+        finally:
+            server.stop()
+
+    def test_unknown_lang_falls_back_to_english(self):
+        server = self._server_with_data()
+        try:
+            html = self._get(server, "/train/overview?lang=xx")
+            assert "Training Overview" in html
+        finally:
+            server.stop()
+
+    def test_lang_is_whitelisted_not_reflected(self):
+        """lang is echoed into hrefs, so arbitrary values must never
+        round-trip (reflected-XSS vector): unknown values normalize to
+        'en' and do not appear in the page."""
+        server = self._server_with_data()
+        try:
+            html = self._get(server,
+                             "/train/overview?lang=%22%3E%3Cb%3E")
+            assert '"><b>' not in html
+            assert 'href="/train/model"' in html  # qs dropped entirely
+        finally:
+            server.stop()
