@@ -74,6 +74,48 @@ class EvaluationBinary:
     def false_negatives(self, col: int) -> int:
         return int(self._fn[col])
 
+
+    # ---- serde + merge (tree-aggregate shape) ----------------------------
+    def to_json(self) -> str:
+        import json
+        return json.dumps({
+            "format_version": 1, "type": "EvaluationBinary",
+            "threshold": self.threshold,
+            "tp": None if self._tp is None else self._tp.tolist(),
+            "fp": None if self._fp is None else self._fp.tolist(),
+            "tn": None if self._tn is None else self._tn.tolist(),
+            "fn": None if self._fn is None else self._fn.tolist(),
+        })
+
+    @classmethod
+    def from_json(cls, s: str) -> "EvaluationBinary":
+        import json
+        d = json.loads(s)
+        if d.get("type") != "EvaluationBinary":
+            raise ValueError(f"Not an EvaluationBinary payload: {d.get('type')}")
+        ev = cls(threshold=d.get("threshold", 0.5))
+        if d.get("tp") is not None:
+            for f, k in (("_tp", "tp"), ("_fp", "fp"), ("_tn", "tn"),
+                         ("_fn", "fn")):
+                setattr(ev, f, np.asarray(d[k], np.int64))
+        return ev
+
+    def merge(self, other: "EvaluationBinary") -> "EvaluationBinary":
+        if other._tp is None:
+            return self
+        if other.threshold != self.threshold:
+            # counts taken at different decision thresholds sum to
+            # numbers that correspond to NO threshold — refuse
+            raise ValueError(
+                f"cannot merge EvaluationBinary at threshold "
+                f"{other.threshold} into one at {self.threshold}")
+        self._ensure(len(other._tp))
+        self._tp += other._tp
+        self._fp += other._fp
+        self._tn += other._tn
+        self._fn += other._fn
+        return self
+
     def stats(self) -> str:
         lines = ["Label   Acc     Precision Recall  F1"]
         for c in range(self.num_labels()):

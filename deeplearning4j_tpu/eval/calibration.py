@@ -90,3 +90,44 @@ class EvaluationCalibration:
         from deeplearning4j_tpu.eval.curves import Histogram
         return Histogram(f"|label - P| (class {cls})", 0.0, 1.0,
                          self._residual_hist[cls].copy())
+
+    # ---- serde + merge ---------------------------------------------------
+    _ACC_FIELDS = ("_bin_counts", "_bin_pos", "_bin_prob_sum", "_prob_hist",
+                   "_residual_hist")
+
+    def to_json(self) -> str:
+        import json
+        d = {"format_version": 1, "type": "EvaluationCalibration",
+             "reliability_bins": self.reliability_bins,
+             "histogram_bins": self.histogram_bins}
+        for f in self._ACC_FIELDS:
+            v = getattr(self, f)
+            d[f] = None if v is None else v.tolist()
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "EvaluationCalibration":
+        import json
+        d = json.loads(s)
+        if d.get("type") != "EvaluationCalibration":
+            raise ValueError(
+                f"Not an EvaluationCalibration payload: {d.get('type')}")
+        ev = cls(reliability_bins=d["reliability_bins"],
+                 histogram_bins=d["histogram_bins"])
+        for f in cls._ACC_FIELDS:
+            if d.get(f) is not None:
+                arr = np.asarray(d[f])
+                setattr(ev, f, arr.astype(
+                    np.int64 if f != "_bin_prob_sum" else np.float64))
+        return ev
+
+    def merge(self, other: "EvaluationCalibration") -> "EvaluationCalibration":
+        if other._bin_counts is None:
+            return self
+        if (other.reliability_bins != self.reliability_bins
+                or other.histogram_bins != self.histogram_bins):
+            raise ValueError("cannot merge calibrations with different bins")
+        self._ensure(other._bin_counts.shape[0])
+        for f in self._ACC_FIELDS:
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+        return self

@@ -49,6 +49,46 @@ class TrainingMaster:
             return data[0], data[1]
         return data, None
 
+    def execute_evaluation(self, model, data, *, batch_size: int = 32,
+                           evaluation=None, n_shards: Optional[int] = None):
+        """Distributed evaluation (reference: the Spark eval RDD
+        functions, `spark/impl/multilayer/scoring/` — each worker
+        scores its partition, the driver merges). The data is split
+        into worker shards, each shard scored through the mesh-sharded
+        forward into its OWN Evaluation, and the per-shard results
+        combined with `Evaluation.merge` — the tree-aggregate shape,
+        so the path multi-process deployments use is the one tested."""
+        import copy
+
+        from deeplearning4j_tpu.eval import Evaluation
+
+        mesh = getattr(self, "mesh", None) or device_mesh()
+        trainer = ParallelTrainer(model, mesh)
+        x, y = self._split(data)
+        merged = evaluation if evaluation is not None else Evaluation()
+        if y is None:  # iterator/DataSet input: score in one pass
+            return trainer.evaluate(x, batch_size=batch_size,
+                                    evaluation=merged)
+        n = n_shards or mesh.shape["data"]
+        n = max(1, min(n, len(x)))
+        # per-shard evaluator = an emptied CLONE of the caller's, so its
+        # configuration (threshold, cost array, labels, top_n) applies
+        # on every shard; evaluator types without reset() score into
+        # `merged` directly (no merge demonstration, same result)
+        can_clone = hasattr(merged, "reset")
+        for xs, ys in zip(np.array_split(np.asarray(x), n),
+                          np.array_split(np.asarray(y), n)):
+            if can_clone:
+                shard_ev = copy.deepcopy(merged)
+                shard_ev.reset()
+                trainer.evaluate(xs, ys, batch_size=batch_size,
+                                 evaluation=shard_ev)
+                merged.merge(shard_ev)
+            else:
+                trainer.evaluate(xs, ys, batch_size=batch_size,
+                                 evaluation=merged)
+        return merged
+
     # -------------------------------------------------- fault tolerance
     # The reference's fault story is Spark re-running failed executors;
     # the TPU-era equivalent is checkpoint/restore (preempted TPU jobs

@@ -333,3 +333,44 @@ class TestRocRegressionSerde:
         for c in range(3):
             assert rt.correlation_r2(c) == pytest.approx(
                 full.correlation_r2(c))
+
+
+class TestBinaryCalibrationSerde:
+    def test_binary_merge_and_round_trip(self):
+        from deeplearning4j_tpu.eval.binary import EvaluationBinary
+        rng = np.random.default_rng(0)
+        y = (rng.random((40, 3)) > 0.5).astype(np.float64)
+        p = rng.random((40, 3))
+        a, b, full = (EvaluationBinary() for _ in range(3))
+        a.eval(y[:20], p[:20])
+        b.eval(y[20:], p[20:])
+        a.merge(b)
+        full.eval(y, p)
+        for c in range(3):
+            assert a.f1(c) == pytest.approx(full.f1(c))
+        rt = EvaluationBinary.from_json(full.to_json())
+        for c in range(3):
+            assert rt.precision(c) == pytest.approx(full.precision(c))
+        other = EvaluationBinary(threshold=0.7)
+        other.eval(y, p)
+        with pytest.raises(ValueError, match="threshold"):
+            full.merge(other)
+
+    def test_calibration_merge_and_round_trip(self):
+        from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+        rng = np.random.default_rng(1)
+        y = np.eye(3)[rng.integers(0, 3, 40)]
+        p = rng.random((40, 3))
+        a, b, full = (EvaluationCalibration() for _ in range(3))
+        a.eval(y[:20], p[:20])
+        b.eval(y[20:], p[20:])
+        a.merge(b)
+        full.eval(y, p)
+        np.testing.assert_array_equal(a._bin_counts, full._bin_counts)
+        np.testing.assert_array_equal(a._residual_hist, full._residual_hist)
+        rt = EvaluationCalibration.from_json(full.to_json())
+        np.testing.assert_array_equal(rt._bin_counts, full._bin_counts)
+        np.testing.assert_array_equal(rt._residual_hist, full._residual_hist)
+        rt.eval(y, p)  # round-tripped object must keep accumulating
+        with pytest.raises(ValueError, match="different bins"):
+            EvaluationCalibration(reliability_bins=5).merge(full)

@@ -305,3 +305,70 @@ def test_shared_master_fused_steps():
     assert net.epoch_count == 2
     for v in net.param_table().values():
         assert np.all(np.isfinite(np.asarray(v)))
+
+
+class TestMasterEvaluate:
+    """Distributed evaluation through the masters (reference: Spark
+    eval functions + treeAggregate): per-shard Evaluations combined
+    with Evaluation.merge must equal a single-pass host evaluation."""
+
+    def _net(self):
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=5, n_out=12, activation="relu"))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_master_evaluate_matches_host(self):
+        import numpy as np
+        from deeplearning4j_tpu.eval import Evaluation
+        from deeplearning4j_tpu.parallel.master import (
+            ParameterAveragingTrainingMaster, SharedTrainingMaster,
+        )
+        net = self._net()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((96, 5)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 96)]
+        host = Evaluation()
+        host.eval(y, np.asarray(net.output(x)))
+        for master in (ParameterAveragingTrainingMaster(),
+                       SharedTrainingMaster()):
+            ev = master.execute_evaluation(net, (x, y), batch_size=16)
+            assert ev.total == 96
+            np.testing.assert_array_equal(ev.confusion.matrix,
+                                          host.confusion.matrix)
+
+    def test_master_evaluate_preserves_evaluation_config(self):
+        """Caller-supplied evaluation settings (decision threshold) must
+        apply on every shard, not just in the merged container."""
+        import numpy as np
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.eval import Evaluation
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.master import SharedTrainingMaster
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=5, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((64, 5)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+        thr = 0.9
+        host = Evaluation(binary_decision_threshold=thr)
+        host.eval(y, np.asarray(net.output(x)))
+        ev = SharedTrainingMaster().execute_evaluation(
+            net, (x, y), batch_size=16,
+            evaluation=Evaluation(binary_decision_threshold=thr))
+        np.testing.assert_array_equal(ev.confusion.matrix,
+                                      host.confusion.matrix)
