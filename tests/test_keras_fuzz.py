@@ -1,0 +1,83 @@
+"""Property-style fuzz: RANDOM small models written by the real keras
+package must import with output parity. Complements the fixed golden
+fixtures (`test_keras_real_golden.py`) by covering layer COMBINATIONS
+none of the hand-picked fixtures hit — each seed builds a different
+stack of conv/pool/norm/dense/recurrent layers.
+
+Needs the keras pip package (skipped where absent). Seeds beyond the
+default three: DL4J_KERAS_FUZZ_SEEDS=n.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+from keras import layers  # noqa: E402
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport  # noqa: E402
+
+N_SEEDS = int(os.environ.get("DL4J_KERAS_FUZZ_SEEDS", "3"))
+
+
+def _random_cnn(rng):
+    """Random conv stack: conv/pool/bn blocks ending in dense softmax."""
+    mdl = [keras.Input(shape=(12, 12, 2))]
+    n_blocks = rng.integers(1, 3)
+    for b in range(n_blocks):
+        filters = int(rng.choice([3, 4, 6]))
+        ksz = int(rng.choice([1, 3]))
+        pad = str(rng.choice(["same", "valid"]))
+        mdl.append(layers.Conv2D(filters, ksz, padding=pad,
+                                 activation=str(rng.choice(
+                                     ["relu", "tanh", "linear"])),
+                                 use_bias=bool(rng.integers(0, 2)),
+                                 name=f"conv{b}"))
+        if rng.integers(0, 2):
+            mdl.append(layers.BatchNormalization(name=f"bn{b}"))
+        if rng.integers(0, 2):
+            pool = (layers.MaxPooling2D if rng.integers(0, 2)
+                    else layers.AveragePooling2D)
+            mdl.append(pool(2, name=f"pool{b}"))
+    mdl.append(layers.Flatten(name="flatten"))
+    if rng.integers(0, 2):
+        mdl.append(layers.Dense(int(rng.choice([5, 8])), activation="relu",
+                                name="hidden"))
+    mdl.append(layers.Dense(3, activation="softmax", name="out"))
+    x = rng.standard_normal((2, 12, 12, 2)).astype(np.float32)
+    return keras.Sequential(mdl, name="fuzz_cnn"), x
+
+
+def _random_rnn(rng):
+    T, F = int(rng.choice([3, 5])), int(rng.choice([2, 4]))
+    mdl = [keras.Input(shape=(T, F))]
+    cls = layers.LSTM if rng.integers(0, 2) else layers.SimpleRNN
+    units = int(rng.choice([4, 6]))
+    return_seq = bool(rng.integers(0, 2))
+    mdl.append(cls(units, return_sequences=return_seq, name="rnn"))
+    if return_seq:
+        mdl.append(layers.LSTM(3, name="rnn2"))
+    mdl.append(layers.Dense(2, activation="softmax", name="out"))
+    x = rng.standard_normal((2, T, F)).astype(np.float32)
+    return keras.Sequential(mdl, name="fuzz_rnn"), x
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+@pytest.mark.parametrize("family", ["cnn", "rnn"])
+def test_random_keras_model_round_trips(tmp_path, seed, family):
+    salt = 1000 * seed + (0 if family == "cnn" else 1)
+    rng = np.random.default_rng(salt)
+    # seed Keras's global RNG too — otherwise layer WEIGHTS differ on
+    # re-run and a near-tolerance failure becomes an unreproducible flake
+    keras.utils.set_random_seed(salt)
+    model, x = (_random_cnn if family == "cnn" else _random_rnn)(rng)
+    want = model.predict(x, verbose=0)
+    path = tmp_path / f"fuzz_{family}_{seed}.h5"
+    model.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(str(path))
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5,
+                               err_msg=f"seed={seed} family={family} "
+                                       f"layers={[l.name for l in model.layers]}")
