@@ -863,7 +863,7 @@ def _scaling_child():
     print(json.dumps({"metric": "dataparallel_scaling_cpu8", **out}))
 
 
-def _probe_tunnel_subprocess(timeout_s=60) -> bool:
+def _probe_tunnel_subprocess(timeout_s=120) -> bool:
     """One tunnel-health probe in a FRESH interpreter. A retry must use
     a subprocess: once this process's backend init hangs on a dead
     tunnel, every later jax call in the same process waits on the same

@@ -98,6 +98,19 @@ class ShardedParallelTrainer:
             self._sharding, self.param_specs,
             is_leaf=lambda x: isinstance(x, P))
 
+    def _build_shardings(self):
+        if getattr(self, "_psh", None) is not None:
+            return
+        psh = self._param_shardings()
+        # updater state mirrors the param tree one level down (per-param
+        # dicts of updater slots) — replicate lookup by param name
+        ush = {lk: {pn: jax.tree_util.tree_map(lambda _: psh[lk][pn], slots)
+                    for pn, slots in lupd.items()}
+               for lk, lupd in self.model.updater_state.items()}
+        self._psh, self._ush = psh, ush
+        self._repl = self._sharding(P())
+        self._bsh = self._sharding(P(self.data_axis))
+
     def _build(self):
         model = self.model
         raw_step = model._make_train_step(tbptt=False)
@@ -105,20 +118,59 @@ class ShardedParallelTrainer:
         def step(params, upd, state, it, x, y, rng):
             return raw_step(params, upd, state, it, x, y, rng, None, None, None)
 
-        psh = self._param_shardings()
-        # updater state mirrors the param tree one level down (per-param
-        # dicts of updater slots) — replicate lookup by param name
-        ush = {lk: {pn: jax.tree_util.tree_map(lambda _: psh[lk][pn], slots)
-                    for pn, slots in lupd.items()}
-               for lk, lupd in model.updater_state.items()}
-        repl = self._sharding(P())
-        bsh = self._sharding(P(self.data_axis))
+        self._build_shardings()
         self._step = jax.jit(
             step,
-            in_shardings=(psh, ush, repl, None, bsh, bsh, None),
-            out_shardings=(psh, ush, repl, None, None),
+            in_shardings=(self._psh, self._ush, self._repl, None,
+                          self._bsh, self._bsh, None),
+            out_shardings=(self._psh, self._ush, self._repl, None, None),
             donate_argnums=(0, 1, 2))
-        self._psh, self._ush, self._repl, self._bsh = psh, ush, repl, bsh
+
+    def evaluate(self, data, labels=None, *, batch_size: int = 32,
+                 evaluation=None):
+        """Evaluation with the SAME shardings training uses: params stay
+        TP-sharded over `model_axis`, the batch shards over `data_axis`,
+        XLA inserts the activation collectives. Ragged tails are scored
+        on the host replica so no example is skipped (mirrors
+        `ParallelTrainer.evaluate`)."""
+        from deeplearning4j_tpu.eval import Evaluation
+        from deeplearning4j_tpu.parallel.placement import gput, gput_tree
+        from deeplearning4j_tpu.parallel.trainer import _mesh_evaluate
+
+        model = self.model
+        self._build_shardings()
+        if not hasattr(model, "_forward_core"):
+            # ComputationGraph support here would need multi-input
+            # feature packing and per-output evaluators — score those
+            # per-output on the host or extend this when needed
+            if (len(model.conf.network_inputs) != 1
+                    or len(model.conf.network_outputs) != 1):
+                raise NotImplementedError(
+                    "ShardedParallelTrainer.evaluate supports single-"
+                    "input single-output graphs; evaluate multi-io "
+                    "graphs on the host via model.evaluate()")
+        if getattr(self, "_eval_forward", None) is None:
+            if hasattr(model, "_forward_core"):  # MultiLayerNetwork
+                def fwd(params, state, x):
+                    h, _, _, _, _ = model._forward_core(
+                        params, state, x, train=False, rng=None)
+                    return h
+            else:  # single-in/out ComputationGraph
+                def fwd(params, state, x):
+                    acts, _, _, _ = model._forward_all(
+                        params, state, [x], train=False, rng=None)
+                    return acts[model.conf.network_outputs[0]]
+            self._eval_forward = jax.jit(
+                fwd, in_shardings=(self._psh, self._repl, self._bsh),
+                out_shardings=self._bsh)
+        params = gput_tree(model.params, self._psh)
+        state = gput_tree(model.net_state, self._repl)
+        iterator = as_iterator(data, labels, batch_size=batch_size)
+        merged = evaluation if evaluation is not None else Evaluation()
+        return _mesh_evaluate(
+            model, iterator, merged, int(self.mesh.shape[self.data_axis]),
+            lambda x: self._eval_forward(params, state, x),
+            lambda f: gput(f, self._bsh))
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32):
         from deeplearning4j_tpu.parallel.placement import (

@@ -46,6 +46,32 @@ from deeplearning4j_tpu.parallel.placement import (  # noqa: E402
 )
 
 
+def _mesh_evaluate(model, iterator, merged, n_div, forward, put_x):
+    """Shared mesh-evaluation loop (ParallelTrainer and
+    ShardedParallelTrainer): device-shard every divisible batch through
+    `forward`, score ragged tails on the host replica so no example is
+    skipped, accumulate into `merged`.
+
+    Multi-process execution is rejected up front: the host-side
+    `np.asarray` readback needs fully-addressable arrays. The
+    multi-process recipe is per-process evaluation + `merge()` of the
+    per-process evaluators (they all serialize via to_json for the
+    transport)."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "mesh evaluate() reads results back to one host and needs "
+            "fully-addressable arrays; under multi-process execution run "
+            "evaluate() per process on its data shard and combine with "
+            "Evaluation.merge (all evaluators serialize via to_json)")
+    for ds in iterator:
+        if ds.num_examples() % n_div != 0:
+            merged.eval(ds.labels, np.asarray(model.output(ds.features)))
+            continue
+        out = np.asarray(forward(put_x(ds.features)))
+        merged.eval(np.asarray(ds.labels), out)
+    return merged
+
+
 class ParallelTrainer:
     def __init__(self, model, mesh: Optional[Mesh] = None, *,
                  mode: str = "sync", averaging_frequency: int = 5,
@@ -293,20 +319,13 @@ class ParallelTrainer:
                 out_shardings=batch_sh)
 
         merged = evaluation if evaluation is not None else Evaluation()
-        n = self.n_workers
-        for ds in iterator:
-            if ds.num_examples() % n != 0:
-                # evaluation must not silently skip examples: ragged
-                # tails are scored on the host replica instead
-                merged.eval(ds.labels, np.asarray(model.output(ds.features)))
-                continue
-            x = _gput(ds.features, batch_sh)
-            out = np.asarray(self._eval_forward(params, state, x))
-            # accumulating into `merged` directly keeps its top_n /
-            # labels / threshold settings; `Evaluation.merge` remains
-            # the cross-process combiner (masters / multihost)
-            merged.eval(ds.labels, out)
-        return merged
+        # accumulating into `merged` directly keeps its top_n / labels /
+        # threshold settings; `Evaluation.merge` remains the
+        # cross-process combiner (masters / multihost)
+        return _mesh_evaluate(
+            model, iterator, merged, self.n_workers,
+            lambda x: self._eval_forward(params, state, x),
+            lambda f: _gput(f, batch_sh))
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,

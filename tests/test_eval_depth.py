@@ -374,3 +374,33 @@ class TestBinaryCalibrationSerde:
         rt.eval(y, p)  # round-tripped object must keep accumulating
         with pytest.raises(ValueError, match="different bins"):
             EvaluationCalibration(reliability_bins=5).merge(full)
+
+
+class TestMeshEvaluateRegression:
+    def test_parallel_trainer_evaluate_accepts_regression_evaluator(self):
+        """evaluate() is evaluator-generic: passing a
+        RegressionEvaluation scores regression outputs over the mesh."""
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=6, n_out=12, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="identity",
+                                   loss="mse"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((64, 6)).astype(np.float32)
+        y = rng.standard_normal((64, 2)).astype(np.float32)
+        ev = ParallelTrainer(net).evaluate(
+            x, y, batch_size=16, evaluation=RegressionEvaluation())
+        host = RegressionEvaluation()
+        host.eval(y, np.asarray(net.output(x)))
+        for c in range(2):
+            assert ev.mean_squared_error(c) == pytest.approx(
+                host.mean_squared_error(c), rel=1e-6)

@@ -396,3 +396,39 @@ class TestSequenceParallelGradients:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-4, atol=5e-5,
                                        err_msg=f"d{name} diverged ({sp})")
+
+
+class TestShardedTrainerEvaluate:
+    @requires_8dev
+    def test_tp_sharded_evaluate_matches_host(self):
+        """evaluate() under DP x TP shardings must equal a host eval —
+        the activation collectives change nothing numerically."""
+        import numpy as np
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.eval import Evaluation
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel import (
+            MeshSpec, ShardedParallelTrainer, make_mesh,
+        )
+
+        mesh = make_mesh(MeshSpec.of(data=4, model=2))
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+                .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((67, 8)).astype(np.float32)  # ragged tail
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 67)]
+        host = Evaluation()
+        host.eval(y, np.asarray(net.output(x)))
+        ev = ShardedParallelTrainer(net, mesh).evaluate(x, y, batch_size=16)
+        assert ev.total == 67
+        np.testing.assert_array_equal(ev.confusion.matrix,
+                                      host.confusion.matrix)
