@@ -130,13 +130,17 @@ class ShardedParallelTrainer:
                  evaluation=None):
         """Evaluation with the SAME shardings training uses: params stay
         TP-sharded over `model_axis`, the batch shards over `data_axis`,
-        XLA inserts the activation collectives. Ragged tails are scored
-        on the host replica so no example is skipped (mirrors
-        `ParallelTrainer.evaluate`)."""
+        XLA inserts the activation collectives. Ragged tails are zero-
+        padded to the data-axis multiple and sliced after the forward —
+        the model never materializes on one device (it may not fit)."""
         from deeplearning4j_tpu.eval import Evaluation
         from deeplearning4j_tpu.parallel.placement import gput, gput_tree
-        from deeplearning4j_tpu.parallel.trainer import _mesh_evaluate
+        from deeplearning4j_tpu.parallel.trainer import (
+            _mesh_evaluate,
+            _require_single_process,
+        )
 
+        _require_single_process("ShardedParallelTrainer.evaluate()")
         model = self.model
         self._build_shardings()
         if not hasattr(model, "_forward_core"):

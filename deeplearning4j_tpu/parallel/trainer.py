@@ -46,28 +46,35 @@ from deeplearning4j_tpu.parallel.placement import (  # noqa: E402
 )
 
 
-def _mesh_evaluate(model, iterator, merged, n_div, forward, put_x):
-    """Shared mesh-evaluation loop (ParallelTrainer and
-    ShardedParallelTrainer): device-shard every divisible batch through
-    `forward`, score ragged tails on the host replica so no example is
-    skipped, accumulate into `merged`.
-
-    Multi-process execution is rejected up front: the host-side
-    `np.asarray` readback needs fully-addressable arrays. The
-    multi-process recipe is per-process evaluation + `merge()` of the
-    per-process evaluators (they all serialize via to_json for the
-    transport)."""
+def _require_single_process(what="mesh evaluate()"):
+    """The host-side `np.asarray` readback needs fully-addressable
+    arrays. Called FIRST so multi-process callers fail before any
+    compile or device transfer is paid."""
     if jax.process_count() > 1:
         raise NotImplementedError(
-            "mesh evaluate() reads results back to one host and needs "
-            "fully-addressable arrays; under multi-process execution run "
-            "evaluate() per process on its data shard and combine with "
-            "Evaluation.merge (all evaluators serialize via to_json)")
+            f"{what} reads results back to one host and needs fully-"
+            f"addressable arrays; under multi-process execution score "
+            f"each process's local data shard on the host "
+            f"(evaluator.eval(y, model.output(x)) per process) and "
+            f"combine the evaluators with merge() — they all serialize "
+            f"via to_json for the transport")
+
+
+def _mesh_evaluate(model, iterator, merged, n_div, forward, put_x):
+    """Shared mesh-evaluation loop (ParallelTrainer and
+    ShardedParallelTrainer): every batch runs through the SHARDED
+    forward; ragged tails are zero-padded up to the data-axis multiple
+    and the padded rows sliced off before scoring — no example is
+    skipped and no full-model host replica is ever materialized (a
+    TP-sharded model may not even fit on one device)."""
     for ds in iterator:
-        if ds.num_examples() % n_div != 0:
-            merged.eval(ds.labels, np.asarray(model.output(ds.features)))
-            continue
-        out = np.asarray(forward(put_x(ds.features)))
+        n = ds.num_examples()
+        x = np.asarray(ds.features)
+        if n % n_div != 0:
+            pad = n_div - n % n_div
+            x = np.concatenate(
+                [x, np.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
+        out = np.asarray(forward(put_x(x)))[:n]
         merged.eval(np.asarray(ds.labels), out)
     return merged
 
@@ -300,6 +307,7 @@ class ParallelTrainer:
         compute scales with the mesh."""
         from deeplearning4j_tpu.eval import Evaluation
 
+        _require_single_process()
         model = self.model
         if not model._initialized:
             model.init()
