@@ -23,6 +23,34 @@ class PretrainedType(str, Enum):
     MNIST = "mnist"
     CIFAR10 = "cifar10"
     VGGFACE = "vggface"
+    TEXT = "text"  # beyond reference: packaged char-LM weights
+
+
+def packaged_weight(name: str):
+    """(file URI, sha256) for an artifact shipped in zoo/weights/, or
+    (None, None) when absent. MANIFEST.json maps filename → metadata.
+    A weights file WITHOUT a manifest entry is treated as not packaged
+    — returning its URI with no checksum would make init_pretrained
+    silently skip integrity verification."""
+    entry = packaged_weight_entry(name)
+    if entry is None or not entry.get("sha256"):
+        return None, None
+    return (Path(__file__).parent / "weights" / name).as_uri(), entry["sha256"]
+
+
+def packaged_weight_entry(name: str) -> Optional[dict]:
+    """Manifest metadata for a packaged artifact (None when the file or
+    its manifest entry is missing)."""
+    import json
+
+    wdir = Path(__file__).parent / "weights"
+    f, mf = wdir / name, wdir / "MANIFEST.json"
+    if not (f.exists() and mf.exists()):
+        return None
+    manifest = json.loads(mf.read_text())
+    if "file" in manifest:  # round-4 single-entry layout
+        manifest = {manifest["file"]: manifest}
+    return manifest.get(name)
 
 
 class ZooModel:

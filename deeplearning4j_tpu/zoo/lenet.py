@@ -4,9 +4,6 @@ softmax output. BASELINE config 0 model."""
 
 from __future__ import annotations
 
-import json
-from pathlib import Path
-
 from deeplearning4j_tpu.common.updaters import Adam
 from deeplearning4j_tpu.common.weights import WeightInit
 from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
@@ -55,17 +52,13 @@ class LeNet(ZooModel):
     # `init_pretrained(MNIST)` works offline end-to-end (reference
     # `ZooModel.initPretrained` downloads from a blob host :52-81).
     def pretrained_url(self, ptype):
-        from deeplearning4j_tpu.zoo.base import PretrainedType
+        from deeplearning4j_tpu.zoo.base import PretrainedType, packaged_weight
         if ptype == PretrainedType.MNIST:
-            w = Path(__file__).parent / "weights" / "lenet_mnist.zip"
-            if w.exists():
-                return w.as_uri()
+            return packaged_weight("lenet_mnist.zip")[0]
         return None
 
     def pretrained_checksum(self, ptype):
-        from deeplearning4j_tpu.zoo.base import PretrainedType
+        from deeplearning4j_tpu.zoo.base import PretrainedType, packaged_weight
         if ptype == PretrainedType.MNIST:
-            mf = Path(__file__).parent / "weights" / "MANIFEST.json"
-            if mf.exists():
-                return json.loads(mf.read_text())["sha256"]
+            return packaged_weight("lenet_mnist.zip")[1]
         return None

@@ -38,3 +38,26 @@ class TextGenerationLSTM(ZooModel):
 
     def init(self) -> MultiLayerNetwork:
         return MultiLayerNetwork(self.conf()).init(self.seed)
+
+    # Packaged pretrained checkpoint: char-LM trained on this repo's own
+    # documentation (provenance + charset in zoo/weights/MANIFEST.json).
+    def pretrained_url(self, ptype):
+        from deeplearning4j_tpu.zoo.base import PretrainedType, packaged_weight
+        if ptype == PretrainedType.TEXT:
+            return packaged_weight("textgen_docs.zip")[0]
+        return None
+
+    def pretrained_checksum(self, ptype):
+        from deeplearning4j_tpu.zoo.base import PretrainedType, packaged_weight
+        if ptype == PretrainedType.TEXT:
+            return packaged_weight("textgen_docs.zip")[1]
+        return None
+
+    @staticmethod
+    def pretrained_charset():
+        """Charset the packaged TEXT checkpoint was trained with (index
+        VOCAB-1 is the unknown slot); None when no packaged artifact."""
+        from deeplearning4j_tpu.zoo.base import packaged_weight_entry
+
+        entry = packaged_weight_entry("textgen_docs.zip")
+        return None if entry is None else entry.get("charset")

@@ -1,0 +1,114 @@
+"""Produce the packaged TextGenerationLSTM pretrained checkpoint.
+
+Trains the zoo char-RNN on this repository's own documentation (real
+English prose, fully reproducible from the repo — no download), and
+writes a ModelSerializer zip + charset manifest into
+`deeplearning4j_tpu/zoo/weights/` for `TextGenerationLSTM.
+init_pretrained(PretrainedType.TEXT)` (reference
+`ZooModel.initPretrained` :52-81; the reference hosted its char-RNN
+weights the same way).
+
+    python tests/make_textgen_pretrained.py
+"""
+
+import hashlib
+import json
+import os
+import sys
+from collections import Counter
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parents[1]))
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+REPO = Path(__file__).parents[1]
+WEIGHTS_DIR = REPO / "deeplearning4j_tpu" / "zoo" / "weights"
+VOCAB, T = 77, 100
+
+
+def load_corpus():
+    parts = []
+    for p in [REPO / "README.md", *sorted((REPO / "docs").glob("*.md")),
+              REPO / "SURVEY.md"]:
+        parts.append(p.read_text(errors="ignore"))
+    return "\n".join(parts)
+
+
+def build_charset(text):
+    # top VOCAB-1 characters by frequency; everything else maps to the
+    # final "unknown" slot
+    common = [c for c, _ in Counter(text).most_common(VOCAB - 1)]
+    return "".join(sorted(common))
+
+
+def encode(text, charset):
+    idx = {c: i for i, c in enumerate(charset)}
+    return np.array([idx.get(c, VOCAB - 1) for c in text], np.int32)
+
+
+def windows(ids):
+    n = (len(ids) - 1) // T
+    x = ids[:n * T].reshape(n, T)
+    y = ids[1:n * T + 1].reshape(n, T)
+    eye = np.eye(VOCAB, dtype=np.float32)
+    return eye[x], eye[y]
+
+
+def main():
+    from deeplearning4j_tpu.util.serializer import ModelSerializer
+    from deeplearning4j_tpu.zoo.textgenlstm import TextGenerationLSTM
+
+    text = load_corpus()
+    charset = build_charset(text)
+    ids = encode(text, charset)
+    x, y = windows(ids)
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(x))
+    x, y = x[order], y[order]
+    n_test = max(len(x) // 10, 8)
+    xtr, ytr, xte, yte = x[n_test:], y[n_test:], x[:n_test], y[:n_test]
+    print(f"corpus {len(text)} chars → {len(x)} windows of {T}")
+
+    model = TextGenerationLSTM(vocab_size=VOCAB, hidden=128, tbptt_length=T)
+    net = model.init()
+    for epoch in range(30):
+        net.fit(xtr, ytr, epochs=1, batch_size=32, steps_per_execution=4)
+        out = np.asarray(net.output(xte))
+        acc = float(np.mean(out.argmax(-1) == yte.argmax(-1)))
+        print(f"epoch {epoch}: held-out next-char accuracy {acc:.4f}")
+        if acc > 0.45:
+            break
+    assert acc > 0.40, "char model too weak to ship"
+
+    WEIGHTS_DIR.mkdir(parents=True, exist_ok=True)
+    dest = WEIGHTS_DIR / "textgen_docs.zip"
+    ModelSerializer.write_model(net, dest, save_updater=False)
+    checksum = hashlib.sha256(dest.read_bytes()).hexdigest()
+    manifest_path = WEIGHTS_DIR / "MANIFEST.json"
+    manifest = json.loads(manifest_path.read_text()) \
+        if manifest_path.exists() else {}
+    if "file" in manifest:  # migrate the round-4 single-entry layout
+        manifest = {"lenet_mnist.zip": manifest}
+    manifest["textgen_docs.zip"] = {
+        "sha256": checksum,
+        "holdout_next_char_accuracy": round(acc, 4),
+        "charset": charset,
+        "train_corpus": "this repository's README/docs/SURVEY markdown "
+                        f"({len(text)} chars)",
+        "generator": "tests/make_textgen_pretrained.py",
+    }
+    manifest_path.write_text(json.dumps(manifest, indent=2))
+    print(json.dumps({k: v for k, v in manifest["textgen_docs.zip"].items()
+                      if k != "charset"}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -69,16 +69,22 @@ def main():
     dest = WEIGHTS_DIR / "lenet_mnist.zip"
     ModelSerializer.write_model(net, dest, save_updater=False)
     checksum = hashlib.sha256(dest.read_bytes()).hexdigest()
-    manifest = {
-        "file": dest.name,
+    # merge into the filename-keyed manifest — a wholesale overwrite
+    # would clobber the other packaged artifacts' entries
+    manifest_path = WEIGHTS_DIR / "MANIFEST.json"
+    manifest = (json.loads(manifest_path.read_text())
+                if manifest_path.exists() else {})
+    if "file" in manifest:  # migrate the old single-entry layout
+        manifest = {manifest["file"]: manifest}
+    manifest["lenet_mnist.zip"] = {
         "sha256": checksum,
         "holdout_accuracy": round(float(acc), 4),
         "train_corpus": "sklearn load_digits (1797 real 8x8 digits) "
                         "upscaled bilinear to 28x28",
         "generator": "tests/make_zoo_pretrained.py",
     }
-    (WEIGHTS_DIR / "MANIFEST.json").write_text(json.dumps(manifest, indent=2))
-    print(json.dumps(manifest, indent=2))
+    manifest_path.write_text(json.dumps(manifest, indent=2))
+    print(json.dumps(manifest["lenet_mnist.zip"], indent=2))
 
 
 if __name__ == "__main__":

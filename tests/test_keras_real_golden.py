@@ -129,3 +129,40 @@ def test_real_weights_only_by_name(preds):
     KerasModelImport.load_weights_into(net, str(FIXDIR / "real_cnn.weights.h5"))
     got = np.asarray(net.output(preds["cnn_x"]))
     np.testing.assert_allclose(got, preds["cnn_y"], rtol=1e-4, atol=1e-5)
+
+
+def test_textgen_packaged_pretrained():
+    """TextGenerationLSTM's packaged char-LM (trained on this repo's
+    README/docs/SURVEY): init_pretrained(TEXT) must restore a model
+    that predicts GENUINELY held-out prose (BASELINE.md — not in the
+    training corpus) far above the 1/77 chance rate, and generates
+    chars autoregressively via rnn_time_step."""
+    from deeplearning4j_tpu.zoo.base import PretrainedType
+    from deeplearning4j_tpu.zoo.textgenlstm import TextGenerationLSTM
+
+    wdir = Path(__file__).parents[1] / "deeplearning4j_tpu/zoo/weights"
+    if not (wdir / "textgen_docs.zip").exists():
+        pytest.skip("textgen pretrained artifact not built")
+    net = TextGenerationLSTM().init_pretrained(PretrainedType.TEXT)
+    charset = TextGenerationLSTM.pretrained_charset()
+    V = len(charset) + 1
+    text = (Path(__file__).parents[1] / "BASELINE.md").read_text()
+    idx = {c: i for i, c in enumerate(charset)}
+    ids = np.array([idx.get(c, V - 1) for c in text[:1201]], np.int64)
+    eye = np.eye(V, dtype=np.float32)
+    x = eye[ids[:1200]].reshape(4, 300, V)
+    y_ids = ids[1:1201].reshape(4, 300)
+    out = np.asarray(net.output(x))
+    acc = float(np.mean(out.argmax(-1) == y_ids))
+    assert acc > 0.30, f"next-char accuracy {acc} barely beats chance"
+    # autoregressive sampling drives the rnn_time_step path
+    net.rnn_clear_previous_state()
+    step = eye[ids[:1]][None]          # [1, 1, V]
+    sampled = []
+    for _ in range(30):
+        probs = np.asarray(net.rnn_time_step(step))[0, -1]
+        nxt = int(probs.argmax())
+        sampled.append(nxt)
+        step = eye[[nxt]][None]
+    assert all(0 <= s < V for s in sampled)
+    assert len(set(sampled)) > 3, "degenerate sampler output"

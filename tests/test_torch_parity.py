@@ -262,3 +262,73 @@ class TestMoreLayerParity:
         want, _ = mha(xt, xt, xt, need_weights=False)
         np.testing.assert_allclose(np.asarray(got), want.detach().numpy(),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestRecurrentParityMore:
+    def test_graves_bilstm_matches_two_torch_lstms(self):
+        """Peephole weights init to ZERO, so each direction reduces to a
+        standard LSTM: fwd torch LSTM + reversed torch LSTM, outputs
+        summed (the reference's activateOutput combination)."""
+        from deeplearning4j_tpu.nn.layers import GravesBidirectionalLSTM
+        rng = np.random.default_rng(9)
+        F, U, T, B = 3, 4, 5, 2
+        layer = GravesBidirectionalLSTM(n_out=U, activation="tanh",
+                                        gate_activation="sigmoid")
+        params, state = _init(layer, F)
+
+        def mk(suffix):
+            W = rng.standard_normal((F, 4 * U)).astype(np.float32) * 0.4
+            R = rng.standard_normal((U, 4 * U)).astype(np.float32) * 0.4
+            b = rng.standard_normal(4 * U).astype(np.float32) * 0.1
+            return {f"W{suffix}": W, f"RW{suffix}": R, f"b{suffix}": b}
+
+        pf, pb = mk("F"), mk("B")
+        params = {**params, **pf, **pb}
+        x = rng.standard_normal((B, T, F)).astype(np.float32)
+        got, _ = layer.forward(params, state, x)
+
+        def ifog_to_ifgo(a, axis):
+            i, f, o, g = np.split(a, 4, axis=axis)
+            return np.concatenate([i, f, g, o], axis=axis)
+
+        def torch_dir(p, suffix, reverse):
+            lstm = torch.nn.LSTM(F, U, batch_first=True)
+            with torch.no_grad():
+                lstm.weight_ih_l0.copy_(torch.from_numpy(
+                    ifog_to_ifgo(p[f"W{suffix}"], 1).T))
+                lstm.weight_hh_l0.copy_(torch.from_numpy(
+                    ifog_to_ifgo(p[f"RW{suffix}"], 1).T))
+                lstm.bias_ih_l0.copy_(torch.from_numpy(
+                    ifog_to_ifgo(p[f"b{suffix}"], 0)))
+                lstm.bias_hh_l0.zero_()
+            xt = torch.from_numpy(x[:, ::-1].copy() if reverse else x)
+            out, _ = lstm(xt)
+            out = out.detach().numpy()
+            return out[:, ::-1] if reverse else out
+
+        want = torch_dir(pf, "F", False) + torch_dir(pb, "B", True)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_conv1d_matches_torch(self):
+        from deeplearning4j_tpu.nn.layers import Convolution1DLayer
+        rng = np.random.default_rng(10)
+        cin, cout, k, T = 4, 6, 3, 9
+        layer = Convolution1DLayer(n_out=cout, kernel_size=k, stride=1,
+                                   convolution_mode=ConvolutionMode.TRUNCATE,
+                                   activation="identity")
+        params, state = _init(layer, cin)
+        w = rng.standard_normal((k, 1, cin, cout)).astype(np.float32) * 0.3
+        b = rng.standard_normal(cout).astype(np.float32) * 0.1
+        params = {**params, "W": w, "b": b}
+        x = rng.standard_normal((2, T, cin)).astype(np.float32)
+        got, _ = layer.forward(params, state, x)
+        tconv = torch.nn.Conv1d(cin, cout, k)
+        with torch.no_grad():
+            tconv.weight.copy_(torch.from_numpy(
+                w[:, 0].transpose(2, 1, 0)))        # kIC→OIk
+            tconv.bias.copy_(torch.from_numpy(b))
+        want = tconv(torch.from_numpy(x.transpose(0, 2, 1))
+                     ).detach().numpy().transpose(0, 2, 1)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-5)
