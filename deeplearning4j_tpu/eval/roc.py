@@ -113,33 +113,83 @@ class ROC:
     # ---- serde + merge (exact mode stores raw scores, so serialization
     # carries them — the reference's exact-mode ROC does the same via
     # its stored prediction arrays)
-    def to_json(self) -> str:
-        import json
+    def to_dict(self) -> dict:
         labels, probs = (self._collect() if self._labels
                          else (np.zeros(0), np.zeros(0)))
-        return json.dumps({"format_version": 1, "type": "ROC",
-                           "threshold_steps": self.threshold_steps,
-                           "labels": labels.tolist(),
-                           "probs": probs.tolist()})
+        return {"format_version": 1, "type": "ROC",
+                "threshold_steps": self.threshold_steps,
+                "labels": labels.tolist(), "probs": probs.tolist()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ROC":
+        if d.get("type") != "ROC":
+            raise ValueError(f"Not a ROC payload: {d.get('type')}")
+        roc = cls(threshold_steps=d.get("threshold_steps", 0))
+        if d.get("labels"):
+            roc._labels.append(np.asarray(d["labels"], np.float64))
+            roc._probs.append(np.asarray(d["probs"], np.float64))
+        return roc
+
+    def to_json(self) -> str:
+        import json
+        return json.dumps(self.to_dict())
 
     @classmethod
     def from_json(cls, s: str) -> "ROC":
         import json
-        d = json.loads(s)
-        if d.get("type") != "ROC":
-            raise ValueError(f"Not a ROC payload: {d.get('type')}")
-        roc = cls(threshold_steps=d.get("threshold_steps", 0))
-        if d["labels"]:
-            roc._labels.append(np.asarray(d["labels"], np.float64))
-            roc._probs.append(np.asarray(d["probs"], np.float64))
-        return roc
+        return cls.from_dict(json.loads(s))
 
     def merge(self, other: "ROC") -> "ROC":
         self._labels.extend(other._labels)
         self._probs.extend(other._probs)
         return self
 
-class ROCBinary:
+
+class _ROCFamily:
+    """Per-column serde/merge shared by ROCBinary and ROCMultiClass
+    (both hold one exact-mode ROC per output column)."""
+
+    _rocs: "Optional[List[ROC]]"
+
+    def to_json(self) -> str:
+        import json
+        return json.dumps({
+            "format_version": 1, "type": type(self).__name__,
+            "columns": ([] if self._rocs is None
+                        else [r.to_dict() for r in self._rocs]),
+        })
+
+    @classmethod
+    def from_json(cls, s: str):
+        import json
+        d = json.loads(s)
+        if d.get("type") != cls.__name__:
+            raise ValueError(f"Not a {cls.__name__} payload: {d.get('type')}")
+        ev = cls()
+        cols = d.get("columns")
+        if cols is None:
+            raise ValueError(f"{cls.__name__} payload has no 'columns'")
+        if cols:
+            ev._rocs = [ROC.from_dict(c) for c in cols]
+        return ev
+
+    def merge(self, other):
+        if other._rocs is None:
+            return self
+        if self._rocs is None:
+            # clone configuration, not just counts — a default ROC()
+            # would silently drop the source's threshold_steps
+            self._rocs = [ROC(threshold_steps=r.threshold_steps)
+                          for r in other._rocs]
+        if len(self._rocs) != len(other._rocs):
+            raise ValueError("cannot merge ROC families with different "
+                             "column counts")
+        for a, b in zip(self._rocs, other._rocs):
+            a.merge(b)
+        return self
+
+
+class ROCBinary(_ROCFamily):
     """Independent binary ROC per output column (reference
     `ROCBinary.java` for multi-label sigmoid outputs)."""
 
@@ -165,7 +215,9 @@ class ROCBinary:
         return 0 if self._rocs is None else len(self._rocs)
 
 
-class ROCMultiClass:
+
+
+class ROCMultiClass(_ROCFamily):
     """One-vs-all ROC per class (reference `ROCMultiClass.java`)."""
 
     def __init__(self):
@@ -191,3 +243,5 @@ class ROCMultiClass:
 
     def calculate_average_auc(self) -> float:
         return float(np.mean([r.calculate_auc() for r in self._rocs]))
+
+

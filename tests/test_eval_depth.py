@@ -404,3 +404,30 @@ class TestMeshEvaluateRegression:
         for c in range(2):
             assert ev.mean_squared_error(c) == pytest.approx(
                 host.mean_squared_error(c), rel=1e-6)
+
+
+class TestRocFamilySerde:
+    @pytest.mark.parametrize("cls_name", ["ROCBinary", "ROCMultiClass"])
+    def test_merge_and_round_trip(self, cls_name):
+        import deeplearning4j_tpu.eval.roc as roc_mod
+        cls = getattr(roc_mod, cls_name)
+        rng = np.random.default_rng(6)
+        y = np.eye(3)[rng.integers(0, 3, 60)]
+        p = rng.random((60, 3))
+        a, b, full = cls(), cls(), cls()
+        a.eval(y[:30], p[:30])
+        b.eval(y[30:], p[30:])
+        a.merge(b)
+        full.eval(y, p)
+        for c in range(3):
+            assert a.calculate_auc(c) == pytest.approx(full.calculate_auc(c))
+        rt = cls.from_json(full.to_json())
+        assert rt.calculate_auc(1) == pytest.approx(full.calculate_auc(1))
+
+    def test_column_count_mismatch_rejected(self):
+        from deeplearning4j_tpu.eval.roc import ROCBinary
+        a, b = ROCBinary(), ROCBinary()
+        a.eval(np.eye(2)[[0, 1]], np.random.rand(2, 2))
+        b.eval(np.eye(3)[[0, 1]], np.random.rand(2, 3))
+        with pytest.raises(ValueError, match="column counts"):
+            a.merge(b)
