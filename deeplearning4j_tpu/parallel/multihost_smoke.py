@@ -52,8 +52,9 @@ def _build_model():
 def _run_training():
     """Global-view training on whatever global mesh exists: (a) DP sync
     (ParallelTrainer), then (b) DP x TP (ShardedParallelTrainer —
-    params sharded over "model" ACROSS processes). Returns one loss
-    trajectory covering both phases."""
+    params sharded over "model" ACROSS processes). Returns (losses
+    covering both phases, this process's local-shard Evaluation as
+    JSON — the distributed-evaluation recipe's transport payload)."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
@@ -94,7 +95,22 @@ def _run_training():
     # through instead of np.asarray-ing them (regression: resumed/
     # multi-call training under multi-process TP)
     tp_trainer.fit(x, y, epochs=1, batch_size=B)
-    return losses + [s for _, s in tp_listener.scores]
+
+    # Distributed-evaluation recipe (what the mesh evaluate() guard
+    # tells multi-process callers to do): each process scores ITS OWN
+    # data shard on the host, the evaluators travel as JSON, and the
+    # collector merges them. Here the "transport" is this process's
+    # stdout; run_smoke merges and compares against the single-process
+    # full-data evaluation.
+    from deeplearning4j_tpu.eval import Evaluation
+
+    pi, pc = jax.process_index(), jax.process_count()
+    # array_split boundaries: uneven B/pc must not drop the remainder
+    bounds = np.cumsum([0] + [len(a) for a in np.array_split(x, pc)])
+    shard = slice(int(bounds[pi]), int(bounds[pi + 1]))
+    local_ev = Evaluation()
+    local_ev.eval(y[shard], np.asarray(model.output(x[shard])))
+    return losses + [s for _, s in tp_listener.scores], local_ev.to_json()
 
 
 def _worker_main(coordinator: str, n: int, i: int):
@@ -106,16 +122,18 @@ def _worker_main(coordinator: str, n: int, i: int):
     initialize_multihost(coordinator, n, i)
     assert jax.process_count() == n, jax.process_count()
     assert len(jax.devices()) == n * _LOCAL_DEVICES, len(jax.devices())
-    losses = _run_training()
+    losses, eval_json = _run_training()
     print("LOSSES " + json.dumps(losses), flush=True)
+    print("EVALJSON " + eval_json, flush=True)
 
 
 def _single_main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    losses = _run_training()
+    losses, eval_json = _run_training()
     print("LOSSES " + json.dumps(losses), flush=True)
+    print("EVALJSON " + eval_json, flush=True)
 
 
 def _free_port() -> int:
@@ -141,11 +159,20 @@ def _spawn(args, n_local_devices):
             os.path.abspath(__file__)))))
 
 
-def _parse_losses(out: str):
+def _parse_tag(out: str, tag: str):
     for line in out.splitlines():
-        if line.startswith("LOSSES "):
-            return json.loads(line[len("LOSSES "):])
+        if line.startswith(tag + " "):
+            return line[len(tag) + 1:]
     return None
+
+
+def _parse_losses(out: str):
+    s = _parse_tag(out, "LOSSES")
+    return None if s is None else json.loads(s)
+
+
+def _parse_eval(out: str):
+    return _parse_tag(out, "EVALJSON")
 
 
 def run_smoke(n: int = 2, timeout: int = 420) -> dict:
@@ -162,17 +189,19 @@ def run_smoke(n: int = 2, timeout: int = 420) -> dict:
         single = _spawn(["--single"], n * _LOCAL_DEVICES)
         procs.append(single)
 
-        results = []
+        results, worker_evals = [], []
         for w in workers:
             out, err = w.communicate(timeout=timeout)
             if w.returncode != 0:
                 raise RuntimeError(
                     f"worker failed rc={w.returncode}: {err[-800:]}")
             results.append(_parse_losses(out))
+            worker_evals.append(_parse_eval(out))
         sout, serr = single.communicate(timeout=timeout)
         if single.returncode != 0:
             raise RuntimeError(f"single-proc run failed: {serr[-800:]}")
         ref = _parse_losses(sout)
+        ref_eval = _parse_eval(sout)
     finally:
         # a dead worker leaves its peer blocked at the coordinator
         # barrier forever — never leak the siblings
@@ -191,8 +220,33 @@ def run_smoke(n: int = 2, timeout: int = 420) -> dict:
                 raise RuntimeError(
                     f"worker {i} loss diverged from single-process run: "
                     f"{r} vs {ref}")
+    # merge the per-process evaluators (the documented multi-process
+    # evaluation recipe) and compare with the single-process full-data
+    # evaluation — confusion matrices must be identical
+    import numpy as np
+
+    from deeplearning4j_tpu.eval import Evaluation
+
+    if any(e is None for e in worker_evals) or ref_eval is None:
+        raise RuntimeError("missing EVALJSON output")
+    merged = Evaluation()
+    for e in worker_evals:
+        merged.merge(Evaluation.from_json(e))
+    ref_ev = Evaluation.from_json(ref_eval)
+    # the loss check above tolerates ~1e-4 cross-run drift (collective
+    # reduction order), so an argmax near-tie may flip ONE sample's
+    # predicted class between runs — require identical totals and allow
+    # at most one flipped count in the confusion matrices
+    diff = int(np.abs(merged.confusion.matrix
+                      - ref_ev.confusion.matrix).sum())
+    eval_match = merged.total == ref_ev.total and diff <= 2
+    if not eval_match:
+        raise RuntimeError(
+            f"merged distributed evaluation != single-process "
+            f"(L1 diff {diff}): {merged.confusion.matrix.tolist()} vs "
+            f"{ref_ev.confusion.matrix.tolist()}")
     return {"n_processes": n, "losses": results[0], "single_process": ref,
-            "match": True}
+            "match": True, "eval_merge_match": True}
 
 
 def main(argv=None):

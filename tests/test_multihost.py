@@ -18,3 +18,5 @@ class TestMultiProcessDistributed:
         assert report["n_processes"] == 2
         # the trajectory must show learning, not just agreement
         assert report["losses"][-1] < report["losses"][0] * 0.7
+        # per-process eval + JSON transport + merge == full-data eval
+        assert report["eval_merge_match"]
