@@ -9,7 +9,11 @@ from __future__ import annotations
 
 from typing import Optional
 
+import json
+
 import numpy as np
+
+from deeplearning4j_tpu.eval.evaluation import check_payload_type
 
 
 class EvaluationBinary:
@@ -77,7 +81,6 @@ class EvaluationBinary:
 
     # ---- serde + merge (tree-aggregate shape) ----------------------------
     def to_json(self) -> str:
-        import json
         return json.dumps({
             "format_version": 1, "type": "EvaluationBinary",
             "threshold": self.threshold,
@@ -89,10 +92,8 @@ class EvaluationBinary:
 
     @classmethod
     def from_json(cls, s: str) -> "EvaluationBinary":
-        import json
         d = json.loads(s)
-        if d.get("type") != "EvaluationBinary":
-            raise ValueError(f"Not an EvaluationBinary payload: {d.get('type')}")
+        check_payload_type(d, "EvaluationBinary")
         ev = cls(threshold=d.get("threshold", 0.5))
         if d.get("tp") is not None:
             for f, k in (("_tp", "tp"), ("_fp", "fp"), ("_tn", "tn"),

@@ -7,7 +7,11 @@ diagram data), residual plot + probability histogram.
 
 from __future__ import annotations
 
+import json
+
 import numpy as np
+
+from deeplearning4j_tpu.eval.evaluation import check_payload_type
 
 
 class EvaluationCalibration:
@@ -96,7 +100,6 @@ class EvaluationCalibration:
                    "_residual_hist")
 
     def to_json(self) -> str:
-        import json
         d = {"format_version": 1, "type": "EvaluationCalibration",
              "reliability_bins": self.reliability_bins,
              "histogram_bins": self.histogram_bins}
@@ -107,11 +110,8 @@ class EvaluationCalibration:
 
     @classmethod
     def from_json(cls, s: str) -> "EvaluationCalibration":
-        import json
         d = json.loads(s)
-        if d.get("type") != "EvaluationCalibration":
-            raise ValueError(
-                f"Not an EvaluationCalibration payload: {d.get('type')}")
+        check_payload_type(d, "EvaluationCalibration")
         ev = cls(reliability_bins=d["reliability_bins"],
                  histogram_bins=d["histogram_bins"])
         for f in cls._ACC_FIELDS:

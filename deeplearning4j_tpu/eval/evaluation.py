@@ -21,6 +21,13 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+def check_payload_type(d: dict, expected: str):
+    """Shared serde guard: every evaluator's JSON payload carries a
+    type tag; reject mismatches with one consistent error."""
+    if d.get("type") != expected:
+        raise ValueError(f"Not a(n) {expected} payload: {d.get('type')!r}")
+
+
 class EvaluationAveraging(str, Enum):
     MACRO = "macro"
     MICRO = "micro"
@@ -379,8 +386,7 @@ class Evaluation:
     @classmethod
     def from_json(cls, s: str) -> "Evaluation":
         d = json.loads(s)
-        if d.get("type") != "Evaluation":
-            raise ValueError(f"Not an Evaluation JSON payload: {d.get('type')}")
+        check_payload_type(d, "Evaluation")
         ev = cls(num_classes=d["num_classes"], top_n=d["top_n"],
                  labels_names=d.get("labels_names"),
                  binary_decision_threshold=d.get("binary_decision_threshold"),

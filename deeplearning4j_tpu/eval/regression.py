@@ -9,7 +9,11 @@ from __future__ import annotations
 
 from typing import Optional
 
+import json
+
 import numpy as np
+
+from deeplearning4j_tpu.eval.evaluation import check_payload_type
 
 
 class RegressionEvaluation:
@@ -93,7 +97,6 @@ class RegressionEvaluation:
                    "_sum_pred", "_sum_pred2", "_sum_label_pred", "_count")
 
     def to_json(self) -> str:
-        import json
         d = {"format_version": 1, "type": "RegressionEvaluation",
              "num_columns": self.num_columns,
              "column_names": self.column_names}
@@ -104,10 +107,8 @@ class RegressionEvaluation:
 
     @classmethod
     def from_json(cls, s: str) -> "RegressionEvaluation":
-        import json
         d = json.loads(s)
-        if d.get("type") != "RegressionEvaluation":
-            raise ValueError(f"Not a RegressionEvaluation payload: {d.get('type')}")
+        check_payload_type(d, "RegressionEvaluation")
         ev = cls(num_columns=d["num_columns"], column_names=d.get("column_names"))
         for f in cls._SUM_FIELDS:
             if d.get(f) is not None:

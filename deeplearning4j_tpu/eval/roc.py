@@ -10,7 +10,11 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import json
+
 import numpy as np
+
+from deeplearning4j_tpu.eval.evaluation import check_payload_type
 
 
 def _binary_roc_points(labels: np.ndarray, probs: np.ndarray):
@@ -122,8 +126,7 @@ class ROC:
 
     @classmethod
     def from_dict(cls, d: dict) -> "ROC":
-        if d.get("type") != "ROC":
-            raise ValueError(f"Not a ROC payload: {d.get('type')}")
+        check_payload_type(d, "ROC")
         roc = cls(threshold_steps=d.get("threshold_steps", 0))
         if d.get("labels"):
             roc._labels.append(np.asarray(d["labels"], np.float64))
@@ -131,12 +134,10 @@ class ROC:
         return roc
 
     def to_json(self) -> str:
-        import json
         return json.dumps(self.to_dict())
 
     @classmethod
     def from_json(cls, s: str) -> "ROC":
-        import json
         return cls.from_dict(json.loads(s))
 
     def merge(self, other: "ROC") -> "ROC":
@@ -152,7 +153,6 @@ class _ROCFamily:
     _rocs: "Optional[List[ROC]]"
 
     def to_json(self) -> str:
-        import json
         return json.dumps({
             "format_version": 1, "type": type(self).__name__,
             "columns": ([] if self._rocs is None
@@ -161,10 +161,8 @@ class _ROCFamily:
 
     @classmethod
     def from_json(cls, s: str):
-        import json
         d = json.loads(s)
-        if d.get("type") != cls.__name__:
-            raise ValueError(f"Not a {cls.__name__} payload: {d.get('type')}")
+        check_payload_type(d, cls.__name__)
         ev = cls()
         cols = d.get("columns")
         if cols is None:

@@ -19,6 +19,7 @@ Layout notes (TPU-native NHWC):
 from __future__ import annotations
 
 import json
+import re
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -445,7 +446,6 @@ class KerasModelImport:
         # (CategoricalCrossentropy → categorical_crossentropy)
         key = loss.lower()
         if key not in _KERAS_LOSSES:
-            import re
             key = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", loss).lower()
         return _KERAS_LOSSES.get(key)
 

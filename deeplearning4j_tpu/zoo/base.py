@@ -65,11 +65,18 @@ class ZooModel:
     def init(self):
         raise NotImplementedError
 
+    # {PretrainedType: filename in zoo/weights/} — subclasses shipping a
+    # packaged artifact declare it here; external-URL models override
+    # pretrained_url/pretrained_checksum instead
+    packaged: dict = {}
+
     def pretrained_url(self, ptype: PretrainedType) -> Optional[str]:
-        return None
+        name = self.packaged.get(ptype)
+        return packaged_weight(name)[0] if name else None
 
     def pretrained_checksum(self, ptype: PretrainedType) -> Optional[str]:
-        return None
+        name = self.packaged.get(ptype)
+        return packaged_weight(name)[1] if name else None
 
     def init_pretrained(self, ptype: PretrainedType = PretrainedType.IMAGENET):
         """Download + verify + load a pretrained checkpoint

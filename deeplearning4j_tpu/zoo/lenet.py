@@ -14,7 +14,7 @@ from deeplearning4j_tpu.nn.layers import (
     SubsamplingLayer,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-from deeplearning4j_tpu.zoo.base import ZooModel
+from deeplearning4j_tpu.zoo.base import PretrainedType, ZooModel
 
 
 class LeNet(ZooModel):
@@ -51,14 +51,4 @@ class LeNet(ZooModel):
     # provenance + held-out accuracy). Ships inside the wheel so
     # `init_pretrained(MNIST)` works offline end-to-end (reference
     # `ZooModel.initPretrained` downloads from a blob host :52-81).
-    def pretrained_url(self, ptype):
-        from deeplearning4j_tpu.zoo.base import PretrainedType, packaged_weight
-        if ptype == PretrainedType.MNIST:
-            return packaged_weight("lenet_mnist.zip")[0]
-        return None
-
-    def pretrained_checksum(self, ptype):
-        from deeplearning4j_tpu.zoo.base import PretrainedType, packaged_weight
-        if ptype == PretrainedType.MNIST:
-            return packaged_weight("lenet_mnist.zip")[1]
-        return None
+    packaged = {PretrainedType.MNIST: "lenet_mnist.zip"}

@@ -10,7 +10,7 @@ from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.builder import BackpropType
 from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-from deeplearning4j_tpu.zoo.base import ZooModel
+from deeplearning4j_tpu.zoo.base import PretrainedType, ZooModel
 
 
 class TextGenerationLSTM(ZooModel):
@@ -41,17 +41,7 @@ class TextGenerationLSTM(ZooModel):
 
     # Packaged pretrained checkpoint: char-LM trained on this repo's own
     # documentation (provenance + charset in zoo/weights/MANIFEST.json).
-    def pretrained_url(self, ptype):
-        from deeplearning4j_tpu.zoo.base import PretrainedType, packaged_weight
-        if ptype == PretrainedType.TEXT:
-            return packaged_weight("textgen_docs.zip")[0]
-        return None
-
-    def pretrained_checksum(self, ptype):
-        from deeplearning4j_tpu.zoo.base import PretrainedType, packaged_weight
-        if ptype == PretrainedType.TEXT:
-            return packaged_weight("textgen_docs.zip")[1]
-        return None
+    packaged = {PretrainedType.TEXT: "textgen_docs.zip"}
 
     @staticmethod
     def pretrained_charset():

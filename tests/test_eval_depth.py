@@ -132,7 +132,7 @@ class TestSerde:
                                       ev.confusion.matrix)
 
     def test_from_json_rejects_wrong_type(self):
-        with pytest.raises(ValueError, match="Not an Evaluation"):
+        with pytest.raises(ValueError, match=r"Not a\(n\) Evaluation"):
             Evaluation.from_json('{"type": "ROC"}')
 
 
