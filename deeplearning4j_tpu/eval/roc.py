@@ -191,7 +191,8 @@ class ROCBinary(_ROCFamily):
     """Independent binary ROC per output column (reference
     `ROCBinary.java` for multi-label sigmoid outputs)."""
 
-    def __init__(self):
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = threshold_steps
         self._rocs: Optional[List[ROC]] = None
 
     def eval(self, labels, predictions, mask=None):
@@ -202,7 +203,8 @@ class ROCBinary(_ROCFamily):
             labels = labels.reshape(-1, c)
             predictions = predictions.reshape(-1, c)
         if self._rocs is None:
-            self._rocs = [ROC() for _ in range(labels.shape[-1])]
+            self._rocs = [ROC(threshold_steps=self.threshold_steps)
+                          for _ in range(labels.shape[-1])]
         for i, roc in enumerate(self._rocs):
             roc.eval(labels[:, i], predictions[:, i])
 
@@ -218,7 +220,8 @@ class ROCBinary(_ROCFamily):
 class ROCMultiClass(_ROCFamily):
     """One-vs-all ROC per class (reference `ROCMultiClass.java`)."""
 
-    def __init__(self):
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = threshold_steps
         self._rocs: Optional[List[ROC]] = None
 
     def eval(self, labels, predictions, mask=None):
@@ -232,7 +235,8 @@ class ROCMultiClass(_ROCFamily):
                 m = np.asarray(mask).reshape(-1).astype(bool)
                 labels, predictions = labels[m], predictions[m]
         if self._rocs is None:
-            self._rocs = [ROC() for _ in range(labels.shape[-1])]
+            self._rocs = [ROC(threshold_steps=self.threshold_steps)
+                          for _ in range(labels.shape[-1])]
         for i, roc in enumerate(self._rocs):
             roc.eval(labels[:, i], predictions[:, i])
 

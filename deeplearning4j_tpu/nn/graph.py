@@ -824,16 +824,37 @@ class ComputationGraph:
                                 None, None, None, train=training)
         return float(loss)
 
-    def evaluate(self, iterator):
-        from deeplearning4j_tpu.eval.evaluation import Evaluation
+    def _evaluate_with(self, evaluator, iterator):
         from deeplearning4j_tpu.datasets.iterator import as_iterator
-        e = Evaluation()
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
         it = as_iterator(iterator, batch_size=128)
         it.reset()
         for ds in it:
-            out = self.output(ds.features)
-            e.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
-        return e
+            masks = (None if ds.features_mask is None
+                     else [jnp.asarray(ds.features_mask)])
+            out = self.output(ds.features, masks=masks)
+            kw = {}
+            meta = getattr(ds, "example_metadata", None)
+            if meta is not None and isinstance(evaluator, Evaluation):
+                kw["record_metadata"] = meta
+            evaluator.eval(ds.labels, np.asarray(out),
+                           mask=ds.labels_mask, **kw)
+        return evaluator
+
+    def evaluate(self, iterator, labels_list=None, top_n: int = 1):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        return self._evaluate_with(
+            Evaluation(labels_names=labels_list, top_n=top_n), iterator)
+
+    def evaluate_roc(self, iterator, threshold_steps: int = 0):
+        from deeplearning4j_tpu.eval.roc import ROC
+        return self._evaluate_with(ROC(threshold_steps=threshold_steps),
+                                   iterator)
+
+    def evaluate_roc_multi_class(self, iterator, threshold_steps: int = 0):
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        return self._evaluate_with(ROCMultiClass(threshold_steps=threshold_steps),
+                                   iterator)
 
     # -------------------------------------------------------- param access
     def param_table(self) -> Dict[str, jnp.ndarray]:

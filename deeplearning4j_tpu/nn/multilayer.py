@@ -515,28 +515,54 @@ class MultiLayerNetwork:
                                 train=training)
         return float(loss)
 
-    def evaluate(self, iterator, data_format=None):
-        from deeplearning4j_tpu.eval.evaluation import Evaluation
-        e = Evaluation()
+    def _evaluate_with(self, evaluator, iterator, data_format=None):
+        """Shared evaluation loop — any evaluator type with
+        .eval(labels, out, mask=) accumulates over the iterator
+        (reference evaluate/evaluateROC/evaluateRegression overloads)."""
         iterator = as_iterator(iterator, batch_size=128)
         iterator.reset()
         for ds in iterator:
             out = self.output(ds.features, data_format=data_format,
-                              mask=None if ds.features_mask is None else jnp.asarray(ds.features_mask))
-            e.eval(ds.labels, np.asarray(out),
-                   mask=ds.labels_mask,
-                   record_metadata=getattr(ds, "example_metadata", None))
-        return e
+                              mask=None if ds.features_mask is None
+                              else jnp.asarray(ds.features_mask))
+            from deeplearning4j_tpu.eval.evaluation import Evaluation
+            kw = {}
+            meta = getattr(ds, "example_metadata", None)
+            if meta is not None and isinstance(evaluator, Evaluation):
+                kw["record_metadata"] = meta
+            evaluator.eval(ds.labels, np.asarray(out),
+                           mask=ds.labels_mask, **kw)
+        return evaluator
+
+    def evaluate(self, iterator, data_format=None, labels_list=None,
+                 top_n: int = 1):
+        """Reference `evaluate(iterator[, labelsList[, topN]])`
+        :2794,:2892,:2944."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        return self._evaluate_with(
+            Evaluation(labels_names=labels_list, top_n=top_n),
+            iterator, data_format)
+
+    def evaluate_roc(self, iterator, threshold_steps: int = 0,
+                     data_format=None):
+        """Binary ROC over the iterator (reference `evaluateROC` :2814)."""
+        from deeplearning4j_tpu.eval.roc import ROC
+        return self._evaluate_with(ROC(threshold_steps=threshold_steps),
+                                   iterator, data_format)
+
+    def evaluate_roc_multi_class(self, iterator, threshold_steps: int = 0,
+                                 data_format=None):
+        """One-vs-all ROC per class (reference `evaluateROCMultiClass`
+        :2825)."""
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        return self._evaluate_with(
+            ROCMultiClass(threshold_steps=threshold_steps), iterator,
+            data_format)
 
     def evaluate_regression(self, iterator, data_format=None):
         from deeplearning4j_tpu.eval.regression import RegressionEvaluation
-        e = RegressionEvaluation()
-        iterator = as_iterator(iterator, batch_size=128)
-        iterator.reset()
-        for ds in iterator:
-            out = self.output(ds.features, data_format=data_format)
-            e.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
-        return e
+        return self._evaluate_with(RegressionEvaluation(), iterator,
+                                   data_format)
 
     # ------------------------------------------------------ rnn streaming
     def rnn_clear_previous_state(self):

@@ -431,3 +431,69 @@ class TestRocFamilySerde:
         b.eval(np.eye(3)[[0, 1]], np.random.rand(2, 3))
         with pytest.raises(ValueError, match="column counts"):
             a.merge(b)
+
+
+class TestContainerEvaluateOverloads:
+    """Container-level evaluate overloads (reference
+    `MultiLayerNetwork.evaluate(iterator, labelsList, topN)` :2892-2944,
+    `evaluateROC` :2814, `evaluateROCMultiClass` :2825)."""
+
+    def _net(self, n_out=3):
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=n_out, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def _data(self, n_out=3, n=48):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((n, 4)).astype(np.float32)
+        y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, n)]
+        from deeplearning4j_tpu.datasets import DataSet
+        return DataSet(x, y)
+
+    def test_evaluate_labels_and_topn(self):
+        net = self._net()
+        ev = net.evaluate(self._data(), labels_list=["a", "b", "c"], top_n=2)
+        assert "a" in ev.stats()
+        assert ev.top_n_accuracy() >= ev.accuracy()
+
+    def test_evaluate_roc_binary(self):
+        net = self._net(n_out=2)
+        roc = net.evaluate_roc(self._data(n_out=2))
+        auc = roc.calculate_auc()
+        assert 0.0 <= auc <= 1.0
+
+    def test_evaluate_roc_multi_class(self):
+        net = self._net()
+        roc = net.evaluate_roc_multi_class(self._data())
+        assert 0.0 <= roc.calculate_average_auc() <= 1.0
+
+    def test_graph_evaluate_overloads(self):
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration, ComputationGraph
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+        b = NeuralNetConfiguration.builder().updater(Adam(1e-2))
+        g = ComputationGraphConfiguration.graph_builder(b)
+        g.add_inputs("in")
+        g.add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+        g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "d")
+        g.set_input_types(InputType.feed_forward(4))
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        ds = self._data()
+        ev = net.evaluate(ds, labels_list=["x", "y", "z"], top_n=2)
+        assert "x" in ev.stats()
+        roc = net.evaluate_roc_multi_class(ds)
+        assert 0.0 <= roc.calculate_average_auc() <= 1.0
