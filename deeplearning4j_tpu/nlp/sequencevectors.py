@@ -401,6 +401,7 @@ class SequenceVectors:
         self.data_axis = data_axis
         self._sharded_step = None
         self._sharded_multi = None
+        self._warmed_key = None
 
     # ------------------------------------------------------------- vocab
     def build_vocab(self, sequences: Iterable[List[str]]):
@@ -648,6 +649,76 @@ class SequenceVectors:
         px = np.zeros(B, np.int32); px[:n] = contexts
         return pc, px, self._valid_mask(B, n)
 
+    def _warm_drain_executables(self, use_hs, array_path):
+        """Pre-compile every drain executable a fit can reach. Which
+        shapes a given fit hits depends on the subsampling rng — a >=B
+        epoch tail drains per-batch [B], a ragged tail hits the masked
+        step — so without this a late tail can stall mid-fit on a fresh
+        XLA compile (seconds over a TPU tunnel), landing inside a
+        user's or the bench's steady-state window. Zero-lr, zero-index
+        calls at the exact production avals; outputs are assigned back
+        (lr=0 makes the update an exact no-op on finite tables) because
+        the steps donate the table buffers. No host rng is consumed, so
+        seeded training streams are unchanged. Mesh-sharded fits skip
+        this: their drain set depends on divisibility and is exercised
+        on virtual devices where compiles are cheap. Inference-mode fits
+        (trainable_from > 0, i.e. infer_vector over one document) skip
+        it too: their pair count is a document, not a corpus, so they
+        only ever touch the masked tail step — pre-compiling the full-
+        batch executables they cannot reach would ADD a compile stall."""
+        if self.mesh is not None or self._trainable_from > 0:
+            return
+        B = self.conf.batch_size
+        key = (self.syn0.shape, B, bool(use_hs), bool(array_path),
+               self._trainable_from)
+        # the skip additionally requires device-resident tables: jit
+        # caches on argument sharding, so host-resident tables (fresh
+        # _init_tables — the normal start of every fit) must be warmed
+        # through to device arrays again or the first real flush of a
+        # refit compiles a second, host-input cache entry
+        if self._warmed_key == key and not isinstance(self.syn0, np.ndarray):
+            return
+        lr0 = np.float32(0.0)
+        zc = np.zeros(B, np.int32)
+        zvalid = self._valid_mask(B, 0)
+        zn = np.zeros((B, max(self.conf.negative, 1)), np.int32)
+        if array_path:
+            if use_hs:
+                pts, cds, msk = (self._hs_points[zc], self._hs_codes[zc],
+                                 self._hs_mask[zc])
+                self.syn0, self.syn1, _ = _sg_hs_step(
+                    self.syn0, self.syn1, zc, pts, cds, msk, lr0)
+                self.syn0, self.syn1, _ = _sg_hs_step_masked(
+                    self.syn0, self.syn1, zc, pts, cds, msk, lr0, zvalid)
+            else:
+                self.syn0, self.syn1neg, _ = _sg_neg_step(
+                    self.syn0, self.syn1neg, zc, zc, zn, lr0,
+                    self._trainable_from)
+                self.syn0, self.syn1neg, _ = _sg_neg_step_masked(
+                    self.syn0, self.syn1neg, zc, zc, zn, lr0,
+                    self._trainable_from, zvalid)
+        else:
+            W2 = 2 * self.conf.window + 1
+            zctx = np.zeros((B, W2), np.int32)
+            zmask = np.zeros((B, W2), np.float32)
+            if use_hs:
+                pts, cds, msk = (self._hs_points[zc], self._hs_codes[zc],
+                                 self._hs_mask[zc])
+                self.syn0, self.syn1, _ = _cbow_hs_step(
+                    self.syn0, self.syn1, zctx, zmask, zc, pts, cds, msk,
+                    lr0)
+                self.syn0, self.syn1, _ = _cbow_hs_step_masked(
+                    self.syn0, self.syn1, zctx, zmask, zc, pts, cds, msk,
+                    lr0, zvalid)
+            else:
+                self.syn0, self.syn1neg, _ = _cbow_neg_step(
+                    self.syn0, self.syn1neg, zctx, zmask, zc, zn, lr0,
+                    self._trainable_from)
+                self.syn0, self.syn1neg, _ = _cbow_neg_step_masked(
+                    self.syn0, self.syn1neg, zctx, zmask, zc, zn, lr0,
+                    self._trainable_from, zvalid)
+        self._warmed_key = key
+
     def _flush_sg_neg_tail(self, centers, contexts, lr):
         if len(centers) == self.conf.batch_size:
             return self._flush_sg_neg(centers, contexts, lr)
@@ -716,7 +787,18 @@ class SequenceVectors:
             total_words = sum(len(s) for s in sequences)
         if total_words is None:
             total_words = self.vocab.total_word_count
+        corpus_words = total_words
         total_words = max(total_words * conf.epochs, 1)
+        # warm only when a full-batch flush is reachable: an epoch emits
+        # at most 2*window pairs per center word (1 for CBOW), so a
+        # corpus whose pair upper bound is below B can only ever hit the
+        # masked tail step — pre-compiling [B] executables for it would
+        # ADD the compile stall this exists to remove. pair_hook makes
+        # the count uncallerable, so it always warms.
+        pairs_per_word = 1 if conf.cbow else 2 * conf.window
+        if (pair_hook is not None
+                or corpus_words * pairs_per_word >= conf.batch_size):
+            self._warm_drain_executables(use_hs, array_path)
         words_seen = 0
         self.last_loss = 0.0
         loss_dev = None      # device-side last loss — read ONCE after fit
