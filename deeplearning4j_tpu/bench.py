@@ -232,14 +232,15 @@ def bench_matmul_peak():
 
 
 # --------------------------------------------------------------- ResNet-50
-def bench_resnet50(accel):
+def bench_resnet50(accel, batch=None, size=None, steps=None,
+                   with_etl=True):
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo.resnet50 import ResNet50
 
-    batch = 128 if accel else 8   # v5e HBM holds it easily; bigger
-    size = 224 if accel else 64   # batches keep the MXU fed
-    steps = 20 if accel else 3
+    batch = batch or (128 if accel else 8)   # v5e HBM holds it easily; bigger
+    size = size or (224 if accel else 64)    # batches keep the MXU fed
+    steps = steps or (20 if accel else 3)
 
     model = ResNet50(num_classes=1000, height=size, width=size, channels=3)
     conf = model.conf()
@@ -382,12 +383,15 @@ def bench_resnet50(accel):
     # are stacked + device_put by a producer thread while the device
     # crunches the previous fused window — the SAME executable as the
     # headline, so the delta is purely the input pipeline.
-    try:
-        etl = _resnet_etl_window(run_x, st, make_rngs, x, y, batch, steps,
-                                 compute_ips=ips)
-        st = etl.pop("_st")
-    except Exception as e:
-        etl = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if with_etl:
+        try:
+            etl = _resnet_etl_window(run_x, st, make_rngs, x, y, batch,
+                                     steps, compute_ips=ips)
+            st = etl.pop("_st")
+        except Exception as e:
+            etl = {"error": f"{type(e).__name__}: {e}"[:300]}
+    else:
+        etl = {"skipped": "sweep config — ETL window on headline only"}
 
     ach_analytic, mfu_analytic = _mfu(analytic_flops)
     ach_hlo, mfu_hlo = _mfu(hlo_flops)
@@ -648,7 +652,8 @@ def bench_lstm_charnn(accel):
 
 
 # ------------------------------------------- Transformer LM (beyond-ref)
-def bench_transformer_lm(accel):
+def bench_transformer_lm(accel, B=None, T=None, d_model=None,
+                         n_layers=None, n_heads=None, steps=None, V=512):
     """Causal transformer LM training throughput (tokens/sec) — the
     beyond-reference long-context flagship (the 2017 zoo tops out at
     LSTMs). On TPU the encoder blocks ride the Pallas flash-attention
@@ -658,10 +663,12 @@ def bench_transformer_lm(accel):
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo.transformer import TransformerLM
 
-    V = 512
-    B, T = (16, 256) if accel else (4, 32)
-    steps = 30 if accel else 3
-    d_model, n_layers, n_heads = (256, 4, 8) if accel else (32, 2, 4)
+    B = B or (16 if accel else 4)
+    T = T or (256 if accel else 32)
+    steps = steps or (30 if accel else 3)
+    d_model = d_model or (256 if accel else 32)
+    n_layers = n_layers or (4 if accel else 2)
+    n_heads = n_heads or (8 if accel else 4)
     lm = TransformerLM(vocab_size=V, d_model=d_model, n_layers=n_layers,
                        n_heads=n_heads, max_len=T)
     if accel:
@@ -675,7 +682,7 @@ def bench_transformer_lm(accel):
     x = jnp.asarray(ids, jnp.float32)
     y = jnp.asarray(np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)])
     dt = _time_fused_steps(net, x, y, steps)
-    return {
+    out = {
         "metric": "transformer_lm_tokens_per_sec",
         "value": round(B * T * steps / dt, 1), "unit": "tokens/sec",
         "batch": B, "seq_len": T, "d_model": d_model,
@@ -683,6 +690,20 @@ def bench_transformer_lm(accel):
         "flash_attention": jax.default_backend() == "tpu",
         "fused_dispatch": True,
     }
+    # long-context config (GPT-2-small-ish blocks at T=2048): at this
+    # length training rides the Pallas flash BACKWARD too (the
+    # size-routed fast path, kernels/flash_attention.py) — the
+    # beyond-reference long-context flagship number
+    if accel and T < 2048:
+        try:
+            out["long_context"] = bench_transformer_lm(
+                accel, B=8, T=2048, d_model=512, n_layers=8, n_heads=8,
+                steps=10)
+            out["long_context"]["metric"] = (
+                "transformer_lm_long_context_tokens_per_sec")
+        except Exception as e:
+            out["long_context"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return out
 
 
 # --------------------------------------------------- Word2Vec (config 3)

@@ -1,0 +1,82 @@
+"""Batch/transformer sweep on the attached accelerator.
+
+Runs the headline bench functions at alternative configs to find the
+best-throughput operating points (the headline BENCH artifact keeps its
+fixed config for round-over-round comparability; this sweep documents
+where the ceiling is). One JSON line per config to stdout + appended to
+SWEEP_r04.jsonl.
+
+Usage: python tools/bench_sweep.py [resnet|transformer|all]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu import bench  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "SWEEP_r04.jsonl")
+
+
+def emit(tag, rec):
+    rec = {"sweep": tag, **rec}
+    # device_diagnostics repeats per record; keep the first only
+    line = json.dumps(rec)
+    print(line, flush=True)
+    with open(OUT, "a") as f:
+        f.write(line + "\n")
+
+
+def sweep_resnet(accel):
+    for batch in (128, 256):
+        try:
+            r = bench.bench_resnet50(accel, batch=batch, with_etl=False)
+            r.pop("device_diagnostics", None)
+            emit(f"resnet50_b{batch}", r)
+        except Exception as e:
+            emit(f"resnet50_b{batch}",
+                 {"error": f"{type(e).__name__}: {e}"[:300]})
+
+
+def sweep_transformer(accel):
+    configs = [
+        # (B, T, d_model, n_layers, n_heads) — the headline config first
+        (16, 256, 256, 4, 8),
+        (32, 512, 256, 4, 8),     # longer sequences, flash attn sweet spot
+        (32, 512, 512, 8, 8),     # GPT-2-small-ish block shape
+        (8, 2048, 512, 8, 8),     # long-context: flash attention tiling
+    ]
+    for B, T, d, L, H in configs:
+        try:
+            r = bench.bench_transformer_lm(accel, B=B, T=T, d_model=d,
+                                           n_layers=L, n_heads=H)
+            emit(f"transformer_B{B}_T{T}_d{d}_L{L}", r)
+        except Exception as e:
+            emit(f"transformer_B{B}_T{T}_d{d}_L{L}",
+                 {"error": f"{type(e).__name__}: {e}"[:300]})
+
+
+def main():
+    what = sys.argv[1] if len(sys.argv) > 1 else "all"
+    info = bench._probe_backend()
+    if info is None:
+        return
+    plat, kind, accel, _ = info
+    try:
+        from deeplearning4j_tpu.nd import enable_compilation_cache
+        enable_compilation_cache()
+    except Exception:
+        pass
+    emit("env", {"platform": plat, "device_kind": kind,
+                 "diagnostics": bench._device_diagnostics()})
+    if what in ("resnet", "all"):
+        sweep_resnet(accel)
+    if what in ("transformer", "all"):
+        sweep_transformer(accel)
+
+
+if __name__ == "__main__":
+    main()
