@@ -53,6 +53,52 @@ def _flash_available() -> bool:
     return _FLASH_OK[backend]
 
 
+_SP_FLASH_OK: dict = {}   # backend name -> carry/chunk-kernel verdict
+
+
+def _sp_flash_available() -> bool:
+    """Availability probe for the kernels the SEQUENCE-PARALLEL flash
+    path actually runs — `flash_attention_carry` plus the chunked
+    backward kernels — which `_flash_available` (plain forward only)
+    does not vouch for. Same eager-compile rationale: a kernel that
+    fails to compile must be discovered before the whole train step is
+    traced."""
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    if backend not in _SP_FLASH_OK:
+        try:
+            from deeplearning4j_tpu.kernels.flash_attention import (
+                _NEG_INF, _bwd_dkv_chunk, _bwd_dq_chunk,
+                flash_attention_carry,
+            )
+            q = jnp.zeros((1, 128, 1, 8), jnp.float32)
+            m = jnp.full((1, 1, 128), _NEG_INF, jnp.float32)
+            l = jnp.zeros((1, 1, 128), jnp.float32)
+            acc = jnp.zeros((1, 1, 128, 8), jnp.float32)
+            m, l, acc = flash_attention_carry(q, q, q, m, l, acc,
+                                              diag=True)
+            jax.block_until_ready(acc)
+            lse = jnp.zeros((1, 1, 128), jnp.float32)
+            delta = jnp.zeros((1, 1, 128), jnp.float32)
+            jax.block_until_ready(
+                _bwd_dq_chunk(q, q, q, q, lse, delta, causal=True,
+                              block_q=512, block_k=1024, interpret=None))
+            jax.block_until_ready(
+                _bwd_dkv_chunk(q, q, q, q, lse, delta, causal=False,
+                               block_q=512, block_k=1024,
+                               interpret=None)[0])
+            _SP_FLASH_OK[backend] = True
+        except Exception as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "flash carry/chunk kernels unavailable on %s (%s: %s); "
+                "sequence-parallel auto mode will use the XLA path",
+                backend, type(e).__name__, e)
+            _SP_FLASH_OK[backend] = False
+    return _SP_FLASH_OK[backend]
+
+
 _SP_FALLBACK_WARNED = set()
 
 
@@ -155,18 +201,30 @@ class MultiHeadAttention(Layer):
                     "fit/output in `with sequence_sharding(mesh):`")
             if ctx is not None:
                 mesh, axis = ctx
+                # the SP schedules accept the same flash fast path: the
+                # per-shard (ring) / per-head-subset (ulysses) attention
+                # runs through the Pallas kernels when the layer's flash
+                # verdict is on — sequence parallelism and flash memory
+                # behavior compose (both fwd and bwd are kernel-backed)
+                sp_flash = self.use_flash
+                if sp_flash is None:
+                    sp_flash = (jax.default_backend() == "tpu"
+                                and _flash_available()
+                                and _sp_flash_available())
                 if self.sequence_parallel == "ring":
                     from deeplearning4j_tpu.parallel import (
                         sequence_parallel_attention)
                     o = sequence_parallel_attention(q, k, v, mesh,
                                                     seq_axis=axis,
-                                                    causal=self.causal)
+                                                    causal=self.causal,
+                                                    use_flash=sp_flash)
                 elif self.sequence_parallel == "ulysses":
                     from deeplearning4j_tpu.parallel import (
                         ulysses_parallel_attention)
                     o = ulysses_parallel_attention(q, k, v, mesh,
                                                    axis_name=axis,
-                                                   causal=self.causal)
+                                                   causal=self.causal,
+                                                   use_flash=sp_flash)
                 else:
                     raise ValueError(
                         f"sequence_parallel must be 'ring'|'ulysses', "

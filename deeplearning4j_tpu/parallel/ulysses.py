@@ -44,10 +44,17 @@ def _full_attention(q, k, v, causal: bool):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      use_flash: bool = False):
     """Per-shard: q/k/v [B, T_local, H, Dh] (sequence-sharded). Returns
     o [B, T_local, H, Dh]. Run inside shard_map with `axis_name` bound;
-    requires H % axis_size == 0."""
+    requires H % axis_size == 0.
+
+    `use_flash=True` runs the post-all-to-all full-sequence attention
+    through the Pallas flash kernels (`kernels/flash_attention.py`,
+    differentiable) — since each device sees the FULL sequence for its
+    head subset, this is where the O(block)-VMEM streaming matters most
+    in the Ulysses schedule."""
     Pn = lax.axis_size(axis_name)
     B, Tl, H, Dh = q.shape
     if H % Pn != 0:
@@ -65,13 +72,19 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
                               tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)   # [B, T, H/P, Dh]
-    oh = _full_attention(qh, kh, vh, causal)
+    if use_flash:
+        from deeplearning4j_tpu.kernels.flash_attention import (
+            flash_attention)
+        oh = flash_attention(qh, kh, vh, causal)
+    else:
+        oh = _full_attention(qh, kh, vh, causal)
     return to_seq(oh)                                    # [B, Tl, H, Dh]
 
 
 def ulysses_parallel_attention(q, k, v, mesh: Mesh, *,
                                axis_name: str = "seq",
-                               causal: bool = False):
+                               causal: bool = False,
+                               use_flash: bool = False):
     """Full arrays [B, T, H, Dh]; shards T over `axis_name`, runs the
     all-to-all schedule, returns full [B, T, H, Dh]."""
     spec = P(None, axis_name, None, None)
@@ -79,7 +92,8 @@ def ulysses_parallel_attention(q, k, v, mesh: Mesh, *,
     @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
     def run(ql, kl, vl):
-        return ulysses_attention(ql, kl, vl, axis_name, causal=causal)
+        return ulysses_attention(ql, kl, vl, axis_name, causal=causal,
+                                 use_flash=use_flash)
 
     sh = NamedSharding(mesh, spec)
     return run(jax.device_put(q, sh), jax.device_put(k, sh),
