@@ -1,12 +1,17 @@
 """Long-context training recipe: sequence parallelism + remat.
 
-The three levers for sequences that don't fit one chip's HBM:
+The levers for sequences that don't fit one chip's HBM:
 1. `sequence_parallel="ring"` (or "ulysses") on the transformer blocks —
    the time axis shards over a mesh "seq" axis; K/V blocks rotate over
    ICI (ring) or heads redistribute via all-to-all (Ulysses).
 2. `remat=True` — intra-block activations are recomputed in backward
    instead of stored (one extra forward of FLOPs, big memory cut).
-3. The mesh rides the `sequence_sharding` context; the config carries
+3. On TPU the SP schedules automatically ride the Pallas flash kernels
+   in BOTH directions (`use_flash` auto) — the per-shard [Tl, Tl]
+   attention tile never materializes, so the per-device memory is
+   O(block), compounding with the sharding. Single chip, flash alone
+   trains to T=65k where plain XLA attention OOMs at 16k.
+4. The mesh rides the `sequence_sharding` context; the config carries
    only the strategy name, so checkpoints stay portable.
 
 Runs on anything: 8 virtual CPU devices here, a real TPU pod slice in
