@@ -6,7 +6,7 @@ fixed config for round-over-round comparability; this sweep documents
 where the ceiling is). One JSON line per config to stdout + appended to
 SWEEP_r04.jsonl.
 
-Usage: python tools/bench_sweep.py [resnet|transformer|all]
+Usage: python benchtools/bench_sweep.py [resnet|transformer|all]
 """
 
 import json
