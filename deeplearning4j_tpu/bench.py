@@ -204,12 +204,15 @@ def bench_matmul_peak():
     probe measures the tunnel, not the MXU (observed: 28 TF/s from 8
     chained calls vs the same silicon sustaining far more in one call).
     The timed window is a single dispatch + one scalar readback; chain
-    length is sized so compute (~90 ms at nominal peak) dominates RTT."""
+    length is sized so compute (~0.4 s at nominal peak) dominates the
+    ~10 ms amortized per-window overhead (measured: chain 128 → 151.7
+    TF/s, chain 512 → 163.5 TF/s on the same silicon; the longer chain
+    recovers the ~85%-of-nominal sustained rate a real v5e shows)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    n, chain = 4096, 128
+    n, chain = 4096, 512
 
     @jax.jit
     def run(x, w):
