@@ -75,3 +75,38 @@ class Word2Vec(SequenceVectors):
         if sequences is None:
             sequences = self._tokenize_corpus()
         return super().fit(sequences, **kw)
+
+
+def load_packaged_word2vec():
+    """Load the packaged doc-trained skip-gram vectors
+    (`zoo/weights/word2vec_docs.bin`, Google binary format) through the
+    full verification path: manifest lookup → sha256 check →
+    `WordVectorSerializer.read_binary`. The pretrained-word-vectors
+    story the reference served with hosted GoogleNews-style .bin files
+    (`WordVectorSerializer.java` readers), shipped as a package asset
+    so it works offline. Raises if the artifact is missing or fails
+    its checksum (never silently loads an unverifiable file — same
+    contract as `zoo.base.packaged_weight`)."""
+    import hashlib
+    from pathlib import Path
+    from urllib.request import url2pathname
+    from urllib.parse import urlparse
+
+    from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+    from deeplearning4j_tpu.zoo import base as zoo_base
+
+    name = "word2vec_docs.bin"
+    # packaged_weight owns the manifest policy (missing entry or missing
+    # sha256 → not packaged) and the weights-dir layout
+    uri, expected = zoo_base.packaged_weight(name)
+    if uri is None:
+        raise FileNotFoundError(
+            f"{name} is not a packaged artifact (no manifest entry); "
+            "regenerate with tests/make_word2vec_pretrained.py")
+    path = Path(url2pathname(urlparse(uri).path))
+    sha = hashlib.sha256(path.read_bytes()).hexdigest()
+    if sha != expected:
+        raise ValueError(
+            f"{name} checksum mismatch (got {sha[:12]}…, manifest "
+            f"{expected[:12]}…) — refusing to load")
+    return WordVectorSerializer.read_binary(path)
