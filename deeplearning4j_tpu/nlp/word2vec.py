@@ -89,21 +89,19 @@ def load_packaged_word2vec():
     contract as `zoo.base.packaged_weight`)."""
     import hashlib
     from pathlib import Path
-    from urllib.request import url2pathname
-    from urllib.parse import urlparse
 
     from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
     from deeplearning4j_tpu.zoo import base as zoo_base
 
     name = "word2vec_docs.bin"
     # packaged_weight owns the manifest policy (missing entry or missing
-    # sha256 → not packaged) and the weights-dir layout
+    # sha256 → not packaged); the path is the weights dir it resolves
     uri, expected = zoo_base.packaged_weight(name)
     if uri is None:
         raise FileNotFoundError(
             f"{name} is not a packaged artifact (no manifest entry); "
             "regenerate with tests/make_word2vec_pretrained.py")
-    path = Path(url2pathname(urlparse(uri).path))
+    path = Path(zoo_base.__file__).parent / "weights" / name
     sha = hashlib.sha256(path.read_bytes()).hexdigest()
     if sha != expected:
         raise ValueError(

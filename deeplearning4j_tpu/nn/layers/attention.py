@@ -185,6 +185,41 @@ class MultiHeadAttention(Layer):
         b, t, d = z.shape
         return z.reshape(b, t, self.n_heads, d // self.n_heads)
 
+    def forward_with_cache(self, params, x, k_cache, v_cache, pos):
+        """Incremental causal attention for autoregressive decoding
+        (the transformer analogue of the reference's `rnnTimeStep`
+        streaming state). `x` [B, T, D] holds NEW tokens whose global
+        positions are [pos, pos+T); `k_cache`/`v_cache` [B, L, H, Dh]
+        are fixed-size buffers (static shapes — the TPU way: one
+        compile, a dynamic write index, masked reads) holding the
+        first `pos` positions. Returns (y, k_cache', v_cache').
+
+        The causal mask `k_pos <= q_pos` also hides every unwritten
+        cache slot (those have k_pos >= pos+T > q_pos), so no separate
+        validity mask is needed. Positions past L are clamped by XLA's
+        dynamic_update_slice — callers size L (the block's
+        `cache_len`) to the longest sequence they will decode."""
+        assert self.causal, "KV-cache decoding requires causal=True"
+        q = self.heads(self._project(params, x, "Wq"))   # [B,T,H,Dh]
+        k = self.heads(self._project(params, x, "Wk"))
+        v = self.heads(self._project(params, x, "Wv"))
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), pos, 1)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), pos, 1)
+        T, L = x.shape[1], k_cache.shape[1]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(self.head_dim, x.dtype))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q,
+                       k_cache.astype(q.dtype)) * scale
+        q_pos = pos + jnp.arange(T)
+        valid = jnp.arange(L)[None, :] <= q_pos[:, None]   # [T, L]
+        s = jnp.where(valid[None, None], s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", w, v_cache.astype(q.dtype))
+        o = o.reshape(x.shape[0], T, -1)
+        return (self.activation(self._project(params, o, "Wo")),
+                k_cache, v_cache)
+
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self.apply_input_dropout(x, train, rng)
         q = self.heads(self._project(params, x, "Wq"))   # [B,T,H,Dh]

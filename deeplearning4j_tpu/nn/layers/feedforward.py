@@ -165,6 +165,10 @@ class EmbeddingLayer(Layer):
     n_in: int = 0  # vocab size
     n_out: int = 0
     has_bias: bool = True
+    # set from the input type at build time (serialized with the conf):
+    # recurrent nets feed [B, T] ids where T may be 1 (streaming decode),
+    # so the FF column-of-indices [B, 1] → [B] squeeze must not apply
+    time_series_input: bool = False
 
     def __post_init__(self):
         if self.activation is None:
@@ -180,6 +184,7 @@ class EmbeddingLayer(Layer):
                 self.n_in = input_type.size
             else:
                 self.n_in = input_type.arity()
+        self.time_series_input = isinstance(input_type, InputTypeRecurrent)
 
     def get_output_type(self, input_type):
         from deeplearning4j_tpu.nn.conf.inputs import InputTypeRecurrent
@@ -201,8 +206,9 @@ class EmbeddingLayer(Layer):
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         idx = x.astype(jnp.int32)
-        if idx.ndim == 2 and idx.shape[-1] == 1:
-            idx = idx[:, 0]
+        if (idx.ndim == 2 and idx.shape[-1] == 1
+                and not self.time_series_input):
+            idx = idx[:, 0]   # FF column-of-indices [B, 1] → [B]
         z = jnp.take(params["W"], idx, axis=0)
         if self.has_bias:
             z = z + params["b"]

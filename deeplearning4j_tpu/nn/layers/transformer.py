@@ -23,14 +23,17 @@ from deeplearning4j_tpu.common.weights import init_weights
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
 from deeplearning4j_tpu.nn.layers.normalization import LayerNormalization
 
 
 @register_layer
 @dataclasses.dataclass(eq=False)
-class PositionalEncodingLayer(Layer):
+class PositionalEncodingLayer(BaseRecurrentLayer):
     """Adds the sinusoidal position signal (parameter-free) to
-    [B, T, D] activations."""
+    [B, T, D] activations. Carry-aware (BaseRecurrentLayer): during
+    streaming decode the carry is the position offset, so token t of a
+    later call gets the same encoding it would in a full forward."""
 
     layer_name = "positional_encoding"
 
@@ -62,10 +65,20 @@ class PositionalEncodingLayer(Layer):
         T, D = x.shape[1], x.shape[2]
         return x + self._table(T, D, x.dtype), state
 
+    def init_carry(self, batch, dtype=jnp.float32):
+        return jnp.zeros((), jnp.int32)
+
+    def forward_with_carry(self, params, state, x, carry, *, train=False,
+                           rng=None, mask=None):
+        T, D = x.shape[1], x.shape[2]
+        table = self._table(self.max_len, D, x.dtype)
+        sl = jax.lax.dynamic_slice_in_dim(table, carry, T, 0)
+        return x + sl, state, carry + T
+
 
 @register_layer
 @dataclasses.dataclass(eq=False)
-class TransformerEncoderBlock(Layer):
+class TransformerEncoderBlock(BaseRecurrentLayer):
     """Pre-LN transformer encoder block over [B, T, D]:
     h = x + MHA(LN(x)); out = h + FFN(LN(h)). Dropout (the layer's
     `dropout` retain-prob) applies to both sublayer outputs, attention
@@ -81,6 +94,12 @@ class TransformerEncoderBlock(Layer):
     ff_activation: str = "gelu"
     use_flash: Optional[bool] = None
     sequence_parallel: Optional[str] = None  # "ring"|"ulysses", see MHA
+    # KV-cache length for streaming decode (`forward_with_carry`):
+    # fixed-size cache buffers keep shapes static across decode steps
+    # (one XLA compile); positions past cache_len are clamped by
+    # dynamic_update_slice, so size it to the longest sequence you will
+    # decode (the zoo TransformerLM wires max_len here)
+    cache_len: int = 512
     # rematerialization: recompute this block's intra-block activations
     # (attention internals, the O(T * ff) hidden) in the backward pass
     # instead of storing them. One block-input residual per layer is
@@ -180,3 +199,60 @@ class TransformerEncoderBlock(Layer):
                                      None if rng is None
                                      else jax.random.fold_in(rng, 3))
         return x + h
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        if self._mha is None:
+            self._build_sublayers()
+        shape = (batch, self.cache_len, self.n_heads,
+                 self.n_in // self.n_heads)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                jnp.zeros((), jnp.int32))
+
+    def forward_with_carry(self, params, state, x, carry, *, train=False,
+                           rng=None, mask=None):
+        """KV-cache streaming step: same pre-LN block, attention against
+        the fixed-size cache (`MultiHeadAttention.forward_with_cache`).
+        This is the transformer analogue of the LSTM rnnTimeStep carry;
+        under TBPTT training it gives Transformer-XL-style chunk
+        recurrence (previous-chunk K/V enter stop-gradiented via the
+        TBPTT wrapper), honoring `remat`. attention_dropout and the
+        flash / sequence-parallel fast paths do not apply on this path
+        (residual/FFN dropout still does); padding masks are rejected
+        loudly because a masked token's K/V would silently enter the
+        cache and corrupt every later attention read."""
+        if mask is not None:
+            raise ValueError(
+                "TransformerEncoderBlock cannot stream (forward_with_"
+                "carry) with a padding mask: masked tokens' K/V would "
+                "enter the cache; strip padding before streaming / "
+                "TBPTT-training this block")
+        if self.remat and train:
+            def body(p, xx, c, r):
+                return self._carry_impl(p, xx, c, train=True, rng=r)
+            y, new_carry = jax.checkpoint(body)(params, x, carry, rng)
+            return y, {}, new_carry
+        y, new_carry = self._carry_impl(params, x, carry, train=train,
+                                        rng=rng)
+        return y, {}, new_carry
+
+    def _carry_impl(self, params, x, carry, *, train, rng):
+        from deeplearning4j_tpu.common.activations import get_activation
+
+        if self._mha is None:
+            self._build_sublayers()
+        k_cache, v_cache, pos = carry
+        h, _ = self._ln1.forward(self._sub(params, "ln1"), {}, x)
+        h, k_cache, v_cache = self._mha.forward_with_cache(
+            self._sub(params, "attn"), h, k_cache, v_cache, pos)
+        h = self.apply_input_dropout(h, train,
+                                     None if rng is None
+                                     else jax.random.fold_in(rng, 2))
+        x = x + h
+        h, _ = self._ln2.forward(self._sub(params, "ln2"), {}, x)
+        act = get_activation(self.ff_activation)
+        h = act(h @ params["ff_W1"] + params["ff_b1"])
+        h = h @ params["ff_W2"] + params["ff_b2"]
+        h = self.apply_input_dropout(h, train,
+                                     None if rng is None
+                                     else jax.random.fold_in(rng, 3))
+        return x + h, (k_cache, v_cache, pos + x.shape[1])
