@@ -694,6 +694,29 @@ def bench_transformer_lm(accel, B=None, T=None, d_model=None,
         "flash_attention": jax.default_backend() == "tpu",
         "fused_dispatch": True,
     }
+    # autoregressive decode throughput: the fused on-device sampling
+    # loop (zoo.transformer.generate — KV caches as rnnTimeStep-style
+    # carries, lax.scan over steps, rng carried). Headline driver only.
+    if with_long_context and accel:
+        try:
+            from deeplearning4j_tpu.zoo.transformer import generate
+            dec_B, dec_N = 8, 224      # prompt 16 + 224 fits max_len=T
+            prompt = np.random.default_rng(11).integers(0, V, (dec_B, 16))
+            generate(net, prompt, dec_N, temperature=0.8)   # compile
+            t0 = time.perf_counter()
+            generate(net, prompt, dec_N, temperature=0.8)
+            d_dt = time.perf_counter() - t0
+            out["decode"] = {
+                "metric": "transformer_decode_tokens_per_sec",
+                "value": round(dec_B * dec_N / d_dt, 1),
+                "unit": "tokens/sec", "batch": dec_B,
+                "new_tokens": dec_N, "ms_per_step": round(
+                    d_dt / dec_N * 1e3, 3),
+                "fused_scan_sampling": True,
+            }
+        except Exception as e:
+            out["decode"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # long-context config (GPT-2-small-ish blocks at T=2048): at this
     # length training rides the Pallas flash BACKWARD too (the
     # size-routed fast path, kernels/flash_attention.py) — the

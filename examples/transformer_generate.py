@@ -1,0 +1,47 @@
+"""Autoregressive text generation with KV caches (transformer
+counterpart of the char-LSTM `rnn_time_step` sampling): train a small
+word-level LM on this repo's docs, then decode with
+`zoo.transformer.generate` — the whole sampling loop is ONE fused
+device dispatch (sampling happens on-device, rng carried)."""
+import os
+import re
+from pathlib import Path
+
+import numpy as np
+
+from deeplearning4j_tpu.zoo.transformer import TransformerLM, generate
+
+
+def load_tokens():
+    repo = Path(__file__).parents[1]
+    text = "\n".join(p.read_text(errors="ignore")
+                     for p in [repo / "README.md",
+                               *sorted((repo / "docs").glob("*.md"))])
+    return re.findall(r"[a-z][a-z0-9_]+", text.lower())
+
+
+def main():
+    toks = load_tokens()
+    vocab = sorted(set(toks))
+    V, T = len(vocab), 32
+    idx = {w: i for i, w in enumerate(vocab)}
+    ids = np.array([idx[w] for w in toks], np.int32)
+    n = (len(ids) - 1) // T
+    x = ids[:n * T].reshape(n, T).astype(np.float32)
+    y = np.eye(V, dtype=np.float32)[ids[1:n * T + 1].reshape(n, T)]
+
+    net = TransformerLM(vocab_size=V, d_model=64, n_layers=2, n_heads=4,
+                        max_len=64, seed=5).init()
+    net.fit(x, y, epochs=3, batch_size=32, steps_per_execution=4)
+    print("loss:", net.score_value)
+
+    prompt_words = ["the", "reference"]
+    prompt = np.array([[idx[w] for w in prompt_words]])
+    out = generate(net, prompt, 24, temperature=0.8,
+                   rng=__import__("jax").random.PRNGKey(0))
+    print("generated:", " ".join(prompt_words)
+          + " " + " ".join(vocab[i] for i in out[0]))
+
+
+if __name__ == "__main__":
+    main()
