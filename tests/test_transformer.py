@@ -333,3 +333,88 @@ class TestKVCacheDecoding:
         prompt = np.zeros((1, 4), np.int32)
         with pytest.raises(ValueError, match="cache length"):
             generate(net, prompt, 10, temperature=0)
+
+
+class TestTransformerStreamingDepth:
+    def test_graph_container_kv_cache_stream(self):
+        # transformer blocks stream inside ComputationGraph too (same
+        # BaseRecurrentLayer carry plumbing as MultiLayerNetwork)
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.common.weights import WeightInit
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import (
+            EmbeddingLayer, PositionalEncodingLayer, RnnOutputLayer,
+            TransformerEncoderBlock)
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            BaseRecurrentLayer)
+
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraphConfiguration)
+
+        V, T = 13, 10
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+            .weight_init(WeightInit.XAVIER))
+        g.add_inputs("ids")
+        g.add_layer("emb", EmbeddingLayer(n_in=V, n_out=16), "ids")
+        g.add_layer("pos", PositionalEncodingLayer(max_len=T), "emb")
+        g.add_layer("blk", TransformerEncoderBlock(
+            n_heads=4, causal=True, cache_len=T), "pos")
+        g.add_layer("out", RnnOutputLayer(
+            n_out=V, activation="softmax", loss="mcxent"), "blk")
+        g.set_outputs("out")
+        g.set_input_types(InputType.recurrent(V))
+        net = ComputationGraph(g.build()).init(5)
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, V, (2, T)).astype(np.float32)
+        full = np.asarray(net.output(ids))
+
+        carries = {n: layer.init_carry(2, jnp.float32)
+                   for n, layer in net._recurrent_nodes()}
+        for t in range(T):
+            acts, _, _, _ = net._forward_all(
+                net.params, net.net_state, [ids[:, t:t + 1]],
+                train=False, rng=None, carries=carries)
+            h = acts[net.conf.network_outputs[0]]
+            np.testing.assert_allclose(np.asarray(h[:, 0]), full[:, t],
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=f"position {t}")
+
+    def test_tbptt_transformer_xl_training(self):
+        # TBPTT chunks thread the KV cache (Transformer-XL recurrence):
+        # training runs, loss decreases, positions continue across
+        # chunk boundaries (would diverge if the cache reset)
+        from deeplearning4j_tpu.nn.conf.builder import BackpropType
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+        lm = TransformerLM(vocab_size=11, d_model=16, n_layers=1,
+                           n_heads=4, max_len=16, seed=9)
+        conf = lm.conf()
+        conf.backprop_type = BackpropType.TRUNCATED_BPTT
+        conf.tbptt_fwd_length = 4
+        conf.tbptt_back_length = 4
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(conf).init(9)
+        rng = np.random.default_rng(4)
+        ids = rng.integers(0, 11, (4, 16))
+        x = ids.astype(np.float32)
+        y = np.eye(11, dtype=np.float32)[(ids + 1) % 11]
+        scores = []
+        for _ in range(6):
+            net.fit(x, y, epochs=1, batch_size=4)
+            scores.append(net.score_value)
+        assert all(np.isfinite(s) for s in scores)
+        assert scores[-1] < scores[0]
+
+    def test_streaming_rejects_padding_mask(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers import TransformerEncoderBlock
+        blk = TransformerEncoderBlock(n_in=8, n_heads=2, causal=True,
+                                      cache_len=8)
+        params = blk.init_params(jax.random.PRNGKey(0))
+        x = jnp.zeros((1, 2, 8))
+        with pytest.raises(ValueError, match="padding mask"):
+            blk.forward_with_carry(params, {}, x, blk.init_carry(1),
+                                   mask=jnp.ones((1, 2)))
