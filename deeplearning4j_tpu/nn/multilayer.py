@@ -571,10 +571,16 @@ class MultiLayerNetwork:
     def rnn_time_step(self, x, data_format=None):
         """Streaming inference carrying RNN state across calls (reference
         `rnnTimeStep` :2605-2673). Accepts [B, F] (single step) or
-        [B, T, F]."""
+        [B, T, F]; for token-id models (embedding first layer over a
+        recurrent input) a rank-2 array is [B, T] ids — including
+        [B, 1] single-step decode — and the KV-cache/positional carries
+        stream exactly like LSTM state."""
         x = _convert_features(x, data_format)
         x = jnp.asarray(x)
-        squeeze = x.ndim == 2
+        ids_input = (len(self.layers) > 0
+                     and getattr(self.layers[0], "time_series_input",
+                                 False))
+        squeeze = x.ndim == 2 and not ids_input
         if squeeze:
             x = x[:, None, :]
         carries = dict(self._rnn_carries)

@@ -418,3 +418,21 @@ class TestTransformerStreamingDepth:
         with pytest.raises(ValueError, match="padding mask"):
             blk.forward_with_carry(params, {}, x, blk.init_carry(1),
                                    mask=jnp.ones((1, 2)))
+
+    def test_rnn_time_step_streams_token_ids(self):
+        # the reference rnnTimeStep API works for transformers too:
+        # rank-2 [B, T] is token ids for embedding-input nets (incl.
+        # [B, 1] single-step decode), not a [B, F] feature row
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+        net = TransformerLM(vocab_size=13, d_model=16, n_layers=1,
+                            n_heads=4, max_len=10, seed=11).init()
+        rng = np.random.default_rng(6)
+        ids = rng.integers(0, 13, (2, 10)).astype(np.float32)
+        full = np.asarray(net.output(ids))
+        net.rnn_clear_previous_state()
+        h = np.asarray(net.rnn_time_step(ids[:, :4]))     # prompt
+        np.testing.assert_allclose(h, full[:, :4], rtol=2e-4, atol=2e-5)
+        for t in range(4, 10):
+            h = np.asarray(net.rnn_time_step(ids[:, t:t + 1]))
+            np.testing.assert_allclose(h[:, 0], full[:, t],
+                                       rtol=2e-4, atol=2e-5)
