@@ -436,3 +436,38 @@ class TestTransformerStreamingDepth:
             h = np.asarray(net.rnn_time_step(ids[:, t:t + 1]))
             np.testing.assert_allclose(h[:, 0], full[:, t],
                                        rtol=2e-4, atol=2e-5)
+
+    def test_generate_topk_topp_filters(self):
+        from deeplearning4j_tpu.zoo.transformer import generate
+        import jax
+        net_lm = __import__("deeplearning4j_tpu.zoo.transformer",
+                            fromlist=["TransformerLM"]).TransformerLM(
+            vocab_size=17, d_model=16, n_layers=1, n_heads=4,
+            max_len=24, seed=13).init()
+        prompt = np.zeros((2, 2), np.int32)
+        k0 = jax.random.PRNGKey(7)
+        # top_k=1 is greedy regardless of temperature
+        a = generate(net_lm, prompt, 6, temperature=1.0, top_k=1, rng=k0)
+        g = generate(net_lm, prompt, 6, temperature=0)
+        np.testing.assert_array_equal(a, g)
+        # no-op filters reproduce unfiltered sampling bit-for-bit
+        b = generate(net_lm, prompt, 6, temperature=1.0, rng=k0)
+        c = generate(net_lm, prompt, 6, temperature=1.0, top_k=17,
+                     rng=k0)
+        d = generate(net_lm, prompt, 6, temperature=1.0, top_p=1.0,
+                     rng=k0)
+        np.testing.assert_array_equal(b, c)
+        np.testing.assert_array_equal(b, d)
+
+    def test_generate_rejects_bad_sampling_args(self):
+        from deeplearning4j_tpu.zoo.transformer import (
+            TransformerLM, generate)
+        net = TransformerLM(vocab_size=17, d_model=16, n_layers=1,
+                            n_heads=4, max_len=24, seed=13).init()
+        prompt = np.zeros((1, 2), np.int32)
+        with pytest.raises(ValueError, match="top_p"):
+            generate(net, prompt, 4, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            generate(net, prompt, 4, top_k=0)
+        with pytest.raises(ValueError, match="top_k"):
+            generate(net, prompt, 4, top_k=99)
