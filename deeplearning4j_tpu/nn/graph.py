@@ -680,10 +680,20 @@ class ComputationGraph:
     def rnn_time_step(self, *inputs, masks=None):
         """Streaming inference carrying RNN state across calls
         (reference `ComputationGraph.rnnTimeStep`). Each input may be
-        [B, F] (single step) or [B, T, F]. Jitted with the carries as
+        [B, F] (single step) or [B, T, F]; inputs consumed by an
+        embedding layer over a recurrent input type are [B, T] token
+        ids — including [B, 1] single-step decode (same disambiguation
+        as MultiLayerNetwork.rnn_time_step). Jitted with the carries as
         arguments so per-token streaming is one compiled dispatch."""
         xs = [jnp.asarray(x) for x in inputs]
-        squeeze = all(x.ndim == 2 for x in xs)
+        # an input feeds token ids iff some layer directly consuming it
+        # was built with time_series_input (embedding over ids)
+        ids_input = any(
+            getattr(n.layer, "time_series_input", False)
+            for n in self.conf.nodes.values()
+            if n.layer is not None
+            and any(src in self.conf.network_inputs for src in n.inputs))
+        squeeze = all(x.ndim == 2 for x in xs) and not ids_input
         if squeeze:
             xs = [x[:, None, :] for x in xs]
         carries = dict(self._rnn_carries)

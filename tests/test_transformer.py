@@ -471,3 +471,39 @@ class TestTransformerStreamingDepth:
             generate(net, prompt, 4, top_k=0)
         with pytest.raises(ValueError, match="top_k"):
             generate(net, prompt, 4, top_k=99)
+
+    def test_graph_rnn_time_step_token_ids(self):
+        # the graph container's rnnTimeStep API streams token-id models
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.common.weights import WeightInit
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import (
+            EmbeddingLayer, PositionalEncodingLayer, RnnOutputLayer,
+            TransformerEncoderBlock)
+        V, T = 13, 8
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+            .weight_init(WeightInit.XAVIER))
+        g.add_inputs("ids")
+        g.add_layer("emb", EmbeddingLayer(n_in=V, n_out=16), "ids")
+        g.add_layer("pos", PositionalEncodingLayer(max_len=T), "emb")
+        g.add_layer("blk", TransformerEncoderBlock(
+            n_heads=4, causal=True, cache_len=T), "pos")
+        g.add_layer("out", RnnOutputLayer(
+            n_out=V, activation="softmax", loss="mcxent"), "blk")
+        g.set_outputs("out")
+        g.set_input_types(InputType.recurrent(V))
+        net = ComputationGraph(g.build()).init(5)
+        rng = np.random.default_rng(8)
+        ids = rng.integers(0, V, (2, T)).astype(np.float32)
+        full = np.asarray(net.output(ids))
+        net.rnn_clear_previous_state()
+        h = np.asarray(net.rnn_time_step(ids[:, :3]))
+        np.testing.assert_allclose(h, full[:, :3], rtol=2e-4, atol=2e-5)
+        for t in range(3, T):
+            h = np.asarray(net.rnn_time_step(ids[:, t:t + 1]))
+            np.testing.assert_allclose(h[:, 0], full[:, t],
+                                       rtol=2e-4, atol=2e-5)
