@@ -106,6 +106,24 @@ class TransformerLM(ZooModel):
         return MultiLayerNetwork(self.conf()).init(self.seed)
 
 
+def _check_cache_budget(net, prompt_len: int, n_tokens: int):
+    """The fixed-size KV caches silently clamp writes past their length
+    (dynamic_update_slice semantics), which would corrupt every token
+    beyond the limit while still emitting valid-looking ids — so both
+    decoders enforce the budget eagerly where the lengths are known."""
+    from deeplearning4j_tpu.nn.layers.transformer import (
+        TransformerEncoderBlock)
+    limits = [layer.cache_len for layer in net.layers
+              if isinstance(layer, TransformerEncoderBlock)]
+    total = prompt_len + n_tokens
+    if limits and total > min(limits):
+        raise ValueError(
+            f"prompt ({prompt_len}) + n_tokens ({n_tokens}) = {total} "
+            f"exceeds the KV cache length {min(limits)} (TransformerLM "
+            f"max_len); decode fewer tokens or rebuild with a larger "
+            f"max_len")
+
+
 def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
              temperature: float = 1.0, top_k: int = None,
              top_p: float = None, rng=None):
@@ -132,21 +150,7 @@ def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
 
     prompt = jnp.asarray(np.asarray(prompt_ids), jnp.float32)
     B = prompt.shape[0]
-    # the fixed-size caches silently clamp writes past their length
-    # (dynamic_update_slice semantics), which would corrupt every token
-    # beyond the limit while still emitting valid-looking ids — so the
-    # budget is enforced eagerly here where both lengths are known
-    from deeplearning4j_tpu.nn.layers.transformer import (
-        TransformerEncoderBlock)
-    limits = [layer.cache_len for layer in net.layers
-              if isinstance(layer, TransformerEncoderBlock)]
-    total = prompt.shape[1] + n_tokens
-    if limits and total > min(limits):
-        raise ValueError(
-            f"prompt ({prompt.shape[1]}) + n_tokens ({n_tokens}) = "
-            f"{total} exceeds the KV cache length {min(limits)} "
-            f"(TransformerLM max_len); decode fewer tokens or rebuild "
-            f"with a larger max_len")
+    _check_cache_budget(net, prompt.shape[1], n_tokens)
     carries = {str(i): layer.init_carry(B, net.dtype.compute_dtype)
                for i, layer in enumerate(net.layers)
                if isinstance(layer, BaseRecurrentLayer)}
@@ -228,3 +232,115 @@ def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
     rng = jax.random.PRNGKey(0) if rng is None else rng
     return np.asarray(decode(net.params, net.net_state, probs, carries,
                              rng, 1.0 if top_p is None else top_p))
+
+
+def beam_search(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
+                beam_width: int = 4, eos_id: int = None):
+    """Beam-search decoding over the same per-layer KV caches as
+    `generate` — the whole search runs as ONE fused `lax.scan` dispatch
+    (beams ride the batch dimension; each step re-gathers every cache
+    by the surviving beams' indices, all static shapes).
+
+    `prompt_ids` [B, T_prompt] int ids → (ids [B, beam_width,
+    n_tokens], log_probs [B, beam_width]) sorted best-first. With
+    `eos_id`, finished beams extend with eos at no cost and keep their
+    score. Deterministic (no rng)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+
+    prompt = jnp.asarray(np.asarray(prompt_ids), jnp.float32)
+    B, Tp = prompt.shape
+    W = int(beam_width)
+    _check_cache_budget(net, Tp, n_tokens)
+    # eager validation (generate's pattern): silent-garbage modes
+    # otherwise — beam_width=0 returns empty arrays, an out-of-range
+    # eos_id never matches any token so EOS handling no-ops
+    if W < 1:
+        raise ValueError(f"beam_width must be >= 1; got {beam_width}")
+    vocab = getattr(net.layers[-1], "n_out", None)
+    if eos_id is not None and vocab and not (0 <= int(eos_id) < vocab):
+        raise ValueError(
+            f"eos_id must be in [0, vocab={vocab}); got {eos_id}")
+
+    jit_cache = net.__dict__.setdefault("_transformer_gen_jit", {})
+    key = ("beam", int(n_tokens), W,
+           None if eos_id is None else int(eos_id))
+    if key not in jit_cache:
+        @jax.jit
+        def search(params, state, prompt, carries0):
+            h, _, carries, _, _ = net._forward_core(
+                params, state, prompt, train=False, rng=None,
+                carries=carries0)
+            logp0 = jnp.log(jnp.clip(h[:, -1], 1e-9, None))  # [B, V]
+            V = logp0.shape[-1]
+            # beams ride the batch dim: replicate the prompt's caches
+            carries = jax.tree_util.tree_map(
+                lambda a: (jnp.repeat(a[:, None], W, 1)
+                           .reshape((B * W,) + a.shape[1:])
+                           if a.ndim > 0 else a),
+                carries)
+            logp = jnp.repeat(logp0[:, None], W, 1)      # [B, W, V]
+            # only beam 0 is live initially (all beams identical after
+            # replication; -inf scores stop duplicate selections)
+            scores = jnp.broadcast_to(
+                jnp.where(jnp.arange(W) == 0, 0.0, -jnp.inf),
+                (B, W))                                  # [B, W]
+            seqs = jnp.zeros((B, W, n_tokens), jnp.int32)
+            fin = jnp.zeros((B, W), bool)
+
+            def body(carry, t):
+                logp, scores, seqs, fin, carries = carry
+                cand = scores[..., None] + logp          # [B, W, V]
+                if eos_id is not None:
+                    # finished beams may only extend with eos, cost 0
+                    only_eos = jnp.full((V,), -jnp.inf
+                                        ).at[eos_id].set(0.0)
+                    cand = jnp.where(fin[..., None],
+                                     scores[..., None] + only_eos, cand)
+                flat = cand.reshape(B, W * V)
+                top_s, top_i = lax.top_k(flat, W)        # [B, W]
+                beam_idx = top_i // V
+                token = top_i % V
+                # re-gather histories and caches by surviving beams
+                seqs = jnp.take_along_axis(
+                    seqs, beam_idx[..., None], axis=1)
+                seqs = lax.dynamic_update_slice_in_dim(
+                    seqs, token[..., None], t, axis=2)
+                fin = jnp.take_along_axis(fin, beam_idx, axis=1)
+                if eos_id is not None:
+                    fin = jnp.logical_or(fin, token == eos_id)
+                gather = jax.vmap(lambda a, i: a[i])     # per batch row
+
+                def regather(a):
+                    if a.ndim == 0:
+                        return a
+                    aw = a.reshape((B, W) + a.shape[1:])
+                    return gather(aw, beam_idx).reshape(a.shape)
+                carries = jax.tree_util.tree_map(regather, carries)
+                h, _, carries, _, _ = net._forward_core(
+                    params, state,
+                    token.reshape(B * W, 1).astype(jnp.float32),
+                    train=False, rng=None, carries=carries)
+                logp = jnp.log(jnp.clip(h[:, -1], 1e-9, None)
+                               ).reshape(B, W, V)
+                return (logp, top_s, seqs, fin, carries), None
+
+            (logp, scores, seqs, fin, carries), _ = lax.scan(
+                body, (logp, scores, seqs, fin, carries),
+                jnp.arange(n_tokens))
+            order = jnp.argsort(-scores, axis=1)
+            return (jnp.take_along_axis(
+                        seqs, order[..., None], axis=1),
+                    jnp.take_along_axis(scores, order, axis=1))
+        jit_cache[key] = search
+    search = jit_cache[key]
+
+    carries0 = {str(i): layer.init_carry(B, net.dtype.compute_dtype)
+                for i, layer in enumerate(net.layers)
+                if isinstance(layer, BaseRecurrentLayer)}
+    ids, scores = search(net.params, net.net_state, prompt, carries0)
+    return np.asarray(ids), np.asarray(scores)
