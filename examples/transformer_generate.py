@@ -37,10 +37,15 @@ def main():
 
     prompt_words = ["the", "reference"]
     prompt = np.array([[idx[w] for w in prompt_words]])
-    out = generate(net, prompt, 24, temperature=0.8,
+    out = generate(net, prompt, 24, temperature=0.8, top_p=0.9,
                    rng=__import__("jax").random.PRNGKey(0))
-    print("generated:", " ".join(prompt_words)
+    print("sampled:", " ".join(prompt_words)
           + " " + " ".join(vocab[i] for i in out[0]))
+
+    from deeplearning4j_tpu.zoo.transformer import beam_search
+    ids, scores = beam_search(net, prompt, 12, beam_width=4)
+    print("best beam (%.2f):" % scores[0, 0], " ".join(prompt_words)
+          + " " + " ".join(vocab[i] for i in ids[0, 0]))
 
 
 if __name__ == "__main__":
