@@ -235,7 +235,8 @@ def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
 
 
 def beam_search(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
-                beam_width: int = 4, eos_id: int = None):
+                beam_width: int = 4, eos_id: int = None,
+                length_penalty: float = 0.0):
     """Beam-search decoding over the same per-layer KV caches as
     `generate` — the whole search runs as ONE fused `lax.scan` dispatch
     (beams ride the batch dimension; each step re-gathers every cache
@@ -244,7 +245,12 @@ def beam_search(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
     `prompt_ids` [B, T_prompt] int ids → (ids [B, beam_width,
     n_tokens], log_probs [B, beam_width]) sorted best-first. With
     `eos_id`, finished beams extend with eos at no cost and keep their
-    score. Deterministic (no rng)."""
+    score. `length_penalty` α ranks the FINAL beams by
+    score / ((5 + len) / 6)^α (the GNMT normalization; len counts
+    tokens up to and incl. eos) — without it, sum-logprob ranking
+    systematically favors short eos'd beams. The returned log_probs
+    stay unnormalized sums (so they remain teacher-forceable);
+    only the ordering changes. Deterministic (no rng)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -267,6 +273,8 @@ def beam_search(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
             f"eos_id must be in [0, vocab={vocab}); got {eos_id}")
 
     jit_cache = net.__dict__.setdefault("_transformer_gen_jit", {})
+    # length_penalty deliberately NOT in the key: the rerank happens
+    # host-side after the scan, so sweeping alpha reuses one executable
     key = ("beam", int(n_tokens), W,
            None if eos_id is None else int(eos_id))
     if key not in jit_cache:
@@ -332,15 +340,23 @@ def beam_search(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
             (logp, scores, seqs, fin, carries), _ = lax.scan(
                 body, (logp, scores, seqs, fin, carries),
                 jnp.arange(n_tokens))
-            order = jnp.argsort(-scores, axis=1)
-            return (jnp.take_along_axis(
-                        seqs, order[..., None], axis=1),
-                    jnp.take_along_axis(scores, order, axis=1))
+            return seqs, scores
         jit_cache[key] = search
     search = jit_cache[key]
 
     carries0 = {str(i): layer.init_carry(B, net.dtype.compute_dtype)
                 for i, layer in enumerate(net.layers)
                 if isinstance(layer, BaseRecurrentLayer)}
-    ids, scores = search(net.params, net.net_state, prompt, carries0)
-    return np.asarray(ids), np.asarray(scores)
+    seqs, scores = search(net.params, net.net_state, prompt, carries0)
+    seqs, scores = np.asarray(seqs), np.asarray(scores)
+    # GNMT length normalization — host-side rerank only (the returned
+    # scores stay raw sums so they remain teacher-forceable)
+    if eos_id is not None:
+        hits = np.cumsum(seqs == eos_id, axis=2) > 0
+        lengths = np.where(hits.any(2), hits.argmax(2) + 1, n_tokens)
+    else:
+        lengths = np.full(scores.shape, n_tokens)
+    norm = ((5.0 + lengths.astype(np.float64)) / 6.0) ** length_penalty
+    order = np.argsort(-scores / norm, axis=1, kind="stable")
+    return (np.take_along_axis(seqs, order[..., None], axis=1),
+            np.take_along_axis(scores, order, axis=1))

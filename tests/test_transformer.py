@@ -554,3 +554,36 @@ class TestTransformerStreamingDepth:
             hits = np.nonzero(seq == 5)[0]
             if hits.size:
                 assert (seq[hits[0]:] == 5).all()
+
+    def test_beam_search_length_penalty(self):
+        from deeplearning4j_tpu.zoo.transformer import (
+            TransformerLM, beam_search)
+        net = TransformerLM(vocab_size=11, d_model=16, n_layers=1,
+                            n_heads=4, max_len=24, seed=3).init()
+        prompt = np.zeros((1, 2), np.int32)
+        # alpha=0 is the unnormalized ordering (argsort of raw scores)
+        ids0, s0 = beam_search(net, prompt, 8, beam_width=3, eos_id=5,
+                               length_penalty=0.0)
+        assert (np.diff(s0, axis=1) <= 1e-6).all()
+        # with alpha the beam SET is unchanged (pure rerank), and the
+        # ORDER must equal the recomputed normalized-score ordering —
+        # this fails if the norm is inverted, multiplied, or lengths
+        # are computed wrong
+        alpha = 1.0
+        ids1, s1 = beam_search(net, prompt, 8, beam_width=3, eos_id=5,
+                               length_penalty=alpha)
+        assert sorted(map(tuple, ids0[0])) == sorted(map(tuple, ids1[0]))
+
+        def norm_score(seq, raw):
+            hit = np.nonzero(seq == 5)[0]
+            L = hit[0] + 1 if hit.size else seq.size
+            return raw / (((5.0 + L) / 6.0) ** alpha)
+
+        ns = [norm_score(ids1[0, w], s1[0, w]) for w in range(3)]
+        assert (np.diff(ns) <= 1e-6).all(), ns
+        # and when beams have different lengths, alpha must actually be
+        # able to change the winner relative to raw ordering whenever
+        # the normalized ordering differs
+        ns0 = [norm_score(ids0[0, w], s0[0, w]) for w in range(3)]
+        if np.argmax(ns0) != 0:
+            assert tuple(ids1[0, 0]) != tuple(ids0[0, 0])
