@@ -32,6 +32,74 @@ from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
 from deeplearning4j_tpu.nn.layers.feedforward import BaseOutputLayerMixin
 
 
+@dataclasses.dataclass
+class DetectedObject:
+    """One detected object, in grid-cell units.
+
+    Reference: `nn/layers/objdetect/DetectedObject.java:17-37` — same
+    fields and accessors (example index within the minibatch, center
+    position + size in grid units, class-probability vector,
+    confidence). With 416x416 input and 32x downsampling the grid is
+    13x13, so center_x 5.5 means 176 px from the left edge.
+    """
+
+    example_number: int
+    center_x: float
+    center_y: float
+    width: float
+    height: float
+    class_predictions: Any   # [C] numpy array of class probabilities
+    confidence: float
+
+    @property
+    def top_left_xy(self):
+        return (self.center_x - self.width / 2.0,
+                self.center_y - self.height / 2.0)
+
+    @property
+    def bottom_right_xy(self):
+        return (self.center_x + self.width / 2.0,
+                self.center_y + self.height / 2.0)
+
+    @property
+    def predicted_class(self) -> int:
+        return int(np.argmax(np.asarray(self.class_predictions)))
+
+
+def iou_xyxy(a, b):
+    """IOU of two (x1, y1, x2, y2) boxes (plain floats, host side)."""
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    area_a = max(0.0, a[2] - a[0]) * max(0.0, a[3] - a[1])
+    area_b = max(0.0, b[2] - b[0]) * max(0.0, b[3] - b[1])
+    return inter / (area_a + area_b - inter + 1e-9)
+
+
+def non_max_suppression(objects, iou_threshold=0.45):
+    """Greedy per-class NMS over `DetectedObject`s (beyond-reference:
+    the 0.9.2 reference stops at thresholded extraction; every practical
+    YOLO deployment needs this next step). Objects from different
+    examples or with different predicted classes never suppress each
+    other. Returns survivors sorted by descending confidence."""
+    remaining = sorted(objects, key=lambda o: -o.confidence)
+    out = []
+    while remaining:
+        best = remaining.pop(0)
+        out.append(best)
+        bb = best.top_left_xy + best.bottom_right_xy
+        key = (best.example_number, best.predicted_class)
+        kept = []
+        for o in remaining:
+            if (o.example_number, o.predicted_class) == key:
+                ob = o.top_left_xy + o.bottom_right_xy
+                if iou_xyxy(bb, ob) >= iou_threshold:
+                    continue
+            kept.append(o)
+        remaining = kept
+    return out
+
+
 @register_layer
 @dataclasses.dataclass(eq=False)
 class Yolo2OutputLayer(Layer, BaseOutputLayerMixin):
@@ -96,6 +164,68 @@ class Yolo2OutputLayer(Layer, BaseOutputLayerMixin):
         cls = jax.nn.softmax(tcls, axis=-1)
         out = jnp.concatenate([cxy, wh, conf, cls], axis=-1)
         return out.reshape(x.shape[0], x.shape[1], x.shape[2], -1), state
+
+    def get_predicted_objects(self, activated_output, threshold):
+        """Decode thresholded detections from `forward()` output.
+
+        Reference: `nn/layers/objdetect/Yolo2OutputLayer.java:610-670`
+        (`getPredictedObjects`) — same contract: minibatch-aware (each
+        `DetectedObject` carries its example index), objects returned
+        where predicted confidence >= threshold, positions/sizes in
+        grid-cell units. Two TPU-first differences: the input here is
+        the NHWC *activated* output `[B, H, W, A*(5+C)]` whose centers
+        already include the grid offset (forward() adds it on device —
+        the reference adds the cell index during this host loop), and
+        the candidate mask is computed vectorized instead of a
+        quadruple-nested scalar loop.
+        """
+        out = np.asarray(activated_output)
+        if out.ndim != 4:
+            raise ValueError(
+                "Invalid network output activations array: should be rank 4 "
+                f"[B, H, W, A*(5+C)]. Got shape {out.shape}")
+        if not 0.0 <= float(threshold) <= 1.0:
+            raise ValueError(
+                f"Invalid threshold: must be in range [0,1]. Got: {threshold}")
+        b, h, w, d = out.shape
+        a = self.n_anchors
+        per = d // a
+        if per < 5 or d % a:
+            raise ValueError(
+                f"Output depth {d} incompatible with {a} anchors: need "
+                "A*(5+C) channels")
+        grid = out.reshape(b, h, w, a, per)
+        conf = grid[..., 4]
+        idx = np.argwhere(conf >= float(threshold))
+        detections = []
+        for (i, y, x, box) in idx:
+            cell = grid[i, y, x, box]
+            detections.append(DetectedObject(
+                example_number=int(i),
+                center_x=float(cell[0]), center_y=float(cell[1]),
+                width=float(cell[2]), height=float(cell[3]),
+                class_predictions=np.array(cell[5:]),
+                confidence=float(conf[i, y, x, box])))
+        return detections
+
+    def get_confidence_matrix(self, activated_output, example, anchor):
+        """[H, W] confidence map for one example + anchor (reference
+        `getConfidenceMatrix`, NHWC layout)."""
+        out = np.asarray(activated_output)
+        b, h, w, d = out.shape
+        per = d // self.n_anchors
+        return out.reshape(b, h, w, self.n_anchors, per)[example, :, :, anchor, 4]
+
+    def get_probability_matrix(self, activated_output, example, class_number):
+        """[H, W] per-class probability map, max over anchors (reference
+        `getProbabilityMatrix`; its layout holds one shared class block —
+        here classes are per-anchor, so the anchor axis is reduced)."""
+        out = np.asarray(activated_output)
+        b, h, w, d = out.shape
+        per = d // self.n_anchors
+        probs = out.reshape(b, h, w, self.n_anchors, per)[
+            example, :, :, :, 5 + class_number]
+        return probs.max(axis=-1)
 
     def compute_loss(self, params, state, x, labels, *, train=True, rng=None, mask=None):
         txy, twh, tconf, tcls = self._split(x)

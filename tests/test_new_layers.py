@@ -263,6 +263,95 @@ class TestYolo2:
         clone = layer_from_dict(yolo.to_dict())
         assert clone.anchors == yolo.anchors
 
+    def _golden_logits(self, b=2, h=4, w=4):
+        """Raw activations engineered so exactly two cells cross a 0.5
+        confidence threshold, with known decoded boxes (golden fixture,
+        mirroring `Yolo2OutputLayer.java:610-670` semantics)."""
+        a, c = len(self.A), self.C
+        x = np.full((b, h, w, a * (5 + c)), -6.0, np.float32)  # conf≈0.0025
+        per = 5 + c
+        # example 0, cell (y=1, x=2), anchor 1: conf≈0.88
+        cell = x[0, 1, 2]
+        cell[1 * per + 0] = 0.0      # tx → sigmoid=0.5 → cx = 2.5
+        cell[1 * per + 1] = 0.0      # ty → cy = 1.5
+        cell[1 * per + 2] = 0.0      # tw → w = anchor_w * e^0 = 2.5
+        cell[1 * per + 3] = 0.0      # th → h = 1.5
+        cell[1 * per + 4] = 2.0      # conf = sigmoid(2) ≈ 0.8808
+        cell[1 * per + 5 + 3] = 4.0  # class 3 dominates the softmax
+        # example 1, cell (y=3, x=0), anchor 0: conf≈0.73
+        cell = x[1, 3, 0]
+        cell[0 * per + 0] = 2.0      # cx = 0 + sigmoid(2) ≈ 0.8808
+        cell[0 * per + 1] = -2.0     # cy = 3 + sigmoid(-2) ≈ 3.1192
+        cell[0 * per + 2] = np.log(2.0)   # w = 1.0 * 2 = 2.0
+        cell[0 * per + 3] = np.log(0.5)   # h = 1.0 * 0.5 = 0.5
+        cell[0 * per + 4] = 1.0      # conf ≈ 0.7311
+        cell[0 * per + 5 + 1] = 4.0  # class 1
+        return jnp.asarray(x)
+
+    def test_get_predicted_objects_golden(self):
+        yolo = self._make()
+        out, _ = yolo.forward({}, {}, self._golden_logits())
+        dets = yolo.get_predicted_objects(out, 0.5)
+        assert len(dets) == 2
+        dets.sort(key=lambda d: d.example_number)
+        d0, d1 = dets
+        assert d0.example_number == 0 and d1.example_number == 1
+        sig2 = 1 / (1 + np.exp(-2.0))
+        np.testing.assert_allclose(
+            [d0.center_x, d0.center_y, d0.width, d0.height],
+            [2.5, 1.5, 2.5, 1.5], atol=1e-5)
+        np.testing.assert_allclose(d0.confidence, sig2, atol=1e-5)
+        assert d0.predicted_class == 3
+        np.testing.assert_allclose(
+            [d1.center_x, d1.center_y, d1.width, d1.height],
+            [sig2, 3 + (1 - sig2), 2.0, 0.5], atol=1e-5)
+        assert d1.predicted_class == 1
+        # accessor parity with DetectedObject.java getTopLeftXY/BottomRight
+        np.testing.assert_allclose(d0.top_left_xy, (2.5 - 1.25, 1.5 - 0.75))
+        np.testing.assert_allclose(d0.bottom_right_xy, (3.75, 2.25))
+        np.testing.assert_allclose(np.sum(d0.class_predictions), 1.0,
+                                   rtol=1e-5)
+
+    def test_get_predicted_objects_threshold_and_validation(self):
+        import pytest
+        yolo = self._make()
+        out, _ = yolo.forward({}, {}, self._golden_logits())
+        assert len(yolo.get_predicted_objects(out, 0.9)) == 0
+        # threshold 0 returns every anchor of every cell
+        assert len(yolo.get_predicted_objects(out, 0.0)) == 2 * 4 * 4 * 2
+        with pytest.raises(ValueError, match="rank 4"):
+            yolo.get_predicted_objects(np.zeros((4, 4, 18)), 0.5)
+        with pytest.raises(ValueError, match="threshold"):
+            yolo.get_predicted_objects(out, 1.5)
+
+    def test_confidence_and_probability_matrices(self):
+        yolo = self._make()
+        out, _ = yolo.forward({}, {}, self._golden_logits())
+        conf = yolo.get_confidence_matrix(out, 0, 1)
+        assert conf.shape == (4, 4)
+        assert abs(conf[1, 2] - 1 / (1 + np.exp(-2.0))) < 1e-5
+        prob = yolo.get_probability_matrix(out, 0, 3)
+        assert prob.shape == (4, 4)
+        assert prob[1, 2] > 0.9          # engineered class-3 peak
+
+    def test_non_max_suppression(self):
+        from deeplearning4j_tpu.nn.layers.objdetect import (
+            DetectedObject, non_max_suppression)
+        mk = lambda ex, cx, conf, cls: DetectedObject(  # noqa: E731
+            ex, cx, 1.0, 2.0, 2.0, np.eye(4)[cls], conf)
+        objs = [
+            mk(0, 1.0, 0.9, 0),   # keeper
+            mk(0, 1.4, 0.8, 0),   # overlaps keeper, same class → suppressed
+            mk(0, 1.4, 0.7, 1),   # overlaps but different class → kept
+            mk(1, 1.0, 0.6, 0),   # different example → kept
+            mk(0, 8.0, 0.5, 0),   # far away → kept
+        ]
+        kept = non_max_suppression(objs, iou_threshold=0.3)
+        assert len(kept) == 4
+        assert all(k.confidence != 0.8 for k in kept)
+        assert [k.confidence for k in kept] == sorted(
+            [k.confidence for k in kept], reverse=True)
+
 
 # ----------------------------------------------------------- dropout family
 class TestDropoutFamily:
