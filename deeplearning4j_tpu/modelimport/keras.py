@@ -370,6 +370,39 @@ class KerasModelImport:
             raise ValueError("Not a Sequential model")
         return model
 
+    @staticmethod
+    def import_keras_configuration(path):
+        """Architecture only, no weights (reference
+        `importKerasModelConfiguration` / `importKerasSequentialConfiguration`,
+        `KerasModelImport.java:50-194`): accepts a bare `model.to_json()`
+        architecture file or an .h5 whose `model_config` attribute is
+        read — returns the mapped configuration object."""
+        with open(path, "rb") as f:
+            magic = f.read(8)
+        if magic == b"\x89HDF\r\n\x1a\n":
+            with Hdf5Archive(path) as h5:
+                config = h5.read_attr_string("model_config")
+                if config is None:
+                    raise ValueError(f"{path}: no model_config attribute")
+                model_dict = json.loads(config)
+        else:
+            with open(path, "r", errors="replace") as f:
+                model_dict = json.loads(f.read())
+        return KerasModelImport.config_from_dict(model_dict)
+
+    @staticmethod
+    def config_from_dict(model_dict, training_config=None):
+        """Keras architecture dict → our configuration object (the
+        config-only half of the import: same layer mapping, no weight
+        copy)."""
+        if model_dict.get("class_name") == "Sequential":
+            net = KerasModelImport._import_sequential(
+                model_dict, None, training_config)
+        else:
+            net = KerasModelImport._import_functional(
+                model_dict, None, training_config)
+        return net.conf
+
     # -------------------------------------------------------- sequential
     @staticmethod
     def _layer_list(model_dict):
@@ -410,7 +443,7 @@ class KerasModelImport:
             ordering = (lc.get("config") or {}).get("dim_ordering")
             if ordering in ("th", "tf"):
                 return ordering == "tf"
-        backend = h5.read_attr_string("backend")
+        backend = h5.read_attr_string("backend") if h5 is not None else None
         if backend:
             return backend == "tensorflow"
         return (model_dict.get("class_name") != "Sequential"
@@ -493,7 +526,8 @@ class KerasModelImport:
             conf.input_preprocessors.values(),
             KerasModelImport._channels_last(model_dict, h5))
         net = MultiLayerNetwork(conf).init()
-        KerasModelImport._copy_weights_mln(net, h5, keras_names)
+        if h5 is not None:
+            KerasModelImport._copy_weights_mln(net, h5, keras_names)
         return net
 
     # -------------------------------------------------------- functional
@@ -606,7 +640,8 @@ class KerasModelImport:
              if n.preprocessor is not None],
             KerasModelImport._channels_last(model_dict, h5))
         net = ComputationGraph(conf).init()
-        KerasModelImport._copy_weights_graph(net, h5, keras_names)
+        if h5 is not None:
+            KerasModelImport._copy_weights_graph(net, h5, keras_names)
         return net
 
     # ----------------------------------------------------- weights-only h5

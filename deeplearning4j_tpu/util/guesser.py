@@ -1,28 +1,141 @@
-"""ModelGuesser — sniff a file and load the right model type.
+"""ModelGuesser — sniff a file and load the right model/config type.
 
-Reference: `deeplearning4j-core/util/ModelGuesser.java` (194 LoC):
-tries MultiLayerNetwork / ComputationGraph checkpoint formats, then
-Keras .h5.
+Reference: `deeplearning4j-core/util/ModelGuesser.java:1-194`, which
+exposes three facades: `loadConfigGuess` (MultiLayerConfiguration JSON
+→ Keras config → ComputationGraphConfiguration JSON → YAML variants),
+`loadModelGuess` (checkpoint zip as MLN/CG → Keras .h5 model), and
+`loadNormalizer`. The reference discriminates formats by chained
+try/except over full loads; here cheap content sniffing (zip/HDF5
+magic bytes, JSON `format` tag) routes first and the exception chain
+is only the fallback — same outcomes, no loading a 500 MB checkpoint
+twice to find out what it is.
+
+Beyond the reference's `loadModelGuess`, a bare config JSON/YAML file
+is also accepted and returns an **initialized** (randomly-weighted)
+network, so every file class this module understands yields a usable
+model object.
 """
 
 from __future__ import annotations
 
+import json
 import zipfile
 from pathlib import Path
+
+_HDF5_MAGIC = b"\x89HDF\r\n\x1a\n"
+
+
+def _read_text(path) -> str:
+    with open(path, "r", errors="replace") as f:
+        return f.read()
+
+
+def _parse_config_text(text: str):
+    """Config text → configuration object (reference loadConfigGuess
+    chain: MLN JSON, Keras config, CG JSON, then the YAML variants)."""
+    from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+
+    try:
+        d = json.loads(text)
+    except json.JSONDecodeError:
+        d = None
+        try:  # YAML fallback (reference fromYaml) — gated: pyyaml optional
+            import yaml
+            d = yaml.safe_load(text)
+        except ImportError:
+            pass
+        except Exception:
+            d = None
+    if not isinstance(d, dict):
+        raise ValueError("not a JSON/YAML mapping")
+
+    if d.get("class_name") in ("Sequential", "Model", "Functional"):
+        # Keras architecture JSON (model.to_json()) — config only, no
+        # weights (reference importKerasModelConfiguration)
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        return KerasModelImport.config_from_dict(d)
+
+    fmt = str(d.get("format", ""))
+    errors = []
+    if "ComputationGraph" in fmt:
+        order = (ComputationGraphConfiguration, MultiLayerConfiguration)
+    else:
+        order = (MultiLayerConfiguration, ComputationGraphConfiguration)
+    for cls in order:
+        try:
+            return cls.from_dict(d)
+        except Exception as e:
+            errors.append(f"{cls.__name__}: {type(e).__name__}: {e}")
+    raise ValueError("config JSON matched no known format: "
+                     + "; ".join(errors))
 
 
 class ModelGuesser:
     @staticmethod
-    def load_model_guess(path):
+    def load_config_guess(path):
+        """File → configuration object (MultiLayerConfiguration,
+        ComputationGraphConfiguration, or a Keras-derived config).
+        Reference `ModelGuesser.loadConfigGuess`."""
+        path = Path(path)
+        if zipfile.is_zipfile(path):
+            # a checkpoint also *contains* its config — return it
+            with zipfile.ZipFile(path) as zf:
+                if "configuration.json" in zf.namelist():
+                    return _parse_config_text(
+                        zf.read("configuration.json").decode())
+            raise ValueError(f"{path}: zip without configuration.json")
+        with open(path, "rb") as f:
+            if f.read(8) == _HDF5_MAGIC:
+                from deeplearning4j_tpu.modelimport.keras import (
+                    KerasModelImport)
+                return KerasModelImport.import_keras_configuration(path)
+        return _parse_config_text(_read_text(path))
+
+    @staticmethod
+    def load_model_guess(path, load_updater: bool = True):
+        """File → loaded model. Order (reference loadModelGuess):
+        framework checkpoint zip (MLN or CG, with then without updater
+        state), Keras HDF5 with weights; beyond-reference: bare config
+        JSON/YAML returns an initialized network."""
         path = Path(path)
         if zipfile.is_zipfile(path):
             from deeplearning4j_tpu.util.serializer import ModelSerializer
-            return ModelSerializer.restore_model(path)
-        # HDF5 magic: \x89HDF\r\n\x1a\n
+            try:
+                return ModelSerializer.restore_model(
+                    path, load_updater=load_updater)
+            except Exception:
+                # reference retry: a checkpoint whose updater state
+                # can't restore still yields a usable model
+                if load_updater:
+                    return ModelSerializer.restore_model(
+                        path, load_updater=False)
+                raise
         with open(path, "rb") as f:
             magic = f.read(8)
-        if magic == b"\x89HDF\r\n\x1a\n":
+        if magic == _HDF5_MAGIC:
             from deeplearning4j_tpu.modelimport import KerasModelImport
             return KerasModelImport.import_keras_model_and_weights(path)
+        conf = _parse_config_text(_read_text(path))
+        return ModelGuesser._init_from_config(conf)
+
+    @staticmethod
+    def _init_from_config(conf):
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        if isinstance(conf, ComputationGraphConfiguration):
+            return ComputationGraph(conf).init()
+        if isinstance(conf, MultiLayerConfiguration):
+            return MultiLayerNetwork(conf).init()
         raise ValueError(
-            f"{path}: not a framework checkpoint (zip) or Keras HDF5 file")
+            f"Config of type {type(conf).__name__} has no runtime "
+            "container to initialize")
+
+    @staticmethod
+    def load_normalizer(path):
+        """Restore the normalizer packaged inside a model zip, or None
+        (reference `ModelGuesser.loadNormalizer` facade)."""
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+        return ModelSerializer.restore_normalizer_from_file(path)

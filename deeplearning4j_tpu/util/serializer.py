@@ -123,3 +123,30 @@ class ModelSerializer:
             model.iteration_count = meta.get("iteration_count", 0)
             model.epoch_count = meta.get("epoch_count", 0)
             return model
+
+    # --------------------------------------------------- normalizers
+    # Reference: ModelSerializer.addNormalizerToModel /
+    # restoreNormalizerFromFile — the fitted preprocessing statistics
+    # travel INSIDE the model zip so serving uses the exact training
+    # normalization.
+
+    @staticmethod
+    def add_normalizer_to_model(path: Union[str, Path], normalizer):
+        meta, arrays = normalizer.state()
+        with zipfile.ZipFile(path, "a", zipfile.ZIP_DEFLATED) as zf:
+            if "normalizer-meta.json" in zf.namelist():
+                raise ValueError(
+                    f"{path} already contains a normalizer; write the model "
+                    "again to replace it")
+            zf.writestr("normalizer-meta.json", json.dumps(meta))
+            _save_npz(zf, "normalizer.npz", arrays)
+
+    @staticmethod
+    def restore_normalizer_from_file(path: Union[str, Path]):
+        from deeplearning4j_tpu.datasets.normalizers import normalizer_from_meta
+        with zipfile.ZipFile(path, "r") as zf:
+            if "normalizer-meta.json" not in zf.namelist():
+                return None
+            meta = json.loads(zf.read("normalizer-meta.json"))
+            arrays = _load_npz(zf, "normalizer.npz")
+        return normalizer_from_meta(meta, arrays)

@@ -182,6 +182,173 @@ class TestModelGuesser:
         with pytest.raises(ValueError):
             ModelGuesser.load_model_guess(bad)
 
+    def _mln_conf(self):
+        return (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+
+    def test_config_guess_mln_json(self, tmp_path):
+        p = tmp_path / "conf.json"
+        p.write_text(self._mln_conf().to_json())
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        conf = ModelGuesser.load_config_guess(p)
+        assert isinstance(conf, MultiLayerConfiguration)
+        assert len(conf.layers) == 2
+
+    def test_config_guess_graph_json(self, tmp_path):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        g = (ComputationGraphConfiguration.graph_builder(
+                NeuralNetConfiguration.builder().updater(Adam(1e-3)))
+             .add_inputs("in")
+             .add_layer("d", DenseLayer(n_in=4, n_out=8), "in")
+             .add_layer("out", OutputLayer(n_in=8, n_out=2), "d")
+             .set_outputs("out"))
+        conf = g.build()
+        p = tmp_path / "graph.json"
+        p.write_text(conf.to_json())
+        guessed = ModelGuesser.load_config_guess(p)
+        assert isinstance(guessed, ComputationGraphConfiguration)
+        # beyond-ref: model guess on a config file → initialized net
+        net = ModelGuesser.load_model_guess(p)
+        assert isinstance(net, ComputationGraph)
+        assert net.params  # initialized
+
+    def test_config_guess_from_checkpoint_zip(self, tmp_path):
+        net = MultiLayerNetwork(self._mln_conf()).init()
+        ckpt = tmp_path / "m.zip"
+        ModelSerializer.write_model(net, ckpt)
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        conf = ModelGuesser.load_config_guess(ckpt)
+        assert isinstance(conf, MultiLayerConfiguration)
+
+    def test_config_guess_keras_architecture_json(self, tmp_path):
+        import json
+        arch = {"class_name": "Sequential", "config": [
+            {"class_name": "Dense", "config": {
+                "name": "d", "units": 3, "activation": "relu",
+                "use_bias": True, "batch_input_shape": [None, 4]}}]}
+        p = tmp_path / "arch.json"
+        p.write_text(json.dumps(arch))
+        conf = ModelGuesser.load_config_guess(p)
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        assert isinstance(conf, MultiLayerConfiguration)
+        # model guess on the architecture file gives an initialized net
+        net = ModelGuesser.load_model_guess(p)
+        assert isinstance(net, MultiLayerNetwork)
+
+    def test_model_guess_initializes_from_mln_config(self, tmp_path):
+        p = tmp_path / "conf.json"
+        p.write_text(self._mln_conf().to_json())
+        net = ModelGuesser.load_model_guess(p)
+        assert isinstance(net, MultiLayerNetwork)
+        out = net.output(np.zeros((2, 4), np.float32))
+        assert out.shape == (2, 2)
+
+
+class TestNormalizers:
+    def _batches(self, n=5, b=16, f=3, seed=0):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.default_rng(seed)
+        return [DataSet(rng.normal(2.0, 3.0, (b, f)).astype(np.float32)
+                        * np.array([1.0, 10.0, 0.1], np.float32))
+                for _ in range(n)]
+
+    def test_standardize_streaming_matches_full_batch(self):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        batches = self._batches()
+        full = np.concatenate([b.features for b in batches])
+        norm = NormalizerStandardize().fit(batches)
+        np.testing.assert_allclose(norm.mean, full.mean(0), rtol=1e-6)
+        np.testing.assert_allclose(norm.std, full.std(0), rtol=1e-5)
+        z = norm.transform(full)
+        np.testing.assert_allclose(z.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(z.std(0), 1.0, atol=1e-4)
+        back = norm.revert(z)
+        np.testing.assert_allclose(back, full, atol=1e-4)
+
+    def test_standardize_rank4_reduces_to_channels(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(4, 8, 8, 3)).astype(np.float32)
+        norm = NormalizerStandardize().fit(DataSet(x))
+        assert norm.mean.shape == (3,)
+        np.testing.assert_allclose(norm.mean, x.mean((0, 1, 2)), rtol=1e-5)
+
+    def test_minmax_scaler(self):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerMinMaxScaler)
+        batches = self._batches()
+        full = np.concatenate([b.features for b in batches])
+        norm = NormalizerMinMaxScaler(-1.0, 1.0).fit(batches)
+        z = norm.transform(full)
+        np.testing.assert_allclose(z.min(0), -1.0, atol=1e-5)
+        np.testing.assert_allclose(z.max(0), 1.0, atol=1e-5)
+        np.testing.assert_allclose(norm.revert(z), full, atol=1e-3)
+
+    def test_image_scaler_stateless(self):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler)
+        norm = ImagePreProcessingScaler(0.0, 1.0)
+        x = np.array([[0, 127.5, 255]], np.float32)
+        np.testing.assert_allclose(norm.transform(x), [[0, 0.5, 1.0]])
+        np.testing.assert_allclose(norm.revert(norm.transform(x)), x)
+
+    def test_pre_process_hook_mutates_dataset(self):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        batches = self._batches(n=2)
+        norm = NormalizerStandardize().fit(batches)
+        ds = batches[0]
+        norm.pre_process(ds)
+        assert abs(float(ds.features.mean())) < 1.0
+
+    def test_normalizer_travels_inside_model_zip(self, tmp_path):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_in=3, n_out=4))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        ckpt = tmp_path / "m.zip"
+        ModelSerializer.write_model(net, ckpt)
+        norm = NormalizerStandardize().fit(self._batches())
+        ModelSerializer.add_normalizer_to_model(ckpt, norm)
+        # double-add is an error (reference replaces via re-save)
+        with pytest.raises(ValueError, match="already contains"):
+            ModelSerializer.add_normalizer_to_model(ckpt, norm)
+        # model still restores; normalizer restores beside it
+        restored_net = ModelSerializer.restore_model(ckpt)
+        assert isinstance(restored_net, MultiLayerNetwork)
+        restored = ModelGuesser.load_normalizer(ckpt)
+        np.testing.assert_allclose(restored.mean, norm.mean)
+        np.testing.assert_allclose(restored.std, norm.std)
+        # zip without a normalizer → None
+        bare = tmp_path / "bare.zip"
+        ModelSerializer.write_model(net, bare)
+        assert ModelGuesser.load_normalizer(bare) is None
+
+    def test_minmax_and_image_persist_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler, NormalizerMinMaxScaler,
+            normalizer_from_meta)
+        norm = NormalizerMinMaxScaler(0.0, 2.0).fit(self._batches())
+        meta, arrays = norm.state()
+        clone = normalizer_from_meta(meta, arrays)
+        x = self._batches(n=1)[0].features
+        np.testing.assert_allclose(clone.transform(x), norm.transform(x))
+        img = ImagePreProcessingScaler(-1.0, 1.0, bits=16)
+        meta, arrays = img.state()
+        clone = normalizer_from_meta(meta, arrays)
+        assert clone.bits == 16 and clone.a == -1.0
+
 
 class TestNewListeners:
     def test_sleepy_and_param_listeners(self):
