@@ -272,6 +272,7 @@ class ComputationGraph:
             getattr(n.layer, "sequence_parallel", None)
             for n in conf.nodes.values() if n.layer is not None)
         self._rnn_carries: Dict[str, Any] = {}
+        self._rnn_stream_pos = 0  # host-side stream-budget tracker
         self.output_layer_names = [
             n for n in conf.network_outputs
             if conf.nodes[n].kind == "layer"
@@ -648,6 +649,14 @@ class ComputationGraph:
         T = max(x.shape[1] for x in xs if x.ndim == 3)
         L = self.conf.tbptt_fwd_length
         batch = xs[0].shape[0]
+        budget = self._stream_budget()
+        if budget is not None and T > budget:
+            raise ValueError(
+                f"TBPTT over a {T}-step sequence exceeds the bounded "
+                f"carry budget {budget} (min over transformer cache_len "
+                f"/ positional max_len): chunks past the budget would "
+                f"silently clamp into the KV cache. Shorten the "
+                f"sequences or rebuild with cache_len/max_len >= {T}.")
         carries = {n: layer.init_carry(batch, self.dtype.compute_dtype)
                    for n, layer in self._recurrent_nodes()}
 
@@ -676,6 +685,29 @@ class ComputationGraph:
     # ------------------------------------------------------ rnn streaming
     def rnn_clear_previous_state(self):
         self._rnn_carries = {}
+        self._rnn_stream_pos = 0
+
+    def _stream_budget(self):
+        if getattr(self, "_stream_budget_cache", None) is None:
+            from deeplearning4j_tpu.nn.layers.transformer import (
+                stream_budget)
+            self._stream_budget_cache = (stream_budget(
+                [n.layer for n in self.conf.nodes.values()
+                 if n.layer is not None]),)
+        return self._stream_budget_cache[0]
+
+    def _check_stream_budget(self, new_tokens: int):
+        """Bounded-carry guard — see
+        `MultiLayerNetwork._check_stream_budget`."""
+        budget = self._stream_budget()
+        pos = getattr(self, "_rnn_stream_pos", 0)
+        if budget is not None and pos + new_tokens > budget:
+            raise ValueError(
+                f"rnn_time_step has streamed {pos} positions and this call "
+                f"adds {new_tokens}, exceeding the stream budget {budget} "
+                f"(min over transformer cache_len / positional max_len). "
+                f"Call rnn_clear_previous_state() to start a new sequence, "
+                f"or rebuild with a larger cache_len/max_len.")
 
     def rnn_time_step(self, *inputs, masks=None):
         """Streaming inference carrying RNN state across calls
@@ -686,16 +718,31 @@ class ComputationGraph:
         as MultiLayerNetwork.rnn_time_step). Jitted with the carries as
         arguments so per-token streaming is one compiled dispatch."""
         xs = [jnp.asarray(x) for x in inputs]
-        # an input feeds token ids iff some layer directly consuming it
-        # was built with time_series_input (embedding over ids)
-        ids_input = any(
-            getattr(n.layer, "time_series_input", False)
-            for n in self.conf.nodes.values()
-            if n.layer is not None
-            and any(src in self.conf.network_inputs for src in n.inputs))
-        squeeze = all(x.ndim == 2 for x in xs) and not ids_input
-        if squeeze:
-            xs = [x[:, None, :] for x in xs]
+        # an input feeds token ids iff some layer directly consuming
+        # THAT input was built with time_series_input (embedding over
+        # ids) — decided per input, so a graph mixing an id input with
+        # a rank-2 [B, F] feature input still squeezes the feature one.
+        # Pure function of the (fixed) config — cached: this sits on
+        # the per-token decode path
+        if getattr(self, "_ids_by_input", None) is None:
+            self._ids_by_input = {
+                inp: any(getattr(n.layer, "time_series_input", False)
+                         for n in self.conf.nodes.values()
+                         if n.layer is not None and inp in n.inputs)
+                for inp in self.conf.network_inputs}
+        ids_by_input = self._ids_by_input
+        squeezed = [x.ndim == 2 and not ids_by_input.get(inp, False)
+                    for inp, x in zip(self.conf.network_inputs, xs)]
+        xs = [x[:, None, :] if sq else x for sq, x in zip(squeezed, xs)]
+        squeeze = any(squeezed)   # single-step call → outputs drop T
+        # new positions this call = longest time axis among the
+        # sequence inputs (rank-3 [B,T,F] or rank-2 id [B,T]; a rank-4
+        # conv input has no time axis and is not counted)
+        t_new = 1
+        for inp, x in zip(self.conf.network_inputs, xs):
+            if x.ndim == 3 or (x.ndim == 2 and ids_by_input.get(inp, False)):
+                t_new = max(t_new, int(x.shape[1]))
+        self._check_stream_budget(t_new)
         carries = dict(self._rnn_carries)
         batch = xs[0].shape[0]
         for n, layer in self._recurrent_nodes():
@@ -712,6 +759,7 @@ class ComputationGraph:
         acts, carries = self._jit_rnn_step(self.params, self.net_state,
                                            tuple(xs), masks, carries)
         self._rnn_carries.update(carries)
+        self._rnn_stream_pos = getattr(self, "_rnn_stream_pos", 0) + t_new
         outs = []
         for n in self.conf.network_outputs:
             h = acts[n]

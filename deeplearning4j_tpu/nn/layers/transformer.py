@@ -256,3 +256,22 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
                                      None if rng is None
                                      else jax.random.fold_in(rng, 3))
         return x + h, (k_cache, v_cache, pos + x.shape[1])
+
+
+def stream_budget(layers):
+    """Smallest bounded stream length in a layer stack, or None.
+
+    KV caches (`TransformerEncoderBlock.cache_len`) and positional
+    tables (`PositionalEncodingLayer.max_len`) both clamp writes/reads
+    past their length (dynamic_update_slice / dynamic_slice semantics)
+    — silently corrupting every later token while still emitting
+    valid-looking activations. Streaming entry points (`rnn_time_step`,
+    TBPTT drivers, zoo generate/beam_search) call this to enforce the
+    budget eagerly on the host, where the accumulated position is
+    known."""
+    limits = [l.cache_len for l in layers
+              if isinstance(l, TransformerEncoderBlock)]
+    limits += [l.max_len for l in layers
+               if isinstance(l, PositionalEncodingLayer)
+               and l.max_len is not None]
+    return min(limits) if limits else None

@@ -95,6 +95,7 @@ class MultiLayerNetwork:
         self.listeners: List[TrainingListener] = []
         self.score_value: float = float("nan")
         self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep streaming state
+        self._rnn_stream_pos = 0  # host-side stream-budget tracker
         self._jit_train_step = None
         self._jit_tbptt_step = None
         self._jit_multi_step = None
@@ -458,6 +459,15 @@ class MultiLayerNetwork:
         MultiLayerNetwork.java:1393)."""
         T = x.shape[1]
         L = self.conf.tbptt_fwd_length
+        from deeplearning4j_tpu.nn.layers.transformer import stream_budget
+        budget = stream_budget(self.layers)
+        if budget is not None and T > budget:
+            raise ValueError(
+                f"TBPTT over a {T}-step sequence exceeds the bounded "
+                f"carry budget {budget} (min over transformer cache_len "
+                f"/ positional max_len): chunks past the budget would "
+                f"silently clamp into the KV cache. Shorten the "
+                f"sequences or rebuild with cache_len/max_len >= {T}.")
         carries = {}
         for i, layer in enumerate(self.layers):
             if isinstance(layer, BaseRecurrentLayer):
@@ -567,6 +577,27 @@ class MultiLayerNetwork:
     # ------------------------------------------------------ rnn streaming
     def rnn_clear_previous_state(self):
         self._rnn_carries = {}
+        self._rnn_stream_pos = 0
+
+    def _check_stream_budget(self, new_tokens: int):
+        """Bounded-carry guard: KV caches / positional tables clamp
+        writes past their length, so streaming beyond the budget would
+        silently corrupt outputs. Tracked host-side because the carry's
+        device-side position cannot raise (same rule the zoo generate /
+        beam_search paths enforce via `_check_cache_budget`)."""
+        if getattr(self, "_stream_budget_cache", None) is None:
+            from deeplearning4j_tpu.nn.layers.transformer import (
+                stream_budget)
+            self._stream_budget_cache = (stream_budget(self.layers),)
+        budget = self._stream_budget_cache[0]
+        pos = getattr(self, "_rnn_stream_pos", 0)
+        if budget is not None and pos + new_tokens > budget:
+            raise ValueError(
+                f"rnn_time_step has streamed {pos} positions and this call "
+                f"adds {new_tokens}, exceeding the stream budget {budget} "
+                f"(min over transformer cache_len / positional max_len). "
+                f"Call rnn_clear_previous_state() to start a new sequence, "
+                f"or rebuild with a larger cache_len/max_len.")
 
     def rnn_time_step(self, x, data_format=None):
         """Streaming inference carrying RNN state across calls (reference
@@ -583,6 +614,11 @@ class MultiLayerNetwork:
         squeeze = x.ndim == 2 and not ids_input
         if squeeze:
             x = x[:, None, :]
+        # time extent of this call: rank-2 (ids [B,T]) and rank-3
+        # ([B,T,F]) carry a time axis at dim 1; a rank-4 conv frame
+        # does not — it is ONE streamed position
+        t_new = int(x.shape[1]) if x.ndim in (2, 3) else 1
+        self._check_stream_budget(t_new)
         carries = dict(self._rnn_carries)
         for i, layer in enumerate(self.layers):
             if isinstance(layer, BaseRecurrentLayer) and str(i) not in carries:
@@ -596,6 +632,7 @@ class MultiLayerNetwork:
         h, new_carries = self._jit_rnn_step(self.params, self.net_state, x,
                                             carries)
         self._rnn_carries.update(new_carries)
+        self._rnn_stream_pos = getattr(self, "_rnn_stream_pos", 0) + t_new
         return h[:, -1, :] if squeeze and h.ndim == 3 else h
 
     # -------------------------------------------------------- param access
@@ -620,9 +657,13 @@ class MultiLayerNetwork:
         clone = MultiLayerNetwork(MultiLayerConfiguration.from_dict(self.conf.to_dict()),
                                  self.dtype)
         if self._initialized:
-            clone.params = jax.tree_util.tree_map(lambda a: a, self.params)
-            clone.net_state = jax.tree_util.tree_map(lambda a: a, self.net_state)
-            clone.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+            # fresh buffers, not aliases: fit() donates its argument
+            # arrays to XLA, which would delete a shared buffer out
+            # from under whichever of original/clone trains second
+            clone.params = jax.tree_util.tree_map(jnp.array, self.params)
+            clone.net_state = jax.tree_util.tree_map(jnp.array, self.net_state)
+            clone.updater_state = jax.tree_util.tree_map(
+                jnp.array, self.updater_state)
             clone._initialized = True
         return clone
 

@@ -111,17 +111,15 @@ def _check_cache_budget(net, prompt_len: int, n_tokens: int):
     (dynamic_update_slice semantics), which would corrupt every token
     beyond the limit while still emitting valid-looking ids — so both
     decoders enforce the budget eagerly where the lengths are known."""
-    from deeplearning4j_tpu.nn.layers.transformer import (
-        TransformerEncoderBlock)
-    limits = [layer.cache_len for layer in net.layers
-              if isinstance(layer, TransformerEncoderBlock)]
+    from deeplearning4j_tpu.nn.layers.transformer import stream_budget
+    budget = stream_budget(net.layers)
     total = prompt_len + n_tokens
-    if limits and total > min(limits):
+    if budget is not None and total > budget:
         raise ValueError(
             f"prompt ({prompt_len}) + n_tokens ({n_tokens}) = {total} "
-            f"exceeds the KV cache length {min(limits)} (TransformerLM "
-            f"max_len); decode fewer tokens or rebuild with a larger "
-            f"max_len")
+            f"exceeds the decode budget {budget} (min over KV cache "
+            f"lengths and positional-encoding max_len); decode fewer "
+            f"tokens or rebuild with a larger max_len")
 
 
 def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,

@@ -92,8 +92,9 @@ class TestFlashAttention:
     @pytest.mark.parametrize("blocks", [(32, 32), (16, 64), (64, 16)])
     def test_backward_parity(self, qkv, causal, blocks):
         # exercises BOTH Pallas backward kernels (dq and dk/dv) against
-        # the XLA vjp across unequal block sizes — the lcm repadding
-        # path in _flash_backward included (T=64 with bq=16/bk=64)
+        # the XLA vjp across unequal block sizes — q-time and k-time are
+        # padded independently per kernel (T=64 with bq=16/bk=64 pads
+        # each axis to its own block multiple)
         q, k, v = qkv
         bq, bk = blocks
 

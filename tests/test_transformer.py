@@ -437,6 +437,98 @@ class TestTransformerStreamingDepth:
             np.testing.assert_allclose(h[:, 0], full[:, t],
                                        rtol=2e-4, atol=2e-5)
 
+    def test_rnn_time_step_enforces_stream_budget(self):
+        # streaming past cache_len used to silently clamp the last KV
+        # slot (dynamic_update_slice) and corrupt later outputs; now
+        # the host-side position tracker raises at the entry point
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+        net = TransformerLM(vocab_size=13, d_model=16, n_layers=1,
+                            n_heads=4, max_len=6, seed=11).init()
+        ids = np.zeros((1, 4), np.float32)
+        net.rnn_clear_previous_state()
+        net.rnn_time_step(ids)                     # pos → 4
+        net.rnn_time_step(ids[:, :2])              # pos → 6 (== budget)
+        with pytest.raises(ValueError, match="stream budget"):
+            net.rnn_time_step(ids[:, :1])
+        # a new sequence resets the tracker
+        net.rnn_clear_previous_state()
+        net.rnn_time_step(ids)
+        # an over-budget single call also raises
+        net.rnn_clear_previous_state()
+        with pytest.raises(ValueError, match="stream budget"):
+            net.rnn_time_step(np.zeros((1, 7), np.float32))
+
+    def test_tbptt_rejects_sequences_beyond_cache(self):
+        from deeplearning4j_tpu.nn.conf.builder import BackpropType
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import (
+            EmbeddingLayer, RnnOutputLayer, TransformerEncoderBlock)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers import DenseLayer
+        V, T = 7, 12
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_in=V, n_out=8))
+                .layer(TransformerEncoderBlock(n_heads=2, causal=True,
+                                               cache_len=8))
+                .layer(RnnOutputLayer(n_out=V, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(V))
+                .backprop_type(BackpropType.TRUNCATED_BPTT, 4)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.zeros((2, T, V), np.float32)    # rank-3 → TBPTT chunking
+        y = np.zeros((2, T, V), np.float32)
+        y[..., 0] = 1.0
+        with pytest.raises(ValueError, match="carry budget"):
+            net.fit(x, y, epochs=1, batch_size=2)
+
+    def test_graph_mixed_id_and_feature_inputs_squeeze_per_input(self):
+        # advisor scenario: a graph mixing a token-id input with a
+        # rank-2 [B, F] feature input must squeeze only the feature one
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+        from deeplearning4j_tpu.nn.layers import (
+            EmbeddingLayer, LSTM, RnnOutputLayer)
+        V, D = 11, 6
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-3)))
+        g.add_inputs("ids", "feat")
+        g.add_layer("emb", EmbeddingLayer(n_in=V, n_out=D), "ids")
+        # the feature input feeds a recurrent consumer directly: at
+        # rnn_time_step a rank-2 [B, F] here is ONE timestep and must
+        # be expanded to [B, 1, F] even though an id input coexists
+        # (the old global flag disabled the squeeze for all inputs)
+        g.add_layer("rnn2", LSTM(n_in=4, n_out=D), "feat")
+        g.add_vertex("cat", MergeVertex(), "emb", "rnn2")
+        g.add_layer("rnn", LSTM(n_in=2 * D, n_out=D), "cat")
+        g.add_layer("out", RnnOutputLayer(n_out=V, activation="softmax",
+                                          loss="mcxent"), "rnn")
+        g.set_outputs("out")
+        g.set_input_types(InputType.recurrent(V),
+                          InputType.recurrent(4))
+        net = ComputationGraph(g.build()).init(3)
+        # full-sequence reference
+        T = 5
+        rng = np.random.default_rng(4)
+        ids_seq = rng.integers(0, V, (2, T)).astype(np.float32)
+        feat_seq = rng.standard_normal((2, T, 4)).astype(np.float32)
+        full = np.asarray(net.output(ids_seq, feat_seq))
+        # stream one step at a time: ids as [B,1], features as [B,F]
+        net.rnn_clear_previous_state()
+        for t in range(T):
+            out = np.asarray(net.rnn_time_step(
+                ids_seq[:, t:t + 1], feat_seq[:, t]))
+            assert out.shape == (2, V)
+            np.testing.assert_allclose(out, full[:, t], rtol=2e-4,
+                                       atol=2e-5)
+
     def test_generate_topk_topp_filters(self):
         from deeplearning4j_tpu.zoo.transformer import generate
         import jax
