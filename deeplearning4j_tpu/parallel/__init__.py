@@ -34,6 +34,10 @@ from deeplearning4j_tpu.parallel.tensor import (
     tp_param_specs,
 )
 from deeplearning4j_tpu.parallel.pipeline import pipeline_apply, pipeline_forward
+from deeplearning4j_tpu.parallel.pipeline_container import (
+    PipelineParallelTrainer,
+    find_homogeneous_run,
+)
 from deeplearning4j_tpu.parallel.master import (
     ParameterAveragingTrainingMaster,
     SharedTrainingMaster,

@@ -30,20 +30,46 @@ from deeplearning4j_tpu.optimize.listeners import ComposedListeners
 
 
 def tp_param_specs(model, model_axis: str = "model",
-                   shard_output_layer: bool = False) -> Dict:
-    """PartitionSpec tree matching model.params (MultiLayerNetwork)."""
-    n_layers = len(model.layers)
+                   shard_output_layer: bool = False,
+                   axis_size: Optional[int] = None) -> Dict:
+    """PartitionSpec tree matching `model.params` for BOTH containers.
+
+    MultiLayerNetwork params are keyed by layer index; ComputationGraph
+    params by node name (output detection switches accordingly). Every
+    ≥2-D param shards its LAST axis — Dense "W" [in, out] and conv HWIO
+    "W" [H, W, I, O] both put output features last, so one rule covers
+    MLPs and conv stacks; 1-D params (biases, BN gamma/beta — per
+    output channel) follow on their only axis. `axis_size` (pass the
+    mesh's model-axis extent) gates sharding on divisibility: an axis
+    the mesh does not divide evenly stays replicated rather than
+    tripping GSPMD's uneven-partition restrictions.
+    """
+    if hasattr(model, "layers"):        # MultiLayerNetwork
+        n_layers = len(model.layers)
+
+        def is_output(lk):
+            return int(lk) == n_layers - 1
+    else:                                # ComputationGraph
+        outputs = set(model.conf.network_outputs)
+
+        def is_output(lk):
+            return lk in outputs
+
+    def divides(dim):
+        return axis_size is None or (dim % axis_size == 0)
+
     specs: Dict[str, Dict] = {}
     for lk, lparams in model.params.items():
-        is_output = int(lk) == n_layers - 1 and not shard_output_layer
+        replicate_all = is_output(lk) and not shard_output_layer
         lspec = {}
         for pn, arr in lparams.items():
-            if is_output or np.ndim(arr) == 0:
+            nd = np.ndim(arr)
+            if replicate_all or nd == 0 or not divides(np.shape(arr)[-1]):
                 lspec[pn] = P()
-            elif np.ndim(arr) == 1:
+            elif nd == 1:
                 lspec[pn] = P(model_axis)
             else:
-                lspec[pn] = P(*([None] * (np.ndim(arr) - 1) + [model_axis]))
+                lspec[pn] = P(*([None] * (nd - 1) + [model_axis]))
         specs[lk] = lspec
     return specs
 
@@ -87,8 +113,20 @@ class ShardedParallelTrainer:
         self.model_axis = model_axis
         if not model._initialized:
             model.init()
-        self.param_specs = param_specs or tp_param_specs(model, model_axis)
+        if param_specs is None:
+            ax = (int(mesh.shape[model_axis])
+                  if model_axis in mesh.shape else None)
+            param_specs = tp_param_specs(model, model_axis, axis_size=ax)
+        self.param_specs = param_specs
         self._step = None
+        # ComputationGraph models pack features/labels as tuples
+        self._is_graph = not hasattr(model, "_forward_core")
+        if self._is_graph and (len(model.conf.network_inputs) != 1
+                               or len(model.conf.network_outputs) != 1):
+            raise NotImplementedError(
+                "ShardedParallelTrainer supports single-input single-"
+                "output graphs; train multi-io graphs via "
+                "ParallelTrainer or model.fit")
 
     def _sharding(self, spec):
         return NamedSharding(self.mesh, spec)
@@ -115,8 +153,14 @@ class ShardedParallelTrainer:
         model = self.model
         raw_step = model._make_train_step(tbptt=False)
 
-        def step(params, upd, state, it, x, y, rng):
-            return raw_step(params, upd, state, it, x, y, rng, None, None, None)
+        if self._is_graph:
+            def step(params, upd, state, it, x, y, rng):
+                return raw_step(params, upd, state, it, (x,), (y,), rng,
+                                (None,), (None,), None)
+        else:
+            def step(params, upd, state, it, x, y, rng):
+                return raw_step(params, upd, state, it, x, y, rng,
+                                None, None, None)
 
         self._build_shardings()
         self._step = jax.jit(

@@ -235,6 +235,121 @@ class TestTensorParallel:
         assert s1 < s0
 
     @requires_8dev
+    def test_tp_conv_bn_model_matches_single_device(self):
+        """TP over a conv+BN stack (HWIO kernels sharded on output
+        channels, BN gamma/beta on the channel axis): GSPMD invariance
+        on the real CNN param set, not just Dense 'W'."""
+        from deeplearning4j_tpu.nn.layers import (
+            BatchNormalization, ConvolutionLayer, SubsamplingLayer)
+
+        def build():
+            conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+                    .list()
+                    .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                            activation="identity",
+                                            has_bias=False))
+                    .layer(BatchNormalization(activation="relu"))
+                    .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                    .layer(DenseLayer(n_out=16, activation="relu"))
+                    .layer(OutputLayer(n_out=3))
+                    .set_input_type(InputType.convolutional(8, 8, 2)).build())
+            return MultiLayerNetwork(conf).init()
+
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((16, 8, 8, 2)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+
+        single = build()
+        single.fit(x, y, epochs=2, batch_size=16)
+        sharded = build()
+        mesh = make_mesh(MeshSpec.of(data=1, model=2))
+        specs = tp_param_specs(sharded, axis_size=2)
+        # conv kernel sharded on its LAST (output-channel) axis; BN
+        # per-channel params follow on their only axis
+        assert specs["0"]["W"] == jax.sharding.PartitionSpec(
+            None, None, None, "model")
+        assert specs["1"]["gamma"] == jax.sharding.PartitionSpec("model")
+        ShardedParallelTrainer(sharded, mesh).fit(x, y, epochs=2,
+                                                  batch_size=16)
+        for lk in single.params:
+            for pn in single.params[lk]:
+                np.testing.assert_allclose(
+                    np.asarray(single.params[lk][pn]),
+                    np.asarray(sharded.params[lk][pn]),
+                    rtol=2e-4, atol=2e-5, err_msg=f"{lk}:{pn}")
+        # BN running stats advanced identically too
+        for lk in single.net_state:
+            for pn in single.net_state[lk]:
+                np.testing.assert_allclose(
+                    np.asarray(single.net_state[lk][pn]),
+                    np.asarray(sharded.net_state[lk][pn]),
+                    rtol=2e-4, atol=2e-5)
+
+    @requires_8dev
+    def test_tp_graph_container_matches_single_device(self):
+        """DP x TP through a ComputationGraph (residual conv+BN block —
+        the ResNet pattern) via the public ShardedParallelTrainer."""
+        from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.layers import (
+            BatchNormalization, ConvolutionLayer, GlobalPoolingLayer)
+
+        def build():
+            g = (ComputationGraphConfiguration.graph_builder(
+                    NeuralNetConfiguration.builder().seed(9)
+                    .updater(Adam(1e-2)))
+                 .add_inputs("in"))
+            g.add_layer("conv1", ConvolutionLayer(
+                n_out=4, kernel_size=(3, 3), activation="identity",
+                has_bias=False, convolution_mode="same"), "in")
+            g.add_layer("bn1", BatchNormalization(activation="relu"), "conv1")
+            g.add_layer("conv2", ConvolutionLayer(
+                n_out=4, kernel_size=(3, 3), activation="identity",
+                has_bias=False, convolution_mode="same"), "bn1")
+            g.add_vertex("res", ElementWiseVertex(op="add"), "conv2", "bn1")
+            g.add_layer("pool", GlobalPoolingLayer(), "res")
+            g.add_layer("out", OutputLayer(n_out=3), "pool")
+            g.set_outputs("out")
+            g.set_input_types(InputType.convolutional(8, 8, 4))
+            return ComputationGraph(g.build()).init(9)
+
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((16, 8, 8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+
+        single = build()
+        single.fit(x, y, epochs=2, batch_size=16)
+        sharded = build()
+        mesh = make_mesh(MeshSpec.of(data=4, model=2))
+        specs = tp_param_specs(sharded, axis_size=2)
+        # node-name keys; the output node stays replicated
+        assert specs["conv1"]["W"] == jax.sharding.PartitionSpec(
+            None, None, None, "model")
+        assert specs["out"]["W"] == jax.sharding.PartitionSpec()
+        ShardedParallelTrainer(sharded, mesh).fit(x, y, epochs=2,
+                                                  batch_size=16)
+        for lk in single.params:
+            for pn in single.params[lk]:
+                np.testing.assert_allclose(
+                    np.asarray(single.params[lk][pn]),
+                    np.asarray(sharded.params[lk][pn]),
+                    rtol=2e-4, atol=2e-5, err_msg=f"{lk}:{pn}")
+
+    @requires_8dev
+    def test_tp_specs_respect_divisibility(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=12, n_out=7, activation="relu"))
+                .layer(OutputLayer(n_in=7, n_out=4))
+                .set_input_type(InputType.feed_forward(12)).build())
+        net = MultiLayerNetwork(conf).init()
+        specs = tp_param_specs(net, axis_size=2)
+        # 7 outputs do not divide a 2-way model axis → replicated
+        assert specs["0"]["W"] == jax.sharding.PartitionSpec()
+        assert specs["0"]["b"] == jax.sharding.PartitionSpec()
+
+    @requires_8dev
     def test_tp_matches_single_device(self):
         """TP sharding must not change the math (GSPMD invariance)."""
         def build():
@@ -531,3 +646,133 @@ class TestShardedTrainerEvaluate:
         assert ev.total == 67
         np.testing.assert_array_equal(ev.confusion.matrix,
                                       host.confusion.matrix)
+
+
+class TestPipelineContainer:
+    """Container-level GPipe (PipelineParallelTrainer): a real zoo
+    TransformerLM stage-partitioned over the 'pipe' axis through the
+    public API, with single-device parity (SURVEY §2.13 PP gap)."""
+
+    def _lm(self, n_layers=4, seed=3):
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+        return TransformerLM(vocab_size=12, d_model=16, n_layers=n_layers,
+                             n_heads=4, max_len=8, seed=seed).init()
+
+    def _data(self, B=8, T=8, V=12, seed=0):
+        rng = np.random.default_rng(seed)
+        ids = rng.integers(0, V, (B, T)).astype(np.float32)
+        y = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+        return ids, y
+
+    def test_find_homogeneous_run_on_transformer_lm(self):
+        from deeplearning4j_tpu.parallel import find_homogeneous_run
+        net = self._lm()
+        r0, r1 = find_homogeneous_run(net)
+        # embedding, posenc | 4 encoder blocks | rnn output
+        assert (r0, r1) == (2, 6)
+
+    @requires_8dev
+    def test_pp_loss_and_grads_match_sequential(self):
+        from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+        net = self._lm()
+        ids, y = self._data()
+        mesh = make_mesh(MeshSpec.of(pipe=4))
+        tr = PipelineParallelTrainer(net, mesh, microbatches=4)
+        l_pp, _ = tr._pp_loss(net.params, net.net_state,
+                              jnp.asarray(ids), jnp.asarray(y), None)
+        l_ref, _ = net._loss_fn(net.params, net.net_state,
+                                jnp.asarray(ids), jnp.asarray(y),
+                                None, None, None, train=True)
+        # the GPipe schedule computes the SAME function
+        np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-6)
+        g_pp = jax.grad(lambda p: tr._pp_loss(
+            p, net.net_state, jnp.asarray(ids), jnp.asarray(y), None)[0])(
+                net.params)
+        g_ref = jax.grad(lambda p: net._loss_fn(
+            p, net.net_state, jnp.asarray(ids), jnp.asarray(y),
+            None, None, None, train=True)[0])(net.params)
+        for lk in g_ref:
+            for pn in g_ref[lk]:
+                np.testing.assert_allclose(
+                    np.asarray(g_pp[lk][pn]), np.asarray(g_ref[lk][pn]),
+                    rtol=1e-4, atol=1e-5, err_msg=f"{lk}:{pn}")
+
+    @requires_8dev
+    def test_pp_sgd_step_matches_single_device(self):
+        """With SGD (no adaptive-moment amplification of fp reordering
+        noise) one PP train step reproduces the sequential container's
+        updated params tightly."""
+        from deeplearning4j_tpu.common.updaters import Sgd
+        from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+
+        def build():
+            lm = TransformerLM(vocab_size=12, d_model=16, n_layers=4,
+                               n_heads=4, max_len=8, seed=3)
+            net = lm.init()
+            for layer in net.layers:
+                layer.updater = Sgd(0.05)
+            return net
+
+        ids, y = self._data()
+        single = build()
+        single.fit(ids, y, epochs=1, batch_size=8)
+        pp = build()
+        mesh = make_mesh(MeshSpec.of(pipe=4))
+        PipelineParallelTrainer(pp, mesh, microbatches=4).fit(
+            ids, y, epochs=1, batch_size=8)
+        np.testing.assert_allclose(pp.score_value, single.score_value,
+                                   rtol=1e-5)
+        for lk in single.params:
+            for pn in single.params[lk]:
+                np.testing.assert_allclose(
+                    np.asarray(pp.params[lk][pn]),
+                    np.asarray(single.params[lk][pn]),
+                    rtol=2e-4, atol=1e-6, err_msg=f"{lk}:{pn}")
+
+    @requires_8dev
+    def test_pp_training_converges(self):
+        from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+        net = self._lm()
+        ids, y = self._data(B=16)
+        mesh = make_mesh(MeshSpec.of(pipe=2))
+        tr = PipelineParallelTrainer(net, mesh, microbatches=4)
+        tr.fit(ids, y, epochs=1, batch_size=16)
+        s0 = net.score_value
+        tr.fit(ids, y, epochs=5, batch_size=16)
+        assert net.score_value < s0
+
+    @requires_8dev
+    def test_pp_validates_stage_partition(self):
+        from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+        net = self._lm(n_layers=3)
+        with pytest.raises(ValueError, match="divide"):
+            PipelineParallelTrainer(net, make_mesh(MeshSpec.of(pipe=2)))
+        net2 = self._lm(n_layers=2)
+        with pytest.raises(ValueError, match="fewer than"):
+            PipelineParallelTrainer(net2, make_mesh(MeshSpec.of(pipe=4)))
+
+    @requires_8dev
+    def test_pp_rejects_dropout_in_run(self):
+        from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+        net = self._lm()
+        from deeplearning4j_tpu.nn.conf.dropout import Dropout
+        # every block stochastic → the homogeneous run itself carries
+        # dropout and must be rejected (a single odd block would just
+        # fall out of the run — config is part of the signature)
+        for i in range(2, 6):
+            net.layers[i].dropout = Dropout(0.5)
+        with pytest.raises(ValueError, match="dropout"):
+            PipelineParallelTrainer(net, make_mesh(MeshSpec.of(pipe=2)))
+
+    @requires_8dev
+    def test_pp_config_differences_split_run(self):
+        """Blocks with identical param shapes but different configs
+        must NOT merge into one run (the stage executes all blocks
+        through the first layer's forward)."""
+        from deeplearning4j_tpu.parallel import find_homogeneous_run
+        net = self._lm()
+        net.layers[3].n_heads = 2   # same shapes, different attention
+        net.layers[3]._mha = None   # force sublayer rebuild
+        r0, r1 = find_homogeneous_run(net)
+        assert (r1 - r0) < 4        # the modified block broke the run
