@@ -43,6 +43,13 @@ def normalizer_from_meta(meta: dict, arrays: dict) -> "Normalizer":
     return cls._from_state(meta, arrays)
 
 
+def _float_dtype(x: np.ndarray):
+    """Normalized output is always floating point — casting a
+    standardized batch back to the input's uint8 would truncate/wrap
+    it into garbage (the reference normalizers yield float too)."""
+    return x.dtype if np.issubdtype(x.dtype, np.floating) else np.float32
+
+
 def _reduce_axes(x: np.ndarray):
     """All axes except the feature axis. Convention: rank-2 [B, F] and
     rank-3 [B, T, F] are feature-last (this repo's NHWC/[B,T,F]
@@ -136,12 +143,12 @@ class NormalizerStandardize(Normalizer):
         self.std = np.sqrt(np.clip(var, 1e-12, None))
 
     def transform(self, features):
-        return ((np.asarray(features) - self.mean) / self.std).astype(
-            np.asarray(features).dtype)
+        x = np.asarray(features)
+        return ((x - self.mean) / self.std).astype(_float_dtype(x))
 
     def revert(self, features):
-        return (np.asarray(features) * self.std + self.mean).astype(
-            np.asarray(features).dtype)
+        x = np.asarray(features)
+        return (x * self.std + self.mean).astype(_float_dtype(x))
 
     def state(self):
         return {"kind": self.kind}, {"mean": self.mean, "std": self.std}
@@ -189,12 +196,12 @@ class NormalizerMinMaxScaler(Normalizer):
         x = np.asarray(features)
         unit = (x - self.data_min) / self._span()
         out = unit * (self.max_range - self.min_range) + self.min_range
-        return out.astype(x.dtype)
+        return out.astype(_float_dtype(x))
 
     def revert(self, features):
         x = np.asarray(features)
         unit = (x - self.min_range) / (self.max_range - self.min_range)
-        return (unit * self._span() + self.data_min).astype(x.dtype)
+        return (unit * self._span() + self.data_min).astype(_float_dtype(x))
 
     def state(self):
         return ({"kind": self.kind, "min_range": self.min_range,

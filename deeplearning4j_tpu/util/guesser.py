@@ -104,12 +104,18 @@ class ModelGuesser:
             try:
                 return ModelSerializer.restore_model(
                     path, load_updater=load_updater)
-            except Exception:
+            except Exception as first:
                 # reference retry: a checkpoint whose updater state
-                # can't restore still yields a usable model
+                # can't restore still yields a usable model — but if
+                # the retry fails too, surface the ORIGINAL error (the
+                # retry's failure is usually a symptom of the same
+                # corruption and would mask the real cause)
                 if load_updater:
-                    return ModelSerializer.restore_model(
-                        path, load_updater=False)
+                    try:
+                        return ModelSerializer.restore_model(
+                            path, load_updater=False)
+                    except Exception:
+                        raise first
                 raise
         with open(path, "rb") as f:
             magic = f.read(8)

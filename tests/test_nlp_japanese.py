@@ -1,0 +1,137 @@
+"""Japanese morphological segmentation through the TokenizerFactory
+seam (reference role: deeplearning4j-nlp-japanese bundles Kuromoji).
+Mirrors tests/test_nlp_cjk.py: proves the lattice+Viterbi segmenter
+drives vocabulary construction and Word2Vec end-to-end over raw
+(unspaced) Japanese text."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.japanese import (
+    JapaneseSegmenter,
+    JapaneseTokenizerFactory,
+    load_seed_dictionary,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+def corpus():
+    # skipgram geometry: words become syn0-similar by SHARING CONTEXTS,
+    # not by co-occurring (direct co-occurrence aligns a word's syn0
+    # with the other's syn1). The probe pairs (猫/犬, 銀行/会社) appear
+    # in parallel sentence frames and never in the same sentence.
+    animals = [
+        "猫は魚を食べる", "犬は肉を食べる", "兎はりんごを食べる",
+        "猫は公園で遊んだ", "犬は公園で遊んだ", "兎は庭で遊んだ",
+        "猫は可愛い動物です", "犬は可愛い動物です", "兎は可愛い動物です",
+        "猫は水を飲んだ", "犬は水を飲んだ",
+        "猫は家で走った", "犬は家で走った",
+    ]
+    finance = [
+        "銀行は株に投資する", "会社は株に投資する",
+        "銀行は経済に投資する", "会社は経済に投資する",
+        "株価が今日上がった", "価格が今日上がった",
+        "株価が市場で下がった", "価格が市場で下がった",
+        "銀行はお金を買った", "会社はお金を買った",
+        "株価が市場で上がった", "価格が今日下がった",
+    ]
+    return (animals + finance) * 6
+
+
+class TestJapaneseSegmenter:
+    def setup_method(self):
+        self.seg = JapaneseSegmenter()
+
+    def test_segments_particles_and_inflections(self):
+        assert self.seg.segment("猫は魚を食べる") == \
+            ["猫", "は", "魚", "を", "食べる"]
+        assert self.seg.segment("株価が上がった") == ["株価", "が", "上がった"]
+
+    def test_pos_tags(self):
+        toks = self.seg.tokenize_with_pos("銀行の投資は高いです")
+        assert toks == [("銀行", "noun"), ("の", "particle"),
+                        ("投資", "noun"), ("は", "particle"),
+                        ("高い", "adj"), ("です", "aux")]
+
+    def test_lattice_resolves_ambiguity(self):
+        # 庭(noun)+に(particle) vs にわとり(noun): the connection costs
+        # must pick the reading consistent with the particle context
+        toks = self.seg.segment("猫とにわとりが庭にいる")
+        assert "にわとり" in toks and "庭" in toks
+
+    def test_unknown_katakana_run_groups(self):
+        toks = self.seg.tokenize_with_pos("私はトヨタの株を買った")
+        assert ("トヨタ", "unk") in toks
+
+    def test_unknown_latin_and_digit_runs(self):
+        toks = self.seg.segment("ABCは東京で123円")
+        assert "ABC" in toks and "123" in toks and "円" in toks
+
+    def test_unknown_kanji_falls_to_singles(self):
+        toks = self.seg.segment("猫が鮫を見た")   # 鮫 is OOV kanji
+        assert "鮫" in toks
+
+    def test_punctuation_splits(self):
+        toks = self.seg.segment("猫は魚、犬は肉。")
+        assert "、" not in toks and "。" not in toks
+        assert toks.count("は") == 2
+
+    def test_user_dictionary_extends_seed(self):
+        seg = JapaneseSegmenter(
+            user_entries=[("深層学習", "noun", 2500.0)])
+        assert "深層学習" in seg.segment("深層学習は新しいです")
+
+    def test_seed_dictionary_loads(self):
+        d = load_seed_dictionary()
+        assert len(d) > 80
+        assert any(pos == "particle" for pos, _ in d["は"])
+
+
+class TestJapaneseTokenizerFactory:
+    def test_seam_contract(self):
+        tf = JapaneseTokenizerFactory()
+        tok = tf.create("猫は魚を食べる")
+        assert tok.count_tokens() == 5
+        assert tok.next_token() == "猫"
+
+    def test_preprocessor_applied(self):
+        from deeplearning4j_tpu.nlp.tokenization import TokenPreProcess
+
+        class Tag(TokenPreProcess):
+            def pre_process(self, t):
+                return f"<{t}>"
+
+        tf = JapaneseTokenizerFactory().set_token_pre_processor(Tag())
+        assert tf.create("猫は魚").get_tokens() == ["<猫>", "<は>", "<魚>"]
+
+
+class TestJapaneseWord2Vec:
+    def test_ja_corpus_trains_with_topic_structure(self):
+        """Word2Vec over raw Japanese sentences via the morphological
+        factory with POS filtering (the standard kuromoji preprocessing
+        for embedding corpora): words sharing sentence frames must
+        cluster — impossible unless the lattice produced real
+        morphemes. Seed-pinned like the other small-corpus embedding
+        fixtures (skipgram on ~150 sentences is seed-noisy)."""
+        from deeplearning4j_tpu.nlp.japanese import CONTENT_POS
+        w2v = Word2Vec(
+            sentence_iterator=corpus(),
+            tokenizer_factory=JapaneseTokenizerFactory(
+                pos_keep=CONTENT_POS),
+            layer_size=24, window_size=3, min_word_frequency=2,
+            negative_sample=5, learning_rate=0.05, epochs=16,
+            batch_size=128, seed=7)
+        w2v.fit()
+        assert w2v.has_word("株価") and w2v.has_word("猫")
+        # no whole-sentence tokens leaked into the vocab, and the POS
+        # filter kept particles out of it
+        assert not w2v.has_word("猫は魚を食べる")
+        assert not w2v.has_word("は") and not w2v.has_word("を")
+        # context-sharing probes: 銀行/会社 and 猫/犬 appear in parallel
+        # frames and never co-occur — skipgram must align them
+        assert w2v.similarity("銀行", "会社") > w2v.similarity("銀行", "猫")
+        assert w2v.similarity("猫", "犬") > w2v.similarity("猫", "株価")
+        near = w2v.words_nearest("銀行", top_n=6)
+        finance = {"会社", "株価", "市場", "価格", "株", "投資", "経済",
+                   "お金"}
+        assert len(finance.intersection(near)) >= 2, near
