@@ -391,6 +391,25 @@ class KerasModelImport:
         return KerasModelImport.config_from_dict(model_dict)
 
     @staticmethod
+    def import_architecture_and_weights(arch, weights_path):
+        """Architecture JSON (file path or dict) + a separate
+        weights-only .h5 (the keras-applications distribution split:
+        `model.to_json()` beside `save_weights` output). Weight copy is
+        BY KERAS LAYER NAME, so it is robust to the file's layer order.
+        Reference: `KerasModelImport.importKerasModelAndWeights(
+        modelJsonFilename, weightsHdf5Filename)` overload
+        (`KerasModelImport.java:103-140`)."""
+        if isinstance(arch, (str, bytes)) or hasattr(arch, "__fspath__"):
+            with open(arch, "r") as f:
+                model_dict = json.loads(f.read())
+        else:
+            model_dict = arch
+        with Hdf5Archive(weights_path) as h5:
+            if model_dict.get("class_name") == "Sequential":
+                return KerasModelImport._import_sequential(model_dict, h5)
+            return KerasModelImport._import_functional(model_dict, h5)
+
+    @staticmethod
     def config_from_dict(model_dict, training_config=None):
         """Keras architecture dict → our configuration object (the
         config-only half of the import: same layer mapping, no weight

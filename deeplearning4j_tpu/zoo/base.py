@@ -70,6 +70,15 @@ class ZooModel:
     # pretrained_url/pretrained_checksum instead
     packaged: dict = {}
 
+    # {PretrainedType: packaged architecture-JSON filename} — for
+    # weights-only keras-applications payloads whose architecture does
+    # NOT match this zoo model's own builder (e.g. keras ResNet50's
+    # explicit ZeroPadding + biased convs vs the zoo's SAME-padded
+    # bias-free builder): the committed `model.to_json()` is the
+    # ground-truth graph the weights belong to, and the import copies
+    # by keras layer name through it
+    keras_architecture: dict = {}
+
     def pretrained_url(self, ptype: PretrainedType) -> Optional[str]:
         name = self.packaged.get(ptype)
         return packaged_weight(name)[0] if name else None
@@ -114,6 +123,13 @@ class ZooModel:
                 full_model = h5.read_attr_string("model_config") is not None
             if full_model:
                 return KerasModelImport.import_keras_model_and_weights(str(dest))
+            arch_name = self.keras_architecture.get(ptype)
+            if arch_name:
+                # weights-only payload + committed keras architecture
+                # JSON: build the ground-truth graph and copy by name
+                arch_path = Path(__file__).parent / "weights" / arch_name
+                return KerasModelImport.import_architecture_and_weights(
+                    arch_path, str(dest))
             # weights-only file (keras-applications format): build this
             # zoo model's own architecture and order-match the weights
             net = self.init()

@@ -98,6 +98,12 @@ class ResNet50(ZooModel):
 
     # Keras-applications hosted weights (reference `ZooModel.java:52-81`
     # pretrainedUrl + checksum pattern); md5 from keras-applications.
+    # The payload is weights-only, and keras ResNet50 (explicit
+    # ZeroPadding + biased convs) differs from this builder, so the
+    # committed `model.to_json()` architecture routes the import.
+    keras_architecture = {PretrainedType.IMAGENET:
+                          "resnet50_keras_arch.json"}
+
     def pretrained_url(self, ptype):
         if ptype == PretrainedType.IMAGENET:
             return ("https://storage.googleapis.com/tensorflow/"

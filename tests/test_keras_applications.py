@@ -1,0 +1,153 @@
+"""Reference-scale pretrained proof: genuine keras-applications
+architectures + externally-produced weight files flow through the zoo
+`init_pretrained` path with golden activation parity against Keras
+itself (reference `ZooModel.java:52-81` + `KerasModelImport.java`).
+
+Offline protocol (zero-egress sandbox): the weights are generated at
+test time by the REAL keras 3 library — the genuine keras-applications
+ResNet50/VGG16 graphs, saved in the exact legacy HDF5 layout the
+keras-applications download distributes (`legacy_h5_format`) — and
+served to `init_pretrained` through a file:// URL with a real md5
+checksum. Everything from the checksum gate to the name-matched weight
+copy is the production path; only the transport is local. The hosted
+URLs + published md5s stay wired in the zoo classes for online use.
+
+Marked slow: building keras models + a 550 MB VGG16 h5 costs ~2-4 min.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def tmp_cache(tmp_path_factory):
+    """Redirect the zoo download cache to a disposable dir."""
+    import deeplearning4j_tpu.zoo.base as zoo_base
+    old = zoo_base.CACHE_DIR
+    zoo_base.CACHE_DIR = tmp_path_factory.mktemp("zoo_cache")
+    yield zoo_base.CACHE_DIR
+    zoo_base.CACHE_DIR = old
+
+
+def _legacy_weights_h5(model, path):
+    import h5py
+    from keras.src.legacy.saving import legacy_h5_format
+    with h5py.File(path, "w") as f:
+        legacy_h5_format.save_weights_to_hdf5_group(f, model)
+
+
+def _serve(zoo, path):
+    md5 = hashlib.md5(open(path, "rb").read()).hexdigest()
+    zoo.pretrained_url = lambda p: f"file://{path}"
+    zoo.pretrained_checksum = lambda p: md5
+    return zoo
+
+
+class TestKerasApplicationsPretrained:
+    def test_resnet50_weights_only_through_init_pretrained(
+            self, tmp_path, tmp_cache):
+        """Full-depth keras-applications ResNet50 (107 weighted
+        tensors, ZeroPadding + biased convs + BN): weights-only legacy
+        h5 routed through the committed architecture JSON, golden
+        activation parity vs keras' own forward."""
+        from deeplearning4j_tpu.zoo.base import PretrainedType
+        from deeplearning4j_tpu.zoo.resnet50 import ResNet50
+
+        keras.utils.set_random_seed(0)
+        km = keras.applications.ResNet50(weights=None)
+        wpath = tmp_path / "rn50_w.h5"
+        _legacy_weights_h5(km, wpath)
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((1, 224, 224, 3)).astype(np.float32)
+        want = km.predict(x, verbose=0)
+
+        net = _serve(ResNet50(), wpath).init_pretrained(
+            PretrainedType.IMAGENET)
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        assert int(np.argmax(got)) == int(np.argmax(want))
+
+    def test_resnet50_full_model_h5_import(self, tmp_path):
+        """The one-file route: keras `model.save(.h5)` (config +
+        weights) → KerasModelImport → same activations."""
+        from deeplearning4j_tpu.modelimport import KerasModelImport
+
+        keras.utils.set_random_seed(0)
+        km = keras.applications.ResNet50(weights=None)
+        mpath = tmp_path / "rn50_full.h5"
+        km.save(mpath)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((1, 224, 224, 3)).astype(np.float32)
+        want = km.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(str(mpath))
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_vgg16_weights_only_into_zoo_architecture(
+            self, tmp_path, tmp_cache):
+        """VGG16: the zoo's OWN builder is keras-compatible (16
+        weighted layers, stride-1 SAME convs), so the weights-only
+        payload order-matches into it — the `ZooModel.initPretrained`
+        route the reference serves VGG16 ImageNet weights through."""
+        from deeplearning4j_tpu.zoo.base import PretrainedType
+        from deeplearning4j_tpu.zoo.vgg import VGG16
+
+        keras.utils.set_random_seed(1)
+        km = keras.applications.VGG16(weights=None)
+        wpath = tmp_path / "vgg16_w.h5"
+        _legacy_weights_h5(km, wpath)
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((1, 224, 224, 3)).astype(np.float32)
+        want = km.predict(x, verbose=0)
+
+        net = _serve(VGG16(), wpath).init_pretrained(
+            PretrainedType.IMAGENET)
+        got = np.asarray(net.output(x))
+        # 138M params of fp32 reduction-order noise through fc1's
+        # 25088-term dots: probabilities agree to ~1e-5 absolute
+        np.testing.assert_allclose(got, want, atol=5e-5)
+        assert int(np.argmax(got)) == int(np.argmax(want))
+
+    def test_checksum_gate_rejects_corruption(self, tmp_path, tmp_cache):
+        from deeplearning4j_tpu.zoo.base import PretrainedType
+        from deeplearning4j_tpu.zoo.resnet50 import ResNet50
+
+        bad = tmp_path / "bad.h5"
+        bad.write_bytes(b"\x89HDF\r\n\x1a\njunk")
+        zoo = ResNet50()
+        zoo.pretrained_url = lambda p: f"file://{bad}"
+        zoo.pretrained_checksum = lambda p: "0" * 32   # wrong md5
+        with pytest.raises(IOError, match="Checksum mismatch"):
+            zoo.init_pretrained(PretrainedType.IMAGENET)
+
+    def test_hosted_urls_and_hashes_stay_wired(self):
+        """The online route: official keras-applications URLs + the
+        md5s keras publishes (`keras.src.applications` WEIGHTS_HASHES)
+        remain declared on the zoo classes."""
+        from deeplearning4j_tpu.zoo.base import PretrainedType
+        from deeplearning4j_tpu.zoo.resnet50 import ResNet50
+        from deeplearning4j_tpu.zoo.vgg import VGG16, VGG19
+
+        rn = ResNet50()
+        assert rn.pretrained_url(PretrainedType.IMAGENET).startswith(
+            "https://storage.googleapis.com/tensorflow/keras-applications/")
+        assert rn.pretrained_checksum(PretrainedType.IMAGENET) == \
+            "2cb95161c43110f7111970584f804107"
+        assert rn.keras_architecture[PretrainedType.IMAGENET] == \
+            "resnet50_keras_arch.json"
+        arch = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..",
+            "deeplearning4j_tpu", "zoo", "weights",
+            "resnet50_keras_arch.json")
+        assert os.path.exists(arch)
+        assert VGG16().pretrained_checksum(PretrainedType.IMAGENET) == \
+            "64373286793e3c8b2b4e3219cbf3544b"
+        assert VGG19().pretrained_checksum(PretrainedType.IMAGENET) == \
+            "cbe5617147190e668d6c5d5026f83318"
