@@ -75,8 +75,9 @@ def _char_class(ch: str) -> str:
         return "katakana"
     if ch.isdigit() or 0xFF10 <= o <= 0xFF19:
         return "digit"
-    if ch.isascii() and ch.isalpha() or 0xFF21 <= o <= 0xFF5A:
-        return "latin"
+    if (ch.isascii() and ch.isalpha()) or 0xFF21 <= o <= 0xFF3A \
+            or 0xFF41 <= o <= 0xFF5A:   # fullwidth A-Z / a-z only —
+        return "latin"                  # the gap (FF3B-FF40) is punct
     return "other"
 
 
@@ -114,8 +115,10 @@ class JapaneseSegmenter:
     def __init__(self, entries: Optional[Dict] = None,
                  user_entries: Optional[Iterable[Tuple[str, str, float]]] = None,
                  conn: Optional[Dict] = None):
-        self.entries = dict(load_seed_dictionary() if entries is None
-                            else entries)
+        base = load_seed_dictionary() if entries is None else entries
+        # copy the value lists too — appending user entries must not
+        # mutate a caller-shared dictionary
+        self.entries = {s: list(v) for s, v in base.items()}
         for surface, pos, cost in (user_entries or ()):
             self.entries.setdefault(surface, []).append((pos, float(cost)))
         self.max_len = max((len(s) for s in self.entries), default=1)

@@ -26,6 +26,7 @@ with label rows (see paragraphvectors.py).
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Iterable, List, Optional, Sequence
 
@@ -53,6 +54,13 @@ class SequenceVectorsConfig:
     seed: int = 42
     cbow: bool = False          # elements learning algorithm: CBOW vs SkipGram
     unigram_power: float = 0.75  # negative-table exponent (word2vec standard)
+    # AsyncSequencer role (`SequenceVectors.java:288`): pack pair
+    # arrays on a producer thread while the device runs the previous
+    # fused scan — the jax dispatch is async, so the two overlap.
+    # Applies to the fast path (skip-gram/neg, iterations=1, no
+    # pair_hook); the trainer records host/device wait ms either way.
+    async_producer: bool = True
+    producer_queue_depth: int = 2
 
 
 # ------------------------------------------------------------ jitted steps
@@ -390,6 +398,8 @@ class SequenceVectors:
         self.syn1neg = None    # negative-sampling output table
         self._neg_table = None
         self._rng = np.random.default_rng(config.seed)
+        self._negs_rng = None   # flush-side stream (see _sample_negatives)
+        self.etl_stats = None   # producer/consumer wait accounting
         # mesh-sharded training (the dl4j-spark-nlp distributed Word2Vec
         # capability, `spark/models/embeddings/word2vec/Word2Vec.java`):
         # the pair batch shards over `data_axis`, tables stay replicated,
@@ -514,8 +524,14 @@ class SequenceVectors:
         return pairs
 
     def _sample_negatives(self, B: int) -> np.ndarray:
+        # own stream, not self._rng: negatives are drawn at FLUSH time
+        # (consumer side) while the pair packer may be running on the
+        # producer thread — one shared generator would race and break
+        # sync/async determinism parity
+        if self._negs_rng is None:
+            self._negs_rng = np.random.default_rng(self.conf.seed + 0x5EED)
         K = max(self.conf.negative, 1)
-        idx = self._rng.integers(0, len(self._neg_table), (B, K))
+        idx = self._negs_rng.integers(0, len(self._neg_table), (B, K))
         return self._neg_table[idx]
 
     def _mesh_steps(self):
@@ -799,8 +815,8 @@ class SequenceVectors:
         if (pair_hook is not None
                 or corpus_words * pairs_per_word >= conf.batch_size):
             self._warm_drain_executables(use_hs, array_path)
-        words_seen = 0
         self.last_loss = 0.0
+        self.etl_stats = None   # per-fit accounting — never stale
         loss_dev = None      # device-side last loss — read ONCE after fit
         B = conf.batch_size
         # fused flush group: skip-gram/neg drains k batches per dispatch;
@@ -808,37 +824,64 @@ class SequenceVectors:
         k_group = (max(1, conf.steps_per_flush)
                    if (array_path and not use_hs and conf.iterations == 1)
                    else 1)
+        if array_path:
+            items = self._pair_work_items(sequences, pair_hook, total_words,
+                                          k_group)
+            # AsyncSequencer role: pair packing on a producer thread,
+            # overlapped with the (async) device dispatches. pair_hook
+            # runs arbitrary user code against self — keep it on the
+            # caller's thread.
+            use_async = conf.async_producer and pair_hook is None
+            if use_async:
+                items = self._produce_async(items)
+            loss_dev = self._drain_items(items, sg_flush, sg_flush_tail,
+                                         conf.iterations)
+        else:
+            loss_dev = self._fit_cbow_list_path(
+                sequences, pair_hook, total_words, cbow_flush,
+                cbow_flush_tail)
+        self.syn0 = np.asarray(self.syn0)
+        self.syn1 = np.asarray(self.syn1)
+        self.syn1neg = np.asarray(self.syn1neg)
+        if loss_dev is not None:
+            self.last_loss = float(loss_dev)
+        return self
+
+    def _pair_work_items(self, sequences, pair_hook, total_words, k_group):
+        """Generator of flush work items for the skip-gram array path:
+        ("group", c[k,B], x[k,B], lrs[k]) fused groups, ("single",
+        c[B], x[B], lr) compiled-shape batches, ("tail", c[<B], x[<B],
+        lr) one ragged flush per epoch."""
+        conf = self.conf
+        B = conf.batch_size
+        words_seen = 0
         lr_prev = conf.learning_rate
         for epoch in range(conf.epochs):
-            abuf_c, abuf_x, abuf_n = [], [], 0   # array buffers (skip-gram)
-            lbuf = []                            # list buffer (CBOW)
+            abuf_c, abuf_x, abuf_n = [], [], 0
             for si, tokens in enumerate(sequences):
                 frac = words_seen / total_words
-                lr = max(conf.learning_rate * (1.0 - frac), conf.min_learning_rate)
+                lr = max(conf.learning_rate * (1.0 - frac),
+                         conf.min_learning_rate)
                 words_seen += len(tokens)
                 if pair_hook is not None:
                     new = pair_hook(self, si, tokens)
-                    if array_path and isinstance(new, list):
+                    if isinstance(new, list):
                         if not new:
                             continue
-                        new = (np.fromiter((p[0] for p in new), np.int32, len(new)),
-                               np.fromiter((p[1] for p in new), np.int32, len(new)))
-                elif array_path:
-                    new = self._sequence_to_pair_arrays(tokens)
+                        new = (np.fromiter((p[0] for p in new), np.int32,
+                                           len(new)),
+                               np.fromiter((p[1] for p in new), np.int32,
+                                           len(new)))
                 else:
-                    new = self._sequence_to_pairs(tokens)
-                if not array_path:
-                    lbuf.extend(new)
-                    while len(lbuf) >= B:
-                        batch, lbuf = lbuf[:B], lbuf[B:]
-                        for _ in range(conf.iterations):
-                            loss_dev = cbow_flush(batch, lr)
-                    continue
+                    new = self._sequence_to_pair_arrays(tokens)
                 if new is None:
                     continue
-                abuf_c.append(new[0]); abuf_x.append(new[1]); abuf_n += len(new[0])
+                abuf_c.append(new[0])
+                abuf_x.append(new[1])
+                abuf_n += len(new[0])
                 while abuf_n >= k_group * B:
-                    cs = np.concatenate(abuf_c); xs = np.concatenate(abuf_x)
+                    cs = np.concatenate(abuf_c)
+                    xs = np.concatenate(abuf_x)
                     take = k_group * B
                     batch_c, rest_c = cs[:take], cs[take:]
                     batch_x, rest_x = xs[:take], xs[take:]
@@ -848,35 +891,122 @@ class SequenceVectors:
                         # granularity the per-batch path would apply
                         lrs = np.linspace(lr_prev, lr, k_group,
                                           dtype=np.float32)
-                        loss_dev = self._flush_sg_neg_multi(
-                            batch_c.reshape(k_group, B),
-                            batch_x.reshape(k_group, B), lrs)
+                        yield ("group", batch_c.reshape(k_group, B),
+                               batch_x.reshape(k_group, B), lrs)
                     else:
-                        for _ in range(conf.iterations):
-                            loss_dev = sg_flush(batch_c, batch_x, lr)
+                        yield ("single", batch_c, batch_x, lr)
                     lr_prev = lr
             tail_lr = max(conf.learning_rate * (1 - words_seen / total_words),
                           conf.min_learning_rate)
-            if array_path and abuf_n:
-                cs = np.concatenate(abuf_c); xs = np.concatenate(abuf_x)
+            if abuf_n:
+                cs = np.concatenate(abuf_c)
+                xs = np.concatenate(abuf_x)
                 # drain full-B batches at the compiled shape, then one
                 # ragged tail flush
                 while len(cs) >= B:
-                    for _ in range(conf.iterations):
-                        loss_dev = sg_flush(cs[:B], xs[:B], tail_lr)
+                    yield ("single", cs[:B], xs[:B], tail_lr)
                     cs, xs = cs[B:], xs[B:]
                 if len(cs):
+                    yield ("tail", cs, xs, tail_lr)
+
+    def _produce_async(self, items):
+        """Run the work-item generator on a producer thread through a
+        bounded queue (AsyncSequencer, `SequenceVectors.java:288`).
+        Wait accounting lands in `self.etl_stats`: consumer_wait_ms is
+        time the device-feeding side starved for host packing (the
+        number to drive to ~0), producer_wait_ms is host time absorbed
+        by the queue bound while the device was busy (healthy)."""
+        import queue as _queue
+        import threading
+
+        q = _queue.Queue(maxsize=max(1, self.conf.producer_queue_depth))
+        stats = {"producer_wait_ms": 0.0, "consumer_wait_ms": 0.0,
+                 "mode": "async"}
+        self.etl_stats = stats
+        DONE = object()
+        stop = threading.Event()   # consumer abandoned (flush raised)
+
+        def produce():
+            try:
+                for item in items:
+                    t0 = time.perf_counter()
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.25)
+                            break
+                        except _queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                    stats["producer_wait_ms"] += (
+                        (time.perf_counter() - t0) * 1e3)
+                q.put(DONE)
+            except BaseException as e:   # surface in the consumer
+                q.put(("__error__", e))
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="sequencevectors-producer")
+        t.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                stats["consumer_wait_ms"] += (time.perf_counter() - t0) * 1e3
+                if item is DONE:
+                    break
+                if isinstance(item, tuple) and item[0] == "__error__":
+                    raise item[1]
+                yield item
+        finally:
+            # a raising flush closes this generator mid-iteration: wake
+            # the producer out of its bounded put so the thread (and
+            # its queued batches) cannot leak
+            stop.set()
+            t.join()
+
+    def _drain_items(self, items, sg_flush, sg_flush_tail, iterations):
+        loss_dev = None
+        if self.etl_stats is None:
+            self.etl_stats = {"mode": "sync"}
+        for kind, c, x, lr in items:
+            if kind == "group":
+                loss_dev = self._flush_sg_neg_multi(c, x, lr)
+            elif kind == "single":
+                for _ in range(iterations):
+                    loss_dev = sg_flush(c, x, lr)
+            else:
+                for _ in range(iterations):
+                    loss_dev = sg_flush_tail(c, x, lr)
+        return loss_dev
+
+    def _fit_cbow_list_path(self, sequences, pair_hook, total_words,
+                            cbow_flush, cbow_flush_tail):
+        conf = self.conf
+        B = conf.batch_size
+        words_seen = 0
+        loss_dev = None
+        for epoch in range(conf.epochs):
+            lbuf = []
+            for si, tokens in enumerate(sequences):
+                frac = words_seen / total_words
+                lr = max(conf.learning_rate * (1.0 - frac),
+                         conf.min_learning_rate)
+                words_seen += len(tokens)
+                if pair_hook is not None:
+                    new = pair_hook(self, si, tokens)
+                else:
+                    new = self._sequence_to_pairs(tokens)
+                lbuf.extend(new)
+                while len(lbuf) >= B:
+                    batch, lbuf = lbuf[:B], lbuf[B:]
                     for _ in range(conf.iterations):
-                        loss_dev = sg_flush_tail(cs, xs, tail_lr)
-            elif lbuf:
+                        loss_dev = cbow_flush(batch, lr)
+            tail_lr = max(conf.learning_rate * (1 - words_seen / total_words),
+                          conf.min_learning_rate)
+            if lbuf:
                 for _ in range(conf.iterations):
                     loss_dev = cbow_flush_tail(lbuf, tail_lr)
-        self.syn0 = np.asarray(self.syn0)
-        self.syn1 = np.asarray(self.syn1)
-        self.syn1neg = np.asarray(self.syn1neg)
-        if loss_dev is not None:
-            self.last_loss = float(loss_dev)
-        return self
+        return loss_dev
 
     # ------------------------------------------------------------- queries
     def get_word_vector(self, word: str):

@@ -220,3 +220,60 @@ class TestPackagedWord2Vec:
                             lambda name: tampered)
         with pytest.raises(ValueError, match="checksum"):
             w2v_mod.load_packaged_word2vec()
+
+
+class TestAsyncProducer:
+    """AsyncSequencer role (`SequenceVectors.java:288`): the pair
+    packer runs on a producer thread overlapped with device flushes —
+    and MUST be bitwise-equivalent to the inline path (the negatives
+    stream is flush-side, the packing stream producer-side, so thread
+    interleaving cannot touch sampling order)."""
+
+    def _corpus(self):
+        rng = np.random.default_rng(3)
+        words = [f"w{i}" for i in range(50)]
+        return [[words[j] for j in rng.integers(0, 50, 12)]
+                for _ in range(200)]
+
+    def _train(self, async_on):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        corp = [" ".join(s) for s in self._corpus()]
+        w2v = Word2Vec(sentence_iterator=corp, layer_size=16,
+                       window_size=3, min_word_frequency=1,
+                       negative_sample=5, learning_rate=0.05, epochs=2,
+                       batch_size=256, seed=12)
+        w2v.conf.async_producer = async_on
+        w2v.fit()
+        return w2v
+
+    def test_async_matches_sync_bitwise(self):
+        a = self._train(True)
+        s = self._train(False)
+        np.testing.assert_array_equal(np.asarray(a.syn0),
+                                      np.asarray(s.syn0))
+        assert a.etl_stats["mode"] == "async"
+        assert s.etl_stats["mode"] == "sync"
+
+    def test_wait_accounting_populated(self):
+        a = self._train(True)
+        assert a.etl_stats["producer_wait_ms"] >= 0.0
+        assert a.etl_stats["consumer_wait_ms"] >= 0.0
+
+    def test_producer_error_propagates(self):
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            SequenceVectors, SequenceVectorsConfig)
+        sv = SequenceVectors(SequenceVectorsConfig(
+            vector_length=8, window=2, batch_size=64, epochs=1,
+            min_word_frequency=1))
+        seqs = [["a", "b", "c"] * 10] * 5
+
+        class Boom(Exception):
+            pass
+
+        def bad_iter():
+            yield from seqs
+            raise Boom("producer died")
+
+        sv.build_vocab(seqs)
+        with pytest.raises(Boom):
+            sv.fit(bad_iter(), total_words=150)
