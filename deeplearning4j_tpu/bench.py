@@ -657,7 +657,7 @@ def bench_lstm_charnn(accel):
 # ------------------------------------------- Transformer LM (beyond-ref)
 def bench_transformer_lm(accel, B=None, T=None, d_model=None,
                          n_layers=None, n_heads=None, steps=None, V=512,
-                         with_long_context=False):
+                         with_long_context=False, remat=False):
     """Causal transformer LM training throughput (tokens/sec) — the
     beyond-reference long-context flagship (the 2017 zoo tops out at
     LSTMs). On TPU the encoder blocks ride the Pallas flash-attention
@@ -674,7 +674,7 @@ def bench_transformer_lm(accel, B=None, T=None, d_model=None,
     n_layers = n_layers or (4 if accel else 2)
     n_heads = n_heads or (8 if accel else 4)
     lm = TransformerLM(vocab_size=V, d_model=d_model, n_layers=n_layers,
-                       n_heads=n_heads, max_len=T)
+                       n_heads=n_heads, max_len=T, remat=remat)
     if accel:
         from deeplearning4j_tpu.nd.dtype import bf16_policy
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -732,6 +732,25 @@ def bench_transformer_lm(accel, B=None, T=None, d_model=None,
                 "transformer_lm_long_context_tokens_per_sec")
         except Exception as e:
             out["long_context"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # T=8192 silicon point: flash fwd+bwd + remat — the config the
+        # CPU tests only exercise at toy scale. Memory stats recorded
+        # when the backend exposes them (bytes_in_use peak)
+        try:
+            out["long_context_8k"] = bench_transformer_lm(
+                accel, B=2, T=8192, d_model=512, n_layers=8, n_heads=8,
+                steps=4, remat=True)
+            out["long_context_8k"]["metric"] = (
+                "transformer_lm_T8192_tokens_per_sec")
+            out["long_context_8k"]["remat"] = True
+            try:
+                ms = jax.devices()[0].memory_stats() or {}
+                out["long_context_8k"]["device_peak_bytes_in_use"] = int(
+                    ms.get("peak_bytes_in_use", 0))
+            except Exception:
+                pass
+        except Exception as e:
+            out["long_context_8k"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
     return out
 
 
