@@ -1,0 +1,104 @@
+"""UIMA-style annotation pipeline (reference role:
+deeplearning4j-nlp-uima — AnalysisEngine aggregates feeding
+UimaSentenceIterator / UimaTokenizerFactory)."""
+
+import pytest
+
+from deeplearning4j_tpu.nlp.annotation import (
+    AnnotationPipeline,
+    AnnotationSentenceIterator,
+    AnnotationTokenizerFactory,
+    POSAnnotator,
+    SentenceAnnotator,
+    StemAnnotator,
+    TokenAnnotator,
+    default_pipeline,
+)
+
+
+class TestSentenceAnnotator:
+    def _sentences(self, text):
+        doc = AnnotationPipeline([SentenceAnnotator()]).annotate(text)
+        return [doc.covered_text(s) for s in doc.select("sentence")]
+
+    def test_splits_on_terminators(self):
+        s = self._sentences("The cat sat. The dog ran! Did it rain? Yes.")
+        assert s == ["The cat sat.", "The dog ran!", "Did it rain?",
+                     "Yes."]
+
+    def test_abbreviation_guard(self):
+        s = self._sentences("Dr. Smith arrived. He sat down.")
+        assert s == ["Dr. Smith arrived.", "He sat down."]
+
+    def test_offsets_cover_original_text(self):
+        text = "One sentence here. And two."
+        doc = AnnotationPipeline([SentenceAnnotator()]).annotate(text)
+        for a in doc.select("sentence"):
+            assert text[a.begin:a.end] == doc.covered_text(a)
+
+
+class TestTokenAndPOS:
+    def test_tokens_within_sentences(self):
+        doc = default_pipeline().annotate("The cat sat. Dogs run quickly.")
+        sents = doc.select("sentence")
+        toks0 = doc.covered("token", sents[0])
+        assert [doc.covered_text(t) for t in toks0] == ["The", "cat",
+                                                        "sat"]
+
+    def test_pos_features(self):
+        doc = default_pipeline().annotate("The cat is running quickly.")
+        tags = {doc.covered_text(t): t.features["pos"]
+                for t in doc.select("token")}
+        assert tags["The"] == "DT"
+        assert tags["is"] == "VB"
+        assert tags["running"] == "VBG"
+        assert tags["quickly"] == "RB"
+        assert tags["cat"] == "NN"
+
+    def test_stemmer(self):
+        doc = default_pipeline(stem=True).annotate("cats running played")
+        stems = [t.features["stem"] for t in doc.select("token")]
+        assert stems == ["cat", "runn", "play"]
+
+    def test_pluggable_tokenizer_factory(self):
+        # the CJK segmenter drives the token annotator unchanged
+        from deeplearning4j_tpu.nlp.cjk import (
+            CJKTokenizerFactory, DictionarySegmenter)
+        tf = CJKTokenizerFactory({"深度": 1.0, "学习": 1.0})
+        doc = AnnotationPipeline(
+            [SentenceAnnotator(), TokenAnnotator(tf)]).annotate("深度学习")
+        toks = [t.features["surface"] for t in doc.select("token")]
+        assert toks == ["深度", "学习"]
+
+
+class TestPipelineSeams:
+    DOCS = ["The cat sat on the mat. The dog barked.",
+            "Markets rose today. Banks invested heavily."]
+
+    def test_sentence_iterator(self):
+        it = AnnotationSentenceIterator(self.DOCS)
+        out = []
+        while it.has_next():
+            out.append(it.next_sentence())
+        assert len(out) == 4
+        assert out[0] == "The cat sat on the mat."
+        it.reset()
+        assert it.has_next()
+
+    def test_tokenizer_factory_pos_filter(self):
+        tf = AnnotationTokenizerFactory(
+            pos_keep=frozenset({"NN", "NNS", "NNP", "VBD"}))
+        toks = tf.create("The cat sat on the big mat").get_tokens()
+        assert "The" not in toks and "on" not in toks
+        assert "cat" in toks and "mat" in toks
+
+    def test_word2vec_through_annotation_factory(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        corpus = ["the cat chased the mouse", "the dog chased the cat",
+                  "banks move markets", "markets follow banks"] * 6
+        w2v = Word2Vec(sentence_iterator=corpus,
+                       tokenizer_factory=AnnotationTokenizerFactory(),
+                       layer_size=8, window_size=2, min_word_frequency=2,
+                       epochs=1, batch_size=64, seed=0)
+        w2v.fit()
+        assert w2v.has_word("cat") and w2v.has_word("markets")

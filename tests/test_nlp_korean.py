@@ -1,0 +1,94 @@
+"""Korean tokenization through the TokenizerFactory seam (reference
+role: deeplearning4j-nlp-korean wraps twitter-korean-text — the
+embedding-relevant behavior is morpheme separation of josa/eomi from
+stems, which whitespace tokenization conflates)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.korean import (
+    CONTENT_POS,
+    KoreanSegmenter,
+    KoreanTokenizerFactory,
+)
+
+
+class TestKoreanSegmenter:
+    def setup_method(self):
+        self.seg = KoreanSegmenter()
+
+    def test_josa_split_with_batchim_agreement(self):
+        # 이 after batchim (은행), 가 after vowel (고양이)
+        assert self.seg.tokenize_with_pos("은행이") == [
+            ("은행", "stem"), ("이", "josa")]
+        assert self.seg.tokenize_with_pos("고양이가") == [
+            ("고양이", "stem"), ("가", "josa")]
+        # wrong-agreement suffix does NOT split: 사자 ends in a vowel,
+        # so a trailing 은 (needs batchim) stays attached... but 는
+        # (vowel form) splits
+        assert ("사자", "stem") in self.seg.tokenize_with_pos("사자는")
+
+    def test_object_topic_particles(self):
+        toks = self.seg.segment("고양이가 물고기를 먹었다")
+        assert toks == ["고양이", "가", "물고기", "를", "먹", "었다"]
+
+    def test_eomi_split(self):
+        assert self.seg.tokenize_with_pos("투자했다") == [
+            ("투자", "stem"), ("했다", "eomi")]
+        assert self.seg.tokenize_with_pos("읽었습니다") == [
+            ("읽", "stem"), ("었습니다", "eomi")]
+
+    def test_same_stem_across_particles(self):
+        """The point of morpheme separation: one stem across case
+        forms — a whitespace tokenizer would see three distinct
+        words."""
+        stems = set()
+        for eojeol in ("학생이", "학생은", "학생을"):
+            stems.add(self.seg.tokenize_with_pos(eojeol)[0])
+        assert stems == {("학생", "stem")}
+
+    def test_non_hangul_passes_through(self):
+        assert ("TPU", "other") in self.seg.tokenize_with_pos("TPU 학습")
+
+    def test_punctuation_stripped(self):
+        assert self.seg.segment("먹었다.") == ["먹", "었다"]
+
+
+class TestKoreanTokenizerFactory:
+    def test_seam_contract(self):
+        tf = KoreanTokenizerFactory()
+        tok = tf.create("고양이가 물고기를 먹었다")
+        assert tok.count_tokens() == 6
+        assert tok.next_token() == "고양이"
+
+    def test_pos_filter_keeps_content(self):
+        tf = KoreanTokenizerFactory(pos_keep=CONTENT_POS)
+        assert tf.create("고양이가 물고기를 먹었다").get_tokens() == \
+            ["고양이", "물고기", "먹"]
+
+    def test_preprocessor_applied(self):
+        from deeplearning4j_tpu.nlp.tokenization import TokenPreProcess
+
+        class Low(TokenPreProcess):
+            def pre_process(self, t):
+                return t.lower()
+
+        tf = KoreanTokenizerFactory(pos_keep=CONTENT_POS)
+        tf.set_token_pre_processor(Low())
+        assert tf.create("TPU 학습").get_tokens() == ["tpu", "학습"]
+
+
+def test_korean_vocab_collapses_case_forms():
+    """Vocabulary built through the factory unifies case-marked forms
+    of the same noun — impossible with whitespace tokens."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    corpus = ["고양이가 물고기를 먹었다", "고양이는 공원에서 놀았다",
+              "고양이를 친구가 보았다"] * 4
+    w2v = Word2Vec(sentence_iterator=corpus,
+                   tokenizer_factory=KoreanTokenizerFactory(
+                       pos_keep=CONTENT_POS),
+                   layer_size=8, window_size=2, min_word_frequency=2,
+                   epochs=1, batch_size=64, seed=0)
+    w2v.fit()
+    assert w2v.has_word("고양이")
+    assert not w2v.has_word("고양이가") and not w2v.has_word("고양이는")
