@@ -19,3 +19,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Persistent XLA compilation cache: the suite is compile-dominated on
+# the 1-core sandbox (measured 3.5x on compile-heavy files), so warm
+# reruns fit the driver's single 600 s window. Programs are keyed by
+# HLO — code changes recompile exactly what they touch.
+from deeplearning4j_tpu.nd import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache(
+    os.environ.get("DL4J_TEST_XLA_CACHE",
+                   os.path.expanduser("~/.cache/dl4tpu-xla-tests")),
+    min_compile_time_secs=0.2)

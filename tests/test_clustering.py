@@ -123,6 +123,7 @@ class TestTsne:
         spread = max(y[:60].std(), y[60:].std())
         assert np.linalg.norm(ca - cb) > 2 * spread
 
+    @pytest.mark.slow   # large-N smoke; exactness tests stay default
     def test_barnes_hut_runs_large(self):
         rng = np.random.default_rng(7)
         x = np.concatenate([rng.standard_normal((300, 5)) + 3,

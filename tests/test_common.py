@@ -246,6 +246,14 @@ class TestCompilationCache:
         import jax.numpy as jnp
         from deeplearning4j_tpu.nd import enable_compilation_cache
 
+        import os
+
+        from jax._src import compilation_cache as _cc
+
+        # conftest already bound the persistent-cache singleton to the
+        # suite-wide dir; re-pointing the config only takes effect
+        # after a reset
+        _cc.reset_cache()
         d = enable_compilation_cache(tmp_path / "xla", min_compile_time_secs=0)
         try:
             @jax.jit
@@ -253,9 +261,12 @@ class TestCompilationCache:
                 return jnp.tanh(a @ b) + a.sum()
 
             f(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
-            import os
             assert os.path.isdir(d)
             assert len(os.listdir(d)) >= 1, "no cache entry written"
         finally:
-            # don't leak the tmp dir into later tests' jit calls
-            jax.config.update("jax_compilation_cache_dir", None)
+            # restore the suite-wide cache for later tests
+            _cc.reset_cache()
+            enable_compilation_cache(
+                os.environ.get("DL4J_TEST_XLA_CACHE",
+                               os.path.expanduser("~/.cache/dl4tpu-xla-tests")),
+                min_compile_time_secs=0.5)

@@ -105,6 +105,8 @@ class TestPipeline:
                                    rtol=1e-5, atol=1e-6)
 
     @requires_8dev
+    @pytest.mark.slow   # 48s; schedule parity (test_matches_sequential) and the
+    # container-level PP parity tests keep pipeline-grad coverage in the default run
     def test_differentiable_and_trains(self):
         S, F = 4, 6
         params = self._stacked_params(S, F)

@@ -28,6 +28,7 @@ def _onehot(b, n, seed=0):
     return np.eye(n, dtype=np.float32)[np.random.default_rng(seed).integers(0, n, b)]
 
 
+@pytest.mark.slow   # heaviest zoo compiles; run with -m slow
 def test_googlenet_builds_and_forwards():
     net = GoogLeNet(num_classes=10, height=64, width=64).init()
     out = net.output(_img(2, 64, 64))
@@ -35,6 +36,7 @@ def test_googlenet_builds_and_forwards():
     np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_inception_resnet_v1_builds_and_forwards():
     net = InceptionResNetV1(num_classes=8, height=80, width=80,
                             blocks35=1, blocks17=1, blocks8=1).init()
@@ -42,6 +44,7 @@ def test_inception_resnet_v1_builds_and_forwards():
     assert out.shape == (2, 8)
 
 
+@pytest.mark.slow
 def test_facenet_nn4_small2_trains():
     net = FaceNetNN4Small2(num_classes=6, height=64, width=64).init()
     x, y = _img(2, 64, 64), _onehot(2, 6)
