@@ -9,8 +9,6 @@ a confident box on it.
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
-
 import numpy as np
 
 
@@ -35,9 +33,12 @@ def make_data(n, grid=6, cell_px=8, seed=0):
 
 
 def main():
-    import jax
-    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS",
-                                                      "cpu"))
+    if os.environ.get("DL4J_FORCE_CPU"):
+        # sandbox escape hatch: the axon TPU plugin hangs on a dead
+        # tunnel; `DL4J_FORCE_CPU=1 python examples/object_detection.py`
+        # pins the CPU backend before any jax backend use
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     from deeplearning4j_tpu.common.updaters import Adam
     from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
     from deeplearning4j_tpu.nn.layers import ConvolutionLayer, SubsamplingLayer
@@ -47,7 +48,7 @@ def main():
 
     grid, cell_px = 6, 8
     anchors = ((1.5, 1.5),)
-    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(2e-3))
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(5e-3))
             .list()
             .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3),
                                     activation="relu",
@@ -68,8 +69,8 @@ def main():
     net = MultiLayerNetwork(conf).init()
 
     x, y = make_data(64, grid, cell_px)
-    print("training 40 epochs on 64 synthetic images ...")
-    net.fit(x, y, epochs=40, batch_size=32)
+    print("training 120 epochs on 64 synthetic images ...")
+    net.fit(x, y, epochs=120, batch_size=32)
     print(f"final loss {net.score_value:.4f}")
 
     # inference: activated output → thresholded boxes → NMS
@@ -77,7 +78,9 @@ def main():
     xt, yt = make_data(4, grid, cell_px, seed=99)
     out = net.output(xt)
     dets = non_max_suppression(
-        yolo.get_predicted_objects(out, threshold=0.5), iou_threshold=0.4)
+        # confidence trains toward the predicted box's IOU, so a
+        # well-fit box sits at ~0.5-0.8 confidence — threshold below it
+        yolo.get_predicted_objects(out, threshold=0.35), iou_threshold=0.4)
     for d in dets:
         tlx, tly = d.top_left_xy
         brx, bry = d.bottom_right_xy
