@@ -23,10 +23,27 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Persistent XLA compilation cache: the suite is compile-dominated on
 # the 1-core sandbox (measured 3.5x on compile-heavy files), so warm
 # reruns fit the driver's single 600 s window. Programs are keyed by
-# HLO — code changes recompile exactly what they touch.
+# HLO — code changes recompile exactly what they touch. The cache dir
+# is fingerprinted by the host's CPU feature set: sandbox sessions
+# migrate between machine types, and XLA:CPU AOT results compiled for
+# another machine load with "may SIGILL" warnings.
 from deeplearning4j_tpu.nd import enable_compilation_cache  # noqa: E402
+
+
+def _machine_tag():
+    import hashlib
+    import platform
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next((line for line in f if line.startswith("flags")), "")
+    except OSError:
+        flags = ""
+    return hashlib.sha256(
+        (platform.machine() + flags).encode()).hexdigest()[:10]
+
 
 enable_compilation_cache(
     os.environ.get("DL4J_TEST_XLA_CACHE",
-                   os.path.expanduser("~/.cache/dl4tpu-xla-tests")),
+                   os.path.expanduser(
+                       f"~/.cache/dl4tpu-xla-tests-{_machine_tag()}")),
     min_compile_time_secs=0.2)

@@ -111,9 +111,18 @@ class TestKerasApplicationsPretrained:
             PretrainedType.IMAGENET)
         got = np.asarray(net.output(x))
         # 138M params of fp32 reduction-order noise through fc1's
-        # 25088-term dots: probabilities agree to ~1e-5 absolute
+        # 25088-term dots: random-weight probabilities are near-uniform
+        # (~1e-3 each), so neither argmax nor centered correlation is
+        # meaningful at the softmax — the 5e-5 absolute bound (20x
+        # tighter than a scrambled-weight outcome) plus exact
+        # first-conv parity below pin the weight placement
         np.testing.assert_allclose(got, want, atol=5e-5)
-        assert int(np.argmax(got)) == int(np.argmax(want))
+        # first conv activations: one layer of accumulation → tight
+        # cross-framework parity proves block1_conv1 weights landed
+        sub = keras.Model(km.inputs, km.layers[1].output)
+        want_c1 = sub.predict(x, verbose=0)
+        got_c1 = np.asarray(net.feed_forward(x)[0])
+        np.testing.assert_allclose(got_c1, want_c1, atol=1e-4)
 
     def test_checksum_gate_rejects_corruption(self, tmp_path, tmp_cache):
         from deeplearning4j_tpu.zoo.base import PretrainedType
