@@ -65,18 +65,25 @@ def pipeline_apply(block_fn: Callable, stage_params, x_mb, axis_name: str):
 
 
 def pipeline_forward(block_fn, stacked_params, x, mesh: Mesh, *,
-                     pipe_axis: str = "pipe", microbatches: int = 4):
+                     pipe_axis: str = "pipe", microbatches: int = 4,
+                     data_axis: str = None):
     """Full-array wrapper: `stacked_params` has a leading stage axis
     (size = mesh["pipe"]), x is [B_total, ...]; B_total must divide by
-    `microbatches`. Returns [B_total, ...] of the final stage."""
+    `microbatches`. Returns [B_total, ...] of the final stage.
+
+    `data_axis` composes DP with the pipeline: the microbatch BATCH
+    dim shards over it (each data-shard runs its own GPipe stream over
+    the same pipe ring; params replicate across "data"), so a
+    ("data", "pipe") mesh trains with both axes live."""
     B = x.shape[0]
     assert B % microbatches == 0, "batch must divide microbatches"
     x_mb = x.reshape((microbatches, B // microbatches) + x.shape[1:])
     p_spec = jax.tree_util.tree_map(
         lambda _: P(pipe_axis), stacked_params)
+    mb_spec = P(None, data_axis) if data_axis else P()
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(p_spec, P()), out_specs=P(),
+             in_specs=(p_spec, mb_spec), out_specs=mb_spec,
              check_vma=False)
     def run(params_stage, mb):
         local = jax.tree_util.tree_map(lambda a: a[0], params_stage)

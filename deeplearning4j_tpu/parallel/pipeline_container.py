@@ -90,7 +90,7 @@ class PipelineParallelTrainer:
     otherwise."""
 
     def __init__(self, model, mesh: Mesh, *, pipe_axis: str = "pipe",
-                 microbatches: int = 4,
+                 data_axis: Optional[str] = None, microbatches: int = 4,
                  run: Optional[Tuple[int, int]] = None):
         if not model._initialized:
             model.init()
@@ -103,6 +103,11 @@ class PipelineParallelTrainer:
         self.model = model
         self.mesh = mesh
         self.pipe_axis = pipe_axis
+        # DP composition: batch shards over `data_axis` (each data
+        # shard streams its own microbatches through the pipe ring;
+        # GSPMD sums the replicated-param gradients across shards)
+        self.data_axis = (data_axis if data_axis and
+                          data_axis in mesh.shape else None)
         self.microbatches = int(microbatches)
         S = int(mesh.shape[pipe_axis])
         self.n_stages = S
@@ -176,7 +181,8 @@ class PipelineParallelTrainer:
 
         h = pipeline_forward(stage_fn, stacked, h, self.mesh,
                              pipe_axis=self.pipe_axis,
-                             microbatches=self.microbatches)
+                             microbatches=self.microbatches,
+                             data_axis=self.data_axis)
 
         # epilog [r1, n): remaining hidden layers + output loss — the
         # same tail structure as `MultiLayerNetwork._loss_fn`

@@ -746,6 +746,41 @@ class TestPipelineContainer:
         assert net.score_value < s0
 
     @requires_8dev
+    def test_dp_x_pp_composition_matches_single_device(self):
+        """Both axes live on one ("data", "pipe") mesh through the
+        public trainer: batch shards over data, the block run pipelines
+        over pipe — one SGD step matches the sequential container."""
+        from deeplearning4j_tpu.common.updaters import Sgd
+        from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+        from jax.sharding import Mesh
+
+        def build():
+            net = TransformerLM(vocab_size=12, d_model=16, n_layers=4,
+                                n_heads=4, max_len=8, seed=3).init()
+            for layer in net.layers:
+                layer.updater = Sgd(0.05)
+            return net
+
+        ids, y = self._data()
+        single = build()
+        single.fit(ids, y, epochs=1, batch_size=8)
+        dp_pp = build()
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("data", "pipe"))
+        PipelineParallelTrainer(dp_pp, mesh, data_axis="data",
+                                microbatches=4).fit(ids, y, epochs=1,
+                                                    batch_size=8)
+        np.testing.assert_allclose(dp_pp.score_value, single.score_value,
+                                   rtol=1e-5)
+        for lk in single.params:
+            for pn in single.params[lk]:
+                np.testing.assert_allclose(
+                    np.asarray(dp_pp.params[lk][pn]),
+                    np.asarray(single.params[lk][pn]),
+                    rtol=2e-4, atol=1e-6, err_msg=f"{lk}:{pn}")
+
+    @requires_8dev
     def test_pp_validates_stage_partition(self):
         from deeplearning4j_tpu.parallel import PipelineParallelTrainer
         net = self._lm(n_layers=3)
