@@ -18,7 +18,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from deeplearning4j_tpu import bench  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "SWEEP_r04.jsonl")
+                   "SWEEP_r05.jsonl")
 
 
 def emit(tag, rec):
@@ -31,13 +31,18 @@ def emit(tag, rec):
 
 
 def sweep_resnet(accel):
-    for batch in (128, 256):
+    # batch sweep incl. the round-4 b256<b128 anomaly: vary steps at
+    # b256 to separate working-set effects (the fused window stacks
+    # steps x batch images on HBM) from per-step compute
+    for batch, steps in ((64, 20), (128, 20), (192, 20), (256, 20),
+                         (256, 10), (256, 5)):
         try:
-            r = bench.bench_resnet50(accel, batch=batch, with_etl=False)
+            r = bench.bench_resnet50(accel, batch=batch, steps=steps,
+                                     with_etl=False)
             r.pop("device_diagnostics", None)
-            emit(f"resnet50_b{batch}", r)
+            emit(f"resnet50_b{batch}_s{steps}", r)
         except Exception as e:
-            emit(f"resnet50_b{batch}",
+            emit(f"resnet50_b{batch}_s{steps}",
                  {"error": f"{type(e).__name__}: {e}"[:300]})
 
 
