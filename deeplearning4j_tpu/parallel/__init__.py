@@ -30,6 +30,7 @@ from deeplearning4j_tpu.parallel.ring import (
 )
 from deeplearning4j_tpu.parallel.tensor import (
     ShardedParallelTrainer,
+    fsdp_param_specs,
     moe_param_specs,
     tp_param_specs,
 )

@@ -74,6 +74,37 @@ def tp_param_specs(model, model_axis: str = "model",
     return specs
 
 
+def fsdp_param_specs(model, data_axis: str = "data",
+                     axis_size: Optional[int] = None,
+                     min_shard_elems: int = 1024) -> Dict:
+    """ZeRO-3 / FSDP as a sharding annotation: every large param
+    shards over the SAME axis the batch shards over, so each device
+    holds 1/N of the weights and optimizer state; GSPMD inserts the
+    all-gather at use and reduce-scatters the gradients. No wrapper
+    engine — the capability the torch ecosystem builds FSDP for is one
+    PartitionSpec tree here (beyond-reference: SURVEY §2.13 leaves the
+    mesh axes open for exactly this).
+
+    Params shard on their LAST axis when divisible; small params
+    (< `min_shard_elems`) and non-divisible axes replicate — gathering
+    a bias costs more than storing it."""
+    def divides(dim):
+        return axis_size is None or (dim % axis_size == 0)
+
+    specs: Dict[str, Dict] = {}
+    for lk, lparams in model.params.items():
+        lspec = {}
+        for pn, arr in lparams.items():
+            nd = np.ndim(arr)
+            if (nd == 0 or int(np.prod(np.shape(arr))) < min_shard_elems
+                    or not divides(np.shape(arr)[-1])):
+                lspec[pn] = P()
+            else:
+                lspec[pn] = P(*([None] * (nd - 1) + [data_axis]))
+        specs[lk] = lspec
+    return specs
+
+
 def moe_param_specs(model, expert_axis: str = "expert",
                     model_axis: Optional[str] = None) -> Dict:
     """Expert parallelism: MixtureOfExperts params get their leading
