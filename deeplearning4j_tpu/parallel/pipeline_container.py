@@ -106,8 +106,13 @@ class PipelineParallelTrainer:
         # DP composition: batch shards over `data_axis` (each data
         # shard streams its own microbatches through the pipe ring;
         # GSPMD sums the replicated-param gradients across shards)
-        self.data_axis = (data_axis if data_axis and
-                          data_axis in mesh.shape else None)
+        if data_axis is not None and data_axis not in mesh.shape:
+            raise ValueError(
+                f"data_axis {data_axis!r} is not a mesh axis "
+                f"{tuple(mesh.shape)} — a silent fallback would leave "
+                "the batch replicated over that axis and mis-scale "
+                "gradients")
+        self.data_axis = data_axis
         self.microbatches = int(microbatches)
         S = int(mesh.shape[pipe_axis])
         self.n_stages = S

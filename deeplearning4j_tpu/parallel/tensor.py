@@ -55,27 +55,39 @@ def tp_param_specs(model, model_axis: str = "model",
         def is_output(lk):
             return lk in outputs
 
+    def replicate(lk, pn, arr):
+        return is_output(lk) and not shard_output_layer
+
+    return _last_axis_specs(model, model_axis, axis_size, replicate,
+                            shard_1d=True)
+
+
+def _last_axis_specs(model, axis, axis_size, replicate_pred, *,
+                     shard_1d):
+    """Shared spec builder: every param shards its LAST axis over
+    `axis` unless `replicate_pred(lk, pn, arr)` says otherwise, the
+    axis does not divide by `axis_size`, or it is a scalar. 1-D params
+    follow only when `shard_1d`."""
     def divides(dim):
         return axis_size is None or (dim % axis_size == 0)
 
     specs: Dict[str, Dict] = {}
     for lk, lparams in model.params.items():
-        replicate_all = is_output(lk) and not shard_output_layer
         lspec = {}
         for pn, arr in lparams.items():
             nd = np.ndim(arr)
-            if replicate_all or nd == 0 or not divides(np.shape(arr)[-1]):
+            if (nd == 0 or replicate_pred(lk, pn, arr)
+                    or not divides(np.shape(arr)[-1])
+                    or (nd == 1 and not shard_1d)):
                 lspec[pn] = P()
-            elif nd == 1:
-                lspec[pn] = P(model_axis)
             else:
-                lspec[pn] = P(*([None] * (nd - 1) + [model_axis]))
+                lspec[pn] = P(*([None] * (nd - 1) + [axis]))
         specs[lk] = lspec
     return specs
 
 
-def fsdp_param_specs(model, data_axis: str = "data",
-                     axis_size: Optional[int] = None,
+def fsdp_param_specs(model, data_axis: str = "data", *,
+                     axis_size: int,
                      min_shard_elems: int = 1024) -> Dict:
     """ZeRO-3 / FSDP as a sharding annotation: every large param
     shards over the SAME axis the batch shards over, so each device
@@ -85,24 +97,16 @@ def fsdp_param_specs(model, data_axis: str = "data",
     PartitionSpec tree here (beyond-reference: SURVEY §2.13 leaves the
     mesh axes open for exactly this).
 
-    Params shard on their LAST axis when divisible; small params
-    (< `min_shard_elems`) and non-divisible axes replicate — gathering
-    a bias costs more than storing it."""
-    def divides(dim):
-        return axis_size is None or (dim % axis_size == 0)
+    `axis_size` is REQUIRED (pass the mesh's data-axis extent): the
+    divisibility gate is what keeps a [*, n_classes] head from hitting
+    GSPMD's uneven-partition errors at fit time. Params shard on their
+    LAST axis when divisible; small params (< `min_shard_elems`)
+    replicate — gathering a bias costs more than storing it."""
+    def replicate(lk, pn, arr):
+        return int(np.prod(np.shape(arr))) < min_shard_elems
 
-    specs: Dict[str, Dict] = {}
-    for lk, lparams in model.params.items():
-        lspec = {}
-        for pn, arr in lparams.items():
-            nd = np.ndim(arr)
-            if (nd == 0 or int(np.prod(np.shape(arr))) < min_shard_elems
-                    or not divides(np.shape(arr)[-1])):
-                lspec[pn] = P()
-            else:
-                lspec[pn] = P(*([None] * (nd - 1) + [data_axis]))
-        specs[lk] = lspec
-    return specs
+    return _last_axis_specs(model, data_axis, int(axis_size), replicate,
+                            shard_1d=True)
 
 
 def moe_param_specs(model, expert_axis: str = "expert",
