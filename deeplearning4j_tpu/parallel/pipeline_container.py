@@ -236,6 +236,64 @@ class PipelineParallelTrainer:
 
         self._step = jax.jit(step, donate_argnums=(0, 1))
 
+    def evaluate(self, data, labels=None, *, batch_size: int = 32,
+                 evaluation=None):
+        """Evaluation through the SAME pipelined forward the trainer
+        uses (prolog | GPipe run | epilog), so a stage-partitioned
+        model never needs to materialize unsharded. Ragged tails pad
+        to the microbatch multiple and slice after the forward."""
+        from deeplearning4j_tpu.eval import Evaluation
+        model = self.model
+        if getattr(self, "_eval_forward", None) is None:
+            r0, r1 = self.run
+            n = len(model.layers)
+
+            def fwd(params, state, x):
+                h, _, _, _, _ = model._forward_core(
+                    params, state, x, train=False, rng=None, upto=r0)
+                S, per = self.n_stages, (r1 - r0) // self.n_stages
+                run_params = [params[str(i)] for i in range(r0, r1)]
+                stacked = jax.tree_util.tree_map(
+                    lambda *leaves: jnp.stack(leaves).reshape(
+                        (S, per) + np.shape(leaves[0])), *run_params)
+                template = model.layers[r0]
+
+                def stage_fn(stage_params, hh):
+                    def body(h2, p_one):
+                        h2, _ = template.forward(p_one, {}, h2,
+                                                 train=False, rng=None)
+                        return h2, None
+                    out, _ = jax.lax.scan(body, hh, stage_params)
+                    return out
+
+                h = pipeline_forward(stage_fn, stacked, h, self.mesh,
+                                     pipe_axis=self.pipe_axis,
+                                     microbatches=self.microbatches,
+                                     data_axis=self.data_axis)
+                for i in range(r1, n):
+                    if i in model.conf.input_preprocessors:
+                        h = model.conf.input_preprocessors[i].pre_process(
+                            h, None)
+                    h, _ = model.layers[i].forward(
+                        params.get(str(i), {}), state.get(str(i), {}),
+                        h, train=False, rng=None)
+                return h
+
+            self._eval_forward = jax.jit(fwd)
+        iterator = as_iterator(data, labels, batch_size=batch_size)
+        ev = evaluation if evaluation is not None else Evaluation()
+        M = self.microbatches
+        for ds in iterator:
+            x = np.asarray(ds.features)
+            n_real = x.shape[0]
+            pad = (-n_real) % M
+            if pad:
+                x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)])
+            out = np.asarray(self._eval_forward(
+                model.params, model.net_state, jnp.asarray(x)))[:n_real]
+            ev.eval(np.asarray(ds.labels), out)
+        return ev
+
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 32):
         model = self.model

@@ -869,3 +869,27 @@ class TestFSDP:
                     np.asarray(fsdp.params[lk][pn]),
                     np.asarray(single.params[lk][pn]),
                     rtol=2e-4, atol=1e-6, err_msg=f"{lk}:{pn}")
+
+
+@requires_8dev
+def test_pp_evaluate_matches_host():
+    """PipelineParallelTrainer.evaluate runs the stage-partitioned
+    forward (incl. a ragged tail padded to the microbatch multiple)
+    and matches host-side evaluation exactly."""
+    from deeplearning4j_tpu.eval import Evaluation
+    from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+    from deeplearning4j_tpu.zoo.transformer import TransformerLM
+    from jax.sharding import Mesh
+
+    net = TransformerLM(vocab_size=12, d_model=16, n_layers=4,
+                        n_heads=4, max_len=8, seed=3).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 12, (10, 8)).astype(np.float32)  # ragged vs M=4
+    y = np.eye(12, dtype=np.float32)[rng.integers(0, 12, (10, 8))]
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+    ev = PipelineParallelTrainer(net, mesh, microbatches=4).evaluate(
+        ids, y, batch_size=10)
+    host = Evaluation()
+    host.eval(y, np.asarray(net.output(ids)))
+    assert ev.total == host.total == 80
+    np.testing.assert_allclose(ev.accuracy(), host.accuracy())
