@@ -1156,6 +1156,39 @@ def _probe_backend(timeout_s=180):
     return None
 
 
+def _compile_tracker():
+    """Cumulative XLA compile tracking via the telemetry core's
+    jit-compile collector (monitor/collectors.py) on a private registry.
+    Returns a snap() closure yielding (compile_count, compile_seconds) —
+    what lets each bench block report warmup (compile) vs steady-state
+    time instead of one undifferentiated wall clock."""
+    try:
+        from deeplearning4j_tpu.monitor import (JitCompileCollector,
+                                                MetricsRegistry)
+        coll = JitCompileCollector(MetricsRegistry()).install()
+        return lambda: (coll.compile_count(), coll.compile_seconds())
+    except Exception:  # collector must never kill a bench run
+        return lambda: (0.0, 0.0)
+
+
+def _with_compile_split(snap, fn, *args, **kwargs):
+    """Run one bench block and attach its compile-vs-steady-state split
+    to the result dict (no-op for non-dict results/errors)."""
+    c0, s0 = snap()
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    wall = time.perf_counter() - t0
+    c1, s1 = snap()
+    if isinstance(out, dict):
+        out["compile"] = {
+            "xla_compiles": int(c1 - c0),
+            "compile_seconds": round(s1 - s0, 3),
+            "wall_seconds": round(wall, 3),
+            "steady_state_wall_seconds": round(max(0.0, wall - (s1 - s0)), 3),
+        }
+    return out
+
+
 def main():
     info = _probe_backend()
     if info is None:
@@ -1169,8 +1202,9 @@ def main():
         enable_compilation_cache()
     except Exception:
         pass
+    snap = _compile_tracker()
     try:
-        primary = bench_resnet50(accel)
+        primary = _with_compile_split(snap, bench_resnet50, accel)
     except Exception as e:
         # a mid-run tunnel drop (or any primary-bench crash) must not
         # zero the scoreboard either
@@ -1186,7 +1220,7 @@ def main():
                           a, with_long_context=True)),
                      ("word2vec", bench_word2vec)):
         try:
-            extras[name] = fn(accel)
+            extras[name] = _with_compile_split(snap, fn, accel)
         except Exception as e:  # secondary metric must not kill the run
             extras[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
     try:

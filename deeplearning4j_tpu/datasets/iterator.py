@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, Iterator, List, Optional
 
 import numpy as np
@@ -221,6 +222,51 @@ class BenchmarkDataSetIterator(DataSetIterator):
 
     def batch_size(self):
         return self.features.shape[0]
+
+
+class TimedDataSetIterator(DataSetIterator):
+    """Times each batch's assembly (the ETL cost: shuffle, slice, disk,
+    decode — whatever the wrapped iterator does to produce a DataSet)
+    and publishes it as `last_etl_ms` / `total_etl_ms`.
+
+    The fit loops wrap their iterator with this and pass `last_etl_ms`
+    into the listener bus's `etl_ms` info key (what PerformanceListener
+    reports) — so ETL attribution comes from the iterator itself, not
+    from loop-side clock bookkeeping. When the monitor substrate is
+    enabled, each batch also lands in the `training_etl_seconds`
+    histogram via MonitorListener; this wrapper itself keeps zero
+    monitor coupling (two `perf_counter` reads per batch)."""
+
+    def __init__(self, base: DataSetIterator):
+        self.base = base
+        self.last_etl_ms = 0.0
+        self.total_etl_ms = 0.0
+        self.batches = 0
+
+    def __iter__(self):
+        it = iter(self.base)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                ds = next(it)
+            except StopIteration:
+                return
+            self.last_etl_ms = (time.perf_counter() - t0) * 1e3
+            self.total_etl_ms += self.last_etl_ms
+            self.batches += 1
+            yield ds
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def total_outcomes(self):
+        return self.base.total_outcomes()
+
+    def input_columns(self):
+        return self.base.input_columns()
 
 
 def as_iterator(data, labels=None, batch_size: int = 32, **kw) -> DataSetIterator:

@@ -50,6 +50,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+
+# pallas compat: new API spells a squeezed block dim `pl.squeezed`;
+# the 0.4.x line uses None in block_shape with identical semantics
+_SQUEEZED = getattr(pl, "squeezed", None)
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
@@ -183,14 +187,14 @@ def _fwd_pallas_call(q, k, v, state, *, block_q, block_k, causal,
     qt, kt, vt = (jnp.transpose(a, (0, 2, 1, 3)) for a in (q, k, v))
     n_q, n_k = Tqp // bq, Tkp // bk
 
-    q_blk = pl.BlockSpec((pl.squeezed, pl.squeezed, bq, D),
+    q_blk = pl.BlockSpec((_SQUEEZED, _SQUEEZED, bq, D),
                          lambda b, h, i, j: (b, h, i, 0))
-    k_blk = pl.BlockSpec((pl.squeezed, pl.squeezed, bk, D),
+    k_blk = pl.BlockSpec((_SQUEEZED, _SQUEEZED, bk, D),
                          lambda b, h, i, j: (b, h, j, 0))
     # trailing singleton: Mosaic wants the block's last two dims
     # divisible by (8, 128) or equal to the array's — [bq, 1]
     # qualifies, a rank-1 [bq] block does not
-    row_q = pl.BlockSpec((pl.squeezed, pl.squeezed, bq, 1),
+    row_q = pl.BlockSpec((_SQUEEZED, _SQUEEZED, bq, 1),
                          lambda b, h, i, j: (b, h, i, 0))
 
     outs = pl.pallas_call(
@@ -383,11 +387,11 @@ def _bwd_dq_chunk(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     qt, kt, vt, dot = (jnp.transpose(a, (0, 2, 1, 3))
                        for a in (q, k, v, do))
     n_q, n_k = Tqp // bq, Tkp // bk
-    q_blk = pl.BlockSpec((pl.squeezed, pl.squeezed, bq, D),
+    q_blk = pl.BlockSpec((_SQUEEZED, _SQUEEZED, bq, D),
                          lambda b, h, i, j: (b, h, i, 0))
-    k_blk = pl.BlockSpec((pl.squeezed, pl.squeezed, bk, D),
+    k_blk = pl.BlockSpec((_SQUEEZED, _SQUEEZED, bk, D),
                          lambda b, h, i, j: (b, h, j, 0))
-    row_q = pl.BlockSpec((pl.squeezed, pl.squeezed, bq, 1),
+    row_q = pl.BlockSpec((_SQUEEZED, _SQUEEZED, bq, 1),
                          lambda b, h, i, j: (b, h, i, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=bq, block_k=bk,
@@ -421,11 +425,11 @@ def _bwd_dkv_chunk(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     n_q, n_k = Tqp // bq, Tkp // bk
     # k-major grid: k/v (and dk/dv outputs) blocked by grid dim 2,
     # q/do/lse/Δ streamed by the minor dim 3
-    kv_blk = pl.BlockSpec((pl.squeezed, pl.squeezed, bk, D),
+    kv_blk = pl.BlockSpec((_SQUEEZED, _SQUEEZED, bk, D),
                           lambda b, h, i, j: (b, h, i, 0))
-    q_stream = pl.BlockSpec((pl.squeezed, pl.squeezed, bq, D),
+    q_stream = pl.BlockSpec((_SQUEEZED, _SQUEEZED, bq, D),
                             lambda b, h, i, j: (b, h, j, 0))
-    row_stream = pl.BlockSpec((pl.squeezed, pl.squeezed, bq, 1),
+    row_stream = pl.BlockSpec((_SQUEEZED, _SQUEEZED, bq, 1),
                               lambda b, h, i, j: (b, h, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=bq, block_k=bk,

@@ -1,0 +1,192 @@
+"""Unified telemetry core: metrics registry + span tracer + collectors.
+
+One substrate answering "where did the step time go" across host, XLA
+compile, and device (the per-phase timeline + counters discipline of
+TensorFlow's runtime instrumentation, arXiv:1605.08695; the fleet
+efficiency/resilience tracking the TPU survey arXiv:2606.15870 leans
+on) — replacing the scattered clocks in `optimize/listeners.py`,
+`ui/stats.py` and `parallel/stats.py` with one registry + one tracer
+and three sinks:
+
+- Prometheus text exposition at the UIServer's `/metrics` route,
+- Chrome trace-event JSON (`export_chrome_trace`) for Perfetto,
+- JSONL event logs (`Tracer.export_jsonl`, `MetricsRegistry.dump_jsonl`).
+
+Usage::
+
+    from deeplearning4j_tpu import monitor
+    monitor.enable()                 # global registry + tracer live
+    net.fit(x, y, epochs=2)          # spans + counters flow automatically
+    monitor.tracer().export_chrome_trace("fit.trace.json")
+    print(monitor.registry().exposition())
+
+Overhead contract: with monitoring DISABLED (the default) the fit loops
+pay one attribute check per iteration and insert **zero** additional
+`block_until_ready` device syncs; enabling the registry/tracer adds
+host-side float math only. The only opt-in syncs in the framework
+remain `PerformanceListener(sync=True)` and `TrainingMasterStats`
+phase timing — exactly as `parallel/stats.py` documents.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from deeplearning4j_tpu.monitor.registry import (
+    GLOBAL_REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Timer,
+)
+from deeplearning4j_tpu.monitor.tracer import (
+    GLOBAL_TRACER,
+    NOOP_SPAN,
+    Span,
+    Tracer,
+)
+from deeplearning4j_tpu.monitor.collectors import (
+    DeviceMemoryCollector,
+    JitCompileCollector,
+    record_transfer as _record_transfer_impl,
+)
+from deeplearning4j_tpu.monitor.listener import MonitorListener, bind_master_stats
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "Timer",
+    "Tracer", "Span", "MonitorListener",
+    "JitCompileCollector", "DeviceMemoryCollector",
+    "enable", "disable", "is_enabled", "enabled", "registry", "tracer",
+    "span", "record_transfer", "bind_master_stats", "attach_master_stats",
+    "extra_listeners", "compile_collector", "memory_collector",
+]
+
+
+class _MonitorState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.enabled = False
+        self.registry: MetricsRegistry = GLOBAL_REGISTRY
+        self.tracer: Tracer = GLOBAL_TRACER
+        self.listener: Optional[MonitorListener] = None
+        self.compile_collector: Optional[JitCompileCollector] = None
+        self.memory_collector: Optional[DeviceMemoryCollector] = None
+
+
+_STATE = _MonitorState()
+
+
+def enable(registry: Optional[MetricsRegistry] = None,
+           tracer: Optional[Tracer] = None, *,
+           jit_compile: bool = True,
+           device_memory: bool = True) -> MetricsRegistry:
+    """Turn the telemetry substrate on (idempotent). Returns the active
+    registry. `jit_compile` installs the compile-event collector;
+    `device_memory` creates the HBM gauge collector (a no-op on
+    backends without `memory_stats()`). Neither inserts device syncs."""
+    with _STATE.lock:
+        if registry is not None:
+            _STATE.registry = registry
+        if tracer is not None:
+            _STATE.tracer = tracer
+        _STATE.tracer.enabled = True
+        _STATE.listener = MonitorListener(_STATE.registry)
+        # a collector pointed at a superseded registry must be torn down
+        # (jax's listener list is append-only: an orphaned active
+        # collector would keep feeding — and pinning — the old registry)
+        if (_STATE.compile_collector is not None
+                and _STATE.compile_collector.registry is not _STATE.registry):
+            _STATE.compile_collector.uninstall()
+            _STATE.compile_collector = None
+        if jit_compile:
+            if _STATE.compile_collector is None:
+                _STATE.compile_collector = JitCompileCollector(_STATE.registry)
+            _STATE.compile_collector.install()
+        elif _STATE.compile_collector is not None:
+            _STATE.compile_collector.uninstall()
+        if device_memory:
+            _STATE.memory_collector = DeviceMemoryCollector(_STATE.registry)
+        else:
+            _STATE.memory_collector = None
+        _STATE.enabled = True
+        return _STATE.registry
+
+
+def disable():
+    """Back to zero-cost: fit loops skip spans/counters entirely."""
+    with _STATE.lock:
+        _STATE.enabled = False
+        _STATE.tracer.enabled = False
+        if _STATE.compile_collector is not None:
+            _STATE.compile_collector.uninstall()
+        _STATE.listener = None
+
+
+def is_enabled() -> bool:
+    return _STATE.enabled
+
+
+enabled = is_enabled  # alias
+
+
+def registry() -> MetricsRegistry:
+    return _STATE.registry
+
+
+def tracer() -> Tracer:
+    return _STATE.tracer
+
+
+def compile_collector() -> Optional[JitCompileCollector]:
+    return _STATE.compile_collector
+
+
+def memory_collector() -> Optional[DeviceMemoryCollector]:
+    return _STATE.memory_collector
+
+
+def span(name: str, **args):
+    """`with monitor.span("fit/forward_backward"): ...` — NOOP_SPAN when
+    disabled (no allocation, no clock read)."""
+    if not _STATE.enabled:
+        return NOOP_SPAN
+    return _STATE.tracer.span(name, **args)
+
+
+def record_transfer(nbytes: int, direction: str = "h2d"):
+    """Host↔device placement counter hook (called by
+    `parallel/placement.gput`); no-op when disabled."""
+    if _STATE.enabled:
+        _record_transfer_impl(_STATE.registry, nbytes, direction)
+
+
+def extra_listeners() -> List:
+    """The auto-attached listener set for fit loops: `[MonitorListener]`
+    when enabled, `[]` when not. Containers call this when composing
+    their listener bus so every fit feeds the registry."""
+    l = _STATE.listener
+    return [l] if (_STATE.enabled and l is not None) else []
+
+
+def attach_master_stats(stats):
+    """Route a TrainingMasterStats' phase events onto the active
+    registry/tracer (no-op when disabled; idempotent per stats object —
+    the trainers call this at every fit()). The binding resolves the
+    registry/tracer at EVENT time, so a later `enable(registry=...)`
+    swap redirects an already-bound stats object to the new sinks (and
+    `disable()` mutes it). Returns `stats`."""
+    if (_STATE.enabled and stats is not None
+            and not getattr(stats, "_monitor_bound", False)):
+        from deeplearning4j_tpu.monitor.listener import record_master_event
+        t0_perf = getattr(stats, "_t0", None)
+
+        def on_event(ev):
+            if _STATE.enabled:
+                record_master_event(ev, _STATE.registry, _STATE.tracer,
+                                    t0_perf)
+
+        stats.add_listener(on_event)
+        stats._monitor_bound = True
+    return stats

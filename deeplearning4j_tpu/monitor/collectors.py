@@ -1,0 +1,124 @@
+"""JAX runtime collectors feeding the metrics registry.
+
+Three windows into the runtime the host-side spans can't see:
+
+- `JitCompileCollector` — compile-cache tracking via `jax.monitoring`
+  duration events (`/jax/core/compile/*`): compile count + cumulative
+  compile seconds, so bench/dashboards can split warmup (trace +
+  lowering + XLA compile) from steady-state device time.
+- `DeviceMemoryCollector` — per-device HBM gauges from
+  `device.memory_stats()` where the backend provides it (TPU/GPU; CPU
+  returns None and the collector reports itself unavailable).
+- transfer counters — host→device placements recorded by the trainers'
+  placement helpers (`parallel/placement.gput`) when monitoring is on.
+
+None of these insert device syncs: compile events are host callbacks,
+`memory_stats()` reads allocator bookkeeping, and transfer counters
+count the placements the program was doing anyway — the "zero extra
+syncs when disabled" contract (see parallel/stats.py) extends to
+"zero extra syncs when ENABLED" for every collector here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+
+
+class JitCompileCollector:
+    """Counts jit compiles and accumulates compile seconds by stage.
+
+    Registers a `jax.monitoring` duration listener; jax's listener list
+    is append-only (`clear_event_listeners` wipes everyone), so
+    `uninstall()` just deactivates the callback.
+    """
+
+    _PREFIX = "/jax/core/compile/"
+    # the event that fires once per actual XLA compilation
+    _BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self._active = False
+        self._registered = False
+
+    def install(self) -> "JitCompileCollector":
+        self._active = True
+        if not self._registered:
+            import jax.monitoring
+            jax.monitoring.register_event_duration_secs_listener(self._on_event)
+            self._registered = True
+        return self
+
+    def uninstall(self):
+        self._active = False
+
+    def _on_event(self, event: str, duration_secs: float, **kwargs):
+        if not self._active or not event.startswith(self._PREFIX):
+            return
+        stage = event[len(self._PREFIX):]
+        self.registry.counter(
+            "jax_compile_seconds_total",
+            help="cumulative jit compile time by stage",
+            stage=stage).inc(duration_secs)
+        if event == self._BACKEND_EVENT:
+            self.registry.counter(
+                "jax_compiles_total",
+                help="number of XLA backend compilations").inc()
+
+    # convenience readers (bench warmup/steady-state split)
+    def compile_count(self) -> float:
+        return self.registry.counter("jax_compiles_total").value
+
+    def compile_seconds(self) -> float:
+        total = 0.0
+        fam = self.registry._families.get("jax_compile_seconds_total")
+        if fam is not None:
+            total = sum(c.value for c in fam.children.values())
+        return total
+
+
+class DeviceMemoryCollector:
+    """Device memory gauges from `device.memory_stats()`.
+
+    `collect()` refreshes the gauges; call it wherever a fresh reading
+    matters (epoch end, /metrics scrape). Backends without allocator
+    stats (XLA:CPU) make this a no-op with `available == False`."""
+
+    _KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+             "largest_alloc_size")
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self.available: Optional[bool] = None
+
+    def collect(self) -> bool:
+        import jax
+        seen = False
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:  # noqa: BLE001 — backend-dependent API
+                stats = None
+            if not stats:
+                continue
+            seen = True
+            for key in self._KEYS:
+                if key in stats:
+                    self.registry.gauge(
+                        "jax_device_memory_bytes",
+                        help="device allocator stats",
+                        device=str(d.id), kind=key).set(float(stats[key]))
+        self.available = seen
+        return seen
+
+
+def record_transfer(registry: MetricsRegistry, nbytes: int, direction: str = "h2d"):
+    """One host↔device placement: bump count + byte counters."""
+    registry.counter("jax_transfers_total",
+                     help="array placements host<->device",
+                     direction=direction).inc()
+    registry.counter("jax_transfer_bytes_total",
+                     help="bytes moved host<->device",
+                     direction=direction).inc(float(max(0, nbytes)))

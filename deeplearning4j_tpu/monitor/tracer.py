@@ -1,0 +1,191 @@
+"""Nested-span tracer with monotonic clocks + Chrome trace export.
+
+The per-phase timeline half of the telemetry substrate (the discipline
+TensorFlow's runtime tracing established, arXiv:1605.08695): spans nest
+per-thread, timestamps come from `time.perf_counter_ns()` (monotonic —
+NTP steps can't produce negative durations), and the whole buffer
+exports as Chrome trace-event JSON that loads directly in Perfetto
+(`ui.perfetto.dev`) next to the XLA traces ProfilerListener captures.
+
+Pure stdlib, bounded memory (ring buffer), thread-safe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class Span:
+    __slots__ = ("name", "start_ns", "end_ns", "args", "thread_id", "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Dict):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self.thread_id = threading.get_ident()
+        self.start_ns = 0
+        self.end_ns = 0
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def set(self, **args):
+        self.args.update(args)
+        return self
+
+    def __enter__(self) -> "Span":
+        self.start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.end_ns = time.perf_counter_ns()
+        if exc_type is not None:
+            self.args["error"] = exc_type.__name__
+        self._tracer._commit(self)
+        return False
+
+
+class _NoopSpan:
+    """Shared do-nothing span — what a disabled tracer hands out, so hot
+    paths stay allocation-free when monitoring is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **args):
+        return self
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Ring-buffered span recorder.
+
+    `with tracer.span("fit/forward_backward", iteration=i): ...` records
+    one complete event; nesting is positional (Perfetto reconstructs the
+    stack from enclosing timestamps per thread, Chrome "X" events).
+    """
+
+    def __init__(self, max_events: int = 200_000, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max_events)
+        self._origin_ns = time.perf_counter_ns()
+        self._pid = os.getpid()
+
+    # ---------------------------------------------------------- recording
+    def span(self, name: str, **args) -> Span:
+        if not self.enabled:
+            return NOOP_SPAN
+        return Span(self, name, args)
+
+    def _commit(self, span: Span):
+        with self._lock:
+            self._events.append({
+                "name": span.name,
+                "ph": "X",
+                "ts": (span.start_ns - self._origin_ns) / 1e3,  # µs
+                "dur": (span.end_ns - span.start_ns) / 1e3,
+                "pid": self._pid,
+                "tid": span.thread_id,
+                "args": span.args,
+            })
+
+    def add_complete_event(self, name: str, start_s: float, duration_s: float,
+                           **args):
+        """Record a span whose window was timed externally (e.g. a
+        TrainingMasterStats phase event) — start_s is seconds since an
+        arbitrary epoch consistent within the caller."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "X",
+                "ts": start_s * 1e6, "dur": duration_s * 1e6,
+                "pid": self._pid, "tid": threading.get_ident(),
+                "args": args,
+            })
+
+    def complete_between(self, name: str, t0_perf: float, t1_perf: float,
+                         **args):
+        """Record a span from two `time.perf_counter()` readings (same
+        monotonic clock as the tracer origin), e.g. an ETL window the
+        iterator timed itself."""
+        if not self.enabled:
+            return
+        start_ns = int(t0_perf * 1e9) - self._origin_ns
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "X",
+                "ts": start_ns / 1e3,
+                "dur": max(0.0, (t1_perf - t0_perf) * 1e6),
+                "pid": self._pid, "tid": threading.get_ident(),
+                "args": args,
+            })
+
+    def instant(self, name: str, **args):
+        """Zero-duration marker (Chrome 'i' event)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "i", "s": "t",
+                "ts": (time.perf_counter_ns() - self._origin_ns) / 1e3,
+                "pid": self._pid, "tid": threading.get_ident(),
+                "args": args,
+            })
+
+    # ------------------------------------------------------------ queries
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def span_names(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for ev in self.events():
+            if ev["ph"] == "X":
+                out[ev["name"]] = out.get(ev["name"], 0) + 1
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self._origin_ns = time.perf_counter_ns()
+
+    # ------------------------------------------------------------- export
+    def export_chrome_trace(self, path: Optional[str] = None) -> str:
+        """Chrome trace-event JSON (object form). Loadable in Perfetto
+        and `chrome://tracing`; returns the JSON string, optionally also
+        writing it to `path`."""
+        doc = {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": {"exporter": "deeplearning4j_tpu.monitor"},
+        }
+        text = json.dumps(doc)
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+    def export_jsonl(self, path: str) -> str:
+        """One event per line — the append-friendly event-log sink."""
+        with open(path, "a") as f:
+            for ev in self.events():
+                f.write(json.dumps({"kind": "span", **ev}) + "\n")
+        return path
+
+
+GLOBAL_TRACER = Tracer(enabled=False)

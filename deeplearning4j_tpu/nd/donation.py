@@ -1,0 +1,113 @@
+"""Backend-aware buffer donation.
+
+Donation (`jit(..., donate_argnums=...)`) is an HBM-reuse optimization:
+on TPU/GPU it lets XLA write step outputs into the input buffers, which
+is what lets `params, ... = step(params, ...)` train models at the
+memory high-water mark of ONE copy. On XLA:CPU it buys nothing (host
+allocator, no HBM pressure) — and on the jaxlib 0.4.x line executing
+donated-buffer programs intermittently corrupts the process heap
+(observed in this repo's CI sandbox: segfaults / `malloc_consolidate():
+invalid chunk size` aborts at varying points of the test suite, gone
+the moment donation is stripped). Every jit site in the framework
+routes its donate_argnums through here so accelerators keep the
+optimization and CPU keeps its memory safety.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Tuple
+
+
+def donation_safe(allow_init: bool = False) -> bool:
+    """True when the selected JAX platform benefits from (and safely
+    supports) buffer donation — i.e. anything but XLA:CPU.
+
+    Decided WITHOUT forcing backend initialization where possible:
+    module-level `@partial(jax.jit, donate_argnums=...)` decorators run
+    at import time, and initializing backends there would break
+    `jax.distributed.initialize()` ordering on multi-host."""
+    import jax
+
+    # a live backend is ground truth (covers "axon,cpu" falling back to
+    # cpu when the tunnel is down)
+    try:
+        from jax._src import xla_bridge as _xb
+        if getattr(_xb, "_backends", None):
+            return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001 — private seam, fall through
+        pass
+    plats = None
+    try:
+        plats = jax.config.jax_platforms
+    except AttributeError:
+        pass
+    if not plats:
+        plats = os.environ.get("JAX_PLATFORMS", "")
+    first = plats.split(",")[0].strip().lower() if plats else ""
+    if first:
+        return first != "cpu"
+    if allow_init:
+        # the caller is at a point where backend init is acceptable
+        # (e.g. about to execute a jitted step anyway) — ask for truth
+        try:
+            return jax.default_backend() != "cpu"
+        except Exception:  # noqa: BLE001 — no backend at all
+            return False
+    # Undecidable (auto-detect, backend not yet initialized): fail
+    # CLOSED. Donation is only an HBM optimization, but donating on
+    # XLA:CPU risks the heap corruption documented above — and
+    # auto-detect with no accelerator plugin registered means CPU.
+    return False
+
+
+def donate_argnums(*nums: int) -> Tuple[int, ...]:
+    """`donate_argnums=donate_argnums(0, 1, 2)` — the given argnums on
+    accelerator backends, `()` on CPU. For jit sites built at run time
+    (the backend is live by then); module-level decorators must use
+    `jit_donated` instead, which defers the decision to first call."""
+    return tuple(nums) if donation_safe() else ()
+
+
+def jit_donated(fn=None, *, donate: Tuple[int, ...], **jit_kwargs):
+    """`jax.jit` whose donate_argnums resolve at FIRST CALL, not at
+    decoration time.
+
+    Module-level `@partial(jax.jit, donate_argnums=...)` decorators
+    evaluate during import, before any backend exists: deciding there
+    either donates on CPU (the heap corruption above) or silently drops
+    donation on TPU/GPU auto-detect. By first invocation the caller is
+    about to execute a device program anyway, so backend init is fair
+    game and the platform answer is ground truth.
+
+    The wrapper delegates attribute access (`.lower`, `._cache_size`,
+    ...) to the resolved jit function."""
+    if fn is None:
+        return lambda f: jit_donated(f, donate=donate, **jit_kwargs)
+
+    lock = threading.Lock()
+
+    class _LazyJit:
+        def _resolve(self):
+            jitted = self.__dict__.get("_jitted")
+            if jitted is None:
+                with lock:
+                    jitted = self.__dict__.get("_jitted")
+                    if jitted is None:
+                        import jax
+                        nums = (tuple(donate)
+                                if donation_safe(allow_init=True) else ())
+                        jitted = jax.jit(fn, donate_argnums=nums,
+                                         **jit_kwargs)
+                        self.__dict__["_jitted"] = jitted
+            return jitted
+
+        def __call__(self, *args, **kwargs):
+            return self._resolve()(*args, **kwargs)
+
+        def __getattr__(self, name):
+            return getattr(self._resolve(), name)
+
+    return functools.update_wrapper(_LazyJit(), fn)

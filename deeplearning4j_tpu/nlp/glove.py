@@ -12,7 +12,6 @@ non-zero entries — gathers + fused elementwise, scatter-add updates.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import jax
@@ -23,7 +22,10 @@ from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors, SequenceVect
 from deeplearning4j_tpu.nlp.vocab import VocabConstructor
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+from deeplearning4j_tpu.nd.donation import jit_donated as _jit_donated
+
+
+@_jit_donated(donate=(0, 1, 2, 3, 4, 5, 6, 7))
 def _glove_step(w, wt, b, bt, gw, gwt, gb, gbt, rows, cols, logx, weight, lr):
     """One AdaGrad step on a batch of non-zero co-occurrence cells."""
 

@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from functools import partial
 from typing import Iterable, List, Optional, Sequence
 
 import jax
@@ -35,6 +34,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+
+
+from deeplearning4j_tpu.nd.donation import donate_argnums as _donate
+from deeplearning4j_tpu.nd.donation import jit_donated as _jit_donated
 
 
 @dataclasses.dataclass
@@ -159,13 +162,13 @@ def _sg_neg_math(syn0, syn1neg, centers, contexts, negs, lr, trainable_from,
     return new_syn0, new_syn1neg, loss / n_eff
 
 
-@partial(jax.jit, static_argnums=(6,), donate_argnums=(0, 1))
+@_jit_donated(donate=(0, 1), static_argnums=(6,))
 def _sg_neg_step(syn0, syn1neg, centers, contexts, negs, lr, trainable_from):
     return _sg_neg_math(syn0, syn1neg, centers, contexts, negs, lr,
                         trainable_from)
 
 
-@partial(jax.jit, static_argnums=(6,), donate_argnums=(0, 1))
+@_jit_donated(donate=(0, 1), static_argnums=(6,))
 def _sg_neg_step_masked(syn0, syn1neg, centers, contexts, negs, lr,
                         trainable_from, valid):
     """Tail flush: ragged batch padded to the compiled [B] shape with a
@@ -197,8 +200,8 @@ def _sg_neg_scan(syn0, syn1neg, centers, contexts, negs, lrs, trainable_from):
     return syn0, syn1neg, losses[-1]
 
 
-_sg_neg_multi = jax.jit(_sg_neg_scan, static_argnums=(6,),
-                        donate_argnums=(0, 1))
+_sg_neg_multi = _jit_donated(_sg_neg_scan, donate=(0, 1),
+                            static_argnums=(6,))
 
 
 def _cbow_neg_math(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr,
@@ -264,14 +267,14 @@ def _cbow_neg_math(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr,
     return new_syn0, new_syn1neg, loss / n_eff
 
 
-@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+@_jit_donated(donate=(0, 1), static_argnums=(7,))
 def _cbow_neg_step(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr,
                    trainable_from):
     return _cbow_neg_math(syn0, syn1neg, ctx, ctx_mask, centers, negs,
                           lr, trainable_from)
 
 
-@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+@_jit_donated(donate=(0, 1), static_argnums=(7,))
 def _cbow_neg_step_masked(syn0, syn1neg, ctx, ctx_mask, centers, negs, lr,
                           trainable_from, valid):
     return _cbow_neg_math(syn0, syn1neg, ctx, ctx_mask, centers, negs,
@@ -327,14 +330,14 @@ def _cbow_hs_math(syn0, syn1, ctx, ctx_mask, centers, points, codes,
     return new_syn0, new_syn1, loss / n_eff
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
+@_jit_donated(donate=(0, 1))
 def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, centers, points, codes,
                   code_mask, lr):
     return _cbow_hs_math(syn0, syn1, ctx, ctx_mask, centers, points,
                          codes, code_mask, lr)
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
+@_jit_donated(donate=(0, 1))
 def _cbow_hs_step_masked(syn0, syn1, ctx, ctx_mask, centers, points, codes,
                          code_mask, lr, valid):
     return _cbow_hs_math(syn0, syn1, ctx, ctx_mask, centers, points,
@@ -372,12 +375,12 @@ def _sg_hs_math(syn0, syn1, centers, points, codes, code_mask, lr,
     return new_syn0, new_syn1, loss / n_eff
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
+@_jit_donated(donate=(0, 1))
 def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
     return _sg_hs_math(syn0, syn1, centers, points, codes, code_mask, lr)
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
+@_jit_donated(donate=(0, 1))
 def _sg_hs_step_masked(syn0, syn1, centers, points, codes, code_mask, lr,
                        valid):
     return _sg_hs_math(syn0, syn1, centers, points, codes, code_mask, lr,
@@ -546,11 +549,11 @@ class SequenceVectors:
             bk = NamedSharding(mesh, P(self.data_axis, None))
             b3 = NamedSharding(mesh, P(None, self.data_axis, None))
             self._sharded_step = jax.jit(
-                _sg_neg_math, static_argnums=(6,), donate_argnums=(0, 1),
+                _sg_neg_math, static_argnums=(6,), donate_argnums=_donate(0, 1),
                 in_shardings=(repl, repl, b1, b1, bk, None),
                 out_shardings=(repl, repl, None))
             self._sharded_multi = jax.jit(
-                _sg_neg_scan, static_argnums=(6,), donate_argnums=(0, 1),
+                _sg_neg_scan, static_argnums=(6,), donate_argnums=_donate(0, 1),
                 in_shardings=(repl, repl, b2, b2, b3, None),
                 out_shardings=(repl, repl, None))
         return self._sharded_step, self._sharded_multi

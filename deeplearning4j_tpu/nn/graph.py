@@ -42,6 +42,10 @@ from deeplearning4j_tpu.optimize.gradients import (
     apply_max_norm_constraint,
 )
 from deeplearning4j_tpu.optimize.listeners import ComposedListeners
+from deeplearning4j_tpu import monitor
+
+
+from deeplearning4j_tpu.nd.donation import donate_argnums as _donate
 
 
 @dataclasses.dataclass
@@ -459,7 +463,7 @@ class ComputationGraph:
             new_params, new_upd = self._apply_updates(params, grads, upd_state, it)
             return new_params, new_upd, new_state, loss, new_carries
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return jax.jit(step_fn, donate_argnums=_donate(0, 1, 2))
 
     def _multi_step_fn(self):
         """Unjitted k-fused-steps function — see
@@ -495,7 +499,7 @@ class ComputationGraph:
         """k fused train steps in one `lax.scan` dispatch — same design
         (and numerics contract) as MultiLayerNetwork._make_multi_step;
         the DAG container shares the dispatch-amortization lever."""
-        return jax.jit(self._multi_step_fn(), donate_argnums=(0, 1, 2))
+        return jax.jit(self._multi_step_fn(), donate_argnums=_donate(0, 1, 2))
 
     def _run_multi_step(self, xs_stack, ys_stack, it0):
         """xs_stack/ys_stack: tuples of [k, B, ...] arrays (one per
@@ -547,10 +551,17 @@ class ComputationGraph:
                 self._solver = Solver(self, self.conf.optimization_algo,
                                       max_iterations=self.conf.max_iterations)
             solver = self._solver
-        listeners = ComposedListeners(self.listeners)
+        listeners = ComposedListeners(self.listeners
+                                      + monitor.extra_listeners())
         rng_root = jax.random.PRNGKey(self.conf.seed + 1)
-        iterator = batches if batches is not None else as_iterator(
-            data, labels, batch_size=batch_size)
+        if batches is not None:
+            iterator = batches
+            timed_it = None
+        else:
+            from deeplearning4j_tpu.datasets.iterator import (
+                TimedDataSetIterator)
+            iterator = timed_it = TimedDataSetIterator(
+                as_iterator(data, labels, batch_size=batch_size))
         spe = max(1, int(steps_per_execution))
         fused_ok = spe > 1 and solver is None and not tbptt
 
@@ -562,37 +573,46 @@ class ComputationGraph:
                 run_one(xs, ys, (None,) * len(xs), (None,) * len(ys),
                         n_examples)
                 return
-            xs_stack = tuple(jnp.stack([p[0][i] for p in pending])
-                             for i in range(len(pending[0][0])))
-            ys_stack = tuple(jnp.stack([p[1][i] for p in pending])
-                             for i in range(len(pending[0][1])))
-            losses = np.asarray(self._run_multi_step(xs_stack, ys_stack,
-                                                     self.iteration_count))
-            for j, (_, _, n_examples) in enumerate(pending):
-                self.score_value = float(losses[j])
+            with monitor.span("fit/forward_backward",
+                              iteration=self.iteration_count,
+                              fused_steps=len(pending)):
+                xs_stack = tuple(jnp.stack([p[0][i] for p in pending])
+                                 for i in range(len(pending[0][0])))
+                ys_stack = tuple(jnp.stack([p[1][i] for p in pending])
+                                 for i in range(len(pending[0][1])))
+                losses = np.asarray(self._run_multi_step(xs_stack, ys_stack,
+                                                         self.iteration_count))
+            with monitor.span("fit/update", fused_steps=len(pending)):
+                for j, (_, _, n_examples) in enumerate(pending):
+                    self.score_value = float(losses[j])
+                    listeners.iteration_done(self, self.iteration_count,
+                                             self.epoch_count, self.score_value,
+                                             batch_size=n_examples)
+                    self.iteration_count += 1
+
+        def run_one(xs, ys, fmasks, lmasks, n_examples, etl_ms=0.0):
+            rng = jax.random.fold_in(rng_root, self.iteration_count)
+            with monitor.span("fit/forward_backward",
+                              iteration=self.iteration_count):
+                if solver is not None:
+                    loss = solver.optimize(list(xs), list(ys), list(fmasks),
+                                           list(lmasks))
+                elif tbptt and any(x.ndim == 3 for x in xs):
+                    loss = self._fit_tbptt(xs, ys, fmasks, lmasks, rng)
+                else:
+                    (self.params, self.updater_state, new_state, loss, _) = \
+                        self._jit_train_step(
+                            self.params, self.updater_state, self.net_state,
+                            self.iteration_count, xs, ys, rng, fmasks, lmasks)
+                    self.net_state = {**self.net_state, **new_state}
+            with monitor.span("fit/update", iteration=self.iteration_count):
+                self.score_value = float(loss)
                 listeners.iteration_done(self, self.iteration_count,
                                          self.epoch_count, self.score_value,
-                                         batch_size=n_examples)
-                self.iteration_count += 1
-
-        def run_one(xs, ys, fmasks, lmasks, n_examples):
-            rng = jax.random.fold_in(rng_root, self.iteration_count)
-            if solver is not None:
-                loss = solver.optimize(list(xs), list(ys), list(fmasks),
-                                       list(lmasks))
-            elif tbptt and any(x.ndim == 3 for x in xs):
-                loss = self._fit_tbptt(xs, ys, fmasks, lmasks, rng)
-            else:
-                (self.params, self.updater_state, new_state, loss, _) = \
-                    self._jit_train_step(
-                        self.params, self.updater_state, self.net_state,
-                        self.iteration_count, xs, ys, rng, fmasks, lmasks)
-                self.net_state = {**self.net_state, **new_state}
-            self.score_value = float(loss)
-            listeners.iteration_done(self, self.iteration_count, self.epoch_count,
-                                     self.score_value, batch_size=n_examples)
+                                         batch_size=n_examples, etl_ms=etl_ms)
             self.iteration_count += 1
 
+        mon_on = monitor.is_enabled()
         listeners.on_fit_start(self)
         for _ in range(epochs):
             listeners.on_epoch_start(self, self.epoch_count)
@@ -600,6 +620,12 @@ class ComputationGraph:
                 iterator.reset()
             pending = []
             for ds in iterator:
+                etl_ms = timed_it.last_etl_ms if timed_it is not None else 0.0
+                if mon_on and timed_it is not None:
+                    t1 = time.perf_counter()
+                    monitor.tracer().complete_between(
+                        "fit/etl", t1 - etl_ms / 1e3, t1,
+                        iteration=self.iteration_count)
                 if isinstance(ds, MultiDataSet):
                     xs = tuple(jnp.asarray(f) for f in ds.features)
                     ys = tuple(jnp.asarray(l) for l in ds.labels)
@@ -619,7 +645,7 @@ class ComputationGraph:
                 if not fused_ok or masked:
                     flush(pending)
                     pending = []
-                    run_one(xs, ys, fmasks, lmasks, n_examples)
+                    run_one(xs, ys, fmasks, lmasks, n_examples, etl_ms)
                 else:
                     if pending and any(
                             a.shape != b.shape

@@ -47,7 +47,15 @@ from deeplearning4j_tpu.optimize.gradients import (
     apply_max_norm_constraint,
 )
 from deeplearning4j_tpu.optimize.listeners import ComposedListeners, TrainingListener
-from deeplearning4j_tpu.datasets.iterator import DataSetIterator, as_iterator
+from deeplearning4j_tpu.datasets.iterator import (
+    DataSetIterator,
+    TimedDataSetIterator,
+    as_iterator,
+)
+from deeplearning4j_tpu import monitor
+
+
+from deeplearning4j_tpu.nd.donation import donate_argnums as _donate
 
 
 def _convert_features(x, data_format):
@@ -276,7 +284,7 @@ class MultiLayerNetwork:
             new_params, new_upd = self._apply_updates(params, grads, upd_state, it)
             return new_params, new_upd, new_state, loss, new_carries
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return jax.jit(step_fn, donate_argnums=_donate(0, 1, 2))
 
     def _multi_step_fn(self):
         """Unjitted k-fused-steps function (`lax.scan` over the step
@@ -326,7 +334,7 @@ class MultiLayerNetwork:
         idiomatic XLA fix. Numerics are identical to k single steps:
         same per-iteration RNG fold, same updater step counter.
         """
-        return jax.jit(self._multi_step_fn(), donate_argnums=(0, 1, 2))
+        return jax.jit(self._multi_step_fn(), donate_argnums=_donate(0, 1, 2))
 
     def _run_multi_step(self, xs, ys, it0):
         """Run len(xs) fused steps on stacked batches. Returns per-step
@@ -356,8 +364,12 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
         self._sync_ambient_context()
-        iterator = as_iterator(data, labels, batch_size=batch_size, shuffle=shuffle)
-        listeners = ComposedListeners(self.listeners)
+        # iterator-side ETL attribution (feeds the etl_ms info key and,
+        # when monitoring is on, fit/etl spans + the ETL histogram)
+        iterator = TimedDataSetIterator(
+            as_iterator(data, labels, batch_size=batch_size, shuffle=shuffle))
+        listeners = ComposedListeners(self.listeners
+                                      + monitor.extra_listeners())
         rng_root = jax.random.PRNGKey(self.conf.seed + 1)
         tbptt = self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
         solver = None
@@ -385,22 +397,29 @@ class MultiLayerNetwork:
 
         def fit_one(x, y, fmask, lmask, etl_ms):
             rng = jax.random.fold_in(rng_root, self.iteration_count)
-            if solver is not None:
-                loss = solver.optimize(x, y, fmask, lmask)
-            elif tbptt and x.ndim == 3:
-                loss = self._fit_tbptt(x, y, fmask, lmask, rng)
-            else:
-                (self.params, self.updater_state, new_state, loss, _) = \
-                    self._jit_train_step(self.params, self.updater_state,
-                                         self.net_state, self.iteration_count,
-                                         x, y, rng, fmask, lmask, None)
-                self.net_state = {**self.net_state, **new_state}
-            self.score_value = float(loss)
-            listeners.iteration_done(self, self.iteration_count, self.epoch_count,
-                                     self.score_value,
-                                     batch_size=int(np.shape(x)[0]),
-                                     etl_ms=etl_ms,
-                                     batch=(x, y, fmask, lmask))
+            # forward_backward covers the step's device dispatch (the
+            # fused fwd+bwd+update program); the score readback + host
+            # state merge + listener fan-out is the update span. With
+            # monitoring off both spans are the shared no-op.
+            with monitor.span("fit/forward_backward",
+                              iteration=self.iteration_count):
+                if solver is not None:
+                    loss = solver.optimize(x, y, fmask, lmask)
+                elif tbptt and x.ndim == 3:
+                    loss = self._fit_tbptt(x, y, fmask, lmask, rng)
+                else:
+                    (self.params, self.updater_state, new_state, loss, _) = \
+                        self._jit_train_step(self.params, self.updater_state,
+                                             self.net_state, self.iteration_count,
+                                             x, y, rng, fmask, lmask, None)
+                    self.net_state = {**self.net_state, **new_state}
+            with monitor.span("fit/update", iteration=self.iteration_count):
+                self.score_value = float(loss)
+                listeners.iteration_done(self, self.iteration_count, self.epoch_count,
+                                         self.score_value,
+                                         batch_size=int(np.shape(x)[0]),
+                                         etl_ms=etl_ms,
+                                         batch=(x, y, fmask, lmask))
             self.iteration_count += 1
 
         def flush(pending, etl_ms):
@@ -409,26 +428,36 @@ class MultiLayerNetwork:
             if len(pending) == 1:
                 fit_one(pending[0][0], pending[0][1], None, None, etl_ms)
                 return
-            xs = jnp.stack([p[0] for p in pending])
-            ys = jnp.stack([p[1] for p in pending])
-            losses = np.asarray(self._run_multi_step(xs, ys, self.iteration_count))
-            for j, (x, y) in enumerate(pending):
-                self.score_value = float(losses[j])
-                listeners.iteration_done(self, self.iteration_count,
-                                         self.epoch_count, self.score_value,
-                                         batch_size=int(np.shape(x)[0]),
-                                         etl_ms=etl_ms if j == 0 else 0.0,
-                                         batch=(x, y, None, None))
-                self.iteration_count += 1
+            with monitor.span("fit/forward_backward",
+                              iteration=self.iteration_count,
+                              fused_steps=len(pending)):
+                xs = jnp.stack([p[0] for p in pending])
+                ys = jnp.stack([p[1] for p in pending])
+                losses = np.asarray(self._run_multi_step(xs, ys,
+                                                         self.iteration_count))
+            with monitor.span("fit/update", fused_steps=len(pending)):
+                for j, (x, y) in enumerate(pending):
+                    self.score_value = float(losses[j])
+                    listeners.iteration_done(self, self.iteration_count,
+                                             self.epoch_count, self.score_value,
+                                             batch_size=int(np.shape(x)[0]),
+                                             etl_ms=etl_ms if j == 0 else 0.0,
+                                             batch=(x, y, None, None))
+                    self.iteration_count += 1
 
+        mon_on = monitor.is_enabled()
         listeners.on_fit_start(self)
         for _ in range(epochs):
             listeners.on_epoch_start(self, self.epoch_count)
             iterator.reset()
-            etl_start = time.perf_counter()
             pending = []
             for ds in iterator:
-                etl_ms = (time.perf_counter() - etl_start) * 1000.0
+                etl_ms = iterator.last_etl_ms
+                if mon_on:
+                    t1 = time.perf_counter()
+                    monitor.tracer().complete_between(
+                        "fit/etl", t1 - etl_ms / 1e3, t1,
+                        iteration=self.iteration_count)
                 x = _convert_features(ds.features, data_format)
                 y = _convert_labels(ds.labels, data_format)
                 fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
@@ -446,7 +475,6 @@ class MultiLayerNetwork:
                     if len(pending) == spe:
                         flush(pending, etl_ms)
                         pending = []
-                etl_start = time.perf_counter()
             flush(pending, 0.0)
             listeners.on_epoch_end(self, self.epoch_count)
             self.epoch_count += 1

@@ -48,25 +48,40 @@ class ScoreIterationListener(TrainingListener):
 
 class PerformanceListener(TrainingListener):
     """Samples/sec + batches/sec + ETL time (reference
-    `PerformanceListener.java:87-88`)."""
+    `PerformanceListener.java:87-88`).
+
+    JAX dispatch is async: without `sync`, the wall-clock window covers
+    enqueue time, not execution — rates read absurdly high for small
+    models. `sync=True` blocks on the model's params before each
+    timestamp so the window brackets real device work (one extra sync
+    per measured iteration — opt in, per the overhead contract in
+    docs/OBSERVABILITY.md)."""
 
     def __init__(self, frequency: int = 1, report_etl: bool = True,
-                 printer: Callable[[str], None] = None):
+                 printer: Callable[[str], None] = None, sync: bool = False):
         self.frequency = max(1, frequency)
         self.report_etl = report_etl
+        self.sync = sync
         self.printer = printer or (lambda s: log.info(s))
         self._last_time: Optional[float] = None
         self.history: List[dict] = []
 
     def iteration_done(self, model, iteration, epoch, score, **info):
+        if self.sync:
+            import jax
+            params = getattr(model, "params", None)
+            if params is not None:
+                jax.block_until_ready(params)
         now = time.perf_counter()
         if self._last_time is not None and iteration % self.frequency == 0:
             dt = now - self._last_time
             batch = info.get("batch_size", 0)
+            # dt == 0 (timer resolution) must emit 0.0, not inf — inf is
+            # not valid JSON and breaks every exporter downstream
             rec = {
                 "iteration": iteration,
-                "batches_per_sec": 1.0 / dt if dt > 0 else float("inf"),
-                "samples_per_sec": batch / dt if dt > 0 else float("inf"),
+                "batches_per_sec": 1.0 / dt if dt > 0 else 0.0,
+                "samples_per_sec": batch / dt if dt > 0 else 0.0,
                 "etl_ms": info.get("etl_ms", 0.0),
             }
             self.history.append(rec)

@@ -1,0 +1,49 @@
+"""Version portability for the JAX SPMD / config surface.
+
+`shard_map` moved from `jax.experimental.shard_map` (jax<=0.4.x, where
+its replication-check kwarg is `check_rep`) to `jax.shard_map` (where
+the kwarg became `check_vma`); `lax.axis_size` and the public
+`jax.enable_x64` context only exist on the new line. Every user in
+this package goes through these shims so the trainers run on both.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+
+def axis_size(axis_name):
+    """`lax.axis_size(axis_name)` where it exists; on 0.4.x fall back
+    to `lax.psum(1, axis_name)`, which JAX folds to a Python int at
+    trace time (no runtime collective)."""
+    impl = getattr(lax, "axis_size", None)
+    if impl is not None:
+        return impl(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def enable_x64(new_val: bool = True):
+    """`jax.enable_x64` context manager on new JAX,
+    `jax.experimental.enable_x64` on 0.4.x."""
+    impl = getattr(jax, "enable_x64", None)
+    if impl is None:
+        from jax.experimental import enable_x64 as impl
+    return impl(new_val)
+
+
+def shard_map(f=None, **kwargs):
+    """`jax.shard_map` on new JAX, `jax.experimental.shard_map` on 0.4.x
+    (translating `check_vma` to its old name `check_rep`). Usable like
+    the real thing: `@partial(shard_map, mesh=..., in_specs=...,
+    out_specs=..., check_vma=False)`."""
+    impl = getattr(jax, "shard_map", None)
+    if impl is None:
+        from jax.experimental.shard_map import shard_map as impl
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return functools.partial(shard_map, **kwargs)
+    return impl(f, **kwargs)

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.compat import axis_size, shard_map
+
 
 def pipeline_apply(block_fn: Callable, stage_params, x_mb, axis_name: str):
     """Per-shard: stage_params = THIS stage's block params (pytree),
@@ -33,7 +35,7 @@ def pipeline_apply(block_fn: Callable, stage_params, x_mb, axis_name: str):
 
     Must run inside shard_map with `axis_name` bound.
     """
-    S = lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     M = x_mb.shape[0]
     ticks = M + S - 1
@@ -78,18 +80,34 @@ def pipeline_forward(block_fn, stacked_params, x, mesh: Mesh, *,
     B = x.shape[0]
     assert B % microbatches == 0, "batch must divide microbatches"
     x_mb = x.reshape((microbatches, B // microbatches) + x.shape[1:])
+    # jax 0.4.x GSPMD miscompiles the reshard of a jit-traced
+    # intermediate into a shard_map in_spec that partitions one mesh
+    # axis while leaving another unmentioned (the value arrives SUMMED
+    # over the unmentioned axis instead of sliced — observed on the
+    # 0.4.37 CPU backend with a ("data", "pipe") mesh). On that line,
+    # hand every stage the full replicated stack (in_spec P()) and
+    # slice its stage inside the body; new-line JAX keeps the intended
+    # P(pipe) param sharding.
+    replicate_params = not hasattr(jax, "shard_map")
     p_spec = jax.tree_util.tree_map(
-        lambda _: P(pipe_axis), stacked_params)
+        lambda _: P() if replicate_params else P(pipe_axis),
+        stacked_params)
     mb_spec = P(None, data_axis) if data_axis else P()
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(p_spec, mb_spec), out_specs=mb_spec,
              check_vma=False)
     def run(params_stage, mb):
-        local = jax.tree_util.tree_map(lambda a: a[0], params_stage)
+        if replicate_params:
+            s = lax.axis_index(pipe_axis)
+            local = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, s, 0, keepdims=False),
+                params_stage)
+        else:
+            local = jax.tree_util.tree_map(lambda a: a[0], params_stage)
         out = pipeline_apply(block_fn, local, mb, pipe_axis)
         # outputs are valid only on the last stage; broadcast them
-        return _broadcast_from(out, pipe_axis, lax.axis_size(pipe_axis) - 1)
+        return _broadcast_from(out, pipe_axis, axis_size(pipe_axis) - 1)
 
     out_mb = run(stacked_params, x_mb)
     return out_mb.reshape((B,) + out_mb.shape[2:])

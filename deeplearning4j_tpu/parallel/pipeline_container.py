@@ -31,6 +31,7 @@ reverse permute), giving pipeline-parallel backprop from one
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -41,6 +42,9 @@ from jax.sharding import Mesh
 from deeplearning4j_tpu.datasets.iterator import as_iterator
 from deeplearning4j_tpu.optimize.listeners import ComposedListeners
 from deeplearning4j_tpu.parallel.pipeline import pipeline_forward
+
+
+from deeplearning4j_tpu.nd.donation import donate_argnums as _donate
 
 
 def _layer_signature(layer, lparams):
@@ -91,7 +95,10 @@ class PipelineParallelTrainer:
 
     def __init__(self, model, mesh: Mesh, *, pipe_axis: str = "pipe",
                  data_axis: Optional[str] = None, microbatches: int = 4,
-                 run: Optional[Tuple[int, int]] = None):
+                 run: Optional[Tuple[int, int]] = None, stats=None):
+        # stats: optional TrainingMasterStats — sync_step timing per
+        # pipelined step (one device sync per step when enabled)
+        self.stats = stats
         if not model._initialized:
             model.init()
         if not hasattr(model, "_forward_core"):
@@ -234,7 +241,7 @@ class PipelineParallelTrainer:
             new_params, new_upd = model._apply_updates(params, grads, upd, it)
             return new_params, new_upd, new_state, loss
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._step = jax.jit(step, donate_argnums=_donate(0, 1))
 
     def evaluate(self, data, labels=None, *, batch_size: int = 32,
                  evaluation=None):
@@ -299,8 +306,11 @@ class PipelineParallelTrainer:
         model = self.model
         if self._step is None:
             self._build()
+        from deeplearning4j_tpu import monitor
+        monitor.attach_master_stats(self.stats)
         iterator = as_iterator(data, labels, batch_size=batch_size)
-        listeners = ComposedListeners(model.listeners)
+        listeners = ComposedListeners(model.listeners
+                                      + monitor.extra_listeners())
         rng_root = jax.random.PRNGKey(model.conf.seed + 1)
         params, upd, state = model.params, model.updater_state, model.net_state
         for _ in range(epochs):
@@ -309,10 +319,17 @@ class PipelineParallelTrainer:
                 if ds.features_mask is not None or ds.labels_mask is not None:
                     raise ValueError("masks are not supported under PP")
                 rng = jax.random.fold_in(rng_root, model.iteration_count)
+                t0 = time.perf_counter() if self.stats is not None else 0.0
                 params, upd, new_state, loss = self._step(
                     params, upd, state, model.iteration_count,
                     jnp.asarray(ds.features), jnp.asarray(ds.labels), rng)
                 state = {**state, **new_state}
+                if self.stats is not None:
+                    jax.block_until_ready(loss)
+                    self.stats.record("sync_step",
+                                      time.perf_counter() - t0,
+                                      iteration=model.iteration_count)
+                    self.stats.next_round()
                 model.score_value = float(loss)
                 listeners.iteration_done(model, model.iteration_count,
                                          model.epoch_count, model.score_value,

@@ -17,6 +17,8 @@ import jax
 import numpy as np
 from jax.sharding import Sharding
 
+from deeplearning4j_tpu import monitor
+
 
 def gput(arr, sharding):
     # a leaf can already be a global array spanning non-addressable
@@ -28,6 +30,8 @@ def gput(arr, sharding):
             return arr
         return jax.device_put(arr, sharding)
     a = np.asarray(arr)
+    # counts the placement the program was doing anyway — no sync added
+    monitor.record_transfer(a.nbytes, "h2d")
     if jax.process_count() > 1:
         return jax.make_array_from_callback(a.shape, sharding,
                                             lambda idx: a[idx])
@@ -49,6 +53,8 @@ def host_view_tree(tree):
     this framework accepts either)."""
     def to_host(a):
         if getattr(a, "is_fully_replicated", True) or jax.process_count() == 1:
-            return np.asarray(a)
+            h = np.asarray(a)
+            monitor.record_transfer(h.nbytes, "d2h")
+            return h
         return a
     return jax.tree_util.tree_map(to_host, tree)

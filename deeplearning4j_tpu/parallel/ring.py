@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.compat import axis_size, shard_map
+
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                    use_flash: bool = False):
@@ -43,7 +45,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     if use_flash:
         return _ring_attention_flash(q, k, v, axis_name, causal)
 
-    P_ = lax.axis_size(axis_name)
+    P_ = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Tl, H, Dh = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, q.dtype))
@@ -111,7 +113,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal):
         _NEG_INF, flash_attention_carry,
     )
 
-    P_ = lax.axis_size(axis_name)
+    P_ = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Tl, H, Dh = q.shape
     out_dtype = q.dtype
@@ -179,7 +181,7 @@ def _ring_flash_bwd(axis_name, causal, res, g):
     )
 
     q, k, v, o, lse = res
-    P_ = lax.axis_size(axis_name)
+    P_ = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     delta = attention_delta(g, o)                    # [B, H, Tl] fp32
 
@@ -251,7 +253,7 @@ def sequence_parallel_attention(q, k, v, mesh: Mesh, *,
     `seq_axis` of `mesh`."""
     spec = P(None, seq_axis)
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(spec, spec, spec), out_specs=spec,
              check_vma=False)
     def run(ql, kl, vl):

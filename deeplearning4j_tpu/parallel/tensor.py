@@ -17,6 +17,7 @@ gather logits across the mesh boundary.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Dict, Optional
 
@@ -27,6 +28,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.datasets.iterator import as_iterator
 from deeplearning4j_tpu.optimize.listeners import ComposedListeners
+
+
+from deeplearning4j_tpu.nd.donation import donate_argnums as _donate
 
 
 def tp_param_specs(model, model_axis: str = "model",
@@ -141,9 +145,14 @@ class ShardedParallelTrainer:
     (gradient psum over data, activation gathers over model)."""
 
     def __init__(self, model, mesh: Mesh, *, data_axis: str = "data",
-                 model_axis: str = "model", param_specs: Optional[Dict] = None):
+                 model_axis: str = "model", param_specs: Optional[Dict] = None,
+                 stats=None):
         self.model = model
         self.mesh = mesh
+        # stats: optional TrainingMasterStats — per-phase round timing
+        # (broadcast / sync_step), same opt-in sync cost as
+        # ParallelTrainer's stats collection
+        self.stats = stats
         self.data_axis = data_axis
         self.model_axis = model_axis
         if not model._initialized:
@@ -203,7 +212,7 @@ class ShardedParallelTrainer:
             in_shardings=(self._psh, self._ush, self._repl, None,
                           self._bsh, self._bsh, None),
             out_shardings=(self._psh, self._ush, self._repl, None, None),
-            donate_argnums=(0, 1, 2))
+            donate_argnums=_donate(0, 1, 2))
 
     def evaluate(self, data, labels=None, *, batch_size: int = 32,
                  evaluation=None):
@@ -262,18 +271,28 @@ class ShardedParallelTrainer:
         model = self.model
         if self._step is None:
             self._build()
+        from deeplearning4j_tpu import monitor
+        monitor.attach_master_stats(self.stats)
         # multi-process aware placement: each process contributes only
         # its addressable shards of the TP-sharded param tree
-        params = gput_tree(model.params, self._psh)
-        upd = gput_tree(model.updater_state, self._ush)
-        state = gput_tree(model.net_state, self._repl)
+        if self.stats is not None:
+            with self.stats.time_phase("broadcast"):
+                params = gput_tree(model.params, self._psh)
+                upd = gput_tree(model.updater_state, self._ush)
+                state = gput_tree(model.net_state, self._repl)
+                jax.block_until_ready(params)
+        else:
+            params = gput_tree(model.params, self._psh)
+            upd = gput_tree(model.updater_state, self._ush)
+            state = gput_tree(model.net_state, self._repl)
         iterator = as_iterator(data, labels, batch_size=batch_size)
-        listeners = ComposedListeners(model.listeners)
+        listeners = ComposedListeners(model.listeners
+                                      + monitor.extra_listeners())
         rng_root = jax.random.PRNGKey(model.conf.seed + 5)
         # per-step scalar readback serializes host on device; only pay
-        # it when a listener will look at the score (same gate as
-        # ParallelTrainer's sync path)
-        eager_loss = bool(model.listeners)
+        # it when a listener/stats consumer will look at the score (same
+        # gate as ParallelTrainer's sync path)
+        eager_loss = bool(model.listeners) or self.stats is not None
         loss = None
         for _ in range(epochs):
             iterator.reset()
@@ -281,12 +300,23 @@ class ShardedParallelTrainer:
                 x = gput(ds.features, self._bsh)
                 y = gput(ds.labels, self._bsh)
                 rng = jax.random.fold_in(rng_root, model.iteration_count)
+                t0 = time.perf_counter() if self.stats is not None else 0.0
                 params, upd, state, loss, _ = self._step(
                     params, upd, state, model.iteration_count, x, y, rng)
+                if self.stats is not None:
+                    jax.block_until_ready(loss)
+                    self.stats.record("sync_step",
+                                      time.perf_counter() - t0,
+                                      iteration=model.iteration_count)
+                    self.stats.next_round()
                 if eager_loss:
                     model.score_value = float(loss)
+                # non-eager: NaN = "score not read back this step" (the
+                # monitor listener's sentinel), never a stale score
                 listeners.iteration_done(model, model.iteration_count,
-                                         model.epoch_count, model.score_value,
+                                         model.epoch_count,
+                                         model.score_value if eager_loss
+                                         else float("nan"),
                                          batch_size=ds.num_examples())
                 model.iteration_count += 1
             model.epoch_count += 1

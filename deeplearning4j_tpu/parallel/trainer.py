@@ -37,6 +37,10 @@ from deeplearning4j_tpu.datasets.iterator import as_iterator
 from deeplearning4j_tpu.optimize.gradients import apply_gradient_normalization
 from deeplearning4j_tpu.optimize.listeners import ComposedListeners
 from deeplearning4j_tpu.parallel.mesh import device_mesh
+from deeplearning4j_tpu import monitor
+
+
+from deeplearning4j_tpu.nd.donation import donate_argnums as _donate
 
 
 # shared with ShardedParallelTrainer — see parallel/placement.py
@@ -119,7 +123,7 @@ class ParallelTrainer:
             step,
             in_shardings=(repl, repl, repl, None, batch_sharded, batch_sharded, None),
             out_shardings=(repl, repl, repl, None, None),
-            donate_argnums=(0, 1, 2),
+            donate_argnums=_donate(0, 1, 2),
         )
 
     def _build_sync_multi(self):
@@ -135,7 +139,7 @@ class ParallelTrainer:
             self.model._multi_step_fn(),
             in_shardings=(repl, repl, repl, None, stack_sh, stack_sh, None),
             out_shardings=(repl, repl, repl, None),
-            donate_argnums=(0, 1, 2),
+            donate_argnums=_donate(0, 1, 2),
         )
 
     # -------------------------------------------------------- averaging mode
@@ -160,7 +164,7 @@ class ParallelTrainer:
         axis = self.data_axis
         local_one_step = self._make_local_one_step()
 
-        from jax import shard_map
+        from deeplearning4j_tpu.parallel.compat import shard_map
 
         # per-replica params: leading axis of size n_workers, sharded over "data"
         rep_spec = P(axis)
@@ -187,8 +191,8 @@ class ParallelTrainer:
             avg = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, axis), tree)
             return jax.tree_util.tree_map(lambda a: a[None], avg)
 
-        self._local_step = jax.jit(local_step, donate_argnums=(0, 1, 2))
-        self._average_fn = jax.jit(average, donate_argnums=(0,))
+        self._local_step = jax.jit(local_step, donate_argnums=_donate(0, 1, 2))
+        self._average_fn = jax.jit(average, donate_argnums=_donate(0))
 
     def _build_averaging_multi(self):
         """k fused local-SGD steps in ONE dispatch: the scan lives
@@ -203,7 +207,7 @@ class ParallelTrainer:
         avg_upd = self.average_updater_state
         local_one_step = self._make_local_one_step()
 
-        from jax import shard_map
+        from deeplearning4j_tpu.parallel.compat import shard_map
         from jax import lax
 
         rep_spec = P(axis)
@@ -245,7 +249,7 @@ class ParallelTrainer:
             expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
             return expand(params), expand(upd), expand(state), losses[:, None]
 
-        self._local_multi = jax.jit(local_multi, donate_argnums=(0, 1, 2))
+        self._local_multi = jax.jit(local_multi, donate_argnums=_donate(0, 1, 2))
 
     @staticmethod
     def _run_grouped(iterator, epochs, spe, divisible, run_single, drain,
@@ -354,7 +358,12 @@ class ParallelTrainer:
         if not model._initialized:
             model.init()
         iterator = as_iterator(data, labels, batch_size=batch_size)
-        listeners = ComposedListeners(model.listeners)
+        # when the telemetry substrate is on, phase events flow onto the
+        # global registry/tracer and the fit feeds /metrics like any
+        # single-model fit (monitor.extra_listeners() is [] when off)
+        monitor.attach_master_stats(self.stats)
+        listeners = ComposedListeners(model.listeners
+                                      + monitor.extra_listeners())
         rng_root = jax.random.PRNGKey(model.conf.seed + 3)
 
         n_div = self.n_workers
@@ -428,8 +437,12 @@ class ParallelTrainer:
                                       time.perf_counter() - t0,
                                       iteration=model.iteration_count)
                     self.stats.next_round()
+                # non-eager: NaN = "score not read back this step" (the
+                # monitor listener's sentinel), never a stale score
                 listeners.iteration_done(model, model.iteration_count,
-                                         model.epoch_count, model.score_value,
+                                         model.epoch_count,
+                                         model.score_value if eager_loss
+                                         else float("nan"),
                                          batch_size=ds.num_examples())
                 model.iteration_count += 1
 
@@ -462,7 +475,8 @@ class ParallelTrainer:
                         model.score_value = float(lv[j])
                     listeners.iteration_done(model, model.iteration_count,
                                              model.epoch_count,
-                                             model.score_value,
+                                             model.score_value if eager_loss
+                                             else float("nan"),
                                              batch_size=d.num_examples())
                     model.iteration_count += 1
 
@@ -536,7 +550,9 @@ class ParallelTrainer:
                                       round=self.stats.next_round())
                 since_avg = 0
             listeners.iteration_done(model, model.iteration_count,
-                                     model.epoch_count, model.score_value,
+                                     model.epoch_count,
+                                     model.score_value if eager_loss
+                                     else float("nan"),
                                      batch_size=ds.num_examples())
             model.iteration_count += 1
 
@@ -565,7 +581,9 @@ class ParallelTrainer:
                 if eager_loss:
                     model.score_value = float(lv[j].mean())
                 listeners.iteration_done(model, model.iteration_count,
-                                         model.epoch_count, model.score_value,
+                                         model.epoch_count,
+                                         model.score_value if eager_loss
+                                         else float("nan"),
                                          batch_size=d.num_examples())
                 model.iteration_count += 1
 

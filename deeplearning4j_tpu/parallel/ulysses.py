@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.compat import axis_size, shard_map
+
 from deeplearning4j_tpu.parallel.ring import reference_attention
 
 
@@ -55,7 +57,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     differentiable) — since each device sees the FULL sequence for its
     head subset, this is where the O(block)-VMEM streaming matters most
     in the Ulysses schedule."""
-    Pn = lax.axis_size(axis_name)
+    Pn = axis_size(axis_name)
     B, Tl, H, Dh = q.shape
     if H % Pn != 0:
         raise ValueError(f"num_heads={H} must divide by seq devices={Pn}")
@@ -89,7 +91,7 @@ def ulysses_parallel_attention(q, k, v, mesh: Mesh, *,
     all-to-all schedule, returns full [B, T, H, Dh]."""
     spec = P(None, axis_name, None, None)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
     def run(ql, kl, vl):
         return ulysses_attention(ql, kl, vl, axis_name, causal=causal,

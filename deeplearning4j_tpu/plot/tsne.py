@@ -13,7 +13,6 @@ capability split the reference has (Java loops there, jit here).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -21,6 +20,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.clustering.sptree import SpTree
+
+
+from deeplearning4j_tpu.nd.donation import jit_donated as _jit_donated
 
 
 def _binary_search_perplexity(d2_row: np.ndarray, perplexity: float,
@@ -62,7 +64,7 @@ def _compute_p(x: np.ndarray, perplexity: float) -> np.ndarray:
     return np.maximum(p, 1e-12)
 
 
-@partial(jax.jit, donate_argnums=(1, 2, 3))
+@_jit_donated(donate=(1, 2, 3))
 def _tsne_step(p, y, velocity, gains, momentum, lr):
     """One exact t-SNE gradient step (jitted: [N,N] blocks on device)."""
     sum_y = jnp.sum(y * y, axis=1)

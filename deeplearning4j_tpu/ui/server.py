@@ -41,8 +41,11 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, registry=None):
         self.storage: StatsStorage = InMemoryStatsStorage()
+        # /metrics exposition source; None → the process-global monitor
+        # registry at request time (so enable() after server start works)
+        self._registry = registry
         self._tsne: Dict[str, dict] = {}          # session → {coords, labels}
         self._activations: Dict[str, bytes] = {}  # name → PNG bytes
         self._module_lock = threading.Lock()      # guards the two dicts
@@ -97,6 +100,11 @@ class UIServer:
                         self._send(404, "not found")
                     else:
                         self._send(200, png, "image/png")
+                elif path == "/metrics":
+                    # Prometheus text exposition (the telemetry core's
+                    # scrape endpoint — see monitor/ and docs/OBSERVABILITY.md)
+                    self._send(200, outer.metrics_text(),
+                               "text/plain; version=0.0.4; charset=utf-8")
                 elif path == "/api/sessions":
                     self._send(200, json.dumps(outer.storage.list_session_ids()),
                                "application/json")
@@ -352,6 +360,26 @@ class UIServer:
     def attach(self, storage: StatsStorage):
         self.storage = storage
         return self
+
+    def attach_registry(self, registry):
+        """Serve `/metrics` from this MetricsRegistry instead of the
+        process-global one."""
+        self._registry = registry
+        return self
+
+    def metrics_text(self) -> str:
+        from deeplearning4j_tpu import monitor
+        reg = self._registry if self._registry is not None \
+            else monitor.registry()
+        # refresh lazy device gauges right before the scrape, into the
+        # registry actually being served (no-op on backends without
+        # memory_stats, and when monitoring is off)
+        if monitor.is_enabled():
+            mc = monitor.memory_collector()
+            if mc is None or mc.registry is not reg:
+                mc = monitor.DeviceMemoryCollector(reg)
+            mc.collect()
+        return reg.exposition()
 
     def start(self):
         self._thread = threading.Thread(target=self._httpd.serve_forever,

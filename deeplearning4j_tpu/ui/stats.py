@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import resource
 import struct
+import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -21,6 +22,16 @@ import numpy as np
 
 _MAGIC = b"DL4JSTAT"
 _VERSION = 1
+
+
+def _rss_mb() -> float:
+    """Peak RSS of this process in MB. `getrusage().ru_maxrss` is
+    KILOBYTES on Linux but BYTES on macOS (see getrusage(2) in each) —
+    dividing by 1024 unconditionally inflated mac numbers 1024x."""
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    if sys.platform == "darwin":
+        return rss / (1024.0 * 1024.0)
+    return rss / 1024.0
 
 
 @dataclasses.dataclass
@@ -149,7 +160,7 @@ class StatsListener:
             iteration=iteration, epoch=epoch, timestamp=time.time(),
             score=float(score), iteration_time_ms=dt_ms,
             examples_per_sec=(batch / (dt_ms / 1e3) if dt_ms > 0 and batch else 0.0),
-            memory_rss_mb=resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+            memory_rss_mb=_rss_mb(),
         )
         for lk, lparams in model.params.items():
             for pn, arr in lparams.items():

@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# The repo's verification gate — what builders and reviewers both run.
+#
+# 1. Tier-1 tests: the ROADMAP.md command VERBATIM (same timeout, same
+#    pass-count accounting), so local runs and the driver's gate can
+#    never drift apart.
+# 2. /metrics smoke: boot a UIServer on an ephemeral port after a short
+#    fit() and assert the Prometheus exposition parses and contains
+#    training counters (the telemetry core's acceptance surface —
+#    docs/OBSERVABILITY.md).
+
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== [1/2] tier-1 tests (ROADMAP.md verbatim) =="
+bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
+tier1_rc=$?
+
+echo "== [2/2] /metrics smoke =="
+JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import UIServer
+
+monitor.enable()
+conf = (NeuralNetConfiguration.builder().seed(0).list()
+        .layer(DenseLayer(n_in=4, n_out=8))
+        .layer(OutputLayer(n_in=8, n_out=3))
+        .build())
+net = MultiLayerNetwork(conf).init()
+x = np.random.default_rng(0).standard_normal((16, 4)).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[np.random.default_rng(1).integers(0, 3, 16)]
+net.fit(x, y, epochs=1, batch_size=8)
+
+server = UIServer().start()   # port=0 -> ephemeral
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/metrics", timeout=10).read().decode()
+finally:
+    server.stop()
+
+assert "training_iterations_total" in body, body[:400]
+for line in body.splitlines():
+    if line and not line.startswith("#"):
+        name = line.split("{")[0].split(" ")[0]
+        assert name and name[0].isalpha() or name[0] == "_", line
+nspans = sum(monitor.tracer().span_names().values())
+assert nspans >= 3, monitor.tracer().span_names()
+print(f"/metrics smoke OK ({len(body.splitlines())} exposition lines, "
+      f"{nspans} spans)")
+EOF
+smoke_rc=$?
+
+echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc}"
+if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ]; then
+    exit 1
+fi
+echo "VERIFY OK"

@@ -38,12 +38,21 @@ def _machine_tag():
             flags = next((line for line in f if line.startswith("flags")), "")
     except OSError:
         flags = ""
+    # fingerprint the full toolchain, not just the CPU: entries AOT-
+    # compiled by another jaxlib build load "successfully" and then
+    # corrupt the heap mid-suite (observed: malloc_consolidate abort
+    # from a cache dir written by a previous sandbox image) — a version
+    # change must land in a fresh namespace
+    import jaxlib
+    versions = (jax.__version__ + getattr(jaxlib, "__version__", "")
+                + platform.python_version())
     return hashlib.sha256(
-        (platform.machine() + flags).encode()).hexdigest()[:10]
+        (platform.machine() + flags + versions).encode()).hexdigest()[:10]
 
 
-enable_compilation_cache(
-    os.environ.get("DL4J_TEST_XLA_CACHE",
-                   os.path.expanduser(
-                       f"~/.cache/dl4tpu-xla-tests-{_machine_tag()}")),
-    min_compile_time_secs=0.2)
+if not os.environ.get("DL4J_DISABLE_XLA_CACHE"):
+    enable_compilation_cache(
+        os.environ.get("DL4J_TEST_XLA_CACHE",
+                       os.path.expanduser(
+                           f"~/.cache/dl4tpu-xla-tests-{_machine_tag()}")),
+        min_compile_time_secs=0.2)

@@ -294,6 +294,112 @@ def test_torn_zip_checkpoint_not_left_behind(tmp_path):
     assert len(glob.glob(d + "/epoch*.ckpt")) == 1
 
 
+class TestStatsRegistrySink:
+    """Phase-event fan-out onto the unified telemetry core: every
+    TrainingMasterStats event must land in the metrics registry (labeled
+    counters + timers) and on the tracer's Perfetto timeline."""
+
+    def _monitored(self):
+        from deeplearning4j_tpu import monitor
+        reg = monitor.MetricsRegistry()
+        tr = monitor.Tracer()
+        monitor.enable(registry=reg, tracer=tr)
+        return monitor, reg, tr
+
+    def _restore(self, monitor):
+        monitor.disable()
+        monitor._STATE.registry = monitor.GLOBAL_REGISTRY
+        monitor._STATE.tracer = monitor.GLOBAL_TRACER
+
+    def test_listener_fanout_order_and_payload(self):
+        stats = TrainingMasterStats()
+        seen_a, seen_b = [], []
+        stats.add_listener(seen_a.append)
+        stats.add_listener(seen_b.append)
+        with stats.time_phase("local_fit", round=0):
+            pass
+        stats.record("average", 0.002, round=0)
+        assert [e["phase"] for e in seen_a] == ["local_fit", "average"]
+        assert seen_a == seen_b == stats.events
+        for ev in seen_a:
+            assert ev["duration_ms"] >= 0 and "start_ms" in ev
+
+    def test_parallel_trainer_routes_to_registry(self):
+        from deeplearning4j_tpu.parallel import ParallelTrainer
+        monitor, reg, tr = self._monitored()
+        try:
+            mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+            trainer = ParallelTrainer(_model(), mesh, mode="sync",
+                                      stats=TrainingMasterStats())
+            x, y = _data(32)
+            trainer.fit(x, y, epochs=1, batch_size=16)
+            expo = reg.exposition()
+            assert "parallel_phase_total" in expo
+            assert 'phase="sync_step"' in expo
+            assert reg.counter("parallel_phase_total",
+                               phase="sync_step").value >= 1
+            # distributed phases share the fit timeline (Perfetto export)
+            names = tr.span_names()
+            assert any(n.startswith("master/") for n in names)
+            # the MonitorListener also rode the trainer's listener bus
+            assert reg.counter("training_iterations_total",
+                               model="default").value >= 2
+        finally:
+            self._restore(monitor)
+
+    def test_sharded_trainer_stats_seam(self):
+        from deeplearning4j_tpu.parallel import (MeshSpec,
+                                                 ShardedParallelTrainer,
+                                                 make_mesh)
+        monitor, reg, _ = self._monitored()
+        try:
+            mesh = make_mesh(MeshSpec.of(data=2, model=2))
+            stats = TrainingMasterStats()
+            trainer = ShardedParallelTrainer(_model(), mesh, stats=stats)
+            x, y = _data(32)
+            trainer.fit(x, y, epochs=1, batch_size=16)
+            counts = stats.phase_counts()
+            assert counts.get("broadcast") == 1
+            assert counts.get("sync_step", 0) >= 2
+            assert reg.timer("parallel_phase_seconds",
+                             phase="sync_step").count >= 2
+        finally:
+            self._restore(monitor)
+
+    def test_rebind_is_idempotent_across_fits(self):
+        from deeplearning4j_tpu import monitor as mon
+        monitor, reg, _ = self._monitored()
+        try:
+            stats = TrainingMasterStats()
+            mon.attach_master_stats(stats)
+            mon.attach_master_stats(stats)  # trainers re-attach every fit
+            stats.record("average", 0.001)
+            assert reg.counter("parallel_phase_total",
+                               phase="average").value == 1
+        finally:
+            self._restore(monitor)
+
+    def test_timeline_export_roundtrip_with_sink(self, tmp_path):
+        monitor, reg, tr = self._monitored()
+        try:
+            stats = TrainingMasterStats()
+            monitor.attach_master_stats(stats)
+            stats.record("broadcast", 0.001, round=0)
+            stats.record("local_fit", 0.02, round=0)
+            # the master's own JSON/HTML exports still round-trip
+            import json
+            data = json.loads(stats.to_json())
+            assert data["summary"]["phase_counts"]["local_fit"] == 1
+            hp = stats.export_html(str(tmp_path / "t.html"))
+            assert "local_fit" in open(hp).read()
+            # and the same events are on the Perfetto timeline
+            doc = json.loads(tr.export_chrome_trace())
+            assert {e["name"] for e in doc["traceEvents"]} == {
+                "master/broadcast", "master/local_fit"}
+        finally:
+            self._restore(monitor)
+
+
 def test_shared_master_fused_steps():
     """SharedTrainingMaster(steps_per_execution=k) drains k-step groups
     through one dispatch and still trains every batch."""
