@@ -1,0 +1,4 @@
+"""Bench tooling that rides alongside the package (sweeps, AOT cost
+analysis, profiler capture, regression gate). Repo-root utilities — not
+shipped in the wheel; run from a checkout (`python -m benchtools.hlo_cost`,
+`python -m benchtools.regression_gate`)."""

@@ -4,7 +4,8 @@ Runs the headline bench functions at alternative configs to find the
 best-throughput operating points (the headline BENCH artifact keeps its
 fixed config for round-over-round comparability; this sweep documents
 where the ceiling is). One JSON line per config to stdout + appended to
-SWEEP_r04.jsonl.
+the sweep artifact (`DL4J_SWEEP_OUT`, default repo-root SWEEP.jsonl —
+`scripts/tunnel_window.sh` points it into the live-window capture dir).
 
 Usage: python benchtools/bench_sweep.py [resnet|transformer|all]
 """
@@ -17,8 +18,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from deeplearning4j_tpu import bench  # noqa: E402
 
-OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "SWEEP_r05.jsonl")
+OUT = os.environ.get(
+    "DL4J_SWEEP_OUT",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "SWEEP.jsonl"))
 
 
 def emit(tag, rec):

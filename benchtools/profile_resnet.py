@@ -22,8 +22,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-OUTDIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                      "PROFILE_r05")
+OUTDIR = os.environ.get(
+    "DL4J_PROFILE_OUT",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "PROFILE_live"))
 
 
 def _xplane_proto():

@@ -1,0 +1,132 @@
+"""Bench regression gate CLI — exits nonzero on an unexplained
+throughput drop.
+
+Wraps `deeplearning4j_tpu.bench.compare_bench`: a structural,
+per-metric-tolerance comparison of a fresh BENCH JSON against the
+committed last-known-good artifact. Stale fallbacks (tunnel died —
+the "fresh" record is the baseline echo with provenance), CPU-sandbox
+runs (different platform), and first runs (no baseline) are explained
+outcomes and exit 0 with a distinct status; only a genuine regression
+exits 1.
+
+Usage::
+
+    python -m benchtools.regression_gate FRESH.json [BASELINE.json]
+        [--tolerance 0.10] [--recompute]
+
+FRESH may be a raw BENCH record, a driver round wrapper
+(``{"parsed": {...}}`` — the committed ``BENCH_r0N.json`` shape), or a
+log whose LAST line is the record (what ``python bench.py | tee`` leaves
+behind). BASELINE defaults to the repo's ``LASTGOOD_BENCH.json``.
+
+If the fresh record already embeds a ``regression_check`` block (bench
+main() computes one against the pre-run baseline before refreshing the
+artifact), that verdict is used — comparing against the now-refreshed
+LASTGOOD would be fresh-vs-fresh and always pass. ``--recompute`` (or an
+explicit BASELINE argument) forces a fresh comparison instead.
+
+Exit codes: 0 pass / explained (stale, incomparable, no baseline),
+1 regression, 2 usage or unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu import bench  # noqa: E402
+
+_EXPLAINED = ("pass", "stale_fallback", "incomparable_platform",
+              "no_baseline", "no_measurement")
+
+
+def load_record(path: str) -> dict:
+    """Accept a raw record, a driver round wrapper, or a JSONL log whose
+    last parseable line is the record."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        rec = json.loads(text)
+    except ValueError:
+        rec = None
+        for line in reversed(text.strip().splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if rec is None:
+            raise ValueError(f"no JSON record found in {path}")
+    if isinstance(rec, dict) and isinstance(rec.get("parsed"), dict):
+        rec = rec["parsed"]          # committed BENCH_r0N.json wrapper
+    if not isinstance(rec, dict):
+        raise ValueError(f"{path} is not a JSON object")
+    return rec
+
+
+def run_gate(fresh: dict, baseline=None, *, tolerance=None,
+             recompute: bool = False) -> dict:
+    """Resolve the gate verdict for a loaded record (library seam the
+    tests drive). Embedded verdicts win unless `recompute`, an explicit
+    baseline, OR a tolerance override asks otherwise — the embedded
+    block was computed at the default tolerance, so honoring it while
+    the caller passes --tolerance would silently ignore the flag."""
+    embedded = fresh.get("regression_check")
+    if (isinstance(embedded, dict) and not recompute and baseline is None
+            and tolerance is None):
+        return {**embedded, "verdict_source": "embedded regression_check "
+                                              "(vs pre-run baseline)"}
+    if baseline is None:
+        baseline = bench._load_lastgood()
+    kw = {}
+    if tolerance is not None:
+        kw["default_tolerance"] = tolerance
+    report = bench.compare_bench(fresh, baseline, **kw)
+    report["verdict_source"] = "recomputed vs baseline artifact"
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="benchtools.regression_gate")
+    ap.add_argument("fresh", help="fresh BENCH JSON (record, driver "
+                                  "wrapper, or log w/ last-line JSON)")
+    ap.add_argument("baseline", nargs="?", default=None,
+                    help="baseline record (default: LASTGOOD_BENCH.json; "
+                         "passing one forces recompute)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="override the default relative-drop tolerance "
+                         f"(default {bench.GATE_DEFAULT_TOLERANCE}); "
+                         "implies recomputing against the baseline "
+                         "artifact (the embedded verdict used the "
+                         "default)")
+    ap.add_argument("--recompute", action="store_true",
+                    help="ignore an embedded regression_check block")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the JSON report (status line only)")
+    args = ap.parse_args(argv)
+    try:
+        fresh = load_record(args.fresh)
+        baseline = load_record(args.baseline) if args.baseline else None
+    except (OSError, ValueError) as e:
+        print(f"regression-gate: cannot load input: {e}", file=sys.stderr)
+        return 2
+    report = run_gate(fresh, baseline, tolerance=args.tolerance,
+                      recompute=args.recompute)
+    status = report.get("status", "regression")
+    if not args.quiet:
+        print(json.dumps(report, indent=1, default=str))
+    nreg = len(report.get("regressions", []) or [])
+    print(f"regression-gate: {status}"
+          + (f" ({nreg} metric(s) past tolerance)" if nreg else ""))
+    return 0 if status in _EXPLAINED else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

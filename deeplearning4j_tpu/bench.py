@@ -300,7 +300,13 @@ def bench_resnet50(accel, batch=None, size=None, steps=None,
     # AOT-compile the fused program ONCE and use the same executable for
     # cost_analysis AND the warmup/timed calls — a jit __call__ would
     # not share the AOT lowering's cache and would recompile the
-    # identical minutes-long ResNet program a second time.
+    # identical minutes-long ResNet program a second time. The lowering
+    # seam is the container's own (`lower_train_step` — what
+    # benchtools/hlo_cost.py AOT-analyzes device-free), so the analyzed
+    # program and the timed program can never drift apart.
+    # Created eagerly OUTSIDE the try: the except-fallback below calls
+    # net._jit_multi_step directly, and a tracing failure inside the
+    # try must surface as itself, not as a None-call.
     if net._jit_multi_step is None:
         net._jit_multi_step = net._make_multi_step()
     # same rng derivation _run_multi_step uses, so the bench exercises
@@ -315,8 +321,7 @@ def bench_resnet50(accel, batch=None, size=None, steps=None,
     st = (net.params, net.updater_state, net.net_state)
     hlo_flops = None
     try:
-        compiled_multi = net._jit_multi_step.lower(
-            *st, 0, (xs_stack,), (ys_stack,), make_rngs(0)).compile()
+        compiled_multi = net.lower_train_step(x, y, steps=steps).compile()
         cost = compiled_multi.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
@@ -1046,6 +1051,9 @@ def _save_lastgood(result):
         snap = dict(result)
         snap.pop("stale", None)
         snap.pop("stale_error", None)
+        # the gate verdict compares against the PREVIOUS artifact — it
+        # must not be frozen into the artifact it superseded
+        snap.pop("regression_check", None)
         snap["measured_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         # write-then-rename: a failed dump (unserializable value) must
@@ -1076,6 +1084,122 @@ def _emit_failure(err, attempts):
         "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
         "error": err, "probe_attempts": attempts,
     }))
+
+
+# ------------------------------------------------- bench regression gate
+# Structural comparison of a fresh BENCH record against the committed
+# last-known-good artifact, with per-metric tolerances. The point is to
+# distinguish three very different events that all look like "the
+# number went down": a stale fallback echo (tunnel died — the record IS
+# the baseline, annotated), a CPU-sandbox run (not comparable to chip
+# numbers), and a genuine throughput regression (exit nonzero — see
+# benchtools/regression_gate.py for the CLI).
+
+GATE_DEFAULT_TOLERANCE = 0.10  # relative drop that flags a regression
+# noisier secondary metrics get wider bands (word2vec rides the host
+# ETL path; the matmul probe is best-of-3 on shared silicon)
+GATE_TOLERANCES = {
+    "transformer_long_context_tokens_per_sec": 0.20,
+    "word2vec_words_per_sec": 0.20,
+    "matmul_peak_tflops": 0.15,
+    "resnet50_mfu": 0.12,
+}
+_GATE_HEADLINE = "resnet50_images_per_sec"
+
+
+def _gate_metrics(rec):
+    """Flatten the gated metrics out of one BENCH record."""
+    out = {}
+
+    def take(name, *path):
+        cur = rec
+        for p in path:
+            if not isinstance(cur, dict):
+                return
+            cur = cur.get(p)
+        if isinstance(cur, (int, float)) and cur > 0:
+            out[name] = float(cur)
+
+    take("resnet50_images_per_sec", "value")
+    take("resnet50_mfu", "mfu")
+    take("matmul_peak_tflops", "measured_matmul_tflops")
+    take("lenet_images_per_sec", "extras", "lenet_mnist", "value")
+    take("lstm_chars_per_sec", "extras", "lstm_char_rnn", "value")
+    take("transformer_tokens_per_sec", "extras", "transformer_lm", "value")
+    take("transformer_long_context_tokens_per_sec",
+         "extras", "transformer_lm", "long_context", "value")
+    take("word2vec_words_per_sec", "extras", "word2vec", "value")
+    return out
+
+
+def compare_bench(fresh, baseline, default_tolerance=GATE_DEFAULT_TOLERANCE,
+                  tolerances=None):
+    """Gate verdict for a fresh BENCH record vs a baseline record.
+
+    Returns a dict whose ``status`` is one of:
+
+    - ``no_baseline``     — nothing to compare against (first run)
+    - ``stale_fallback``  — fresh is the tunnel-failure echo of the
+      baseline itself (``stale: true``), not a measurement: explained,
+      never a regression
+    - ``incomparable_platform`` — CPU-sandbox record vs chip baseline
+    - ``no_measurement``  — fresh carries an error and no usable value
+    - ``regression``      — at least one metric dropped past tolerance
+      (or the headline metric vanished)
+    - ``pass``            — every shared metric within tolerance
+    """
+    tol = dict(GATE_TOLERANCES)
+    tol.update(tolerances or {})
+    if not isinstance(baseline, dict) or not _gate_metrics(baseline):
+        return {"status": "no_baseline",
+                "note": "no usable baseline metrics — nothing gated"}
+    if not isinstance(fresh, dict):
+        return {"status": "no_measurement", "note": "fresh record unreadable"}
+    if fresh.get("stale"):
+        return {"status": "stale_fallback",
+                "stale_error": fresh.get("stale_error"),
+                "note": ("fresh record is the last-known-good echo emitted "
+                         "on a tunnel failure — an explained outage, not a "
+                         "throughput regression")}
+    fplat = str(fresh.get("platform", ""))
+    bplat = str(baseline.get("platform", ""))
+    if fplat and bplat and fplat != bplat:
+        return {"status": "incomparable_platform",
+                "fresh_platform": fplat, "baseline_platform": bplat,
+                "note": "sandbox/chip records are not comparable"}
+    fm, bm = _gate_metrics(fresh), _gate_metrics(baseline)
+    if not fm:
+        if fresh.get("error"):
+            return {"status": "no_measurement",
+                    "error": fresh.get("error"),
+                    "note": "fresh record carries an explicit error and no "
+                            "usable value"}
+        return {"status": "regression", "regressions": [],
+                "missing": sorted(bm),
+                "note": "fresh record has no gated metrics and no error"}
+    regressions, improvements, missing, checked = [], [], [], []
+    for name, base in sorted(bm.items()):
+        t = tol.get(name, default_tolerance)
+        val = fm.get(name)
+        if val is None:
+            missing.append(name)
+            continue
+        checked.append(name)
+        delta = val / base - 1.0
+        entry = {"metric": name, "baseline": base, "fresh": val,
+                 "delta_pct": round(100.0 * delta, 2),
+                 "tolerance_pct": round(100.0 * t, 1)}
+        if delta < -t:
+            regressions.append(entry)
+        elif delta > t:
+            improvements.append(entry)
+    status = "pass"
+    if regressions or _GATE_HEADLINE in missing:
+        status = "regression"
+    return {"status": status, "checked": checked,
+            "regressions": regressions, "improvements": improvements,
+            "missing": missing,
+            "tolerance_default_pct": round(100.0 * default_tolerance, 1)}
 
 
 def _probe_tunnel_subprocess(timeout_s=None) -> bool:
@@ -1229,6 +1353,17 @@ def main():
         extras["scaling_cpu8"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     primary["extras"] = extras
+    # gate verdict vs the PREVIOUS last-known-good — computed before
+    # _save_lastgood replaces it, and embedded in the printed record so
+    # benchtools/regression_gate.py can exit on it even after the
+    # artifact has been refreshed (comparing afterwards would be
+    # fresh-vs-fresh and always pass)
+    try:
+        prior = _load_lastgood()
+        if prior is not None:
+            primary["regression_check"] = compare_bench(primary, prior)
+    except Exception:  # noqa: BLE001 — the gate must never kill a run
+        pass
     _save_lastgood(primary)
     print(json.dumps(primary))
 

@@ -53,6 +53,12 @@ from deeplearning4j_tpu.monitor.collectors import (
     record_transfer as _record_transfer_impl,
 )
 from deeplearning4j_tpu.monitor.listener import MonitorListener, bind_master_stats
+from deeplearning4j_tpu.monitor import xprof
+from deeplearning4j_tpu.monitor.xprof import (
+    ProfilerCapture,
+    publish_cost_report,
+    roofline,
+)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "Timer",
@@ -61,6 +67,7 @@ __all__ = [
     "enable", "disable", "is_enabled", "enabled", "registry", "tracer",
     "span", "record_transfer", "bind_master_stats", "attach_master_stats",
     "extra_listeners", "compile_collector", "memory_collector",
+    "xprof", "ProfilerCapture", "roofline", "publish_cost_report",
 ]
 
 

@@ -516,6 +516,46 @@ class ComputationGraph:
                                  rngs)
         return losses
 
+    # ------------------------------------------------- AOT observability
+    def _train_step_avals(self, xs, ys, steps: int):
+        """Stacked input avals (tuples — one entry per graph input /
+        output). Accepts single arrays, sequences of arrays, or
+        ShapeDtypeStructs; only shapes/dtypes are read."""
+        def tup(v):
+            return tuple(v) if isinstance(v, (list, tuple)) else (v,)
+
+        def sds(a):
+            return jax.ShapeDtypeStruct((steps,) + tuple(a.shape),
+                                        jnp.dtype(a.dtype))
+        key = jax.random.PRNGKey(0)
+        rngs = jax.ShapeDtypeStruct((steps,) + tuple(key.shape), key.dtype)
+        return (tuple(sds(a) for a in tup(xs)),
+                tuple(sds(a) for a in tup(ys)), rngs)
+
+    def lower_train_step(self, xs, ys, *, steps: int = 1, it0: int = 0):
+        """AOT-lower the exact fused train-step — same contract as
+        `MultiLayerNetwork.lower_train_step` (device-free
+        `.cost_analysis()`; `.compile()` is the fit-loop executable;
+        pass a plain Python int for `it0` when calling it)."""
+        if not self._initialized:
+            self.init()
+        if self._jit_multi_step is None:
+            self._jit_multi_step = self._make_multi_step()
+        xs_a, ys_a, rngs = self._train_step_avals(xs, ys, steps)
+        return self._jit_multi_step.lower(
+            self.params, self.updater_state, self.net_state, it0,
+            xs_a, ys_a, rngs)
+
+    def train_step_jaxpr(self, xs, ys, *, steps: int = 1):
+        """ClosedJaxpr of the same fused train-step (per-op cost
+        tables — `benchtools/hlo_cost.py`)."""
+        if not self._initialized:
+            self.init()
+        xs_a, ys_a, rngs = self._train_step_avals(xs, ys, steps)
+        return jax.make_jaxpr(self._multi_step_fn())(
+            self.params, self.updater_state, self.net_state, 0,
+            xs_a, ys_a, rngs)
+
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             steps_per_execution: int = 1):
@@ -565,13 +605,13 @@ class ComputationGraph:
         spe = max(1, int(steps_per_execution))
         fused_ok = spe > 1 and solver is None and not tbptt
 
-        def flush(pending):
+        def flush(pending, etl_ms=0.0):
             if not pending:
                 return
             if len(pending) == 1:
                 xs, ys, n_examples = pending[0]
                 run_one(xs, ys, (None,) * len(xs), (None,) * len(ys),
-                        n_examples)
+                        n_examples, etl_ms)
                 return
             with monitor.span("fit/forward_backward",
                               iteration=self.iteration_count,
@@ -587,7 +627,12 @@ class ComputationGraph:
                     self.score_value = float(losses[j])
                     listeners.iteration_done(self, self.iteration_count,
                                              self.epoch_count, self.score_value,
-                                             batch_size=n_examples)
+                                             batch_size=n_examples,
+                                             # ETL attribution matches the
+                                             # MultiLayerNetwork fused path:
+                                             # flush-time ETL charged to the
+                                             # first fused iteration
+                                             etl_ms=etl_ms if j == 0 else 0.0)
                     self.iteration_count += 1
 
         def run_one(xs, ys, fmasks, lmasks, n_examples, etl_ms=0.0):
@@ -655,7 +700,7 @@ class ComputationGraph:
                         pending = []
                     pending.append((xs, ys, n_examples))
                     if len(pending) == spe:
-                        flush(pending)
+                        flush(pending, etl_ms)
                         pending = []
             flush(pending)
             listeners.on_epoch_end(self, self.epoch_count)

@@ -349,6 +349,49 @@ class MultiLayerNetwork:
                                  self.net_state, it0, xs, ys, rngs)
         return losses
 
+    # ------------------------------------------------- AOT observability
+    def _train_step_avals(self, x, y, steps: int):
+        """Stacked input avals for the fused train-step: only shapes and
+        dtypes are read, so callers can pass arrays OR ShapeDtypeStructs
+        and no host memory is spent on the stacks."""
+        def sds(a):
+            return jax.ShapeDtypeStruct((steps,) + tuple(a.shape),
+                                        jnp.dtype(a.dtype))
+        key = jax.random.PRNGKey(0)
+        rngs = jax.ShapeDtypeStruct((steps,) + tuple(key.shape), key.dtype)
+        return sds(x), sds(y), rngs
+
+    def lower_train_step(self, x, y, *, steps: int = 1, it0: int = 0):
+        """AOT-lower the exact fused train-step that
+        `fit(steps_per_execution=steps)` dispatches. Returns a
+        `jax.stages.Lowered`: `.cost_analysis()` (per-program FLOPs /
+        bytes accessed) runs on any host with no accelerator attached —
+        the device-free seam `benchtools/hlo_cost.py` builds on — and
+        `.compile()` yields the same executable the fit loop would
+        build (bench.py compiles it once for cost analysis AND the
+        timed windows, so the minutes-long ResNet program is never
+        compiled twice). Call the compiled executable with a plain
+        Python int for `it0`, matching this lowering's aval."""
+        if not self._initialized:
+            self.init()
+        if self._jit_multi_step is None:
+            self._jit_multi_step = self._make_multi_step()
+        xs, ys, rngs = self._train_step_avals(x, y, steps)
+        return self._jit_multi_step.lower(
+            self.params, self.updater_state, self.net_state, it0,
+            xs, ys, rngs)
+
+    def train_step_jaxpr(self, x, y, *, steps: int = 1):
+        """ClosedJaxpr of the same fused train-step (the per-op cost
+        tables in `benchtools/hlo_cost.py` walk it primitive by
+        primitive)."""
+        if not self._initialized:
+            self.init()
+        xs, ys, rngs = self._train_step_avals(x, y, steps)
+        return jax.make_jaxpr(self._multi_step_fn())(
+            self.params, self.updater_state, self.net_state, 0,
+            xs, ys, rngs)
+
     # ----------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             data_format=None, shuffle: bool = True,

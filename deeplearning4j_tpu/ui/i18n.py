@@ -22,6 +22,9 @@ _MESSAGES = {
         "param": "param", "mean_value": "mean |value|",
         "no_sessions": "No training sessions attached yet.",
         "no_model_stats": "No model stats yet.",
+        "profile": "Profile", "title.profile": "AOT Cost / Profile",
+        "profile.summary": "cost summary",
+        "profile.top_ops": "top ops by FLOPs",
     },
     "ja": {
         "overview": "概要", "model": "モデル", "system": "システム",
@@ -39,6 +42,9 @@ _MESSAGES = {
         "param": "パラメータ", "mean_value": "平均 |値|",
         "no_sessions": "学習セッションがまだ接続されていません。",
         "no_model_stats": "モデル統計はまだありません。",
+        "profile": "プロファイル", "title.profile": "AOTコスト / プロファイル",
+        "profile.summary": "コスト概要",
+        "profile.top_ops": "FLOPs上位オペレーション",
     },
     "zh": {
         "overview": "概览", "model": "模型", "system": "系统",
@@ -56,6 +62,9 @@ _MESSAGES = {
         "param": "参数", "mean_value": "平均 |值|",
         "no_sessions": "尚未连接任何训练会话。",
         "no_model_stats": "尚无模型统计。",
+        "profile": "性能分析", "title.profile": "AOT成本 / 性能分析",
+        "profile.summary": "成本摘要",
+        "profile.top_ops": "按FLOPs排序的算子",
     },
 }
 

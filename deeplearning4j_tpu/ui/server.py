@@ -105,6 +105,15 @@ class UIServer:
                     # scrape endpoint — see monitor/ and docs/OBSERVABILITY.md)
                     self._send(200, outer.metrics_text(),
                                "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/profile":
+                    # AOT cost tables + roofline (benchtools/hlo_cost.py
+                    # publishes; committed PROFILE_*/cost_*.json fill in)
+                    self._send(200, outer._profile_html())
+                elif path == "/api/profile":
+                    from deeplearning4j_tpu.monitor import xprof
+                    self._send(200, json.dumps(xprof.cost_reports(scan=True),
+                                               default=str),
+                               "application/json")
                 elif path == "/api/sessions":
                     self._send(200, json.dumps(outer.storage.list_session_ids()),
                                "application/json")
@@ -193,7 +202,7 @@ class UIServer:
         qs = self._qs()
         pages = [("overview", "/train/overview"), ("model", "/train/model"),
                  ("system", "/train/system"), ("tsne", "/tsne"),
-                 ("activations", "/activations")]
+                 ("activations", "/activations"), ("profile", "/profile")]
         links = "".join(
             f'<a href="{url}{qs}" style="margin-right:16px;'
             f'{"font-weight:bold" if p == active else ""}">'
@@ -341,6 +350,67 @@ class UIServer:
         if len(body) == 1:
             body.append("<p>No activation grids posted yet.</p>")
         return self._page(self._tr("title.activations"), "".join(body))
+
+    def _profile_html(self):
+        """AOT cost / roofline page: one section per cost report
+        (in-process published first, committed ``PROFILE_*/cost_*.json``
+        artifacts as fallback — see docs/OBSERVABILITY.md)."""
+        from deeplearning4j_tpu.monitor import xprof
+        reports = xprof.cost_reports(scan=True)
+        body = [self._nav("profile")]
+        for model in sorted(reports):
+            rep = reports[model]
+            per_op = rep.get("per_op", {}) or {}
+            roof = rep.get("roofline", {}) or {}
+            pred = rep.get("predicted", {}) or {}
+            meas = rep.get("measured", {}) or {}
+            body.append(f"<h3>{_html.escape(str(model))}</h3>")
+
+            def fmt(v, scale=1.0, nd=3):
+                return (f"{v * scale:.{nd}g}"
+                        if isinstance(v, (int, float)) else "—")
+            rows = [
+                ("FLOPs / step", fmt(per_op.get("total_flops_per_step"))),
+                ("conv+dot FLOPs / step (MFU numerator)",
+                 fmt(per_op.get("conv_dot_flops_per_step"))),
+                ("bytes / step (unfused upper bound)",
+                 fmt(per_op.get("total_bytes_per_step"))),
+                ("arithmetic intensity (FLOP/byte)",
+                 fmt(roof.get("arithmetic_intensity_flop_per_byte"))),
+                ("binding ceiling", str(roof.get("bound", "—"))),
+                ("predicted step time (ms)",
+                 fmt(pred.get("step_seconds"), 1e3, 4)),
+                ("predicted MFU (lower bound)", fmt(pred.get("mfu"))),
+                ("MFU if compute-bound (upper bound)",
+                 fmt(pred.get("mfu_if_compute_bound"))),
+                ("peak (TFLOP/s)", fmt(roof.get("peak_tflops"))
+                 + f" [{_html.escape(str(roof.get('peak_source', '?')))}]"),
+            ]
+            if meas:
+                rows.append(("measured throughput",
+                             fmt(meas.get("throughput")) + " "
+                             + _html.escape(str(meas.get("unit", "")))))
+                rows.append(("predicted / measured step time",
+                             fmt(meas.get(
+                                 "predicted_over_measured_step_time"))))
+            body.append(ComponentTable(
+                ["quantity", "value"], [(k, v) for k, v in rows],
+                title=f"{model} — {self._tr('profile.summary')}").render())
+            top = per_op.get("top10") or []
+            if top:
+                body.append(ComponentTable(
+                    ["op", "shape", "FLOPs/step", "bytes/step", "share"],
+                    [(str(s.get("op")), str(s.get("shape", ""))[:80],
+                      fmt(s.get("flops")), fmt(s.get("bytes")),
+                      fmt(s.get("share")))
+                     for s in top],
+                    title=f"{model} — {self._tr('profile.top_ops')}").render())
+        if len(body) == 1:
+            body.append("<p>No AOT cost reports yet — run "
+                        "<code>python -m benchtools.hlo_cost --all</code> "
+                        "(device-free) or commit PROFILE_*/cost_*.json "
+                        "artifacts.</p>")
+        return self._page(self._tr("title.profile"), "".join(body))
 
     def _page(self, title, body):
         refresh = getattr(self._req, "refresh", 0)

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# One-shot live-silicon capture — run the MOMENT the accelerator tunnel
+# comes up. Budgeted to land inside a ~10-minute window (every stage is
+# under its own `timeout`, and a stage failure never skips the rest):
+#
+#   [1/4] headline bench  -> BENCH json (+ LASTGOOD refresh, embedded
+#         regression_check vs the pre-run baseline)
+#   [2/4] regression gate -> exits the script nonzero later if the
+#         fresh numbers regressed past tolerance (stale/explained
+#         outcomes pass — see benchtools/regression_gate.py)
+#   [3/4] xplane profile  -> jax.profiler trace of the fused ResNet
+#         step + per-op device table (benchtools/profile_resnet.py,
+#         via the monitor ProfilerCapture seam)
+#   [4/4] operating-point sweep (resnet subset)
+#
+# Everything lands in one timestamped PROFILE_live_<stamp>/ dir to
+# commit. The AOT cost tables (python -m benchtools.hlo_cost --all ->
+# PROFILE_aot/) are device-free — refresh them any time, do NOT spend
+# tunnel minutes on them.
+#
+# Usage: bash scripts/tunnel_window.sh  [sweep-target: resnet|transformer|all]
+
+set -u
+cd "$(dirname "$0")/.."
+
+SWEEP_TARGET="${1:-resnet}"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+OUT="PROFILE_live_${STAMP}"
+mkdir -p "$OUT"
+echo "== tunnel window capture -> $OUT =="
+
+echo "== [1/4] headline bench =="
+timeout -k 15 420 python bench.py | tee "$OUT/bench_stdout.log"
+bench_rc=${PIPESTATUS[0]}
+tail -n 1 "$OUT/bench_stdout.log" > "$OUT/bench.json" 2>/dev/null || true
+
+echo "== [2/4] regression gate =="
+gate_rc=0
+if [ -s "$OUT/bench.json" ]; then
+    python -m benchtools.regression_gate "$OUT/bench.json" \
+        | tee "$OUT/gate.json"
+    gate_rc=${PIPESTATUS[0]}
+else
+    echo "no bench record captured — gate skipped"
+    gate_rc=2
+fi
+
+echo "== [3/4] xplane profile (fused ResNet step) =="
+DL4J_PROFILE_OUT="$OUT" timeout -k 15 240 \
+    python benchtools/profile_resnet.py 128 20
+profile_rc=$?
+
+echo "== [4/4] sweep ($SWEEP_TARGET) =="
+DL4J_SWEEP_OUT="$OUT/sweep.jsonl" timeout -k 15 240 \
+    python benchtools/bench_sweep.py "$SWEEP_TARGET"
+sweep_rc=$?
+
+echo "bench_rc=${bench_rc} gate_rc=${gate_rc} profile_rc=${profile_rc} sweep_rc=${sweep_rc}"
+echo "artifacts: $OUT/ (commit it; LASTGOOD_BENCH.json refreshed on success)"
+# the script's verdict is the GATE's: capture hiccups are logged above,
+# but only a genuine regression (or a bench that produced nothing)
+# should fail the window
+if [ "$gate_rc" -ne 0 ]; then
+    exit 1
+fi
+echo "TUNNEL WINDOW OK"

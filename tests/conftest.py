@@ -56,3 +56,45 @@ if not os.environ.get("DL4J_DISABLE_XLA_CACHE"):
                        os.path.expanduser(
                            f"~/.cache/dl4tpu-xla-tests-{_machine_tag()}")),
         min_compile_time_secs=0.2)
+
+
+# ---------------------------------------------------- suite budget report
+# Per-file duration accounting for the tier-1 gate: the suite runs in a
+# single hard window (driver: 600 s; ROADMAP timeout -k 10 870), and at
+# ~8% headroom a silent overflow loses the whole round's verification.
+# These hooks ride INSIDE the verbatim ROADMAP command (they are repo
+# conftest code, not extra flags) and leave a JSON report that
+# scripts/verify.sh turns into a top-offenders table + a soft-budget
+# warning above 480 s.
+import collections as _collections
+import json as _json
+
+_FILE_DURATIONS = _collections.defaultdict(float)
+_DURATIONS_OUT = os.environ.get("DL4J_SUITE_DURATIONS",
+                                "/tmp/_t1_durations.json")
+SUITE_BUDGET_SOFT_S = 480.0
+SUITE_BUDGET_HARD_S = 600.0
+
+
+def pytest_runtest_logreport(report):
+    # setup + call + teardown all charged to the test's file
+    _FILE_DURATIONS[report.location[0]] += getattr(report, "duration",
+                                                   0.0) or 0.0
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _FILE_DURATIONS:
+        return
+    total = sum(_FILE_DURATIONS.values())
+    files = sorted(({"file": f, "seconds": round(s, 2)}
+                    for f, s in _FILE_DURATIONS.items()),
+                   key=lambda r: -r["seconds"])
+    try:
+        with open(_DURATIONS_OUT, "w") as f:
+            _json.dump({"total_seconds": round(total, 2),
+                        "budget_soft_seconds": SUITE_BUDGET_SOFT_S,
+                        "budget_hard_seconds": SUITE_BUDGET_HARD_S,
+                        "files": files}, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass

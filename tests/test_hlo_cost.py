@@ -1,0 +1,400 @@
+"""AOT cost-analysis pipeline tests: golden per-op tables, roofline
+math, container lowering hooks, and the bench regression gate
+(pass/fail/stale/incomparable with synthetic BENCH JSONs).
+
+Everything here is device-free by design — the whole point of the
+compile-time observability layer (docs/OBSERVABILITY.md) is that it
+runs with no accelerator attached.
+"""
+
+import copy
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from benchtools import hlo_cost, regression_gate
+from deeplearning4j_tpu.bench import (
+    GATE_DEFAULT_TOLERANCE,
+    compare_bench,
+)
+from deeplearning4j_tpu.monitor import xprof
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def mlp_net():
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ------------------------------------------------------ per-op golden
+class TestPerOpTable:
+    def test_matmul_flops_exact(self):
+        """One dot_general: 2*M*K*N FLOPs — the 2/MAC accounting."""
+        jp = jax.make_jaxpr(lambda a, b: a @ b)(
+            jnp.zeros((16, 4)), jnp.zeros((4, 8)))
+        table = hlo_cost.per_op_table(jp)
+        by = {r["op"]: r for r in table["by_primitive"]}
+        assert by["dot_general"]["flops"] == 2 * 16 * 4 * 8
+        assert by["dot_general"]["count"] == 1
+        # operand + result traffic: (16*4 + 4*8 + 16*8) f32 elements
+        assert by["dot_general"]["bytes"] == (16 * 4 + 4 * 8 + 16 * 8) * 4
+
+    def test_conv_flops_match_xla(self):
+        """The conv formula agrees with XLA's own cost analysis (VALID
+        padding — under SAME, XLA subtracts the border taps padding
+        zeroes out while the MFU convention, like bench's analytic
+        count, charges the full kernel footprint)."""
+        def f(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, window_strides=(1, 1), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jnp.zeros((2, 8, 8, 3))
+        w = jnp.zeros((3, 3, 3, 16))
+        table = hlo_cost.per_op_table(jax.make_jaxpr(f)(x, w))
+        ours = {r["op"]: r for r in table["by_primitive"]}[
+            "conv_general_dilated"]["flops"]
+        xla = jax.jit(f).lower(x, w).cost_analysis()["flops"]
+        assert ours == pytest.approx(xla, rel=0.01)
+        # and matches the closed form: 2 * out_elems * kh*kw*cin
+        assert ours == 2 * (2 * 6 * 6 * 16) * 3 * 3 * 3
+
+    def test_scan_trip_count_multiplied(self):
+        """XLA charges a scan body once; the per-op walk multiplies by
+        trip count (what makes LSTM time loops count correctly)."""
+        def f(x, w):
+            def body(c, _):
+                return c @ w, None
+            c, _ = jax.lax.scan(body, x, None, length=7)
+            return c
+        x, w = jnp.zeros((4, 4)), jnp.zeros((4, 4))
+        table = hlo_cost.per_op_table(jax.make_jaxpr(f)(x, w))
+        by = {r["op"]: r for r in table["by_primitive"]}
+        assert by["dot_general"]["flops"] == 7 * (2 * 4 * 4 * 4)
+        assert by["dot_general"]["count"] == 7
+
+    def test_mlp_golden_table(self):
+        """Tiny-MLP train step: dot_general dominates, the fused-steps
+        division yields per-step figures, and the conv+dot count agrees
+        with XLA's whole-program FLOPs (which include elementwise)."""
+        net = mlp_net()
+        x = jax.ShapeDtypeStruct((16, 4), jnp.float32)
+        y = jax.ShapeDtypeStruct((16, 3), jnp.float32)
+        steps = 3
+        table = hlo_cost.per_op_table(
+            net.train_step_jaxpr(x, y, steps=steps), fused_steps=steps)
+        assert table["top10"][0]["op"] == "dot_general"
+        assert table["total_flops"] == pytest.approx(
+            steps * table["total_flops_per_step"])
+        # fwd dots: 2*16*4*8 + 2*16*8*3 = 1792; autodiff adds dW (and
+        # dx for the chain) — strictly more than forward, less than 4x
+        assert 1792 < table["conv_dot_flops_per_step"] < 4 * 1792
+        xla_flops = float(net.lower_train_step(x, y, steps=steps)
+                          .cost_analysis()["flops"])
+        assert table["conv_dot_flops_per_step"] <= xla_flops * 1.05
+        assert table["conv_dot_flops_per_step"] > 0.4 * xla_flops
+        shares = [r["share"] for r in table["by_primitive"]]
+        assert abs(sum(shares) - 1.0) < 0.01
+
+    def test_top10_sorted_and_bounded(self):
+        net = mlp_net()
+        x = jax.ShapeDtypeStruct((16, 4), jnp.float32)
+        y = jax.ShapeDtypeStruct((16, 3), jnp.float32)
+        table = hlo_cost.per_op_table(net.train_step_jaxpr(x, y, steps=2),
+                                      fused_steps=2, top=10)
+        flops = [s["flops"] for s in table["top10"]]
+        assert flops == sorted(flops, reverse=True)
+        assert len(flops) <= 10
+        assert all("shape" in s and "->" in s["shape"]
+                   for s in table["top10"])
+
+
+# --------------------------------------------------------- roofline math
+class TestRoofline:
+    def test_compute_bound(self):
+        r = xprof.roofline(flops=1e12, bytes_accessed=1e9,
+                           peak_flops=1e12, peak_bytes_per_sec=1e10)
+        # AI = 1000 >> critical 100 -> compute-bound, 1s step
+        assert r["bound"] == "compute"
+        assert r["predicted_step_seconds"] == pytest.approx(1.0)
+        assert r["predicted_mfu"] == pytest.approx(1.0)
+        assert r["arithmetic_intensity_flop_per_byte"] == pytest.approx(1e3)
+        assert r["critical_intensity_flop_per_byte"] == pytest.approx(100.0)
+
+    def test_memory_bound(self):
+        r = xprof.roofline(flops=1e9, bytes_accessed=1e9,
+                           peak_flops=1e12, peak_bytes_per_sec=1e10)
+        # AI = 1 << critical 100 -> memory-bound: 0.1s step, MFU 1/100
+        assert r["bound"] == "memory"
+        assert r["predicted_step_seconds"] == pytest.approx(0.1)
+        assert r["predicted_mfu"] == pytest.approx(0.01)
+        assert r["step_seconds_compute_bound"] == pytest.approx(1e-3)
+
+    def test_rejects_nonpositive(self):
+        with pytest.raises(ValueError):
+            xprof.roofline(0, 1, 1, 1)
+        with pytest.raises(ValueError):
+            xprof.roofline(1, 1, 0, 1)
+
+
+# ------------------------------------------------- container lowering hooks
+class TestLowerTrainStep:
+    def test_multilayer_lower_compile_run(self):
+        """The AOT seam yields the SAME executable contract the fit
+        loop uses: compile it, drive it with concrete stacks, losses
+        come back finite."""
+        net = mlp_net()
+        x = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+        y = jax.ShapeDtypeStruct((8, 3), jnp.float32)
+        low = net.lower_train_step(x, y, steps=2)
+        ca = low.cost_analysis()
+        assert ca["flops"] > 0 and ca["bytes accessed"] > 0
+        compiled = low.compile()
+        rng = np.random.default_rng(0)
+        xs = jnp.asarray(rng.standard_normal((2, 8, 4)), jnp.float32)
+        ys = jnp.asarray(np.eye(3, dtype=np.float32)[
+            rng.integers(0, 3, (2, 8))])
+        key = jax.random.PRNGKey(1)
+        rngs = jnp.stack([key, jax.random.fold_in(key, 1)])
+        out = compiled(net.params, net.updater_state, net.net_state, 0,
+                       xs, ys, rngs)
+        losses = np.asarray(out[3])
+        assert losses.shape == (2,) and np.isfinite(losses).all()
+
+    def test_graph_lower_cost_analysis(self):
+        g = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(7))
+        g.add_inputs("in")
+        g.add_layer("dense", DenseLayer(n_in=4, n_out=8), "in")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=3), "dense")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        x = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+        y = jax.ShapeDtypeStruct((8, 3), jnp.float32)
+        ca = net.lower_train_step(x, y, steps=2).cost_analysis()
+        assert ca["flops"] > 0
+        table = hlo_cost.per_op_table(net.train_step_jaxpr(x, y, steps=2),
+                                      fused_steps=2)
+        assert table["conv_dot_flops_per_step"] > 0
+
+    def test_lowering_accepts_concrete_arrays(self):
+        net = mlp_net()
+        x = np.zeros((8, 4), np.float32)
+        y = np.zeros((8, 3), np.float32)
+        assert net.lower_train_step(x, y, steps=1).cost_analysis()[
+            "flops"] > 0
+
+
+# -------------------------------------------------- analyze() end-to-end
+class TestAnalyze:
+    def test_mlp_report_and_artifact(self, tmp_path):
+        reports = hlo_cost.run(["mlp"], out_dir=str(tmp_path),
+                               publish=False)
+        rep = reports[0]
+        path = tmp_path / "cost_mlp.json"
+        assert path.exists()
+        on_disk = json.loads(path.read_text())
+        assert on_disk["model"] == "mlp"
+        # acceptance surface: top-10 per-op table, total FLOPs/bytes,
+        # predicted-MFU roofline figure
+        assert on_disk["per_op"]["top10"]
+        assert on_disk["per_op"]["total_flops_per_step"] > 0
+        assert on_disk["per_op"]["total_bytes_per_step"] > 0
+        assert 0 < on_disk["predicted"]["mfu"] <= 1.0
+        assert 0 < on_disk["predicted"]["mfu_if_compute_bound"] <= 1.0
+        assert (on_disk["predicted"]["mfu"]
+                <= on_disk["predicted"]["mfu_if_compute_bound"])
+        assert rep["roofline"]["bound"] in ("compute", "memory")
+        assert rep["roofline"]["peak_tflops"] > 0
+        assert "peak_source" in rep["roofline"]
+
+    def test_publish_sets_gauges_and_store(self):
+        reg = MetricsRegistry()
+        xprof.clear_cost_reports()
+        try:
+            report = {"model": "fake",
+                      "per_op": {"total_flops_per_step": 123.0,
+                                 "total_bytes_per_step": 456.0},
+                      "roofline": {
+                          "arithmetic_intensity_flop_per_byte": 0.27,
+                          "predicted_step_seconds": 0.5},
+                      "predicted": {"mfu": 0.25}}
+            xprof.publish_cost_report(report, registry=reg)
+            expo = reg.exposition()
+            assert 'aot_cost_flops_per_step{model="fake"} 123.0' in expo
+            assert 'aot_cost_predicted_mfu{model="fake"} 0.25' in expo
+            assert xprof.cost_reports()["fake"] is report
+        finally:
+            xprof.clear_cost_reports()
+
+    def test_load_cost_reports_from_disk(self, tmp_path):
+        d = tmp_path / "PROFILE_x"
+        d.mkdir()
+        (d / "cost_demo.json").write_text(json.dumps({"model": "demo",
+                                                      "per_op": {}}))
+        (d / "cost_bad.json").write_text("{not json")
+        out = xprof.load_cost_reports(str(tmp_path))
+        assert list(out) == ["demo"]
+        # published reports shadow disk artifacts of the same model
+        xprof.clear_cost_reports()
+        try:
+            xprof.publish_cost_report({"model": "demo", "x": 1},
+                                      registry=MetricsRegistry())
+            merged = xprof.cost_reports(scan=True, root=str(tmp_path))
+            assert merged["demo"]["x"] == 1
+        finally:
+            xprof.clear_cost_reports()
+
+
+# ----------------------------------------------------- regression gate
+def _baseline():
+    return {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 2425.14, "platform": "tpu", "mfu": 0.3105,
+        "measured_matmul_tflops": 111.44,
+        "extras": {
+            "lenet_mnist": {"value": 151182.14},
+            "lstm_char_rnn": {"value": 2430366.6},
+            "transformer_lm": {"value": 959948.2,
+                               "long_context": {"value": 222011.4}},
+            "word2vec": {"value": 103698.0},
+        },
+    }
+
+
+class TestCompareBench:
+    def test_unchanged_passes(self):
+        base = _baseline()
+        rep = compare_bench(copy.deepcopy(base), base)
+        assert rep["status"] == "pass"
+        assert not rep["regressions"] and not rep["missing"]
+        assert "resnet50_images_per_sec" in rep["checked"]
+
+    def test_injected_20pct_drop_flags(self):
+        base = _baseline()
+        fresh = copy.deepcopy(base)
+        fresh["value"] = base["value"] * 0.8       # the acceptance case
+        rep = compare_bench(fresh, base)
+        assert rep["status"] == "regression"
+        names = [r["metric"] for r in rep["regressions"]]
+        assert names == ["resnet50_images_per_sec"]
+        assert rep["regressions"][0]["delta_pct"] == pytest.approx(-20.0)
+
+    def test_drop_within_tolerance_passes(self):
+        base = _baseline()
+        fresh = copy.deepcopy(base)
+        fresh["value"] = base["value"] * (1 - GATE_DEFAULT_TOLERANCE / 2)
+        assert compare_bench(fresh, base)["status"] == "pass"
+
+    def test_stale_fallback_is_explained(self):
+        base = _baseline()
+        fresh = copy.deepcopy(base)
+        fresh["stale"] = True
+        fresh["stale_error"] = "tunnel unreachable"
+        rep = compare_bench(fresh, base)
+        assert rep["status"] == "stale_fallback"
+        assert rep["stale_error"] == "tunnel unreachable"
+
+    def test_cpu_sandbox_is_incomparable(self):
+        base = _baseline()
+        fresh = copy.deepcopy(base)
+        fresh["platform"] = "cpu"
+        fresh["value"] = 12.0                      # 200x "drop": not gated
+        assert compare_bench(fresh, base)["status"] == \
+            "incomparable_platform"
+
+    def test_missing_headline_is_regression(self):
+        base = _baseline()
+        fresh = copy.deepcopy(base)
+        fresh["value"] = 0.0                       # headline gone
+        rep = compare_bench(fresh, base)
+        assert rep["status"] == "regression"
+        assert "resnet50_images_per_sec" in rep["missing"]
+
+    def test_missing_secondary_warns_only(self):
+        base = _baseline()
+        fresh = copy.deepcopy(base)
+        del fresh["extras"]["word2vec"]
+        rep = compare_bench(fresh, base)
+        assert rep["status"] == "pass"
+        assert rep["missing"] == ["word2vec_words_per_sec"]
+
+    def test_no_baseline(self):
+        assert compare_bench(_baseline(), None)["status"] == "no_baseline"
+        assert compare_bench(_baseline(), {})["status"] == "no_baseline"
+
+    def test_error_record_is_no_measurement(self):
+        fresh = {"value": 0.0, "error": "tunnel unreachable",
+                 "platform": "tpu"}
+        assert compare_bench(fresh, _baseline())["status"] == \
+            "no_measurement"
+
+    def test_improvement_reported_not_flagged(self):
+        base = _baseline()
+        fresh = copy.deepcopy(base)
+        fresh["value"] = base["value"] * 1.5
+        rep = compare_bench(fresh, base)
+        assert rep["status"] == "pass"
+        assert [r["metric"] for r in rep["improvements"]] == \
+            ["resnet50_images_per_sec"]
+
+
+class TestRegressionGateCLI:
+    def _write(self, tmp_path, name, rec):
+        p = tmp_path / name
+        p.write_text(json.dumps(rec))
+        return str(p)
+
+    def test_exit_codes(self, tmp_path):
+        base = self._write(tmp_path, "base.json", _baseline())
+        ok = self._write(tmp_path, "ok.json", _baseline())
+        bad_rec = _baseline()
+        bad_rec["value"] *= 0.8
+        bad = self._write(tmp_path, "bad.json", bad_rec)
+        stale_rec = _baseline()
+        stale_rec["stale"] = True
+        stale = self._write(tmp_path, "stale.json", stale_rec)
+        assert regression_gate.main([ok, base, "--quiet"]) == 0
+        assert regression_gate.main([bad, base, "--quiet"]) == 1
+        assert regression_gate.main([stale, base, "--quiet"]) == 0
+        assert regression_gate.main([str(tmp_path / "nope.json"),
+                                     "--quiet"]) == 2
+
+    def test_embedded_verdict_wins(self, tmp_path):
+        """bench main() embeds the verdict vs the PRE-run baseline; the
+        CLI must honor it even though the on-disk artifact has since
+        been refreshed to the fresh numbers (fresh-vs-fresh would
+        always pass)."""
+        rec = _baseline()
+        rec["regression_check"] = {
+            "status": "regression",
+            "regressions": [{"metric": "resnet50_images_per_sec"}]}
+        fresh = self._write(tmp_path, "fresh.json", rec)
+        base = self._write(tmp_path, "base.json", _baseline())
+        assert regression_gate.main([fresh, "--quiet"]) == 1
+        # explicit baseline (or --recompute) forces a re-comparison
+        assert regression_gate.main([fresh, base, "--quiet"]) == 0
+
+    def test_load_record_formats(self, tmp_path):
+        rec = _baseline()
+        raw = self._write(tmp_path, "raw.json", rec)
+        wrapped = self._write(tmp_path, "wrapped.json",
+                              {"n": 4, "cmd": "python bench.py",
+                               "parsed": rec})
+        log = tmp_path / "run.log"
+        log.write_text("warmup noise\nnot json\n" + json.dumps(rec) + "\n")
+        for p in (raw, wrapped, str(log)):
+            assert regression_gate.load_record(p)["value"] == rec["value"]

@@ -408,3 +408,50 @@ class TestMasterStatsBridge:
         assert names["master/broadcast"] == 1
         assert names["master/local_fit"] == 2
         _assert_exposition_parses(reg.exposition())
+
+
+class TestProfilerCapture:
+    def test_capture_writes_trace_and_records_metrics(self, tmp_path):
+        import glob
+
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.monitor import ProfilerCapture
+
+        logdir = str(tmp_path / "trace")
+        reg = monitor.enable(registry=MetricsRegistry())
+        try:
+            cap = ProfilerCapture(logdir)
+            try:
+                cap.start()
+            except Exception as e:  # noqa: BLE001 — profiler availability
+                pytest.skip(f"jax.profiler unavailable: {e}")
+            assert cap.active
+            with pytest.raises(RuntimeError):
+                cap.start()          # double-start is a caller bug
+            f = jax.jit(lambda v: (v @ v).sum())
+            f(jnp.ones((16, 16))).block_until_ready()
+            assert cap.stop() == logdir
+            assert not cap.active
+            assert cap.stop() is None          # idempotent
+            assert glob.glob(logdir + "/**/*", recursive=True), \
+                "capture wrote nothing"
+            assert reg.counter("profiler_captures_total").value == 1
+            assert reg.gauge("profiler_capture_seconds").value > 0
+            assert monitor.tracer().span_names().get(
+                "profiler/capture", 0) >= 1
+        finally:
+            monitor.disable()
+
+    def test_context_manager_roundtrip_without_monitoring(self, tmp_path):
+        from deeplearning4j_tpu.monitor import ProfilerCapture
+
+        assert not monitor.is_enabled()
+        logdir = str(tmp_path / "trace2")
+        try:
+            with ProfilerCapture(logdir) as cap:
+                assert cap.active
+        except Exception as e:  # noqa: BLE001
+            pytest.skip(f"jax.profiler unavailable: {e}")
+        assert not cap.active

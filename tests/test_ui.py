@@ -405,3 +405,53 @@ class TestDashboardDepth:
             assert 'href="/train/model"' in html  # qs dropped entirely
         finally:
             server.stop()
+
+
+class TestProfileRoute:
+    def _get(self, server, path):
+        import urllib.request
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}", timeout=10).read().decode()
+
+    def test_profile_renders_published_report(self):
+        from deeplearning4j_tpu.monitor import xprof
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+
+        xprof.clear_cost_reports()
+        xprof.publish_cost_report({
+            "model": "demo_model",
+            "per_op": {"total_flops_per_step": 1.0e9,
+                       "total_bytes_per_step": 2.0e8,
+                       "conv_dot_flops_per_step": 9.0e8,
+                       "top10": [{"op": "dot_general",
+                                  "shape": "f32[8,4] -> f32[8,8]",
+                                  "flops": 9.0e8, "bytes": 1e6,
+                                  "share": 0.9}]},
+            "roofline": {"arithmetic_intensity_flop_per_byte": 5.0,
+                         "bound": "memory", "peak_tflops": 111.4,
+                         "peak_source": "test"},
+            "predicted": {"step_seconds": 0.01, "mfu": 0.2,
+                          "mfu_if_compute_bound": 0.9},
+        }, registry=MetricsRegistry())
+        server = UIServer().start()
+        try:
+            html = self._get(server, "/profile")
+            assert "demo_model" in html
+            assert "dot_general" in html
+            api = json.loads(self._get(server, "/api/profile"))
+            assert api["demo_model"]["predicted"]["mfu"] == 0.2
+        finally:
+            server.stop()
+            xprof.clear_cost_reports()
+
+    def test_profile_empty_shows_hint(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.monitor import xprof
+
+        xprof.clear_cost_reports()
+        monkeypatch.chdir(tmp_path)   # no PROFILE_* artifacts to scan
+        server = UIServer().start()
+        try:
+            html = self._get(server, "/profile")
+            assert "benchtools.hlo_cost" in html
+        finally:
+            server.stop()
