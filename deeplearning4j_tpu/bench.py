@@ -1068,10 +1068,37 @@ def _save_lastgood(result):
         pass
 
 
-def _emit_failure(err, attempts):
-    """Tunnel/run failure: emit last-known-good with provenance, or
-    zeros only when no good measurement has ever been recorded."""
-    lastgood = _load_lastgood()
+# connectivity-class failure classification for the LASTGOOD echo: the
+# stale fallback exists to survive TUNNEL flaps (the chip is fine, we
+# just can't reach it), not to launder in-bench crashes — a genuine
+# regression that throws must surface as the explicit error/zero shape,
+# never as 2425 img/s with a `stale` flag (ADVICE r5).
+_CONNECTIVITY_MARKERS = (
+    "tunnel", "unavailable", "deadline", "connection", "connect",
+    "grpc", "socket", "transport", "timed out", "timeout",
+    "unreachable", "backend did not initialize",
+)
+
+
+def _is_connectivity_error(err) -> bool:
+    """Heuristic: does this exception/message describe losing the
+    accelerator, rather than the bench code failing?"""
+    if isinstance(err, (ConnectionError, TimeoutError)):
+        return True
+    msg = (f"{type(err).__name__}: {err}" if isinstance(err, BaseException)
+           else str(err)).lower()
+    return any(m in msg for m in _CONNECTIVITY_MARKERS)
+
+
+def _emit_failure(err, attempts, connectivity=True):
+    """Failure emission. Connectivity-class failures (tunnel probe /
+    backend init / mid-run transport loss) echo last-known-good with
+    staleness provenance — the committed measurement is still the best
+    estimate of the silicon. Anything else (an in-bench exception) is a
+    code/regression signal and emits the explicit error/zero shape so
+    the gate can catch it; zeros also when no good measurement was ever
+    recorded."""
+    lastgood = _load_lastgood() if connectivity else None
     if lastgood is not None:
         out = dict(lastgood)
         out["stale"] = True
@@ -1330,10 +1357,13 @@ def main():
     try:
         primary = _with_compile_split(snap, bench_resnet50, accel)
     except Exception as e:
-        # a mid-run tunnel drop (or any primary-bench crash) must not
-        # zero the scoreboard either
+        # a mid-run tunnel drop must not zero the scoreboard — but ONLY
+        # a connectivity-class failure may echo LASTGOOD; an in-bench
+        # crash is a regression signal and emits the explicit error
+        # shape (a genuine regression must never surface as stale-good)
         _emit_failure(f"primary bench failed: {type(e).__name__}: "
-                      f"{e}"[:400], attempts=0)
+                      f"{e}"[:400], attempts=0,
+                      connectivity=_is_connectivity_error(e))
         return
 
     extras = {}

@@ -65,13 +65,20 @@ class Normalizer:
     fits_labels = False
 
     def fit(self, data) -> "Normalizer":
-        """Accept a DataSet or any iterable of DataSets."""
+        """Accept a DataSet or any iterable of DataSets. A
+        `features_mask` ([B, T], 1 = real timestep) excludes padded
+        timesteps from the statistics — matching ND4J's masked-aware
+        accumulation (`NormalizerStandardize` + `DataSetUtil`
+        masked-columns path): padding zeros must not drag the mean
+        toward 0 or deflate the variance of a padded corpus."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
         batches = [data] if isinstance(data, DataSet) else data
         self._begin()
         n = 0
         for ds in batches:
-            self._accumulate(np.asarray(ds.features))
+            mask = getattr(ds, "features_mask", None)
+            self._accumulate(np.asarray(ds.features),
+                             None if mask is None else np.asarray(mask))
             n += 1
         if n == 0:
             raise ValueError("fit() saw no data")
@@ -100,11 +107,22 @@ class Normalizer:
     def _begin(self):
         raise NotImplementedError
 
-    def _accumulate(self, x):
+    def _accumulate(self, x, mask=None):
         raise NotImplementedError
 
     def _finish(self):
         pass
+
+
+def _mask_weights(x: np.ndarray, mask) -> Optional[np.ndarray]:
+    """Broadcastable 0/1 weights for a [B, T] features_mask against
+    [B, T, F] features (None when the mask doesn't apply)."""
+    if mask is None or x.ndim != 3:
+        return None
+    w = np.asarray(mask, np.float64)
+    if w.shape != x.shape[:2]:
+        return None
+    return w[:, :, None]
 
 
 @register_normalizer
@@ -124,12 +142,18 @@ class NormalizerStandardize(Normalizer):
         self._sum = None
         self._sumsq = None
 
-    def _accumulate(self, x):
+    def _accumulate(self, x, mask=None):
         x = np.asarray(x, np.float64)
         axes = _reduce_axes(x)
-        cnt = float(np.prod([x.shape[a] for a in axes])) if axes else 1.0
-        s = x.sum(axis=axes)
-        sq = (x * x).sum(axis=axes)
+        w = _mask_weights(x, mask)
+        if w is not None:
+            cnt = float(w.sum())  # per-feature count — same for every F
+            s = (x * w).sum(axis=axes)
+            sq = (x * x * w).sum(axis=axes)
+        else:
+            cnt = float(np.prod([x.shape[a] for a in axes])) if axes else 1.0
+            s = x.sum(axis=axes)
+            sq = (x * x).sum(axis=axes)
         if self._sum is None:
             self._sum, self._sumsq = s, sq
         else:
@@ -138,6 +162,14 @@ class NormalizerStandardize(Normalizer):
         self._n += cnt
 
     def _finish(self):
+        if not self._n:
+            # every timestep masked out (upstream filtering bug): a
+            # silent 0/0 would make mean/std NaN and poison every
+            # later transform with no pointer back here
+            raise ValueError(
+                "fit() saw no unmasked timesteps — the features_mask "
+                "excluded every value; check the mask polarity "
+                "(1 = real timestep)")
         self.mean = self._sum / self._n
         var = self._sumsq / self._n - self.mean ** 2
         self.std = np.sqrt(np.clip(var, 1e-12, None))
@@ -178,16 +210,33 @@ class NormalizerMinMaxScaler(Normalizer):
         self.data_min = None
         self.data_max = None
 
-    def _accumulate(self, x):
+    def _accumulate(self, x, mask=None):
         x = np.asarray(x, np.float64)
         axes = _reduce_axes(x)
-        lo = x.min(axis=axes)
-        hi = x.max(axis=axes)
+        w = _mask_weights(x, mask)
+        if w is not None:
+            keep = w > 0
+            lo = np.where(keep, x, np.inf).min(axis=axes)
+            hi = np.where(keep, x, -np.inf).max(axis=axes)
+            if not np.isfinite(lo).all():  # batch fully padded
+                return
+        else:
+            lo = x.min(axis=axes)
+            hi = x.max(axis=axes)
         if self.data_min is None:
             self.data_min, self.data_max = lo, hi
         else:
             self.data_min = np.minimum(self.data_min, lo)
             self.data_max = np.maximum(self.data_max, hi)
+
+    def _finish(self):
+        if self.data_min is None:
+            # every batch was fully masked — same loud failure as the
+            # standardizer, instead of a later None-arithmetic crash
+            raise ValueError(
+                "fit() saw no unmasked timesteps — the features_mask "
+                "excluded every value; check the mask polarity "
+                "(1 = real timestep)")
 
     def _span(self):
         return np.clip(self.data_max - self.data_min, 1e-12, None)

@@ -94,6 +94,13 @@ _GAUGE_FIELDS = (
      ("roofline", "arithmetic_intensity_flop_per_byte")),
     ("aot_cost_predicted_step_seconds", ("roofline", "predicted_step_seconds")),
     ("aot_cost_predicted_mfu", ("predicted", "mfu")),
+    # program section (scan-over-layers observability): how big the
+    # compiled train step is and what compiling it cost
+    ("aot_compile_seconds", ("program", "compile_seconds")),
+    ("aot_compile_jaxpr_eqns", ("program", "jaxpr_eqn_count")),
+    ("aot_compile_peak_temp_bytes", ("program", "peak_temp_bytes")),
+    ("aot_compile_code_size_bytes",
+     ("program", "generated_code_size_in_bytes")),
 )
 
 

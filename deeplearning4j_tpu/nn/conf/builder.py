@@ -104,6 +104,12 @@ class MultiLayerConfiguration:
     pretrain: bool = False
     optimization_algo: str = "sgd"  # OptimizationAlgorithm value
     max_iterations: int = 5  # line-search solver iterations per batch
+    # scan-over-layers compilation (nn/scan_stack.py): roll maximal
+    # homogeneous layer runs into one lax.scan so compile time /
+    # program size stop scaling with depth. Numerics are identical to
+    # the unrolled loop; disable for A/B or debugging (also via the
+    # DL4J_SCAN_LAYERS=0 env override).
+    scan_layers: bool = True
 
     def to_dict(self):
         return {
@@ -122,6 +128,7 @@ class MultiLayerConfiguration:
             "pretrain": self.pretrain,
             "optimization_algo": self.optimization_algo,
             "max_iterations": self.max_iterations,
+            "scan_layers": self.scan_layers,
         }
 
     def to_json(self, **kw):
@@ -146,6 +153,7 @@ class MultiLayerConfiguration:
             pretrain=d.get("pretrain", False),
             optimization_algo=d.get("optimization_algo", "sgd"),
             max_iterations=d.get("max_iterations", 5),
+            scan_layers=d.get("scan_layers", True),
         )
 
     @staticmethod
@@ -227,6 +235,7 @@ class ListBuilder:
         self._tbptt_fwd = 20
         self._tbptt_back = 20
         self._pretrain = False
+        self._scan_layers = True
 
     def layer(self, layer_or_idx, maybe_layer=None) -> "ListBuilder":
         layer = maybe_layer if maybe_layer is not None else layer_or_idx
@@ -252,6 +261,12 @@ class ListBuilder:
 
     def pretrain(self, flag: bool) -> "ListBuilder":
         self._pretrain = flag
+        return self
+
+    def scan_layers(self, flag: bool) -> "ListBuilder":
+        """Enable/disable scan-over-layers compilation of homogeneous
+        layer runs (default on; see nn/scan_stack.py)."""
+        self._scan_layers = bool(flag)
         return self
 
     def build(self) -> MultiLayerConfiguration:
@@ -296,6 +311,7 @@ class ListBuilder:
             pretrain=self._pretrain,
             optimization_algo=g.optimization_algo_value,
             max_iterations=g.max_iterations_value,
+            scan_layers=self._scan_layers,
         )
 
 
@@ -320,6 +336,7 @@ class NeuralNetConfiguration:
         self.gradient_normalization_value = GradientNormalization.NONE
         self.gradient_normalization_threshold_value = 1.0
         self.max_norm_value: Optional[float] = None
+        self.remat_policy_value: Optional[str] = None
         self.activation_value = None
         self.optimization_algo_value = "sgd"
         self.max_iterations_value = 5
@@ -377,6 +394,18 @@ class NeuralNetConfiguration:
         self.gradient_normalization_threshold_value = threshold
         return self
 
+    def remat_policy(self, policy: Optional[str]):
+        """Global rematerialization default pushed into every layer
+        that doesn't set its own: "none"/None stores activations,
+        "full" recomputes the layer in backward, "dots_saveable"
+        recomputes everything except matmul outputs (the
+        peak-activation-memory lever for deep stacks — see
+        nn/scan_stack.py and docs/COMPILE.md)."""
+        from deeplearning4j_tpu.nn.scan_stack import validate_remat_policy
+        validate_remat_policy(policy)
+        self.remat_policy_value = policy
+        return self
+
     def optimization_algo(self, algo):
         """Reference `NeuralNetConfiguration.Builder.optimizationAlgo`
         (`nn/api/OptimizationAlgorithm.java`): sgd runs the jitted
@@ -411,6 +440,9 @@ class NeuralNetConfiguration:
             layer.l1_bias = self.l1_bias_value
         if layer.l2_bias == 0.0:
             layer.l2_bias = self.l2_bias_value
+        if (getattr(layer, "remat_policy", None) is None
+                and self.remat_policy_value is not None):
+            layer.remat_policy = self.remat_policy_value
         if layer.dropout is None and self.dropout_value is not None:
             # output-ish layers don't get input dropout by default in the
             # reference either; applied uniformly here, harmless for eval.

@@ -34,6 +34,7 @@ from deeplearning4j_tpu.nn.conf.builder import (
 )
 from deeplearning4j_tpu.nn.conf.graph import GraphVertex, vertex_from_dict
 from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn import scan_stack
 from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
 from deeplearning4j_tpu.nn.layers.feedforward import BaseOutputLayerMixin
 from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
@@ -75,6 +76,7 @@ class ComputationGraphConfiguration:
         self.max_norm: Optional[float] = None
         self.optimization_algo: str = "sgd"
         self.max_iterations: int = 5
+        self.scan_layers: bool = True  # roll homogeneous chains into lax.scan
         self.topo_order: List[str] = []
 
     # ------------------------------------------------------------- builder
@@ -125,6 +127,7 @@ class ComputationGraphConfiguration:
             "max_norm": self.max_norm,
             "optimization_algo": self.optimization_algo,
             "max_iterations": self.max_iterations,
+            "scan_layers": self.scan_layers,
             "input_types": {k: v.to_dict() for k, v in self.input_types.items()},
             "nodes": [
                 {
@@ -159,6 +162,7 @@ class ComputationGraphConfiguration:
         conf.max_norm = d.get("max_norm")
         conf.optimization_algo = d.get("optimization_algo", "sgd")
         conf.max_iterations = d.get("max_iterations", 5)
+        conf.scan_layers = d.get("scan_layers", True)
         conf.input_types = {k: InputType.from_dict(v)
                             for k, v in d.get("input_types", {}).items()}
         for nd in d["nodes"]:
@@ -215,6 +219,12 @@ class GraphBuilder:
     def backprop_type(self, bptype, fwd_length: int = 20) -> "GraphBuilder":
         self._conf.backprop_type = BackpropType(bptype)
         self._conf.tbptt_fwd_length = fwd_length
+        return self
+
+    def scan_layers(self, flag: bool) -> "GraphBuilder":
+        """Enable/disable scan-over-layers compilation of homogeneous
+        layer chains (default on; see nn/scan_stack.py)."""
+        self._conf.scan_layers = bool(flag)
         return self
 
     def build(self) -> ComputationGraphConfiguration:
@@ -275,6 +285,10 @@ class ComputationGraph:
         self._uses_seq_parallel = any(
             getattr(n.layer, "sequence_parallel", None)
             for n in conf.nodes.values() if n.layer is not None)
+        # scan-over-layers chain plan (nn/scan_stack.py), built lazily
+        # from traced shapes: {head: [members]}, skip set, fold indices
+        self._chain_plan = None
+        self._packed_runs_cache = None
         self._rnn_carries: Dict[str, Any] = {}
         self._rnn_stream_pos = 0  # host-side stream-budget tracker
         self.output_layer_names = [
@@ -336,15 +350,32 @@ class ComputationGraph:
         return self
 
     # --------------------------------------------------------------- forward
+    def _chains(self, params):
+        """Scan-over-layers chain plan: maximal single-consumer chains
+        of structurally identical layer nodes (nn/scan_stack.py).
+        Cached — node structure and param shapes are fixed per model.
+        Returns ({head: [members]}, skip_set, {name: topo_index})."""
+        if self._chain_plan is None:
+            chains, members = scan_stack.build_graph_plan(
+                self.conf, params, self.output_layer_names)
+            topo_index = {n: i for i, n in enumerate(self.conf.topo_order)}
+            self._chain_plan = (chains, members, topo_index)
+        return self._chain_plan
+
     def _forward_all(self, params, state, inputs: Sequence, *, train, rng,
                      masks: Optional[Sequence] = None, stop_at_loss: bool = False,
-                     carries: Optional[Dict] = None):
+                     carries: Optional[Dict] = None, unrolled: bool = False):
         """Walk topo order. Returns (activations dict, preout dict,
         new_state, mask dict). When `carries` is given (a dict keyed by
         node name), recurrent layers run `forward_with_carry` and the
         updated carries are written back into it (TBPTT / rnn_time_step
         state threading, reference ComputationGraph rnnTimeStep /
-        rnnActivateUsingStoredState)."""
+        rnnActivateUsingStoredState).
+
+        Maximal single-consumer chains of structurally identical layer
+        nodes execute as ONE `lax.scan` over stacked params — interior
+        chain activations are not materialized, so callers that need
+        every node's activation (feed_forward) pass `unrolled=True`."""
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         masks = list(masks) if masks else [None] * len(inputs)
@@ -355,10 +386,43 @@ class ComputationGraph:
         for i, name in enumerate(self.conf.network_inputs):
             acts[name] = self.dtype.cast_compute(jnp.asarray(inputs[i]))
             mask_map[name] = masks[i] if i < len(masks) else None
+        use_scan = (carries is None and not unrolled
+                    and scan_stack.scan_enabled(self.conf))
+        chains, chain_skip, topo_index = (
+            self._chains(params) if use_scan else ({}, set(), {}))
+        chain_skip = set(chain_skip)
         for li, name in enumerate(self.conf.topo_order):
             node = self.conf.nodes[name]
             if node.kind == "input":
                 continue
+            if name in chain_skip:
+                continue  # interior chain member — covered by its head
+            if use_scan and name in chains:
+                members = chains[name]
+                template = node.layer
+                h = acts[node.inputs[0]]
+                mask = mask_map.get(node.inputs[0])
+                packed = params.get(scan_stack.run_key(members))
+                if scan_stack.mask_invariant(template, mask):
+                    if packed is None:
+                        packed = scan_stack.stack_params(
+                            [params[m] for m in members])
+                    h = scan_stack.scan_forward(
+                        template, packed, h, train=train, rng=rng,
+                        fold_ids=[topo_index[m] for m in members],
+                        mask=mask)
+                    tail = members[-1]
+                    acts[tail] = h
+                    mask_map[tail] = mask
+                    continue
+                # mask transforms per layer — replay the chain unrolled
+                # (the per-node body below handles the head; unskip the
+                # interior members so the walk reaches them too)
+                if packed is not None:
+                    params = {**params,
+                              **dict(zip(members, scan_stack.unstack_entry(
+                                  packed, len(members))))}
+                chain_skip -= set(members[1:])
             in_acts = [acts[s] for s in node.inputs]
             in_masks = [mask_map.get(s) for s in node.inputs]
             if node.kind == "vertex":
@@ -383,13 +447,14 @@ class ComputationGraph:
                 carry_in = carries.get(name)
                 if carry_in is None:
                     carry_in = layer.init_carry(h.shape[0], h.dtype)
-                h, st, carry_out = layer.forward_with_carry(
-                    lparams, state.get(name, {}), h, carry_in,
+                h, st, carry_out = scan_stack.layer_forward_with_carry(
+                    layer, lparams, state.get(name, {}), h, carry_in,
                     train=train, rng=lrng, mask=mask)
                 carries[name] = carry_out
             else:
-                h, st = layer.forward(lparams, state.get(name, {}), h,
-                                      train=train, rng=lrng, mask=mask)
+                h, st = scan_stack.layer_forward(
+                    layer, lparams, state.get(name, {}), h,
+                    train=train, rng=lrng, mask=mask)
             if st:
                 new_state[name] = st
             acts[name] = h
@@ -419,6 +484,12 @@ class ComputationGraph:
         for name, node in self.conf.nodes.items():
             if node.kind == "layer" and name in params:
                 total = total + node.layer.regularization_score(params[name])
+        for k, p in params.items():
+            if scan_stack.is_run_key(k):
+                # stacked run entry: the template's l1/l2 sums over the
+                # stacked array — identical to summing per layer
+                template = self.conf.nodes[scan_stack.run_members(k)[0]].layer
+                total = total + template.regularization_score(p)
         # auxiliary losses threaded through layer state (e.g. MoE load
         # balance) — consumed here, not persisted across steps
         for st in new_state.values():
@@ -427,17 +498,35 @@ class ComputationGraph:
         return self.dtype.cast_output(total), (new_state, out_carries)
 
     # ------------------------------------------------------------ train step
+    def _packed_runs(self, params):
+        """Chains packed at the train-step boundary — see
+        `MultiLayerNetwork._packed_runs` (nn/scan_stack.py)."""
+        runs = self._packed_runs_cache
+        if runs is None:
+            chains, _, _ = self._chains(params)
+            rwt = [(members, self.conf.nodes[members[0]].layer)
+                   for members in chains.values()]
+            runs = scan_stack.packable_runs(self.conf, rwt)
+            self._packed_runs_cache = runs
+        return runs
+
     def _apply_updates(self, params, grads, upd_state, step):
         new_params, new_upd = {}, {}
         for lk, lgrads in grads.items():
-            layer = self.conf.nodes[lk].layer
+            if scan_stack.is_run_key(lk):
+                # stacked run entry — elementwise updater covers the
+                # whole run (packable_runs guarantees no constraints)
+                layer = self.conf.nodes[scan_stack.run_members(lk)[0]].layer
+            else:
+                layer = self.conf.nodes[lk].layer
             updater = layer.updater or Sgd(1e-3)
             lp, lu = {}, {}
             for pk, g in lgrads.items():
                 delta, new_s = updater.apply(g, upd_state[lk][pk], step)
                 lp[pk] = params[lk][pk] - delta.astype(params[lk][pk].dtype)
                 lu[pk] = new_s
-            new_params[lk] = layer.apply_constraints(lp)
+            new_params[lk] = (lp if scan_stack.is_run_key(lk)
+                              else layer.apply_constraints(lp))
             new_upd[lk] = lu
         if self.conf.max_norm is not None:
             new_params = apply_max_norm_constraint(new_params, self.conf.max_norm)
@@ -449,6 +538,13 @@ class ComputationGraph:
 
         def step_fn(params, upd_state, state, it, xs, ys, rng, fmasks, lmasks,
                     carries=None):
+            # boundary packing — see MultiLayerNetwork._make_train_step
+            runs = ([] if tbptt or not scan_stack.scan_enabled(self.conf)
+                    else self._packed_runs(params))
+            if runs:
+                params = scan_stack.pack_tree(params, runs)
+                upd_state = scan_stack.pack_tree(upd_state, runs)
+
             def lf(p):
                 if tbptt and carries is not None:
                     stopped = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
@@ -461,6 +557,9 @@ class ComputationGraph:
                 lf, has_aux=True)(params)
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd_state, it)
+            if runs:
+                new_params = scan_stack.unpack_tree(new_params, runs)
+                new_upd = scan_stack.unpack_tree(new_upd, runs)
             return new_params, new_upd, new_state, loss, new_carries
 
         return jax.jit(step_fn, donate_argnums=_donate(0, 1, 2))
@@ -488,9 +587,19 @@ class ComputationGraph:
             return (new_params, new_upd, state, it + 1), loss
 
         def multi(params, upd, state, it0, xs_stack, ys_stack, rngs):
+            # homogeneous chains ride the k-step scan carry stacked —
+            # packed/unpacked once per PROGRAM (see scan_stack)
+            runs = (self._packed_runs(params)
+                    if scan_stack.scan_enabled(self.conf) else [])
+            if runs:
+                params = scan_stack.pack_tree(params, runs)
+                upd = scan_stack.pack_tree(upd, runs)
             (params, upd, state, _), losses = jax.lax.scan(
                 one, (params, upd, state, jnp.asarray(it0, jnp.int32)),
                 (xs_stack, ys_stack, rngs))
+            if runs:
+                params = scan_stack.unpack_tree(params, runs)
+                upd = scan_stack.unpack_tree(upd, runs)
             return params, upd, state, losses
 
         return multi
@@ -940,8 +1049,11 @@ class ComputationGraph:
         return outs[0] if len(outs) == 1 else outs
 
     def feed_forward(self, *inputs, train: bool = False, masks=None):
+        # unrolled: every node's activation must materialize (a scanned
+        # chain would skip its interior members)
         acts, _, _, _ = self._forward_all(self.params, self.net_state, list(inputs),
-                                          train=train, rng=None, masks=masks)
+                                          train=train, rng=None, masks=masks,
+                                          unrolled=True)
         return acts
 
     def score(self, dataset=None, training: bool = False):

@@ -115,6 +115,14 @@ class Layer:
 
     layer_name = "base"
 
+    # stackable-params contract (nn/scan_stack.py): containers may roll
+    # maximal runs of structurally identical layers into one
+    # `lax.scan` over params stacked along a leading axis. A layer
+    # whose forward cannot be replayed that way (emits fresh state keys
+    # like MoE aux losses, or closes over per-instance mutable state)
+    # sets this False to stay on the unrolled path.
+    stackable_params = True
+
     # common config fields (reference BaseLayer.java)
     activation: Any = None  # Activation | str | None
     weight_init: Any = WeightInit.XAVIER
@@ -129,12 +137,21 @@ class Layer:
     weight_noise: Optional[IWeightNoise] = None  # DropConnect / WeightNoise
     constraints: Any = None  # list[LayerConstraint], applied post-update
     name: Optional[str] = None
+    # rematerialization policy applied by the containers in training
+    # (scan body AND unrolled path): None/"none" stores activations,
+    # "full" recomputes everything in backward (`jax.checkpoint`),
+    # "dots_saveable" recomputes everything except matmul outputs
+    # (`jax.checkpoint_policies.dots_saveable` — recompute cheap
+    # elementwise/norm work, keep the MXU results)
+    remat_policy: Optional[str] = None
 
     def __post_init__(self):
         if self.activation is not None:
             self.activation = get_activation(self.activation)
         if self.weight_init is not None and not isinstance(self.weight_init, WeightInit):
             self.weight_init = WeightInit(self.weight_init)
+        from deeplearning4j_tpu.nn.scan_stack import validate_remat_policy
+        validate_remat_policy(self.remat_policy)
 
     # ---- shape inference -------------------------------------------------
     def set_n_in(self, input_type: InputType, override: bool = True) -> None:

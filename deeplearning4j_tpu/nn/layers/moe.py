@@ -32,6 +32,12 @@ from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
 class MixtureOfExperts(Layer):
     layer_name = "mixture_of_experts"
 
+    # forward emits a fresh "aux_loss" state key the containers' loss
+    # consumes — a stacked-params scan carry cannot thread that, so MoE
+    # stacks stay on the unrolled path (same exclusion the pipeline
+    # container enforces)
+    stackable_params = False
+
     n_in: int = 0
     n_out: int = 0          # defaults to n_in
     n_experts: int = 4

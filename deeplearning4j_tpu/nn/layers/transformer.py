@@ -108,6 +108,12 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
     # O(layers * block internals) — the standard lever for long-context
     # training on HBM-limited chips. FLOPs grow by ~1 extra forward;
     # numerics are identical.
+    #
+    # Legacy bool, equivalent to `remat_policy="full"` on the Layer
+    # base — the generalized per-layer knob (also "dots_saveable").
+    # The CONTAINERS apply the policy (scan body, unrolled path, and
+    # the carry-threading TBPTT branch alike — see nn/scan_stack.py);
+    # layers no longer wrap themselves.
     remat: bool = False
 
     def __post_init__(self):
@@ -167,14 +173,6 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
                 if k.startswith(prefix + "_")}
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
-        if self.remat and train:
-            # mask rides the closure (no grad needed); params/x/rng are
-            # the differentiated/recomputed arguments
-            def body(p, xx, r):
-                return self._forward_impl(p, xx, train=True, rng=r,
-                                          mask=mask)
-
-            return jax.checkpoint(body)(params, x, rng), state
         return self._forward_impl(params, x, train=train, rng=rng,
                                   mask=mask), state
 
@@ -226,11 +224,6 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
                 "carry) with a padding mask: masked tokens' K/V would "
                 "enter the cache; strip padding before streaming / "
                 "TBPTT-training this block")
-        if self.remat and train:
-            def body(p, xx, c, r):
-                return self._carry_impl(p, xx, c, train=True, rng=r)
-            y, new_carry = jax.checkpoint(body)(params, x, carry, rng)
-            return y, {}, new_carry
         y, new_carry = self._carry_impl(params, x, carry, train=train,
                                         rng=rng)
         return y, {}, new_carry

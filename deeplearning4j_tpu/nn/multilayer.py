@@ -42,6 +42,7 @@ from deeplearning4j_tpu.nn.conf.builder import (
 from deeplearning4j_tpu.nn.layers.base import Layer
 from deeplearning4j_tpu.nn.layers.feedforward import BaseOutputLayerMixin
 from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.nn import scan_stack
 from deeplearning4j_tpu.optimize.gradients import (
     apply_gradient_normalization,
     apply_max_norm_constraint,
@@ -113,6 +114,10 @@ class MultiLayerNetwork:
         self._ambient_seq_ctx = None
         self._uses_seq_parallel = any(
             getattr(l, "sequence_parallel", None) for l in self.layers)
+        # scan-over-layers segment plans (nn/scan_stack.py), keyed by
+        # the forward's layer count; built lazily from traced shapes
+        self._scan_plans: Dict[int, list] = {}
+        self._packed_runs_cache = None
         self._initialized = False
         out = self.layers[-1] if self.layers else None
         if out is not None and not isinstance(out, BaseOutputLayerMixin):
@@ -179,41 +184,105 @@ class MultiLayerNetwork:
         return self
 
     # --------------------------------------------------------------- forward
+    def _forward_plan(self, params, n):
+        """Scan-over-layers segment plan for the first `n` layers —
+        ('layer', i) entries interleaved with ('scan', start, stop)
+        maximal homogeneous runs. Cached per n (shapes are fixed per
+        model); built from the traced params so it works identically
+        under jit and AOT lowering."""
+        plan = self._scan_plans.get(n)
+        if plan is None:
+            plan = scan_stack.build_layer_plan(
+                self.layers, params, self.conf.input_preprocessors, n)
+            self._scan_plans[n] = plan
+        return plan
+
     def _forward_core(self, params, state, x, *, train, rng, mask=None,
                       carries=None, upto=None, collect=False):
         """Shared forward pass. Returns (h, new_state, new_carries,
-        activations_if_collect, final_mask)."""
+        activations_if_collect, final_mask).
+
+        Maximal runs of structurally identical layers execute as ONE
+        `lax.scan` over their stacked params (nn/scan_stack.py) —
+        program size and compile time stop scaling with depth. The
+        carry-threading path (TBPTT / rnn_time_step / generate), the
+        per-activation collector, and heterogeneous stacks stay on the
+        unrolled loop; both paths apply each layer's `remat_policy`
+        and produce identical numerics (same per-layer rng folds)."""
         h = self.dtype.cast_compute(jnp.asarray(x))
         new_state = {}
         new_carries = {}
         acts = []
         n = len(self.layers) if upto is None else upto
-        for i in range(n):
+
+        def one_layer(i, h, mask, skip_pp=False, override_params=None):
             layer = self.layers[i]
             si = str(i)
-            if i in self.conf.input_preprocessors:
+            if not skip_pp and i in self.conf.input_preprocessors:
                 pp = self.conf.input_preprocessors[i]
                 h = pp.pre_process(h, mask)
                 mask = pp.process_mask(mask)
             lrng = None if rng is None else jax.random.fold_in(rng, i)
             lparams = layer.apply_weight_noise(
-                params.get(si, {}), train,
+                params.get(si, {}) if override_params is None
+                else override_params, train,
                 None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
             lstate = state.get(si, {})
             if carries is not None and isinstance(layer, BaseRecurrentLayer):
                 carry_in = carries.get(si)
                 if carry_in is None:
                     carry_in = layer.init_carry(h.shape[0], h.dtype)
-                h, st, carry_out = layer.forward_with_carry(
-                    lparams, lstate, h, carry_in, train=train, rng=lrng, mask=mask)
+                h, st, carry_out = scan_stack.layer_forward_with_carry(
+                    layer, lparams, lstate, h, carry_in, train=train,
+                    rng=lrng, mask=mask)
                 new_carries[si] = carry_out
             else:
-                h, st = layer.forward(lparams, lstate, h, train=train, rng=lrng, mask=mask)
+                h, st = scan_stack.layer_forward(
+                    layer, lparams, lstate, h, train=train, rng=lrng,
+                    mask=mask)
             if st:
                 new_state[si] = st
             mask = layer.forward_mask(mask, None)
             if collect:
                 acts.append(h)
+            return h, mask
+
+        if (carries is None and not collect
+                and scan_stack.scan_enabled(self.conf)):
+            segments = self._forward_plan(params, n)
+        else:
+            segments = [("layer", i) for i in range(n)]
+        for seg in segments:
+            if seg[0] == "layer":
+                h, mask = one_layer(seg[1], h, mask)
+                continue
+            start, stop = seg[1], seg[2]
+            if start in self.conf.input_preprocessors:
+                pp = self.conf.input_preprocessors[start]
+                h = pp.pre_process(h, mask)
+                mask = pp.process_mask(mask)
+            template = self.layers[start]
+            run_keys = [str(i) for i in range(start, stop)]
+            packed = params.get(scan_stack.run_key(run_keys))
+            if not scan_stack.mask_invariant(template, mask):
+                # run layers transform the mask — replay unrolled (the
+                # start preprocessor is already applied; the plan
+                # guarantees none inside the run)
+                plist = (scan_stack.unstack_entry(packed, stop - start)
+                         if packed is not None else
+                         [params[k] for k in run_keys])
+                h, mask = one_layer(start, h, mask, skip_pp=True,
+                                    override_params=plist[0])
+                for i in range(start + 1, stop):
+                    h, mask = one_layer(i, h, mask,
+                                        override_params=plist[i - start])
+                continue
+            if packed is None:
+                packed = scan_stack.stack_params(
+                    [params[k] for k in run_keys])
+            h = scan_stack.scan_forward(
+                template, packed, h, train=train, rng=rng,
+                fold_ids=range(start, stop), mask=mask)
         return h, new_state, new_carries, acts, mask
 
     def _loss_fn(self, params, state, x, y, rng, fmask, lmask, *, train, carries=None):
@@ -241,6 +310,12 @@ class MultiLayerNetwork:
             p = params.get(str(i))
             if p:
                 reg = reg + layer.regularization_score(p)
+        for k, p in params.items():
+            if scan_stack.is_run_key(k):
+                # stacked run entry: the template's l1/l2 sums over the
+                # stacked array — identical to summing per layer
+                template = self.layers[int(scan_stack.run_members(k)[0])]
+                reg = reg + template.regularization_score(p)
         # auxiliary losses threaded through layer state (e.g. MoE load
         # balance) — consumed here, not persisted across steps
         for st in new_state.values():
@@ -249,17 +324,40 @@ class MultiLayerNetwork:
         return self.dtype.cast_output(loss) + reg, (new_state, new_carries)
 
     # ---------------------------------------------------------- train step
+    def _packed_runs(self, params):
+        """Runs packed at the train-step boundary (nn/scan_stack.py):
+        the loss-path scan runs (plan over n-1 — the output layer never
+        packs) filtered to configs whose gradient-normalization /
+        constraint semantics survive a stacked leading axis."""
+        runs = self._packed_runs_cache
+        if runs is None:
+            n = len(self.layers)
+            plan = self._forward_plan(params, max(n - 1, 0))
+            rwt = [([str(i) for i in range(seg[1], seg[2])],
+                    self.layers[seg[1]])
+                   for seg in plan if seg[0] == "scan"]
+            runs = scan_stack.packable_runs(self.conf, rwt)
+            self._packed_runs_cache = runs
+        return runs
+
     def _apply_updates(self, params, grads, upd_state, step):
         new_params, new_upd = {}, {}
         for lk, lgrads in grads.items():
-            layer = self.layers[int(lk)]
+            if scan_stack.is_run_key(lk):
+                # stacked run entry: the shared updater is elementwise,
+                # so one application covers the whole run (packable_runs
+                # guarantees no per-layer constraints on these layers)
+                layer = self.layers[int(scan_stack.run_members(lk)[0])]
+            else:
+                layer = self.layers[int(lk)]
             updater = layer.updater or Sgd(1e-3)
             lp, lu = {}, {}
             for pk, g in lgrads.items():
                 delta, new_s = updater.apply(g, upd_state[lk][pk], step)
                 lp[pk] = params[lk][pk] - delta.astype(params[lk][pk].dtype)
                 lu[pk] = new_s
-            new_params[lk] = layer.apply_constraints(lp)
+            new_params[lk] = (lp if scan_stack.is_run_key(lk)
+                              else layer.apply_constraints(lp))
             new_upd[lk] = lu
         if self.conf.max_norm is not None:
             new_params = apply_max_norm_constraint(new_params, self.conf.max_norm)
@@ -270,6 +368,17 @@ class MultiLayerNetwork:
         gn_t = self.conf.gradient_normalization_threshold
 
         def step_fn(params, upd_state, state, it, x, y, rng, fmask, lmask, carries=None):
+            # boundary packing (nn/scan_stack.py): homogeneous runs ride
+            # the whole step as ONE stacked entry — forward scan,
+            # backward, and updater all stay depth-independent. The
+            # TBPTT step threads carries through the unrolled path and
+            # keeps the per-layer tree.
+            runs = ([] if tbptt or not scan_stack.scan_enabled(self.conf)
+                    else self._packed_runs(params))
+            if runs:
+                params = scan_stack.pack_tree(params, runs)
+                upd_state = scan_stack.pack_tree(upd_state, runs)
+
             def lf(p):
                 if tbptt and carries is not None:
                     stopped = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
@@ -282,6 +391,9 @@ class MultiLayerNetwork:
                 lf, has_aux=True)(params)
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd_state, it)
+            if runs:
+                new_params = scan_stack.unpack_tree(new_params, runs)
+                new_upd = scan_stack.unpack_tree(new_upd, runs)
             return new_params, new_upd, new_state, loss, new_carries
 
         return jax.jit(step_fn, donate_argnums=_donate(0, 1, 2))
@@ -316,9 +428,19 @@ class MultiLayerNetwork:
             return (new_params, new_upd, state, it + 1), loss
 
         def multi(params, upd, state, it0, xs, ys, rngs):
+            # homogeneous runs ride the k-step scan carry as stacked
+            # entries — packed/unpacked once per PROGRAM, not per step
+            runs = (self._packed_runs(params)
+                    if scan_stack.scan_enabled(self.conf) else [])
+            if runs:
+                params = scan_stack.pack_tree(params, runs)
+                upd = scan_stack.pack_tree(upd, runs)
             (params, upd, state, _), losses = jax.lax.scan(
                 one, (params, upd, state, jnp.asarray(it0, jnp.int32)),
                 (xs, ys, rngs))
+            if runs:
+                params = scan_stack.unpack_tree(params, runs)
+                upd = scan_stack.unpack_tree(upd, runs)
             return params, upd, state, losses
 
         return multi

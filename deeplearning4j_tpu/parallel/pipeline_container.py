@@ -121,6 +121,9 @@ class PipelineParallelTrainer:
                 "gradients")
         self.data_axis = data_axis
         self.microbatches = int(microbatches)
+        if self.microbatches < 1:
+            raise ValueError(
+                f"microbatches must be >= 1; got {microbatches}")
         S = int(mesh.shape[pipe_axis])
         self.n_stages = S
         r0, r1 = run if run is not None else find_homogeneous_run(model)
@@ -158,6 +161,36 @@ class PipelineParallelTrainer:
         self.run = (r0, r1)
         self._step = None
 
+    # ------------------------------------------------------ batch shaping
+    def _data_shards(self) -> int:
+        return (1 if self.data_axis is None
+                else int(self.mesh.shape[self.data_axis]))
+
+    def _batch_multiple(self) -> int:
+        """Every (micro)batch reshapes to [microbatches, shard, ...] —
+        the batch must be a multiple of this."""
+        return self.microbatches * self._data_shards()
+
+    def _validate_batch(self, n: int, what: str):
+        """Eager divisibility check with a clear error — a bad shape
+        must fail HERE, not as a cryptic reshape error inside the
+        GPipe schedule (and a ragged tail must never silently train on
+        a misaligned microbatch grid)."""
+        M, shards = self.microbatches, self._data_shards()
+        if n % M:
+            raise ValueError(
+                f"{what} of {n} examples does not divide into "
+                f"microbatches={M}; choose a batch size that is a "
+                f"multiple of {self._batch_multiple()} (microbatches x "
+                f"mesh['{self.data_axis}']), or drop the ragged tail")
+        if (n // M) % shards:
+            raise ValueError(
+                f"{what} of {n} examples: per-microbatch size "
+                f"{n // M} does not divide over the {shards}-way "
+                f"'{self.data_axis}' mesh axis; choose a batch size "
+                f"that is a multiple of {self._batch_multiple()} "
+                f"(microbatches x mesh['{self.data_axis}'])")
+
     # ------------------------------------------------------------ loss
     def _pp_loss(self, params, state, x, y, rng):
         """Mirrors `MultiLayerNetwork._loss_fn` with the homogeneous
@@ -182,12 +215,19 @@ class PipelineParallelTrainer:
         def stage_fn(stage_params, h):
             # stage_params leaves [per, ...]: apply this stage's `per`
             # blocks sequentially via scan (rng=None — the constructor
-            # rejects stochastic layers inside the run)
+            # rejects stochastic layers inside the run); the template's
+            # remat_policy wraps the block body exactly like the
+            # sequential container's scan path (nn/scan_stack.py)
+            from deeplearning4j_tpu.nn import scan_stack
+
             def body(hh, p_one):
                 hh, _ = template.forward(p_one, {}, hh, train=True,
                                          rng=None)
                 return hh, None
 
+            body = scan_stack.remat_wrap(
+                body, scan_stack.effective_remat_policy(template),
+                prevent_cse=False)
             h_out, _ = jax.lax.scan(body, h, stage_params)
             return h_out
 
@@ -197,14 +237,24 @@ class PipelineParallelTrainer:
                              data_axis=self.data_axis)
 
         # epilog [r1, n): remaining hidden layers + output loss — the
-        # same tail structure as `MultiLayerNetwork._loss_fn`
+        # same tail structure as `MultiLayerNetwork._loss_fn`, incl.
+        # weight noise (the prolog gets it via `_forward_core`; without
+        # it here an epilog DropConnect layer would silently train
+        # different math than `model.fit`)
+        from deeplearning4j_tpu.nn import scan_stack
         for i in range(r1, n - 1):
             layer = model.layers[i]
             if i in model.conf.input_preprocessors:
                 h = model.conf.input_preprocessors[i].pre_process(h, None)
             lrng = None if rng is None else jax.random.fold_in(rng, i)
-            h, st = layer.forward(params.get(str(i), {}), state.get(str(i), {}),
-                                  h, train=True, rng=lrng)
+            lparams = layer.apply_weight_noise(
+                params.get(str(i), {}), True,
+                None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
+            # layer_forward applies the layer's remat_policy (the
+            # containers own remat now — layers no longer self-wrap)
+            h, st = scan_stack.layer_forward(
+                layer, lparams, state.get(str(i), {}), h, train=True,
+                rng=lrng)
             if st:
                 new_state[str(i)] = st
         if (n - 1) in model.conf.input_preprocessors:
@@ -213,7 +263,10 @@ class PipelineParallelTrainer:
         si = str(n - 1)
         lrng = None if rng is None else jax.random.fold_in(rng, n - 1)
         y = model.dtype.cast_compute(jnp.asarray(y))
-        loss = out_layer.compute_loss(params.get(si, {}), state.get(si, {}),
+        out_params = out_layer.apply_weight_noise(
+            params.get(si, {}), True,
+            None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
+        loss = out_layer.compute_loss(out_params, state.get(si, {}),
                                       h, y, train=True, rng=lrng)
         reg = 0.0
         for i, layer in enumerate(model.layers):
@@ -289,7 +342,11 @@ class PipelineParallelTrainer:
             self._eval_forward = jax.jit(fwd)
         iterator = as_iterator(data, labels, batch_size=batch_size)
         ev = evaluation if evaluation is not None else Evaluation()
-        M = self.microbatches
+        # tails pad to the FULL microbatch grid — microbatches x the
+        # data-axis shard count: padding only to `microbatches` would
+        # leave a per-microbatch size that doesn't divide over the
+        # data mesh axis and fail (or mis-shard) inside the schedule
+        M = self._batch_multiple()
         for ds in iterator:
             x = np.asarray(ds.features)
             n_real = x.shape[0]
@@ -304,6 +361,9 @@ class PipelineParallelTrainer:
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 32):
         model = self.model
+        # eager divisibility validation (the requested batch size AND
+        # every actual batch — iterators can yield ragged tails)
+        self._validate_batch(int(batch_size), "batch_size")
         if self._step is None:
             self._build()
         from deeplearning4j_tpu import monitor
@@ -318,6 +378,7 @@ class PipelineParallelTrainer:
             for ds in iterator:
                 if ds.features_mask is not None or ds.labels_mask is not None:
                     raise ValueError("masks are not supported under PP")
+                self._validate_batch(ds.num_examples(), "fit batch")
                 rng = jax.random.fold_in(rng_root, model.iteration_count)
                 t0 = time.perf_counter() if self.stats is not None else 0.0
                 params, upd, new_state, loss = self._step(

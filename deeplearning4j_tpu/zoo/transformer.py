@@ -33,8 +33,8 @@ class TransformerClassifier(ZooModel):
                  d_model: int = 128, n_layers: int = 2, n_heads: int = 8,
                  ff_multiplier: int = 4, max_len: int = 512,
                  dropout: float = None, pooling: PoolingType = PoolingType.AVG,
-                 remat: bool = False, sequence_parallel: str = None,
-                 seed: int = 123):
+                 remat: bool = False, remat_policy: str = None,
+                 sequence_parallel: str = None, seed: int = 123):
         super().__init__(num_classes=num_classes, seed=seed)
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -45,6 +45,7 @@ class TransformerClassifier(ZooModel):
         self.dropout = dropout
         self.pooling = pooling
         self.remat = remat
+        self.remat_policy = remat_policy
         self.sequence_parallel = sequence_parallel
 
     def conf(self):
@@ -54,10 +55,13 @@ class TransformerClassifier(ZooModel):
              .list()
              .layer(EmbeddingLayer(n_in=self.vocab_size, n_out=self.d_model))
              .layer(PositionalEncodingLayer(max_len=self.max_len)))
+        # the block run scans by default (scan-over-layers — identical
+        # blocks roll into one lax.scan; nn/scan_stack.py)
         for _ in range(self.n_layers):
             b.layer(TransformerEncoderBlock(
                 n_heads=self.n_heads, ff_multiplier=self.ff_multiplier,
                 dropout=self.dropout, remat=self.remat,
+                remat_policy=self.remat_policy,
                 sequence_parallel=self.sequence_parallel))
         b.layer(GlobalPoolingLayer(pooling_type=self.pooling))
         b.layer(OutputLayer(n_out=self.num_classes, activation="softmax",
@@ -73,8 +77,8 @@ class TransformerLM(ZooModel):
     def __init__(self, vocab_size: int, *, d_model: int = 128,
                  n_layers: int = 2, n_heads: int = 8,
                  ff_multiplier: int = 4, max_len: int = 512,
-                 remat: bool = False, sequence_parallel: str = None,
-                 seed: int = 123):
+                 remat: bool = False, remat_policy: str = None,
+                 sequence_parallel: str = None, seed: int = 123):
         super().__init__(num_classes=vocab_size, seed=seed)
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -83,6 +87,7 @@ class TransformerLM(ZooModel):
         self.ff_multiplier = ff_multiplier
         self.max_len = max_len
         self.remat = remat
+        self.remat_policy = remat_policy
         self.sequence_parallel = sequence_parallel
 
     def conf(self):
@@ -92,10 +97,13 @@ class TransformerLM(ZooModel):
              .list()
              .layer(EmbeddingLayer(n_in=self.vocab_size, n_out=self.d_model))
              .layer(PositionalEncodingLayer(max_len=self.max_len)))
+        # identical causal blocks — the containers roll this run into
+        # one lax.scan by default (scan-over-layers, nn/scan_stack.py)
         for _ in range(self.n_layers):
             b.layer(TransformerEncoderBlock(
                 n_heads=self.n_heads, ff_multiplier=self.ff_multiplier,
-                causal=True, remat=self.remat, cache_len=self.max_len,
+                causal=True, remat=self.remat,
+                remat_policy=self.remat_policy, cache_len=self.max_len,
                 sequence_parallel=self.sequence_parallel))
         b.layer(RnnOutputLayer(n_out=self.vocab_size, activation="softmax",
                                loss="mcxent"))

@@ -14,11 +14,15 @@
 #    fit() and assert the Prometheus exposition parses and contains
 #    training counters (the telemetry core's acceptance surface —
 #    docs/OBSERVABILITY.md).
+# 4. AOT cost smoke: `hlo_cost --all` (reduced batch, scratch dir) must
+#    produce every report with the program section's compile_seconds +
+#    peak-memory fields — the scan-over-layers/remat observability
+#    surface (docs/COMPILE.md). CPU-forced; a dead tunnel can't hang it.
 
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== [1/3] tier-1 tests (ROADMAP.md verbatim) =="
+echo "== [1/4] tier-1 tests (ROADMAP.md verbatim) =="
 # stale-report guard: a timeout-killed suite never reaches
 # pytest_sessionfinish, and step [2/3] must not read the previous
 # run's durations as this run's
@@ -26,7 +30,7 @@ rm -f "${DL4J_SUITE_DURATIONS:-/tmp/_t1_durations.json}"
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
 tier1_rc=$?
 
-echo "== [2/3] suite duration budget =="
+echo "== [2/4] suite duration budget =="
 python - <<'EOF'
 import json
 import os
@@ -53,7 +57,7 @@ if total > soft:
           "mark 'slow' the top offenders above before adding tests.")
 EOF
 
-echo "== [3/3] /metrics smoke =="
+echo "== [3/4] /metrics smoke =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import sys
 import urllib.request
@@ -95,8 +99,42 @@ print(f"/metrics smoke OK ({len(body.splitlines())} exposition lines, "
 EOF
 smoke_rc=$?
 
-echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc}"
-if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ]; then
+echo "== [4/4] AOT cost smoke (hlo_cost --all) =="
+hlo_out=$(mktemp -d)
+timeout -k 10 840 env JAX_PLATFORMS=cpu \
+    python -m benchtools.hlo_cost --all --batch 8 --steps 2 --out "$hlo_out"
+hlo_run_rc=$?
+JAX_PLATFORMS=cpu HLO_SMOKE_OUT="$hlo_out" python - <<'EOF'
+import glob
+import json
+import os
+
+out = os.environ["HLO_SMOKE_OUT"]
+paths = sorted(glob.glob(os.path.join(out, "cost_*.json")))
+assert len(paths) >= 4, f"expected 4 headline reports, got {paths}"
+for p in paths:
+    with open(p) as f:
+        rep = json.load(f)
+    prog = rep.get("program") or {}
+    missing = [k for k in ("compile_seconds", "peak_temp_bytes",
+                           "temp_size_in_bytes", "jaxpr_eqn_count")
+               if not prog.get(k)]
+    assert not missing, f"{p}: program section missing {missing}"
+svu = json.load(open(os.path.join(out, "cost_transformer.json")))
+assert svu["scan_vs_unrolled"]["eqn_reduction"] >= 3.0, \
+    svu["scan_vs_unrolled"]
+assert svu["remat_compare"]["full"]["temp_reduction"] > 1.0, \
+    svu["remat_compare"]
+print("AOT cost smoke OK "
+      f"(eqn_reduction={svu['scan_vs_unrolled']['eqn_reduction']}x, "
+      f"remat full temp_reduction="
+      f"{svu['remat_compare']['full']['temp_reduction']}x)")
+EOF
+hlo_rc=$?
+rm -rf "$hlo_out"
+
+echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc}"
+if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ]; then
     exit 1
 fi
 echo "VERIFY OK"

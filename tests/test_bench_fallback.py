@@ -43,6 +43,42 @@ def test_emit_failure_falls_back_to_lastgood(lastgood, capsys):
     assert out["vs_baseline"] > 0
 
 
+def test_emit_failure_nonconnectivity_never_echoes_lastgood(lastgood, capsys):
+    """An in-bench crash is a regression signal: even with a committed
+    chip measurement available it must emit the explicit error/zero
+    shape — a genuine regression must not surface as 2425 img/s with a
+    `stale` flag (ADVICE r5)."""
+    lastgood.write_text(json.dumps(_fake_result(value=2425.14)))
+    bench._emit_failure("primary bench failed: ValueError: shapes differ",
+                        attempts=0, connectivity=False)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 0.0
+    assert "stale" not in out
+    assert "shapes differ" in out["error"]
+
+
+def test_connectivity_classifier():
+    assert bench._is_connectivity_error(ConnectionError("reset"))
+    assert bench._is_connectivity_error(TimeoutError())
+    assert bench._is_connectivity_error(
+        RuntimeError("accelerator tunnel unreachable after 4 probes"))
+    assert bench._is_connectivity_error(
+        RuntimeError("DEADLINE_EXCEEDED: grpc channel"))
+    assert not bench._is_connectivity_error(ValueError("shapes differ"))
+    assert not bench._is_connectivity_error(KeyError("extras"))
+
+
+def test_emit_failure_connectivity_still_echoes_lastgood(lastgood, capsys):
+    lastgood.write_text(json.dumps(_fake_result()))
+    bench._emit_failure("mid-run tunnel drop: connection reset",
+                        attempts=1,
+                        connectivity=bench._is_connectivity_error(
+                            ConnectionError("connection reset")))
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 1234.5
+    assert out["stale"] is True
+
+
 def test_emit_failure_without_lastgood_is_explicit_zero(lastgood, capsys):
     assert not lastgood.exists()
     bench._emit_failure("no tunnel", attempts=2)

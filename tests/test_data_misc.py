@@ -291,6 +291,70 @@ class TestNormalizers:
         np.testing.assert_allclose(z.max(0), 1.0, atol=1e-5)
         np.testing.assert_allclose(norm.revert(z), full, atol=1e-3)
 
+    def test_standardize_honors_features_mask_on_padded_corpus(self):
+        """Padded timesteps must not enter the statistics — matching
+        ND4J's masked-aware NormalizerStandardize: stats fit on a
+        padded corpus (with features_mask) equal stats fit on the
+        unpadded sequences (ADVICE r5)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        rng = np.random.default_rng(7)
+        B, T, F = 4, 10, 3
+        batches, real_rows = [], []
+        for _ in range(3):
+            x = np.zeros((B, T, F), np.float32)
+            mask = np.zeros((B, T), np.float32)
+            for i in range(B):
+                L = int(rng.integers(3, T + 1))
+                seq = rng.normal(5.0, 2.0, (L, F)).astype(np.float32)
+                x[i, :L] = seq
+                mask[i, :L] = 1.0
+                real_rows.append(seq)
+                # poison the padding: masked stats must not see it
+                x[i, L:] = 1e6
+            batches.append(DataSet(x, features_mask=mask))
+        real = np.concatenate(real_rows)
+        norm = NormalizerStandardize().fit(batches)
+        np.testing.assert_allclose(norm.mean, real.mean(0), rtol=1e-6)
+        np.testing.assert_allclose(norm.std, real.std(0), rtol=1e-5)
+
+    def test_minmax_honors_features_mask_on_padded_corpus(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerMinMaxScaler)
+        rng = np.random.default_rng(8)
+        x = rng.uniform(-1.0, 1.0, (3, 6, 2)).astype(np.float32)
+        mask = np.ones((3, 6), np.float32)
+        mask[:, 4:] = 0.0
+        x[:, 4:] = 99.0   # padding outside the real range
+        norm = NormalizerMinMaxScaler().fit(DataSet(x, features_mask=mask))
+        np.testing.assert_allclose(norm.data_max, x[:, :4].reshape(-1, 2).max(0))
+        np.testing.assert_allclose(norm.data_min, x[:, :4].reshape(-1, 2).min(0))
+
+    def test_fully_masked_corpus_fails_loudly(self):
+        """An all-zero mask (upstream filtering bug) must raise at
+        fit(), not produce NaN stats that poison every transform."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerMinMaxScaler,
+            NormalizerStandardize,
+        )
+        x = np.ones((2, 4, 3), np.float32)
+        mask = np.zeros((2, 4), np.float32)
+        for cls in (NormalizerStandardize, NormalizerMinMaxScaler):
+            with pytest.raises(ValueError, match="unmasked"):
+                cls().fit(DataSet(x, features_mask=mask))
+
+    def test_unmasked_fit_unchanged(self):
+        """No mask → identical statistics to the seed behavior."""
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        batches = self._batches()
+        full = np.concatenate([b.features for b in batches])
+        norm = NormalizerStandardize().fit(batches)
+        np.testing.assert_allclose(norm.mean, full.mean(0), rtol=1e-6)
+
     def test_image_scaler_stateless(self):
         from deeplearning4j_tpu.datasets.normalizers import (
             ImagePreProcessingScaler)

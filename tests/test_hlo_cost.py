@@ -220,6 +220,51 @@ class TestAnalyze:
         assert rep["roofline"]["bound"] in ("compute", "memory")
         assert rep["roofline"]["peak_tflops"] > 0
         assert "peak_source" in rep["roofline"]
+        # program section (scan-over-layers observability): equation
+        # count, compile seconds, peak-memory — the verify.sh smoke
+        # fails on these fields missing
+        prog = on_disk["program"]
+        assert prog["jaxpr_eqn_count"] > 0
+        assert prog["compile_seconds"] > 0
+        assert prog["peak_temp_bytes"] > 0
+        assert prog["xla_compiles"] >= 1
+        assert prog["scan_layers"] is True
+
+    def test_no_program_flag_skips_compile(self, tmp_path):
+        rep = hlo_cost.analyze("mlp", program=False)
+        assert "program" not in rep
+
+    def test_deep_compare_blocks(self, monkeypatch):
+        """scan_vs_unrolled + remat_compare on a tiny stand-in config
+        (the committed artifact uses the real >=12-block one)."""
+        monkeypatch.setattr(
+            hlo_cost, "_DEEP_LM",
+            dict(n_layers=3, d_model=16, n_heads=2, seq_len=16,
+                 vocab=32, batch=4, steps=1))
+        svu = hlo_cost.scan_vs_unrolled()
+        assert svu["scan"]["jaxpr_eqn_count"] \
+            < svu["unrolled"]["jaxpr_eqn_count"]
+        assert svu["eqn_reduction"] > 1.0
+        assert svu["scan"]["compile_seconds"] > 0
+        rc = hlo_cost.remat_compare()
+        assert rc["none"]["peak_temp_bytes"] > 0
+        assert rc["full"]["peak_temp_bytes"] > 0
+        assert "temp_reduction" in rc["full"]
+
+    def test_count_jaxpr_eqns_counts_nested_once(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            def body(c, _):
+                return c * 2.0 + 1.0, None
+            out, _ = jax.lax.scan(body, x, None, length=8)
+            return out
+
+        closed = jax.make_jaxpr(f)(jnp.ones(()))
+        n = hlo_cost.count_jaxpr_eqns(closed)
+        # scan body counted once, NOT multiplied by the trip count
+        assert 2 <= n < 10
 
     def test_publish_sets_gauges_and_store(self):
         reg = MetricsRegistry()
@@ -231,11 +276,17 @@ class TestAnalyze:
                       "roofline": {
                           "arithmetic_intensity_flop_per_byte": 0.27,
                           "predicted_step_seconds": 0.5},
-                      "predicted": {"mfu": 0.25}}
+                      "predicted": {"mfu": 0.25},
+                      "program": {"compile_seconds": 1.5,
+                                  "jaxpr_eqn_count": 870,
+                                  "peak_temp_bytes": 4096.0}}
             xprof.publish_cost_report(report, registry=reg)
             expo = reg.exposition()
             assert 'aot_cost_flops_per_step{model="fake"} 123.0' in expo
             assert 'aot_cost_predicted_mfu{model="fake"} 0.25' in expo
+            assert 'aot_compile_seconds{model="fake"} 1.5' in expo
+            assert 'aot_compile_jaxpr_eqns{model="fake"} 870' in expo
+            assert 'aot_compile_peak_temp_bytes{model="fake"} 4096.0' in expo
             assert xprof.cost_reports()["fake"] is report
         finally:
             xprof.clear_cost_reports()

@@ -815,6 +815,68 @@ class TestPipelineContainer:
         r0, r1 = find_homogeneous_run(net)
         assert (r1 - r0) < 4        # the modified block broke the run
 
+    @requires_8dev
+    def test_pp_fit_validates_batch_divisibility_eagerly(self):
+        """(batch // microbatches) must divide over the data mesh axis
+        — checked eagerly in fit() with a clear error, not as a cryptic
+        reshape failure inside the GPipe schedule (ADVICE r5)."""
+        from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+        from jax.sharding import Mesh
+
+        net = self._lm()
+        ids, y = self._data(B=8)
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("data", "pipe"))
+        tr = PipelineParallelTrainer(net, mesh, data_axis="data",
+                                     microbatches=4)
+        # batch 8 / 4 microbatches = 2 per micro — divides the 2-way
+        # data axis; batch 6 does not divide microbatches at all
+        with pytest.raises(ValueError, match="microbatches"):
+            tr.fit(ids, y, batch_size=6)
+        # per-microbatch size 1 does not divide the 2-way data axis
+        with pytest.raises(ValueError, match="mesh"):
+            tr.fit(ids[:4], y[:4], batch_size=4)
+
+    @requires_8dev
+    def test_pp_fit_rejects_ragged_tail_with_clear_error(self):
+        from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+        net = self._lm()
+        ids, y = self._data(B=10)   # 10 = 8 + ragged tail of 2
+        mesh = make_mesh(MeshSpec.of(pipe=4))
+        tr = PipelineParallelTrainer(net, mesh, microbatches=4)
+        with pytest.raises(ValueError, match="ragged tail|microbatches"):
+            tr.fit(ids, y, batch_size=8)
+
+    @requires_8dev
+    def test_pp_rejects_nonpositive_microbatches(self):
+        from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+        net = self._lm()
+        with pytest.raises(ValueError, match="microbatches"):
+            PipelineParallelTrainer(net, make_mesh(MeshSpec.of(pipe=4)),
+                                    microbatches=0)
+
+    @requires_8dev
+    def test_pp_weight_noise_in_epilog_matches_sequential(self):
+        """Weight noise on an epilog/output layer must produce the SAME
+        loss as `model.fit`'s `_loss_fn` (same per-layer rng folds) —
+        no silent math divergence (ADVICE r5)."""
+        from deeplearning4j_tpu.nn.conf.weightnoise import DropConnect
+        from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+
+        net = self._lm()
+        # output layer (epilog) gets DropConnect; the run stays clean
+        net.layers[-1].weight_noise = DropConnect(0.8)
+        ids, y = self._data()
+        mesh = make_mesh(MeshSpec.of(pipe=4))
+        tr = PipelineParallelTrainer(net, mesh, microbatches=4)
+        rng = jax.random.PRNGKey(7)
+        l_pp, _ = tr._pp_loss(net.params, net.net_state,
+                              jnp.asarray(ids), jnp.asarray(y), rng)
+        l_ref, _ = net._loss_fn(net.params, net.net_state,
+                                jnp.asarray(ids), jnp.asarray(y),
+                                rng, None, None, train=True)
+        np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-6)
+
 
 class TestFSDP:
     """ZeRO-3/FSDP as a sharding spec (fsdp_param_specs): large params
@@ -889,6 +951,36 @@ def test_pp_evaluate_matches_host():
     mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
     ev = PipelineParallelTrainer(net, mesh, microbatches=4).evaluate(
         ids, y, batch_size=10)
+    host = Evaluation()
+    host.eval(y, np.asarray(net.output(ids)))
+    assert ev.total == host.total == 80
+    np.testing.assert_allclose(ev.accuracy(), host.accuracy())
+
+
+@requires_8dev
+def test_pp_evaluate_pads_tail_to_data_axis_multiple():
+    """Under DP x PP the ragged tail must pad to microbatches x
+    mesh['data'] — padding only to `microbatches` would leave a
+    per-microbatch size that can't shard over the data axis
+    (ADVICE r5)."""
+    from deeplearning4j_tpu.eval import Evaluation
+    from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+    from deeplearning4j_tpu.zoo.transformer import TransformerLM
+    from jax.sharding import Mesh
+
+    net = TransformerLM(vocab_size=12, d_model=16, n_layers=4,
+                        n_heads=4, max_len=8, seed=3).init()
+    rng = np.random.default_rng(0)
+    # 10 examples: multiple of M=2 but NOT of M x data(2) = 4... the
+    # tail batch (10 % 8 = 2) is ragged against the 2x2 grid
+    ids = rng.integers(0, 12, (10, 8)).astype(np.float32)
+    y = np.eye(12, dtype=np.float32)[rng.integers(0, 12, (10, 8))]
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("data", "pipe"))
+    tr = PipelineParallelTrainer(net, mesh, data_axis="data",
+                                 microbatches=2)
+    assert tr._batch_multiple() == 4
+    ev = tr.evaluate(ids, y, batch_size=8)
     host = Evaluation()
     host.eval(y, np.asarray(net.output(ids)))
     assert ev.total == host.total == 80
