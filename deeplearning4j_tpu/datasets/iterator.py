@@ -104,7 +104,14 @@ class ArrayDataSetIterator(DataSetIterator):
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch wrapper (reference
     `AsyncDataSetIterator.java`: bounded queue + worker thread so ETL
-    overlaps device compute)."""
+    overlaps device compute).
+
+    Early-abandon safe: a consumer that `break`s out (or otherwise
+    closes the generator) must not leave the worker blocked forever on
+    the bounded `q.put` — the generator's finally clause signals the
+    stop event, drains the queue so any in-flight put completes, and
+    joins the worker, so no daemon thread (or its grip on the base
+    iterator) outlives the consumer."""
 
     _SENTINEL = object()
 
@@ -114,26 +121,56 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
         err: list = []
 
         def worker():
             try:
                 for ds in self.base:
-                    q.put(ds)
+                    # bounded put with a stop check: a full queue whose
+                    # consumer has gone away must not block forever
+                    while not stop.is_set():
+                        try:
+                            q.put(ds, timeout=0.05)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             except BaseException as e:  # propagate into consumer
                 err.append(e)
             finally:
-                q.put(self._SENTINEL)
+                # the sentinel must REACH a live consumer (it blocks in
+                # q.get until one arrives) but must not block forever
+                # for an abandoned one — same stop-aware bounded put
+                while not stop.is_set():
+                    try:
+                        q.put(self._SENTINEL, timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is self._SENTINEL:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is self._SENTINEL:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # GeneratorExit (consumer break/close) and normal exhaustion
+            # both land here: stop the worker, unblock any pending put,
+            # and reap the thread
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5)
 
     def reset(self):
         self.base.reset()

@@ -101,6 +101,13 @@ _GAUGE_FIELDS = (
     ("aot_compile_peak_temp_bytes", ("program", "peak_temp_bytes")),
     ("aot_compile_code_size_bytes",
      ("program", "generated_code_size_in_bytes")),
+    # gradient-exchange payload (threshold-encoded gradient sharing —
+    # parallel/gradient_sharing.py wire format vs dense fp32)
+    ("aot_comm_bytes_dense", ("program", "comm_bytes",
+                              "dense_bytes_per_step")),
+    ("aot_comm_bytes_threshold", ("program", "comm_bytes",
+                                  "threshold_bytes_per_step")),
+    ("aot_comm_bytes_reduction", ("program", "comm_bytes", "reduction")),
 )
 
 

@@ -110,6 +110,14 @@ class MultiLayerConfiguration:
     # the unrolled loop; disable for A/B or debugging (also via the
     # DL4J_SCAN_LAYERS=0 env override).
     scan_layers: bool = True
+    # gradient exchange mode for the distributed sync trainers
+    # (parallel/gradient_sharing.py): "dense" fp32 all-reduce, or
+    # "threshold" error-feedback sign-magnitude encoding (the reference
+    # SharedTrainingMaster wire format; DL4J_GRADIENT_SHARING env
+    # overrides). `gradient_sharing_threshold` is the initial adaptive
+    # τ (reference threshold default 1e-3).
+    gradient_sharing: str = "dense"
+    gradient_sharing_threshold: float = 1e-3
 
     def to_dict(self):
         return {
@@ -129,6 +137,8 @@ class MultiLayerConfiguration:
             "optimization_algo": self.optimization_algo,
             "max_iterations": self.max_iterations,
             "scan_layers": self.scan_layers,
+            "gradient_sharing": self.gradient_sharing,
+            "gradient_sharing_threshold": self.gradient_sharing_threshold,
         }
 
     def to_json(self, **kw):
@@ -154,6 +164,9 @@ class MultiLayerConfiguration:
             optimization_algo=d.get("optimization_algo", "sgd"),
             max_iterations=d.get("max_iterations", 5),
             scan_layers=d.get("scan_layers", True),
+            gradient_sharing=d.get("gradient_sharing", "dense"),
+            gradient_sharing_threshold=d.get("gradient_sharing_threshold",
+                                             1e-3),
         )
 
     @staticmethod
@@ -236,6 +249,8 @@ class ListBuilder:
         self._tbptt_back = 20
         self._pretrain = False
         self._scan_layers = True
+        self._gradient_sharing = "dense"
+        self._gradient_sharing_threshold = 1e-3
 
     def layer(self, layer_or_idx, maybe_layer=None) -> "ListBuilder":
         layer = maybe_layer if maybe_layer is not None else layer_or_idx
@@ -267,6 +282,21 @@ class ListBuilder:
         """Enable/disable scan-over-layers compilation of homogeneous
         layer runs (default on; see nn/scan_stack.py)."""
         self._scan_layers = bool(flag)
+        return self
+
+    def gradient_sharing(self, mode: str,
+                         threshold: Optional[float] = None) -> "ListBuilder":
+        """Gradient exchange mode for the distributed sync trainers:
+        "dense" (default) or "threshold" (error-feedback compressed
+        collectives — parallel/gradient_sharing.py). `threshold` sets
+        the initial adaptive τ (reference SharedTrainingMaster
+        threshold, default 1e-3)."""
+        if mode not in ("dense", "threshold"):
+            raise ValueError(
+                f"gradient_sharing must be dense|threshold, got {mode!r}")
+        self._gradient_sharing = mode
+        if threshold is not None:
+            self._gradient_sharing_threshold = float(threshold)
         return self
 
     def build(self) -> MultiLayerConfiguration:
@@ -312,6 +342,8 @@ class ListBuilder:
             optimization_algo=g.optimization_algo_value,
             max_iterations=g.max_iterations_value,
             scan_layers=self._scan_layers,
+            gradient_sharing=self._gradient_sharing,
+            gradient_sharing_threshold=self._gradient_sharing_threshold,
         )
 
 

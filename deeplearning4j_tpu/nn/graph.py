@@ -77,6 +77,10 @@ class ComputationGraphConfiguration:
         self.optimization_algo: str = "sgd"
         self.max_iterations: int = 5
         self.scan_layers: bool = True  # roll homogeneous chains into lax.scan
+        # gradient exchange mode for the distributed sync trainers
+        # (parallel/gradient_sharing.py; DL4J_GRADIENT_SHARING overrides)
+        self.gradient_sharing: str = "dense"
+        self.gradient_sharing_threshold: float = 1e-3
         self.topo_order: List[str] = []
 
     # ------------------------------------------------------------- builder
@@ -128,6 +132,8 @@ class ComputationGraphConfiguration:
             "optimization_algo": self.optimization_algo,
             "max_iterations": self.max_iterations,
             "scan_layers": self.scan_layers,
+            "gradient_sharing": self.gradient_sharing,
+            "gradient_sharing_threshold": self.gradient_sharing_threshold,
             "input_types": {k: v.to_dict() for k, v in self.input_types.items()},
             "nodes": [
                 {
@@ -163,6 +169,9 @@ class ComputationGraphConfiguration:
         conf.optimization_algo = d.get("optimization_algo", "sgd")
         conf.max_iterations = d.get("max_iterations", 5)
         conf.scan_layers = d.get("scan_layers", True)
+        conf.gradient_sharing = d.get("gradient_sharing", "dense")
+        conf.gradient_sharing_threshold = d.get("gradient_sharing_threshold",
+                                                1e-3)
         conf.input_types = {k: InputType.from_dict(v)
                             for k, v in d.get("input_types", {}).items()}
         for nd in d["nodes"]:
@@ -225,6 +234,18 @@ class GraphBuilder:
         """Enable/disable scan-over-layers compilation of homogeneous
         layer chains (default on; see nn/scan_stack.py)."""
         self._conf.scan_layers = bool(flag)
+        return self
+
+    def gradient_sharing(self, mode: str, threshold=None) -> "GraphBuilder":
+        """Gradient exchange mode for the distributed sync trainers:
+        "dense" (default) or "threshold" (error-feedback compressed
+        collectives — parallel/gradient_sharing.py)."""
+        if mode not in ("dense", "threshold"):
+            raise ValueError(
+                f"gradient_sharing must be dense|threshold, got {mode!r}")
+        self._conf.gradient_sharing = mode
+        if threshold is not None:
+            self._conf.gradient_sharing_threshold = float(threshold)
         return self
 
     def build(self) -> ComputationGraphConfiguration:

@@ -8,15 +8,19 @@
   aggregation) → local-SGD mode: k per-replica steps under `shard_map`,
   then parameter `pmean` (the `averaging_frequency` knob survives).
 - `SharedTrainingMaster` + Aeron parameter server (async threshold-
-  compressed updates over UDP) → unnecessary on ICI: synchronous
-  `psum` at ~TB/s replaces compressed gossip designed for 10GbE; the
-  cadence knob is kept for DCN-spanning topologies.
+  compressed updates over UDP) → on ICI the synchronous `psum` at
+  ~TB/s replaces compressed gossip outright; for DCN-spanning /
+  bandwidth-bound topologies the reference's threshold encoding
+  survives as `gradient_sharing="threshold"` — error-feedback int8
+  compressed collectives with adaptive τ (gradient_sharing.py,
+  docs/COMMS.md), selectable on both sync trainers.
 
 Mesh axes are named ("data", "model", "seq", "pipe") so tensor/sequence/
 pipeline parallelism are sharding specs, not new engines.
 """
 
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh, device_mesh
+from deeplearning4j_tpu.parallel.gradient_sharing import ThresholdConfig
 from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.ulysses import (

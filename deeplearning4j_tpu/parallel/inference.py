@@ -91,6 +91,8 @@ class ParallelInference:
         coalesced into one device batch within `queue_limit_ms`
         (reference `ObservablesProvider` :84 — requests observable until
         the batch fires)."""
+        if getattr(self, "_shutdown", False):
+            raise RuntimeError("ParallelInference is shut down")
         if self._running:
             return self
         if self._fwd is None:
@@ -109,8 +111,12 @@ class ParallelInference:
             self._queue.put(None)  # wake the collector
             self._collector.join(timeout=5)
             self._collector = None
-        # drain requests that never made it into a batch: leaving their
-        # Futures unresolved would hang callers blocked in .result()
+        self._fail_pending()
+
+    def _fail_pending(self):
+        """Drain requests that never made it into a batch: leaving
+        their Futures unresolved would hang callers blocked in
+        `.result()`."""
         while True:
             try:
                 item = self._queue.get_nowait()
@@ -121,6 +127,19 @@ class ParallelInference:
                     RuntimeError("ParallelInference stopped before this "
                                  "request was executed"))
 
+    def shutdown(self):
+        """Terminal teardown: stop the collector thread, fail every
+        pending Future, and refuse further `output_async` calls. Unlike
+        `stop()` (which a later `start()` can undo), shutdown closes
+        the enqueue side FIRST, so a request racing with teardown
+        either gets the terminal error immediately or is drained and
+        failed — nothing can hang at `.result()`."""
+        self._shutdown = True
+        self.stop()
+        # a racing output_async may have enqueued between the drain and
+        # the flag becoming visible — sweep once more
+        self._fail_pending()
+
     def __enter__(self):
         return self.start()
 
@@ -130,10 +149,18 @@ class ParallelInference:
     def output_async(self, x) -> Future:
         """Enqueue one request; the Future resolves with this request's
         rows once the coalesced batch it joined has executed."""
+        if getattr(self, "_shutdown", False):
+            raise RuntimeError("ParallelInference is shut down")
         if not self._running:
             raise RuntimeError("call start() before output_async()")
         fut: Future = Future()
         self._queue.put((np.asarray(x), fut))
+        # enqueue/teardown race: shutdown() may have completed between
+        # the flag check and the put — no collector will ever drain this
+        # request, so fail it ourselves (the queue is the sync point; a
+        # request the collector DID take resolves normally)
+        if getattr(self, "_shutdown", False):
+            self._fail_pending()
         return fut
 
     def _collect_loop(self):

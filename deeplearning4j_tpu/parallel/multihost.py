@@ -17,6 +17,31 @@ from typing import Optional
 import jax
 
 
+def _enable_cpu_collectives() -> None:
+    """The CPU backend has no built-in cross-process collectives ("
+    Multiprocess computations aren't implemented on the CPU backend") —
+    they only exist behind the gloo/mpi plugin selected by
+    `jax_cpu_collectives_implementation`, whose default is "none".
+    Select gloo when the process targets CPU and nothing was chosen
+    explicitly, so the same multi-host programs run on CPU clusters
+    (and in the 2-process CI smoke) without per-caller setup."""
+    import jax._src.xla_bridge as xb
+
+    if "cpu" not in str(os.environ.get("JAX_PLATFORMS",
+                                       jax.config.jax_platforms or "cpu")):
+        return
+    try:
+        current = xb.CPU_COLLECTIVES_IMPLEMENTATION.value
+    except AttributeError:     # newer jax: option renamed/absorbed
+        current = None
+    if current not in (None, "none"):
+        return                 # an explicit mpi/gloo choice wins
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — jaxlib without gloo: keep going,
+        pass           # initialize() will surface the real capability
+
+
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
                          process_id: Optional[int] = None) -> None:
@@ -26,6 +51,7 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     (the reference's `controller address` `SharedTrainingMaster.java:443`)."""
     if getattr(initialize_multihost, "_done", False):
         return
+    _enable_cpu_collectives()
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address

@@ -96,6 +96,19 @@ def _run_training():
     # multi-call training under multi-process TP)
     tp_trainer.fit(x, y, epochs=1, batch_size=B)
 
+    # Threshold-encoded gradient sharing over the SAME global mesh
+    # (parallel/gradient_sharing.py): the int8 all-reduce + residual/τ
+    # shard_map program must compute the identical trajectory under 1
+    # and N processes — the multihost proof of the compressed exchange
+    # (its collectives ride the distributed runtime like the dense psum)
+    thr_model = _build_model()
+    thr_listener = CollectScoresListener()
+    thr_model.set_listeners(thr_listener)
+    ParallelTrainer(thr_model, mesh, mode="sync",
+                    gradient_sharing="threshold").fit(x, y, epochs=3,
+                                                      batch_size=B)
+    thr_losses = [s for _, s in thr_listener.scores]
+
     # Distributed-evaluation recipe (what the mesh evaluate() guard
     # tells multi-process callers to do): each process scores ITS OWN
     # data shard on the host, the evaluators travel as JSON, and the
@@ -110,7 +123,8 @@ def _run_training():
     shard = slice(int(bounds[pi]), int(bounds[pi + 1]))
     local_ev = Evaluation()
     local_ev.eval(y[shard], np.asarray(model.output(x[shard])))
-    return losses + [s for _, s in tp_listener.scores], local_ev.to_json()
+    return (losses + [s for _, s in tp_listener.scores], thr_losses,
+            local_ev.to_json())
 
 
 def _worker_main(coordinator: str, n: int, i: int):
@@ -122,8 +136,9 @@ def _worker_main(coordinator: str, n: int, i: int):
     initialize_multihost(coordinator, n, i)
     assert jax.process_count() == n, jax.process_count()
     assert len(jax.devices()) == n * _LOCAL_DEVICES, len(jax.devices())
-    losses, eval_json = _run_training()
+    losses, thr_losses, eval_json = _run_training()
     print("LOSSES " + json.dumps(losses), flush=True)
+    print("THRLOSSES " + json.dumps(thr_losses), flush=True)
     print("EVALJSON " + eval_json, flush=True)
 
 
@@ -131,8 +146,9 @@ def _single_main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    losses, eval_json = _run_training()
+    losses, thr_losses, eval_json = _run_training()
     print("LOSSES " + json.dumps(losses), flush=True)
+    print("THRLOSSES " + json.dumps(thr_losses), flush=True)
     print("EVALJSON " + eval_json, flush=True)
 
 
@@ -189,18 +205,21 @@ def run_smoke(n: int = 2, timeout: int = 420) -> dict:
         single = _spawn(["--single"], n * _LOCAL_DEVICES)
         procs.append(single)
 
-        results, worker_evals = [], []
+        results, thr_results, worker_evals = [], [], []
         for w in workers:
             out, err = w.communicate(timeout=timeout)
             if w.returncode != 0:
                 raise RuntimeError(
                     f"worker failed rc={w.returncode}: {err[-800:]}")
             results.append(_parse_losses(out))
+            thr_results.append(json.loads(_parse_tag(out, "THRLOSSES")
+                                          or "null"))
             worker_evals.append(_parse_eval(out))
         sout, serr = single.communicate(timeout=timeout)
         if single.returncode != 0:
             raise RuntimeError(f"single-proc run failed: {serr[-800:]}")
         ref = _parse_losses(sout)
+        thr_ref = json.loads(_parse_tag(sout, "THRLOSSES") or "null")
         ref_eval = _parse_eval(sout)
     finally:
         # a dead worker leaves its peer blocked at the coordinator
@@ -212,14 +231,22 @@ def run_smoke(n: int = 2, timeout: int = 420) -> dict:
 
     if any(r is None for r in results) or ref is None:
         raise RuntimeError("missing LOSSES output")
-    for i, r in enumerate(results):
-        if len(r) != len(ref):
-            raise RuntimeError(f"worker {i} trajectory length {len(r)} != {len(ref)}")
-        for a, b in zip(r, ref):
-            if abs(a - b) > 1e-4 * max(1.0, abs(b)):
+
+    def check_match(worker_traj, ref_traj, what):
+        for i, r in enumerate(worker_traj):
+            if r is None or ref_traj is None or len(r) != len(ref_traj):
                 raise RuntimeError(
-                    f"worker {i} loss diverged from single-process run: "
-                    f"{r} vs {ref}")
+                    f"worker {i} {what} trajectory length mismatch: "
+                    f"{r} vs {ref_traj}")
+            for a, b in zip(r, ref_traj):
+                if abs(a - b) > 1e-4 * max(1.0, abs(b)):
+                    raise RuntimeError(
+                        f"worker {i} {what} loss diverged from single-"
+                        f"process run: {r} vs {ref_traj}")
+
+    check_match(results, ref, "dense")
+    # the compressed exchange must be process-count invariant too
+    check_match(thr_results, thr_ref, "threshold")
     # merge the per-process evaluators (the documented multi-process
     # evaluation recipe) and compare with the single-process full-data
     # evaluation — confusion matrices must be identical
@@ -246,7 +273,8 @@ def run_smoke(n: int = 2, timeout: int = 420) -> dict:
             f"(L1 diff {diff}): {merged.confusion.matrix.tolist()} vs "
             f"{ref_ev.confusion.matrix.tolist()}")
     return {"n_processes": n, "losses": results[0], "single_process": ref,
-            "match": True, "eval_merge_match": True}
+            "threshold_losses": thr_results[0], "match": True,
+            "threshold_match": True, "eval_merge_match": True}
 
 
 def main(argv=None):

@@ -146,7 +146,8 @@ class ShardedParallelTrainer:
 
     def __init__(self, model, mesh: Mesh, *, data_axis: str = "data",
                  model_axis: str = "model", param_specs: Optional[Dict] = None,
-                 stats=None):
+                 gradient_sharing: Optional[str] = None,
+                 threshold_config=None, stats=None):
         self.model = model
         self.mesh = mesh
         # stats: optional TrainingMasterStats — per-phase round timing
@@ -162,6 +163,24 @@ class ShardedParallelTrainer:
                   if model_axis in mesh.shape else None)
             param_specs = tp_param_specs(model, model_axis, axis_size=ax)
         self.param_specs = param_specs
+        # gradient exchange over the DATA axis: dense fp32 (GSPMD psum)
+        # or error-feedback threshold encoding — the data-axis exchange
+        # goes manual (shard_map) while the model-axis TP collectives
+        # stay GSPMD-inserted (`auto` axes). Resolution mirrors
+        # ParallelTrainer: env > arg > conf > dense.
+        from deeplearning4j_tpu.parallel import gradient_sharing as _gs
+        self.gradient_sharing = _gs.resolve_mode(gradient_sharing,
+                                                 model.conf)
+        n_data = int(mesh.shape[data_axis]) if data_axis in mesh.shape else 1
+        if self.gradient_sharing == "threshold":
+            _gs.wire_dtype(n_data)      # replica-count ceiling check
+        self.threshold_config = (threshold_config if threshold_config
+                                 is not None
+                                 else _gs.ThresholdConfig.from_conf(
+                                     model.conf))
+        self._thr_step = None
+        self._thr_residual_r = None
+        self._thr_tau = None
         self._step = None
         # ComputationGraph models pack features/labels as tuples
         self._is_graph = not hasattr(model, "_forward_core")
@@ -213,6 +232,90 @@ class ShardedParallelTrainer:
                           self._bsh, self._bsh, None),
             out_shardings=(self._psh, self._ush, self._repl, None, None),
             donate_argnums=_donate(0, 1, 2))
+
+    # ------------------------------------------- threshold gradient sharing
+    def _rep_sharding(self, leaf, spec):
+        """Sharding for a per-replica (leading data-axis) stacked leaf:
+        replica axis sharded over `data_axis`, the underlying TP spec
+        preserved on the trailing dims when ranks line up (scalar-state
+        leaves just shard the replica axis)."""
+        dims = tuple(spec)
+        if np.ndim(leaf) == len(dims):     # leaf given UNSTACKED
+            return NamedSharding(self.mesh, P(self.data_axis, *dims))
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    def _replicate_per_worker(self, tree, spec_for):
+        """Stack n_data copies on a new leading axis and shard it over
+        the data axis (the per-replica residual / updater-state layout
+        of the threshold exchange)."""
+        from deeplearning4j_tpu.parallel.placement import gput
+        n = int(self.mesh.shape[self.data_axis])
+
+        def place(path_spec, a):
+            a = np.asarray(a)
+            stacked = np.broadcast_to(a[None], (n,) + a.shape)
+            return gput(stacked, self._rep_sharding(a, path_spec))
+
+        out = {}
+        for lk, sub in tree.items():
+            out[lk] = {}
+            for pn, v in sub.items():
+                spec = spec_for(lk, pn)
+                out[lk][pn] = jax.tree_util.tree_map(
+                    lambda a: place(spec, a), v)
+        return out
+
+    def _build_threshold(self):
+        """Threshold sync step for DP x TP: shard_map is MANUAL over the
+        data axis only (the compressed integer all-reduce), while every
+        other mesh axis stays `auto` — GSPMD keeps inserting the TP
+        activation/weight collectives inside the body, so tensor
+        parallelism composes with the compressed gradient exchange
+        without hand-written model-axis collectives."""
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+        from deeplearning4j_tpu.parallel.compat import shard_map
+
+        mesh, axis = self.mesh, self.data_axis
+        n = int(mesh.shape[axis])
+        autoaxes = frozenset(mesh.axis_names) - {axis}
+        # jaxlib 0.4.x SPMD partitioner limitation: an inner lax.scan
+        # under a partially-manual shard_map hard-crashes (`Check
+        # failed: sharding.IsManualSubgroup()`), so with auto (TP) axes
+        # the step body traces the unrolled layer path
+        step = gs.make_threshold_step(
+            self.model, axis, self.threshold_config, n_workers=n,
+            is_graph=self._is_graph, allow_scan=not autoaxes)
+        self._build_shardings()
+        rep = P(axis)
+        strip = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+        expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+        kwargs = dict(mesh=mesh,
+                      in_specs=(P(), rep, P(), None, rep, P(),
+                                P(axis), P(axis), None),
+                      out_specs=(P(), rep, P(), rep, P(), P(), P()),
+                      check_vma=False)
+        if autoaxes:
+            kwargs["auto"] = autoaxes
+
+        @partial(shard_map, **kwargs)
+        def thr_step(params, upd_r, state, it, res_r, tau, x, y, rng):
+            params, upd, state, res, tau, loss, sp = step(
+                params, strip(upd_r), state, it, strip(res_r), tau,
+                x, y, rng)
+            return params, expand(upd), state, expand(res), tau, loss, sp
+
+        self._thr_step = jax.jit(thr_step, donate_argnums=_donate(0, 1, 2, 4))
+
+    def _threshold_state(self):
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+        import jax.numpy as jnp
+        if self._thr_residual_r is None:
+            zeros = gs.zeros_residual(self.model.params)
+            self._thr_residual_r = self._replicate_per_worker(
+                zeros, lambda lk, pn: self.param_specs[lk][pn])
+            self._thr_tau = jnp.float32(
+                self.threshold_config.initial_threshold)
+        return self._thr_residual_r, self._thr_tau
 
     def evaluate(self, data, labels=None, *, batch_size: int = 32,
                  evaluation=None):
@@ -269,22 +372,40 @@ class ShardedParallelTrainer:
             gput, gput_tree, host_view_tree)
 
         model = self.model
-        if self._step is None:
+        thr = self.gradient_sharing == "threshold"
+        if thr and self._thr_step is None:
+            self._build_threshold()
+        if not thr and self._step is None:
             self._build()
         from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
         monitor.attach_master_stats(self.stats)
+        n_data = int(self.mesh.shape[self.data_axis])
         # multi-process aware placement: each process contributes only
-        # its addressable shards of the TP-sharded param tree
+        # its addressable shards of the TP-sharded param tree. Threshold
+        # mode holds updater state PER-REPLICA (leading data axis — each
+        # reference worker advances its own updater).
+        def place_upd():
+            if thr:
+                return self._replicate_per_worker(
+                    model.updater_state,
+                    lambda lk, pn: self.param_specs[lk][pn])
+            return gput_tree(model.updater_state, self._ush)
         if self.stats is not None:
             with self.stats.time_phase("broadcast"):
                 params = gput_tree(model.params, self._psh)
-                upd = gput_tree(model.updater_state, self._ush)
+                upd = place_upd()
                 state = gput_tree(model.net_state, self._repl)
                 jax.block_until_ready(params)
         else:
             params = gput_tree(model.params, self._psh)
-            upd = gput_tree(model.updater_state, self._ush)
+            upd = place_upd()
             state = gput_tree(model.net_state, self._repl)
+        if thr:
+            res_r, tau = self._threshold_state()
+            wire_b = gs.exchange_wire_bytes(model.params, "threshold",
+                                            n_workers=n_data)
+        dense_b = gs.exchange_wire_bytes(model.params, "dense")
         iterator = as_iterator(data, labels, batch_size=batch_size)
         listeners = ComposedListeners(model.listeners
                                       + monitor.extra_listeners())
@@ -294,6 +415,7 @@ class ShardedParallelTrainer:
         # gate as ParallelTrainer's sync path)
         eager_loss = bool(model.listeners) or self.stats is not None
         loss = None
+        sp = None
         for _ in range(epochs):
             iterator.reset()
             for ds in iterator:
@@ -301,8 +423,18 @@ class ShardedParallelTrainer:
                 y = gput(ds.labels, self._bsh)
                 rng = jax.random.fold_in(rng_root, model.iteration_count)
                 t0 = time.perf_counter() if self.stats is not None else 0.0
-                params, upd, state, loss, _ = self._step(
-                    params, upd, state, model.iteration_count, x, y, rng)
+                if thr:
+                    params, upd, state, res_r, tau, loss, sp = \
+                        self._thr_step(params, upd, state,
+                                       model.iteration_count, res_r, tau,
+                                       x, y, rng)
+                    gs.record_exchange("threshold", wire_b, dense_b, 1,
+                                       trainer="sharded")
+                else:
+                    params, upd, state, loss, _ = self._step(
+                        params, upd, state, model.iteration_count, x, y, rng)
+                    gs.record_exchange("dense", dense_b, dense_b, 1,
+                                       trainer="sharded")
                 if self.stats is not None:
                     jax.block_until_ready(loss)
                     self.stats.record("sync_step",
@@ -322,6 +454,20 @@ class ShardedParallelTrainer:
             model.epoch_count += 1
         if loss is not None and not eager_loss:
             model.score_value = float(loss)
+        if thr:
+            self._thr_residual_r, self._thr_tau = res_r, tau
+            if sp is not None:
+                gs.record_threshold_stats(float(np.asarray(tau)),
+                                          float(np.asarray(sp)),
+                                          trainer="sharded")
+            # per-replica updater states drift (reference semantics);
+            # the model keeps replica 0's view, sliced with the dense
+            # updater shardings so the result is fetchable/reusable
+            # under multi-process execution
+            rep0 = jax.jit(
+                lambda t: jax.tree_util.tree_map(lambda a: a[0], t),
+                out_shardings=self._ush)
+            upd = rep0(upd)
         # model-sharded leaves are not host-gatherable from one process
         # under multi-process execution; those stay as global arrays
         model.params = host_view_tree(params)

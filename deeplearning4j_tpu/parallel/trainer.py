@@ -87,7 +87,8 @@ class ParallelTrainer:
     def __init__(self, model, mesh: Optional[Mesh] = None, *,
                  mode: str = "sync", averaging_frequency: int = 5,
                  average_updater_state: bool = True, data_axis: str = "data",
-                 stats=None):
+                 gradient_sharing: Optional[str] = None,
+                 threshold_config=None, stats=None):
         if mode not in ("sync", "averaging"):
             raise ValueError(f"mode must be sync|averaging, got {mode}")
         # stats: optional TrainingMasterStats — per-phase round timing
@@ -101,6 +102,39 @@ class ParallelTrainer:
         self.average_updater_state = average_updater_state
         self.data_axis = data_axis
         self.n_workers = int(np.prod([self.mesh.shape[a] for a in [data_axis]]))
+        # gradient exchange mode for sync training: dense fp32 psum (XLA
+        # default) vs error-feedback threshold encoding (reference
+        # SharedTrainingMaster semantics — parallel/gradient_sharing.py).
+        # Resolution: DL4J_GRADIENT_SHARING env > explicit arg > model
+        # conf's gradient_sharing field > "dense".
+        from deeplearning4j_tpu.parallel import gradient_sharing as _gs
+        self.gradient_sharing = _gs.resolve_mode(gradient_sharing,
+                                                 model.conf)
+        if self.gradient_sharing == "threshold" and mode != "sync":
+            if (_gs.env_mode() == "threshold"
+                    and (gradient_sharing or "dense") != "threshold"
+                    and getattr(model.conf, "gradient_sharing",
+                                "dense") != "threshold"):
+                # global env A/B toggle: degrade gracefully where the
+                # compressed exchange does not apply (averaging mode
+                # exchanges parameters, not gradients) — only an
+                # EXPLICIT arg/conf request is a hard error
+                self.gradient_sharing = "dense"
+            else:
+                raise ValueError(
+                    "gradient_sharing='threshold' compresses the per-step "
+                    "gradient exchange and only applies to mode='sync'; "
+                    "averaging mode exchanges parameters, not gradients")
+        if self.gradient_sharing == "threshold":
+            _gs.wire_dtype(self.n_workers)  # replica-count ceiling check
+        self.threshold_config = (threshold_config if threshold_config
+                                 is not None
+                                 else _gs.ThresholdConfig.from_conf(
+                                     model.conf))
+        self._thr_step = None
+        self._thr_multi = None
+        self._thr_residual_r = None   # per-replica error-feedback residual
+        self._thr_tau = None          # adaptive threshold (device scalar)
         self._sync_step = None
         self._sync_multi = None
         self._local_step = None
@@ -141,6 +175,89 @@ class ParallelTrainer:
             out_shardings=(repl, repl, repl, None),
             donate_argnums=_donate(0, 1, 2),
         )
+
+    # ------------------------------------------- threshold gradient sharing
+    def _build_threshold_step(self):
+        """Per-step threshold sync: the explicit-collective shard_map
+        program from parallel/gradient_sharing.py — local grads on the
+        batch shard, error-feedback threshold encode, integer all-reduce,
+        decode, shared update. The per-replica residual enters/exits with
+        a leading replica axis sharded over the data axis (the averaging
+        mode's rep-spec idiom); ``stacked::`` run packing happens inside
+        the step, so the residual the trainer holds stays per-layer."""
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+        from deeplearning4j_tpu.parallel.compat import shard_map
+
+        mesh, axis = self.mesh, self.data_axis
+        step = gs.make_threshold_step(
+            self.model, axis, self.threshold_config,
+            n_workers=self.n_workers, is_graph=False)
+        rep = P(axis)
+        strip = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+        expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), rep, P(), None, rep, P(),
+                           P(axis), P(axis), None),
+                 out_specs=(P(), rep, P(), rep, P(), P(), P()),
+                 check_vma=False)
+        def thr_step(params, upd_r, state, it, res_r, tau, x, y, rng):
+            params, upd, state, res, tau, loss, sp = step(
+                params, strip(upd_r), state, it, strip(res_r), tau,
+                x, y, rng)
+            return params, expand(upd), state, expand(res), tau, loss, sp
+
+        self._thr_step = jax.jit(thr_step, donate_argnums=_donate(0, 1, 2, 4))
+
+    def _build_threshold_multi(self):
+        """k fused threshold sync steps in ONE dispatch: the scan lives
+        inside shard_map and the residual + τ ride its carry next to the
+        updater state (gradient_sharing.make_threshold_multi); packing
+        of ``stacked::`` runs is paid once per program."""
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+        from deeplearning4j_tpu.parallel.compat import shard_map
+
+        mesh, axis = self.mesh, self.data_axis
+        multi = gs.make_threshold_multi(
+            self.model, axis, self.threshold_config,
+            n_workers=self.n_workers, is_graph=False)
+        rep = P(axis)
+        strip = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+        expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), rep, P(), None, rep, P(),
+                           P(None, axis), P(None, axis), None),
+                 out_specs=(P(), rep, P(), rep, P(), P(), P()),
+                 check_vma=False)
+        def thr_multi(params, upd_r, state, it0, res_r, tau, xs, ys, rngs):
+            params, upd, state, res, tau, losses, sps = multi(
+                params, strip(upd_r), state, it0, strip(res_r), tau,
+                xs, ys, rngs)
+            return params, expand(upd), state, expand(res), tau, losses, sps
+
+        self._thr_multi = jax.jit(thr_multi,
+                                  donate_argnums=_donate(0, 1, 2, 4))
+
+    def _threshold_state(self):
+        """(residual_r, tau) device state — created lazily, persisted
+        across fit() calls exactly like updater state (the reference's
+        accumulator survives across training rounds)."""
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+        if self._thr_residual_r is None:
+            self._thr_residual_r = self._replicate_tree(
+                gs.zeros_residual(self.model.params))
+            self._thr_tau = jnp.float32(
+                self.threshold_config.initial_threshold)
+        return self._thr_residual_r, self._thr_tau
+
+    def threshold_residual(self):
+        """Host view of the per-replica error-feedback residual
+        (per-LAYER keys — the ``stacked::`` packing exists only inside
+        the step program), or None before the first threshold step."""
+        if self._thr_residual_r is None:
+            return None
+        return jax.tree_util.tree_map(np.asarray, self._thr_residual_r)
 
     # -------------------------------------------------------- averaging mode
     def _make_local_one_step(self):
@@ -339,6 +456,143 @@ class ParallelTrainer:
             lambda x: self._eval_forward(params, state, x),
             lambda f: _gput(f, batch_sh))
 
+    def _fit_sync_threshold(self, iterator, listeners, rng_root, epochs,
+                            steps_per_execution, divisible, check_trained):
+        """Sync-mode fit with threshold-encoded gradient exchange
+        (gradient_sharing="threshold"): same grouping/looping contract
+        as the dense path, but each step's all-reduce moves the int8
+        sign tensor instead of fp32 gradients, with the per-replica
+        error-feedback residual and adaptive τ persisted across steps
+        (and across fit() calls) like updater state."""
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+
+        model = self.model
+        if self._thr_step is None:
+            self._build_threshold_step()
+        spe = max(1, int(steps_per_execution))
+        if spe > 1 and self._thr_multi is None:
+            self._build_threshold_multi()
+        repl = NamedSharding(self.mesh, P())
+        # updater state is PER-REPLICA in threshold mode (each reference
+        # worker advances its own updater on its local gradients) —
+        # leading replica axis, same layout as the residual
+        if self.stats is not None:
+            with self.stats.time_phase("broadcast"):
+                params = _gput_tree(model.params, repl)
+                upd_r = self._replicate_tree(model.updater_state)
+                state = _gput_tree(model.net_state, repl)
+                jax.block_until_ready(params)
+        else:
+            params = _gput_tree(model.params, repl)
+            upd_r = self._replicate_tree(model.updater_state)
+            state = _gput_tree(model.net_state, repl)
+        res_r, tau = self._threshold_state()
+        batch_sh = NamedSharding(self.mesh, P(self.data_axis))
+        stack_sh = NamedSharding(self.mesh, P(None, self.data_axis))
+        eager_loss = bool(model.listeners) or self.stats is not None
+        # comm accounting is host math on static shapes — every step is
+        # counted with zero device syncs (docs/COMMS.md)
+        wire_b = gs.exchange_wire_bytes(model.params, "threshold",
+                                        n_workers=self.n_workers)
+        dense_b = gs.exchange_wire_bytes(model.params, "dense")
+        last_loss = None
+        last_sparsity = None
+
+        def run_single(ds):
+            nonlocal params, upd_r, state, res_r, tau
+            nonlocal last_loss, last_sparsity
+            x = _gput(ds.features, batch_sh)
+            y = _gput(ds.labels, batch_sh)
+            rng = jax.random.fold_in(rng_root, model.iteration_count)
+            t0 = time.perf_counter()
+            params, upd_r, state, res_r, tau, loss, sp = self._thr_step(
+                params, upd_r, state, model.iteration_count, res_r, tau,
+                x, y, rng)
+            last_loss, last_sparsity = loss, sp
+            gs.record_exchange("threshold", wire_b, dense_b, 1,
+                               trainer="parallel")
+            if eager_loss:
+                model.score_value = float(loss)
+                gs.record_threshold_stats(float(tau), float(sp),
+                                          trainer="parallel")
+            if self.stats is not None:
+                self.stats.record("sync_step", time.perf_counter() - t0,
+                                  iteration=model.iteration_count)
+                self.stats.next_round()
+            listeners.iteration_done(model, model.iteration_count,
+                                     model.epoch_count,
+                                     model.score_value if eager_loss
+                                     else float("nan"),
+                                     batch_size=ds.num_examples())
+            model.iteration_count += 1
+
+        def drain(pending):
+            nonlocal params, upd_r, state, res_r, tau
+            nonlocal last_loss, last_sparsity
+            if not pending:
+                return
+            if len(pending) == 1:
+                run_single(pending[0])
+                return
+            xs = _gput(np.stack([np.asarray(d.features) for d in pending]),
+                       stack_sh)
+            ys = _gput(np.stack([np.asarray(d.labels) for d in pending]),
+                       stack_sh)
+            it0 = model.iteration_count
+            rngs = jax.vmap(lambda i: jax.random.fold_in(rng_root, i))(
+                jnp.arange(it0, it0 + len(pending)))
+            t0 = time.perf_counter()
+            params, upd_r, state, res_r, tau, losses, sps = self._thr_multi(
+                params, upd_r, state, it0, res_r, tau, xs, ys, rngs)
+            last_loss, last_sparsity = losses, sps
+            gs.record_exchange("threshold", wire_b, dense_b, len(pending),
+                               trainer="parallel")
+            lv = np.asarray(losses) if eager_loss else None
+            if eager_loss:
+                gs.record_threshold_stats(float(tau),
+                                          float(np.asarray(sps)[-1]),
+                                          trainer="parallel")
+            if self.stats is not None:
+                self.stats.record("sync_step", time.perf_counter() - t0,
+                                  iteration=it0, fused_steps=len(pending))
+                self.stats.next_round()
+            for j, d in enumerate(pending):
+                if eager_loss:
+                    model.score_value = float(lv[j])
+                listeners.iteration_done(model, model.iteration_count,
+                                         model.epoch_count,
+                                         model.score_value if eager_loss
+                                         else float("nan"),
+                                         batch_size=d.num_examples())
+                model.iteration_count += 1
+
+        self._run_grouped(iterator, epochs, spe, divisible,
+                          run_single, drain, model)
+        check_trained()
+        self._thr_residual_r, self._thr_tau = res_r, tau
+        if last_loss is not None and not eager_loss:
+            lv = np.asarray(last_loss)
+            model.score_value = float(lv[-1] if lv.ndim else lv)
+        if last_sparsity is not None:
+            sv = np.asarray(last_sparsity)
+            gs.record_threshold_stats(float(np.asarray(tau)),
+                                      float(sv[-1] if sv.ndim else sv),
+                                      trainer="parallel")
+        model.params = jax.tree_util.tree_map(np.asarray, params)
+        model.net_state = jax.tree_util.tree_map(np.asarray, state)
+        # per-replica updater states drift (each advanced on its own
+        # shard, reference semantics); the model keeps replica 0's view.
+        # The slice is taken with a REPLICATED out-sharding so the host
+        # fetch is legal under multi-process execution (a bare a[0]
+        # lands on replica 0's devices, which other processes cannot
+        # read back)
+        rep0 = jax.jit(
+            lambda t: jax.tree_util.tree_map(lambda a: a[0], t),
+            out_shardings=repl)
+        model.updater_state = jax.tree_util.tree_map(np.asarray,
+                                                     rep0(upd_r))
+        return model
+
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             steps_per_execution: int = 1):
@@ -396,6 +650,11 @@ class ParallelTrainer:
                     f"axis — fit() would be a silent no-op; use a "
                     f"batch_size divisible by {n_div}")
 
+        if self.mode == "sync" and self.gradient_sharing == "threshold":
+            return self._fit_sync_threshold(
+                iterator, listeners, rng_root, epochs, steps_per_execution,
+                divisible, check_trained)
+
         if self.mode == "sync":
             if self._sync_step is None:
                 self._build_sync_step()
@@ -419,6 +678,8 @@ class ParallelTrainer:
             # it when someone (listener/stats consumer) will look at it
             eager_loss = bool(model.listeners) or self.stats is not None
             last_loss = None
+            from deeplearning4j_tpu.parallel import gradient_sharing as gs
+            dense_b = gs.exchange_wire_bytes(model.params, "dense")
 
             def run_single(ds):
                 nonlocal params, upd, state, last_loss
@@ -428,6 +689,8 @@ class ParallelTrainer:
                 t0 = time.perf_counter()
                 params, upd, state, loss, _ = self._sync_step(
                     params, upd, state, model.iteration_count, x, y, rng)
+                gs.record_exchange("dense", dense_b, dense_b, 1,
+                                   trainer="parallel")
                 last_loss = loss
                 if eager_loss:
                     model.score_value = float(loss)
@@ -463,6 +726,8 @@ class ParallelTrainer:
                 t0 = time.perf_counter()
                 params, upd, state, losses = self._sync_multi(
                     params, upd, state, it0, xs, ys, rngs)
+                gs.record_exchange("dense", dense_b, dense_b, len(pending),
+                                   trainer="parallel")
                 last_loss = losses
                 lv = np.asarray(losses) if eager_loss else None
                 if self.stats is not None:

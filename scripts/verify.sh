@@ -17,12 +17,17 @@
 # 4. AOT cost smoke: `hlo_cost --all` (reduced batch, scratch dir) must
 #    produce every report with the program section's compile_seconds +
 #    peak-memory fields — the scan-over-layers/remat observability
-#    surface (docs/COMPILE.md). CPU-forced; a dead tunnel can't hang it.
+#    surface (docs/COMPILE.md) — AND the comm_bytes block (dense-vs-
+#    threshold gradient-exchange payload, threshold < dense;
+#    docs/COMMS.md). CPU-forced; a dead tunnel can't hang it.
+# 5. Gradient-sharing smoke: tiny-MLP dense vs threshold loss
+#    trajectories must stay within tolerance after 50 sync steps on a
+#    4-way mesh (the error-feedback convergence guarantee).
 
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== [1/4] tier-1 tests (ROADMAP.md verbatim) =="
+echo "== [1/5] tier-1 tests (ROADMAP.md verbatim) =="
 # stale-report guard: a timeout-killed suite never reaches
 # pytest_sessionfinish, and step [2/3] must not read the previous
 # run's durations as this run's
@@ -30,7 +35,7 @@ rm -f "${DL4J_SUITE_DURATIONS:-/tmp/_t1_durations.json}"
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
 tier1_rc=$?
 
-echo "== [2/4] suite duration budget =="
+echo "== [2/5] suite duration budget =="
 python - <<'EOF'
 import json
 import os
@@ -57,7 +62,7 @@ if total > soft:
           "mark 'slow' the top offenders above before adding tests.")
 EOF
 
-echo "== [3/4] /metrics smoke =="
+echo "== [3/5] /metrics smoke =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import sys
 import urllib.request
@@ -99,7 +104,7 @@ print(f"/metrics smoke OK ({len(body.splitlines())} exposition lines, "
 EOF
 smoke_rc=$?
 
-echo "== [4/4] AOT cost smoke (hlo_cost --all) =="
+echo "== [4/5] AOT cost smoke (hlo_cost --all) =="
 hlo_out=$(mktemp -d)
 timeout -k 10 840 env JAX_PLATFORMS=cpu \
     python -m benchtools.hlo_cost --all --batch 8 --steps 2 --out "$hlo_out"
@@ -120,6 +125,13 @@ for p in paths:
                            "temp_size_in_bytes", "jaxpr_eqn_count")
                if not prog.get(k)]
     assert not missing, f"{p}: program section missing {missing}"
+    cb = prog.get("comm_bytes") or {}
+    assert cb.get("dense_bytes_per_step") and \
+        cb.get("threshold_bytes_per_step"), f"{p}: comm_bytes missing: {cb}"
+    assert cb["threshold_bytes_per_step"] < cb["dense_bytes_per_step"], \
+        f"{p}: threshold exchange not smaller than dense: {cb}"
+    assert cb.get("reduction", 0) >= 3.9, \
+        f"{p}: comm reduction below 4x wire format: {cb}"
 svu = json.load(open(os.path.join(out, "cost_transformer.json")))
 assert svu["scan_vs_unrolled"]["eqn_reduction"] >= 3.0, \
     svu["scan_vs_unrolled"]
@@ -133,8 +145,59 @@ EOF
 hlo_rc=$?
 rm -rf "$hlo_out"
 
-echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc}"
-if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ]; then
+echo "== [5/5] gradient-sharing smoke (dense vs threshold) =="
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    timeout -k 10 300 python - <<'PYEOF'
+import numpy as np
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import device_mesh
+from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+
+def build():
+    b = NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01)).list()
+    for _ in range(4):
+        b = b.layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+    return MultiLayerNetwork(
+        (b.layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                             loss="mcxent"))
+          .set_input_type(InputType.feed_forward(16)).build())).init()
+
+
+rng = np.random.default_rng(0)
+B = 32
+x = rng.standard_normal((B * 10, 16)).astype(np.float32)
+w = rng.standard_normal((16, 4))
+y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+ds = DataSet(x, y)
+
+dense = build()
+ParallelTrainer(dense, device_mesh(), mode="sync").fit(
+    x, y, epochs=5, batch_size=B)                       # 50 steps
+thr = build()
+ParallelTrainer(thr, device_mesh(), mode="sync",
+                gradient_sharing="threshold").fit(
+    x, y, epochs=5, batch_size=B)
+
+d, t = float(dense.score(ds)), float(thr.score(ds))
+init = float(build().score(ds))
+assert d < init * 0.5, f"dense failed to learn: {init} -> {d}"
+assert t < init * 0.5, f"threshold failed to learn: {init} -> {t}"
+# error-feedback convergence guarantee: within tolerance of dense
+assert abs(t - d) <= 0.35 * init, \
+    f"threshold diverged from dense: dense={d} thr={t} init={init}"
+print(f"gradient-sharing smoke OK (init={init:.3f} dense={d:.3f} "
+      f"threshold={t:.3f})")
+PYEOF
+gs_rc=$?
+
+echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc}"
+if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ]; then
     exit 1
 fi
 echo "VERIFY OK"

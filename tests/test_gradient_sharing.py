@@ -1,0 +1,349 @@
+"""Threshold-encoded gradient sharing (parallel/gradient_sharing.py):
+encode/decode/error-feedback units, adaptive-τ controller, mode
+resolution + conf serde, convergence parity vs dense sync training
+(deep MLP with packed ``stacked::`` runs, TransformerLM with
+scan_layers + fused multi-step, DP x TP), and the comm-bytes
+accounting seam (benchtools/hlo_cost.collective_table /
+comm_bytes_block)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+from deeplearning4j_tpu.parallel import gradient_sharing as gs
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, device_mesh, make_mesh
+from deeplearning4j_tpu.parallel.tensor import ShardedParallelTrainer
+from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+
+def deep_mlp(n_hidden=6, seed=7, lr=0.01):
+    """Deep homogeneous MLP — the hidden stack forms ONE scan run that
+    packs at the train-step boundary (stacked:: entries)."""
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr)).list()
+    for _ in range(n_hidden):
+        b = b.layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+    conf = (b.layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                                loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def toy_data(n=320, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 4))
+    y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+# ---------------------------------------------------------------- unit level
+class TestEncodeDecode:
+    def test_error_feedback_identity(self):
+        """enc*τ + residual == grad + old residual, exactly: nothing is
+        ever lost to the compression."""
+        rng = np.random.default_rng(3)
+        acc = rng.standard_normal((64,)).astype(np.float32) * 0.01
+        tau = jnp.float32(0.005)
+        enc, res, sent = gs.encode_leaf(jnp.asarray(acc), tau, jnp.int8)
+        rebuilt = (np.asarray(enc).astype(np.float32) * np.float32(0.005)
+                   + np.asarray(res))
+        np.testing.assert_allclose(rebuilt, acc, rtol=0, atol=1e-8)
+        assert np.asarray(enc).dtype == np.int8
+        assert set(np.unique(np.asarray(enc))) <= {-1, 0, 1}
+        assert float(sent) == float(np.sum(np.abs(acc) >= 0.005))
+
+    def test_wire_dtype(self):
+        assert gs.wire_dtype(8) == jnp.int8
+        assert gs.wire_dtype(127) == jnp.int8
+        assert gs.wire_dtype(128) == jnp.int16
+        with pytest.raises(ValueError, match="32767"):
+            gs.wire_dtype(40000)
+
+    def test_adapt_threshold_band(self):
+        cfg = gs.ThresholdConfig()
+        tau = jnp.float32(1e-3)
+        # above the band: boost (send less)
+        up = gs.adapt_threshold(tau, jnp.float32(0.5), cfg)
+        assert float(up) == pytest.approx(1e-3 * cfg.boost)
+        # below the band: decay (send more)
+        down = gs.adapt_threshold(tau, jnp.float32(1e-5), cfg)
+        assert float(down) == pytest.approx(1e-3 * cfg.decay)
+        # inside: unchanged
+        mid = gs.adapt_threshold(tau, jnp.float32(0.05), cfg)
+        assert float(mid) == pytest.approx(1e-3)
+        # clamp
+        lo = gs.adapt_threshold(jnp.float32(1e-8), jnp.float32(0.0), cfg)
+        assert float(lo) >= float(np.float32(cfg.min_threshold))
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="band"):
+            gs.ThresholdConfig(sparsity_target_min=0.5,
+                               sparsity_target_max=0.1)
+        with pytest.raises(ValueError, match="decay"):
+            gs.ThresholdConfig(decay=1.5)
+        with pytest.raises(ValueError, match="min_threshold"):
+            gs.ThresholdConfig(initial_threshold=2.0)
+
+
+class TestModeResolution:
+    def test_precedence(self, monkeypatch):
+        conf = deep_mlp(2).conf
+        assert gs.resolve_mode(None, conf) == "dense"
+        conf.gradient_sharing = "threshold"
+        assert gs.resolve_mode(None, conf) == "threshold"
+        assert gs.resolve_mode("dense", conf) == "dense"
+        monkeypatch.setenv("DL4J_GRADIENT_SHARING", "threshold")
+        assert gs.resolve_mode("dense", conf) == "threshold"
+        monkeypatch.setenv("DL4J_GRADIENT_SHARING", "0")
+        assert gs.resolve_mode("threshold", conf) == "dense"
+        monkeypatch.setenv("DL4J_GRADIENT_SHARING", "bogus")
+        with pytest.raises(ValueError, match="DL4J_GRADIENT_SHARING"):
+            gs.resolve_mode(None, conf)
+
+    def test_env_override_reaches_trainer(self, monkeypatch):
+        monkeypatch.setenv("DL4J_GRADIENT_SHARING", "dense")
+        t = ParallelTrainer(deep_mlp(2), device_mesh(), mode="sync",
+                            gradient_sharing="threshold")
+        assert t.gradient_sharing == "dense"
+
+    def test_threshold_rejects_averaging_mode(self):
+        with pytest.raises(ValueError, match="sync"):
+            ParallelTrainer(deep_mlp(2), device_mesh(), mode="averaging",
+                            gradient_sharing="threshold")
+
+    def test_env_toggle_degrades_gracefully_for_averaging(self, monkeypatch):
+        """The global DL4J_GRADIENT_SHARING=threshold A/B toggle must
+        not crash unrelated averaging-mode trainers (it falls back to
+        dense there); only an EXPLICIT arg/conf request hard-errors."""
+        monkeypatch.setenv("DL4J_GRADIENT_SHARING", "threshold")
+        t = ParallelTrainer(deep_mlp(2), device_mesh(), mode="averaging")
+        assert t.gradient_sharing == "dense"
+        with pytest.raises(ValueError, match="sync"):
+            ParallelTrainer(deep_mlp(2), device_mesh(), mode="averaging",
+                            gradient_sharing="threshold")
+
+    def test_mlc_serde_round_trip(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_in=4, n_out=8))
+                .layer(OutputLayer(n_in=8, n_out=3))
+                .gradient_sharing("threshold", threshold=5e-4)
+                .build())
+        assert conf.gradient_sharing == "threshold"
+        assert conf.gradient_sharing_threshold == 5e-4
+        back = type(conf).from_json(conf.to_json())
+        assert back.gradient_sharing == "threshold"
+        assert back.gradient_sharing_threshold == 5e-4
+        # trainer picks the conf flag + τ0 up
+        net = MultiLayerNetwork(back).init()
+        t = ParallelTrainer(net, device_mesh(), mode="sync")
+        assert t.gradient_sharing == "threshold"
+        assert t.threshold_config.initial_threshold == 5e-4
+
+    def test_graph_serde_round_trip(self):
+        conf = (ComputationGraphConfiguration.graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_in=4, n_out=8), "in")
+                .add_layer("out", OutputLayer(n_in=8, n_out=3), "d")
+                .set_outputs("out")
+                .gradient_sharing("threshold", threshold=2e-3)
+                .build())
+        back = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert back.gradient_sharing == "threshold"
+        assert back.gradient_sharing_threshold == 2e-3
+        with pytest.raises(ValueError, match="dense|threshold"):
+            (ComputationGraphConfiguration.graph_builder()
+             .gradient_sharing("sparse"))
+
+
+# --------------------------------------------------------- convergence parity
+class TestConvergenceParity:
+    def test_deep_mlp_threshold_tracks_dense(self):
+        """Deep MLP (one packed stacked:: run), 50 sync steps: threshold
+        with error feedback must learn and stay within tolerance of the
+        dense trajectory; the per-replica residual must survive the
+        pack/unpack boundary with per-LAYER keys."""
+        x, y = toy_data()
+        ds = DataSet(x, y)
+        init = float(deep_mlp().score(ds))
+
+        dense = deep_mlp()
+        ParallelTrainer(dense, device_mesh(), mode="sync").fit(
+            x, y, epochs=5, batch_size=32)
+        thr = deep_mlp()
+        t = ParallelTrainer(thr, device_mesh(), mode="sync",
+                            gradient_sharing="threshold")
+        t.fit(x, y, epochs=5, batch_size=32)
+
+        d, th = float(dense.score(ds)), float(thr.score(ds))
+        assert d < 0.5 * init, f"dense failed to learn {init}->{d}"
+        assert th < 0.5 * init, f"threshold failed to learn {init}->{th}"
+        assert abs(th - d) <= 0.35 * init, (init, d, th)
+
+        # residual: per-layer keys (stacked:: packing never leaks out),
+        # per-replica leading axis, and nonzero — error feedback active
+        res = t.threshold_residual()
+        assert set(res.keys()) == set(thr.params.keys())
+        assert not any(k.startswith("stacked::") for k in res)
+        lead = res["0"]["W"].shape
+        assert lead == (t.n_workers,) + thr.params["0"]["W"].shape
+        assert any(float(np.abs(l).max()) > 0
+                   for l in jax.tree_util.tree_leaves(res))
+        # τ adapted away from its initial value
+        assert float(np.asarray(t._thr_tau)) != pytest.approx(
+            t.threshold_config.initial_threshold)
+
+    def test_fused_multi_step_bit_identical(self):
+        """steps_per_execution>1 (residual + τ riding the scan carry)
+        must reproduce the per-step trajectory exactly — same numeric
+        contract the dense fused path keeps."""
+        x, y = toy_data(n=256, seed=1)
+
+        def run(spe):
+            net = deep_mlp(4)
+            listener = CollectScoresListener()
+            net.set_listeners(listener)
+            t = ParallelTrainer(net, device_mesh(), mode="sync",
+                                gradient_sharing="threshold")
+            t.fit(x, y, epochs=3, batch_size=32, steps_per_execution=spe)
+            return ([s for _, s in listener.scores],
+                    float(np.asarray(t._thr_tau)))
+
+        per_step, tau1 = run(1)
+        fused, tau4 = run(4)
+        assert len(per_step) == len(fused) == 24
+        np.testing.assert_allclose(per_step, fused, rtol=0, atol=0)
+        assert tau1 == tau4
+
+    def test_transformer_lm_threshold_tracks_dense(self):
+        """TransformerLM with scan_layers on + fused multi-step: the
+        threshold exchange must hold convergence parity through the
+        scan-compiled, boundary-packed program."""
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+        B, T, V = 16, 16, 37
+        rng = np.random.default_rng(5)
+        ids = rng.integers(0, V, (B * 4, T + 1))
+        x = ids[:, :-1].astype(np.float32)
+        y = np.eye(V, dtype=np.float32)[ids[:, 1:]]
+
+        def build():
+            lm = TransformerLM(vocab_size=V, d_model=32, n_layers=3,
+                               n_heads=2, max_len=T)
+            conf = lm.conf()
+            assert conf.scan_layers
+            net = MultiLayerNetwork(conf).init(11)
+            return net
+
+        def run(mode):
+            net = build()
+            listener = CollectScoresListener()
+            net.set_listeners(listener)
+            ParallelTrainer(net, device_mesh(), mode="sync",
+                            gradient_sharing=mode).fit(
+                x, y, epochs=6, batch_size=B, steps_per_execution=4)
+            return [s for _, s in listener.scores]
+
+        dense = run("dense")
+        thr = run("threshold")
+        assert len(dense) == len(thr) == 24
+        assert dense[-1] < dense[0]
+        assert thr[-1] < thr[0], f"threshold LM failed to learn: {thr}"
+        # parity band: same scale of progress from the same start
+        assert abs(thr[-1] - dense[-1]) <= 0.35 * dense[0], (dense, thr)
+
+    def test_sharded_dp_tp_threshold(self):
+        """DP x TP (auto model axis): the compressed data-axis exchange
+        composes with GSPMD tensor parallelism."""
+        x, y = toy_data(n=256, seed=2)
+        ds = DataSet(x, y)
+        mesh = make_mesh(MeshSpec.of(data=4, model=2))
+        init = float(deep_mlp(3).score(ds))
+
+        thr = deep_mlp(3)
+        t = ShardedParallelTrainer(thr, mesh, gradient_sharing="threshold")
+        t.fit(x, y, epochs=6, batch_size=32)
+        th = float(thr.score(ds))
+        assert th < 0.6 * init, f"TP threshold failed to learn {init}->{th}"
+        assert t._thr_residual_r is not None
+        assert float(np.asarray(t._thr_tau)) > 0
+
+
+# ------------------------------------------------------- comm-bytes accounting
+class TestCommAccounting:
+    def test_exchange_jaxpr_bytes(self):
+        """The traced exchange programs carry the wire contract: dense
+        moves 4 bytes/element, threshold 1 byte/element (+ scalars)."""
+        from benchtools.hlo_cost import collective_table
+        net = deep_mlp(2)
+        elems = sum(int(np.prod(np.shape(l)))
+                    for l in jax.tree_util.tree_leaves(net.params))
+        dense = collective_table(gs.exchange_jaxpr(net.params, "dense", 8))
+        thr = collective_table(gs.exchange_jaxpr(net.params, "threshold", 8))
+        assert dense["comm_bytes_per_step"] == 4 * elems
+        assert thr["comm_bytes_per_step"] == elems + 4  # + sent-count psum
+        assert dense["by_collective"]["all_reduce"]["count"] > 0
+        ratio = dense["comm_bytes_per_step"] / thr["comm_bytes_per_step"]
+        assert ratio > 3.5
+
+    def test_wire_bytes_accounting(self):
+        net = deep_mlp(2)
+        elems = sum(int(np.prod(np.shape(l)))
+                    for l in jax.tree_util.tree_leaves(net.params))
+        assert gs.exchange_wire_bytes(net.params, "dense") == 4 * elems
+        assert gs.exchange_wire_bytes(net.params, "threshold",
+                                      n_workers=8) == elems + 8
+        # int16 widening beyond 127 replicas
+        assert gs.exchange_wire_bytes(net.params, "threshold",
+                                      n_workers=200) == 2 * elems + 8
+
+    def test_comm_bytes_block_and_gauges(self):
+        """hlo_cost's program-section block + the aot_comm_bytes_*
+        gauges the /metrics route serves."""
+        from benchtools import hlo_cost
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor import MetricsRegistry, xprof
+        net = deep_mlp(2)
+        blk = hlo_cost.comm_bytes_block(net, n_workers=8)
+        assert "error" not in blk, blk
+        assert blk["threshold_bytes_per_step"] < blk["dense_bytes_per_step"]
+        assert blk["reduction"] >= 3.5
+        reg = MetricsRegistry()
+        xprof.publish_cost_report(
+            {"model": "gs_test", "program": {"comm_bytes": blk}},
+            registry=reg)
+        expo = reg.exposition()
+        assert 'aot_comm_bytes_dense{model="gs_test"}' in expo
+        assert 'aot_comm_bytes_threshold{model="gs_test"}' in expo
+        assert 'aot_comm_bytes_reduction{model="gs_test"}' in expo
+
+    def test_trainer_comm_counters(self):
+        """The trainers count exchanged bytes + compression ratio on the
+        monitor registry (host math, both modes)."""
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor import MetricsRegistry
+        reg = monitor.enable(registry=MetricsRegistry())
+        try:
+            x, y = toy_data(n=64, seed=3)
+            for mode in ("dense", "threshold"):
+                net = deep_mlp(2)
+                ParallelTrainer(net, device_mesh(), mode="sync",
+                                gradient_sharing=mode).fit(
+                    x, y, epochs=1, batch_size=32)
+            expo = reg.exposition()
+            assert 'gradient_exchange_bytes_total{mode="dense"' in expo
+            assert 'gradient_exchange_bytes_total{mode="threshold"' in expo
+            assert "gradient_sharing_compression_ratio" in expo
+            assert "gradient_sharing_threshold" in expo
+            assert "gradient_sharing_sparsity" in expo
+            snap = reg.snapshot()["gradient_exchange_bytes_total"]["values"]
+            by_mode = {e["labels"]["mode"]: e["value"] for e in snap}
+            assert by_mode["dense"] > by_mode["threshold"] * 3.5
+        finally:
+            monitor.disable()

@@ -230,3 +230,68 @@ class TestParallelInferenceCoalescing:
         pi = ParallelInference(net, device_mesh())
         with pytest.raises(RuntimeError, match="start"):
             pi.output_async(np.zeros((1, 4), np.float32))
+
+    def test_size_one_requests_coalesce_through_bucket_padding(self):
+        """N threads submitting SIZE-1 requests (the ObservablesProvider
+        worst case): they must execute as few multi-request device
+        batches — observable as batch_size_history entries > 1 — and
+        every coalesced batch rides the pad-to-bucket path (no bucket
+        equals the odd coalesced sizes)."""
+        import threading
+        net = MultiLayerNetwork(mlp_conf()).init()
+        pi = ParallelInference(net, device_mesh(),
+                               batch_limit=32, queue_limit_ms=60.0)
+        n_callers = 16
+        xs = [np.random.randn(1, 4).astype(np.float32)
+              for _ in range(n_callers)]
+        with pi:
+            pi.output(np.zeros((8, 4), np.float32))  # warm the compile
+            futs = [None] * n_callers
+            barrier = threading.Barrier(n_callers)
+
+            def call(i):
+                barrier.wait()
+                futs[i] = pi.output_async(xs[i])
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(n_callers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            outs = [futs[i].result(timeout=30) for i in range(n_callers)]
+        for x, o in zip(xs, outs):
+            assert o.shape == (1, 3)
+            np.testing.assert_allclose(o, np.asarray(net.output(x)),
+                                       atol=1e-5)
+        executed = list(pi.batch_size_history)
+        assert any(b > 1 for b in executed), (
+            f"16 size-1 requests never coalesced: {executed}")
+        # every async row executed exactly once (the synchronous warmup
+        # call does not ride the coalescing history)
+        assert sum(executed) == n_callers
+
+    def test_shutdown_fails_pending_and_refuses_new_requests(self):
+        """shutdown(): collector stops, queued requests fail instead of
+        hanging at .result(), and the enqueue side stays closed."""
+        net = MultiLayerNetwork(mlp_conf()).init()
+        pi = ParallelInference(net, device_mesh(), queue_limit_ms=5.0)
+        pi.start()
+        done = pi.output_async(np.zeros((2, 4), np.float32))
+        assert done.result(timeout=30).shape == (2, 3)
+        # stop the collector first so the next request stays queued,
+        # then shutdown must fail it rather than leave it pending
+        pi._running = False
+        pi._queue.put(None)
+        pi._collector.join(timeout=5)
+        pi._collector = None
+        import concurrent.futures
+        fut = concurrent.futures.Future()
+        pi._queue.put((np.zeros((1, 4), np.float32), fut))
+        pi.shutdown()
+        with pytest.raises(RuntimeError, match="stopped before"):
+            fut.result(timeout=5)
+        with pytest.raises(RuntimeError, match="shut down"):
+            pi.output_async(np.zeros((1, 4), np.float32))
+        with pytest.raises(RuntimeError, match="shut down"):
+            pi.start()

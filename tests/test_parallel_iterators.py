@@ -126,6 +126,43 @@ class TestDevicePrefetch:
         assert net.score_value < 1.2
 
 
+class TestAsyncEarlyAbandon:
+    def test_break_consumer_reaps_worker_thread(self):
+        """A consumer that abandons AsyncDataSetIterator mid-epoch must
+        not leave the prefetch worker blocked forever on the bounded
+        queue put (daemon-thread leak): closing the generator signals
+        the worker to stop, drains the queue, and joins the thread."""
+        import gc
+        import threading
+        import time
+
+        from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator
+
+        base = _iter(50, 1.0)           # far more batches than consumed
+        before = set(threading.enumerate())
+        a = AsyncDataSetIterator(base, prefetch=1)
+        for ds in a:                     # prefetch=1: queue fills, the
+            break                        # worker blocks in q.put — abandon
+        gc.collect()                     # close the abandoned generator
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            leaked = [t for t in set(threading.enumerate()) - before
+                      if t.is_alive()]
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert not leaked, f"prefetch worker leaked: {leaked}"
+        # the iterator is still usable afterwards (fresh worker per epoch)
+        assert sum(1 for _ in a) == 50
+
+    def test_exhausted_consumer_unchanged(self):
+        from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator
+        a = AsyncDataSetIterator(_iter(5, 2.0), prefetch=2)
+        seen = [ds for ds in a]
+        assert len(seen) == 5
+        assert float(seen[0].features[0, 0]) == 2.0
+
+
 def test_reset_mode_tolerates_empty_producer():
     # a zero-batch producer must be dropped, not busy-looped (regression)
     empty = ArrayDataSetIterator(np.zeros((0, 3), np.float32),
