@@ -25,13 +25,31 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 
 
 class DataSetIterator:
-    """Base: iterable of DataSet minibatches with reset()."""
+    """Base: iterable of DataSet minibatches with reset().
+
+    Checkpointable iterators additionally implement the position
+    contract `cursor()`/`seek(cursor)` (fault/ runtime): `cursor()`
+    returns a json-safe dict pinning the ingest position — epoch index,
+    batches CONSUMED within it, and the shuffle seed — and
+    `seek(cursor)` repositions a fresh iterator there so a resumed run
+    replays no consumed batch and sees the exact same remaining batch
+    sequence (shuffle permutations are re-derived from the seed, not
+    stored). The base returns None / raises: not every source is
+    seekable."""
 
     def __iter__(self) -> Iterator[DataSet]:
         raise NotImplementedError
 
     def reset(self) -> None:
         pass
+
+    def cursor(self) -> Optional[dict]:
+        return None
+
+    def seek(self, cursor: dict) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement the "
+            f"cursor()/seek() position contract")
 
     def batch_size(self) -> Optional[int]:
         return None
@@ -74,25 +92,69 @@ class ArrayDataSetIterator(DataSetIterator):
         self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
         self._batch = batch_size
         self._shuffle = shuffle
+        self._seed = seed
         self._rng = np.random.default_rng(seed)
         self._drop_last = drop_last
+        # cursor()/seek() position contract (fault/ checkpointing):
+        # epoch = passes started, yielded = batches consumed this pass,
+        # skip = batches to silently skip at the next pass start
+        self._epochs_started = 0
+        self._yielded = 0
+        self._skip = 0
 
     def __iter__(self):
         n = self.features.shape[0]
         idx = np.arange(n)
         if self._shuffle:
             self._rng.shuffle(idx)
+        self._epochs_started += 1
+        skip, self._skip = self._skip, 0
+        self._yielded = skip
         stop = n - (n % self._batch) if self._drop_last else n
-        for i in range(0, stop, self._batch):
+        for bi, i in enumerate(range(0, stop, self._batch)):
             sel = idx[i:i + self._batch]
             if self._drop_last and len(sel) < self._batch:
                 break
+            if bi < skip:        # seek(): consumed by the interrupted run
+                continue
+            # count BEFORE yielding: code after a yield only runs at the
+            # NEXT pull, so a cursor() taken while the consumer holds
+            # this batch must already include it
+            self._yielded += 1
             yield DataSet(
                 self.features[sel],
                 None if self.labels is None else self.labels[sel],
                 None if self.features_mask is None else self.features_mask[sel],
                 None if self.labels_mask is None else self.labels_mask[sel],
             )
+
+    def cursor(self):
+        """Position contract: epoch (0-based pass index), batch
+        (consumed within the pass), and the shuffle seed the
+        permutation stream derives from. Valid mid-pass."""
+        return {"epoch": max(0, self._epochs_started - 1),
+                "batch": int(self._yielded),
+                "seed": int(self._seed),
+                "shuffle": bool(self._shuffle)}
+
+    def seek(self, cursor: dict):
+        """Reposition to `cursor` without replaying consumed batches:
+        the shuffle rng is rebuilt from the seed and fast-forwarded by
+        replaying the prior passes' permutation draws (a Generator's
+        shuffle consumes state by LENGTH only), so the resumed pass
+        draws the identical permutation the interrupted run was
+        consuming — and the next pass continues the same stream."""
+        epoch = int(cursor["epoch"])
+        self._seed = int(cursor.get("seed", self._seed))
+        self._rng = np.random.default_rng(self._seed)
+        if self._shuffle:
+            n = self.features.shape[0]
+            scratch = np.arange(n)
+            for _ in range(epoch):
+                self._rng.shuffle(scratch)
+        self._epochs_started = epoch
+        self._skip = int(cursor["batch"])
+        self._yielded = 0
 
     def batch_size(self):
         return self._batch
@@ -118,6 +180,11 @@ class AsyncDataSetIterator(DataSetIterator):
     def __init__(self, base: DataSetIterator, prefetch: int = 4):
         self.base = base
         self.prefetch = prefetch
+        # batches handed to the CONSUMER this pass — the prefetch queue
+        # means the base iterator runs AHEAD of consumption, so the
+        # checkpointable position is counted here, not in the base
+        self._consumed = 0
+        self._seek_offset = 0
 
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
@@ -152,6 +219,9 @@ class AsyncDataSetIterator(DataSetIterator):
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
+        # a seek() positions the base mid-pass; consumption resumes
+        # from that absolute batch index, not from zero
+        self._consumed, self._seek_offset = self._seek_offset, 0
         try:
             while True:
                 item = q.get()
@@ -159,6 +229,9 @@ class AsyncDataSetIterator(DataSetIterator):
                     if err:
                         raise err[0]
                     return
+                # count BEFORE yielding (a cursor() taken while the
+                # consumer holds this batch must already include it)
+                self._consumed += 1
                 yield item
         finally:
             # GeneratorExit (consumer break/close) and normal exhaustion
@@ -174,6 +247,22 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def reset(self):
         self.base.reset()
+
+    def cursor(self):
+        """Position contract: the base's cursor with the batch index
+        replaced by what the CONSUMER has actually taken — prefetched-
+        but-unconsumed batches must be replayed after a restore, not
+        skipped (they never reached the training loop)."""
+        cur = self.base.cursor()
+        if cur is None:
+            return None
+        cur = dict(cur)
+        cur["batch"] = int(self._consumed)
+        return cur
+
+    def seek(self, cursor: dict):
+        self.base.seek(cursor)
+        self._seek_offset = int(cursor.get("batch", 0))
 
     def batch_size(self):
         return self.base.batch_size()
@@ -295,6 +384,12 @@ class TimedDataSetIterator(DataSetIterator):
 
     def reset(self):
         self.base.reset()
+
+    def cursor(self):
+        return self.base.cursor()
+
+    def seek(self, cursor):
+        self.base.seek(cursor)
 
     def batch_size(self):
         return self.base.batch_size()

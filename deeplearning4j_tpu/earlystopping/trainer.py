@@ -30,12 +30,70 @@ class _IterationGuard(TrainingListener):
 
 
 class EarlyStoppingTrainer:
-    def __init__(self, config: EarlyStoppingConfiguration, model, train_data):
+    def __init__(self, config: EarlyStoppingConfiguration, model, train_data,
+                 checkpointer=None):
+        """`checkpointer`: optional AsyncCheckpointer (or directory
+        path) — persists the FULL training state plus the early-stopping
+        trackers (best score/epoch, epochs-without-improvement, score
+        history) and the best model's arrays after every evaluated
+        epoch, so an early-stopped run is resumable with
+        `fit(resume=True)` (fault/ runtime)."""
         self.config = config
         self.model = model
         self.train_data = train_data
+        if checkpointer is not None:
+            from deeplearning4j_tpu.fault import AsyncCheckpointer
+            if not isinstance(checkpointer, AsyncCheckpointer):
+                checkpointer = AsyncCheckpointer(checkpointer)
+        self.checkpointer = checkpointer
 
-    def fit(self) -> EarlyStoppingResult:
+    # --------------------------------------------------- fault persistence
+    def _capture_best(self):
+        """Host snapshot of the current (new-best) model arrays."""
+        from deeplearning4j_tpu.fault import state as fs
+        return {
+            "params": fs.unflatten_arrays(fs.flatten_arrays(
+                self.model.params)),
+            "net_state": fs.unflatten_arrays(fs.flatten_arrays(
+                self.model.net_state)) if self.model.net_state else {},
+            "updater_state": fs.unflatten_arrays(fs.flatten_arrays(
+                self.model.updater_state)),
+        }
+
+    def _save_checkpoint(self, epoch, best_score, best_epoch,
+                         score_vs_epoch, best_arrays):
+        from deeplearning4j_tpu.fault import capture_training_state
+        state = capture_training_state(
+            self.model,
+            iterator=(self.train_data
+                      if hasattr(self.train_data, "cursor") else None),
+            extra_meta={"earlystopping": {
+                "epoch": epoch,
+                "best_score": (None if math.isinf(best_score)
+                               else float(best_score)),
+                "best_epoch": best_epoch,
+                "epochs_since_best": epoch - best_epoch,
+                "score_vs_epoch": {str(k): float(v)
+                                   for k, v in score_vs_epoch.items()},
+            }})
+        # the best arrays ride EVERY checkpoint on purpose: retention GC
+        # may delete the checkpoint where the best was first recorded,
+        # and resume reads only the newest valid one (the arrays are a
+        # one-time host snapshot — per-save cost is the extra npz bytes)
+        if best_arrays is not None:
+            state["arrays"]["es_best"] = best_arrays
+        self.checkpointer.save(state, int(self.model.iteration_count))
+
+    def _model_from_arrays(self, arrays):
+        from deeplearning4j_tpu.fault import state as fs
+        m = fs.build_model({"model_type": type(self.model).__name__,
+                            "configuration": self.model.conf.to_dict()})
+        fs.restore_training_state(
+            m, {"arrays": arrays,
+                "meta": {"iteration_count": 0, "epoch_count": 0}})
+        return m
+
+    def fit(self, resume: bool = False) -> EarlyStoppingResult:
         cfg = self.config
         for c in cfg.epoch_termination_conditions:
             c.initialize()
@@ -45,8 +103,47 @@ class EarlyStoppingTrainer:
         self.model.listeners = list(self.model.listeners) + [guard]
 
         best_score, best_epoch = math.inf, -1
+        best_arrays = None
         score_vs_epoch = {}
         epoch = 0
+        if resume:
+            if self.checkpointer is None:
+                raise ValueError(
+                    "fit(resume=True) needs a checkpointer; construct "
+                    "EarlyStoppingTrainer(..., checkpointer=dir)")
+            from deeplearning4j_tpu.fault import (
+                load_latest_valid,
+                restore_training_state,
+            )
+            try:
+                state, _ = load_latest_valid(self.checkpointer.directory)
+            except FileNotFoundError:
+                state = None      # nothing saved yet: cold start
+            if state is not None:
+                restore_training_state(self.model, state)
+                es = state["meta"].get("earlystopping") or {}
+                if es.get("best_score") is not None:
+                    best_score = float(es["best_score"])
+                best_epoch = int(es.get("best_epoch", -1))
+                score_vs_epoch = {int(k): v for k, v in
+                                  (es.get("score_vs_epoch") or {}).items()}
+                epoch = int(es.get("epoch", -1)) + 1
+                # trajectory parity: the checkpoint was taken at an
+                # epoch END, so the iterator must continue at the NEXT
+                # pass of the same shuffle stream (not replay the
+                # completed pass, not restart the stream at pass 0)
+                cur = state["meta"].get("iterator")
+                if cur is not None:
+                    try:
+                        self.train_data.seek({"epoch": epoch, "batch": 0,
+                                              "seed": cur.get("seed"),
+                                              "shuffle": cur.get("shuffle")})
+                    except NotImplementedError:
+                        pass   # source without the position contract
+                best_arrays = state["arrays"].get("es_best")
+                if best_arrays is not None and cfg.model_saver:
+                    cfg.model_saver.save_best_model(
+                        self._model_from_arrays(best_arrays), best_score)
         reason = TerminationReason.MAX_EPOCHS
         details = "no termination condition triggered"
         while True:
@@ -63,8 +160,13 @@ class EarlyStoppingTrainer:
                     best_score, best_epoch = score, epoch
                     if cfg.model_saver:
                         cfg.model_saver.save_best_model(self.model, score)
+                    if self.checkpointer is not None:
+                        best_arrays = self._capture_best()
                 if cfg.save_last_model and cfg.model_saver:
                     cfg.model_saver.save_latest_model(self.model, score)
+                if self.checkpointer is not None:
+                    self._save_checkpoint(epoch, best_score, best_epoch,
+                                          score_vs_epoch, best_arrays)
             stop = False
             last = score_vs_epoch.get(epoch, self.model.score())
             for c in cfg.epoch_termination_conditions:
@@ -77,8 +179,16 @@ class EarlyStoppingTrainer:
                 break
             epoch += 1
 
-        best_model = (cfg.model_saver.get_best_model()
-                      if cfg.model_saver and best_epoch >= 0 else self.model)
+        if self.checkpointer is not None:
+            self.checkpointer.wait()   # durable before reporting done
+        if cfg.model_saver and best_epoch >= 0:
+            best_model = cfg.model_saver.get_best_model()
+        elif best_arrays is not None and best_epoch >= 0:
+            # no saver configured but the checkpointer kept the best
+            # arrays (a resumed run's best may predate this process)
+            best_model = self._model_from_arrays(best_arrays)
+        else:
+            best_model = self.model
         self.model.listeners = [l for l in self.model.listeners if l is not guard]
         return EarlyStoppingResult(
             termination_reason=reason,

@@ -1,0 +1,63 @@
+"""Preemption-tolerant training runtime.
+
+TPU fleets preempt: the TPU-generations survey (arXiv:2606.15870)
+treats checkpoint/restore cadence matched to MTBF as a first-class
+design axis at pod scale, and TensorFlow (arXiv:1605.08695) built its
+fault-tolerance story on periodic checkpointing. This package makes a
+kill at step k a non-event:
+
+- `AsyncCheckpointer` — versioned, checksummed, per-host-sharded,
+  atomically-committed (tmp+fsync+rename) full-state checkpoints
+  written by a background thread with keep-last-N / keep-every-K
+  retention (fault/checkpointer.py);
+- `capture_training_state` / `restore_training_state` — the complete
+  state schema: params, per-layer updater state, gradient-sharing
+  residual + τ, layer running stats, iteration/epoch counters (which
+  pin the per-step rng fold), iterator cursor, normalizer stats
+  (fault/state.py);
+- `CheckpointListener` — the fit-loop wiring via the ordinary listener
+  bus, honoring fused multi-step boundaries (fault/listener.py);
+- `resume(dir)` — exact restart from the newest VALID checkpoint, with
+  corrupt-shard fallback, trainer residual/τ restore and elastic
+  replica-count re-sharding (fault/resume.py);
+- fault-injection drills: scripted preemption, shard corruption,
+  auto-resume driving (fault/drill.py + scripts/fault_drill.py).
+
+Interrupt + resume reproduces the uninterrupted run's params and
+updater state bit-identically on CPU (tests/test_fault_runtime.py);
+docs/FAULT_TOLERANCE.md documents the state schema, manifest format
+and drill recipes.
+"""
+
+from deeplearning4j_tpu.fault.checkpointer import (
+    AsyncCheckpointer,
+    list_checkpoints,
+    load_checkpoint,
+)
+from deeplearning4j_tpu.fault.drill import (
+    PreemptionListener,
+    auto_resume,
+    checkpoint_meta,
+    corrupt_checkpoint,
+)
+from deeplearning4j_tpu.fault.errors import (
+    CheckpointCorruptError,
+    SimulatedPreemption,
+)
+from deeplearning4j_tpu.fault.listener import CheckpointListener
+from deeplearning4j_tpu.fault.resume import load_latest_valid, resume
+from deeplearning4j_tpu.fault.state import (
+    capture_training_state,
+    reshard_replica_stack,
+    restore_normalizer,
+    restore_training_state,
+)
+
+__all__ = [
+    "AsyncCheckpointer", "CheckpointListener", "CheckpointCorruptError",
+    "SimulatedPreemption", "PreemptionListener",
+    "capture_training_state", "restore_training_state",
+    "restore_normalizer", "reshard_replica_stack",
+    "resume", "load_latest_valid", "list_checkpoints", "load_checkpoint",
+    "auto_resume", "corrupt_checkpoint", "checkpoint_meta",
+]

@@ -1,0 +1,25 @@
+"""Fault-runtime exception types (dependency-free so every layer —
+util serializers, checkpointer, drills — can raise/catch them without
+import cycles)."""
+
+from __future__ import annotations
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification (checksum mismatch,
+    truncated shard, unreadable container). Raised instead of the raw
+    numpy/zip traceback so callers can implement previous-checkpoint
+    fallback."""
+
+
+class SimulatedPreemption(BaseException):
+    """Raised by the fault-injection drill at the scripted step.
+
+    Derives from BaseException (like KeyboardInterrupt) so ordinary
+    `except Exception` recovery blocks inside training code cannot
+    swallow the simulated kill — a real SIGTERM would not be
+    catchable there either."""
+
+    def __init__(self, step: int):
+        super().__init__(f"simulated preemption at step {step}")
+        self.step = step

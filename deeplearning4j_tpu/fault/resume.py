@@ -1,0 +1,81 @@
+"""`resume(dir)` — exact-restart entry point with corrupt-checkpoint
+fallback.
+
+Walks committed checkpoints newest-first; the first one that passes
+integrity verification wins, and every corrupt newer one degrades with
+a logged warning instead of a crash (the acceptance contract: a
+truncated/bit-flipped newest shard falls back to the previous
+checkpoint). Restores the model (built from the stored configuration
+when none is passed), the trainer's residual/τ/per-replica state, the
+iterator position, and bumps ``restore_total`` on the monitor registry.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+from deeplearning4j_tpu.fault import state as fstate
+from deeplearning4j_tpu.fault.checkpointer import (
+    list_checkpoints,
+    load_checkpoint,
+)
+from deeplearning4j_tpu.fault.errors import CheckpointCorruptError
+
+log = logging.getLogger("deeplearning4j_tpu.fault")
+
+
+def load_latest_valid(directory, *, max_step: Optional[int] = None
+                      ) -> Tuple[Dict[str, Any], int]:
+    """(state, step) of the newest checkpoint that verifies; corrupt
+    ones are skipped with a warning. Raises FileNotFoundError when the
+    directory has no committed checkpoints at all, and
+    CheckpointCorruptError when every committed checkpoint is damaged."""
+    steps = list_checkpoints(directory)
+    if max_step is not None:
+        steps = [s for s in steps if s <= max_step]
+    if not steps:
+        raise FileNotFoundError(
+            f"no committed checkpoints under {directory}")
+    last_err: Optional[CheckpointCorruptError] = None
+    for step in reversed(steps):
+        try:
+            return load_checkpoint(directory, step), step
+        except CheckpointCorruptError as e:
+            log.warning(
+                "checkpoint step %d under %s is corrupt (%s); falling "
+                "back to the previous checkpoint", step, directory, e)
+            last_err = e
+    raise CheckpointCorruptError(
+        f"every committed checkpoint under {directory} failed "
+        f"verification; newest error: {last_err}")
+
+
+def resume(directory, model=None, *, trainer=None, iterator=None,
+           max_step: Optional[int] = None):
+    """Restore the newest valid checkpoint. Returns ``(model, meta)``.
+
+    `model=None` rebuilds the container from the stored configuration.
+    `trainer` (ParallelTrainer / ShardedParallelTrainer /
+    PipelineParallelTrainer) additionally restores gradient-sharing
+    residual + τ and per-replica updater state — including the elastic
+    re-shard when the current replica count differs from the one the
+    checkpoint was written with. `iterator` is seeked to the stored
+    ingest cursor so no consumed batch replays."""
+    state, step = load_latest_valid(directory, max_step=max_step)
+    meta = state["meta"]
+    if model is None:
+        model = fstate.build_model(meta)
+    fstate.restore_training_state(model, state, trainer=trainer,
+                                  iterator=iterator)
+    from deeplearning4j_tpu import monitor
+    if monitor.is_enabled():
+        monitor.registry().counter(
+            "restore_total",
+            help="successful training-state restores").inc()
+        monitor.registry().gauge(
+            "restore_last_step",
+            help="step of the last restored checkpoint").set(step)
+    log.info("resumed training state from step %d under %s", step,
+             directory)
+    return model, meta

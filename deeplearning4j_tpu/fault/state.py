@@ -1,0 +1,291 @@
+"""Complete-training-state capture/restore.
+
+The zip `ModelSerializer` persists *model weights*; surviving a
+preemption needs the whole optimization process: params + per-layer
+updater state, gradient-sharing residual and τ, layer running stats,
+iteration/epoch counters (the per-step rng key is `fold_in(PRNGKey(
+seed+1), iteration_count)` in every fit loop, so restoring the counters
+restores the rng stream bit-exactly), dataset-iterator cursor and
+normalizer statistics. This module defines that state schema and the
+pure capture/restore halves the checkpointer and `resume()` build on.
+
+Capture sources:
+- outside a trainer, the model's own attribute trees are the live state
+  (`fit()` writes params/updater_state back every step);
+- inside `ParallelTrainer` / `ShardedParallelTrainer` /
+  `PipelineParallelTrainer`, the live state is held in fit-local device
+  arrays, NOT on the model — those fits publish a
+  `model._live_state_provider` callable for the duration of the fit and
+  the capture goes through it (including per-replica updater state and
+  the threshold residual/τ, which never exist on the model at all).
+
+Trees are flattened to npz-friendly flat dicts with `\\x1f`-joined path
+keys (the ASCII unit separator cannot appear in layer indices or graph
+node names) and carry a crc32 per array so restore can detect silent
+shard corruption (`CheckpointCorruptError`) instead of loading garbage.
+The ``stacked::`` run packing of nn/scan_stack.py exists only inside
+jitted step programs — every tree here is per-layer-keyed by contract,
+so checkpoints are independent of the scan/pack configuration that
+wrote them.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.fault.errors import CheckpointCorruptError
+
+STATE_FORMAT_VERSION = 1
+
+# path separator inside flattened array keys; ASCII unit separator —
+# cannot collide with layer indices ("0", "1", ...) or sane node names
+SEP = "\x1f"
+
+
+# --------------------------------------------------------------- flattening
+def flatten_arrays(tree, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Nested str-keyed dicts of array leaves → flat {path: np.ndarray}.
+    Leaves are materialized on host (device→host copy happens HERE, at
+    the step boundary, before any donation can invalidate them)."""
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            k = str(k)
+            if SEP in k:
+                raise ValueError(
+                    f"tree key {k!r} contains the reserved path "
+                    f"separator U+001F")
+            out.update(flatten_arrays(v, f"{prefix}{k}{SEP}"))
+        return out
+    if not getattr(tree, "is_fully_addressable", True):
+        raise ValueError(
+            f"array at {prefix[:-1]!r} spans processes this host cannot "
+            f"address (multi-host tensor-sharded state); the fault "
+            f"checkpointer covers replicated/data-parallel state — "
+            f"checkpoint TP-sharded multi-host models through "
+            f"util.sharded_checkpoint.ShardedCheckpoint (Orbax)")
+    out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def unflatten_arrays(flat: Dict[str, np.ndarray]) -> Dict:
+    """Inverse of `flatten_arrays`."""
+    out: Dict = {}
+    for path, arr in flat.items():
+        parts = path.split(SEP)
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return out
+
+
+def checksum_array(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def checksum_flat(flat: Dict[str, np.ndarray]) -> Dict[str, int]:
+    return {k: checksum_array(v) for k, v in flat.items()}
+
+
+def verify_checksums(flat: Dict[str, np.ndarray],
+                     expected: Dict[str, int], *, context: str = ""):
+    """Raise `CheckpointCorruptError` naming every mismatching/missing
+    array — the caller's cue to fall back to an older checkpoint."""
+    bad = []
+    for key, crc in expected.items():
+        if key not in flat:
+            bad.append(f"{key!r} missing")
+        elif checksum_array(flat[key]) != crc:
+            bad.append(f"{key!r} checksum mismatch")
+    if bad:
+        raise CheckpointCorruptError(
+            f"{context or 'checkpoint'} failed integrity verification: "
+            + "; ".join(bad[:8])
+            + (f" (+{len(bad) - 8} more)" if len(bad) > 8 else ""))
+
+
+# ------------------------------------------------------------------ capture
+def capture_training_state(model, *, iterator=None, normalizer=None,
+                           step: Optional[int] = None,
+                           epoch: Optional[int] = None,
+                           extra_meta: Optional[Dict] = None
+                           ) -> Dict[str, Any]:
+    """Snapshot the COMPLETE training state to host memory.
+
+    Returns ``{"arrays": {section: nested tree of np arrays},
+    "meta": {...json-safe...}}``. `step`/`epoch` override the model's
+    counters (the CheckpointListener fires before the fit loop
+    increments them); `iterator` contributes its `cursor()` when it has
+    one; `normalizer` contributes its fitted statistics.
+    """
+    provider = getattr(model, "_live_state_provider", None)
+    if provider is not None:
+        src = provider()
+    else:
+        src = {"params": model.params, "net_state": model.net_state,
+               "updater_state": model.updater_state}
+    host = lambda t: unflatten_arrays(flatten_arrays(t)) if t else {}
+    arrays: Dict[str, Any] = {
+        "params": host(src["params"]),
+        "net_state": host(src.get("net_state")),
+        "updater_state": host(src.get("updater_state")),
+    }
+    meta: Dict[str, Any] = {
+        "format_version": STATE_FORMAT_VERSION,
+        "model_type": type(model).__name__,
+        "configuration": model.conf.to_dict(),
+        "iteration_count": int(model.iteration_count if step is None
+                               else step),
+        "epoch_count": int(model.epoch_count if epoch is None else epoch),
+        "score": float(getattr(model, "score_value", float("nan"))),
+    }
+    if src.get("trainer_arrays"):
+        arrays["trainer"] = host(src["trainer_arrays"])
+    if src.get("trainer_meta"):
+        meta["trainer"] = dict(src["trainer_meta"])
+    if iterator is not None:
+        cur = getattr(iterator, "cursor", lambda: None)()
+        if cur is not None:
+            meta["iterator"] = dict(cur)
+    if normalizer is not None:
+        nmeta, narrays = normalizer.state()
+        meta["normalizer"] = nmeta
+        arrays["normalizer"] = dict(narrays)
+    if extra_meta:
+        meta.update(extra_meta)
+    return {"arrays": arrays, "meta": meta}
+
+
+# ------------------------------------------------------------------ restore
+def build_model(meta: Dict[str, Any]):
+    """Reconstruct an uninitialized container from checkpoint meta
+    (same two-phase conf→init restore `ModelSerializer` uses)."""
+    if meta["model_type"] == "ComputationGraph":
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        return ComputationGraph(
+            ComputationGraphConfiguration.from_dict(meta["configuration"]))
+    from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    return MultiLayerNetwork(
+        MultiLayerConfiguration.from_dict(meta["configuration"]))
+
+
+def _deep_merge(base, overlay):
+    """Overlay leaves replace base leaves, dicts merge recursively.
+    Restore goes through a freshly-initialized tree merged with the
+    checkpoint because flat npz keys cannot represent EMPTY dicts —
+    e.g. a stateless Sgd updater's `{}` slots — and replacing the whole
+    tree would silently drop that structure (breaking
+    `_apply_updates`'s `upd_state[lk][pk]` lookups on resume)."""
+    if not isinstance(base, dict) or not isinstance(overlay, dict):
+        return overlay
+    out = dict(base)
+    for k, v in overlay.items():
+        out[k] = _deep_merge(base[k], v) if k in base else v
+    return out
+
+
+def restore_training_state(model, state: Dict[str, Any], *,
+                           trainer=None, iterator=None):
+    """Load a captured/loaded state into `model` (and optionally a
+    trainer's residual/τ/per-replica state + an iterator's position).
+    Returns `model`. Bit-exact resume contract: counters are restored
+    so the per-step rng fold and updater step counts continue exactly
+    where the interrupted run stopped."""
+    import jax
+    import jax.numpy as jnp
+
+    arrays, meta = state["arrays"], state["meta"]
+    as_dev = lambda t: {} if not t else jax.tree_util.tree_map(jnp.asarray, t)
+    if not getattr(model, "_initialized", False):
+        model.init()
+    model.params = as_dev(_deep_merge(model.params,
+                                      arrays.get("params") or {}))
+    model.net_state = as_dev(_deep_merge(model.net_state,
+                                         arrays.get("net_state") or {}))
+    model.updater_state = as_dev(_deep_merge(model.updater_state,
+                                             arrays.get("updater_state")
+                                             or {}))
+    model.iteration_count = int(meta.get("iteration_count", 0))
+    model.epoch_count = int(meta.get("epoch_count", 0))
+    if "score" in meta:
+        model.score_value = float(meta["score"])
+    model._initialized = True
+    if trainer is not None and hasattr(trainer, "_restore_fault_state"):
+        trainer._restore_fault_state(arrays.get("trainer") or {},
+                                     meta.get("trainer") or {})
+    if iterator is not None and meta.get("iterator") is not None:
+        try:
+            # the DataSetIterator base defines seek() as raising, so a
+            # hasattr check can never distinguish support — probe by
+            # calling and translate into the actionable error
+            iterator.seek(meta["iterator"])
+        except NotImplementedError as e:
+            raise ValueError(
+                f"checkpoint carries an iterator cursor but "
+                f"{type(iterator).__name__} does not implement the "
+                f"cursor()/seek() position contract "
+                f"(ArrayDataSetIterator and AsyncDataSetIterator do)"
+            ) from e
+    return model
+
+
+def restore_normalizer(state: Dict[str, Any]):
+    """The fitted normalizer stored in a checkpoint, or None."""
+    meta = state["meta"].get("normalizer")
+    if meta is None:
+        return None
+    from deeplearning4j_tpu.datasets.normalizers import normalizer_from_meta
+    return normalizer_from_meta(meta, state["arrays"].get("normalizer", {}))
+
+
+# ------------------------------------------------------- elastic resharding
+def reshard_replica_stack(tree, new_n: int, *, kind: str = "state"):
+    """Re-shard a per-replica stacked tree (leading replica axis) to a
+    different replica count — the elastic-resume path when a job comes
+    back on more/fewer chips than it checkpointed with.
+
+    kind="residual": the error-feedback residual is un-sent update
+    MASS; the decode applies τ·Σ_r enc_r / N, so what must be preserved
+    across a replica-count change is the SUM over replicas — each new
+    replica gets sum/new_n and Σ residual is bit-for-bit conserved.
+
+    kind="state": per-replica updater state drifts like independent
+    workers; on an elastic restart every new replica starts from the
+    replica MEAN for float leaves (the same averaging rule the
+    param-averaging mode applies to updater state) and replica 0's
+    value for integer/step-count leaves.
+    """
+    def one(a):
+        a = np.asarray(a)
+        if a.ndim == 0:
+            return a
+        old_n = a.shape[0]
+        if old_n == new_n:
+            return a
+        if kind == "residual":
+            total = a.sum(axis=0, dtype=np.float64)
+            return np.broadcast_to(
+                (total / new_n).astype(a.dtype), (new_n,) + a.shape[1:]
+            ).copy()
+        if np.issubdtype(a.dtype, np.floating):
+            m = a.mean(axis=0)
+        else:
+            m = a[0]
+        return np.broadcast_to(m, (new_n,) + a.shape[1:]).copy()
+
+    import jax
+    return jax.tree_util.tree_map(one, tree)
+
+
+def stacked_replica_count(tree) -> Optional[int]:
+    """Leading replica-axis extent of a per-replica stacked tree (None
+    for an empty tree)."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(np.shape(leaves[0])[0]) if leaves else None

@@ -370,6 +370,10 @@ class ComputationGraph:
         self.listeners = list(listeners)
         return self
 
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+        return self
+
     # --------------------------------------------------------------- forward
     def _chains(self, params):
         """Scan-over-layers chain plan: maximal single-consumer chains
@@ -762,7 +766,12 @@ class ComputationGraph:
                                              # MultiLayerNetwork fused path:
                                              # flush-time ETL charged to the
                                              # first fused iteration
-                                             etl_ms=etl_ms if j == 0 else 0.0)
+                                             etl_ms=etl_ms if j == 0 else 0.0,
+                                             # only the group's LAST callback
+                                             # sees params consistent with the
+                                             # iteration count (checkpointable)
+                                             step_boundary=(
+                                                 j == len(pending) - 1))
                     self.iteration_count += 1
 
         def run_one(xs, ys, fmasks, lmasks, n_examples, etl_ms=0.0):
@@ -966,6 +975,21 @@ class ComputationGraph:
             h = acts[n]
             outs.append(h[:, -1, :] if squeeze and h.ndim == 3 else h)
         return outs[0] if len(outs) == 1 else tuple(outs)
+
+    # ------------------------------------------------------------- resume
+    @staticmethod
+    def resume(directory) -> "ComputationGraph":
+        """Rebuild from the newest VALID full-state checkpoint under
+        `directory` (fault/ runtime) — exact-restart counterpart of
+        `MultiLayerNetwork.resume`; corrupt newest checkpoints fall
+        back to older ones with a logged warning."""
+        from deeplearning4j_tpu import fault
+        model, _ = fault.resume(directory)
+        if not isinstance(model, ComputationGraph):
+            raise TypeError(
+                f"checkpoint under {directory} holds a "
+                f"{type(model).__name__}; use that container's resume()")
+        return model
 
     # ------------------------------------------------------------ pretrain
     def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):

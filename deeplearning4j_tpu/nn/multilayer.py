@@ -603,11 +603,17 @@ class MultiLayerNetwork:
             with monitor.span("fit/update", fused_steps=len(pending)):
                 for j, (x, y) in enumerate(pending):
                     self.score_value = float(losses[j])
+                    # mid-group callbacks see POST-group params with a
+                    # mid-group iteration count; only the last callback
+                    # is a state-consistent step boundary (checkpoint
+                    # listeners key off this)
                     listeners.iteration_done(self, self.iteration_count,
                                              self.epoch_count, self.score_value,
                                              batch_size=int(np.shape(x)[0]),
                                              etl_ms=etl_ms if j == 0 else 0.0,
-                                             batch=(x, y, None, None))
+                                             batch=(x, y, None, None),
+                                             step_boundary=(
+                                                 j == len(pending) - 1))
                     self.iteration_count += 1
 
         mon_on = monitor.is_enabled()
@@ -859,6 +865,23 @@ class MultiLayerNetwork:
                 jnp.array, self.updater_state)
             clone._initialized = True
         return clone
+
+    # ------------------------------------------------------------- resume
+    @staticmethod
+    def resume(directory) -> "MultiLayerNetwork":
+        """Rebuild from the newest VALID full-state checkpoint under
+        `directory` (fault/ runtime): params, updater state, running
+        stats and counters all restored, so a follow-up `fit()`
+        continues the interrupted run bit-exactly (the per-step rng key
+        is derived from the restored iteration count). Corrupt newest
+        checkpoints fall back to older ones with a logged warning."""
+        from deeplearning4j_tpu import fault
+        model, _ = fault.resume(directory)
+        if not isinstance(model, MultiLayerNetwork):
+            raise TypeError(
+                f"checkpoint under {directory} holds a "
+                f"{type(model).__name__}; use that container's resume()")
+        return model
 
     # ------------------------------------------------------------ pretrain
     def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):

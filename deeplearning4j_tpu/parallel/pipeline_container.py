@@ -373,29 +373,62 @@ class PipelineParallelTrainer:
                                       + monitor.extra_listeners())
         rng_root = jax.random.PRNGKey(model.conf.seed + 1)
         params, upd, state = model.params, model.updater_state, model.net_state
-        for _ in range(epochs):
-            iterator.reset()
-            for ds in iterator:
-                if ds.features_mask is not None or ds.labels_mask is not None:
-                    raise ValueError("masks are not supported under PP")
-                self._validate_batch(ds.num_examples(), "fit batch")
-                rng = jax.random.fold_in(rng_root, model.iteration_count)
-                t0 = time.perf_counter() if self.stats is not None else 0.0
-                params, upd, new_state, loss = self._step(
-                    params, upd, state, model.iteration_count,
-                    jnp.asarray(ds.features), jnp.asarray(ds.labels), rng)
-                state = {**state, **new_state}
-                if self.stats is not None:
-                    jax.block_until_ready(loss)
-                    self.stats.record("sync_step",
-                                      time.perf_counter() - t0,
-                                      iteration=model.iteration_count)
-                    self.stats.next_round()
-                model.score_value = float(loss)
-                listeners.iteration_done(model, model.iteration_count,
-                                         model.epoch_count, model.score_value,
-                                         batch_size=ds.num_examples())
-                model.iteration_count += 1
-            model.epoch_count += 1
+
+        def live_state():
+            # fault/ checkpointing: fit-local device trees (the model's
+            # attributes are only written back when fit returns)
+            return {"params": params, "net_state": state,
+                    "updater_state": upd,
+                    "trainer_meta": {"kind": "pipeline",
+                                     "trainer": "pipeline",
+                                     "n_stages": self.n_stages}}
+
+        model._live_state_provider = live_state
+        try:
+            # epoch/fit listener events fire like the containers' fit
+            # loops (checkpoint listeners drain their writer at fit end)
+            listeners.on_fit_start(model)
+            for _ in range(epochs):
+                listeners.on_epoch_start(model, model.epoch_count)
+                iterator.reset()
+                for ds in iterator:
+                    if ds.features_mask is not None or \
+                            ds.labels_mask is not None:
+                        raise ValueError("masks are not supported under PP")
+                    self._validate_batch(ds.num_examples(), "fit batch")
+                    rng = jax.random.fold_in(rng_root, model.iteration_count)
+                    t0 = time.perf_counter() if self.stats is not None else 0.0
+                    params, upd, new_state, loss = self._step(
+                        params, upd, state, model.iteration_count,
+                        jnp.asarray(ds.features), jnp.asarray(ds.labels), rng)
+                    state = {**state, **new_state}
+                    if self.stats is not None:
+                        jax.block_until_ready(loss)
+                        self.stats.record("sync_step",
+                                          time.perf_counter() - t0,
+                                          iteration=model.iteration_count)
+                        self.stats.next_round()
+                    model.score_value = float(loss)
+                    listeners.iteration_done(model, model.iteration_count,
+                                             model.epoch_count,
+                                             model.score_value,
+                                             batch_size=ds.num_examples())
+                    model.iteration_count += 1
+                listeners.on_epoch_end(model, model.epoch_count)
+                model.epoch_count += 1
+            listeners.on_fit_end(model)
+        finally:
+            model._live_state_provider = None
         model.params, model.updater_state, model.net_state = params, upd, state
+        return model
+
+    def resume(self, directory, *, iterator=None):
+        """Restore the model's full training state from the newest
+        VALID checkpoint under `directory` (fault/ runtime). The GPipe
+        step keeps the container's per-layer param tree as the
+        optimization state, so a model-level restore is complete — a
+        following `fit()` continues the interrupted run."""
+        from deeplearning4j_tpu import fault
+        model, _ = fault.resume(directory, model=self.model, trainer=self,
+                                iterator=iterator)
         return model

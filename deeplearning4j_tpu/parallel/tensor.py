@@ -181,6 +181,9 @@ class ShardedParallelTrainer:
         self._thr_step = None
         self._thr_residual_r = None
         self._thr_tau = None
+        # exact-resume per-replica updater stack restored by
+        # _restore_fault_state (fault/), consumed by the next fit()
+        self._resume_upd_r = None
         self._step = None
         # ComputationGraph models pack features/labels as tuples
         self._is_graph = not hasattr(model, "_forward_core")
@@ -264,6 +267,62 @@ class ShardedParallelTrainer:
                 out[lk][pn] = jax.tree_util.tree_map(
                     lambda a: place(spec, a), v)
         return out
+
+    def _place_per_worker(self, stacked, spec_for):
+        """Place an ALREADY-stacked per-replica host tree (leading
+        replica axis) under the rep shardings — the restore-side
+        counterpart of `_replicate_per_worker` (fault/ resume hands
+        back per-replica state that must keep its drift, not be
+        re-broadcast)."""
+        from deeplearning4j_tpu.parallel.placement import gput
+
+        def place(path_spec, a):
+            a = np.asarray(a)
+            return gput(a, self._rep_sharding(a[0] if a.ndim else a,
+                                              path_spec))
+
+        out = {}
+        for lk, sub in stacked.items():
+            out[lk] = {}
+            for pn, v in sub.items():
+                spec = spec_for(lk, pn)
+                out[lk][pn] = jax.tree_util.tree_map(
+                    lambda a: place(spec, a), v)
+        return out
+
+    # ---------------------------------------------------------- fault/resume
+    def _restore_fault_state(self, arrays, meta):
+        """fault.resume() hook: threshold residual + τ + per-replica
+        updater stacks back under their DP x TP shardings, re-sharding
+        the replica axis on an elastic replica-count change."""
+        if meta.get("kind") != "threshold" or not arrays:
+            return
+        from deeplearning4j_tpu.fault import state as fs
+        self._build_shardings()
+        n = (int(self.mesh.shape[self.data_axis])
+             if self.data_axis in self.mesh.shape else 1)
+        spec_for = lambda lk, pn: self.param_specs[lk][pn]
+        res_r = arrays.get("residual_r")
+        if res_r:
+            self._thr_residual_r = self._place_per_worker(
+                fs.reshard_replica_stack(res_r, n, kind="residual"),
+                spec_for)
+        tau = arrays.get("tau")
+        if tau is not None:
+            self._thr_tau = jnp.float32(np.asarray(tau))
+        upd_r = arrays.get("upd_r")
+        if upd_r:
+            self._resume_upd_r = self._place_per_worker(
+                fs.reshard_replica_stack(upd_r, n, kind="state"), spec_for)
+
+    def resume(self, directory, *, iterator=None):
+        """Restore model + trainer state from the newest VALID
+        checkpoint under `directory` (fault/ runtime). Returns the
+        model; a following `fit()` continues the interrupted run."""
+        from deeplearning4j_tpu import fault
+        model, _ = fault.resume(directory, model=self.model, trainer=self,
+                                iterator=iterator)
+        return model
 
     def _build_threshold(self):
         """Threshold sync step for DP x TP: shard_map is MANUAL over the
@@ -387,6 +446,11 @@ class ShardedParallelTrainer:
         # reference worker advances its own updater).
         def place_upd():
             if thr:
+                # exact resume (fault/) hands back the drifted per-
+                # replica stack; a cold start replicates the model view
+                if self._resume_upd_r is not None:
+                    u, self._resume_upd_r = self._resume_upd_r, None
+                    return u
                 return self._replicate_per_worker(
                     model.updater_state,
                     lambda lk, pn: self.param_specs[lk][pn])
@@ -416,42 +480,76 @@ class ShardedParallelTrainer:
         eager_loss = bool(model.listeners) or self.stats is not None
         loss = None
         sp = None
-        for _ in range(epochs):
-            iterator.reset()
-            for ds in iterator:
-                x = gput(ds.features, self._bsh)
-                y = gput(ds.labels, self._bsh)
-                rng = jax.random.fold_in(rng_root, model.iteration_count)
-                t0 = time.perf_counter() if self.stats is not None else 0.0
-                if thr:
-                    params, upd, state, res_r, tau, loss, sp = \
-                        self._thr_step(params, upd, state,
-                                       model.iteration_count, res_r, tau,
-                                       x, y, rng)
-                    gs.record_exchange("threshold", wire_b, dense_b, 1,
-                                       trainer="sharded")
-                else:
-                    params, upd, state, loss, _ = self._step(
-                        params, upd, state, model.iteration_count, x, y, rng)
-                    gs.record_exchange("dense", dense_b, dense_b, 1,
-                                       trainer="sharded")
-                if self.stats is not None:
-                    jax.block_until_ready(loss)
-                    self.stats.record("sync_step",
-                                      time.perf_counter() - t0,
-                                      iteration=model.iteration_count)
-                    self.stats.next_round()
-                if eager_loss:
-                    model.score_value = float(loss)
-                # non-eager: NaN = "score not read back this step" (the
-                # monitor listener's sentinel), never a stale score
-                listeners.iteration_done(model, model.iteration_count,
-                                         model.epoch_count,
-                                         model.score_value if eager_loss
-                                         else float("nan"),
-                                         batch_size=ds.num_examples())
-                model.iteration_count += 1
-            model.epoch_count += 1
+        rep0_live = jax.jit(
+            lambda t: jax.tree_util.tree_map(lambda a: a[0], t),
+            out_shardings=self._ush) if thr else None
+
+        def live_state():
+            # fault/ checkpointing: fit-local device trees (the model's
+            # attributes are stale until fit returns); threshold mode
+            # adds the per-replica updater stack + residual/τ
+            src = {"params": params, "net_state": state}
+            if thr:
+                src["updater_state"] = rep0_live(upd)
+                src["trainer_arrays"] = {"upd_r": upd,
+                                         "residual_r": res_r, "tau": tau}
+                src["trainer_meta"] = {"kind": "threshold",
+                                       "trainer": "sharded",
+                                       "n_workers": n_data}
+            else:
+                src["updater_state"] = upd
+                src["trainer_meta"] = {"kind": "sync_dense",
+                                       "trainer": "sharded",
+                                       "n_workers": n_data}
+            return src
+
+        model._live_state_provider = live_state
+        try:
+            # epoch/fit listener events fire like the containers' fit
+            # loops (checkpoint listeners drain their writer at fit end)
+            listeners.on_fit_start(model)
+            for _ in range(epochs):
+                listeners.on_epoch_start(model, model.epoch_count)
+                iterator.reset()
+                for ds in iterator:
+                    x = gput(ds.features, self._bsh)
+                    y = gput(ds.labels, self._bsh)
+                    rng = jax.random.fold_in(rng_root, model.iteration_count)
+                    t0 = time.perf_counter() if self.stats is not None else 0.0
+                    if thr:
+                        params, upd, state, res_r, tau, loss, sp = \
+                            self._thr_step(params, upd, state,
+                                           model.iteration_count, res_r, tau,
+                                           x, y, rng)
+                        gs.record_exchange("threshold", wire_b, dense_b, 1,
+                                           trainer="sharded")
+                    else:
+                        params, upd, state, loss, _ = self._step(
+                            params, upd, state, model.iteration_count, x, y,
+                            rng)
+                        gs.record_exchange("dense", dense_b, dense_b, 1,
+                                           trainer="sharded")
+                    if self.stats is not None:
+                        jax.block_until_ready(loss)
+                        self.stats.record("sync_step",
+                                          time.perf_counter() - t0,
+                                          iteration=model.iteration_count)
+                        self.stats.next_round()
+                    if eager_loss:
+                        model.score_value = float(loss)
+                    # non-eager: NaN = "score not read back this step" (the
+                    # monitor listener's sentinel), never a stale score
+                    listeners.iteration_done(model, model.iteration_count,
+                                             model.epoch_count,
+                                             model.score_value if eager_loss
+                                             else float("nan"),
+                                             batch_size=ds.num_examples())
+                    model.iteration_count += 1
+                listeners.on_epoch_end(model, model.epoch_count)
+                model.epoch_count += 1
+            listeners.on_fit_end(model)
+        finally:
+            model._live_state_provider = None
         if loss is not None and not eager_loss:
             model.score_value = float(loss)
         if thr:

@@ -135,6 +135,12 @@ class ParallelTrainer:
         self._thr_multi = None
         self._thr_residual_r = None   # per-replica error-feedback residual
         self._thr_tau = None          # adaptive threshold (device scalar)
+        # exact-resume stacks restored by _restore_fault_state (fault/):
+        # consumed by the next fit() instead of replicating the model's
+        # host trees (per-replica updater/param state drifts — a
+        # broadcast would erase the drift the checkpoint preserved)
+        self._resume_upd_r = None
+        self._resume_avg = None
         self._sync_step = None
         self._sync_multi = None
         self._local_step = None
@@ -370,13 +376,17 @@ class ParallelTrainer:
 
     @staticmethod
     def _run_grouped(iterator, epochs, spe, divisible, run_single, drain,
-                     model):
+                     model, listeners=None):
         """Shared epoch/grouping loop for both modes: accumulate up to
         `spe` same-shape batches, drain each FULL group through one
         fused dispatch; spe == 1 runs per-step. Partial groups (epoch
         tails, shape changes) go through run_single so only ONE fused
         shape [spe, ...] ever compiles — a distinct executable per tail
-        length would cost minutes of XLA compile each on a real TPU."""
+        length would cost minutes of XLA compile each on a real TPU.
+
+        Epoch/fit listener events fire like the containers' own fit
+        loops (epoch-cadence checkpointing and the end-of-fit
+        durability drain depend on them)."""
         def flush(pending):
             if len(pending) == spe:
                 drain(pending)
@@ -384,7 +394,11 @@ class ParallelTrainer:
                 for d in pending:
                     run_single(d)
 
+        if listeners is not None:
+            listeners.on_fit_start(model)
         for _ in range(epochs):
+            if listeners is not None:
+                listeners.on_epoch_start(model, model.epoch_count)
             iterator.reset()
             pending = []
             for ds in iterator:
@@ -402,7 +416,11 @@ class ParallelTrainer:
                     drain(pending)
                     pending = []
             flush(pending)
+            if listeners is not None:
+                listeners.on_epoch_end(model, model.epoch_count)
             model.epoch_count += 1
+        if listeners is not None:
+            listeners.on_fit_end(model)
 
     def _replicate_tree(self, tree):
         """Stack n_workers copies along a new leading axis, shard over data."""
@@ -415,6 +433,60 @@ class ParallelTrainer:
 
     def _unreplicate_tree(self, tree):
         return jax.tree_util.tree_map(lambda a: np.asarray(a[0]), tree)
+
+    def _place_replica_stack(self, stacked):
+        """Place an ALREADY-stacked per-replica host tree (leading
+        replica axis of size n_workers) sharded over the data axis —
+        the restore-side counterpart of `_replicate_tree`, which
+        broadcasts one copy instead."""
+        return _gput_tree(stacked, NamedSharding(self.mesh,
+                                                 P(self.data_axis)))
+
+    # ---------------------------------------------------------- fault/resume
+    def _restore_fault_state(self, arrays, meta):
+        """fault.resume() hook: restore gradient-sharing residual + τ,
+        per-replica updater state and the averaging-mode stacks from a
+        checkpoint — re-sharding the replica axis when the checkpoint
+        was written at a different replica count (elastic resume)."""
+        if not arrays and not meta:
+            return
+        from deeplearning4j_tpu.fault import state as fs
+        kind = meta.get("kind")
+        n = self.n_workers
+        if kind == "threshold":
+            res_r = arrays.get("residual_r")
+            if res_r:
+                res_r = fs.reshard_replica_stack(res_r, n, kind="residual")
+                self._thr_residual_r = self._place_replica_stack(res_r)
+            tau = arrays.get("tau")
+            if tau is not None:
+                self._thr_tau = jnp.float32(np.asarray(tau))
+            upd_r = arrays.get("upd_r")
+            if upd_r:
+                upd_r = fs.reshard_replica_stack(upd_r, n, kind="state")
+                self._resume_upd_r = self._place_replica_stack(upd_r)
+        elif kind == "averaging":
+            stacks = {}
+            for k in ("params_r", "upd_r", "state_r"):
+                t = arrays.get(k)
+                stacks[k] = self._place_replica_stack(
+                    fs.reshard_replica_stack(t, n, kind="state")) \
+                    if t else {}
+            stacks["since_avg"] = int(meta.get("since_avg", 0))
+            self._resume_avg = stacks
+
+    def resume(self, directory, *, iterator=None):
+        """Restore model + trainer state from the newest VALID
+        checkpoint under `directory` (fault/ runtime): params, layer
+        state, per-replica updater stacks, threshold residual/τ or
+        averaging-cadence phase, counters, and the iterator cursor when
+        one is passed. Returns the model; a following `fit()` continues
+        the interrupted run exactly (elastic: a changed mesh replica
+        count re-shards the per-replica leaves)."""
+        from deeplearning4j_tpu import fault
+        model, _ = fault.resume(directory, model=self.model, trainer=self,
+                                iterator=iterator)
+        return model
 
     # -------------------------------------------------------------- evaluate
     def evaluate(self, data, labels=None, *, batch_size: int = 32,
@@ -473,19 +545,25 @@ class ParallelTrainer:
         if spe > 1 and self._thr_multi is None:
             self._build_threshold_multi()
         repl = NamedSharding(self.mesh, P())
+
         # updater state is PER-REPLICA in threshold mode (each reference
         # worker advances its own updater on its local gradients) —
-        # leading replica axis, same layout as the residual
+        # leading replica axis, same layout as the residual. An exact
+        # resume (fault/) hands back the drifted per-replica stack; a
+        # cold start replicates the model's view.
+        def place():
+            p = _gput_tree(model.params, repl)
+            if self._resume_upd_r is not None:
+                u, self._resume_upd_r = self._resume_upd_r, None
+            else:
+                u = self._replicate_tree(model.updater_state)
+            return p, u, _gput_tree(model.net_state, repl)
         if self.stats is not None:
             with self.stats.time_phase("broadcast"):
-                params = _gput_tree(model.params, repl)
-                upd_r = self._replicate_tree(model.updater_state)
-                state = _gput_tree(model.net_state, repl)
+                params, upd_r, state = place()
                 jax.block_until_ready(params)
         else:
-            params = _gput_tree(model.params, repl)
-            upd_r = self._replicate_tree(model.updater_state)
-            state = _gput_tree(model.net_state, repl)
+            params, upd_r, state = place()
         res_r, tau = self._threshold_state()
         batch_sh = NamedSharding(self.mesh, P(self.data_axis))
         stack_sh = NamedSharding(self.mesh, P(None, self.data_axis))
@@ -497,6 +575,24 @@ class ParallelTrainer:
         dense_b = gs.exchange_wire_bytes(model.params, "dense")
         last_loss = None
         last_sparsity = None
+        # replica-0 slice with a REPLICATED out-sharding (multi-process
+        # fetchable) — the model-level updater view inside checkpoints
+        rep0 = jax.jit(
+            lambda t: jax.tree_util.tree_map(lambda a: a[0], t),
+            out_shardings=repl)
+
+        def live_state():
+            # fault/ checkpointing: the fit's device-local trees are the
+            # live training state (model attributes are stale until fit
+            # returns); the per-replica updater stack and residual/τ
+            # ride along for exact resume
+            return {"params": params, "net_state": state,
+                    "updater_state": rep0(upd_r),
+                    "trainer_arrays": {"upd_r": upd_r,
+                                       "residual_r": res_r, "tau": tau},
+                    "trainer_meta": {"kind": "threshold",
+                                     "trainer": "parallel",
+                                     "n_workers": self.n_workers}}
 
         def run_single(ds):
             nonlocal params, upd_r, state, res_r, tau
@@ -563,11 +659,17 @@ class ParallelTrainer:
                                          model.epoch_count,
                                          model.score_value if eager_loss
                                          else float("nan"),
-                                         batch_size=d.num_examples())
+                                         batch_size=d.num_examples(),
+                                         step_boundary=(
+                                             j == len(pending) - 1))
                 model.iteration_count += 1
 
-        self._run_grouped(iterator, epochs, spe, divisible,
-                          run_single, drain, model)
+        model._live_state_provider = live_state
+        try:
+            self._run_grouped(iterator, epochs, spe, divisible,
+                              run_single, drain, model, listeners)
+        finally:
+            model._live_state_provider = None
         check_trained()
         self._thr_residual_r, self._thr_tau = res_r, tau
         if last_loss is not None and not eager_loss:
@@ -681,6 +783,15 @@ class ParallelTrainer:
             from deeplearning4j_tpu.parallel import gradient_sharing as gs
             dense_b = gs.exchange_wire_bytes(model.params, "dense")
 
+            def live_state():
+                # fault/ checkpointing: fit-local device trees (the
+                # model's attributes are stale until fit returns)
+                return {"params": params, "net_state": state,
+                        "updater_state": upd,
+                        "trainer_meta": {"kind": "sync_dense",
+                                         "trainer": "parallel",
+                                         "n_workers": self.n_workers}}
+
             def run_single(ds):
                 nonlocal params, upd, state, last_loss
                 x = _gput(ds.features, batch_sh)
@@ -742,11 +853,17 @@ class ParallelTrainer:
                                              model.epoch_count,
                                              model.score_value if eager_loss
                                              else float("nan"),
-                                             batch_size=d.num_examples())
+                                             batch_size=d.num_examples(),
+                                             step_boundary=(
+                                                 j == len(pending) - 1))
                     model.iteration_count += 1
 
-            self._run_grouped(iterator, epochs, spe, divisible,
-                              run_single, drain, model)
+            model._live_state_provider = live_state
+            try:
+                self._run_grouped(iterator, epochs, spe, divisible,
+                                  run_single, drain, model, listeners)
+            finally:
+                model._live_state_provider = None
             check_trained()
             if last_loss is not None and not eager_loss:
                 lv = np.asarray(last_loss)
@@ -769,23 +886,47 @@ class ParallelTrainer:
             spe = 1
         if spe > 1 and self._local_multi is None:
             self._build_averaging_multi()
+        # exact resume (fault/) hands back the drifted per-replica
+        # stacks + the averaging-cadence phase; a cold start replicates
+        def place():
+            if self._resume_avg is not None:
+                ra, self._resume_avg = self._resume_avg, None
+                return (ra["params_r"], ra["upd_r"], ra["state_r"],
+                        ra["since_avg"])
+            return (self._replicate_tree(model.params),
+                    self._replicate_tree(model.updater_state),
+                    self._replicate_tree(model.net_state), 0)
         if self.stats is not None:
             with self.stats.time_phase("broadcast"):
-                params_r = self._replicate_tree(model.params)
-                upd_r = self._replicate_tree(model.updater_state)
-                state_r = self._replicate_tree(model.net_state)
+                params_r, upd_r, state_r, since_avg = place()
                 jax.block_until_ready(params_r)
         else:
-            params_r = self._replicate_tree(model.params)
-            upd_r = self._replicate_tree(model.updater_state)
-            state_r = self._replicate_tree(model.net_state)
+            params_r, upd_r, state_r, since_avg = place()
         batch_sh = NamedSharding(self.mesh, P(self.data_axis))
         stack_sh = NamedSharding(self.mesh, P(None, self.data_axis))
-        since_avg = 0
         # same lazy-readback gate as sync mode: the per-step scalar sync
         # is only paid when a listener/stats consumer will look at it
         eager_loss = bool(model.listeners) or self.stats is not None
         last_losses = None
+        repl = NamedSharding(self.mesh, P())
+        rep0 = jax.jit(
+            lambda t: jax.tree_util.tree_map(lambda a: a[0], t),
+            out_shardings=repl)
+
+        def live_state():
+            # fault/ checkpointing: every replica's params/updater/state
+            # drifted independently since the last pmean round — the
+            # full stacks plus the cadence phase are the live state;
+            # replica 0 stands in for the model-level view
+            return {"params": rep0(params_r), "net_state": rep0(state_r),
+                    "updater_state": rep0(upd_r),
+                    "trainer_arrays": {"params_r": params_r,
+                                       "upd_r": upd_r,
+                                       "state_r": state_r},
+                    "trainer_meta": {"kind": "averaging",
+                                     "trainer": "parallel",
+                                     "since_avg": int(since_avg),
+                                     "n_workers": self.n_workers}}
 
         def run_single(ds):
             nonlocal params_r, upd_r, state_r, since_avg, last_losses
@@ -849,11 +990,17 @@ class ParallelTrainer:
                                          model.epoch_count,
                                          model.score_value if eager_loss
                                          else float("nan"),
-                                         batch_size=d.num_examples())
+                                         batch_size=d.num_examples(),
+                                         step_boundary=(
+                                             j == len(pending) - 1))
                 model.iteration_count += 1
 
-        self._run_grouped(iterator, epochs, spe, divisible,
-                          run_single, drain, model)
+        model._live_state_provider = live_state
+        try:
+            self._run_grouped(iterator, epochs, spe, divisible,
+                              run_single, drain, model, listeners)
+        finally:
+            model._live_state_provider = None
         if since_avg:
             params_r = self._average_fn(params_r)
             state_r = self._average_fn(state_r)

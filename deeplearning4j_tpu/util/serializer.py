@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
 from pathlib import Path
 from typing import Union
@@ -27,7 +28,31 @@ from typing import Union
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.fault.errors import CheckpointCorruptError
+
 FORMAT_VERSION = 1
+
+
+# one checksum primitive for the whole persistence layer — a change to
+# the integrity rule must not diverge between model zips and fault
+# checkpoints
+from deeplearning4j_tpu.fault.state import checksum_array as _crc
+
+
+def _verify(meta: dict, section: str, flat: dict, path):
+    """Per-array crc check against meta.json (zips written before the
+    checksums existed skip silently)."""
+    expected = meta.get("array_checksums")
+    if not expected:
+        return
+    bad = [k for k, arr in flat.items()
+           if f"{section}::{k}" in expected
+           and _crc(arr) != expected[f"{section}::{k}"]]
+    if bad:
+        raise CheckpointCorruptError(
+            f"{path}: {section} arrays failed checksum verification: "
+            f"{bad[:5]}{'...' if len(bad) > 5 else ''} — the file is "
+            f"corrupt; restore from a backup or an earlier checkpoint")
 
 
 def _save_npz(zf: zipfile.ZipFile, name: str, arrays: dict):
@@ -65,61 +90,98 @@ def _unflatten_updater(flat: dict) -> dict:
 class ModelSerializer:
     @staticmethod
     def write_model(model, path: Union[str, Path], save_updater: bool = True):
+        """Atomic durable write: the zip is assembled at a same-directory
+        tmp path, flushed + fsync'd, then renamed over the target — a
+        crash mid-save can never leave a torn model file where a valid
+        one was expected. Every array carries a crc32 in meta.json so
+        `restore_model` detects silent corruption."""
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         model_type = ("ComputationGraph" if isinstance(model, ComputationGraph)
                       else "MultiLayerNetwork")
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr("configuration.json", model.conf.to_json(indent=2))
-            params_flat = {}
-            for lk, lv in model.params.items():
-                for pk, arr in lv.items():
-                    params_flat[f"{lk}::{pk}"] = arr
-            _save_npz(zf, "params.npz", params_flat)
-            state_flat = {}
-            for lk, lv in model.net_state.items():
-                for pk, arr in lv.items():
-                    state_flat[f"{lk}::{pk}"] = arr
-            _save_npz(zf, "state.npz", state_flat)
-            if save_updater:
-                _save_npz(zf, "updater.npz", _flatten_updater(model.updater_state))
-            zf.writestr("meta.json", json.dumps({
-                "format_version": FORMAT_VERSION,
-                "model_type": model_type,
-                "iteration_count": model.iteration_count,
-                "epoch_count": model.epoch_count,
-            }))
+        params_flat = {}
+        for lk, lv in model.params.items():
+            for pk, arr in lv.items():
+                params_flat[f"{lk}::{pk}"] = np.asarray(arr)
+        state_flat = {}
+        for lk, lv in model.net_state.items():
+            for pk, arr in lv.items():
+                state_flat[f"{lk}::{pk}"] = np.asarray(arr)
+        upd_flat = ({k: np.asarray(v) for k, v in
+                     _flatten_updater(model.updater_state).items()}
+                    if save_updater else {})
+        checksums = {}
+        for section, flat in (("params", params_flat), ("state", state_flat),
+                              ("updater", upd_flat)):
+            for k, arr in flat.items():
+                checksums[f"{section}::{k}"] = _crc(arr)
+        tmp = path.with_name(f".{path.name}.tmp-{os.getpid()}")
+        try:
+            with open(tmp, "wb") as f:
+                with zipfile.ZipFile(f, "w", zipfile.ZIP_DEFLATED) as zf:
+                    zf.writestr("configuration.json",
+                                model.conf.to_json(indent=2))
+                    _save_npz(zf, "params.npz", params_flat)
+                    _save_npz(zf, "state.npz", state_flat)
+                    if save_updater:
+                        _save_npz(zf, "updater.npz", upd_flat)
+                    zf.writestr("meta.json", json.dumps({
+                        "format_version": FORMAT_VERSION,
+                        "model_type": model_type,
+                        "iteration_count": model.iteration_count,
+                        "epoch_count": model.epoch_count,
+                        "array_checksums": checksums,
+                    }))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if tmp.exists():
+                tmp.unlink()
 
     @staticmethod
     def restore_model(path: Union[str, Path], load_updater: bool = True):
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
         from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
         from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
-        with zipfile.ZipFile(path, "r") as zf:
-            conf_json = json.loads(zf.read("configuration.json"))
-            meta = json.loads(zf.read("meta.json")) if "meta.json" in zf.namelist() else {}
-            if meta.get("model_type") == "ComputationGraph" or \
-                    conf_json.get("format", "").endswith("ComputationGraphConfiguration"):
-                conf = ComputationGraphConfiguration.from_dict(conf_json)
-                model = ComputationGraph(conf)
-            else:
-                conf = MultiLayerConfiguration.from_dict(conf_json)
-                model = MultiLayerNetwork(conf)
-            model.init()
-            params_flat = _load_npz(zf, "params.npz")
+        try:
+            zf_ctx = zipfile.ZipFile(path, "r")
+        except (zipfile.BadZipFile, OSError) as e:
+            raise CheckpointCorruptError(
+                f"{path}: not a readable model zip ({e})") from e
+        with zf_ctx as zf:
+            try:
+                conf_json = json.loads(zf.read("configuration.json"))
+                meta = json.loads(zf.read("meta.json")) if "meta.json" in zf.namelist() else {}
+                if meta.get("model_type") == "ComputationGraph" or \
+                        conf_json.get("format", "").endswith("ComputationGraphConfiguration"):
+                    conf = ComputationGraphConfiguration.from_dict(conf_json)
+                    model = ComputationGraph(conf)
+                else:
+                    conf = MultiLayerConfiguration.from_dict(conf_json)
+                    model = MultiLayerNetwork(conf)
+                model.init()
+                params_flat = _load_npz(zf, "params.npz")
+                state_flat = _load_npz(zf, "state.npz")
+                upd_flat = _load_npz(zf, "updater.npz") if load_updater else {}
+            except (zipfile.BadZipFile, ValueError, KeyError,
+                    EOFError, OSError) as e:
+                raise CheckpointCorruptError(
+                    f"{path}: model zip is corrupt or truncated "
+                    f"({e})") from e
+            _verify(meta, "params", params_flat, path)
+            _verify(meta, "state", state_flat, path)
+            _verify(meta, "updater", upd_flat, path)
             for key, arr in params_flat.items():
                 lk, pk = key.split("::", 1)
                 model.params[lk][pk] = jnp.asarray(arr)
-            state_flat = _load_npz(zf, "state.npz")
             for key, arr in state_flat.items():
                 lk, pk = key.split("::", 1)
                 model.net_state.setdefault(lk, {})[pk] = jnp.asarray(arr)
-            if load_updater:
-                upd_flat = _load_npz(zf, "updater.npz")
-                if upd_flat:
-                    model.updater_state = _unflatten_updater(upd_flat)
+            if load_updater and upd_flat:
+                model.updater_state = _unflatten_updater(upd_flat)
             model.iteration_count = meta.get("iteration_count", 0)
             model.epoch_count = meta.get("epoch_count", 0)
             return model

@@ -27,6 +27,36 @@ import os
 from functools import partial
 
 import jax
+import numpy as np
+
+from deeplearning4j_tpu.fault.errors import CheckpointCorruptError
+
+
+def _addressable_checksums(state) -> dict:
+    """crc32 per fully-addressable array, keyed by '/'-joined tree path.
+    Sharded leaves no single host can fetch are skipped (their
+    integrity is TensorStore's job); on the single-host restore path
+    this covers every array."""
+    from deeplearning4j_tpu.fault.state import checksum_array
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if not getattr(leaf, "is_fully_addressable", True):
+            continue
+        key = "/".join(str(getattr(p, "key", p)) for p in path)
+        out[key] = checksum_array(np.asarray(leaf))
+    return out
+
+
+def _verify_addressable(state, expected: dict, path: str):
+    if not expected:
+        return
+    got = _addressable_checksums(state)
+    bad = [k for k, crc in expected.items()
+           if k in got and got[k] != crc]
+    if bad:
+        raise CheckpointCorruptError(
+            f"{path}: restored arrays failed checksum verification: "
+            f"{bad[:5]}{'...' if len(bad) > 5 else ''}")
 
 
 class ShardedCheckpoint:
@@ -44,7 +74,8 @@ class ShardedCheckpoint:
         meta = {"configuration": model.conf.to_dict(),
                 "model_type": type(model).__name__,
                 "iteration_count": model.iteration_count,
-                "epoch_count": model.epoch_count}
+                "epoch_count": model.epoch_count,
+                "checksums": _addressable_checksums(state)}
         # one composite checkpoint: arrays + meta commit atomically under
         # Orbax's finalization protocol (a crash mid-save leaves no
         # half-checkpoint that restore() would trip over)
@@ -92,9 +123,15 @@ class ShardedCheckpoint:
                 # reaches spec_for as "no target sharding"
                 abstract = jax.tree_util.tree_map(
                     spec_for, template, shardings)
-            state = ckptr.restore(
-                path, args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(abstract)))["state"]
+            try:
+                state = ckptr.restore(
+                    path, args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(abstract)))["state"]
+            except (ValueError, KeyError, FileNotFoundError, OSError) as e:
+                raise CheckpointCorruptError(
+                    f"{path}: sharded checkpoint unreadable or "
+                    f"incomplete ({e})") from e
+        _verify_addressable(state, meta.get("checksums"), path)
         model.params = state["params"]
         model.net_state = state["net_state"]
         model.updater_state = state["updater_state"]

@@ -23,11 +23,16 @@
 # 5. Gradient-sharing smoke: tiny-MLP dense vs threshold loss
 #    trajectories must stay within tolerance after 50 sync steps on a
 #    4-way mesh (the error-feedback convergence guarantee).
+# 6. Fault-drill smoke: 30-step tiny-MLP run killed (real SIGTERM) at
+#    step 15 with async checkpointing every 5, auto-resumed by the
+#    drill driver — final params/updater state must be BIT-identical
+#    to the uninterrupted run (the preemption-tolerance guarantee,
+#    docs/FAULT_TOLERANCE.md).
 
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== [1/5] tier-1 tests (ROADMAP.md verbatim) =="
+echo "== [1/6] tier-1 tests (ROADMAP.md verbatim) =="
 # stale-report guard: a timeout-killed suite never reaches
 # pytest_sessionfinish, and step [2/3] must not read the previous
 # run's durations as this run's
@@ -35,7 +40,7 @@ rm -f "${DL4J_SUITE_DURATIONS:-/tmp/_t1_durations.json}"
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
 tier1_rc=$?
 
-echo "== [2/5] suite duration budget =="
+echo "== [2/6] suite duration budget =="
 python - <<'EOF'
 import json
 import os
@@ -62,7 +67,7 @@ if total > soft:
           "mark 'slow' the top offenders above before adding tests.")
 EOF
 
-echo "== [3/5] /metrics smoke =="
+echo "== [3/6] /metrics smoke =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import sys
 import urllib.request
@@ -104,7 +109,7 @@ print(f"/metrics smoke OK ({len(body.splitlines())} exposition lines, "
 EOF
 smoke_rc=$?
 
-echo "== [4/5] AOT cost smoke (hlo_cost --all) =="
+echo "== [4/6] AOT cost smoke (hlo_cost --all) =="
 hlo_out=$(mktemp -d)
 timeout -k 10 840 env JAX_PLATFORMS=cpu \
     python -m benchtools.hlo_cost --all --batch 8 --steps 2 --out "$hlo_out"
@@ -145,7 +150,7 @@ EOF
 hlo_rc=$?
 rm -rf "$hlo_out"
 
-echo "== [5/5] gradient-sharing smoke (dense vs threshold) =="
+echo "== [5/6] gradient-sharing smoke (dense vs threshold) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
     timeout -k 10 300 python - <<'PYEOF'
 import numpy as np
@@ -196,8 +201,17 @@ print(f"gradient-sharing smoke OK (init={init:.3f} dense={d:.3f} "
 PYEOF
 gs_rc=$?
 
-echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc}"
-if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ]; then
+echo "== [6/6] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
+# train 30 steps on a tiny MLP in a child process, SIGTERM at step 15
+# (async checkpoint every 5, atomic tmp+fsync+rename commits), auto-
+# resume from the newest valid checkpoint, and require the final
+# params/updater state BIT-identical to an uninterrupted 30-step run
+# (docs/FAULT_TOLERANCE.md). CPU-forced; subprocess kills are real.
+JAX_PLATFORMS=cpu timeout -k 10 300 python scripts/fault_drill.py --smoke
+drill_rc=$?
+
+echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc}"
+if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ]; then
     exit 1
 fi
 echo "VERIFY OK"

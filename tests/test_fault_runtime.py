@@ -1,0 +1,710 @@
+"""Preemption-tolerant training runtime (deeplearning4j_tpu/fault/).
+
+Acceptance surface: kill-at-step-k then resume reproduces the
+uninterrupted run's params/updater state BIT-identically on CPU —
+plain, fused multi-step, scan_layers stacks, and threshold
+gradient-sharing (incl. residual/τ and drifted per-replica updater
+state); a corrupted newest checkpoint degrades to the previous one with
+a logged warning; retention GC honors keep-last/keep-every; elastic
+resume re-shards per-replica leaves across a changed replica count.
+"""
+
+import math
+import shutil
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu import fault, monitor
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.iterator import (
+    ArrayDataSetIterator,
+    AsyncDataSetIterator,
+)
+from deeplearning4j_tpu.fault import state as fstate
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def build_net(depth: int = 1, width: int = 8, n_in: int = 8,
+              n_out: int = 3, seed: int = 7):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(Adam(0.01)).list())
+    b = b.layer(DenseLayer(n_in=n_in, n_out=width, activation="tanh"))
+    for _ in range(depth - 1):
+        b = b.layer(DenseLayer(n_in=width, n_out=width, activation="tanh"))
+    conf = (b.layer(OutputLayer(n_in=width, n_out=n_out,
+                                activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_data(n=48, n_in=8, n_out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, n)]
+    return x, y
+
+
+def make_iter(x, y, batch=8, shuffle=True):
+    return ArrayDataSetIterator(x, y, batch_size=batch, shuffle=shuffle,
+                                seed=11)
+
+
+def trees_bitwise(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.asarray(p).dtype == np.asarray(q).dtype
+        and np.array_equal(np.asarray(p), np.asarray(q))
+        for p, q in zip(la, lb))
+
+
+@pytest.fixture
+def tmpdir_():
+    d = tempfile.mkdtemp(prefix="fault_test_")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def interrupt_fit(net, iterator, *, kill_at, freq, ckpt_dir, epochs=2,
+                  spe=1, trainer=None):
+    """Train with checkpointing + scripted preemption; returns the
+    checkpointer after the kill fired."""
+    ck = fault.AsyncCheckpointer(ckpt_dir, keep_last=10)
+    net.add_listener(fault.CheckpointListener(ck, frequency=freq,
+                                              iterator=iterator))
+    net.add_listener(fault.PreemptionListener(kill_at, mode="exception"))
+    with pytest.raises(fault.SimulatedPreemption):
+        if trainer is not None:
+            trainer.fit(iterator, epochs=epochs, batch_size=8)
+        else:
+            net.fit(iterator, epochs=epochs, steps_per_execution=spe)
+    ck.wait()
+    assert ck.steps(), "no checkpoint committed before the kill"
+    return ck
+
+
+# ===================================================== state schema units
+class TestStateSchema:
+    def test_flatten_roundtrip_and_checksums(self):
+        tree = {"params": {"0": {"W": np.arange(6.0).reshape(2, 3),
+                                 "b": np.zeros(3)}},
+                "updater_state": {"0": {"W": {"m": np.ones(2),
+                                              "v": np.zeros(2)}}}}
+        flat = fstate.flatten_arrays(tree)
+        assert fstate.unflatten_arrays(flat).keys() == tree.keys()
+        back = fstate.unflatten_arrays(flat)
+        assert np.array_equal(back["params"]["0"]["W"],
+                              tree["params"]["0"]["W"])
+        crcs = fstate.checksum_flat(flat)
+        fstate.verify_checksums(flat, crcs)      # clean: no raise
+        flat2 = dict(flat)
+        key = next(iter(flat2))
+        flat2[key] = flat2[key] + 1.0
+        with pytest.raises(fault.CheckpointCorruptError):
+            fstate.verify_checksums(flat2, crcs)
+
+    def test_reserved_separator_rejected(self):
+        with pytest.raises(ValueError):
+            fstate.flatten_arrays({"a\x1fb": np.zeros(2)})
+
+    def test_capture_restore_roundtrip(self):
+        net = build_net()
+        x, y = make_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        state = fstate.capture_training_state(net)
+        clone = build_net()
+        fstate.restore_training_state(clone, state)
+        assert trees_bitwise(net.params, clone.params)
+        assert trees_bitwise(net.updater_state, clone.updater_state)
+        assert clone.iteration_count == net.iteration_count
+        assert clone.epoch_count == net.epoch_count
+
+    def test_stateless_updater_slots_survive_restore(self):
+        # Sgd's init_state is {} — flat npz keys cannot represent empty
+        # dicts, so restore must rebuild the structure (deep-merge over
+        # an initialized tree) or _apply_updates KeyErrors on resume
+        from deeplearning4j_tpu.common.updaters import Sgd
+        b = (NeuralNetConfiguration.builder().seed(7)
+             .updater(Sgd(0.05)).list()
+             .layer(DenseLayer(n_in=8, n_out=8, activation="tanh"))
+             .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                loss="mcxent"))
+             .set_input_type(InputType.feed_forward(8)))
+        net = MultiLayerNetwork(b.build()).init()
+        x, y = make_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        state = fstate.capture_training_state(net)
+        clone = MultiLayerNetwork(b.build())
+        fstate.restore_training_state(clone, state)
+        assert clone.updater_state["0"]["W"] == {}
+        clone.fit(x, y, epochs=1, batch_size=16)   # no KeyError
+        assert trees_bitwise(net.params, state["arrays"]["params"])
+
+    def test_reshard_replica_stack(self):
+        tree = {"0": {"W": np.arange(24, dtype=np.float32).reshape(2, 3, 4)}}
+        res = fstate.reshard_replica_stack(tree, 4, kind="residual")
+        assert res["0"]["W"].shape == (4, 3, 4)
+        # error-feedback mass (the replica SUM) is conserved
+        assert np.isclose(res["0"]["W"].sum(dtype=np.float64),
+                          tree["0"]["W"].sum(dtype=np.float64), rtol=1e-6)
+        st = fstate.reshard_replica_stack(tree, 3, kind="state")
+        assert st["0"]["W"].shape == (3, 3, 4)
+        assert np.allclose(st["0"]["W"][0], tree["0"]["W"].mean(axis=0))
+        ints = {"0": {"n": np.array([3, 5], dtype=np.int32)}}
+        assert fstate.reshard_replica_stack(
+            ints, 3, kind="state")["0"]["n"].tolist() == [3, 3, 3]
+
+
+# ===================================================== checkpointer core
+class TestAsyncCheckpointer:
+    def _state(self, i):
+        return {"arrays": {"params": {"0": {"W": np.full((4, 4), float(i),
+                                                         np.float32)}}},
+                "meta": {"iteration_count": i, "epoch_count": 0}}
+
+    def test_atomic_commit_and_load(self, tmpdir_):
+        ck = fault.AsyncCheckpointer(tmpdir_, async_write=False)
+        ck.save(self._state(5), 5)
+        assert ck.steps() == [5]
+        got = ck.load()
+        assert got["meta"]["iteration_count"] == 5
+        assert np.array_equal(got["arrays"]["params"]["0"]["W"],
+                              np.full((4, 4), 5.0, np.float32))
+        # no tmp droppings after a clean commit
+        import os
+        assert not [e for e in os.listdir(tmpdir_)
+                    if e.startswith(".tmp-")]
+
+    def test_retention_keep_last_and_keep_every(self, tmpdir_):
+        ck = fault.AsyncCheckpointer(tmpdir_, keep_last=2, keep_every=10,
+                                     async_write=False)
+        for s in (5, 10, 15, 20, 25):
+            ck.save(self._state(s), s)
+        # keep_last=2 -> {20, 25}; keep_every=10 -> {10, 20} stay forever
+        assert ck.steps() == [10, 20, 25]
+
+    def test_async_latest_wins_and_wait(self, tmpdir_):
+        ck = fault.AsyncCheckpointer(tmpdir_, keep_last=10)
+        for s in (1, 2, 3, 4):
+            ck.save(self._state(s), s)
+        ck.wait()
+        steps = ck.steps()
+        assert steps and steps[-1] == 4   # newest always committed
+
+    def test_metrics_surface(self, tmpdir_):
+        reg = monitor.enable(registry=monitor.MetricsRegistry())
+        try:
+            ck = fault.AsyncCheckpointer(tmpdir_, async_write=False)
+            ck.save(self._state(3), 3)
+            fault.resume(tmpdir_, model=build_net())
+            expo = reg.exposition()
+            for name in ("checkpoint_write_seconds", "checkpoint_bytes_total",
+                         "checkpoint_last_age_seconds", "checkpoint_last_step",
+                         "restore_total"):
+                assert name in expo, f"{name} missing from /metrics"
+        finally:
+            monitor.disable()
+
+
+# ============================================== interrupt/resume parity
+class TestInterruptResumeParity:
+    def test_plain_per_step(self, tmpdir_):
+        x, y = make_data()
+        ref = build_net()
+        ref.fit(make_iter(x, y), epochs=2)
+
+        net = build_net()
+        it = make_iter(x, y)
+        interrupt_fit(net, it, kill_at=7, freq=3, ckpt_dir=tmpdir_)
+
+        it2 = make_iter(x, y)
+        net2, meta = fault.resume(tmpdir_, iterator=it2)
+        assert net2.iteration_count == meta["iteration_count"]
+        net2.fit(it2, epochs=2 - net2.epoch_count)
+        assert net2.iteration_count == ref.iteration_count
+        assert trees_bitwise(ref.params, net2.params)
+        assert trees_bitwise(ref.updater_state, net2.updater_state)
+
+    def test_fused_multi_step_boundaries(self, tmpdir_):
+        x, y = make_data()
+        ref = build_net()
+        ref.fit(make_iter(x, y), epochs=2, steps_per_execution=3)
+
+        net = build_net()
+        it = make_iter(x, y)
+        # kill_at=8 is NOT a group boundary: the preemption must fire at
+        # the fused boundary (9), and the checkpoint cadence must land
+        # on boundaries only
+        interrupt_fit(net, it, kill_at=8, freq=4, ckpt_dir=tmpdir_, spe=3)
+        from deeplearning4j_tpu.fault.checkpointer import list_checkpoints
+        assert all(s % 3 == 0 for s in list_checkpoints(tmpdir_)), \
+            "checkpoint landed off a fused step boundary"
+
+        it2 = make_iter(x, y)
+        net2, _ = fault.resume(tmpdir_, iterator=it2)
+        net2.fit(it2, epochs=2 - net2.epoch_count, steps_per_execution=3)
+        assert trees_bitwise(ref.params, net2.params)
+        assert trees_bitwise(ref.updater_state, net2.updater_state)
+
+    def test_scan_layers_stack(self, tmpdir_):
+        # deep homogeneous stack: params/updater ride the fit as ONE
+        # ``stacked::`` entry inside jit, per-layer keys at the
+        # checkpoint boundary — resume must be oblivious to packing
+        x, y = make_data()
+        assert build_net(depth=5).conf.scan_layers
+        ref = build_net(depth=5)
+        ref.fit(make_iter(x, y), epochs=2)
+
+        net = build_net(depth=5)
+        it = make_iter(x, y)
+        interrupt_fit(net, it, kill_at=7, freq=3, ckpt_dir=tmpdir_)
+        it2 = make_iter(x, y)
+        net2, _ = fault.resume(tmpdir_, iterator=it2)
+        assert all(not k.startswith("stacked::") for k in net2.params)
+        net2.fit(it2, epochs=2 - net2.epoch_count)
+        assert trees_bitwise(ref.params, net2.params)
+        assert trees_bitwise(ref.updater_state, net2.updater_state)
+
+    def test_threshold_gradient_sharing(self, tmpdir_):
+        from deeplearning4j_tpu.parallel.mesh import device_mesh
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+        x, y = make_data()
+        mesh = device_mesh()
+        ref = build_net()
+        rtr = ParallelTrainer(ref, mesh, mode="sync",
+                              gradient_sharing="threshold")
+        rtr.fit(make_iter(x, y), epochs=2, batch_size=8)
+
+        net = build_net()
+        it = make_iter(x, y)
+        tr = ParallelTrainer(net, mesh, mode="sync",
+                             gradient_sharing="threshold")
+        interrupt_fit(net, it, kill_at=7, freq=3, ckpt_dir=tmpdir_,
+                      trainer=tr)
+
+        net2 = build_net()
+        it2 = make_iter(x, y)
+        tr2 = ParallelTrainer(net2, mesh, mode="sync",
+                              gradient_sharing="threshold")
+        tr2.resume(tmpdir_, iterator=it2)
+        tr2.fit(it2, epochs=2 - net2.epoch_count, batch_size=8)
+        assert trees_bitwise(ref.params, net2.params)
+        # per-replica updater drift and the error-feedback residual + τ
+        # must survive the restart bit-exactly too
+        assert trees_bitwise(ref.updater_state, net2.updater_state)
+        assert trees_bitwise(rtr.threshold_residual(),
+                             tr2.threshold_residual())
+        assert np.array_equal(np.asarray(rtr._thr_tau),
+                              np.asarray(tr2._thr_tau))
+
+    def test_threshold_fused_multi_step(self, tmpdir_):
+        from deeplearning4j_tpu.parallel.mesh import device_mesh
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+        x, y = make_data()
+        mesh = device_mesh()
+        ref = build_net()
+        ParallelTrainer(ref, mesh, mode="sync",
+                        gradient_sharing="threshold").fit(
+            make_iter(x, y), epochs=2, batch_size=8,
+            steps_per_execution=3)
+
+        net = build_net()
+        it = make_iter(x, y)
+        tr = ParallelTrainer(net, mesh, mode="sync",
+                             gradient_sharing="threshold")
+        ck = fault.AsyncCheckpointer(tmpdir_, keep_last=10)
+        net.add_listener(fault.CheckpointListener(ck, frequency=3,
+                                                  iterator=it))
+        net.add_listener(fault.PreemptionListener(8, mode="exception"))
+        with pytest.raises(fault.SimulatedPreemption):
+            tr.fit(it, epochs=2, batch_size=8, steps_per_execution=3)
+        ck.wait()
+
+        net2 = build_net()
+        it2 = make_iter(x, y)
+        tr2 = ParallelTrainer(net2, mesh, mode="sync",
+                              gradient_sharing="threshold")
+        tr2.resume(tmpdir_, iterator=it2)
+        tr2.fit(it2, epochs=2 - net2.epoch_count, batch_size=8,
+                steps_per_execution=3)
+        assert trees_bitwise(ref.params, net2.params)
+        assert trees_bitwise(ref.updater_state, net2.updater_state)
+
+
+    def test_epoch_end_checkpoint_resumes_exact(self, tmpdir_):
+        # epoch-cadence checkpoints pair epoch_count=e+1 with a cursor
+        # normalized to the NEXT pass — an un-normalized end-of-pass
+        # cursor would replay an empty pass and train one epoch short
+        x, y = make_data()
+        ref = build_net()
+        ref.fit(make_iter(x, y), epochs=3)
+
+        net = build_net()
+        it = make_iter(x, y)
+        ck = fault.AsyncCheckpointer(tmpdir_, async_write=False)
+        net.add_listener(fault.CheckpointListener(
+            ck, frequency=10 ** 9, epoch_frequency=1, iterator=it))
+        net.fit(it, epochs=1)
+
+        it2 = make_iter(x, y)
+        net2, meta = fault.resume(tmpdir_, iterator=it2)
+        assert meta["epoch_count"] == 1
+        assert meta["iterator"] == {"epoch": 1, "batch": 0, "seed": 11,
+                                    "shuffle": True}
+        net2.fit(it2, epochs=3 - net2.epoch_count)
+        assert net2.iteration_count == ref.iteration_count == 18
+        assert trees_bitwise(ref.params, net2.params)
+        assert trees_bitwise(ref.updater_state, net2.updater_state)
+
+    def test_trainer_fires_epoch_and_fit_events(self):
+        # the parallel trainers must dispatch epoch/fit listener events
+        # like the containers do — CheckpointListener's end-of-fit
+        # durability drain and epoch-cadence saves depend on them
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+        from deeplearning4j_tpu.parallel.mesh import device_mesh
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+        events = []
+
+        class Probe(TrainingListener):
+            def on_fit_start(self, model):
+                events.append("fit_start")
+
+            def on_epoch_start(self, model, epoch):
+                events.append(("epoch_start", epoch))
+
+            def on_epoch_end(self, model, epoch):
+                events.append(("epoch_end", epoch))
+
+            def on_fit_end(self, model):
+                events.append("fit_end")
+
+        x, y = make_data()
+        net = build_net()
+        net.add_listener(Probe())
+        ParallelTrainer(net, device_mesh(), mode="sync").fit(
+            make_iter(x, y), epochs=2, batch_size=8)
+        assert events == ["fit_start", ("epoch_start", 0), ("epoch_end", 0),
+                          ("epoch_start", 1), ("epoch_end", 1), "fit_end"]
+
+    def test_computation_graph(self, tmpdir_):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph,
+            ComputationGraphConfiguration,
+        )
+
+        def build_graph():
+            g = ComputationGraphConfiguration.graph_builder(
+                NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(0.01)))
+            g.add_inputs("in")
+            g.add_layer("d1", DenseLayer(n_in=8, n_out=10,
+                                         activation="tanh"), "in")
+            g.add_layer("out", OutputLayer(n_in=10, n_out=3,
+                                           activation="softmax",
+                                           loss="mcxent"), "d1")
+            g.set_outputs("out")
+            return ComputationGraph(g.build()).init()
+
+        x, y = make_data()
+        ref = build_graph()
+        ref.fit(make_iter(x, y), epochs=2)
+
+        g = build_graph()
+        it = make_iter(x, y)
+        interrupt_fit(g, it, kill_at=7, freq=3, ckpt_dir=tmpdir_)
+        it2 = make_iter(x, y)
+        g2, _ = fault.resume(tmpdir_, iterator=it2)
+        assert isinstance(g2, ComputationGraph)   # rebuilt from meta
+        g2.fit(it2, epochs=2 - g2.epoch_count)
+        assert trees_bitwise(ref.params, g2.params)
+        assert trees_bitwise(ref.updater_state, g2.updater_state)
+
+    def test_pipeline_parallel_trainer(self, tmpdir_):
+        from deeplearning4j_tpu.parallel.pipeline_container import (
+            PipelineParallelTrainer,
+        )
+
+        def build_deep():
+            # n_in=4 prolog layer differs from the 8-wide body, so the
+            # homogeneous run is the 4 inner blocks (divisible into 2
+            # stages)
+            return build_net(depth=5, n_in=4)
+
+        x, y = make_data(n_in=4)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("pipe",))
+        ref = build_deep()
+        PipelineParallelTrainer(ref, mesh, microbatches=2).fit(
+            make_iter(x, y), epochs=2, batch_size=8)
+
+        net = build_deep()
+        it = make_iter(x, y)
+        tr = PipelineParallelTrainer(net, mesh, microbatches=2)
+        interrupt_fit(net, it, kill_at=7, freq=3, ckpt_dir=tmpdir_,
+                      trainer=tr)
+        net2 = build_deep()
+        it2 = make_iter(x, y)
+        tr2 = PipelineParallelTrainer(net2, mesh, microbatches=2)
+        tr2.resume(tmpdir_, iterator=it2)
+        tr2.fit(it2, epochs=2 - net2.epoch_count, batch_size=8)
+        assert trees_bitwise(ref.params, net2.params)
+        assert trees_bitwise(ref.updater_state, net2.updater_state)
+
+
+# ============================================= corrupt-shard fallback
+class TestCorruptionFallback:
+    def _checkpointed_run(self, tmpdir_):
+        x, y = make_data()
+        net = build_net()
+        it = make_iter(x, y)
+        ck = fault.AsyncCheckpointer(tmpdir_, keep_last=10,
+                                     async_write=False)
+        net.add_listener(fault.CheckpointListener(ck, frequency=2,
+                                                  iterator=it))
+        net.fit(it, epochs=1)
+        return ck.steps()
+
+    def test_flip_falls_back_with_warning(self, tmpdir_, caplog):
+        steps = self._checkpointed_run(tmpdir_)
+        assert len(steps) >= 2
+        fault.corrupt_checkpoint(tmpdir_, mode="flip")
+        with caplog.at_level("WARNING", logger="deeplearning4j_tpu.fault"):
+            _, meta = fault.resume(tmpdir_)
+        assert meta["iteration_count"] == steps[-2]
+        assert any("corrupt" in r.message for r in caplog.records)
+
+    def test_truncate_falls_back(self, tmpdir_):
+        steps = self._checkpointed_run(tmpdir_)
+        fault.corrupt_checkpoint(tmpdir_, mode="truncate")
+        _, meta = fault.resume(tmpdir_)
+        assert meta["iteration_count"] == steps[-2]
+
+    def test_manifest_corruption_falls_back(self, tmpdir_):
+        steps = self._checkpointed_run(tmpdir_)
+        fault.corrupt_checkpoint(tmpdir_, mode="truncate",
+                                 target="manifest")
+        _, meta = fault.resume(tmpdir_)
+        assert meta["iteration_count"] == steps[-2]
+
+    def test_all_corrupt_raises_typed_error(self, tmpdir_):
+        steps = self._checkpointed_run(tmpdir_)
+        for s in steps:
+            fault.corrupt_checkpoint(tmpdir_, step=s, mode="flip")
+        with pytest.raises(fault.CheckpointCorruptError):
+            fault.resume(tmpdir_)
+
+    def test_empty_dir_raises_filenotfound(self, tmpdir_):
+        with pytest.raises(FileNotFoundError):
+            fault.resume(tmpdir_)
+
+
+# ==================================================== elastic resume
+class TestElasticResume:
+    def test_replica_count_change(self, tmpdir_):
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+        x, y = make_data()
+        m2 = Mesh(np.array(jax.devices()[:2]), ("data",))
+        m4 = Mesh(np.array(jax.devices()[:4]), ("data",))
+        net = build_net()
+        it = make_iter(x, y)
+        tr = ParallelTrainer(net, m2, mode="sync",
+                             gradient_sharing="threshold")
+        interrupt_fit(net, it, kill_at=6, freq=4, ckpt_dir=tmpdir_,
+                      trainer=tr)
+        saved = fault.load_latest_valid(tmpdir_)[0]
+        saved_res = saved["arrays"]["trainer"]["residual_r"]
+        assert fstate.stacked_replica_count(saved_res) == 2
+
+        net2 = build_net()
+        it2 = make_iter(x, y)
+        tr2 = ParallelTrainer(net2, m4, mode="sync",
+                              gradient_sharing="threshold")
+        tr2.resume(tmpdir_, iterator=it2)
+        res4 = tr2.threshold_residual()
+        assert fstate.stacked_replica_count(res4) == 4
+        # error-feedback mass conserved through the re-shard
+        s_old = sum(np.asarray(l).sum(dtype=np.float64)
+                    for l in jax.tree_util.tree_leaves(saved_res))
+        s_new = sum(np.asarray(l).sum(dtype=np.float64)
+                    for l in jax.tree_util.tree_leaves(res4))
+        assert np.isclose(s_old, s_new, rtol=1e-4, atol=1e-7)
+        # and the elastic run trains to completion on the new mesh
+        tr2.fit(it2, epochs=2 - net2.epoch_count, batch_size=8)
+        assert net2.iteration_count == 12
+
+
+# ================================================== iterator cursor
+class TestIteratorCursor:
+    def test_array_iterator_mid_epoch(self):
+        x, y = make_data(n=40)
+        a = make_iter(x, y)
+        seen = []
+        for ep in range(2):
+            for i, ds in enumerate(a):
+                seen.append(np.asarray(ds.features))
+                if ep == 1 and i == 1:
+                    cur = a.cursor()
+                    break
+            else:
+                continue
+            break
+        assert cur == {"epoch": 1, "batch": 2, "seed": 11, "shuffle": True}
+        b = make_iter(x, y)
+        b.seek(cur)
+        nxt = next(iter(b))
+        # the resumed stream continues with the batch AFTER the cursor,
+        # under the SAME epoch-1 permutation
+        expect_a = make_iter(x, y)
+        it = iter(expect_a)
+        for _ in range(5):
+            next(it)           # drain epoch 0
+        it = iter(expect_a)
+        next(it), next(it)
+        want = next(it)
+        assert np.array_equal(np.asarray(nxt.features),
+                              np.asarray(want.features))
+
+    def test_seek_to_epoch_end_yields_nothing(self):
+        x, y = make_data(n=40)
+        a = make_iter(x, y)
+        a.seek({"epoch": 0, "batch": 5, "seed": 11})
+        assert list(a) == []
+        assert len(list(a)) == 5   # next pass is a full epoch
+
+    def test_async_counts_consumed_not_prefetched(self):
+        x, y = make_data(n=64)
+        base = make_iter(x, y)
+        a = AsyncDataSetIterator(base, prefetch=4)
+        it = iter(a)
+        for _ in range(3):
+            next(it)
+        import time
+        time.sleep(0.2)      # let the worker run far ahead
+        cur = a.cursor()
+        assert cur["batch"] == 3, cur   # consumer position, not producer
+        it.close()
+        b = AsyncDataSetIterator(make_iter(x, y), prefetch=4)
+        b.seek(cur)
+        got = next(iter(b))
+        ref = make_iter(x, y)
+        rit = iter(ref)
+        for _ in range(3):
+            next(rit)
+        want = next(rit)
+        assert np.array_equal(np.asarray(got.features),
+                              np.asarray(want.features))
+
+    def test_unseekable_iterator_clear_error(self):
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+        with pytest.raises(NotImplementedError):
+            ListDataSetIterator([]).seek({"epoch": 0, "batch": 0})
+
+
+# ========================================= serializer hardening satellite
+class TestSerializerHardening:
+    def test_atomic_write_and_checksum_roundtrip(self, tmpdir_):
+        import os
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+        net = build_net()
+        x, y = make_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        path = os.path.join(tmpdir_, "model.zip")
+        ModelSerializer.write_model(net, path)
+        assert not [e for e in os.listdir(tmpdir_) if e.startswith(".")]
+        back = ModelSerializer.restore_model(path)
+        assert trees_bitwise(net.params, back.params)
+
+    def test_corrupt_zip_raises_typed_error(self, tmpdir_):
+        import os
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+        net = build_net()
+        path = os.path.join(tmpdir_, "model.zip")
+        ModelSerializer.write_model(net, path)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:     # silent bit rot mid-file
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(fault.CheckpointCorruptError):
+            ModelSerializer.restore_model(path)
+
+    def test_truncated_zip_raises_typed_error(self, tmpdir_):
+        import os
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+        net = build_net()
+        path = os.path.join(tmpdir_, "model.zip")
+        ModelSerializer.write_model(net, path)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+        with pytest.raises(fault.CheckpointCorruptError):
+            ModelSerializer.restore_model(path)
+
+
+# ========================================= early stopping resume satellite
+class TestEarlyStoppingResume:
+    def test_persist_and_resume(self, tmpdir_):
+        from deeplearning4j_tpu.earlystopping.conditions import (
+            MaxEpochsTerminationCondition,
+        )
+        from deeplearning4j_tpu.earlystopping.config import (
+            EarlyStoppingConfiguration,
+        )
+        from deeplearning4j_tpu.earlystopping.trainer import (
+            EarlyStoppingTrainer,
+        )
+
+        x, y = make_data()
+
+        def cfg(n):
+            return EarlyStoppingConfiguration(
+                epoch_termination_conditions=[
+                    MaxEpochsTerminationCondition(n)])
+
+        ref = EarlyStoppingTrainer(cfg(4), build_net(), make_iter(x, y)).fit()
+
+        # phase 1: stop after 2 epochs, persisting through the fault
+        # checkpointer; phase 2: fresh trainer resumes to 4 total
+        t1 = EarlyStoppingTrainer(cfg(2), build_net(), make_iter(x, y),
+                                  checkpointer=tmpdir_)
+        r1 = t1.fit()
+        assert r1.total_epochs == 2  # MaxEpochs(2) stops after epoch 1
+
+        t2 = EarlyStoppingTrainer(cfg(4), build_net(), make_iter(x, y),
+                                  checkpointer=tmpdir_)
+        r2 = t2.fit(resume=True)
+        assert set(r2.score_vs_epoch) == set(ref.score_vs_epoch)
+        assert r2.best_model_epoch == ref.best_model_epoch
+        assert np.isclose(r2.best_model_score, ref.best_model_score,
+                          rtol=1e-6)
+        assert r2.best_model is not None
+
+
+# =============================================== step_boundary contract
+class TestStepBoundaryContract:
+    def test_fused_marks_only_group_tail(self):
+        x, y = make_data()
+        net = build_net()
+        seen = []
+
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+        class Probe(TrainingListener):
+            def iteration_done(self, model, iteration, epoch, score,
+                               **info):
+                seen.append((iteration, info.get("step_boundary", True)))
+
+        net.add_listener(Probe())
+        net.fit(make_iter(x, y, shuffle=False), epochs=1,
+                steps_per_execution=3)
+        # 6 batches, spe=3 -> groups [0,1,2], [3,4,5]; boundaries at 2, 5
+        assert [b for _, b in seen] == [False, False, True,
+                                        False, False, True]
