@@ -405,6 +405,23 @@ def bench_resnet50(accel, batch=None, size=None, steps=None,
     ach_hlo, mfu_hlo = _mfu(hlo_flops)
     mfu_vs_eff = (ach_analytic / effective_peak
                   if ach_analytic is not None and effective_peak else None)
+    try:
+        # exposed-vs-overlapped comm bytes of the (default) bucketed
+        # gradient exchange for this exact net — host math over the
+        # bucket plan (benchtools/hlo_cost.comm_overlap_block), so the
+        # BENCH ledger tracks the overlap win alongside MFU
+        from benchtools import hlo_cost as _hc
+        _co = _hc.comm_overlap_block(
+            net,
+            backward_flops_per_step=(analytic_flops or 0.0) * 2.0 / 3.0,
+            peak_tflops=(measured_peak or nominal_peak or 100.0),
+            device_kind=str(kind), bucket_table=False)
+        comm_overlap = {k: _co[k] for k in (
+            "total_bytes", "exposed_bytes", "overlapped_bytes",
+            "exposed_fraction", "ici_gbps", "ici_source", "n_workers",
+            "buckets")}
+    except Exception as e:  # noqa: BLE001 — accounting never kills bench
+        comm_overlap = {"error": f"{type(e).__name__}: {e}"[:200]}
     return {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 2),
@@ -445,6 +462,7 @@ def bench_resnet50(accel, batch=None, size=None, steps=None,
                      "tunneled device_kind label may not match the "
                      "executing silicon"),
         "with_etl": etl,
+        "comm_overlap": comm_overlap,
         "loss_first": losses[0], "loss_last": losses[-1],
         "loss_after_timed_windows": loss_last,
         "train_signal_ok": losses[-1] < losses[0],

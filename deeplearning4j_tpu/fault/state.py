@@ -27,6 +27,22 @@ The ``stacked::`` run packing of nn/scan_stack.py exists only inside
 jitted step programs — every tree here is per-layer-keyed by contract,
 so checkpoints are independent of the scan/pack configuration that
 wrote them.
+
+Sharding-related invariants of the trainer state kinds:
+
+- ``threshold`` / ``threshold_rs``: the error-feedback residual is a
+  per-replica stack (leading replica axis) — elastic restore re-shards
+  it sum-preserving (`reshard_replica_stack(kind="residual")`); τ is
+  either one scalar (PR-4 single-barrier checkpoints) or a per-bucket
+  ``{layer_key: scalar}`` tree (bucketed exchange) — both restore
+  as written and coerce at the next fit.
+- ``sync_dense_rs`` / ``threshold_rs``: the ZeRO modes hold updater
+  state SHARDED over the data axis during fit, but checkpoints always
+  carry the reassembled FULL per-layer tree (the trainers'
+  `_rs_full_state_fn` gathers at capture) — so data-axis-sharded
+  updater state is replica-count independent on disk and an elastic
+  resume just re-slices at the next fit, with the shard plan
+  re-derived for the new replica count.
 """
 
 from __future__ import annotations

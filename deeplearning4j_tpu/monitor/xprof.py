@@ -108,6 +108,15 @@ _GAUGE_FIELDS = (
     ("aot_comm_bytes_threshold", ("program", "comm_bytes",
                                   "threshold_bytes_per_step")),
     ("aot_comm_bytes_reduction", ("program", "comm_bytes", "reduction")),
+    # exposed-vs-overlapped comm bytes of the bucketed exchange
+    # (benchtools/hlo_cost.comm_overlap_block; headline = the sync
+    # trainers' default bucketed-dense program)
+    ("aot_comm_overlap_exposed_bytes", ("program", "comm_overlap",
+                                        "exposed_bytes")),
+    ("aot_comm_overlap_overlapped_bytes", ("program", "comm_overlap",
+                                           "overlapped_bytes")),
+    ("aot_comm_overlap_exposed_fraction", ("program", "comm_overlap",
+                                           "exposed_fraction")),
 )
 
 

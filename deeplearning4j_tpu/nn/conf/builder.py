@@ -287,13 +287,16 @@ class ListBuilder:
     def gradient_sharing(self, mode: str,
                          threshold: Optional[float] = None) -> "ListBuilder":
         """Gradient exchange mode for the distributed sync trainers:
-        "dense" (default) or "threshold" (error-feedback compressed
-        collectives — parallel/gradient_sharing.py). `threshold` sets
-        the initial adaptive τ (reference SharedTrainingMaster
-        threshold, default 1e-3)."""
-        if mode not in ("dense", "threshold"):
+        "dense" (default), "threshold" (error-feedback compressed
+        collectives), or the ZeRO-style reduce-scatter modes
+        "dense_rs"/"threshold_rs" (updater state sharded over the data
+        axis — parallel/gradient_sharing.py). `threshold` sets the
+        initial adaptive τ (reference SharedTrainingMaster threshold,
+        default 1e-3)."""
+        if mode not in ("dense", "threshold", "dense_rs", "threshold_rs"):
             raise ValueError(
-                f"gradient_sharing must be dense|threshold, got {mode!r}")
+                f"gradient_sharing must be dense|threshold|dense_rs|"
+                f"threshold_rs, got {mode!r}")
         self._gradient_sharing = mode
         if threshold is not None:
             self._gradient_sharing_threshold = float(threshold)

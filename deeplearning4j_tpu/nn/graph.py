@@ -238,11 +238,13 @@ class GraphBuilder:
 
     def gradient_sharing(self, mode: str, threshold=None) -> "GraphBuilder":
         """Gradient exchange mode for the distributed sync trainers:
-        "dense" (default) or "threshold" (error-feedback compressed
-        collectives — parallel/gradient_sharing.py)."""
-        if mode not in ("dense", "threshold"):
+        "dense" (default), "threshold" (error-feedback compressed
+        collectives), or "dense_rs"/"threshold_rs" (ZeRO-style sharded
+        updater — parallel/gradient_sharing.py)."""
+        if mode not in ("dense", "threshold", "dense_rs", "threshold_rs"):
             raise ValueError(
-                f"gradient_sharing must be dense|threshold, got {mode!r}")
+                f"gradient_sharing must be dense|threshold|dense_rs|"
+                f"threshold_rs, got {mode!r}")
         self._conf.gradient_sharing = mode
         if threshold is not None:
             self._conf.gradient_sharing_threshold = float(threshold)

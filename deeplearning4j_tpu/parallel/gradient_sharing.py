@@ -50,6 +50,39 @@ more); always clamped to [min_threshold, max_threshold]. τ and the
 residual ride the fused multi-step scan carry next to the updater
 state, and pack/unpack across the ``stacked::`` run boundary exactly
 like updater state does (nn/scan_stack.py).
+
+Bucketed (overlapped) exchange — the default for sync trainers:
+instead of one post-backward barrier, every ``stacked::`` packed run
+and every unpacked layer is a **bucket** whose exchange is emitted by
+a `jax.custom_vjp` hook the moment backward finishes that bucket's
+VJP: the cotangent of bucket i's params is data-independent of the
+backward compute of buckets i+1.. (layers earlier in forward order),
+so XLA's scheduler can run collective i concurrently with the
+remaining backward — the comm/compute overlap the CUDA-aware-MPI
+characterization (arXiv:1810.11112) identifies as the scaling
+headroom beyond compression. In threshold mode the per-bucket
+residual and τ thread THROUGH the VJP via the hook's cotangent
+channel (the bwd rule returns the advanced residual/τ/updater state
+as the "gradients" of those inputs), preserving the error-feedback
+identity enc·τ + res_new = update + res_old **per bucket**. Opt out
+with ``DL4J_BUCKETED_EXCHANGE=0`` (or ``bucketed=False`` on the
+trainers) for the PR-4 single-barrier program.
+
+ZeRO-style sharded-updater modes ``dense_rs`` / ``threshold_rs``:
+on the same bucket structure, gradients are **reduce-scattered** over
+the data axis instead of all-reduced, each replica runs the updater
+only on its gradient shard (updater state sharded over the data axis
+— 1/N optimizer memory, the ZeRO partitioning), updates its param
+shard, and the updated params are **all-gathered**. Which leaves
+shard follows the same rule as `parallel.tensor.fsdp_param_specs`
+(last axis, divisibility-gated, small leaves replicated) so the wire
+layout composes with FSDP sharding annotations. ``dense_rs`` is
+bit-identical to bucketed ``dense`` (reduce-scatter + all-gather is
+the same sum, elementwise updater math is shard-oblivious);
+``threshold_rs`` threshold-encodes the RAW gradient (+ residual)
+before the integer reduce-scatter — the updater runs post-decode on
+the shard, so τ lives on the gradient scale there, unlike
+``threshold`` where it lives on the update scale.
 """
 
 from __future__ import annotations
@@ -64,13 +97,39 @@ import numpy as np
 
 from deeplearning4j_tpu.nn import scan_stack
 
-MODES = ("dense", "threshold")
+MODES = ("dense", "threshold", "dense_rs", "threshold_rs")
+RS_MODES = ("dense_rs", "threshold_rs")
 
 # env values that force each mode (mirrors DL4J_SCAN_LAYERS's spelling
 # tolerance: 0/off/false disable the feature, i.e. force dense)
 _ENV_VAR = "DL4J_GRADIENT_SHARING"
 _ENV_DENSE = ("dense", "0", "off", "false", "no")
 _ENV_THRESHOLD = ("threshold", "1", "on", "true", "yes")
+
+# bucketed (per-layer-run, overlapped) exchange toggle: default ON;
+# DL4J_BUCKETED_EXCHANGE=0 restores the PR-4 single-barrier program
+_BUCKET_ENV_VAR = "DL4J_BUCKETED_EXCHANGE"
+
+
+def resolve_bucketed(explicit: Optional[bool] = None) -> bool:
+    """Bucketed-exchange resolution: the ``DL4J_BUCKETED_EXCHANGE``
+    env override wins (A/B the overlap without touching code), then an
+    explicit trainer argument, then the default True. Unknown env
+    spellings raise (mirroring ``DL4J_GRADIENT_SHARING``) — a typo'd
+    opt-out must not silently keep the bucketed program running."""
+    env = os.environ.get(_BUCKET_ENV_VAR)
+    if env is not None and env.strip():
+        v = env.strip().lower()
+        if v in ("0", "off", "false", "no"):
+            return False
+        if v in ("1", "on", "true", "yes"):
+            return True
+        raise ValueError(
+            f"{_BUCKET_ENV_VAR}={env!r}: expected one of "
+            f"('0', 'off', 'false', 'no', '1', 'on', 'true', 'yes')")
+    if explicit is not None:
+        return bool(explicit)
+    return True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,9 +190,11 @@ def env_mode() -> Optional[str]:
         return "dense"
     if v in _ENV_THRESHOLD:
         return "threshold"
+    if v in RS_MODES:
+        return v
     raise ValueError(
         f"{_ENV_VAR}={env!r}: expected one of "
-        f"{_ENV_DENSE + _ENV_THRESHOLD}")
+        f"{_ENV_DENSE + _ENV_THRESHOLD + RS_MODES}")
 
 
 def resolve_mode(explicit: Optional[str] = None, conf=None) -> str:
@@ -278,24 +339,12 @@ def compute_updater_deltas(model, is_graph: bool, params, grads,
 
 
 def apply_decoded_updates(model, is_graph: bool, params, dhat):
-    """params minus the decoded shared update, with the same
-    constraint pipeline `_apply_updates` runs post-update (per-layer
-    constraints — never present on packed runs, `packable_runs`
-    guarantees it — then the global max-norm)."""
-    from deeplearning4j_tpu.optimize.gradients import (
-        apply_max_norm_constraint,
-    )
-
-    new_params = {}
-    for lk, ld in dhat.items():
-        layer = _layer_for_key(model, is_graph, lk)
-        lp = {pk: params[lk][pk] - d for pk, d in ld.items()}
-        new_params[lk] = (lp if scan_stack.is_run_key(lk)
-                          else layer.apply_constraints(lp))
-    if model.conf.max_norm is not None:
-        new_params = apply_max_norm_constraint(new_params,
-                                               model.conf.max_norm)
-    return new_params
+    """params minus the decoded shared update, then the shared
+    post-update constraint pipeline (`_apply_constraints_tree` — one
+    copy for the threshold and bucketed dense/rs paths)."""
+    new_params = {lk: {pk: params[lk][pk] - d for pk, d in ld.items()}
+                  for lk, ld in dhat.items()}
+    return _apply_constraints_tree(model, is_graph, new_params)
 
 
 def _pmean_state(state, axis):
@@ -445,14 +494,674 @@ def make_threshold_multi(model, axis: str, cfg: ThresholdConfig, *,
     return multi
 
 
+# ----------------------------------------- partial-manual scan support probe
+# jaxlib's 0.4.x SPMD partitioner hard-crashes (C++ CHECK failure —
+# `Check failed: sharding.IsManualSubgroup()` — NOT a catchable Python
+# exception) on an inner `lax.scan` under a partially-manual shard_map
+# (`auto=` axes, the DP x TP threshold exchange). Newer jaxlibs
+# partition it fine, and unconditionally unrolling there throws away
+# the scan-over-layers compiled-size win. This probe decides at trace
+# time: known-crashy versions are version-gated WITHOUT ever compiling
+# (a compile attempt would abort the process, so try/except cannot
+# probe them), newer ones are proven by actually compiling a tiny
+# scan-under-partial-manual program once per process.
+_PARTIAL_MANUAL_SCAN_MIN_JAXLIB = (0, 5, 0)
+_partial_manual_scan_cache: Optional[bool] = None
+
+
+def _jaxlib_version() -> tuple:
+    try:
+        import jaxlib
+        return tuple(int(p) for p in jaxlib.__version__.split(".")[:3])
+    except Exception:  # noqa: BLE001 — unparseable version: assume old
+        return (0, 0, 0)
+
+
+def _probe_partial_manual_scan() -> bool:
+    """Compile a minimal inner-scan-under-partial-manual program. Only
+    called on jaxlibs past the version gate, where partitioner failures
+    surface as Python exceptions. The AUTO (model) axis gets size 2
+    whenever a second device exists — a 1-partition auto axis would
+    skip the partial-manual subgroup path entirely and prove nothing;
+    on a genuinely single-device host the probe stays weak and the
+    version gate is the real decision."""
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.compat import shard_map
+
+    devs = jax.devices()
+    n_auto = 2 if len(devs) >= 2 else 1
+    mesh = Mesh(np.array(devs[:n_auto]).reshape(1, n_auto),
+                ("data", "model"))
+
+    @partial(shard_map, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+             auto=frozenset({"model"}), check_vma=False)
+    def prog(x):
+        def body(c, s):
+            return c + s, None
+        out, _ = jax.lax.scan(body, x[0], jnp.ones((3,) + x.shape[1:]))
+        return out[None] + jax.lax.psum(x, "data")
+
+    jax.jit(prog).lower(jnp.ones((1, 4))).compile()
+    return True
+
+
+def partial_manual_scan_supported() -> bool:
+    """True when this jaxlib can partition an inner `lax.scan` under a
+    partially-manual shard_map — the gate for keeping scan-over-layers
+    compilation in the DP x TP step instead of `force_unrolled`.
+    Cached per process; see docs/COMMS.md ("Scan under DP x TP")."""
+    global _partial_manual_scan_cache
+    if _partial_manual_scan_cache is None:
+        if _jaxlib_version() < _PARTIAL_MANUAL_SCAN_MIN_JAXLIB:
+            _partial_manual_scan_cache = False
+        else:
+            try:
+                _partial_manual_scan_cache = _probe_partial_manual_scan()
+            except Exception:  # noqa: BLE001 — any failure: stay unrolled
+                _partial_manual_scan_cache = False
+    return _partial_manual_scan_cache
+
+
+# ------------------------------------------- bucketed (overlapped) exchange
+# Bucket = one top-level key of the packed gradient tree: a
+# ``stacked::`` run or a single unpacked layer. Each bucket's exchange
+# is a `jax.custom_vjp` hook on that bucket's params: backward produces
+# the bucket's cotangent the moment its VJP completes, the hook's bwd
+# rule emits the collective right there, and XLA schedules it against
+# the backward compute still pending for earlier layers. State the
+# exchange advances (per-replica updater state, error-feedback
+# residual, the [τ, sparsity] control vector) enters the hook as extra
+# primal inputs and exits through their cotangents — the only data
+# path out of a VJP rule — so the error-feedback identity holds per
+# bucket with no post-backward barrier.
+
+def _ctrl(tau):
+    """[τ, sparsity] control vector for one bucket (sparsity slot is
+    an output: the bwd rule fills it with the achieved encoded
+    fraction)."""
+    return jnp.stack([jnp.asarray(tau, jnp.float32), jnp.float32(0.0)])
+
+
+def _elementwise_gn(g, gn, gn_t):
+    """The gradient-normalization subset the rs modes support: modes
+    that factorize per ELEMENT (so clipping a reduced shard equals
+    clipping the reduced full tensor). Norm-based modes need the whole
+    layer and are rejected at trainer build time."""
+    gn = getattr(gn, "value", gn) or "none"
+    if gn == "clip_elementwise_absolute_value":
+        return jnp.clip(g, -gn_t, gn_t)
+    return g
+
+
+def rs_supported_gn(conf) -> bool:
+    """True when this configuration's gradient normalization factorizes
+    per element (the `_rs` modes normalize reduced gradient SHARDS)."""
+    gn = getattr(conf, "gradient_normalization", None)
+    gn = getattr(gn, "value", gn) or "none"
+    return gn in ("none", "clip_elementwise_absolute_value")
+
+
+def rs_shard_plan(params, n_workers: int, *, specs=None,
+                  data_axis: str = "data",
+                  min_shard_elems: int = 1024) -> dict:
+    """{layer_key: {param_name: bool}} — which leaves the `_rs` modes
+    reduce-scatter on their LAST axis. With `specs` (a PartitionSpec
+    tree, e.g. `parallel.tensor.fsdp_param_specs` output) a leaf shards
+    iff its spec's last entry names `data_axis` — the composition seam
+    with FSDP annotations. Without, the same rule fsdp_param_specs
+    applies is derived from shapes: last axis divisible by n_workers,
+    at least `min_shard_elems` elements."""
+    plan = {}
+    for lk, lparams in params.items():
+        lplan = {}
+        for pn, arr in lparams.items():
+            if specs is not None:
+                spec = specs[lk][pn]
+                dims = tuple(spec)
+                lplan[pn] = bool(dims and dims[-1] == data_axis)
+            else:
+                shape = np.shape(arr)
+                lplan[pn] = bool(
+                    shape and shape[-1] % n_workers == 0
+                    and int(np.prod(shape)) >= min_shard_elems)
+        plan[lk] = lplan
+    return plan
+
+
+def _plan_for(rs_plan: dict, lk: str) -> dict:
+    """Bucket-key lookup into a per-layer rs plan: a ``stacked::`` run
+    resolves to its first member (structural identity guarantees every
+    member shares the plan)."""
+    if scan_stack.is_run_key(lk):
+        lk = scan_stack.run_members(lk)[0]
+    return rs_plan[lk]
+
+
+
+
+def _threshold_bucket_hook(model, is_graph: bool, lk: str, axis: str,
+                           cfg: ThresholdConfig, n_workers: int,
+                           gn, gn_t):
+    """Threshold exchange for ONE bucket, emitted inside the backward
+    pass. Primal: identity on the bucket's params. VJP: local gradient
+    → gradient normalization (every GN mode factorizes per layer key,
+    so per-bucket == whole-tree) → per-replica updater → error-feedback
+    threshold encode at this bucket's τ → integer all-reduce → decode.
+    The advanced updater state / residual / [τ', sparsity] leave
+    through the cotangents of the matching primal inputs."""
+    from deeplearning4j_tpu.common.updaters import Sgd
+    from deeplearning4j_tpu.optimize.gradients import (
+        apply_gradient_normalization,
+    )
+
+    layer = _layer_for_key(model, is_graph, lk)
+    updater = layer.updater or Sgd(1e-3)
+
+    @jax.custom_vjp
+    def hook(p, u, r, c, it_f):
+        return p
+
+    def fwd(p, u, r, c, it_f):
+        return p, (p, u, r, c, it_f)
+
+    def bwd(saved, g):
+        p, u, r, c, it_f = saved
+        g = apply_gradient_normalization({lk: g}, gn, gn_t)[lk]
+        deltas, new_u = {}, {}
+        for pk, gg in g.items():
+            d, s = updater.apply(gg, u[pk], it_f)
+            deltas[pk] = d.astype(p[pk].dtype)
+            new_u[pk] = s
+        dhat, new_r, new_tau, sp = threshold_exchange(
+            deltas, r, c[0], axis, cfg, n_workers=n_workers)
+        new_r = jax.tree_util.tree_map(
+            lambda nr, rr: nr.astype(rr.dtype), new_r, r)
+        return (dhat, new_u, new_r, jnp.stack([new_tau, sp]),
+                jnp.zeros_like(it_f))
+
+    hook.defvjp(fwd, bwd)
+    return hook
+
+
+def _dense_bucket_hook(model, is_graph: bool, lk: str, axis: str,
+                       n_workers: int, gn, gn_t, plan_b: dict, *,
+                       full_gn: bool):
+    """Dense / ZeRO exchange for ONE bucket, emitted inside the
+    backward pass. Per leaf: all-reduce-mean (plan False) or
+    reduce-scatter-mean over the data axis (plan True — each replica
+    then holds only its gradient shard), gradient normalization, the
+    updater on exactly what this replica holds (full tensor, or the
+    shard with SHARDED updater state — 1/N optimizer memory), update
+    the held params, all-gather updated shards. The cotangent of the
+    bucket's params is the UPDATED params (constraints applied by the
+    caller).
+
+    ``dense`` is this hook with an all-False plan (`full_gn=True`:
+    every GN mode factorizes per layer key, so per-bucket GN on the
+    reduced full gradient equals whole-tree GN); ``dense_rs`` shards
+    by plan with elementwise-only GN (build-time gated). Under
+    elementwise GN the two run the SAME per-element op sequence —
+    reduce-scatter + all-gather is the same sum as the all-reduce —
+    which is what makes dense_rs bit-identical to bucketed dense."""
+    from deeplearning4j_tpu.common.updaters import Sgd
+    from deeplearning4j_tpu.optimize.gradients import (
+        apply_gradient_normalization,
+    )
+
+    layer = _layer_for_key(model, is_graph, lk)
+    updater = layer.updater or Sgd(1e-3)
+    n = n_workers
+
+    @jax.custom_vjp
+    def hook(p, u, it_f):
+        return p
+
+    def fwd(p, u, it_f):
+        return p, (p, u, it_f)
+
+    def bwd(saved, g):
+        p, u, it_f = saved
+        idx = jax.lax.axis_index(axis)
+        reduced = {}
+        for pk, gg in g.items():
+            if plan_b.get(pk):
+                reduced[pk] = jax.lax.psum_scatter(
+                    gg, axis, scatter_dimension=gg.ndim - 1, tiled=True) / n
+            else:
+                reduced[pk] = jax.lax.pmean(gg, axis)
+        if full_gn:
+            reduced = apply_gradient_normalization({lk: reduced},
+                                                   gn, gn_t)[lk]
+        else:
+            reduced = {pk: _elementwise_gn(v, gn, gn_t)
+                       for pk, v in reduced.items()}
+        # fusion barrier: pin the reduce | updater | apply cluster
+        # boundaries so the dense and dense_rs programs compile the
+        # SAME elementwise updater kernels — the dense_rs==dense
+        # bit-parity contract would otherwise be broken by
+        # context-dependent FMA contraction (1-ulp drift). Costs
+        # nothing material: the updater is a vanishing share of step
+        # FLOPs and collective scheduling is unaffected.
+        reduced = jax.lax.optimization_barrier(reduced)
+        new_p, new_u = {}, {}
+        for pk, gg in g.items():
+            d, su = updater.apply(reduced[pk], u[pk], it_f)
+            d = jax.lax.optimization_barrier(d)
+            if plan_b.get(pk):
+                s = gg.shape[-1] // n
+                psh = jax.lax.dynamic_slice_in_dim(
+                    p[pk], idx * s, s, axis=gg.ndim - 1)
+                new_p[pk] = jax.lax.all_gather(
+                    psh - d.astype(psh.dtype), axis,
+                    axis=gg.ndim - 1, tiled=True)
+            else:
+                new_p[pk] = p[pk] - d.astype(p[pk].dtype)
+            new_u[pk] = su
+        return new_p, new_u, jnp.zeros_like(it_f)
+
+    hook.defvjp(fwd, bwd)
+    return hook
+
+
+def _threshold_rs_bucket_hook(model, is_graph: bool, lk: str, axis: str,
+                              cfg: ThresholdConfig, n_workers: int,
+                              gn, gn_t, plan_b: dict, elems: float):
+    """Compressed ZeRO exchange for ONE bucket: threshold-encode the
+    RAW local gradient (+ error-feedback residual) to the integer wire
+    format, reduce-scatter the int tensor, decode the gradient SHARD
+    (τ·Σ/N), run the updater on the shard (sharded updater state),
+    update the param shard, all-gather updated params. Unlike
+    ``threshold``, the updater runs post-decode — so τ lives on the
+    GRADIENT scale here, and the residual keeps un-sent gradient (not
+    update) mass."""
+    from deeplearning4j_tpu.common.updaters import Sgd
+
+    layer = _layer_for_key(model, is_graph, lk)
+    updater = layer.updater or Sgd(1e-3)
+    n = n_workers
+    wdtype = wire_dtype(n)
+    inv_n = 1.0 / float(n)
+
+    @jax.custom_vjp
+    def hook(p, u, r, c, it_f):
+        return p
+
+    def fwd(p, u, r, c, it_f):
+        return p, (p, u, r, c, it_f)
+
+    def bwd(saved, g):
+        p, u, r, c, it_f = saved
+        tau = c[0]
+        idx = jax.lax.axis_index(axis)
+        new_p, new_u, new_r = {}, {}, {}
+        sent_total = jnp.float32(0.0)
+        for pk, gg in g.items():
+            acc = gg + r[pk].astype(gg.dtype)
+            enc, res_new, sent = encode_leaf(acc, tau, wdtype)
+            sent_total = sent_total + sent
+            new_r[pk] = res_new.astype(r[pk].dtype)
+            scale = tau.astype(gg.dtype) * gg.dtype.type(inv_n)
+            if plan_b.get(pk):
+                wire = jax.lax.psum_scatter(
+                    enc, axis, scatter_dimension=enc.ndim - 1, tiled=True)
+                # GN on the REDUCED (decoded) shard — the same
+                # post-reduce order dense_rs uses, which is the
+                # contract the trainer's elementwise-GN gate states
+                gsh = _elementwise_gn(wire.astype(gg.dtype) * scale,
+                                      gn, gn_t)
+                s = gg.shape[-1] // n
+                psh = jax.lax.dynamic_slice_in_dim(
+                    p[pk], idx * s, s, axis=gg.ndim - 1)
+                d, su = updater.apply(gsh, u[pk], it_f)
+                nps = psh - d.astype(psh.dtype)
+                new_p[pk] = jax.lax.all_gather(
+                    nps, axis, axis=gg.ndim - 1, tiled=True)
+            else:
+                ghat = _elementwise_gn(
+                    jax.lax.psum(enc, axis).astype(gg.dtype) * scale,
+                    gn, gn_t)
+                d, su = updater.apply(ghat, u[pk], it_f)
+                new_p[pk] = p[pk] - d.astype(p[pk].dtype)
+            new_u[pk] = su
+        sp = jax.lax.pmean(sent_total, axis) / elems
+        new_tau = adapt_threshold(tau, sp, cfg)
+        return (new_p, new_u, new_r, jnp.stack([new_tau, sp]),
+                jnp.zeros_like(it_f))
+
+    hook.defvjp(fwd, bwd)
+    return hook
+
+
+def _apply_constraints_tree(model, is_graph: bool, new_params):
+    """The post-update constraint pipeline `_apply_updates` runs, for
+    params the rs hooks already updated: per-layer constraints (never
+    on packed runs — `packable_runs` guarantees it), then the global
+    max-norm. Replicated math on replicated params."""
+    from deeplearning4j_tpu.optimize.gradients import (
+        apply_max_norm_constraint,
+    )
+
+    out = {}
+    for lk, lp in new_params.items():
+        layer = _layer_for_key(model, is_graph, lk)
+        out[lk] = (lp if scan_stack.is_run_key(lk)
+                   else layer.apply_constraints(lp))
+    if model.conf.max_norm is not None:
+        out = apply_max_norm_constraint(out, model.conf.max_norm)
+    return out
+
+
+def make_bucketed_core(model, axis: str, cfg: ThresholdConfig, *,
+                       n_workers: int, mode: str, is_graph: bool = False,
+                       rs_plan: Optional[dict] = None):
+    """Per-replica bucketed sync-step body on ALREADY-PACKED trees.
+    Uniform signature across the four modes:
+
+        core(params, upd, state, it, residual, tau, x, y, rng)
+          -> (params, upd, state, residual, tau, loss, sparsity)
+
+    `tau` is a PER-BUCKET dict of f32 scalars (empty for the dense
+    modes, as is `residual`); `upd` is the per-replica updater view for
+    ``threshold`` (each replica its own, PR-4 semantics), the SHARDED
+    updater view for the `_rs` modes (ZeRO partitioning), and the
+    single replicated tree for ``dense``. `sparsity` is the
+    element-weighted mean encoded fraction over buckets (1.0 for
+    dense modes — everything is sent)."""
+    from deeplearning4j_tpu.optimize.gradients import (
+        apply_gradient_normalization,
+    )
+
+    gn = model.conf.gradient_normalization
+    gn_t = model.conf.gradient_normalization_threshold
+    local_loss = _local_loss_fn(model, is_graph)
+
+    def core(params, upd, state, it, residual, tau, x, y, rng):
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+        it_f = jnp.asarray(it, jnp.float32)
+
+        if mode in ("dense", "dense_rs"):
+            no_shard: dict = {}
+            hooks = {lk: _dense_bucket_hook(
+                model, is_graph, lk, axis, n_workers, gn, gn_t,
+                no_shard if mode == "dense" else _plan_for(rs_plan, lk),
+                full_gn=mode == "dense") for lk in params}
+
+            def lf(p, u):
+                hp = {lk: hooks[lk](p[lk], u[lk], it_f) for lk in p}
+                return local_loss(hp, state, x, y, rng)
+
+            (loss, (new_state, _)), (upd_p, new_upd) = jax.value_and_grad(
+                lf, argnums=(0, 1), has_aux=True)(params, upd)
+            new_params = _apply_constraints_tree(model, is_graph, upd_p)
+            return (new_params, new_upd, _pmean_state(new_state, axis),
+                    residual, tau, jax.lax.pmean(loss, axis),
+                    jnp.float32(1.0))
+
+        if mode == "threshold":
+            hooks = {lk: _threshold_bucket_hook(
+                model, is_graph, lk, axis, cfg, n_workers, gn, gn_t)
+                for lk in params}
+            ctrl = {lk: _ctrl(tau[lk]) for lk in params}
+
+            def lf(p, u, r, c):
+                hp = {lk: hooks[lk](p[lk], u[lk], r[lk], c[lk], it_f)
+                      for lk in p}
+                return local_loss(hp, state, x, y, rng)
+
+            (loss, (new_state, _)), (dhat, new_upd, new_res, new_ctrl) = \
+                jax.value_and_grad(lf, argnums=(0, 1, 2, 3),
+                                   has_aux=True)(params, upd, residual,
+                                                 ctrl)
+            new_params = apply_decoded_updates(model, is_graph, params,
+                                               dhat)
+
+        elif mode == "threshold_rs":
+            hooks = {lk: _threshold_rs_bucket_hook(
+                model, is_graph, lk, axis, cfg, n_workers, gn, gn_t,
+                _plan_for(rs_plan, lk), tree_elements(params[lk]))
+                for lk in params}
+            ctrl = {lk: _ctrl(tau[lk]) for lk in params}
+
+            def lf(p, u, r, c):
+                hp = {lk: hooks[lk](p[lk], u[lk], r[lk], c[lk], it_f)
+                      for lk in p}
+                return local_loss(hp, state, x, y, rng)
+
+            (loss, (new_state, _)), (upd_p, new_upd, new_res, new_ctrl) = \
+                jax.value_and_grad(lf, argnums=(0, 1, 2, 3),
+                                   has_aux=True)(params, upd, residual,
+                                                 ctrl)
+            new_params = _apply_constraints_tree(model, is_graph, upd_p)
+
+        else:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+
+        new_tau = {lk: new_ctrl[lk][0] for lk in new_ctrl}
+        total = tree_elements(params)
+        sparsity = sum(new_ctrl[lk][1] * tree_elements(params[lk])
+                       for lk in new_ctrl) / total
+        return (new_params, new_upd, _pmean_state(new_state, axis),
+                new_res, new_tau, jax.lax.pmean(loss, axis), sparsity)
+
+    return core
+
+
+def _pack_scalar_tree(tree, runs):
+    """Per-layer scalar tree (per-bucket τ) packed to bucket keys: a
+    run's bucket carries its FIRST member's scalar (unpack broadcasts
+    it back, so all members of a run share τ by invariant)."""
+    members = {k for keys in runs for k in keys}
+    out = {k: v for k, v in tree.items() if k not in members}
+    for keys in runs:
+        out[scan_stack.run_key(keys)] = tree[keys[0]]
+    return out
+
+
+def _unpack_scalar_tree(tree, runs):
+    out = {k: v for k, v in tree.items() if not scan_stack.is_run_key(k)}
+    for keys in runs:
+        v = tree[scan_stack.run_key(keys)]
+        for k in keys:
+            out[k] = v
+    return out
+
+
+def init_tau_tree(params, cfg: ThresholdConfig) -> dict:
+    """Fresh per-bucket τ state with per-LAYER keys (the checkpoint
+    contract: ``stacked::`` packing exists only inside the program)."""
+    return {lk: np.float32(cfg.initial_threshold) for lk in params}
+
+
+def coerce_tau(tau, layer_keys, cfg: Optional[ThresholdConfig] = None):
+    """Checkpoint-form τ → per-layer tree: PR-4 checkpoints carry ONE
+    scalar (broadcast to every layer), bucketed checkpoints a per-layer
+    dict; a missing τ falls back to the config's initial value."""
+    keys = list(layer_keys)
+    if tau is None:
+        cfg = cfg or ThresholdConfig()
+        return {lk: np.float32(cfg.initial_threshold) for lk in keys}
+    if isinstance(tau, dict):
+        cfg = cfg or ThresholdConfig()
+        return {lk: np.float32(tau[lk]) if lk in tau
+                else np.float32(cfg.initial_threshold) for lk in keys}
+    return {lk: np.float32(np.asarray(tau)) for lk in keys}
+
+
+def ensure_tau_form(tau, per_bucket: bool, params,
+                    cfg: ThresholdConfig):
+    """The second half of the τ seam (`restore_tau` is the first):
+    bring an existing τ state — or None — into the form the CURRENT
+    step program needs: a per-bucket `{layer_key: scalar}` tree when
+    `per_bucket`, one scalar otherwise. Cross-form inputs coerce
+    (scalar broadcasts; a tree collapses to its bucket mean). One
+    helper for both trainers so path switches and cross-form
+    checkpoint restores can never diverge between them."""
+    if tau is None:
+        return (init_tau_tree(params, cfg) if per_bucket
+                else jnp.float32(cfg.initial_threshold))
+    if per_bucket and not isinstance(tau, dict):
+        return coerce_tau(np.asarray(tau), params.keys(), cfg)
+    if not per_bucket and isinstance(tau, dict):
+        return jnp.float32(tau_scalar(tau))
+    return tau
+
+
+def restore_tau(tau):
+    """Checkpoint-form τ → trainer state AS WRITTEN: a per-bucket
+    {layer_key: scalar} tree (bucketed checkpoints) or one scalar
+    (PR-4 single-barrier checkpoints). Coercion to the current path's
+    form happens at the next fit (`coerce_tau` / `tau_scalar`); the
+    single restore seam keeps both trainers' checkpoint handling from
+    diverging."""
+    if isinstance(tau, dict):
+        return {lk: np.float32(np.asarray(v)) for lk, v in tau.items()}
+    return jnp.float32(np.asarray(tau))
+
+
+def tau_scalar(tau) -> float:
+    """Observability scalar for a τ state of either form (scalar or
+    per-layer tree): the mean over buckets. Tree leaves are stacked on
+    device and fetched in ONE transfer — a per-leaf float() would cost
+    one host round-trip per layer per step on the eager-listener
+    path."""
+    if isinstance(tau, dict):
+        if not tau:
+            return 0.0
+        vals = np.asarray(jnp.stack([jnp.asarray(v)
+                                     for v in tau.values()]))
+        return float(vals.mean())
+    return float(np.asarray(tau))
+
+
+def make_bucketed_step(model, axis: str, cfg: ThresholdConfig, *,
+                       n_workers: int, mode: str, is_graph: bool = False,
+                       allow_scan: bool = True,
+                       rs_plan: Optional[dict] = None):
+    """One bucketed sync step on per-layer (boundary) trees: packs
+    ``stacked::`` runs for params, updater state, residual AND the
+    per-bucket τ at entry, unpacks at exit. Signature matches
+    `make_threshold_step` with τ as a per-layer scalar tree (empty
+    dicts for residual/τ in the dense modes)."""
+    core = make_bucketed_core(model, axis, cfg, n_workers=n_workers,
+                              mode=mode, is_graph=is_graph,
+                              rs_plan=rs_plan)
+    threshold_state = mode in ("threshold", "threshold_rs")
+
+    def step(params, upd, state, it, residual, tau, x, y, rng):
+        with scan_stack.force_unrolled(not allow_scan):
+            runs = (model._packed_runs(params)
+                    if scan_stack.scan_enabled(model.conf) else [])
+            if runs:
+                params = scan_stack.pack_tree(params, runs)
+                upd = scan_stack.pack_tree(upd, runs)
+                if threshold_state:
+                    residual = scan_stack.pack_tree(residual, runs)
+                    tau = _pack_scalar_tree(tau, runs)
+            params, upd, state, residual, tau, loss, sparsity = core(
+                params, upd, state, it, residual, tau, x, y, rng)
+            if runs:
+                params = scan_stack.unpack_tree(params, runs)
+                upd = scan_stack.unpack_tree(upd, runs)
+                if threshold_state:
+                    residual = scan_stack.unpack_tree(residual, runs)
+                    tau = _unpack_scalar_tree(tau, runs)
+        return params, upd, state, residual, tau, loss, sparsity
+
+    return step
+
+
+def make_bucketed_multi(model, axis: str, cfg: ThresholdConfig, *,
+                        n_workers: int, mode: str, is_graph: bool = False,
+                        allow_scan: bool = True,
+                        rs_plan: Optional[dict] = None):
+    """k fused bucketed sync steps: ONE `lax.scan` whose carry is
+    (params, updater state, layer state, iteration, residual, τ-tree)
+    — the per-bucket residual/τ ride the carry next to the updater
+    state, and the ``stacked::`` packing happens once per PROGRAM.
+    Bit-identical to k per-step calls (same rng folds, same
+    counters)."""
+    core = make_bucketed_core(model, axis, cfg, n_workers=n_workers,
+                              mode=mode, is_graph=is_graph,
+                              rs_plan=rs_plan)
+    threshold_state = mode in ("threshold", "threshold_rs")
+
+    def multi(params, upd, state, it0, residual, tau, xs, ys, rngs):
+        with scan_stack.force_unrolled(not allow_scan):
+            runs = (model._packed_runs(params)
+                    if scan_stack.scan_enabled(model.conf) else [])
+            if runs:
+                params = scan_stack.pack_tree(params, runs)
+                upd = scan_stack.pack_tree(upd, runs)
+                if threshold_state:
+                    residual = scan_stack.pack_tree(residual, runs)
+                    tau = _pack_scalar_tree(tau, runs)
+            tau = jax.tree_util.tree_map(
+                lambda t: jnp.asarray(t, jnp.float32), tau)
+
+            def body(carry, inp):
+                params, upd, state, it, residual, tau = carry
+                x, y, rng = inp
+                (params, upd, new_state, residual, tau, loss,
+                 sparsity) = core(params, upd, state, it, residual, tau,
+                                  x, y, rng)
+                state = {k: new_state.get(k, v) for k, v in state.items()}
+                return ((params, upd, state, it + 1, residual, tau),
+                        (loss, sparsity))
+
+            carry = (params, upd, state, jnp.asarray(it0, jnp.int32),
+                     residual, tau)
+            (params, upd, state, _, residual, tau), (losses, sps) = \
+                jax.lax.scan(body, carry, (xs, ys, rngs))
+            if runs:
+                params = scan_stack.unpack_tree(params, runs)
+                upd = scan_stack.unpack_tree(upd, runs)
+                if threshold_state:
+                    residual = scan_stack.unpack_tree(residual, runs)
+                    tau = _unpack_scalar_tree(tau, runs)
+        return params, upd, state, residual, tau, losses, sps
+
+    return multi
+
+
+def bucket_plan(model) -> list:
+    """Ordered (bucket_key, [member layer keys]) list of the model's
+    exchange buckets in FORWARD order — packed ``stacked::`` runs plus
+    singleton layers. Reversed, this is the backward ISSUE order the
+    comm-overlap accounting in benchtools/hlo_cost.py walks (the last
+    layer's bucket exchanges first)."""
+    params = model.params
+    runs = (model._packed_runs(params)
+            if scan_stack.scan_enabled(model.conf) else [])
+    members = {k for keys in runs for k in keys}
+    entries = []
+    for keys in runs:
+        entries.append((scan_stack.run_key(keys), list(keys)))
+    for lk in params:
+        if lk not in members:
+            entries.append((lk, [lk]))
+
+    if hasattr(model, "layers"):
+        order = {str(i): i for i in range(len(model.layers))}
+    else:
+        order = {name: i for i, name in enumerate(model.conf.topo_order)}
+    entries.sort(key=lambda e: min(order.get(m, 0) for m in e[1]))
+    return entries
+
+
 # ------------------------------------------------------ comm-bytes accounting
-def exchange_wire_bytes(params, mode: str, *, n_workers: int = 2) -> float:
+def exchange_wire_bytes(params, mode: str, *, n_workers: int = 2,
+                        rs_plan: Optional[dict] = None) -> float:
     """Host-side accounting of one step's gradient-exchange payload
-    per replica (the all-reduce operand): fp32 gradients for dense,
+    per replica (collective operand bytes): fp32 gradients for dense,
     the integer wire tensors + the sent-count/loss scalars for
-    threshold. Static — no device work, so the trainers can count
-    every step without a sync (the FLOP-accounting discipline applied
-    to communication)."""
+    threshold. The `_rs` modes count the gradient reduce-scatter
+    operand (fp32 or the int wire tensor) plus the updated-param
+    all-gather operand (one fp32 shard per replica). Static — no
+    device work, so the trainers can count every step without a sync
+    (the FLOP-accounting discipline applied to communication)."""
     def leaf_itemsize(l):
         # shape/dtype only — a leaf may be a multi-process global array
         # whose VALUE no single host can fetch (TP-sharded params after
@@ -464,6 +1173,21 @@ def exchange_wire_bytes(params, mode: str, *, n_workers: int = 2) -> float:
         return float(sum(
             int(np.prod(np.shape(l))) * leaf_itemsize(l)
             for l in jax.tree_util.tree_leaves(params)))
+    if mode in RS_MODES:
+        if rs_plan is None:
+            rs_plan = rs_shard_plan(params, n_workers)
+        wire_item = (jnp.dtype(wire_dtype(n_workers)).itemsize
+                     if mode == "threshold_rs" else None)
+        total = 8.0 if mode == "threshold_rs" else 0.0
+        for lk, lparams in params.items():
+            for pn, arr in lparams.items():
+                e = float(int(np.prod(np.shape(arr))))
+                item = leaf_itemsize(arr)
+                grad_item = wire_item if wire_item is not None else item
+                total += e * grad_item
+                if rs_plan[lk][pn]:
+                    total += (e / n_workers) * item
+        return total
     itemsize = jnp.dtype(wire_dtype(n_workers)).itemsize
     # + sent-count pmean (f32) + loss pmean (f32)
     return tree_elements(params) * itemsize + 8.0
@@ -508,7 +1232,8 @@ def record_threshold_stats(tau: float, sparsity: float, *,
 
 # ------------------------------------------------- AOT analysis seam (jaxpr)
 def exchange_jaxpr(params, mode: str, n_workers: int, *,
-                   axis: str = "data", cfg: Optional[ThresholdConfig] = None):
+                   axis: str = "data", cfg: Optional[ThresholdConfig] = None,
+                   rs_plan: Optional[dict] = None):
     """ClosedJaxpr of ONE gradient exchange (dense pmean vs threshold
     encode→int-psum→decode) over an **AbstractMesh** — traceable on a
     single-device host with no mesh at all, which is what lets
@@ -544,6 +1269,41 @@ def exchange_jaxpr(params, mode: str, n_workers: int, *,
                  check_vma=False)
         def ex(g_r):
             return expand(dense_exchange(strip(g_r), axis))
+
+        return jax.make_jaxpr(ex)(grads_r)
+
+    if mode in RS_MODES:
+        plan = rs_plan if rs_plan is not None else rs_shard_plan(
+            params, n_workers)
+        wdtype = wire_dtype(n_workers)
+        inv_n = 1.0 / float(n_workers)
+
+        @partial(shard_map, mesh=mesh, in_specs=(rep,), out_specs=rep,
+                 check_vma=False)
+        def ex(g_r):
+            g = strip(g_r)
+            tau = jnp.float32(cfg.initial_threshold)
+            out = {}
+            for lk, lgrads in g.items():
+                lout = {}
+                for pn, gg in lgrads.items():
+                    if mode == "threshold_rs":
+                        enc, _, _ = encode_leaf(gg, tau, wdtype)
+                    else:
+                        enc = gg
+                    if plan[lk][pn]:
+                        sh = jax.lax.psum_scatter(
+                            enc, axis, scatter_dimension=enc.ndim - 1,
+                            tiled=True)
+                        nsh = sh.astype(gg.dtype) * gg.dtype.type(inv_n)
+                        lout[pn] = jax.lax.all_gather(
+                            nsh, axis, axis=nsh.ndim - 1, tiled=True)
+                    else:
+                        lout[pn] = (jax.lax.psum(enc, axis)
+                                    .astype(gg.dtype)
+                                    * gg.dtype.type(inv_n))
+                out[lk] = lout
+            return expand(out)
 
         return jax.make_jaxpr(ex)(grads_r)
 

@@ -147,7 +147,8 @@ class ShardedParallelTrainer:
     def __init__(self, model, mesh: Mesh, *, data_axis: str = "data",
                  model_axis: str = "model", param_specs: Optional[Dict] = None,
                  gradient_sharing: Optional[str] = None,
-                 threshold_config=None, stats=None):
+                 threshold_config=None, stats=None,
+                 bucketed: Optional[bool] = None):
         self.model = model
         self.mesh = mesh
         # stats: optional TrainingMasterStats — per-phase round timing
@@ -171,6 +172,34 @@ class ShardedParallelTrainer:
         from deeplearning4j_tpu.parallel import gradient_sharing as _gs
         self.gradient_sharing = _gs.resolve_mode(gradient_sharing,
                                                  model.conf)
+        if self.gradient_sharing in _gs.RS_MODES:
+            if _gs.env_mode() == self.gradient_sharing and (
+                    gradient_sharing or "dense") not in _gs.RS_MODES \
+                    and getattr(model.conf, "gradient_sharing",
+                                "dense") not in _gs.RS_MODES:
+                # global env A/B toggle: degrade where the ZeRO path
+                # does not apply (params here may be TP/FSDP-sharded
+                # over mesh axes GSPMD owns) — back to what the ARG/CONF
+                # would have resolved without the env, NOT blanket dense
+                # (an explicitly configured threshold exchange must
+                # survive a fleet-wide rs A/B)
+                for v in (gradient_sharing,
+                          getattr(model.conf, "gradient_sharing", None)):
+                    if v is not None:
+                        self.gradient_sharing = v
+                        break
+                else:
+                    self.gradient_sharing = "dense"
+            else:
+                raise NotImplementedError(
+                    "dense_rs/threshold_rs shard the updater over the "
+                    "data axis of a pure-DP mesh (ParallelTrainer); "
+                    "under ShardedParallelTrainer the params are "
+                    "GSPMD-sharded and FSDP-style sharding goes through "
+                    "param_specs=fsdp_param_specs(...) instead")
+        # bucketed (per-layer-run, overlapped) threshold exchange:
+        # default ON, same resolution as ParallelTrainer
+        self.bucketed = _gs.resolve_bucketed(bucketed)
         n_data = int(mesh.shape[data_axis]) if data_axis in mesh.shape else 1
         if self.gradient_sharing == "threshold":
             _gs.wire_dtype(n_data)      # replica-count ceiling check
@@ -298,6 +327,7 @@ class ShardedParallelTrainer:
         if meta.get("kind") != "threshold" or not arrays:
             return
         from deeplearning4j_tpu.fault import state as fs
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
         self._build_shardings()
         n = (int(self.mesh.shape[self.data_axis])
              if self.data_axis in self.mesh.shape else 1)
@@ -309,7 +339,9 @@ class ShardedParallelTrainer:
                 spec_for)
         tau = arrays.get("tau")
         if tau is not None:
-            self._thr_tau = jnp.float32(np.asarray(tau))
+            # scalar (PR-4 / single-barrier) or per-bucket tree
+            # (bucketed) — restored as written, coerced at next fit
+            self._thr_tau = gs.restore_tau(tau)
         upd_r = arrays.get("upd_r")
         if upd_r:
             self._resume_upd_r = self._place_per_worker(
@@ -339,11 +371,32 @@ class ShardedParallelTrainer:
         autoaxes = frozenset(mesh.axis_names) - {axis}
         # jaxlib 0.4.x SPMD partitioner limitation: an inner lax.scan
         # under a partially-manual shard_map hard-crashes (`Check
-        # failed: sharding.IsManualSubgroup()`), so with auto (TP) axes
-        # the step body traces the unrolled layer path
-        step = gs.make_threshold_step(
+        # failed: sharding.IsManualSubgroup()`) — but newer jaxlibs
+        # partition it fine and keep the scan-over-layers compiled-size
+        # win, so the decision is a trace-time PROBE
+        # (gs.partial_manual_scan_supported: version-gated for the
+        # crash-prone line, compile-probed beyond it) instead of an
+        # unconditional unroll
+        allow_scan = (not autoaxes) or gs.partial_manual_scan_supported()
+        if self.bucketed and any(
+                not jnp.issubdtype(jnp.result_type(l), jnp.floating)
+                for l in jax.tree_util.tree_leaves(
+                    self.model.updater_state)):
+            # the bucketed VJP threads updater state through the
+            # cotangent channel (float leaves only) — fail with the
+            # escape hatch named instead of an obscure custom_vjp
+            # cotangent TypeError at trace time
+            raise ValueError(
+                "bucketed threshold gradient sharing threads updater "
+                "state through the VJP and requires float state leaves; "
+                "this model's updater has non-float state — pass "
+                "bucketed=False for the single-barrier program")
+        maker = (gs.make_bucketed_step if self.bucketed
+                 else gs.make_threshold_step)
+        step = maker(
             self.model, axis, self.threshold_config, n_workers=n,
-            is_graph=self._is_graph, allow_scan=not autoaxes)
+            is_graph=self._is_graph, allow_scan=allow_scan,
+            **({"mode": "threshold"} if self.bucketed else {}))
         self._build_shardings()
         rep = P(axis)
         strip = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
@@ -367,13 +420,16 @@ class ShardedParallelTrainer:
 
     def _threshold_state(self):
         from deeplearning4j_tpu.parallel import gradient_sharing as gs
-        import jax.numpy as jnp
         if self._thr_residual_r is None:
             zeros = gs.zeros_residual(self.model.params)
             self._thr_residual_r = self._replicate_per_worker(
                 zeros, lambda lk, pn: self.param_specs[lk][pn])
-            self._thr_tau = jnp.float32(
-                self.threshold_config.initial_threshold)
+        # τ form follows the step program: per-bucket tree (bucketed)
+        # vs one scalar (single-barrier) — one coercion seam for both
+        # trainers (path switches + cross-form checkpoint restores)
+        self._thr_tau = gs.ensure_tau_form(
+            self._thr_tau, self.bucketed, self.model.params,
+            self.threshold_config)
         return self._thr_residual_r, self._thr_tau
 
     def evaluate(self, data, labels=None, *, batch_size: int = 32,
@@ -495,6 +551,7 @@ class ShardedParallelTrainer:
                                          "residual_r": res_r, "tau": tau}
                 src["trainer_meta"] = {"kind": "threshold",
                                        "trainer": "sharded",
+                                       "bucketed": self.bucketed,
                                        "n_workers": n_data}
             else:
                 src["updater_state"] = upd
@@ -555,7 +612,7 @@ class ShardedParallelTrainer:
         if thr:
             self._thr_residual_r, self._thr_tau = res_r, tau
             if sp is not None:
-                gs.record_threshold_stats(float(np.asarray(tau)),
+                gs.record_threshold_stats(gs.tau_scalar(tau),
                                           float(np.asarray(sp)),
                                           trainer="sharded")
             # per-replica updater states drift (reference semantics);

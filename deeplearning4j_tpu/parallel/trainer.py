@@ -88,7 +88,8 @@ class ParallelTrainer:
                  mode: str = "sync", averaging_frequency: int = 5,
                  average_updater_state: bool = True, data_axis: str = "data",
                  gradient_sharing: Optional[str] = None,
-                 threshold_config=None, stats=None):
+                 threshold_config=None, stats=None,
+                 bucketed: Optional[bool] = None, rs_param_specs=None):
         if mode not in ("sync", "averaging"):
             raise ValueError(f"mode must be sync|averaging, got {mode}")
         # stats: optional TrainingMasterStats — per-phase round timing
@@ -102,39 +103,67 @@ class ParallelTrainer:
         self.average_updater_state = average_updater_state
         self.data_axis = data_axis
         self.n_workers = int(np.prod([self.mesh.shape[a] for a in [data_axis]]))
-        # gradient exchange mode for sync training: dense fp32 psum (XLA
-        # default) vs error-feedback threshold encoding (reference
-        # SharedTrainingMaster semantics — parallel/gradient_sharing.py).
-        # Resolution: DL4J_GRADIENT_SHARING env > explicit arg > model
-        # conf's gradient_sharing field > "dense".
+        # gradient exchange mode for sync training: dense fp32 exchange,
+        # error-feedback threshold encoding (reference
+        # SharedTrainingMaster semantics), or the ZeRO-style
+        # reduce-scatter modes dense_rs/threshold_rs
+        # (parallel/gradient_sharing.py). Resolution:
+        # DL4J_GRADIENT_SHARING env > explicit arg > model conf's
+        # gradient_sharing field > "dense".
         from deeplearning4j_tpu.parallel import gradient_sharing as _gs
         self.gradient_sharing = _gs.resolve_mode(gradient_sharing,
                                                  model.conf)
-        if self.gradient_sharing == "threshold" and mode != "sync":
-            if (_gs.env_mode() == "threshold"
-                    and (gradient_sharing or "dense") != "threshold"
+        if self.gradient_sharing != "dense" and mode != "sync":
+            want = self.gradient_sharing
+            if (_gs.env_mode() == want
+                    and (gradient_sharing or "dense") != want
                     and getattr(model.conf, "gradient_sharing",
-                                "dense") != "threshold"):
+                                "dense") != want):
                 # global env A/B toggle: degrade gracefully where the
-                # compressed exchange does not apply (averaging mode
-                # exchanges parameters, not gradients) — only an
+                # compressed/sharded exchange does not apply (averaging
+                # mode exchanges parameters, not gradients) — only an
                 # EXPLICIT arg/conf request is a hard error
                 self.gradient_sharing = "dense"
             else:
                 raise ValueError(
-                    "gradient_sharing='threshold' compresses the per-step "
+                    f"gradient_sharing={want!r} restructures the per-step "
                     "gradient exchange and only applies to mode='sync'; "
                     "averaging mode exchanges parameters, not gradients")
-        if self.gradient_sharing == "threshold":
+        if self.gradient_sharing in ("threshold", "threshold_rs"):
             _gs.wire_dtype(self.n_workers)  # replica-count ceiling check
+        if (self.gradient_sharing in _gs.RS_MODES
+                and not _gs.rs_supported_gn(model.conf)):
+            raise ValueError(
+                "the dense_rs/threshold_rs modes run gradient "
+                "normalization on reduced gradient SHARDS and support "
+                "only elementwise modes (none / "
+                "clip_elementwise_absolute_value); this configuration's "
+                f"{model.conf.gradient_normalization!r} needs whole-layer "
+                "norms — use dense/threshold instead")
+        # bucketed (per-layer-run, overlapped) exchange: default ON —
+        # each packed run / unpacked layer exchanges inside the backward
+        # pass. DL4J_BUCKETED_EXCHANGE=0 or bucketed=False restores the
+        # PR-4 single-barrier program (the rs modes are inherently
+        # bucketed). docs/COMMS.md "Bucketed collectives".
+        self.bucketed = _gs.resolve_bucketed(bucketed)
+        # optional PartitionSpec tree (e.g. tensor.fsdp_param_specs
+        # output) steering WHICH leaves the rs modes reduce-scatter —
+        # the FSDP composition seam; default derives the same rule from
+        # shapes at first fit
+        self.rs_param_specs = rs_param_specs
+        self._rs_plan_cache = None
         self.threshold_config = (threshold_config if threshold_config
                                  is not None
                                  else _gs.ThresholdConfig.from_conf(
                                      model.conf))
         self._thr_step = None
         self._thr_multi = None
+        self._bkt_step = None         # bucketed step (any mode)
+        self._bkt_multi = None
         self._thr_residual_r = None   # per-replica error-feedback residual
-        self._thr_tau = None          # adaptive threshold (device scalar)
+        self._thr_tau = None          # adaptive threshold: per-bucket
+        #                               {layer_key: f32} tree (bucketed)
+        #                               or device scalar (single-barrier)
         # exact-resume stacks restored by _restore_fault_state (fault/):
         # consumed by the next fit() instead of replicating the model's
         # host trees (per-replica updater/param state drifts — a
@@ -146,6 +175,14 @@ class ParallelTrainer:
         self._local_step = None
         self._local_multi = None
         self._average_fn = None
+        # ComputationGraph models: the bucketed engine supports
+        # single-input/single-output graphs (gradient_sharing's
+        # _local_loss_fn packs the tuples); multi-io graphs keep the
+        # GSPMD single-barrier dense program
+        self._is_graph = not hasattr(model, "_forward_core")
+        self._multi_io_graph = self._is_graph and (
+            len(model.conf.network_inputs) != 1
+            or len(model.conf.network_outputs) != 1)
 
     # ------------------------------------------------------------- sync mode
     def _build_sync_step(self):
@@ -197,7 +234,7 @@ class ParallelTrainer:
         mesh, axis = self.mesh, self.data_axis
         step = gs.make_threshold_step(
             self.model, axis, self.threshold_config,
-            n_workers=self.n_workers, is_graph=False)
+            n_workers=self.n_workers, is_graph=self._is_graph)
         rep = P(axis)
         strip = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
         expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
@@ -226,7 +263,7 @@ class ParallelTrainer:
         mesh, axis = self.mesh, self.data_axis
         multi = gs.make_threshold_multi(
             self.model, axis, self.threshold_config,
-            n_workers=self.n_workers, is_graph=False)
+            n_workers=self.n_workers, is_graph=self._is_graph)
         rep = P(axis)
         strip = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
         expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
@@ -245,17 +282,131 @@ class ParallelTrainer:
         self._thr_multi = jax.jit(thr_multi,
                                   donate_argnums=_donate(0, 1, 2, 4))
 
-    def _threshold_state(self):
+    def _threshold_state(self, per_bucket: bool = False):
         """(residual_r, tau) device state — created lazily, persisted
         across fit() calls exactly like updater state (the reference's
-        accumulator survives across training rounds)."""
+        accumulator survives across training rounds). τ is a per-bucket
+        {layer_key: scalar} tree on the bucketed paths and one scalar
+        on the single-barrier path; switching paths between fits (or
+        resuming a checkpoint written by the other one) coerces the
+        form (scalar broadcast / bucket mean)."""
         from deeplearning4j_tpu.parallel import gradient_sharing as gs
         if self._thr_residual_r is None:
             self._thr_residual_r = self._replicate_tree(
                 gs.zeros_residual(self.model.params))
-            self._thr_tau = jnp.float32(
-                self.threshold_config.initial_threshold)
+        self._thr_tau = gs.ensure_tau_form(
+            self._thr_tau, per_bucket, self.model.params,
+            self.threshold_config)
         return self._thr_residual_r, self._thr_tau
+
+    # ------------------------------------------ bucketed exchange (any mode)
+    def _updater_state_floats(self) -> bool:
+        """True when every updater-state leaf is floating — the
+        precondition for threading updater state through the bucketed
+        VJP's cotangent channel (all built-in updaters qualify)."""
+        return all(jnp.issubdtype(jnp.result_type(l), jnp.floating)
+                   for l in jax.tree_util.tree_leaves(
+                       self.model.updater_state))
+
+    def _rs_plan(self):
+        """Which param leaves the `_rs` modes reduce-scatter — derived
+        once from `rs_param_specs` (e.g. `tensor.fsdp_param_specs`
+        output: the FSDP composition) or from shapes by the same
+        rule."""
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+        if self._rs_plan_cache is None:
+            self._rs_plan_cache = gs.rs_shard_plan(
+                self.model.params, self.n_workers,
+                specs=self.rs_param_specs, data_axis=self.data_axis)
+        return self._rs_plan_cache
+
+    def _shard_rs_state(self, tree):
+        """Cold-start ZeRO placement of the (full, per-layer) updater
+        state: sharded leaves split along their LAST axis into one
+        stacked shard per replica, replicated leaves broadcast — the
+        leading replica axis is sharded over the data axis so each
+        device physically holds 1/N of the sharded optimizer state."""
+        plan = self._rs_plan()
+        n = self.n_workers
+        out = {}
+        for lk, lupd in tree.items():
+            out[lk] = {}
+            for pk, slots in lupd.items():
+                if plan[lk][pk]:
+                    f = lambda a: np.stack(
+                        np.split(np.asarray(a), n, axis=-1))
+                else:
+                    f = lambda a: np.broadcast_to(
+                        np.asarray(a)[None], (n,) + np.shape(a)).copy()
+                out[lk][pk] = jax.tree_util.tree_map(f, slots)
+        return self._place_replica_stack(out)
+
+    def _rs_full_state_fn(self):
+        """jit that reassembles the full per-layer updater tree from
+        the sharded stack (replicated out-sharding — multi-process
+        fetchable): concatenate shards along the sharded axis,
+        replica 0 for replicated leaves. The checkpoint/model view of
+        ZeRO state is ALWAYS the full tree, so checkpoints are
+        independent of the replica count that wrote them and elastic
+        resume is plain re-slicing at the next fit."""
+        plan = self._rs_plan()
+        n = self.n_workers
+        repl = NamedSharding(self.mesh, P())
+
+        def full(upd_r):
+            out = {}
+            for lk, lupd in upd_r.items():
+                out[lk] = {}
+                for pk, slots in lupd.items():
+                    if plan[lk][pk]:
+                        f = lambda a: jnp.concatenate(
+                            [a[i] for i in range(n)], axis=-1)
+                    else:
+                        f = lambda a: a[0]
+                    out[lk][pk] = jax.tree_util.tree_map(f, slots)
+            return out
+
+        return jax.jit(full, out_shardings=repl)
+
+    def _build_bucketed(self, mode: str, multi: bool):
+        """Bucketed sync program (per-step or k-fused) for any exchange
+        mode: the shard_map wrapper strips/expands the leading replica
+        axis of the per-replica trees (threshold updater stacks, rs
+        updater shards, the error-feedback residual) and leaves
+        replicated trees alone."""
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+        from deeplearning4j_tpu.parallel.compat import shard_map
+
+        mesh, axis = self.mesh, self.data_axis
+        rs_plan = self._rs_plan() if mode in gs.RS_MODES else None
+        maker = gs.make_bucketed_multi if multi else gs.make_bucketed_step
+        fn = maker(self.model, axis, self.threshold_config,
+                   n_workers=self.n_workers, mode=mode,
+                   is_graph=self._is_graph, rs_plan=rs_plan)
+        per_replica_upd = mode != "dense"
+        has_thr = mode in ("threshold", "threshold_rs")
+        rep = P(axis)
+        upd_spec = rep if per_replica_upd else P()
+        res_spec = rep if has_thr else P()
+        batch_spec = P(None, axis) if multi else P(axis)
+        strip = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+        expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), upd_spec, P(), None, res_spec, P(),
+                           batch_spec, batch_spec, None),
+                 out_specs=(P(), upd_spec, P(), res_spec, P(), P(), P()),
+                 check_vma=False)
+        def run(params, upd_r, state, it, res_r, tau, x, y, rng):
+            u = strip(upd_r) if per_replica_upd else upd_r
+            r = strip(res_r) if has_thr else res_r
+            params, u, state, r, tau, loss, sp = fn(
+                params, u, state, it, r, tau, x, y, rng)
+            return (params, expand(u) if per_replica_upd else u, state,
+                    expand(r) if has_thr else r, tau, loss, sp)
+
+        donate = _donate(0, 1, 2, 4) if has_thr else _donate(0, 1, 2)
+        return jax.jit(run, donate_argnums=donate)
 
     def threshold_residual(self):
         """Host view of the per-replica error-feedback residual
@@ -453,16 +604,25 @@ class ParallelTrainer:
         from deeplearning4j_tpu.fault import state as fs
         kind = meta.get("kind")
         n = self.n_workers
-        if kind == "threshold":
+        if kind in ("threshold", "threshold_rs"):
             res_r = arrays.get("residual_r")
             if res_r:
                 res_r = fs.reshard_replica_stack(res_r, n, kind="residual")
                 self._thr_residual_r = self._place_replica_stack(res_r)
             tau = arrays.get("tau")
             if tau is not None:
-                self._thr_tau = jnp.float32(np.asarray(tau))
+                # scalar (PR-4) or per-bucket tree, restored as written;
+                # _threshold_state coerces at the next fit if the
+                # trainer runs the other path
+                from deeplearning4j_tpu.parallel import (
+                    gradient_sharing as _gs)
+                self._thr_tau = _gs.restore_tau(tau)
             upd_r = arrays.get("upd_r")
             if upd_r:
+                # threshold_rs carries NO per-replica stack: its sharded
+                # updater state round-trips through the model-level full
+                # tree and re-slices at the next fit (elastic by
+                # construction)
                 upd_r = fs.reshard_replica_stack(upd_r, n, kind="state")
                 self._resume_upd_r = self._place_replica_stack(upd_r)
         elif kind == "averaging":
@@ -695,6 +855,210 @@ class ParallelTrainer:
                                                      rep0(upd_r))
         return model
 
+    def _fit_sync_bucketed(self, mode, iterator, listeners, rng_root,
+                           epochs, steps_per_execution, divisible,
+                           check_trained):
+        """Sync-mode fit with the bucketed (overlapped) exchange: every
+        ``stacked::`` packed run / unpacked layer exchanges inside the
+        backward pass (dense pmean, threshold encode+int-psum, or the
+        ZeRO reduce-scatter+all-gather of the `_rs` modes), per-bucket
+        residual/τ persisted across steps and fit() calls like updater
+        state. Same grouping/looping contract as the single-barrier
+        paths."""
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+
+        model = self.model
+        per_replica_upd = mode != "dense"
+        has_thr = mode in ("threshold", "threshold_rs")
+        rs = mode in gs.RS_MODES
+        if not self._updater_state_floats():
+            # the updater advances INSIDE the VJP hooks — its state
+            # threads the cotangent channel, which carries float leaves
+            # only (every built-in updater qualifies; fit() already
+            # degraded plain dense to the single-barrier program)
+            raise ValueError(
+                f"gradient_sharing={mode!r} threads updater state "
+                "through the bucketed VJP and requires float state "
+                "leaves, but this model's updater has non-float state. "
+                "The rs modes are inherently bucketed (bucketed=False "
+                "does not apply); use gradient_sharing='dense' or "
+                "'threshold' with bucketed=False instead")
+        if self._bkt_step is None:
+            self._bkt_step = self._build_bucketed(mode, multi=False)
+        spe = max(1, int(steps_per_execution))
+        if spe > 1 and self._bkt_multi is None:
+            self._bkt_multi = self._build_bucketed(mode, multi=True)
+        repl = NamedSharding(self.mesh, P())
+
+        def place_upd():
+            if rs:
+                return self._shard_rs_state(model.updater_state)
+            if mode == "threshold":
+                if self._resume_upd_r is not None:
+                    u, self._resume_upd_r = self._resume_upd_r, None
+                    return u
+                return self._replicate_tree(model.updater_state)
+            return _gput_tree(model.updater_state, repl)
+
+        def place():
+            return (_gput_tree(model.params, repl), place_upd(),
+                    _gput_tree(model.net_state, repl))
+        if self.stats is not None:
+            with self.stats.time_phase("broadcast"):
+                params, upd_r, state = place()
+                jax.block_until_ready(params)
+        else:
+            params, upd_r, state = place()
+        if has_thr:
+            res_r, tau = self._threshold_state(per_bucket=True)
+        else:
+            res_r, tau = {}, {}
+        batch_sh = NamedSharding(self.mesh, P(self.data_axis))
+        stack_sh = NamedSharding(self.mesh, P(None, self.data_axis))
+        eager_loss = bool(model.listeners) or self.stats is not None
+        # comm accounting is host math on static shapes — every step is
+        # counted with zero device syncs (docs/COMMS.md)
+        wire_b = gs.exchange_wire_bytes(
+            model.params, mode, n_workers=self.n_workers,
+            rs_plan=self._rs_plan() if rs else None)
+        dense_b = gs.exchange_wire_bytes(model.params, "dense")
+        last_loss = None
+        last_sparsity = None
+        rep0 = jax.jit(
+            lambda t: jax.tree_util.tree_map(lambda a: a[0], t),
+            out_shardings=repl)
+        rs_full = self._rs_full_state_fn() if rs else None
+
+        def updater_view():
+            # the model/checkpoint view of the live updater state:
+            # replica 0 of the drifted per-replica stack (threshold),
+            # the reassembled full tree (rs — checkpoints stay
+            # replica-count independent), or the replicated tree itself
+            if rs:
+                return rs_full(upd_r)
+            if mode == "threshold":
+                return rep0(upd_r)
+            return upd_r
+
+        def live_state():
+            # fault/ checkpointing: the fit's device-local trees are the
+            # live training state (model attributes are stale until fit
+            # returns); threshold-family modes add the per-bucket
+            # residual/τ — and per-replica updater drift where it exists
+            src = {"params": params, "net_state": state,
+                   "updater_state": updater_view(),
+                   "trainer_meta": {"kind": {"dense": "sync_dense",
+                                             "threshold": "threshold",
+                                             "dense_rs": "sync_dense_rs",
+                                             "threshold_rs": "threshold_rs",
+                                             }[mode],
+                                    "trainer": "parallel",
+                                    "bucketed": True,
+                                    "n_workers": self.n_workers}}
+            if has_thr:
+                arrays = {"residual_r": res_r, "tau": tau}
+                if mode == "threshold":
+                    arrays["upd_r"] = upd_r
+                src["trainer_arrays"] = arrays
+            return src
+
+        def record(steps):
+            gs.record_exchange(mode, wire_b, dense_b, steps,
+                               trainer="parallel")
+
+        def run_single(ds):
+            nonlocal params, upd_r, state, res_r, tau
+            nonlocal last_loss, last_sparsity
+            x = _gput(ds.features, batch_sh)
+            y = _gput(ds.labels, batch_sh)
+            rng = jax.random.fold_in(rng_root, model.iteration_count)
+            t0 = time.perf_counter()
+            params, upd_r, state, res_r, tau, loss, sp = self._bkt_step(
+                params, upd_r, state, model.iteration_count, res_r, tau,
+                x, y, rng)
+            last_loss, last_sparsity = loss, sp
+            record(1)
+            if eager_loss:
+                model.score_value = float(loss)
+                if has_thr:
+                    gs.record_threshold_stats(gs.tau_scalar(tau),
+                                              float(sp),
+                                              trainer="parallel")
+            if self.stats is not None:
+                self.stats.record("sync_step", time.perf_counter() - t0,
+                                  iteration=model.iteration_count)
+                self.stats.next_round()
+            listeners.iteration_done(model, model.iteration_count,
+                                     model.epoch_count,
+                                     model.score_value if eager_loss
+                                     else float("nan"),
+                                     batch_size=ds.num_examples())
+            model.iteration_count += 1
+
+        def drain(pending):
+            nonlocal params, upd_r, state, res_r, tau
+            nonlocal last_loss, last_sparsity
+            if not pending:
+                return
+            if len(pending) == 1:
+                run_single(pending[0])
+                return
+            xs = _gput(np.stack([np.asarray(d.features) for d in pending]),
+                       stack_sh)
+            ys = _gput(np.stack([np.asarray(d.labels) for d in pending]),
+                       stack_sh)
+            it0 = model.iteration_count
+            rngs = jax.vmap(lambda i: jax.random.fold_in(rng_root, i))(
+                jnp.arange(it0, it0 + len(pending)))
+            t0 = time.perf_counter()
+            params, upd_r, state, res_r, tau, losses, sps = self._bkt_multi(
+                params, upd_r, state, it0, res_r, tau, xs, ys, rngs)
+            last_loss, last_sparsity = losses, sps
+            record(len(pending))
+            lv = np.asarray(losses) if eager_loss else None
+            if eager_loss and has_thr:
+                gs.record_threshold_stats(gs.tau_scalar(tau),
+                                          float(np.asarray(sps)[-1]),
+                                          trainer="parallel")
+            if self.stats is not None:
+                self.stats.record("sync_step", time.perf_counter() - t0,
+                                  iteration=it0, fused_steps=len(pending))
+                self.stats.next_round()
+            for j, d in enumerate(pending):
+                if eager_loss:
+                    model.score_value = float(lv[j])
+                listeners.iteration_done(model, model.iteration_count,
+                                         model.epoch_count,
+                                         model.score_value if eager_loss
+                                         else float("nan"),
+                                         batch_size=d.num_examples(),
+                                         step_boundary=(
+                                             j == len(pending) - 1))
+                model.iteration_count += 1
+
+        model._live_state_provider = live_state
+        try:
+            self._run_grouped(iterator, epochs, spe, divisible,
+                              run_single, drain, model, listeners)
+        finally:
+            model._live_state_provider = None
+        check_trained()
+        if has_thr:
+            self._thr_residual_r, self._thr_tau = res_r, tau
+        if last_loss is not None and not eager_loss:
+            lv = np.asarray(last_loss)
+            model.score_value = float(lv[-1] if lv.ndim else lv)
+        if has_thr and last_sparsity is not None:
+            sv = np.asarray(last_sparsity)
+            gs.record_threshold_stats(gs.tau_scalar(tau),
+                                      float(sv[-1] if sv.ndim else sv),
+                                      trainer="parallel")
+        model.params = jax.tree_util.tree_map(np.asarray, params)
+        model.net_state = jax.tree_util.tree_map(np.asarray, state)
+        model.updater_state = jax.tree_util.tree_map(np.asarray,
+                                                     updater_view())
+        return model
+
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             steps_per_execution: int = 1):
@@ -752,10 +1116,44 @@ class ParallelTrainer:
                     f"axis — fit() would be a silent no-op; use a "
                     f"batch_size divisible by {n_div}")
 
-        if self.mode == "sync" and self.gradient_sharing == "threshold":
-            return self._fit_sync_threshold(
-                iterator, listeners, rng_root, epochs, steps_per_execution,
-                divisible, check_trained)
+        if self.mode == "sync":
+            from deeplearning4j_tpu.parallel import gradient_sharing as _gs
+            gsmode = self.gradient_sharing
+            if (gsmode == "dense" and self.bucketed
+                    and not self._updater_state_floats()):
+                # a custom updater with non-float state cannot thread
+                # the bucketed VJP's cotangent channel — plain dense
+                # silently keeps the single-barrier GSPMD program
+                # (threshold/rs modes raise in _fit_sync_bucketed)
+                gsmode = None
+            if self._multi_io_graph and gsmode is not None:
+                if gsmode == "dense":
+                    # multi-input/-output graphs keep the GSPMD
+                    # single-barrier program (the bucketed loss body
+                    # packs exactly one features/labels pair)
+                    gsmode = None
+                else:
+                    raise NotImplementedError(
+                        f"gradient_sharing={gsmode!r} supports single-"
+                        "input single-output models; train multi-io "
+                        "graphs with gradient_sharing='dense' or via "
+                        "model.fit")
+            if gsmode is not None and (
+                    gsmode in _gs.RS_MODES
+                    or (self.bucketed and gsmode in ("dense",
+                                                     "threshold"))):
+                # default: bucketed per-layer-run exchange inside the
+                # backward pass (the rs modes are inherently bucketed)
+                return self._fit_sync_bucketed(
+                    gsmode, iterator, listeners, rng_root, epochs,
+                    steps_per_execution, divisible, check_trained)
+            gsmode = self.gradient_sharing
+            if gsmode == "threshold":
+                # single-barrier PR-4 program (bucketed=False /
+                # DL4J_BUCKETED_EXCHANGE=0)
+                return self._fit_sync_threshold(
+                    iterator, listeners, rng_root, epochs,
+                    steps_per_execution, divisible, check_trained)
 
         if self.mode == "sync":
             if self._sync_step is None:

@@ -18,11 +18,16 @@
 #    produce every report with the program section's compile_seconds +
 #    peak-memory fields — the scan-over-layers/remat observability
 #    surface (docs/COMPILE.md) — AND the comm_bytes block (dense-vs-
-#    threshold gradient-exchange payload, threshold < dense;
-#    docs/COMMS.md). CPU-forced; a dead tunnel can't hang it.
+#    threshold gradient-exchange payload, threshold < dense) AND the
+#    comm_overlap block (bucketed exchange: exposed <= total for every
+#    report, overlapped_bytes > 0 for the transformer — the
+#    comm/compute overlap evidence; docs/COMMS.md). CPU-forced; a dead
+#    tunnel can't hang it.
 # 5. Gradient-sharing smoke: tiny-MLP dense vs threshold loss
 #    trajectories must stay within tolerance after 50 sync steps on a
-#    4-way mesh (the error-feedback convergence guarantee).
+#    4-way mesh (the error-feedback convergence guarantee), and the
+#    ZeRO path (dense_rs: reduce-scatter + sharded updater +
+#    all-gather) must match bucketed dense BIT-exactly on that mesh.
 # 6. Fault-drill smoke: 30-step tiny-MLP run killed (real SIGTERM) at
 #    step 15 with async checkpointing every 5, auto-resumed by the
 #    drill driver — final params/updater state must be BIT-identical
@@ -137,7 +142,29 @@ for p in paths:
         f"{p}: threshold exchange not smaller than dense: {cb}"
     assert cb.get("reduction", 0) >= 3.9, \
         f"{p}: comm reduction below 4x wire format: {cb}"
+    co = prog.get("comm_overlap") or {}
+    assert "error" not in co and co.get("total_bytes"), \
+        f"{p}: comm_overlap block missing: {co}"
+    for mode, e in co["modes"].items():
+        assert e["exposed_bytes"] <= e["total_bytes"] + 1e-6, \
+            f"{p}: {mode} exposed > total: {e}"
+        assert e["all_at_end_exposed_bytes"] == e["total_bytes"], \
+            f"{p}: {mode} single-barrier baseline broken: {e}"
 svu = json.load(open(os.path.join(out, "cost_transformer.json")))
+co = svu["program"]["comm_overlap"]
+assert co["overlapped_bytes"] > 0, \
+    f"transformer bucketed exchange hides no bytes: {co}"
+assert co["exposed_bytes"] < co["modes"]["dense"]["all_at_end_exposed_bytes"], \
+    f"bucketing does not beat the single-barrier baseline: {co}"
+# the acceptance bar names BOTH headline shapes: the resnet (graph
+# container, conv bucket plan) must beat the single-barrier baseline too
+rco = json.load(open(os.path.join(out, "cost_resnet50.json")))[
+    "program"]["comm_overlap"]
+assert rco["overlapped_bytes"] > 0, \
+    f"resnet bucketed exchange hides no bytes: {rco}"
+assert rco["exposed_bytes"] < \
+    rco["modes"]["dense"]["all_at_end_exposed_bytes"], \
+    f"resnet bucketing does not beat the single-barrier baseline: {rco}"
 assert svu["scan_vs_unrolled"]["eqn_reduction"] >= 3.0, \
     svu["scan_vs_unrolled"]
 assert svu["remat_compare"]["full"]["temp_reduction"] > 1.0, \
@@ -145,7 +172,8 @@ assert svu["remat_compare"]["full"]["temp_reduction"] > 1.0, \
 print("AOT cost smoke OK "
       f"(eqn_reduction={svu['scan_vs_unrolled']['eqn_reduction']}x, "
       f"remat full temp_reduction="
-      f"{svu['remat_compare']['full']['temp_reduction']}x)")
+      f"{svu['remat_compare']['full']['temp_reduction']}x, "
+      f"transformer overlapped_bytes={co['overlapped_bytes']:.0f})")
 EOF
 hlo_rc=$?
 rm -rf "$hlo_out"
@@ -196,8 +224,25 @@ assert t < init * 0.5, f"threshold failed to learn: {init} -> {t}"
 # error-feedback convergence guarantee: within tolerance of dense
 assert abs(t - d) <= 0.35 * init, \
     f"threshold diverged from dense: dense={d} thr={t} init={init}"
+
+# ZeRO smoke: dense_rs (reduce-scatter + data-axis-sharded updater +
+# all-gather) must reproduce bucketed dense BIT-exactly on the 4-way
+# mesh (min_shard_elems=1 so the tiny net's 16-wide leaves shard)
+import jax
+from deeplearning4j_tpu.parallel.tensor import fsdp_param_specs
+rs = build()
+ParallelTrainer(rs, device_mesh(), mode="sync",
+                gradient_sharing="dense_rs",
+                rs_param_specs=fsdp_param_specs(
+                    rs, axis_size=4, min_shard_elems=1)).fit(
+    x, y, epochs=5, batch_size=B)
+bit = all(
+    np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(dense.params),
+                    jax.tree_util.tree_leaves(rs.params)))
+assert bit, "dense_rs diverged bitwise from bucketed dense"
 print(f"gradient-sharing smoke OK (init={init:.3f} dense={d:.3f} "
-      f"threshold={t:.3f})")
+      f"threshold={t:.3f} dense_rs=bit-exact)")
 PYEOF
 gs_rc=$?
 

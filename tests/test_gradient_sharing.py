@@ -196,8 +196,11 @@ class TestConvergenceParity:
         assert lead == (t.n_workers,) + thr.params["0"]["W"].shape
         assert any(float(np.abs(l).max()) > 0
                    for l in jax.tree_util.tree_leaves(res))
-        # τ adapted away from its initial value
-        assert float(np.asarray(t._thr_tau)) != pytest.approx(
+        # τ adapted away from its initial value — per-bucket tree on
+        # the (default) bucketed path, per-layer keys like the residual
+        assert isinstance(t._thr_tau, dict)
+        assert set(t._thr_tau.keys()) == set(thr.params.keys())
+        assert gs.tau_scalar(t._thr_tau) != pytest.approx(
             t.threshold_config.initial_threshold)
 
     def test_fused_multi_step_bit_identical(self):
@@ -214,7 +217,8 @@ class TestConvergenceParity:
                                 gradient_sharing="threshold")
             t.fit(x, y, epochs=3, batch_size=32, steps_per_execution=spe)
             return ([s for _, s in listener.scores],
-                    float(np.asarray(t._thr_tau)))
+                    {k: float(np.asarray(v))
+                     for k, v in t._thr_tau.items()})
 
         per_step, tau1 = run(1)
         fused, tau4 = run(4)
@@ -272,7 +276,374 @@ class TestConvergenceParity:
         th = float(thr.score(ds))
         assert th < 0.6 * init, f"TP threshold failed to learn {init}->{th}"
         assert t._thr_residual_r is not None
-        assert float(np.asarray(t._thr_tau)) > 0
+        assert gs.tau_scalar(t._thr_tau) > 0
+
+
+# ------------------------------------------------ bucketed (overlapped) exchange
+def wide_mlp(seed=7, lr=0.01):
+    """MLP wide enough that the default rs plan actually shards (the
+    128-wide W leaves divide by the 8-way data axis and clear
+    min_shard_elems) and deep enough to pack a stacked:: run."""
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr)).list()
+    b = b.layer(DenseLayer(n_in=16, n_out=128, activation="tanh"))
+    for _ in range(2):
+        b = b.layer(DenseLayer(n_in=128, n_out=128, activation="tanh"))
+    conf = (b.layer(OutputLayer(n_in=128, n_out=4, activation="softmax",
+                                loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def params_bitwise(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(p), np.asarray(q))
+        for p, q in zip(la, lb))
+
+
+class TestBucketedExchange:
+    def test_bucketed_resolution(self, monkeypatch):
+        """env > arg > default(True), mirroring DL4J_SCAN_LAYERS."""
+        assert gs.resolve_bucketed() is True
+        assert gs.resolve_bucketed(False) is False
+        monkeypatch.setenv("DL4J_BUCKETED_EXCHANGE", "0")
+        assert gs.resolve_bucketed(True) is False
+        monkeypatch.setenv("DL4J_BUCKETED_EXCHANGE", "1")
+        assert gs.resolve_bucketed(False) is True
+        # a typo'd opt-out must raise, not silently stay bucketed
+        monkeypatch.setenv("DL4J_BUCKETED_EXCHANGE", "flase")
+        with pytest.raises(ValueError, match="DL4J_BUCKETED_EXCHANGE"):
+            gs.resolve_bucketed()
+        monkeypatch.delenv("DL4J_BUCKETED_EXCHANGE")
+        t = ParallelTrainer(deep_mlp(2), device_mesh(), mode="sync")
+        assert t.bucketed is True
+
+    def test_dense_bucketed_tracks_single_barrier(self):
+        """Bucketed dense (per-run pmean inside backward) vs the PR-4
+        single-barrier GSPMD program: same math, different association
+        — loss trajectories must agree within fp tolerance on a deep
+        MLP whose hidden stack packs one stacked:: run."""
+        x, y = toy_data(n=256, seed=4)
+
+        def run(bucketed, scan):
+            net = deep_mlp(4)
+            net.conf.scan_layers = scan
+            listener = CollectScoresListener()
+            net.set_listeners(listener)
+            ParallelTrainer(net, device_mesh(), mode="sync",
+                            bucketed=bucketed).fit(
+                x, y, epochs=3, batch_size=32)
+            return np.asarray([s for _, s in listener.scores])
+
+        for scan in (True, False):
+            mono = run(False, scan)
+            bkt = run(True, scan)
+            assert len(mono) == len(bkt) == 24
+            np.testing.assert_allclose(bkt, mono, rtol=0, atol=5e-5,
+                                       err_msg=f"scan_layers={scan}")
+
+    def test_threshold_bucketed_tracks_single_barrier(self):
+        """Bucketed threshold (per-bucket residual/τ inside backward)
+        vs the PR-4 single-barrier program: per-bucket τ adapts
+        independently, so trajectories agree within the error-feedback
+        band, and both learn."""
+        x, y = toy_data(n=256, seed=5)
+        ds = DataSet(x, y)
+        init = float(deep_mlp().score(ds))
+
+        def run(bucketed):
+            net = deep_mlp()
+            ParallelTrainer(net, device_mesh(), mode="sync",
+                            gradient_sharing="threshold",
+                            bucketed=bucketed).fit(
+                x, y, epochs=6, batch_size=32)
+            return float(net.score(ds))
+
+        mono, bkt = run(False), run(True)
+        assert bkt < 0.6 * init, f"bucketed threshold failed: {init}->{bkt}"
+        assert abs(bkt - mono) <= 0.35 * init, (init, mono, bkt)
+
+    def test_transformer_bucketed_parity(self):
+        """TransformerLM (scan_layers on and off): bucketed dense must
+        track the single-barrier trajectory within fp tolerance through
+        the scan-compiled, boundary-packed program, fused dispatch."""
+        from deeplearning4j_tpu.zoo.transformer import TransformerLM
+        B, T, V = 16, 16, 37
+        rng = np.random.default_rng(6)
+        ids = rng.integers(0, V, (B * 4, T + 1))
+        x = ids[:, :-1].astype(np.float32)
+        y = np.eye(V, dtype=np.float32)[ids[:, 1:]]
+
+        def run(bucketed, scan, mode):
+            lm = TransformerLM(vocab_size=V, d_model=32, n_layers=3,
+                               n_heads=2, max_len=T)
+            conf = lm.conf()
+            conf.scan_layers = scan
+            net = MultiLayerNetwork(conf).init(11)
+            listener = CollectScoresListener()
+            net.set_listeners(listener)
+            ParallelTrainer(net, device_mesh(), mode="sync",
+                            gradient_sharing=mode, bucketed=bucketed).fit(
+                x, y, epochs=3, batch_size=B, steps_per_execution=4)
+            return np.asarray([s for _, s in listener.scores])
+
+        for scan in (True, False):
+            mono = run(False, scan, "dense")
+            bkt = run(True, scan, "dense")
+            np.testing.assert_allclose(bkt, mono, rtol=0, atol=2e-4,
+                                       err_msg=f"scan_layers={scan}")
+        thr = run(True, True, "threshold")
+        assert thr[-1] < thr[0], f"bucketed threshold LM failed: {thr}"
+
+    def test_dense_rs_bit_exact_vs_dense(self):
+        """The ZeRO acceptance bar: dense_rs (reduce-scatter + sharded
+        updater + all-gather) must match bucketed dense BIT-exactly on
+        a 4-way mesh — params AND updater state, across steps where the
+        rs plan genuinely shards."""
+        mesh = make_mesh(MeshSpec.of(data=4))
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((128, 16)).astype(np.float32)
+        w = rng.standard_normal((16, 4))
+        y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+
+        def run(mode):
+            net = wide_mlp()
+            t = ParallelTrainer(net, mesh, mode="sync",
+                                gradient_sharing=mode)
+            t.fit(x, y, epochs=3, batch_size=32)
+            return net, t
+
+        dense, _ = run("dense")
+        rs_net, rs_t = run("dense_rs")
+        plan = rs_t._rs_plan()
+        assert any(v for lp in plan.values() for v in lp.values()), plan
+        assert params_bitwise(dense.params, rs_net.params)
+        assert params_bitwise(dense.updater_state, rs_net.updater_state)
+        # the full per-layer updater view survives the shard round-trip
+        assert rs_net.updater_state["1"]["W"]["m"].shape == (128, 128)
+
+    def test_threshold_rs_learns_and_composes_with_fsdp_specs(self):
+        """threshold_rs: int8 reduce-scatter + sharded updater. The rs
+        plan built from fsdp_param_specs (the FSDP composition seam)
+        must match the shape-derived default, the mode must learn, and
+        per-bucket residual/τ must persist like the threshold mode's."""
+        from deeplearning4j_tpu.parallel.tensor import fsdp_param_specs
+        x, y = toy_data(n=256, seed=8)
+        ds = DataSet(x, y)
+        net = wide_mlp()
+        init = float(net.score(ds))
+        specs = fsdp_param_specs(net, axis_size=8)
+        t = ParallelTrainer(net, device_mesh(), mode="sync",
+                            gradient_sharing="threshold_rs",
+                            rs_param_specs=specs)
+        assert t._rs_plan() == gs.rs_shard_plan(net.params, 8)
+        t.fit(x, y, epochs=6, batch_size=32)
+        got = float(net.score(ds))
+        assert got < 0.7 * init, f"threshold_rs failed to learn: {init}->{got}"
+        assert isinstance(t._thr_tau, dict)
+        res = t.threshold_residual()
+        assert res["1"]["W"].shape == (8, 128, 128)  # full-size residual
+
+    def test_rs_mode_guards(self, monkeypatch):
+        """rs modes: sync-only (env toggle degrades, explicit raises),
+        elementwise-GN-only, rejected under ShardedParallelTrainer,
+        serde accepts the mode strings."""
+        with pytest.raises(ValueError, match="sync"):
+            ParallelTrainer(deep_mlp(2), device_mesh(), mode="averaging",
+                            gradient_sharing="dense_rs")
+        monkeypatch.setenv("DL4J_GRADIENT_SHARING", "dense_rs")
+        t = ParallelTrainer(deep_mlp(2), device_mesh(), mode="averaging")
+        assert t.gradient_sharing == "dense"
+        monkeypatch.delenv("DL4J_GRADIENT_SHARING")
+        # whole-layer gradient normalization cannot run on shards
+        from deeplearning4j_tpu.nn.conf.builder import GradientNormalization
+        net = deep_mlp(2)
+        net.conf.gradient_normalization = \
+            GradientNormalization.CLIP_L2_PER_LAYER
+        net.conf.gradient_normalization_threshold = 1.0
+        with pytest.raises(ValueError, match="elementwise"):
+            ParallelTrainer(net, device_mesh(), mode="sync",
+                            gradient_sharing="threshold_rs")
+        mesh = make_mesh(MeshSpec.of(data=4, model=2))
+        with pytest.raises(NotImplementedError, match="fsdp_param_specs"):
+            ShardedParallelTrainer(deep_mlp(2), mesh,
+                                   gradient_sharing="dense_rs")
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_in=4, n_out=8))
+                .layer(OutputLayer(n_in=8, n_out=3))
+                .gradient_sharing("threshold_rs", threshold=5e-4)
+                .build())
+        back = type(conf).from_json(conf.to_json())
+        assert back.gradient_sharing == "threshold_rs"
+
+    def test_rs_wire_bytes_and_jaxpr(self):
+        """rs comm accounting: reduce-scatter + param all-gather
+        payloads, visible in the traced exchange as reduce_scatter /
+        all_gather collectives."""
+        from benchtools.hlo_cost import collective_table
+        net = wide_mlp()
+        n = 8
+        plan = gs.rs_shard_plan(net.params, n)
+        dense_b = gs.exchange_wire_bytes(net.params, "dense")
+        rs_b = gs.exchange_wire_bytes(net.params, "dense_rs", n_workers=n)
+        # grads move the same fp32 bytes; the param all-gather adds the
+        # sharded fraction / n on top
+        shard_elems = sum(
+            int(np.prod(np.shape(net.params[lk][pn])))
+            for lk in plan for pn, on in plan[lk].items() if on)
+        assert rs_b == pytest.approx(dense_b + 4.0 * shard_elems / n)
+        trs_b = gs.exchange_wire_bytes(net.params, "threshold_rs",
+                                       n_workers=n)
+        assert trs_b < rs_b  # int8 wire beats fp32
+        tbl = collective_table(gs.exchange_jaxpr(net.params, "dense_rs", n))
+        assert tbl["by_collective"]["reduce_scatter"]["count"] > 0
+        assert tbl["by_collective"]["all_gather"]["count"] > 0
+        tbl = collective_table(
+            gs.exchange_jaxpr(net.params, "threshold_rs", n))
+        assert tbl["by_collective"]["reduce_scatter"]["count"] > 0
+
+
+class TestBucketedGraphContainer:
+    def _graph(self, seed=9):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        adam = lambda: Adam(0.01)
+        conf = (ComputationGraphConfiguration.graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_in=16, n_out=16,
+                                            activation="tanh",
+                                            updater=adam()), "in")
+                .add_layer("d2", DenseLayer(n_in=16, n_out=16,
+                                            activation="tanh",
+                                            updater=adam()), "d1")
+                .add_layer("out", OutputLayer(n_in=16, n_out=4,
+                                              activation="softmax",
+                                              loss="mcxent",
+                                              updater=adam()), "d2")
+                .set_outputs("out").build())
+        conf.seed = seed
+        return ComputationGraph(conf).init(seed)
+
+    def test_graph_bucketed_dense_tracks_single_barrier(self):
+        """Single-in/out ComputationGraph through ParallelTrainer: the
+        (default) bucketed dense path must train it — regression guard
+        for the graph-container crash — and track the single-barrier
+        program within fp tolerance."""
+        x, y = toy_data(n=128, seed=9)
+        ds = DataSet(x, y)
+        init = float(self._graph().score(ds))
+
+        def run(bucketed):
+            net = self._graph()
+            t = ParallelTrainer(net, device_mesh(), mode="sync",
+                                bucketed=bucketed)
+            assert t._is_graph and not t._multi_io_graph
+            t.fit(x, y, epochs=4, batch_size=32)
+            return float(net.score(ds))
+
+        mono, bkt = run(False), run(True)
+        assert bkt < 0.7 * init, f"graph bucketed dense failed: {init}->{bkt}"
+        assert abs(bkt - mono) <= 1e-3 * max(1.0, init), (init, mono, bkt)
+
+    def test_graph_bucketed_threshold_learns(self):
+        x, y = toy_data(n=128, seed=10)
+        ds = DataSet(x, y)
+        net = self._graph()
+        init = float(net.score(ds))
+        t = ParallelTrainer(net, device_mesh(), mode="sync",
+                            gradient_sharing="threshold")
+        t.fit(x, y, epochs=4, batch_size=32)
+        assert float(net.score(ds)) < init
+        assert set(t._thr_tau.keys()) == set(net.params.keys())
+
+    def test_multi_io_graph_falls_back_or_raises(self):
+        """Multi-io graphs: dense silently keeps the GSPMD
+        single-barrier program; the bucketed-only modes name the
+        limitation instead of crashing mid-trace."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (ComputationGraphConfiguration.graph_builder()
+                .add_inputs("a", "b")
+                .add_layer("da", DenseLayer(n_in=8, n_out=8), "a")
+                .add_layer("db", DenseLayer(n_in=8, n_out=8), "b")
+                .add_layer("oa", OutputLayer(n_in=8, n_out=3), "da")
+                .add_layer("ob", OutputLayer(n_in=8, n_out=3), "db")
+                .set_outputs("oa", "ob").build())
+        net = ComputationGraph(conf).init(3)
+        t = ParallelTrainer(net, device_mesh(), mode="sync",
+                            gradient_sharing="threshold")
+        assert t._multi_io_graph
+        with pytest.raises(NotImplementedError, match="single-"):
+            t.fit(np.zeros((8, 8), np.float32),
+                  np.zeros((8, 3), np.float32), epochs=1, batch_size=8)
+
+
+class TestPartialManualScanProbe:
+    def _reset(self, monkeypatch):
+        monkeypatch.setattr(gs, "_partial_manual_scan_cache", None)
+
+    def test_version_gate_never_compiles_on_crashy_jaxlib(self, monkeypatch):
+        """jaxlib 0.4.x CHECK-aborts the process on the probe program —
+        the version gate must answer False WITHOUT attempting it."""
+        self._reset(monkeypatch)
+        monkeypatch.setattr(gs, "_jaxlib_version", lambda: (0, 4, 36))
+        monkeypatch.setattr(
+            gs, "_probe_partial_manual_scan",
+            lambda: (_ for _ in ()).throw(AssertionError("compiled!")))
+        assert gs.partial_manual_scan_supported() is False
+
+    def test_probe_runs_and_caches_on_new_jaxlib(self, monkeypatch):
+        self._reset(monkeypatch)
+        calls = []
+        monkeypatch.setattr(gs, "_jaxlib_version", lambda: (0, 7, 0))
+        monkeypatch.setattr(gs, "_probe_partial_manual_scan",
+                            lambda: calls.append(1) or True)
+        assert gs.partial_manual_scan_supported() is True
+        assert gs.partial_manual_scan_supported() is True
+        assert len(calls) == 1  # cached
+        # a probe failure (partitioner raises) falls back to unrolled
+        self._reset(monkeypatch)
+        monkeypatch.setattr(
+            gs, "_probe_partial_manual_scan",
+            lambda: (_ for _ in ()).throw(RuntimeError("partitioner")))
+        assert gs.partial_manual_scan_supported() is False
+
+    def test_current_jaxlib_resolves_without_crashing(self, monkeypatch):
+        """Whatever jaxlib the environment ships, the probe must
+        resolve to a bool without killing the process."""
+        self._reset(monkeypatch)
+        assert gs.partial_manual_scan_supported() in (True, False)
+
+    def test_sharded_trainer_threads_probe_into_allow_scan(self,
+                                                           monkeypatch):
+        """The DP x TP step must trace with scan-over-layers exactly
+        when the probe says the partitioner survives it."""
+        captured = {}
+        real = gs.make_bucketed_step
+
+        def spy(model, axis, cfg, **kw):
+            captured["allow_scan"] = kw.get("allow_scan")
+            return real(model, axis, cfg, **kw)
+
+        monkeypatch.setattr(gs, "make_bucketed_step", spy)
+        mesh = make_mesh(MeshSpec.of(data=4, model=2))
+        for supported in (False, True):
+            monkeypatch.setattr(gs, "partial_manual_scan_supported",
+                                lambda s=supported: s)
+            t = ShardedParallelTrainer(deep_mlp(3), mesh,
+                                       gradient_sharing="threshold")
+            t._build_threshold()
+            assert captured["allow_scan"] is supported
+        # pure-DP (no auto axes) always scans, probe irrelevant
+        monkeypatch.setattr(gs, "partial_manual_scan_supported",
+                            lambda: False)
+        net = deep_mlp(3)
+        from jax.sharding import PartitionSpec as P
+        repl_specs = {lk: {pn: P() for pn in lp}
+                      for lk, lp in net.params.items()}
+        t = ShardedParallelTrainer(
+            net, make_mesh(MeshSpec.of(data=8)),
+            gradient_sharing="threshold", param_specs=repl_specs)
+        t._build_threshold()
+        assert captured["allow_scan"] is True
 
 
 # ------------------------------------------------------- comm-bytes accounting

@@ -326,6 +326,97 @@ def _baseline():
     }
 
 
+class TestCommOverlap:
+    def _deep_net(self):
+        b = NeuralNetConfiguration.builder().seed(0).list()
+        for _ in range(4):
+            b = b.layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+        return MultiLayerNetwork(
+            b.layer(OutputLayer(n_in=16, n_out=3)).build()).init()
+
+    def test_timeline_model(self):
+        """Serial-ICI timeline: with ample backward compute after each
+        issue, only the LAST bucket's transfer can stick out."""
+        # peak 1 flop/s, bw 1 byte/s for hand math
+        buckets = [("a", 10.0, 2.0), ("b", 10.0, 2.0), ("c", 10.0, 2.0)]
+        exposed_s, bwd_s, table = hlo_cost._overlap_timeline(
+            buckets, 1.0, 1.0)
+        assert bwd_s == 30.0
+        # a issues at t=10 done 12; b at 20 done 22; c at 30 done 32
+        assert exposed_s == pytest.approx(2.0)
+        assert [r["bucket"] for r in table] == ["a", "b", "c"]
+        # ICI saturated: transfers queue and most bytes stay exposed
+        exposed_s, _, _ = hlo_cost._overlap_timeline(
+            [("a", 1.0, 100.0), ("b", 1.0, 100.0)], 1.0, 1.0)
+        assert exposed_s == pytest.approx(199.0)
+
+    def test_resolve_ici_gbps(self, monkeypatch):
+        monkeypatch.delenv("DL4J_ICI_GBPS", raising=False)
+        assert hlo_cost.resolve_ici_gbps(123.0)["ici_gbps"] == 123.0
+        got = hlo_cost.resolve_ici_gbps(None, "tpu v4 chip")
+        assert got["ici_gbps"] == 300.0 and "v4" in got["ici_source"]
+        assert hlo_cost.resolve_ici_gbps(
+            None, "weird")["ici_gbps"] == hlo_cost._DEFAULT_ICI_GBPS
+        monkeypatch.setenv("DL4J_ICI_GBPS", "77.5")
+        got = hlo_cost.resolve_ici_gbps(None, "tpu v4 chip")
+        assert got["ici_gbps"] == 77.5 and "env" in got["ici_source"]
+
+    def test_block_structure_and_invariants(self):
+        """Bucketed overlap block: exposed <= total == all-at-end
+        baseline (the PR-4 single barrier exposes everything),
+        overlapped > 0 once compute hides any bucket, threshold moves
+        fewer total bytes than dense, headline mirrors dense."""
+        net = self._deep_net()  # 4 hidden = one stacked:: run + out
+        blk = hlo_cost.comm_overlap_block(
+            net, backward_flops_per_step=1e9, peak_tflops=100.0,
+            ici_gbps=200.0)
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+        assert blk["buckets"] == len(gs.bucket_plan(net))
+        for mode, e in blk["modes"].items():
+            assert e["exposed_bytes"] <= e["total_bytes"] + 1e-9
+            assert e["all_at_end_exposed_bytes"] == e["total_bytes"]
+            assert e["overlapped_bytes"] == pytest.approx(
+                e["total_bytes"] - e["exposed_bytes"])
+            # issue order is BACKWARD: output layer's bucket first
+            assert e["bucket_table"][0]["bucket"] == "4"
+        assert (blk["modes"]["threshold"]["total_bytes"]
+                < blk["modes"]["dense"]["total_bytes"])
+        assert blk["exposed_bytes"] == blk["modes"]["dense"]["exposed_bytes"]
+
+    def test_overlap_beats_single_barrier_when_compute_hides(self):
+        """With realistic compute per bucket the bucketed exchange must
+        expose strictly fewer bytes than the all-at-end barrier."""
+        net = self._deep_net()
+        blk = hlo_cost.comm_overlap_block(
+            net, backward_flops_per_step=1e12, peak_tflops=100.0,
+            ici_gbps=200.0, modes=("dense",))
+        e = blk["modes"]["dense"]
+        assert e["overlapped_bytes"] > 0
+        assert e["exposed_bytes"] < e["all_at_end_exposed_bytes"]
+
+    def test_gauges_published(self):
+        reg = MetricsRegistry()
+        xprof.publish_cost_report(
+            {"model": "ov_test",
+             "program": {"comm_overlap": {"exposed_bytes": 10.0,
+                                          "overlapped_bytes": 30.0,
+                                          "exposed_fraction": 0.25}}},
+            registry=reg)
+        expo = reg.exposition()
+        assert 'aot_comm_overlap_exposed_bytes{model="ov_test"}' in expo
+        assert 'aot_comm_overlap_overlapped_bytes{model="ov_test"}' in expo
+        assert 'aot_comm_overlap_exposed_fraction{model="ov_test"}' in expo
+
+    def test_analyze_embeds_overlap_block(self, tmp_path):
+        rep = hlo_cost.analyze("mlp", batch=8, steps=2,
+                               deep_compare=False)
+        co = rep["program"]["comm_overlap"]
+        assert "error" not in co, co
+        assert co["overlapped_bytes"] >= 0
+        assert co["exposed_bytes"] <= co["total_bytes"] + 1e-9
+        assert set(co["modes"]) >= {"dense", "threshold", "dense_rs"}
+
+
 class TestCompareBench:
     def test_unchanged_passes(self):
         base = _baseline()
