@@ -295,25 +295,44 @@ def comm_bytes_block(net, *, n_workers: int = 8, axis: str = "data") -> dict:
     (`gradient_sharing.exchange_jaxpr`) are traced over an AbstractMesh
     — no devices, no mesh, tunnel-independent — and their collectives
     counted by `collective_table`. The committed evidence that the
-    threshold wire format moves >= 4x fewer bytes per step."""
+    threshold wire format moves >= 4x fewer bytes per step. The dense
+    program is traced with the model's REAL gradient dtype (the dtype
+    policy's compute dtype — bf16 grads under mixed_bf16 halve the
+    dense wire)."""
     from deeplearning4j_tpu.parallel import gradient_sharing as gs
+    grad_dtype = net.dtype.compute_dtype
     out = {"n_workers": n_workers, "axis": axis,
+           "grad_dtype": jnp_dtype_name(grad_dtype),
            "note": ("per-replica all-reduce payload of ONE gradient "
                     "exchange, traced over an AbstractMesh "
                     "(device-free); threshold = int8 sign tensor + "
-                    "controller scalars, dense = fp32 gradients")}
+                    "controller scalars, dense = grad-dtype gradients "
+                    "(the dtype policy's compute dtype)")}
     try:
         for mode in ("dense", "threshold"):
-            jx = gs.exchange_jaxpr(net.params, mode, n_workers, axis=axis)
+            jx = gs.exchange_jaxpr(net.params, mode, n_workers, axis=axis,
+                                   grad_dtype=grad_dtype)
             tbl = collective_table(jx)
             out[mode] = tbl
             out[f"{mode}_bytes_per_step"] = tbl["comm_bytes_per_step"]
         if out.get("threshold_bytes_per_step"):
             out["reduction"] = round(out["dense_bytes_per_step"]
                                      / out["threshold_bytes_per_step"], 2)
+            # the PR-4 "4x wire format" claim is int8-vs-FP32; under a
+            # mixed policy the real dense wire is already bf16 (2x),
+            # so both ratios are recorded
+            fp32_dense = gs.exchange_wire_bytes(net.params, "dense")
+            out["dense_fp32_bytes_per_step"] = fp32_dense
+            out["reduction_vs_fp32"] = round(
+                fp32_dense / out["threshold_bytes_per_step"], 2)
     except Exception as e:  # noqa: BLE001 — per-version shard_map surface
         out["error"] = f"{type(e).__name__}: {e}"[:200]
     return out
+
+
+def jnp_dtype_name(dt) -> str:
+    import jax.numpy as jnp
+    return jnp.dtype(dt).name
 
 
 def resolve_ici_gbps(ici_gbps: Optional[float] = None,
@@ -390,6 +409,7 @@ def comm_overlap_block(net, *, backward_flops_per_step: float,
     peak_fs = peak_tflops * 1e12
     plan = gs.bucket_plan(net)
     params = net.params
+    grad_dtype = net.dtype.compute_dtype
     total_elems = sum(float(np.prod(np.shape(l)))
                       for l in jax.tree_util.tree_leaves(params))
     rs_plan = gs.rs_shard_plan(params, n_workers)
@@ -419,7 +439,8 @@ def comm_overlap_block(net, *, backward_flops_per_step: float,
             payload = gs.exchange_wire_bytes(
                 sub, mode, n_workers=n_workers,
                 rs_plan={m: rs_plan[m] for m in members}
-                if mode in gs.RS_MODES else None)
+                if mode in gs.RS_MODES else None,
+                grad_dtype=grad_dtype)
             bwd = backward_flops_per_step * (sub_elems
                                              / max(total_elems, 1.0))
             buckets.append((key, bwd, payload))
@@ -605,6 +626,59 @@ def remat_compare() -> dict:
     return out
 
 
+def precision_block(model: str, spec: dict, table: dict, *,
+                    batch=None, steps=None) -> dict:
+    """fp32-vs-bf16 evidence for one headline config: the SAME model
+    traced under both dtype policies, per-op bytes/FLOPs per step from
+    the jaxpr walk (no XLA compile — the active policy's program
+    section already carries compile evidence), plus the dense-exchange
+    wire bytes in each policy's real gradient dtype. The committed
+    proof that mixed_bf16 strictly shrinks activation and wire traffic
+    (and shifts roofline intensity up) on this program."""
+    from deeplearning4j_tpu.parallel import gradient_sharing as gs
+
+    active = spec["net"].dtype.name
+    other = "float32" if active != "float32" else "mixed_bf16"
+
+    def policy_entry(pol_name, tbl, net):
+        b = tbl["total_bytes_per_step"]
+        f = tbl["total_flops_per_step"]
+        return {
+            "policy": pol_name,
+            "bytes_per_step": b,
+            "flops_per_step": f,
+            "arithmetic_intensity_flop_per_byte": f / max(b, 1.0),
+            "wire_bytes_dense": gs.exchange_wire_bytes(
+                net.params, "dense", grad_dtype=net.dtype.compute_dtype),
+        }
+
+    entries = {active: policy_entry(active, table, spec["net"])}
+    spec2 = MODELS[model](batch=batch, steps=steps, policy=other)
+    jaxpr2 = spec2["net"].train_step_jaxpr(spec2["x"], spec2["y"],
+                                           steps=spec2["steps"])
+    table2 = per_op_table(jaxpr2, fused_steps=spec2["steps"], top=1)
+    entries[other] = policy_entry(other, table2, spec2["net"])
+
+    fp32 = entries.get("float32")
+    bf16 = entries.get("mixed_bf16") or entries.get("custom")
+    out = {"active_policy": active, **{k: v for k, v in entries.items()}}
+    if fp32 and bf16:
+        out["bytes_reduction"] = round(
+            fp32["bytes_per_step"] / max(bf16["bytes_per_step"], 1.0), 3)
+        out["wire_reduction"] = round(
+            fp32["wire_bytes_dense"] / max(bf16["wire_bytes_dense"], 1.0),
+            3)
+        out["intensity_shift"] = round(
+            bf16["arithmetic_intensity_flop_per_byte"]
+            / max(fp32["arithmetic_intensity_flop_per_byte"], 1e-12), 3)
+    out["note"] = ("per-op jaxpr bytes (unfused operand+result traffic) "
+                   "per optimizer step under each dtype policy; wire = "
+                   "dense gradient-exchange payload in the policy's "
+                   "real grad dtype; bf16 programs must move strictly "
+                   "fewer bytes (verify.sh [4/7] asserts)")
+    return out
+
+
 def per_op_table(closed_jaxpr, *, fused_steps: int = 1,
                  top: int = 10) -> dict:
     """Per-op cost table for a (fused) train-step jaxpr. `lax.scan`
@@ -659,55 +733,75 @@ def per_op_table(closed_jaxpr, *, fused_steps: int = 1,
 
 
 # ------------------------------------------------------------ model builders
-def _bf16_net(conf, seed=123):
-    from deeplearning4j_tpu.nd.dtype import bf16_policy
+def _resolve_builder_policy(policy, default="mixed_bf16"):
+    """Builder-level policy resolution: an EXPLICIT `policy=` is a
+    measurement seam (the precision_block's fp32-vs-bf16 counterfactual
+    trace) and must win over the DL4J_DTYPE_POLICY env override —
+    otherwise the env A/B would silently trace BOTH sides of the
+    comparison under the same policy and the evidence degenerates to
+    1.0 ratios. `policy=None` (the CLI default) still honors the env,
+    so headline reports remain A/B-able."""
+    from deeplearning4j_tpu.nd.dtype import as_policy, env_policy
+    if policy is not None:
+        return as_policy(policy)
+    return env_policy() or as_policy(default)
+
+
+def _policy_net(conf, policy, seed=123):
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-    return MultiLayerNetwork(conf, dtype_policy=bf16_policy()).init(seed)
+    net = MultiLayerNetwork(conf, dtype_policy=policy)
+    # pin the resolved policy past the container's own env-aware
+    # resolution (env semantics were already applied above)
+    net.dtype = policy
+    return net.init(seed)
 
 
-def build_mlp(batch=None, steps=None):
+def build_mlp(batch=None, steps=None, policy=None):
     """Tiny dense net — the golden-test config (not a bench headline)."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     batch, steps = batch or 16, steps or 2
+    pol = _resolve_builder_policy(policy, default="float32")
     conf = (NeuralNetConfiguration.builder().seed(0).list()
             .layer(DenseLayer(n_in=4, n_out=8))
             .layer(OutputLayer(n_in=8, n_out=3))
             .build())
-    net = MultiLayerNetwork(conf).init()
+    net = _policy_net(conf, pol, seed=conf.seed)
     x = jax.ShapeDtypeStruct((batch, 4), jnp.float32)
     y = jax.ShapeDtypeStruct((batch, 3), jnp.float32)
     return dict(model="mlp", net=net, x=x, y=y, steps=steps,
                 examples_per_step=batch, unit="examples/sec",
                 measured_path=None,
-                config={"batch": batch, "steps": steps})
+                config={"batch": batch, "steps": steps,
+                        "dtype_policy": pol.name})
 
 
-def build_lenet(batch=None, steps=None):
+def build_lenet(batch=None, steps=None, policy=None):
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo.lenet import LeNet
     batch, steps = batch or 128, steps or 100
-    net = _bf16_net(LeNet(num_classes=10).conf())
+    pol = _resolve_builder_policy(policy)
+    net = _policy_net(LeNet(num_classes=10).conf(), pol)
     x = jax.ShapeDtypeStruct((batch, 28, 28, 1), jnp.float32)
     y = jax.ShapeDtypeStruct((batch, 10), jnp.float32)
     return dict(model="lenet", net=net, x=x, y=y, steps=steps,
                 examples_per_step=batch, unit="images/sec",
                 measured_path=("extras", "lenet_mnist", "value"),
-                config={"batch": batch, "steps": steps, "bf16": True})
+                config={"batch": batch, "steps": steps,
+                        "dtype_policy": pol.name})
 
 
-def build_resnet50(batch=None, steps=None):
+def build_resnet50(batch=None, steps=None, policy=None):
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.common.updaters import Nesterovs
-    from deeplearning4j_tpu.nd.dtype import bf16_policy
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.zoo.resnet50 import ResNet50
     batch, steps = batch or 128, steps or 20
+    pol = _resolve_builder_policy(policy)
     model = ResNet50(num_classes=1000, height=224, width=224, channels=3)
     conf = model.conf()
     # same bench-only lr override bench_resnet50 applies — identical
@@ -716,25 +810,29 @@ def build_resnet50(batch=None, steps=None):
         if node.layer is not None and getattr(node.layer, "updater",
                                               None) is not None:
             node.layer.updater = Nesterovs(0.005, 0.9)
-    net = ComputationGraph(conf, dtype_policy=bf16_policy()).init(model.seed)
-    x = jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.bfloat16)
+    net = ComputationGraph(conf, dtype_policy=pol)
+    net.dtype = pol          # see _policy_net: explicit policy is final
+    net.init(model.seed)
+    x = jax.ShapeDtypeStruct((batch, 224, 224, 3),
+                             pol.compute_dtype)
     y = jax.ShapeDtypeStruct((batch, 1000), jnp.float32)
     return dict(model="resnet50", net=net, x=x, y=y, steps=steps,
                 examples_per_step=batch, unit="images/sec",
                 measured_path=("value",),
                 config={"batch": batch, "image_size": 224, "steps": steps,
-                        "bf16": True})
+                        "dtype_policy": pol.name})
 
 
-def build_transformer(batch=None, steps=None):
+def build_transformer(batch=None, steps=None, policy=None):
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo.transformer import TransformerLM
     B, T, V = batch or 16, 256, 512
     steps = steps or 30
+    pol = _resolve_builder_policy(policy)
     lm = TransformerLM(vocab_size=V, d_model=256, n_layers=4, n_heads=8,
                        max_len=T)
-    net = _bf16_net(lm.conf())
+    net = _policy_net(lm.conf(), pol)
     x = jax.ShapeDtypeStruct((B, T), jnp.float32)
     y = jax.ShapeDtypeStruct((B, T, V), jnp.float32)
     return dict(model="transformer", net=net, x=x, y=y, steps=steps,
@@ -742,25 +840,27 @@ def build_transformer(batch=None, steps=None):
                 measured_path=("extras", "transformer_lm", "value"),
                 config={"batch": B, "seq_len": T, "d_model": 256,
                         "n_layers": 4, "n_heads": 8, "vocab": V,
-                        "bf16": True,
+                        "dtype_policy": pol.name,
                         "attention": ("xla fallback — flash attention "
                                       "rides only the TPU backend; same "
                                       "matmul FLOPs")})
 
 
-def build_lstm(batch=None, steps=None):
+def build_lstm(batch=None, steps=None, policy=None):
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo.textgenlstm import TextGenerationLSTM
     B, T, V = batch or 64, 100, 77
     steps = steps or 50
-    net = _bf16_net(TextGenerationLSTM(vocab_size=V).conf())
+    pol = _resolve_builder_policy(policy)
+    net = _policy_net(TextGenerationLSTM(vocab_size=V).conf(), pol)
     x = jax.ShapeDtypeStruct((B, T, V), jnp.float32)
     y = jax.ShapeDtypeStruct((B, T, V), jnp.float32)
     return dict(model="lstm", net=net, x=x, y=y, steps=steps,
                 examples_per_step=B * T, unit="chars/sec",
                 measured_path=("extras", "lstm_char_rnn", "value"),
-                config={"batch": B, "seq_len": T, "vocab": V, "bf16": True})
+                config={"batch": B, "seq_len": T, "vocab": V,
+                        "dtype_policy": pol.name})
 
 
 MODELS = {
@@ -915,6 +1015,13 @@ def analyze(model: str, *, batch: Optional[int] = None,
                 "error": f"{type(e).__name__}: {e}"[:200]}
         prog.update(compile_program(lowered))
         report["program"] = prog
+        try:
+            # fp32-vs-bf16 dtype-policy evidence (jaxpr walk only — no
+            # second XLA compile; ~2x jaxpr_walk_seconds)
+            report["precision"] = precision_block(model, spec, table,
+                                                  batch=batch, steps=steps)
+        except Exception as e:  # noqa: BLE001 — per-model surface
+            report["precision"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     if deep_compare is None:
         # the evidence battery XLA-compiles five deep-stack programs —
         # honoring --no-program's "no XLA compile" promise means it
@@ -1049,6 +1156,10 @@ def run(models, *, out_dir: str = "PROFILE_aot", batch=None, steps=None,
             co = prog.get("comm_overlap") or {}
             line["comm_exposed_bytes"] = co.get("exposed_bytes")
             line["comm_overlapped_bytes"] = co.get("overlapped_bytes")
+        prec = rep.get("precision") or {}
+        if prec.get("bytes_reduction"):
+            line["precision_bytes_reduction"] = prec["bytes_reduction"]
+            line["precision_wire_reduction"] = prec.get("wire_reduction")
         svu = rep.get("scan_vs_unrolled")
         if svu:
             line["scan_eqn_reduction"] = svu.get("eqn_reduction")

@@ -422,6 +422,25 @@ def bench_resnet50(accel, batch=None, size=None, steps=None,
             "buckets")}
     except Exception as e:  # noqa: BLE001 — accounting never kills bench
         comm_overlap = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        # dtype-policy provenance + real wire dtype: a run that fell
+        # back to fp32 (policy resolution, env override) must be
+        # visible in the ledger, and the gate's wire_reduction entry
+        # catches a stale fp32 record masquerading as a bf16 win
+        from deeplearning4j_tpu.parallel import gradient_sharing as _gs
+        _wire = _gs.exchange_wire_bytes(
+            net.params, "dense", grad_dtype=net.dtype.compute_dtype)
+        _wire_fp32 = _gs.exchange_wire_bytes(net.params, "dense")
+        precision = {
+            "policy": net.dtype.name,
+            "param_dtype": str(np.dtype(net.dtype.param_dtype)),
+            "compute_dtype": jnp.dtype(net.dtype.compute_dtype).name,
+            "wire_bytes_dense": _wire,
+            "wire_bytes_dense_fp32": _wire_fp32,
+            "wire_reduction": round(_wire_fp32 / max(_wire, 1.0), 3),
+        }
+    except Exception as e:  # noqa: BLE001 — accounting never kills bench
+        precision = {"error": f"{type(e).__name__}: {e}"[:200]}
     return {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 2),
@@ -463,6 +482,7 @@ def bench_resnet50(accel, batch=None, size=None, steps=None,
                      "executing silicon"),
         "with_etl": etl,
         "comm_overlap": comm_overlap,
+        "precision": precision,
         "loss_first": losses[0], "loss_last": losses[-1],
         "loss_after_timed_windows": loss_last,
         "train_signal_ok": losses[-1] < losses[0],
@@ -1148,6 +1168,12 @@ GATE_TOLERANCES = {
     "word2vec_words_per_sec": 0.20,
     "matmul_peak_tflops": 0.15,
     "resnet50_mfu": 0.12,
+    # precision metrics are STRUCTURAL (wire-byte ratios from static
+    # shape/dtype math, not timings): near-zero tolerance, so a record
+    # whose run silently fell back to fp32 (wire_reduction 1.0 against
+    # a bf16 baseline's 2.0) gates as a regression instead of
+    # masquerading as a bf16 win
+    "resnet50_bf16_wire_reduction": 0.02,
 }
 _GATE_HEADLINE = "resnet50_images_per_sec"
 
@@ -1167,6 +1193,7 @@ def _gate_metrics(rec):
 
     take("resnet50_images_per_sec", "value")
     take("resnet50_mfu", "mfu")
+    take("resnet50_bf16_wire_reduction", "precision", "wire_reduction")
     take("matmul_peak_tflops", "measured_matmul_tflops")
     take("lenet_images_per_sec", "extras", "lenet_mnist", "value")
     take("lstm_chars_per_sec", "extras", "lstm_char_rnn", "value")

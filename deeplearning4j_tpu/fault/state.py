@@ -154,6 +154,10 @@ def capture_training_state(model, *, iterator=None, normalizer=None,
         "format_version": STATE_FORMAT_VERSION,
         "model_type": type(model).__name__,
         "configuration": model.conf.to_dict(),
+        # the ACTIVE dtype policy (which may come from a constructor
+        # arg or env override, not the conf) — resume must rebuild the
+        # same mixed-precision program or bit-parity breaks
+        "dtype_policy": model.dtype.to_dict(),
         "iteration_count": int(model.iteration_count if step is None
                                else step),
         "epoch_count": int(model.epoch_count if epoch is None else epoch),
@@ -179,16 +183,26 @@ def capture_training_state(model, *, iterator=None, normalizer=None,
 # ------------------------------------------------------------------ restore
 def build_model(meta: Dict[str, Any]):
     """Reconstruct an uninitialized container from checkpoint meta
-    (same two-phase conf→init restore `ModelSerializer` uses)."""
+    (same two-phase conf→init restore `ModelSerializer` uses). The
+    checkpoint's recorded dtype policy is passed explicitly so a run
+    trained under `mixed_bf16()` (via arg or env) resumes into the
+    same mixed-precision program — bit-parity depends on it. The
+    `DL4J_DTYPE_POLICY` env override still wins (resolution order)."""
+    policy = None
+    if meta.get("dtype_policy") is not None:
+        from deeplearning4j_tpu.nd.dtype import as_policy
+        policy = as_policy(meta["dtype_policy"])
     if meta["model_type"] == "ComputationGraph":
         from deeplearning4j_tpu.nn.graph import (
             ComputationGraph, ComputationGraphConfiguration)
         return ComputationGraph(
-            ComputationGraphConfiguration.from_dict(meta["configuration"]))
+            ComputationGraphConfiguration.from_dict(meta["configuration"]),
+            dtype_policy=policy)
     from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     return MultiLayerNetwork(
-        MultiLayerConfiguration.from_dict(meta["configuration"]))
+        MultiLayerConfiguration.from_dict(meta["configuration"]),
+        dtype_policy=policy)
 
 
 def _deep_merge(base, overlay):

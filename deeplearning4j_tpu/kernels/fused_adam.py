@@ -1,0 +1,155 @@
+"""Fused Adam over a whole ``stacked::`` packed run — Pallas kernel.
+
+The optimizer sweep is the elementwise tail of the train step: per
+leaf, the jnp Adam path reads m, v, param, grad and writes m', v',
+param' as separate XLA ops — for a packed scan stack that is a pile of
+small bandwidth-bound kernels. This kernel consumes the ENTIRE run in
+one pass: every leaf of the packed param/grad/m/v trees is raveled and
+concatenated into one [rows, 128] lane-aligned buffer, and a single
+grid sweep read-modify-writes param/m/v together — one kernel launch
+per run instead of ~6 XLA ops per leaf.
+
+Honest cost note: the operand assembly is NOT free — the
+concatenate/pad in, slice out adds full-tree copies around the kernel
+(Pallas operands must be contiguous), so the net HBM win over a
+well-fused XLA elementwise chain depends on how many per-leaf kernels
+XLA would otherwise launch and on leaf count/size; the structural win
+(one launch, one sweep) is what's provable device-free. The follow-up
+that removes the relayout entirely — storing the packed run's
+optimizer state pre-flattened so no per-step concat happens — is
+recorded in ROADMAP.md; compiled-mode numbers need the next live
+tunnel window.
+
+Numerics are BIT-comparable to `common.updaters.Adam.apply` + the
+containers' ``param - upd`` application (test-enforced in interpret
+mode): the bias corrections ``1 − βᵢᵗ`` and the (possibly scheduled)
+learning rate are computed OUTSIDE the kernel with the exact jnp
+expressions the updater uses and enter as scalar operands, and the
+in-kernel expression tree mirrors `Adam.apply` term for term. Mixed
+precision: gradients are upcast to the param (master) dtype before the
+kernel, exactly like the jnp path — m/v/param stay an fp32 master.
+
+Interpret mode on CPU (parity tests), compiled on TPU; dispatch is
+gated by `kernels_enabled()` (DL4J_PALLAS_KERNELS) in the containers'
+`_apply_updates`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from deeplearning4j_tpu.common.updaters import Adam, _lr
+from deeplearning4j_tpu.kernels.flash_attention import (
+    _ceil_to,
+    _resolve_interpret,
+)
+
+_LANES = 128
+_SUBLANES = 8
+
+
+def fused_adam_eligible(updater) -> bool:
+    """Packed-run fast-path gate: exactly the Adam rule (subclasses
+    like Nadam change the update math) and kernels enabled."""
+    from deeplearning4j_tpu.kernels import kernels_enabled
+    return type(updater) is Adam and kernels_enabled()
+
+
+def _adam_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, bc1_ref, bc2_ref,
+                 p_out, m_out, v_out, *, beta1: float, beta2: float,
+                 eps: float):
+    g = g_ref[...]
+    # optimization_barrier pins each product: the fused kernel body is
+    # one XLA computation where mul+add would FMA-contract, drifting
+    # 1 ulp off the per-op jnp path the bit-parity tests compare to
+    # (the same pinning the dense_rs==dense contract uses)
+    pin = jax.lax.optimization_barrier
+    m = pin(beta1 * m_ref[...]) + pin((1 - beta1) * g)
+    v = pin(beta2 * v_ref[...]) + pin((1 - beta2) * g * g)
+    mhat = m / bc1_ref[0, 0]
+    vhat = v / bc2_ref[0, 0]
+    upd = pin(lr_ref[0, 0] * mhat / (jnp.sqrt(vhat) + eps))
+    p_out[...] = p_ref[...] - upd
+    m_out[...] = m
+    v_out[...] = v
+
+
+def _flatten_run(params, grads, state):
+    """Concatenate every leaf (sorted by param name) of the packed
+    run's param/grad/m/v trees into four 1-D buffers; grads upcast to
+    the master dtype (the jnp path's `g.astype(param.dtype)`)."""
+    keys = sorted(params)
+    shapes = [np.shape(params[k]) for k in keys]
+    sizes = [int(np.prod(s)) for s in shapes]
+    dt = params[keys[0]].dtype
+    p = jnp.concatenate([params[k].reshape(-1) for k in keys])
+    g = jnp.concatenate([grads[k].reshape(-1).astype(dt) for k in keys])
+    m = jnp.concatenate([state[k]["m"].reshape(-1) for k in keys])
+    v = jnp.concatenate([state[k]["v"].reshape(-1) for k in keys])
+    return keys, shapes, sizes, p, g, m, v
+
+
+def _unflatten(flat, keys, shapes, sizes):
+    out, off = {}, 0
+    for k, shape, n in zip(keys, shapes, sizes):
+        out[k] = flat[off:off + n].reshape(shape)
+        off += n
+    return out
+
+
+def adam_update_packed(updater: Adam, params, grads, state, step, *,
+                       block_rows: int = 512,
+                       interpret: bool | None = None):
+    """One fused-kernel Adam update of a packed run entry. Returns
+    (new_params, new_updater_state) shaped like the inputs — drop-in
+    for the per-leaf loop in the containers' `_apply_updates`."""
+    interpret = _resolve_interpret(interpret)
+    keys, shapes, sizes, p, g, m, v = _flatten_run(params, grads, state)
+    n = p.shape[0]
+    # the EXACT scalar expressions Adam.apply evaluates — dividing by
+    # the same scalars keeps the kernel bit-comparable to the jnp path
+    t = jnp.asarray(step, jnp.float32) + 1.0
+    bc1 = jnp.asarray(1 - updater.beta1 ** t, jnp.float32).reshape(1, 1)
+    bc2 = jnp.asarray(1 - updater.beta2 ** t, jnp.float32).reshape(1, 1)
+    lr = jnp.asarray(_lr(updater.learning_rate, step),
+                     jnp.float32).reshape(1, 1)
+
+    npad = _ceil_to(max(n, 1), _LANES * _SUBLANES)
+    rows = npad // _LANES
+    br = min(block_rows, _ceil_to(rows, _SUBLANES))
+    rowsp = _ceil_to(rows, br)
+    if rowsp * _LANES != npad:
+        npad = rowsp * _LANES
+
+    def to2d(a):
+        if npad != n:
+            a = jnp.pad(a, (0, npad - n))
+        return a.reshape(rowsp, _LANES)
+
+    p2, g2, m2, v2 = (to2d(a) for a in (p, g, m, v))
+    row_blk = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
+    scal_blk = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    dt = p2.dtype
+    p_new, m_new, v_new = pl.pallas_call(
+        functools.partial(_adam_kernel, beta1=float(updater.beta1),
+                          beta2=float(updater.beta2),
+                          eps=float(updater.epsilon)),
+        grid=(rowsp // br,),
+        in_specs=[row_blk] * 4 + [scal_blk] * 3,
+        out_specs=[row_blk] * 3,
+        out_shape=[jax.ShapeDtypeStruct((rowsp, _LANES), dt)] * 3,
+        interpret=interpret,
+    )(p2, g2, m2, v2, lr, bc1, bc2)
+
+    p_new, m_new, v_new = (a.reshape(-1)[:n]
+                           for a in (p_new, m_new, v_new))
+    new_params = _unflatten(p_new, keys, shapes, sizes)
+    new_m = _unflatten(m_new, keys, shapes, sizes)
+    new_v = _unflatten(v_new, keys, shapes, sizes)
+    new_state = {k: {"m": new_m[k], "v": new_v[k]} for k in keys}
+    return new_params, new_state

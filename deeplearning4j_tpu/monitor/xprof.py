@@ -117,6 +117,14 @@ _GAUGE_FIELDS = (
                                            "overlapped_bytes")),
     ("aot_comm_overlap_exposed_fraction", ("program", "comm_overlap",
                                            "exposed_fraction")),
+    # dtype-policy (mixed-precision) evidence — fp32-vs-bf16 bytes per
+    # step of the SAME program (benchtools/hlo_cost.precision_block)
+    ("aot_precision_fp32_bytes_per_step", ("precision", "float32",
+                                           "bytes_per_step")),
+    ("aot_precision_bf16_bytes_per_step", ("precision", "mixed_bf16",
+                                           "bytes_per_step")),
+    ("aot_precision_bytes_reduction", ("precision", "bytes_reduction")),
+    ("aot_precision_wire_reduction", ("precision", "wire_reduction")),
 )
 
 

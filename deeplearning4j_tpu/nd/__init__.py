@@ -10,7 +10,12 @@ from deeplearning4j_tpu.nd.cache import enable_compilation_cache
 from deeplearning4j_tpu.nd.dtype import (
     DataTypePolicy,
     default_policy,
+    get_default_policy,
+    mixed_bf16,
+    policy_from_name,
+    resolve_policy,
     set_default_dtype,
+    set_default_policy,
     get_default_dtype,
 )
 from deeplearning4j_tpu.nd.random import RngStream

@@ -118,6 +118,11 @@ class MultiLayerConfiguration:
     # τ (reference threshold default 1e-3).
     gradient_sharing: str = "dense"
     gradient_sharing_threshold: float = 1e-3
+    # mixed-precision policy (nd/dtype.py): None = process default
+    # (float32), or a DataTypePolicy — "mixed_bf16" is fp32 master
+    # params / bf16 compute / fp32 losses. The DL4J_DTYPE_POLICY env
+    # override beats this field (mirroring DL4J_SCAN_LAYERS).
+    dtype_policy: Optional[Any] = None
 
     def to_dict(self):
         return {
@@ -139,6 +144,8 @@ class MultiLayerConfiguration:
             "scan_layers": self.scan_layers,
             "gradient_sharing": self.gradient_sharing,
             "gradient_sharing_threshold": self.gradient_sharing_threshold,
+            "dtype_policy": (None if self.dtype_policy is None
+                             else _policy_to_dict(self.dtype_policy)),
         }
 
     def to_json(self, **kw):
@@ -167,11 +174,26 @@ class MultiLayerConfiguration:
             gradient_sharing=d.get("gradient_sharing", "dense"),
             gradient_sharing_threshold=d.get("gradient_sharing_threshold",
                                              1e-3),
+            dtype_policy=_policy_from_serde(d.get("dtype_policy")),
         )
 
     @staticmethod
     def from_json(s: str) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration.from_dict(json.loads(s))
+
+
+def _policy_to_dict(p):
+    """Serde form of a dtype_policy field value (a DataTypePolicy, a
+    preset name, or an already-serialized dict)."""
+    from deeplearning4j_tpu.nd.dtype import as_policy
+    return as_policy(p).to_dict()
+
+
+def _policy_from_serde(d):
+    if d is None:
+        return None
+    from deeplearning4j_tpu.nd.dtype import as_policy
+    return as_policy(d)
 
 
 def _family(input_type: InputType) -> str:
@@ -251,6 +273,7 @@ class ListBuilder:
         self._scan_layers = True
         self._gradient_sharing = "dense"
         self._gradient_sharing_threshold = 1e-3
+        self._dtype_policy = global_conf.dtype_policy_value
 
     def layer(self, layer_or_idx, maybe_layer=None) -> "ListBuilder":
         layer = maybe_layer if maybe_layer is not None else layer_or_idx
@@ -302,6 +325,14 @@ class ListBuilder:
             self._gradient_sharing_threshold = float(threshold)
         return self
 
+    def dtype_policy(self, policy) -> "ListBuilder":
+        """Mixed-precision policy for this model (nd/dtype.py): a
+        DataTypePolicy, a preset name ("mixed_bf16" / "float32"), or
+        None for the process default. `DL4J_DTYPE_POLICY` env wins."""
+        from deeplearning4j_tpu.nd.dtype import as_policy
+        self._dtype_policy = as_policy(policy)
+        return self
+
     def build(self) -> MultiLayerConfiguration:
         g = self._g
         layers = [l.clone() for l in self._layers]
@@ -347,6 +378,7 @@ class ListBuilder:
             scan_layers=self._scan_layers,
             gradient_sharing=self._gradient_sharing,
             gradient_sharing_threshold=self._gradient_sharing_threshold,
+            dtype_policy=self._dtype_policy,
         )
 
 
@@ -376,6 +408,7 @@ class NeuralNetConfiguration:
         self.optimization_algo_value = "sgd"
         self.max_iterations_value = 5
         self.mini_batch = True
+        self.dtype_policy_value = None
 
     @staticmethod
     def builder() -> "NeuralNetConfiguration":
@@ -452,6 +485,17 @@ class NeuralNetConfiguration:
 
     def max_iterations(self, n: int):
         self.max_iterations_value = int(n)
+        return self
+
+    def dtype_policy(self, policy):
+        """Mixed-precision policy threaded into the built configuration
+        (nd/dtype.py): a DataTypePolicy object or a preset name —
+        ``"mixed_bf16"`` selects fp32 master params / bf16 compute /
+        fp32 losses; ``"float32"`` forces pure fp32. ``None`` keeps the
+        process default. A/B without code changes via the
+        ``DL4J_DTYPE_POLICY`` env override, which beats this field."""
+        from deeplearning4j_tpu.nd.dtype import as_policy
+        self.dtype_policy_value = as_policy(policy)
         return self
 
     def constrain_max_norm(self, v: float):

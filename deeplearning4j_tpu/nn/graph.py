@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.common.updaters import Sgd
-from deeplearning4j_tpu.nd.dtype import DataTypePolicy, default_policy
+from deeplearning4j_tpu.nd.dtype import DataTypePolicy, resolve_policy
 from deeplearning4j_tpu.nn.conf.builder import (
     CONFIG_FORMAT_VERSION,
     check_format_version,
@@ -81,6 +81,8 @@ class ComputationGraphConfiguration:
         # (parallel/gradient_sharing.py; DL4J_GRADIENT_SHARING overrides)
         self.gradient_sharing: str = "dense"
         self.gradient_sharing_threshold: float = 1e-3
+        # mixed-precision policy (nd/dtype.py; DL4J_DTYPE_POLICY wins)
+        self.dtype_policy = None
         self.topo_order: List[str] = []
 
     # ------------------------------------------------------------- builder
@@ -134,6 +136,8 @@ class ComputationGraphConfiguration:
             "scan_layers": self.scan_layers,
             "gradient_sharing": self.gradient_sharing,
             "gradient_sharing_threshold": self.gradient_sharing_threshold,
+            "dtype_policy": (None if self.dtype_policy is None
+                             else self.dtype_policy.to_dict()),
             "input_types": {k: v.to_dict() for k, v in self.input_types.items()},
             "nodes": [
                 {
@@ -172,6 +176,9 @@ class ComputationGraphConfiguration:
         conf.gradient_sharing = d.get("gradient_sharing", "dense")
         conf.gradient_sharing_threshold = d.get("gradient_sharing_threshold",
                                                 1e-3)
+        if d.get("dtype_policy") is not None:
+            from deeplearning4j_tpu.nd.dtype import as_policy
+            conf.dtype_policy = as_policy(d["dtype_policy"])
         conf.input_types = {k: InputType.from_dict(v)
                             for k, v in d.get("input_types", {}).items()}
         for nd in d["nodes"]:
@@ -250,6 +257,14 @@ class GraphBuilder:
             self._conf.gradient_sharing_threshold = float(threshold)
         return self
 
+    def dtype_policy(self, policy) -> "GraphBuilder":
+        """Mixed-precision policy for this graph (nd/dtype.py): a
+        DataTypePolicy or preset name ("mixed_bf16" / "float32");
+        `DL4J_DTYPE_POLICY` env wins."""
+        from deeplearning4j_tpu.nd.dtype import as_policy
+        self._conf.dtype_policy = as_policy(policy)
+        return self
+
     def build(self) -> ComputationGraphConfiguration:
         conf = self._conf
         conf.seed = self._g.seed_value
@@ -258,6 +273,8 @@ class GraphBuilder:
         conf.max_norm = self._g.max_norm_value
         conf.optimization_algo = self._g.optimization_algo_value
         conf.max_iterations = self._g.max_iterations_value
+        if conf.dtype_policy is None:
+            conf.dtype_policy = getattr(self._g, "dtype_policy_value", None)
         conf.topo_order = conf.topological_sort()
         # shape inference + automatic preprocessors (reference
         # GraphBuilder.build → addPreProcessors)
@@ -289,7 +306,9 @@ class ComputationGraph:
     def __init__(self, conf: ComputationGraphConfiguration,
                  dtype_policy: DataTypePolicy = None):
         self.conf = conf
-        self.dtype = dtype_policy or default_policy()
+        # DL4J_DTYPE_POLICY env > explicit arg > conf.dtype_policy >
+        # process default (nd/dtype.py)
+        self.dtype = resolve_policy(dtype_policy, conf)
         self.params: Dict[str, Dict[str, jnp.ndarray]] = {}
         self.net_state: Dict[str, Dict[str, jnp.ndarray]] = {}
         self.updater_state: Dict[str, Dict[str, Any]] = {}
@@ -377,6 +396,20 @@ class ComputationGraph:
         return self
 
     # --------------------------------------------------------------- forward
+    def _input_feeds_ids(self, input_name: str) -> bool:
+        """True when some embedding layer (possibly frozen-wrapped)
+        consumes this network input directly — its activations are
+        token ids, not features. Ids routed through intermediate
+        vertices should be carried as INT arrays (non-floating inputs
+        are never cast; docs/PRECISION.md)."""
+        if getattr(self, "_ids_inputs_cache", None) is None:
+            self._ids_inputs_cache = {
+                inp: any(scan_stack.consumes_token_ids(n.layer)
+                         for n in self.conf.nodes.values()
+                         if n.layer is not None and inp in n.inputs)
+                for inp in self.conf.network_inputs}
+        return self._ids_inputs_cache.get(input_name, False)
+
     def _chains(self, params):
         """Scan-over-layers chain plan: maximal single-consumer chains
         of structurally identical layer nodes (nn/scan_stack.py).
@@ -406,12 +439,21 @@ class ComputationGraph:
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         masks = list(masks) if masks else [None] * len(inputs)
+        # mixed precision: param leaves compute in compute_dtype
+        # (identity for the fp32 policy / an already-cast tree — the
+        # train step casts OUTSIDE value_and_grad so grads are bf16)
+        params = self.dtype.cast_params(params)
         acts: Dict[str, jnp.ndarray] = {}
         mask_map: Dict[str, Any] = {}
         preouts: Dict[str, jnp.ndarray] = {}
         new_state: Dict[str, Dict] = {}
         for i, name in enumerate(self.conf.network_inputs):
-            acts[name] = self.dtype.cast_compute(jnp.asarray(inputs[i]))
+            x = jnp.asarray(inputs[i])
+            if not self._input_feeds_ids(name):
+                # token-id inputs pass uncast: a bf16 round corrupts
+                # ids above 256 (embedding gathers float-carried ids)
+                x = self.dtype.cast_compute(x)
+            acts[name] = x
             mask_map[name] = masks[i] if i < len(masks) else None
         use_scan = (carries is None and not unrolled
                     and scan_stack.scan_enabled(self.conf))
@@ -501,10 +543,16 @@ class ComputationGraph:
         for oi, name in enumerate(self.output_layer_names):
             layer = self.conf.nodes[name].layer
             h, mask, lrng = preouts[name]
-            y = self.dtype.cast_compute(jnp.asarray(labels[oi]))
+            # losses / softmax statistics stay fp32 under a mixed
+            # policy (activations, labels and output-layer params all
+            # upcast to output_dtype; see MultiLayerNetwork._loss_fn)
+            h = self.dtype.cast_output(h)
+            y = self.dtype.cast_output(jnp.asarray(labels[oi]))
+            lparams = self.dtype.cast_output_params(
+                self.dtype.cast_params(params.get(name, {})))
             lmask = lmasks[oi] if lmasks[oi] is not None else mask
             lparams = layer.apply_weight_noise(
-                params.get(name, {}), train,
+                lparams, train,
                 None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
             total = total + layer.compute_loss(lparams, state.get(name, {}),
                                                h, y, train=train, rng=lrng, mask=lmask)
@@ -538,6 +586,7 @@ class ComputationGraph:
         return runs
 
     def _apply_updates(self, params, grads, upd_state, step):
+        from deeplearning4j_tpu.kernels import fused_adam as fa
         new_params, new_upd = {}, {}
         for lk, lgrads in grads.items():
             if scan_stack.is_run_key(lk):
@@ -547,8 +596,19 @@ class ComputationGraph:
             else:
                 layer = self.conf.nodes[lk].layer
             updater = layer.updater or Sgd(1e-3)
+            if (scan_stack.is_run_key(lk)
+                    and fa.fused_adam_eligible(updater)):
+                # Pallas fast path — one kernel per packed run (see
+                # MultiLayerNetwork._apply_updates)
+                lp, lu = fa.adam_update_packed(
+                    updater, params[lk], lgrads, upd_state[lk], step)
+                new_params[lk] = lp
+                new_upd[lk] = lu
+                continue
             lp, lu = {}, {}
             for pk, g in lgrads.items():
+                # bf16 grads (mixed policy) meet the fp32 master here
+                g = g.astype(params[lk][pk].dtype)
                 delta, new_s = updater.apply(g, upd_state[lk][pk], step)
                 lp[pk] = params[lk][pk] - delta.astype(params[lk][pk].dtype)
                 lu[pk] = new_s
@@ -580,8 +640,10 @@ class ComputationGraph:
                 return self._loss_fn(p, state, xs, ys, rng, fmasks, lmasks,
                                      train=True, carries=stopped)
 
+            # cast outside value_and_grad: bf16 grads under mixed_bf16,
+            # fp32 master update below (see MultiLayerNetwork)
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
-                lf, has_aux=True)(params)
+                lf, has_aux=True)(self.dtype.cast_params(params))
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd_state, it)
             if runs:
@@ -607,7 +669,7 @@ class ComputationGraph:
                                      train=True)
 
             (loss, (new_state, _)), grads = jax.value_and_grad(
-                lf, has_aux=True)(params)
+                lf, has_aux=True)(self.dtype.cast_params(params))
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd, it)
             state = {k: new_state.get(k, v) for k, v in state.items()}
@@ -1089,7 +1151,9 @@ class ComputationGraph:
             def fwd(params, state, xs, masks):
                 acts, _, _, _ = self._forward_all(params, state, xs, train=False,
                                                   rng=None, masks=masks)
-                return tuple(acts[n] for n in self.conf.network_outputs)
+                # eval numerics stay fp32 under a mixed policy
+                return tuple(self.dtype.cast_output(acts[n])
+                             for n in self.conf.network_outputs)
             self._jit_output = jax.jit(fwd)
         xs = tuple(jnp.asarray(x) for x in inputs)
         outs = self._jit_output(self.params, self.net_state, xs, masks)

@@ -67,10 +67,14 @@ class BatchNormalization(Layer):
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         # normalize over all axes except the last (feature/channel) —
         # covers FF [B,F], CNN NHWC [B,H,W,C] and RNN [B,T,F] uniformly.
+        # Batch statistics are computed in fp32 regardless of the
+        # activation dtype (mixed_bf16 policy: a bf16 mean/variance
+        # drifts the running stats) — identity for fp32 activations.
         axes = tuple(range(x.ndim - 1))
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            x32 = x.astype(jnp.float32)
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
@@ -78,7 +82,7 @@ class BatchNormalization(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        inv = 1.0 / jnp.sqrt(var + self.eps)
+        inv = 1.0 / jnp.sqrt(var.astype(jnp.float32) + self.eps)
         xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
         if not self.lock_gamma_beta:
             xhat = xhat * params["gamma"].astype(x.dtype) + params["beta"].astype(x.dtype)
@@ -146,7 +150,27 @@ class LayerNormalization(Layer):
                 "beta": jnp.zeros((self.n_out,), dtype)}
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
-        mean = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        y = (x - mean) / jnp.sqrt(var + self.eps)
-        return self.activation(y * params["gamma"] + params["beta"]), state
+        from deeplearning4j_tpu.kernels import kernels_enabled
+        if kernels_enabled() and params and x.ndim >= 2:
+            # fused Pallas fast path: one kernel computes the fp32 row
+            # statistics and applies gamma/beta in a single HBM pass
+            # (interpret mode on CPU for the parity tests;
+            # DL4J_PALLAS_KERNELS=0 opts out)
+            from deeplearning4j_tpu.kernels.layernorm import layer_norm
+            y = layer_norm(x, params["gamma"], params["beta"], self.eps)
+            return self.activation(y), state
+        return self.activation(
+            layer_norm_reference(x, params["gamma"], params["beta"],
+                                 self.eps)), state
+
+
+def layer_norm_reference(x, gamma, beta, eps):
+    """Pure-XLA layer norm — the jnp path the Pallas kernel is
+    parity-tested against. Row statistics in fp32 regardless of the
+    activation dtype (mixed_bf16: bf16 mean/var destabilizes the
+    normalization); the normalized value returns in x.dtype."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = ((x32 - mean) / jnp.sqrt(var + eps)).astype(x.dtype)
+    return y * gamma + beta

@@ -178,6 +178,7 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
 
     def _forward_impl(self, params, x, *, train, rng, mask):
         from deeplearning4j_tpu.common.activations import get_activation
+        from deeplearning4j_tpu.kernels import kernels_enabled
 
         if self._mha is None:
             self._build_sublayers()
@@ -188,8 +189,18 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
         h = self.apply_input_dropout(h, train,
                                      None if rng is None
                                      else jax.random.fold_in(rng, 2))
-        x = x + h
-        h, _ = self._ln2.forward(self._sub(params, "ln2"), {}, x)
+        if kernels_enabled():
+            # fused residual+LayerNorm Pallas kernel: the [B, T, D]
+            # residual sum and the fp32 row statistics share one HBM
+            # pass (kernels/layernorm.py; DL4J_PALLAS_KERNELS gates)
+            from deeplearning4j_tpu.kernels.layernorm import (
+                residual_layer_norm)
+            ln2 = self._sub(params, "ln2")
+            x, h = residual_layer_norm(x, h, ln2["gamma"], ln2["beta"],
+                                       self._ln2.eps)
+        else:
+            x = x + h
+            h, _ = self._ln2.forward(self._sub(params, "ln2"), {}, x)
         act = get_activation(self.ff_activation)
         h = act(h @ params["ff_W1"] + params["ff_b1"])
         h = h @ params["ff_W2"] + params["ff_b2"]

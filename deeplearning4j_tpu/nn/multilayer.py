@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.common.updaters import Sgd, Updater
-from deeplearning4j_tpu.nd.dtype import DataTypePolicy, default_policy
+from deeplearning4j_tpu.nd.dtype import DataTypePolicy, resolve_policy
 from deeplearning4j_tpu.nn.conf.builder import (
     BackpropType,
     GradientNormalization,
@@ -95,7 +95,9 @@ class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration, dtype_policy: DataTypePolicy = None):
         self.conf = conf
         self.layers: List[Layer] = conf.layers
-        self.dtype = dtype_policy or default_policy()
+        # DL4J_DTYPE_POLICY env > explicit arg > conf.dtype_policy >
+        # process default (nd/dtype.py)
+        self.dtype = resolve_policy(dtype_policy, conf)
         self.params: Dict[str, Dict[str, jnp.ndarray]] = {}
         self.net_state: Dict[str, Dict[str, jnp.ndarray]] = {}
         self.updater_state: Dict[str, Dict[str, Any]] = {}
@@ -209,7 +211,16 @@ class MultiLayerNetwork:
         per-activation collector, and heterogeneous stacks stay on the
         unrolled loop; both paths apply each layer's `remat_policy`
         and produce identical numerics (same per-layer rng folds)."""
-        h = self.dtype.cast_compute(jnp.asarray(x))
+        # mixed precision: every param leaf computes in compute_dtype
+        # (identity for the fp32 policy / an already-cast tree — the
+        # train step casts OUTSIDE value_and_grad so grads are bf16)
+        params = self.dtype.cast_params(params)
+        x = jnp.asarray(x)
+        if not (self.layers and scan_stack.consumes_token_ids(self.layers[0])):
+            # token-id inputs pass uncast: a bf16 round corrupts ids
+            # above 256 (the embedding gathers from float-carried ids)
+            x = self.dtype.cast_compute(x)
+        h = x
         new_state = {}
         new_carries = {}
         acts = []
@@ -299,9 +310,16 @@ class MultiLayerNetwork:
         si = str(n - 1)
         lrng = None if rng is None else jax.random.fold_in(rng, n - 1)
         label_mask = lmask if lmask is not None else mask
-        y = self.dtype.cast_compute(jnp.asarray(y))
+        # losses / softmax statistics stay fp32 under a mixed policy:
+        # the incoming activations, the labels AND the output layer's
+        # params are upcast to output_dtype (grads still flow back in
+        # compute_dtype through the cast transpose)
+        h = self.dtype.cast_output(h)
+        y = self.dtype.cast_output(jnp.asarray(y))
+        out_params = self.dtype.cast_output_params(
+            self.dtype.cast_params(params.get(si, {})))
         out_params = out_layer.apply_weight_noise(
-            params.get(si, {}), train,
+            out_params, train,
             None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
         loss = out_layer.compute_loss(out_params, state.get(si, {}), h, y,
                                       train=train, rng=lrng, mask=label_mask)
@@ -341,6 +359,7 @@ class MultiLayerNetwork:
         return runs
 
     def _apply_updates(self, params, grads, upd_state, step):
+        from deeplearning4j_tpu.kernels import fused_adam as fa
         new_params, new_upd = {}, {}
         for lk, lgrads in grads.items():
             if scan_stack.is_run_key(lk):
@@ -351,8 +370,22 @@ class MultiLayerNetwork:
             else:
                 layer = self.layers[int(lk)]
             updater = layer.updater or Sgd(1e-3)
+            if (scan_stack.is_run_key(lk)
+                    and fa.fused_adam_eligible(updater)):
+                # Pallas fast path: ONE kernel read-modify-writes the
+                # whole packed run's param/m/v stack in a single pass
+                # (bit-comparable to the per-leaf jnp path below;
+                # DL4J_PALLAS_KERNELS=0 opts out)
+                lp, lu = fa.adam_update_packed(
+                    updater, params[lk], lgrads, upd_state[lk], step)
+                new_params[lk] = lp
+                new_upd[lk] = lu
+                continue
             lp, lu = {}, {}
             for pk, g in lgrads.items():
+                # bf16 grads (mixed policy) meet the fp32 master here:
+                # upcast BEFORE the updater so m/v/param stay fp32
+                g = g.astype(params[lk][pk].dtype)
                 delta, new_s = updater.apply(g, upd_state[lk][pk], step)
                 lp[pk] = params[lk][pk] - delta.astype(params[lk][pk].dtype)
                 lu[pk] = new_s
@@ -387,8 +420,12 @@ class MultiLayerNetwork:
                 return self._loss_fn(p, state, x, y, rng, fmask, lmask,
                                      train=True, carries=stopped)
 
+            # differentiate wrt the COMPUTE-dtype tree (cast outside
+            # value_and_grad): under mixed_bf16 the gradients — and any
+            # data-parallel all-reduce of them — are bf16; the updater
+            # below upcasts onto the fp32 master params/state
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
-                lf, has_aux=True)(params)
+                lf, has_aux=True)(self.dtype.cast_params(params))
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd_state, it)
             if runs:
@@ -421,7 +458,7 @@ class MultiLayerNetwork:
                                      train=True)
 
             (loss, (new_state, _)), grads = jax.value_and_grad(
-                lf, has_aux=True)(params)
+                lf, has_aux=True)(self.dtype.cast_params(params))
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd, it)
             state = {k: new_state.get(k, v) for k, v in state.items()}
@@ -699,7 +736,8 @@ class MultiLayerNetwork:
             def fwd(params, state, x, mask):
                 h, _, _, _, _ = self._forward_core(params, state, x, train=False,
                                                    rng=None, mask=mask)
-                return h
+                # eval numerics stay fp32 under a mixed policy
+                return self.dtype.cast_output(h)
             self._jit_output = jax.jit(fwd)
         return self._jit_output(self.params, self.net_state, x, mask)
 

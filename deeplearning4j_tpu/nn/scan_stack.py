@@ -166,6 +166,18 @@ def scan_enabled(conf) -> bool:
     return bool(getattr(conf, "scan_layers", True))
 
 
+def consumes_token_ids(layer) -> bool:
+    """True when this layer treats its input as token IDS (embedding
+    gathers), unwrapping frozen/transfer-learning wrappers — the guard
+    the mixed-precision input cast consults: a bf16 round corrupts
+    float-carried ids above 256. Ids carried as INT arrays are always
+    safe (non-floating inputs are never cast)."""
+    inner = getattr(layer, "layer", None)
+    if inner is not None and getattr(layer, "layer_name", "") == "frozen":
+        return consumes_token_ids(inner)
+    return getattr(layer, "layer_name", "") == "embedding"
+
+
 def layer_signature(layer, lparams) -> Tuple:
     """Structural identity of a layer instance: full config equality
     (not just class — two blocks with different head counts must not

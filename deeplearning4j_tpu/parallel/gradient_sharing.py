@@ -330,6 +330,10 @@ def compute_updater_deltas(model, is_graph: bool, params, grads,
         updater = layer.updater or Sgd(1e-3)
         ld, lu = {}, {}
         for pk, g in lgrads.items():
+            # mixed policy: grads arrive in compute dtype (bf16) —
+            # upcast BEFORE the updater so the deltas the threshold
+            # encoder consumes (and the EF identity) live in fp32
+            g = g.astype(params[lk][pk].dtype)
             delta, new_s = updater.apply(g, upd_state[lk][pk], step)
             ld[pk] = delta.astype(params[lk][pk].dtype)
             lu[pk] = new_s
@@ -401,8 +405,11 @@ def make_threshold_core(model, axis: str, cfg: ThresholdConfig, *,
 
     def core(params, upd, state, it, residual, tau, x, y, rng):
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+        # cast outside value_and_grad: bf16 grads under a mixed policy
+        # (compute_updater_deltas upcasts before the EF encode)
         (loss, (new_state, _)), grads = jax.value_and_grad(
-            lambda p: local_loss(p, state, x, y, rng), has_aux=True)(params)
+            lambda p: local_loss(p, state, x, y, rng),
+            has_aux=True)(model.dtype.cast_params(params))
         grads = apply_gradient_normalization(grads, gn, gn_t)
         deltas, new_upd = compute_updater_deltas(
             model, is_graph, params, grads, upd, it)
@@ -659,20 +666,26 @@ def _threshold_bucket_hook(model, is_graph: bool, lk: str, axis: str,
 
     layer = _layer_for_key(model, is_graph, lk)
     updater = layer.updater or Sgd(1e-3)
+    policy = model.dtype
 
     @jax.custom_vjp
     def hook(p, u, r, c, it_f):
-        return p
+        # primal casts to compute dtype INSIDE the hook: forward runs
+        # bf16 under a mixed policy while the saved p stays the fp32
+        # master, and the incoming cotangent (the gradient) is bf16
+        return policy.cast_params(p)
 
     def fwd(p, u, r, c, it_f):
-        return p, (p, u, r, c, it_f)
+        return policy.cast_params(p), (p, u, r, c, it_f)
 
     def bwd(saved, g):
         p, u, r, c, it_f = saved
         g = apply_gradient_normalization({lk: g}, gn, gn_t)[lk]
         deltas, new_u = {}, {}
         for pk, gg in g.items():
-            d, s = updater.apply(gg, u[pk], it_f)
+            # bf16 grad → fp32 BEFORE the updater/EF encode, so
+            # enc·τ + res' = upd + res holds exactly in fp32
+            d, s = updater.apply(gg.astype(p[pk].dtype), u[pk], it_f)
             deltas[pk] = d.astype(p[pk].dtype)
             new_u[pk] = s
         dhat, new_r, new_tau, sp = threshold_exchange(
@@ -714,13 +727,18 @@ def _dense_bucket_hook(model, is_graph: bool, lk: str, axis: str,
     layer = _layer_for_key(model, is_graph, lk)
     updater = layer.updater or Sgd(1e-3)
     n = n_workers
+    policy = model.dtype
 
     @jax.custom_vjp
     def hook(p, u, it_f):
-        return p
+        return policy.cast_params(p)
 
     def fwd(p, u, it_f):
-        return p, (p, u, it_f)
+        # saved p = the fp32 master; the hook OUTPUT (and therefore the
+        # incoming cotangent) is compute dtype — under mixed_bf16 the
+        # gradient collective below moves bf16 on the wire (half the
+        # dense fp32 payload), upcast to fp32 only after the reduce
+        return policy.cast_params(p), (p, u, it_f)
 
     def bwd(saved, g):
         p, u, it_f = saved
@@ -728,10 +746,11 @@ def _dense_bucket_hook(model, is_graph: bool, lk: str, axis: str,
         reduced = {}
         for pk, gg in g.items():
             if plan_b.get(pk):
-                reduced[pk] = jax.lax.psum_scatter(
+                red = jax.lax.psum_scatter(
                     gg, axis, scatter_dimension=gg.ndim - 1, tiled=True) / n
             else:
-                reduced[pk] = jax.lax.pmean(gg, axis)
+                red = jax.lax.pmean(gg, axis)
+            reduced[pk] = red.astype(p[pk].dtype)
         if full_gn:
             reduced = apply_gradient_normalization({lk: reduced},
                                                    gn, gn_t)[lk]
@@ -784,13 +803,14 @@ def _threshold_rs_bucket_hook(model, is_graph: bool, lk: str, axis: str,
     n = n_workers
     wdtype = wire_dtype(n)
     inv_n = 1.0 / float(n)
+    policy = model.dtype
 
     @jax.custom_vjp
     def hook(p, u, r, c, it_f):
-        return p
+        return policy.cast_params(p)
 
     def fwd(p, u, r, c, it_f):
-        return p, (p, u, r, c, it_f)
+        return policy.cast_params(p), (p, u, r, c, it_f)
 
     def bwd(saved, g):
         p, u, r, c, it_f = saved
@@ -799,6 +819,9 @@ def _threshold_rs_bucket_hook(model, is_graph: bool, lk: str, axis: str,
         new_p, new_u, new_r = {}, {}, {}
         sent_total = jnp.float32(0.0)
         for pk, gg in g.items():
+            # bf16 grad → fp32 residual dtype BEFORE the EF encode (a
+            # bf16 accumulate would erase the carried residual mass)
+            gg = gg.astype(r[pk].dtype)
             acc = gg + r[pk].astype(gg.dtype)
             enc, res_new, sent = encode_leaf(acc, tau, wdtype)
             sent_total = sent_total + sent
@@ -1153,15 +1176,19 @@ def bucket_plan(model) -> list:
 
 # ------------------------------------------------------ comm-bytes accounting
 def exchange_wire_bytes(params, mode: str, *, n_workers: int = 2,
-                        rs_plan: Optional[dict] = None) -> float:
+                        rs_plan: Optional[dict] = None,
+                        grad_dtype=None) -> float:
     """Host-side accounting of one step's gradient-exchange payload
-    per replica (collective operand bytes): fp32 gradients for dense,
-    the integer wire tensors + the sent-count/loss scalars for
-    threshold. The `_rs` modes count the gradient reduce-scatter
-    operand (fp32 or the int wire tensor) plus the updated-param
-    all-gather operand (one fp32 shard per replica). Static — no
-    device work, so the trainers can count every step without a sync
-    (the FLOP-accounting discipline applied to communication)."""
+    per replica (collective operand bytes): gradients in their ACTUAL
+    dtype for dense (`grad_dtype` — the policy's compute dtype; bf16
+    under mixed_bf16 halves the dense wire), the integer wire tensors
+    + the sent-count/loss scalars for threshold. The `_rs` modes count
+    the gradient reduce-scatter operand (grad-dtype or the int wire
+    tensor) plus the updated-param all-gather operand (one PARAM-dtype
+    shard per replica — the fp32 master is what gets gathered).
+    Static — no device work, so the trainers can count every step
+    without a sync (the FLOP-accounting discipline applied to
+    communication)."""
     def leaf_itemsize(l):
         # shape/dtype only — a leaf may be a multi-process global array
         # whose VALUE no single host can fetch (TP-sharded params after
@@ -1169,9 +1196,18 @@ def exchange_wire_bytes(params, mode: str, *, n_workers: int = 2,
         dt = getattr(l, "dtype", None)
         return jnp.dtype(dt if dt is not None else type(l)).itemsize
 
+    grad_item_of = leaf_itemsize
+    if grad_dtype is not None:
+        gsize = jnp.dtype(grad_dtype).itemsize
+
+        def grad_item_of(l):  # noqa: F811 — floating grads ride
+            dt = getattr(l, "dtype", None)  # grad_dtype, ints as-is
+            dt = jnp.dtype(dt if dt is not None else type(l))
+            return gsize if jnp.issubdtype(dt, jnp.floating) else dt.itemsize
+
     if mode == "dense":
         return float(sum(
-            int(np.prod(np.shape(l))) * leaf_itemsize(l)
+            int(np.prod(np.shape(l))) * grad_item_of(l)
             for l in jax.tree_util.tree_leaves(params)))
     if mode in RS_MODES:
         if rs_plan is None:
@@ -1182,11 +1218,12 @@ def exchange_wire_bytes(params, mode: str, *, n_workers: int = 2,
         for lk, lparams in params.items():
             for pn, arr in lparams.items():
                 e = float(int(np.prod(np.shape(arr))))
-                item = leaf_itemsize(arr)
-                grad_item = wire_item if wire_item is not None else item
+                grad_item = (wire_item if wire_item is not None
+                             else grad_item_of(arr))
                 total += e * grad_item
                 if rs_plan[lk][pn]:
-                    total += (e / n_workers) * item
+                    # updated-PARAM shard all-gather: master dtype
+                    total += (e / n_workers) * leaf_itemsize(arr)
         return total
     itemsize = jnp.dtype(wire_dtype(n_workers)).itemsize
     # + sent-count pmean (f32) + loss pmean (f32)
@@ -1233,13 +1270,15 @@ def record_threshold_stats(tau: float, sparsity: float, *,
 # ------------------------------------------------- AOT analysis seam (jaxpr)
 def exchange_jaxpr(params, mode: str, n_workers: int, *,
                    axis: str = "data", cfg: Optional[ThresholdConfig] = None,
-                   rs_plan: Optional[dict] = None):
+                   rs_plan: Optional[dict] = None, grad_dtype=None):
     """ClosedJaxpr of ONE gradient exchange (dense pmean vs threshold
     encode→int-psum→decode) over an **AbstractMesh** — traceable on a
     single-device host with no mesh at all, which is what lets
     `benchtools/hlo_cost.py` emit committed dense-vs-threshold
     comm-bytes with a dead tunnel. Gradient avals are taken from
-    `params` (gradients share the param tree's shapes/dtypes)."""
+    `params` (shapes; floating leaves take `grad_dtype` when given —
+    the mixed policy's compute dtype, so the analyzed program carries
+    the REAL bf16 wire)."""
     from functools import partial
 
     from jax.sharding import AbstractMesh, PartitionSpec as P
@@ -1250,16 +1289,28 @@ def exchange_jaxpr(params, mode: str, n_workers: int, *,
     mesh = AbstractMesh(((axis, int(n_workers)),))
     # per-replica operands enter with a leading replica axis (the
     # rep-spec representation the trainers use for residuals)
-    def aval_r(a):
+    def leaf_dtype(a):
         # shape/dtype only — a leaf may be a non-fetchable global array
         # (TP-sharded params after a multi-process fit), and a host
         # round-trip per leaf would be waste even when legal
         dt = getattr(a, "dtype", None)
         if dt is None:
             dt = np.asarray(a).dtype
+        return jnp.dtype(dt)
+
+    def aval_r(a, dtype_override=None):
+        dt = leaf_dtype(a)
+        if dtype_override is not None and jnp.issubdtype(dt, jnp.floating):
+            dt = jnp.dtype(dtype_override)
         return jax.ShapeDtypeStruct((int(n_workers),) + tuple(np.shape(a)),
                                     dt)
-    grads_r = jax.tree_util.tree_map(aval_r, params)
+    # the grad-dtype override shapes the wire only where the wire IS
+    # the gradient (dense / dense_rs); the threshold modes encode fp32
+    # accumulators (post-upcast) to an int wire either way
+    dense_like = mode in ("dense", "dense_rs")
+    grads_r = jax.tree_util.tree_map(
+        lambda a: aval_r(a, grad_dtype if dense_like else None), params)
+    param_dtypes = jax.tree_util.tree_map(leaf_dtype, params)
     strip = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
     expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
     rep = P(axis)
@@ -1295,7 +1346,8 @@ def exchange_jaxpr(params, mode: str, n_workers: int, *,
                         sh = jax.lax.psum_scatter(
                             enc, axis, scatter_dimension=enc.ndim - 1,
                             tiled=True)
-                        nsh = sh.astype(gg.dtype) * gg.dtype.type(inv_n)
+                        nsh = (sh.astype(gg.dtype) * gg.dtype.type(inv_n)
+                               ).astype(param_dtypes[lk][pn])
                         lout[pn] = jax.lax.all_gather(
                             nsh, axis, axis=nsh.ndim - 1, tiled=True)
                     else:

@@ -262,9 +262,13 @@ class PipelineParallelTrainer:
         out_layer = model.layers[-1]
         si = str(n - 1)
         lrng = None if rng is None else jax.random.fold_in(rng, n - 1)
-        y = model.dtype.cast_compute(jnp.asarray(y))
+        # losses stay in output dtype (fp32 under a mixed policy) —
+        # same rule as the containers' _loss_fn
+        h = model.dtype.cast_output(h)
+        y = model.dtype.cast_output(jnp.asarray(y))
         out_params = out_layer.apply_weight_noise(
-            params.get(si, {}), True,
+            model.dtype.cast_output_params(
+                model.dtype.cast_params(params.get(si, {}))), True,
             None if lrng is None else jax.random.fold_in(lrng, 0x5EED))
         loss = out_layer.compute_loss(out_params, state.get(si, {}),
                                       h, y, train=True, rng=lrng)

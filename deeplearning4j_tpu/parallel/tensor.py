@@ -525,7 +525,8 @@ class ShardedParallelTrainer:
             res_r, tau = self._threshold_state()
             wire_b = gs.exchange_wire_bytes(model.params, "threshold",
                                             n_workers=n_data)
-        dense_b = gs.exchange_wire_bytes(model.params, "dense")
+        dense_b = gs.exchange_wire_bytes(
+            model.params, "dense", grad_dtype=model.dtype.compute_dtype)
         iterator = as_iterator(data, labels, batch_size=batch_size)
         listeners = ComposedListeners(model.listeners
                                       + monitor.extra_listeners())

@@ -732,7 +732,8 @@ class ParallelTrainer:
         # counted with zero device syncs (docs/COMMS.md)
         wire_b = gs.exchange_wire_bytes(model.params, "threshold",
                                         n_workers=self.n_workers)
-        dense_b = gs.exchange_wire_bytes(model.params, "dense")
+        dense_b = gs.exchange_wire_bytes(
+            model.params, "dense", grad_dtype=model.dtype.compute_dtype)
         last_loss = None
         last_sparsity = None
         # replica-0 slice with a REPLICATED out-sharding (multi-process
@@ -920,8 +921,10 @@ class ParallelTrainer:
         # counted with zero device syncs (docs/COMMS.md)
         wire_b = gs.exchange_wire_bytes(
             model.params, mode, n_workers=self.n_workers,
-            rs_plan=self._rs_plan() if rs else None)
-        dense_b = gs.exchange_wire_bytes(model.params, "dense")
+            rs_plan=self._rs_plan() if rs else None,
+            grad_dtype=model.dtype.compute_dtype)
+        dense_b = gs.exchange_wire_bytes(
+            model.params, "dense", grad_dtype=model.dtype.compute_dtype)
         last_loss = None
         last_sparsity = None
         rep0 = jax.jit(
@@ -1179,7 +1182,10 @@ class ParallelTrainer:
             eager_loss = bool(model.listeners) or self.stats is not None
             last_loss = None
             from deeplearning4j_tpu.parallel import gradient_sharing as gs
-            dense_b = gs.exchange_wire_bytes(model.params, "dense")
+            # real wire dtype: the GSPMD all-reduce moves COMPUTE-dtype
+            # grads (bf16 under mixed_bf16 — half the fp32 payload)
+            dense_b = gs.exchange_wire_bytes(
+                model.params, "dense", grad_dtype=model.dtype.compute_dtype)
 
             def live_state():
                 # fault/ checkpointing: fit-local device trees (the

@@ -33,11 +33,18 @@
 #    drill driver — final params/updater state must be BIT-identical
 #    to the uninterrupted run (the preemption-tolerance guarantee,
 #    docs/FAULT_TOLERANCE.md).
+# 7. Mixed-precision smoke: tiny-MLP bf16-vs-fp32 loss trajectory
+#    within the documented tolerance (docs/PRECISION.md), fp32 master
+#    params/updater state, bf16 gradients, and the fused-Adam Pallas
+#    kernel bit-comparable (inside jit) to the jnp updater path in
+#    interpret mode. The hlo_cost `precision` block (bf16 bytes <
+#    fp32 bytes) is asserted in step [4/7] where the reports are
+#    already on disk.
 
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== [1/6] tier-1 tests (ROADMAP.md verbatim) =="
+echo "== [1/7] tier-1 tests (ROADMAP.md verbatim) =="
 # stale-report guard: a timeout-killed suite never reaches
 # pytest_sessionfinish, and step [2/3] must not read the previous
 # run's durations as this run's
@@ -45,7 +52,7 @@ rm -f "${DL4J_SUITE_DURATIONS:-/tmp/_t1_durations.json}"
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
 tier1_rc=$?
 
-echo "== [2/6] suite duration budget =="
+echo "== [2/7] suite duration budget =="
 python - <<'EOF'
 import json
 import os
@@ -72,7 +79,7 @@ if total > soft:
           "mark 'slow' the top offenders above before adding tests.")
 EOF
 
-echo "== [3/6] /metrics smoke =="
+echo "== [3/7] /metrics smoke =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import sys
 import urllib.request
@@ -114,7 +121,7 @@ print(f"/metrics smoke OK ({len(body.splitlines())} exposition lines, "
 EOF
 smoke_rc=$?
 
-echo "== [4/6] AOT cost smoke (hlo_cost --all) =="
+echo "== [4/7] AOT cost smoke (hlo_cost --all) =="
 hlo_out=$(mktemp -d)
 timeout -k 10 840 env JAX_PLATFORMS=cpu \
     python -m benchtools.hlo_cost --all --batch 8 --steps 2 --out "$hlo_out"
@@ -140,8 +147,16 @@ for p in paths:
         cb.get("threshold_bytes_per_step"), f"{p}: comm_bytes missing: {cb}"
     assert cb["threshold_bytes_per_step"] < cb["dense_bytes_per_step"], \
         f"{p}: threshold exchange not smaller than dense: {cb}"
-    assert cb.get("reduction", 0) >= 3.9, \
-        f"{p}: comm reduction below 4x wire format: {cb}"
+    # int8-vs-fp32 stays the 4x wire format; against the REAL dense
+    # wire (bf16 grads under the mixed_bf16 headline policy) the
+    # honest floor is ~2x
+    assert cb.get("reduction_vs_fp32", cb.get("reduction", 0)) >= 3.9, \
+        f"{p}: comm reduction below 4x wire format vs fp32: {cb}"
+    assert cb.get("reduction", 0) >= 1.9, \
+        f"{p}: comm reduction below the real-dtype floor: {cb}"
+    prec = rep.get("precision") or {}
+    assert "error" not in prec and prec.get("active_policy"), \
+        f"{p}: precision block missing: {prec}"
     co = prog.get("comm_overlap") or {}
     assert "error" not in co and co.get("total_bytes"), \
         f"{p}: comm_overlap block missing: {co}"
@@ -169,16 +184,28 @@ assert svu["scan_vs_unrolled"]["eqn_reduction"] >= 3.0, \
     svu["scan_vs_unrolled"]
 assert svu["remat_compare"]["full"]["temp_reduction"] > 1.0, \
     svu["remat_compare"]
+# mixed-precision evidence: bf16 activation/wire bytes strictly below
+# fp32 on the transformer AND resnet programs (docs/PRECISION.md)
+for name in ("cost_transformer.json", "cost_resnet50.json"):
+    prec = json.load(open(os.path.join(out, name)))["precision"]
+    assert prec["mixed_bf16"]["bytes_per_step"] < \
+        prec["float32"]["bytes_per_step"], f"{name}: {prec}"
+    assert prec["mixed_bf16"]["wire_bytes_dense"] < \
+        prec["float32"]["wire_bytes_dense"], f"{name}: {prec}"
+    assert prec["wire_reduction"] >= 1.9, f"{name}: {prec}"
+tprec = json.load(open(os.path.join(out, "cost_transformer.json")))[
+    "precision"]
 print("AOT cost smoke OK "
       f"(eqn_reduction={svu['scan_vs_unrolled']['eqn_reduction']}x, "
       f"remat full temp_reduction="
       f"{svu['remat_compare']['full']['temp_reduction']}x, "
-      f"transformer overlapped_bytes={co['overlapped_bytes']:.0f})")
+      f"transformer overlapped_bytes={co['overlapped_bytes']:.0f}, "
+      f"precision bytes_reduction={tprec['bytes_reduction']}x)")
 EOF
 hlo_rc=$?
 rm -rf "$hlo_out"
 
-echo "== [5/6] gradient-sharing smoke (dense vs threshold) =="
+echo "== [5/7] gradient-sharing smoke (dense vs threshold) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
     timeout -k 10 300 python - <<'PYEOF'
 import numpy as np
@@ -246,7 +273,7 @@ print(f"gradient-sharing smoke OK (init={init:.3f} dense={d:.3f} "
 PYEOF
 gs_rc=$?
 
-echo "== [6/6] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
+echo "== [6/7] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
 # train 30 steps on a tiny MLP in a child process, SIGTERM at step 15
 # (async checkpoint every 5, atomic tmp+fsync+rename commits), auto-
 # resume from the newest valid checkpoint, and require the final
@@ -255,8 +282,97 @@ echo "== [6/6] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python scripts/fault_drill.py --smoke
 drill_rc=$?
 
-echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc}"
-if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ]; then
+echo "== [7/7] mixed-precision smoke (bf16 trajectory + fused-Adam parity) =="
+JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PYEOF'
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def build(policy=None):
+    b = NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01))
+    if policy is not None:
+        b = b.dtype_policy(policy)
+    b = b.list()
+    for _ in range(4):
+        b = b.layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+    return MultiLayerNetwork(
+        (b.layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                             loss="mcxent"))
+          .set_input_type(InputType.feed_forward(16)).build())).init()
+
+
+rng = np.random.default_rng(0)
+x = rng.standard_normal((320, 16)).astype(np.float32)
+w = rng.standard_normal((16, 4))
+y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+ds = DataSet(x, y)
+init = float(build().score(ds))
+
+fp = build()
+fp.fit(x, y, epochs=5, batch_size=32, shuffle=False)
+bf = build("mixed_bf16")
+bf.fit(x, y, epochs=5, batch_size=32, shuffle=False)
+d, b = float(fp.score(ds)), float(bf.score(ds))
+assert d < 0.5 * init, f"fp32 failed to learn: {init} -> {d}"
+assert b < 0.5 * init, f"bf16 failed to learn: {init} -> {b}"
+# documented tolerance band (docs/PRECISION.md): |Δloss| <= 5% of init
+assert abs(b - d) <= 0.05 * init, \
+    f"bf16 trajectory outside tolerance: init={init} fp32={d} bf16={b}"
+# fp32 master contract: params/updater state never leave fp32
+for leaf in jax.tree_util.tree_leaves(bf.params):
+    assert leaf.dtype == jnp.float32
+for leaf in jax.tree_util.tree_leaves(bf.updater_state):
+    assert leaf.dtype == jnp.float32
+
+# fused-Adam Pallas kernel: bit-comparable to the jnp path inside jit
+# (interpret mode on CPU — the DL4J_PALLAS_KERNELS fast path)
+from deeplearning4j_tpu.kernels.fused_adam import adam_update_packed
+upd = Adam(0.01)
+r2 = np.random.default_rng(3)
+params = {"W": jnp.asarray(r2.standard_normal((4, 16, 16)), jnp.float32),
+          "b": jnp.asarray(r2.standard_normal((4, 16)), jnp.float32)}
+grads = {k: jnp.asarray(r2.standard_normal(v.shape), jnp.bfloat16)
+         for k, v in params.items()}
+state = {k: {"m": jnp.asarray(r2.standard_normal(v.shape),
+                              jnp.float32) * 0.1,
+             "v": jnp.abs(jnp.asarray(r2.standard_normal(v.shape),
+                                      jnp.float32)) * 0.01}
+         for k, v in params.items()}
+kp, ks = jax.jit(lambda p, g, s: adam_update_packed(
+    upd, p, g, s, 7, interpret=True))(params, grads, state)
+
+
+@jax.jit
+def ref(p, g, s):
+    out_p, out_s = {}, {}
+    for pk, gg in g.items():
+        gg = gg.astype(p[pk].dtype)
+        delta, s2 = upd.apply(gg, s[pk], 7)
+        out_p[pk] = p[pk] - delta.astype(p[pk].dtype)
+        out_s[pk] = s2
+    return out_p, out_s
+
+
+rp, rs = ref(params, grads, state)
+for pk in params:
+    assert np.array_equal(np.asarray(kp[pk]), np.asarray(rp[pk])), \
+        f"fused-Adam param {pk} not bit-equal to jnp path"
+    assert np.array_equal(np.asarray(ks[pk]["m"]), np.asarray(rs[pk]["m"]))
+    assert np.array_equal(np.asarray(ks[pk]["v"]), np.asarray(rs[pk]["v"]))
+print(f"mixed-precision smoke OK (init={init:.3f} fp32={d:.3f} "
+      f"bf16={b:.3f}, fused-Adam bit-parity)")
+PYEOF
+mp_rc=$?
+
+echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc} mp_rc=${mp_rc}"
+if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ] || [ "$mp_rc" -ne 0 ]; then
     exit 1
 fi
 echo "VERIFY OK"

@@ -1,0 +1,310 @@
+"""Mixed-precision dtype policy (nd/dtype.py) — policy seams,
+resolution order, serde, and the bf16-vs-fp32 training contract:
+bf16 compute with an fp32 master copy (params + updater state stay
+fp32, gradients arrive bf16, losses stay fp32), loss trajectories
+within the documented tolerance of pure fp32 (docs/PRECISION.md).
+Device-free (CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nd import dtype as dt
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.common.updaters import Adam
+
+
+def build(policy=None, depth=4, seed=7):
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.01))
+    if policy is not None:
+        b = b.dtype_policy(policy)
+    b = b.list()
+    for _ in range(depth):
+        b = b.layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+    conf = (b.layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                                loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 4))
+    y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+# ------------------------------------------------------------ policy seams
+class TestPolicySeams:
+    def test_presets_and_names(self):
+        p = dt.mixed_bf16()
+        assert p.is_mixed and p.name == "mixed_bf16"
+        assert jnp.dtype(p.param_dtype) == jnp.float32
+        assert jnp.dtype(p.compute_dtype) == jnp.bfloat16
+        assert not dt.DataTypePolicy().is_mixed
+        assert dt.policy_from_name("float32").name == "float32"
+        assert dt.policy_from_name("bf16").name == "mixed_bf16"
+        with pytest.raises(ValueError):
+            dt.policy_from_name("fp8")
+
+    def test_get_default_policy_sees_the_active_policy(self):
+        # the legacy get_default_dtype() only exposed param_dtype —
+        # callers could not see an active mixed policy
+        try:
+            dt.set_default_dtype(compute_dtype=jnp.bfloat16)
+            assert dt.get_default_policy().is_mixed
+            assert dt.get_default_dtype() == jnp.float32
+        finally:
+            dt.set_default_dtype(reset=True)
+        assert not dt.get_default_policy().is_mixed
+
+    def test_set_default_dtype_explicit_reset(self):
+        dt.set_default_dtype(compute_dtype=jnp.bfloat16)
+        # reset=True restores factory FIRST, then applies overrides
+        out = dt.set_default_dtype(reset=True)
+        assert not out.is_mixed
+        dt.set_default_policy(dt.mixed_bf16())
+        assert dt.get_default_policy().is_mixed
+        dt.set_default_policy(None)
+        assert not dt.get_default_policy().is_mixed
+
+    def test_non_floating_inputs_pass_unchanged(self):
+        p = dt.mixed_bf16()
+        ids = jnp.arange(400, dtype=jnp.int32)       # > bf16's 256 span
+        out = p.cast_compute(ids)
+        assert out is ids
+        b = jnp.array([True, False])
+        assert p.cast_compute(b) is b
+        f = jnp.ones((3,), jnp.float32)
+        assert p.cast_compute(f).dtype == jnp.bfloat16
+
+    def test_cast_params_identity_for_fp32(self):
+        p = dt.DataTypePolicy()
+        tree = {"0": {"W": jnp.ones((2, 2))}}
+        assert p.cast_params(tree) is tree          # no retrace churn
+
+    def test_serde_roundtrip(self):
+        p = dt.mixed_bf16()
+        assert dt.DataTypePolicy.from_dict(p.to_dict()) == p
+        assert dt.as_policy("mixed_bf16") == p
+        assert dt.as_policy(p.to_dict()) == p
+        assert dt.as_policy(None) is None
+
+
+class TestResolution:
+    def test_order_env_beats_arg_beats_conf(self, monkeypatch):
+        conf = build("mixed_bf16").conf
+        assert dt.resolve_policy(None, conf).is_mixed
+        # explicit arg beats conf
+        assert not dt.resolve_policy("float32", conf).is_mixed
+        # env beats everything (mirrors DL4J_SCAN_LAYERS)
+        monkeypatch.setenv("DL4J_DTYPE_POLICY", "0")
+        assert not dt.resolve_policy("mixed_bf16", conf).is_mixed
+        monkeypatch.setenv("DL4J_DTYPE_POLICY", "mixed_bf16")
+        assert dt.resolve_policy("float32", conf).is_mixed
+        monkeypatch.setenv("DL4J_DTYPE_POLICY", "float999")
+        with pytest.raises(ValueError):
+            dt.resolve_policy(None, conf)
+
+    def test_env_ab_toggle_on_container(self, monkeypatch):
+        monkeypatch.setenv("DL4J_DTYPE_POLICY", "mixed_bf16")
+        net = build()                                 # no conf policy
+        assert net.dtype.is_mixed
+        monkeypatch.delenv("DL4J_DTYPE_POLICY")
+        assert not build().dtype.is_mixed
+
+    def test_conf_serde_carries_policy(self):
+        net = build("mixed_bf16")
+        conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        assert MultiLayerNetwork(conf2).dtype.is_mixed
+        # absent field stays None → process default
+        net3 = build()
+        assert net3.conf.dtype_policy is None
+        conf4 = MultiLayerConfiguration.from_json(net3.conf.to_json())
+        assert conf4.dtype_policy is None
+
+    def test_graph_builder_and_serde(self):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        conf = (ComputationGraphConfiguration.graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_in=8, n_out=8), "in")
+                .add_layer("out", OutputLayer(n_in=8, n_out=3), "d")
+                .set_outputs("out")
+                .dtype_policy("mixed_bf16")
+                .build())
+        assert ComputationGraph(conf).dtype.is_mixed
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert ComputationGraph(conf2).dtype.is_mixed
+
+
+# --------------------------------------------------------- mixed training
+class TestMixedTraining:
+    def test_master_stays_fp32_and_grads_are_bf16(self):
+        net = build("mixed_bf16")
+        x, y = make_data()
+        seen = []
+        orig = net._apply_updates
+
+        def spy(params, grads, upd, step):
+            seen.append(jax.tree_util.tree_map(lambda g: g.dtype, grads))
+            return orig(params, grads, upd, step)
+
+        net._apply_updates = spy
+        net.fit(x, y, epochs=1, batch_size=16, shuffle=False)
+        # grads arrive in compute dtype (the wire dtype of a DP
+        # all-reduce)...
+        assert all(d == jnp.bfloat16
+                   for d in jax.tree_util.tree_leaves(seen[0]))
+        # ...while the master copy stays fp32
+        for leaf in jax.tree_util.tree_leaves(net.params):
+            assert leaf.dtype == jnp.float32
+        for leaf in jax.tree_util.tree_leaves(net.updater_state):
+            assert leaf.dtype == jnp.float32
+
+    def test_trajectory_within_tolerance_of_fp32(self):
+        # the documented band (docs/PRECISION.md): after 12 steps on a
+        # separable problem, |loss_bf16 − loss_fp32| ≤ 5% of the
+        # initial loss, and both must actually learn
+        x, y = make_data()
+        fp = build()
+        bf = build("mixed_bf16")
+        init = float(fp.score_value) if fp.score_value == fp.score_value \
+            else None
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        ds = DataSet(x, y)
+        init = float(build().score(ds))
+        fp.fit(x, y, epochs=3, batch_size=16, shuffle=False)
+        bf.fit(x, y, epochs=3, batch_size=16, shuffle=False)
+        d, b = float(fp.score(ds)), float(bf.score(ds))
+        assert d < 0.8 * init and b < 0.8 * init
+        assert abs(d - b) <= 0.05 * init, (init, d, b)
+
+    def test_fused_multi_step_matches_per_step(self):
+        x, y = make_data()
+        a = build("mixed_bf16")
+        a.fit(x, y, epochs=2, batch_size=16, shuffle=False)
+        b = build("mixed_bf16")
+        b.fit(x, y, epochs=2, batch_size=16, shuffle=False,
+              steps_per_execution=4)
+        for p, q in zip(jax.tree_util.tree_leaves(a.params),
+                        jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(q),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_output_and_loss_stay_fp32(self):
+        net = build("mixed_bf16")
+        x, y = make_data(16)
+        out = net.output(x)
+        assert out.dtype == jnp.float32
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        assert np.isfinite(net.score(DataSet(x, y)))
+
+    def test_embedding_ids_survive_mixed_policy(self):
+        # float-carried token ids above 256 would be corrupted by a
+        # bf16 input cast — they must reach the embedding uncast
+        from deeplearning4j_tpu.nn.layers import EmbeddingLayer
+        b = (NeuralNetConfiguration.builder().seed(3)
+             .dtype_policy("mixed_bf16").list()
+             .layer(EmbeddingLayer(n_in=512, n_out=8))
+             .layer(OutputLayer(n_in=8, n_out=4, activation="softmax",
+                                loss="mcxent")))
+        conf = b.set_input_type(InputType.recurrent(512)).build()
+        net = MultiLayerNetwork(conf).init()
+        ids = jnp.asarray([[300, 301], [511, 2]], jnp.float32)
+        out_hi = np.asarray(net.output(ids))
+        # neighbouring ids must produce DIFFERENT embeddings (a bf16
+        # round would collapse 300 and 301 onto the same row)
+        assert not np.allclose(out_hi[0, 0], out_hi[0, 1])
+
+    def test_frozen_embedding_ids_survive_mixed_policy(self):
+        # transfer-learning pattern: a FrozenLayer-wrapped embedding
+        # must still be recognized as an id consumer (the guard
+        # unwraps wrappers — nn/scan_stack.consumes_token_ids)
+        from deeplearning4j_tpu.nn.layers import EmbeddingLayer
+        from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
+        from deeplearning4j_tpu.nn import scan_stack
+        emb = EmbeddingLayer(n_in=512, n_out=8)
+        assert scan_stack.consumes_token_ids(emb)
+        assert scan_stack.consumes_token_ids(FrozenLayer(layer=emb))
+        assert not scan_stack.consumes_token_ids(
+            DenseLayer(n_in=8, n_out=8))
+
+    def test_graph_container_mixed_trains(self):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        conf = (ComputationGraphConfiguration.graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_in=16, n_out=16,
+                                            activation="tanh",
+                                            updater=Adam(0.01)), "in")
+                .add_layer("out", OutputLayer(n_in=16, n_out=4,
+                                              activation="softmax",
+                                              loss="mcxent",
+                                              updater=Adam(0.01)), "d1")
+                .set_outputs("out")
+                .dtype_policy("mixed_bf16")
+                .build())
+        net = ComputationGraph(conf).init()
+        x, y = make_data()
+        net.fit(x, y, epochs=2, batch_size=16)
+        for leaf in jax.tree_util.tree_leaves(net.params):
+            assert leaf.dtype == jnp.float32
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        assert np.isfinite(net.score(DataSet(x, y)))
+
+
+# ------------------------------------------------------- wire accounting
+class TestWireDtypes:
+    def test_exchange_wire_bytes_grad_dtype(self):
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+        params = {"0": {"W": jnp.zeros((8, 8)), "b": jnp.zeros((8,))}}
+        dense32 = gs.exchange_wire_bytes(params, "dense")
+        dense16 = gs.exchange_wire_bytes(params, "dense",
+                                         grad_dtype=jnp.bfloat16)
+        assert dense16 == dense32 / 2
+        # threshold wire is int8 regardless of the grad dtype
+        t = gs.exchange_wire_bytes(params, "threshold", n_workers=4)
+        assert t == 72 * 1 + 8.0
+
+    def test_exchange_jaxpr_dense_carries_real_dtype(self):
+        from benchtools.hlo_cost import collective_table
+        from deeplearning4j_tpu.parallel import gradient_sharing as gs
+        params = {"0": {"W": jnp.zeros((16, 16))}}
+        j32 = gs.exchange_jaxpr(params, "dense", 4)
+        j16 = gs.exchange_jaxpr(params, "dense", 4,
+                                grad_dtype=jnp.bfloat16)
+        b32 = collective_table(j32)["comm_bytes_per_step"]
+        b16 = collective_table(j16)["comm_bytes_per_step"]
+        assert b16 == b32 / 2
+
+    def test_trainer_mixed_threshold_parity(self):
+        # end-to-end: mixed-precision threshold gradient sharing on the
+        # default (bucketed) path — bf16 grads upcast before the EF
+        # encode; the trajectory stays in the dense band and the
+        # residual/master state stay fp32
+        from deeplearning4j_tpu.parallel.mesh import device_mesh
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        x, y = make_data(320)
+        ds = DataSet(x, y)
+        init = float(build().score(ds))
+        # 50 sync steps at B=32 — the verify.sh gradient-sharing
+        # smoke's regime, where the error-feedback band is calibrated
+        dense = build("mixed_bf16")
+        ParallelTrainer(dense, device_mesh(), mode="sync").fit(
+            x, y, epochs=5, batch_size=32)
+        thr = build("mixed_bf16")
+        tr = ParallelTrainer(thr, device_mesh(), mode="sync",
+                             gradient_sharing="threshold")
+        tr.fit(x, y, epochs=5, batch_size=32)
+        d, t = float(dense.score(ds)), float(thr.score(ds))
+        assert d < 0.8 * init and t < 0.8 * init
+        assert abs(t - d) <= 0.35 * init, (init, d, t)
+        for leaf in jax.tree_util.tree_leaves(thr.params):
+            assert leaf.dtype == jnp.float32

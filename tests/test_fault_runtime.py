@@ -251,6 +251,38 @@ class TestInterruptResumeParity:
         assert trees_bitwise(ref.params, net2.params)
         assert trees_bitwise(ref.updater_state, net2.updater_state)
 
+    def test_mixed_bf16_policy(self, tmpdir_):
+        # mixed-precision contract: bf16 compute, fp32 master params /
+        # updater state — checkpoints are layout-identical to fp32
+        # training and resume must rebuild the SAME mixed program
+        # (fault/state.py records the ACTIVE policy in meta, since it
+        # may come from an arg/env, not the conf)
+        from deeplearning4j_tpu.nd.dtype import mixed_bf16
+        x, y = make_data()
+
+        def build_mixed():
+            net = build_net(depth=3)
+            return MultiLayerNetwork(net.conf,
+                                     dtype_policy=mixed_bf16()).init()
+
+        ref = build_mixed()
+        assert ref.dtype.is_mixed
+        ref.fit(make_iter(x, y), epochs=2)
+        for leaf in jax.tree_util.tree_leaves(ref.params):
+            assert np.asarray(leaf).dtype == np.float32
+
+        net = build_mixed()
+        it = make_iter(x, y)
+        interrupt_fit(net, it, kill_at=7, freq=3, ckpt_dir=tmpdir_)
+        it2 = make_iter(x, y)
+        net2, meta = fault.resume(tmpdir_, iterator=it2)
+        assert meta.get("dtype_policy", {}).get("compute_dtype") == \
+            "bfloat16"
+        assert net2.dtype.is_mixed     # policy came from meta, not conf
+        net2.fit(it2, epochs=2 - net2.epoch_count)
+        assert trees_bitwise(ref.params, net2.params)
+        assert trees_bitwise(ref.updater_state, net2.updater_state)
+
     def test_scan_layers_stack(self, tmpdir_):
         # deep homogeneous stack: params/updater ride the fit as ONE
         # ``stacked::`` entry inside jit, per-layer keys at the

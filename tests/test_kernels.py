@@ -217,3 +217,286 @@ class TestFlashFallbackSeam:
         layer, params, x = self._layer(True)
         with pytest.raises(RuntimeError, match="kernel exploded"):
             layer.forward(params, {}, x)
+
+
+class TestKernelGate:
+    """`kernels_enabled()` — the DL4J_PALLAS_KERNELS switch: off/on
+    spellings, TPU-only default, typo'd values loud."""
+
+    def test_env_spellings(self, monkeypatch):
+        from deeplearning4j_tpu.kernels import kernels_enabled
+        for v in ("0", "off", "false", "no"):
+            monkeypatch.setenv("DL4J_PALLAS_KERNELS", v)
+            assert kernels_enabled() is False
+        for v in ("1", "on", "true", "yes"):
+            monkeypatch.setenv("DL4J_PALLAS_KERNELS", v)
+            assert kernels_enabled() is True
+        monkeypatch.setenv("DL4J_PALLAS_KERNELS", "maybe")
+        with pytest.raises(ValueError):
+            kernels_enabled()
+
+    def test_default_is_backend_gated(self, monkeypatch):
+        from deeplearning4j_tpu.kernels import kernels_enabled
+        monkeypatch.delenv("DL4J_PALLAS_KERNELS", raising=False)
+        assert kernels_enabled() is (jax.default_backend() == "tpu")
+
+
+class TestLayerNormKernel:
+    """Fused LayerNorm(+residual) vs the jnp reference
+    (`layer_norm_reference`) — interpret mode on CPU."""
+
+    def _data(self, D=24, dtype=jnp.float32):
+        k = jax.random.PRNGKey(0)
+        x = jax.random.normal(k, (2, 40, D), dtype)
+        g = (jax.random.normal(jax.random.fold_in(k, 1), (D,), dtype)
+             + jnp.asarray(1.0, dtype))
+        b = jax.random.normal(jax.random.fold_in(k, 2), (D,), dtype)
+        return x, g, b
+
+    def test_forward_parity(self):
+        from deeplearning4j_tpu.kernels.layernorm import layer_norm
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            layer_norm_reference)
+        x, g, b = self._data()
+        got = layer_norm(x, g, b, 1e-5, 256, True)
+        want = layer_norm_reference(x, g, b, 1e-5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("R", [3, 8, 130])  # ragged row padding
+    def test_ragged_rows(self, R):
+        from deeplearning4j_tpu.kernels.layernorm import layer_norm
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            layer_norm_reference)
+        k = jax.random.PRNGKey(3)
+        x = jax.random.normal(k, (R, 16))
+        g = jnp.ones((16,))
+        b = jnp.zeros((16,))
+        got = layer_norm(x, g, b, 1e-5, 64, True)
+        want = layer_norm_reference(x, g, b, 1e-5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_backward_parity(self):
+        from deeplearning4j_tpu.kernels.layernorm import layer_norm
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            layer_norm_reference)
+        x, g, b = self._data()
+
+        def lk(x_, g_, b_):
+            return jnp.sum(layer_norm(x_, g_, b_, 1e-5, 256, True) ** 2)
+
+        def lr(x_, g_, b_):
+            return jnp.sum(layer_norm_reference(x_, g_, b_, 1e-5) ** 2)
+
+        ga = jax.grad(lk, argnums=(0, 1, 2))(x, g, b)
+        gb = jax.grad(lr, argnums=(0, 1, 2))(x, g, b)
+        for a, c in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_residual_fusion_parity(self):
+        from deeplearning4j_tpu.kernels.layernorm import (
+            residual_layer_norm)
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            layer_norm_reference)
+        x, g, b = self._data()
+        h = jax.random.normal(jax.random.PRNGKey(9), x.shape)
+        s, y = residual_layer_norm(x, h, g, b, 1e-5, 256, True)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(x + h),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(layer_norm_reference(x + h, g, b,
+                                                           1e-5)),
+            rtol=1e-6, atol=1e-6)
+
+        def lk(x_, h_):
+            s_, y_ = residual_layer_norm(x_, h_, g, b, 1e-5, 256, True)
+            return jnp.sum(y_ ** 2) + jnp.sum(s_ ** 3)
+
+        def lr(x_, h_):
+            s_ = x_ + h_
+            return (jnp.sum(layer_norm_reference(s_, g, b, 1e-5) ** 2)
+                    + jnp.sum(s_ ** 3))
+
+        ga = jax.grad(lk, argnums=(0, 1))(x, h)
+        gb = jax.grad(lr, argnums=(0, 1))(x, h)
+        for a, c in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_bf16_activations(self):
+        # mixed_bf16 policy: bf16 in/out, fp32 row statistics inside
+        from deeplearning4j_tpu.kernels.layernorm import layer_norm
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            layer_norm_reference)
+        x, g, b = self._data(dtype=jnp.bfloat16)
+        got = layer_norm(x, g, b, 1e-5, 256, True)
+        assert got.dtype == jnp.bfloat16
+        want = layer_norm_reference(x, g, b, 1e-5)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_layer_dispatch_identical_on_off(self, monkeypatch):
+        # the DL4J_PALLAS_KERNELS=0 fallback and the kernel path must
+        # agree through the LayerNormalization layer API
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            LayerNormalization)
+        layer = LayerNormalization(n_out=16)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 12, 16))
+        monkeypatch.setenv("DL4J_PALLAS_KERNELS", "0")
+        off, _ = layer.forward(params, {}, x)
+        monkeypatch.setenv("DL4J_PALLAS_KERNELS", "1")
+        on, _ = layer.forward(params, {}, x)
+        np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_transformer_block_fused_residual_on_off(self, monkeypatch):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.transformer import (
+            TransformerEncoderBlock)
+        blk = TransformerEncoderBlock(n_in=16, n_heads=2, use_flash=False)
+        blk.set_n_in(InputType.recurrent(16))
+        params = blk.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 12, 16))
+        monkeypatch.setenv("DL4J_PALLAS_KERNELS", "0")
+        off, _ = blk.forward(params, {}, x)
+        monkeypatch.setenv("DL4J_PALLAS_KERNELS", "1")
+        on, _ = blk.forward(params, {}, x)
+        np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestFusedAdamKernel:
+    """One-kernel packed-run Adam (kernels/fused_adam.py) vs the
+    per-leaf jnp path — BIT-comparable inside jit (both sides compile;
+    the containers always run the updater inside the jitted step)."""
+
+    def _run(self, seed=3, gdtype=jnp.float32):
+        rng = np.random.default_rng(seed)
+        params = {"W": jnp.asarray(rng.standard_normal((4, 16, 16)),
+                                   jnp.float32),
+                  "b": jnp.asarray(rng.standard_normal((4, 16)),
+                                   jnp.float32)}
+        grads = {k: jnp.asarray(rng.standard_normal(v.shape), gdtype)
+                 for k, v in params.items()}
+        state = {k: {"m": jnp.asarray(rng.standard_normal(v.shape),
+                                      jnp.float32) * 0.1,
+                     "v": jnp.abs(jnp.asarray(
+                         rng.standard_normal(v.shape), jnp.float32))
+                     * 0.01}
+                 for k, v in params.items()}
+        return params, grads, state
+
+    @pytest.mark.parametrize("gdtype", [jnp.float32, jnp.bfloat16])
+    def test_bit_parity_vs_jnp_path(self, gdtype):
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.kernels.fused_adam import (
+            adam_update_packed)
+        upd = Adam(0.01)
+        params, grads, state = self._run(gdtype=gdtype)
+
+        @jax.jit
+        def kern(p, g, s):
+            return adam_update_packed(upd, p, g, s, 7, interpret=True)
+
+        @jax.jit
+        def ref(p, g, s):
+            out_p, out_s = {}, {}
+            for pk, gg in g.items():
+                gg = gg.astype(p[pk].dtype)
+                delta, s2 = upd.apply(gg, s[pk], 7)
+                out_p[pk] = p[pk] - delta.astype(p[pk].dtype)
+                out_s[pk] = s2
+            return out_p, out_s
+
+        kp, ks = kern(params, grads, state)
+        rp, rs = ref(params, grads, state)
+        for pk in params:
+            assert np.array_equal(np.asarray(kp[pk]), np.asarray(rp[pk]))
+            assert np.array_equal(np.asarray(ks[pk]["m"]),
+                                  np.asarray(rs[pk]["m"]))
+            assert np.array_equal(np.asarray(ks[pk]["v"]),
+                                  np.asarray(rs[pk]["v"]))
+            assert kp[pk].dtype == jnp.float32    # fp32 master
+
+    def test_schedule_lr(self):
+        from deeplearning4j_tpu.common.schedules import ExponentialSchedule
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.kernels.fused_adam import (
+            adam_update_packed)
+        upd = Adam(ExponentialSchedule(0.01, 0.9))
+        params, grads, state = self._run()
+        kp, _ = jax.jit(lambda p, g, s: adam_update_packed(
+            upd, p, g, s, 5, interpret=True))(params, grads, state)
+        rp = {}
+        for pk, gg in grads.items():
+            delta, _ = upd.apply(gg, state[pk], 5)
+            rp[pk] = params[pk] - delta
+        for pk in params:
+            np.testing.assert_allclose(np.asarray(kp[pk]),
+                                       np.asarray(rp[pk]),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_eligibility(self, monkeypatch):
+        from deeplearning4j_tpu.common.updaters import Adam, Nadam, Sgd
+        from deeplearning4j_tpu.kernels.fused_adam import (
+            fused_adam_eligible)
+        monkeypatch.setenv("DL4J_PALLAS_KERNELS", "1")
+        assert fused_adam_eligible(Adam(0.01))
+        assert not fused_adam_eligible(Nadam(0.01))   # different math
+        assert not fused_adam_eligible(Sgd(0.01))
+        monkeypatch.setenv("DL4J_PALLAS_KERNELS", "0")
+        assert not fused_adam_eligible(Adam(0.01))
+
+    def test_container_on_off_bit_identical(self, monkeypatch):
+        # whole train loop: fused-Adam kernel vs jnp path over a packed
+        # deep-MLP run — params AND updater state bit-identical
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        def run(env):
+            monkeypatch.setenv("DL4J_PALLAS_KERNELS", env)
+            b = (NeuralNetConfiguration.builder().seed(7)
+                 .updater(Adam(0.01)).list())
+            for _ in range(4):
+                b = b.layer(DenseLayer(n_in=16, n_out=16,
+                                       activation="tanh"))
+            conf = (b.layer(OutputLayer(n_in=16, n_out=4,
+                                        activation="softmax",
+                                        loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(16)).build())
+            net = MultiLayerNetwork(conf).init()
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((32, 16)).astype(np.float32)
+            y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+            net.fit(x, y, epochs=2, batch_size=16, shuffle=False)
+            return net
+
+        on, off = run("1"), run("0")
+        for a, b in zip(jax.tree_util.tree_leaves(on.params),
+                        jax.tree_util.tree_leaves(off.params)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(on.updater_state),
+                        jax.tree_util.tree_leaves(off.updater_state)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestFlashBf16:
+    def test_flash_attention_bf16_inputs(self):
+        # mixed_bf16 policy feeds the attention kernel bf16 q/k/v —
+        # fp32 accumulation inside, parity vs the XLA path in bf16 band
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (2, 64, 2, 16), jnp.bfloat16)
+                   for kk in ks)
+        got = flash_attention(q, k, v, True, 32, 32, True)
+        assert got.dtype == jnp.bfloat16
+        want = _xla_attention(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=2e-2, atol=2e-2)
