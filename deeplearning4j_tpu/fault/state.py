@@ -158,6 +158,11 @@ def capture_training_state(model, *, iterator=None, normalizer=None,
         # arg or env override, not the conf) — resume must rebuild the
         # same mixed-precision program or bit-parity breaks
         "dtype_policy": model.dtype.to_dict(),
+        # the ACTIVE diagnostics config, same rationale: an arg/env-
+        # selected watchdog (monitor/diagnostics.py) must survive
+        # resume — under the `skip` policy it is trajectory-bearing
+        "diagnostics": (None if getattr(model, "diagnostics", None) is None
+                        else model.diagnostics.to_dict()),
         "iteration_count": int(model.iteration_count if step is None
                                else step),
         "epoch_count": int(model.epoch_count if epoch is None else epoch),
@@ -192,17 +197,23 @@ def build_model(meta: Dict[str, Any]):
     if meta.get("dtype_policy") is not None:
         from deeplearning4j_tpu.nd.dtype import as_policy
         policy = as_policy(meta["dtype_policy"])
+    diagnostics = None
+    if meta.get("diagnostics") is not None:
+        # the ACTIVE diagnostics config (arg/env-selected watchdogs
+        # included) — DL4J_DIAGNOSTICS still wins at resolution time
+        from deeplearning4j_tpu.monitor.diagnostics import as_diagnostics
+        diagnostics = as_diagnostics(meta["diagnostics"])
     if meta["model_type"] == "ComputationGraph":
         from deeplearning4j_tpu.nn.graph import (
             ComputationGraph, ComputationGraphConfiguration)
         return ComputationGraph(
             ComputationGraphConfiguration.from_dict(meta["configuration"]),
-            dtype_policy=policy)
+            dtype_policy=policy, diagnostics=diagnostics)
     from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     return MultiLayerNetwork(
         MultiLayerConfiguration.from_dict(meta["configuration"]),
-        dtype_policy=policy)
+        dtype_policy=policy, diagnostics=diagnostics)
 
 
 def _deep_merge(base, overlay):

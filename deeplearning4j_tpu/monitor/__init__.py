@@ -53,6 +53,13 @@ from deeplearning4j_tpu.monitor.collectors import (
     record_transfer as _record_transfer_impl,
 )
 from deeplearning4j_tpu.monitor.listener import MonitorListener, bind_master_stats
+from deeplearning4j_tpu.monitor import diagnostics
+from deeplearning4j_tpu.monitor.diagnostics import (
+    Diagnostics,
+    DiagnosticsConfig,
+    NonFiniteGradientsError,
+    resolve_diagnostics,
+)
 from deeplearning4j_tpu.monitor import xprof
 from deeplearning4j_tpu.monitor.xprof import (
     ProfilerCapture,
@@ -68,6 +75,8 @@ __all__ = [
     "span", "record_transfer", "bind_master_stats", "attach_master_stats",
     "extra_listeners", "compile_collector", "memory_collector",
     "xprof", "ProfilerCapture", "roofline", "publish_cost_report",
+    "diagnostics", "Diagnostics", "DiagnosticsConfig",
+    "NonFiniteGradientsError", "resolve_diagnostics",
 ]
 
 

@@ -1,0 +1,596 @@
+"""In-graph model-internals diagnostics: sync-free per-layer training
+stats, a device-side non-finite watchdog, and the real feed behind the
+training UI / StatsListener.
+
+The reference's signature observability feature is the StatsListener +
+training UI (per-layer parameter/gradient/update magnitudes, update:
+parameter ratios, activation statistics — `BaseStatsListener.java`
+:286-544 and the TrainModule charts). Reproducing it host-side would
+mean one device→host sync per param leaf per report and — worse — an
+EXTRA eager backward pass just to see gradients, because the real
+gradients/updates live inside the fused jitted train step. TensorFlow's
+system paper (arXiv:1605.08695) makes the argument this module follows:
+training-health introspection must be part of the dataflow program
+itself; and arXiv:2606.15870 names silent numeric failure at scale as a
+defining resilience constraint — the device-side watchdog below is that
+defense.
+
+Design:
+
+- **Stats are auxiliary outputs of the train step.** Both containers
+  (and the parallel trainers / gradient-sharing step programs) compute
+  per-layer fp32 statistics of the step's REAL gradients, applied
+  updates, parameters and activations inside the jitted program and
+  return them as one packed f32 vector (`Diagnostics.collect`). The
+  trajectory is bit-identical to diagnostics-off — aux outputs only —
+  except when the watchdog's explicit ``skip`` policy fires.
+- **`stacked::` packed runs stay packed.** Per-layer stats of a run
+  are axis-0-preserving reductions over the stacked entry (one [R]
+  vector per stat), keyed back to per-layer names at the boundary —
+  the same contract checkpoints follow (nn/scan_stack.py): stats are
+  independent of the scan configuration.
+- **One batched transfer per report.** The packed vector is a single
+  device array; `Diagnostics.read` fetches it with ONE `np.asarray`
+  (counted on the ``jax_transfers_total{direction="d2h"}`` counter).
+  Fused ``steps_per_execution>1`` groups stack per-step vectors in the
+  `lax.scan` ys, still one transfer per drain. Off-cadence steps are
+  never read — zero additional transfers.
+- **Watchdog** (``warn | skip | halt``): per-layer is-finite flags ride
+  the stats vector. ``warn`` logs + counts; ``skip`` discards the bad
+  update IN-GRAPH (`jnp.where` on the is-finite reduction over the
+  step's gradients/updates — params, updater state, exchange residuals
+  all keep their previous values) and counts it; ``halt`` raises
+  `NonFiniteGradientsError` naming the offending layer keys. Host-side
+  actions happen at report cadence (default: every step).
+
+Resolution mirrors ``DL4J_SCAN_LAYERS`` / ``DL4J_DTYPE_POLICY``: the
+``DL4J_DIAGNOSTICS`` env override wins (``0/off`` force-disables,
+``1/on`` enables the default config, ``warn|skip|halt`` enables with
+that watchdog policy), then the container's ``diagnostics=`` argument,
+then the configuration's ``diagnostics`` field, then off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_ENV_VAR = "DL4J_DIAGNOSTICS"
+
+WATCHDOG_POLICIES = ("warn", "skip", "halt")
+
+# per-(layer, param) statistics in the packed vector
+PARAM_STATS = ("grad_mm", "grad_l2", "upd_mm", "upd_l2",
+               "param_mm", "param_l2", "ratio")
+# per-layer activation statistics
+ACT_STATS = ("act_mean", "act_std", "act_dead")
+
+
+class NonFiniteGradientsError(RuntimeError):
+    """Raised by the ``halt`` watchdog policy: the step produced
+    non-finite gradients/updates. Carries the offending layer keys and
+    the iteration."""
+
+    def __init__(self, layer_keys, iteration):
+        self.layer_keys = sorted(str(k) for k in layer_keys)
+        self.iteration = int(iteration)
+        super().__init__(
+            f"non-finite gradients/updates at iteration {iteration} "
+            f"(layers {self.layer_keys}) — watchdog policy 'halt'")
+
+
+@dataclasses.dataclass(frozen=True)
+class DiagnosticsConfig:
+    """Knobs of the in-graph diagnostics.
+
+    activation_stats: per-layer activation mean/std/dead-unit fraction
+        (dead = exactly-zero fraction, the post-ReLU dead-unit signal).
+    histograms: fixed-bin in-graph parameter histograms
+        (``histogram_bins`` bins over ``[-histogram_range,
+        histogram_range]`` — fixed bins keep the program static).
+    watchdog: None | "warn" | "skip" | "halt".
+    report_frequency: host readback cadence in iterations (the listener
+        cadence); the in-graph side always computes (and ``skip``
+        always gates) — only VISIBILITY follows the cadence.
+    """
+
+    activation_stats: bool = True
+    histograms: bool = False
+    histogram_bins: int = 20
+    histogram_range: float = 1.0
+    watchdog: Optional[str] = None
+    report_frequency: int = 1
+
+    def __post_init__(self):
+        if self.watchdog is not None and self.watchdog not in WATCHDOG_POLICIES:
+            raise ValueError(
+                f"watchdog must be one of {WATCHDOG_POLICIES} (or None); "
+                f"got {self.watchdog!r}")
+        if self.report_frequency < 1:
+            raise ValueError(
+                f"report_frequency must be >= 1, got {self.report_frequency}")
+        if self.histogram_bins < 2:
+            raise ValueError(
+                f"histogram_bins must be >= 2, got {self.histogram_bins}")
+        if not self.histogram_range > 0:
+            raise ValueError(
+                f"histogram_range must be > 0, got {self.histogram_range}")
+
+    # ----------------------------------------------------------- serde
+    def to_dict(self) -> dict:
+        return {
+            "activation_stats": self.activation_stats,
+            "histograms": self.histograms,
+            "histogram_bins": self.histogram_bins,
+            "histogram_range": self.histogram_range,
+            "watchdog": self.watchdog,
+            "report_frequency": self.report_frequency,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "DiagnosticsConfig":
+        return DiagnosticsConfig(
+            activation_stats=bool(d.get("activation_stats", True)),
+            histograms=bool(d.get("histograms", False)),
+            histogram_bins=int(d.get("histogram_bins", 20)),
+            histogram_range=float(d.get("histogram_range", 1.0)),
+            watchdog=d.get("watchdog"),
+            report_frequency=int(d.get("report_frequency", 1)),
+        )
+
+
+def as_diagnostics(spec) -> Optional[DiagnosticsConfig]:
+    """Coerce a user-facing spec to a DiagnosticsConfig (or None):
+    None/False → off, True/"on"/"default" → defaults, a watchdog policy
+    name → defaults with that policy, a dict → serde form, a config →
+    itself."""
+    if spec is None or spec is False:
+        return None
+    if spec is True:
+        return DiagnosticsConfig()
+    if isinstance(spec, DiagnosticsConfig):
+        return spec
+    if isinstance(spec, str):
+        v = spec.strip().lower()
+        if v in ("1", "on", "true", "yes", "default"):
+            return DiagnosticsConfig()
+        if v in ("0", "off", "false", "no"):
+            return None
+        if v in WATCHDOG_POLICIES:
+            return DiagnosticsConfig(watchdog=v)
+        raise ValueError(
+            f"cannot interpret {spec!r} as a diagnostics spec; known "
+            f"names: on/off/default or a watchdog policy "
+            f"{WATCHDOG_POLICIES}")
+    if isinstance(spec, dict):
+        return DiagnosticsConfig.from_dict(spec)
+    raise TypeError(f"cannot interpret {spec!r} as a diagnostics spec")
+
+
+_ENV_OFF = object()  # sentinel: env explicitly forces diagnostics OFF
+
+
+def env_diagnostics():
+    """The ``DL4J_DIAGNOSTICS`` override: None when unset, the `_ENV_OFF`
+    sentinel when explicitly disabled, else a DiagnosticsConfig.
+    Unknown spellings raise (a typo'd fleet A/B toggle must not
+    silently no-op)."""
+    import os
+    env = os.environ.get(_ENV_VAR)
+    if env is None or not env.strip():
+        return None
+    v = env.strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return _ENV_OFF
+    if v in ("1", "on", "true", "yes"):
+        return DiagnosticsConfig()
+    if v in WATCHDOG_POLICIES:
+        return DiagnosticsConfig(watchdog=v)
+    raise ValueError(
+        f"{_ENV_VAR}={env!r}: expected 0/off/1/on or a watchdog policy "
+        f"{WATCHDOG_POLICIES}")
+
+
+def resolve_diagnostics(explicit=None, conf=None) -> Optional[DiagnosticsConfig]:
+    """Container-side resolution: DL4J_DIAGNOSTICS env override wins
+    (including force-off), then the explicit constructor argument, then
+    the configuration's ``diagnostics`` field, then off."""
+    forced = env_diagnostics()
+    if forced is _ENV_OFF:
+        return None
+    if forced is not None:
+        return forced
+    e = as_diagnostics(explicit)
+    if e is not None:
+        return e
+    return as_diagnostics(getattr(conf, "diagnostics", None))
+
+
+# ------------------------------------------------------- in-graph helpers
+def _f32(a):
+    a = jnp.asarray(a)
+    return a if a.dtype == jnp.float32 else a.astype(jnp.float32)
+
+
+def activation_stats(h):
+    """[mean, std, dead-fraction] of one layer's output, computed fp32
+    regardless of the activation dtype (the mixed_bf16 rule: statistics
+    never accumulate in bf16)."""
+    h32 = _f32(h)
+    return jnp.stack([jnp.mean(h32), jnp.std(h32),
+                      jnp.mean((h32 == 0).astype(jnp.float32))])
+
+
+def keep_finite(ok, new_tree, old_tree):
+    """The watchdog ``skip`` gate: elementwise select on the step-global
+    is-finite flag — when the step was finite the select returns the
+    new values BITWISE, so enabling the watchdog never perturbs a
+    healthy trajectory."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o.astype(n.dtype)), new_tree, old_tree)
+
+
+def _members_of(lk: str) -> List[str]:
+    from deeplearning4j_tpu.nn import scan_stack
+    if scan_stack.is_run_key(lk):
+        return scan_stack.run_members(lk)
+    return [lk]
+
+
+def _reduce_axes(leaf, n_members: int):
+    """Reduction axes keeping a packed run's leading layer axis (the
+    "per-layer stats without unpacking" contract): all axes for a
+    singleton, axes 1.. for a stacked entry."""
+    if n_members == 1:
+        return None
+    return tuple(range(1, jnp.ndim(leaf))) or None
+
+
+def _as_members(v, n_members: int):
+    """A stat value as a list of per-member scalars."""
+    if n_members == 1:
+        return [v]
+    return [v[j] for j in range(n_members)]
+
+
+class Diagnostics:
+    """Per-model diagnostics engine: trace-time stat packing + host-side
+    readback/watchdog, sharing one DiagnosticsConfig.
+
+    Layouts (the static key list describing the packed vector) are kept
+    per program family (``name``): the containers' fit step ("fit"),
+    the gradient-sharing exchange step ("exchange" — update/param stats
+    only; raw grads live inside the VJP hooks there), the pipeline
+    trainer ("pipeline"). A layout is established the first time the
+    matching program traces `collect` and reused by every `read`."""
+
+    def __init__(self, config: DiagnosticsConfig):
+        self.config = config
+        self.layouts: Dict[str, List[Tuple[str, int]]] = {}
+        self.nonfinite_total = 0
+        self.skipped_total = 0
+        self.last: Optional[dict] = None
+
+    # ------------------------------------------------------- trace time
+    def collect(self, name: str, *, params_new, params_old, loss,
+                grads=None, acts=None, extra_finite=None, axis_name=None):
+        """Build the packed diag vector for ONE step (called at trace
+        time inside the jitted step). Trees may contain ``stacked::``
+        run entries — never unpacked; per-layer stats use axis-0-
+        preserving reductions.
+
+        grads: post-normalization gradient tree (None on the exchange
+        paths, where gradients live inside the VJP hooks — update stats
+        are post-exchange by construction there).
+        acts: {tree_key: [3] or [R, 3]} activation stats.
+        extra_finite: additional tree (e.g. the error-feedback residual)
+        folded into the per-layer finite flags.
+        axis_name: shard_map data axis — per-replica non-finite counts
+        are psum'd so the flags (and the skip gate) are global.
+
+        Returns (dv, ok): dv is ``{"v": flat f32 vector}``, ok the
+        step-global is-finite bool (the ``skip`` gate input)."""
+        cfg = self.config
+        entries: Dict[str, Any] = {}
+        layer_bad: Dict[str, Any] = {}
+
+        def add_bad(mk, v):
+            layer_bad[mk] = layer_bad.get(mk, jnp.float32(0.0)) + v
+
+        for lk in params_new:
+            members = _members_of(lk)
+            R = len(members)
+            for pn in params_new[lk]:
+                p_new = _f32(params_new[lk][pn])
+                p_old = _f32(params_old[lk][pn])
+                axes = _reduce_axes(p_new, R)
+                upd = p_old - p_new
+                stats = {
+                    "param_mm": jnp.mean(jnp.abs(p_new), axis=axes),
+                    "param_l2": jnp.sqrt(jnp.sum(p_new * p_new, axis=axes)),
+                    "upd_mm": jnp.mean(jnp.abs(upd), axis=axes),
+                    "upd_l2": jnp.sqrt(jnp.sum(upd * upd, axis=axes)),
+                }
+                stats["ratio"] = stats["upd_mm"] / (stats["param_mm"] + 1e-12)
+                # finite flags watch the UPDATE as well as the gradient:
+                # an inf learning rate (or poisoned updater state) turns
+                # finite gradients into a non-finite update — the skip
+                # gate must fire on either
+                bad = jnp.sum((~jnp.isfinite(upd)).astype(jnp.float32),
+                              axis=axes)
+                if grads is not None:
+                    g = _f32(grads[lk][pn])
+                    stats["grad_mm"] = jnp.mean(jnp.abs(g), axis=axes)
+                    stats["grad_l2"] = jnp.sqrt(jnp.sum(g * g, axis=axes))
+                    bad = bad + jnp.sum(
+                        (~jnp.isfinite(g)).astype(jnp.float32), axis=axes)
+                if extra_finite is not None and pn in extra_finite.get(lk, {}):
+                    e = _f32(extra_finite[lk][pn])
+                    bad = bad + jnp.sum(
+                        (~jnp.isfinite(e)).astype(jnp.float32), axis=axes)
+                for st, v in stats.items():
+                    for j, mk in enumerate(_as_members(v, R)):
+                        entries[f"{st}.{members[j]}_{pn}"] = mk
+                for j, b in enumerate(_as_members(bad, R)):
+                    add_bad(members[j], b)
+                if cfg.histograms:
+                    lo, hi = -cfg.histogram_range, cfg.histogram_range
+
+                    def hist(a):
+                        c, _ = jnp.histogram(
+                            jnp.reshape(a, (-1,)), bins=cfg.histogram_bins,
+                            range=(lo, hi))
+                        return c.astype(jnp.float32)
+
+                    if R == 1:
+                        hs = [hist(p_new)]
+                    else:
+                        hs = list(jax.vmap(hist)(p_new))
+                    for j, hv in enumerate(hs):
+                        entries[f"hist.{members[j]}_{pn}"] = hv
+
+        if acts:
+            for lk, sv in acts.items():
+                members = _members_of(lk)
+                sv = _f32(sv)
+                for j, mk in enumerate(members):
+                    row = sv if len(members) == 1 and sv.ndim == 1 else sv[j]
+                    for si, st in enumerate(ACT_STATS):
+                        entries[f"{st}.{mk}"] = row[si]
+
+        total_bad = jnp.float32(0.0)
+        for mk in layer_bad:
+            total_bad = total_bad + layer_bad[mk]
+        loss_bad = (~jnp.isfinite(_f32(loss))).astype(jnp.float32)
+        if jnp.ndim(loss_bad):
+            loss_bad = jnp.sum(loss_bad)
+        total_bad = total_bad + loss_bad
+        if axis_name is not None:
+            # per-replica counts → global flags (one tiny psum; the
+            # skip gate must fire on EVERY replica or params diverge)
+            stacked_bad = jnp.stack(
+                [layer_bad[mk] for mk in sorted(layer_bad)] + [total_bad])
+            stacked_bad = jax.lax.psum(stacked_bad, axis_name)
+            for i, mk in enumerate(sorted(layer_bad)):
+                layer_bad[mk] = stacked_bad[i]
+            total_bad = stacked_bad[-1]
+        for mk, b in layer_bad.items():
+            entries[f"finite.{mk}"] = (b == 0).astype(jnp.float32)
+        # the loss can be the only non-finite value (saturated logits
+        # can yield a NaN loss with finite gradients) — flag it under
+        # its own key so halt/warn name SOMETHING
+        entries["finite.<loss>"] = (loss_bad == 0).astype(jnp.float32)
+        entries["nonfinite"] = (total_bad > 0).astype(jnp.float32)
+        ok = total_bad == 0
+
+        keys = sorted(entries)
+        layout: List[Tuple[str, int]] = []
+        pieces = []
+        for k in keys:
+            v = jnp.reshape(_f32(entries[k]), (-1,))
+            layout.append((k, int(v.shape[0])))
+            pieces.append(v)
+        self.layouts[name] = layout
+        vec = jnp.concatenate(pieces) if pieces \
+            else jnp.zeros((0,), jnp.float32)
+        return {"v": vec}, ok
+
+    # --------------------------------------------------------- host side
+    def due(self, iteration: int) -> bool:
+        return iteration % self.config.report_frequency == 0
+
+    def read(self, dv, name: str) -> List[dict]:
+        """ONE batched device→host transfer of the packed vector (or the
+        fused group's [k, K] stack), sliced by the layout into one
+        structured dict per step."""
+        from deeplearning4j_tpu import monitor
+        vec = np.asarray(dv["v"])
+        monitor.record_transfer(vec.nbytes, "d2h")
+        rows = vec if vec.ndim == 2 else vec[None]
+        layout = self.layouts[name]
+        out = []
+        for row in rows:
+            flat = {}
+            off = 0
+            for k, size in layout:
+                flat[k] = (float(row[off]) if size == 1
+                           else np.array(row[off:off + size]))
+                off += size
+            out.append(self._structure(flat))
+        return out
+
+    @staticmethod
+    def _structure(flat: dict) -> dict:
+        d = {"params": {}, "activations": {}, "hists": {}, "finite": {},
+             "nonfinite": bool(flat.get("nonfinite", 0.0))}
+        for k, v in flat.items():
+            if "." not in k:
+                continue
+            st, key = k.split(".", 1)
+            if st in PARAM_STATS:
+                d["params"].setdefault(key, {})[st] = v
+            elif st in ACT_STATS:
+                short = {"act_mean": "mean", "act_std": "std",
+                         "act_dead": "dead"}[st]
+                d["activations"].setdefault(key, {})[short] = v
+            elif st == "hist":
+                d["hists"][key] = v
+            elif st == "finite":
+                d["finite"][key] = bool(v)
+        return d
+
+    def process(self, model, dv, name: str, it0: int) -> List[dict]:
+        """Read one step's (or one fused group's) diag vector, apply the
+        watchdog's host-side actions, publish registry gauges, and cache
+        the latest host stats on the model (``model._last_diagnostics``
+        — what StatsListener / ParamAndGradientIterationListener
+        consume). Raises NonFiniteGradientsError under ``halt``."""
+        if not dv:
+            return []
+        rows = self.read(dv, name)
+        policy = self.config.watchdog
+        from deeplearning4j_tpu import monitor
+        mon = monitor.is_enabled()
+        reg = monitor.registry() if mon else None
+        for i, row in enumerate(rows):
+            if not row["nonfinite"]:
+                continue
+            bad = [k for k, fine in row["finite"].items() if not fine]
+            self.nonfinite_total += 1
+            if mon:
+                reg.counter(
+                    "watchdog_nonfinite_total",
+                    help="steps that produced non-finite grads/updates",
+                ).inc()
+                reg.gauge("watchdog_last_nonfinite_iteration",
+                          help="iteration of the last non-finite step",
+                          ).set(float(it0 + i))
+            if policy == "skip":
+                self.skipped_total += 1
+                if mon:
+                    reg.counter(
+                        "watchdog_skipped_total",
+                        help="updates discarded in-graph by the skip "
+                             "policy").inc()
+                log.warning(
+                    "diagnostics watchdog: non-finite update at iteration "
+                    "%d (layers %s) — update SKIPPED in-graph",
+                    it0 + i, sorted(bad))
+            elif policy == "halt":
+                raise NonFiniteGradientsError(bad, it0 + i)
+            else:  # warn (and None: count only)
+                if policy == "warn":
+                    log.warning(
+                        "diagnostics watchdog: non-finite gradients/"
+                        "updates at iteration %d (layers %s)",
+                        it0 + i, sorted(bad))
+        last = rows[-1]
+        if mon:
+            for key, st in last["params"].items():
+                if "grad_l2" in st:
+                    reg.gauge("training_grad_l2",
+                              help="per-param gradient L2 norm",
+                              param=key).set(st["grad_l2"])
+                reg.gauge("training_update_l2",
+                          help="per-param applied-update L2 norm",
+                          param=key).set(st["upd_l2"])
+                reg.gauge("training_update_ratio",
+                          help="mean |update| : mean |param| ratio",
+                          param=key).set(st["ratio"])
+            for lk, st in last["activations"].items():
+                reg.gauge("training_activation_std",
+                          help="per-layer activation std",
+                          layer=lk).set(st["std"])
+                reg.gauge("training_activation_dead",
+                          help="per-layer exactly-zero activation "
+                               "fraction", layer=lk).set(st["dead"])
+        self.last = last
+        model._last_diagnostics = last
+        return rows
+
+
+def collect_and_gate(diag, name: str, *, params_old, params_new, upd_old,
+                     upd_new, state_old, state_new, grads, loss,
+                     acts=None):
+    """The containers' shared diagnostics tail: collect the step's
+    stats and, under the ``skip`` watchdog, discard the bad update
+    in-graph (params/updater/layer state keep their previous values).
+    One copy for the per-step, fused-scan and pipeline step bodies —
+    the gradient-sharing cores have their own (`_exchange_diag`, which
+    additionally reverts residual/τ). Returns
+    (params_new, upd_new, state_new, dv)."""
+    if diag is None:
+        return params_new, upd_new, state_new, {}
+    dv, ok = diag.collect(name, params_new=params_new,
+                          params_old=params_old, grads=grads, loss=loss,
+                          acts=acts)
+    if diag.config.watchdog == "skip":
+        params_new = keep_finite(ok, params_new, params_old)
+        upd_new = keep_finite(ok, upd_new, upd_old)
+        state_new = {k: (keep_finite(ok, v, state_old[k])
+                         if k in state_old else v)
+                     for k, v in state_new.items()}
+    return params_new, upd_new, state_new, dv
+
+
+def process_if_due(model, dv, name: str, it0: int, steps: int = 1):
+    """Trainer-side cadence gate: process the step's (or fused group's)
+    diag vector iff the model has diagnostics AND any covered iteration
+    is on report cadence. Returns the host-stat rows or None — callers
+    hand ``rows[j]`` to on-cadence listener callbacks. Off-cadence:
+    nothing is read, zero transfers."""
+    md = getattr(model, "_diag", None)
+    if md is None or not dv:
+        return None
+    if not any(md.due(it0 + j) for j in range(steps)):
+        return None
+    return md.process(model, dv, name, it0)
+
+
+# ----------------------------------------------- batched host readback
+_BATCH_FETCH_CACHE: Dict[Any, Any] = {}
+
+
+def batched_host_tree(tree):
+    """Fetch every leaf of a device tree to host numpy in ONE batched
+    device→host transfer: a tiny jitted program concatenates the
+    raveled f32 leaves into one buffer, fetched with a single
+    `np.asarray` (counted as one d2h transfer). Host-resident trees
+    (numpy leaves) pass through with zero transfers.
+
+    This is the StatsListener seam: the reference behavior (one
+    `np.asarray` per param leaf per report) cost one device round-trip
+    per leaf."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    if all(isinstance(l, np.ndarray) for l in leaves):
+        return tree
+    from deeplearning4j_tpu import monitor
+    key = (treedef, tuple((tuple(np.shape(l)), str(getattr(l, "dtype", "?")))
+                          for l in leaves))
+    fn = _BATCH_FETCH_CACHE.get(key)
+    if fn is None:
+        def concat(ls):
+            return jnp.concatenate([jnp.reshape(_f32(l), (-1,))
+                                    for l in ls])
+        fn = jax.jit(concat)
+        if len(_BATCH_FETCH_CACHE) > 64:
+            _BATCH_FETCH_CACHE.clear()
+        _BATCH_FETCH_CACHE[key] = fn
+    flat = np.asarray(fn(leaves))
+    monitor.record_transfer(flat.nbytes, "d2h")
+    out = []
+    off = 0
+    for l in leaves:
+        n = int(np.prod(np.shape(l)))
+        out.append(flat[off:off + n].reshape(np.shape(l)))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -123,6 +123,10 @@ class MultiLayerConfiguration:
     # params / bf16 compute / fp32 losses. The DL4J_DTYPE_POLICY env
     # override beats this field (mirroring DL4J_SCAN_LAYERS).
     dtype_policy: Optional[Any] = None
+    # in-graph model-internals diagnostics (monitor/diagnostics.py):
+    # None = off, or a DiagnosticsConfig / spec ("on", a watchdog
+    # policy name, a serde dict). DL4J_DIAGNOSTICS env wins.
+    diagnostics: Optional[Any] = None
 
     def to_dict(self):
         return {
@@ -146,6 +150,8 @@ class MultiLayerConfiguration:
             "gradient_sharing_threshold": self.gradient_sharing_threshold,
             "dtype_policy": (None if self.dtype_policy is None
                              else _policy_to_dict(self.dtype_policy)),
+            "diagnostics": (None if self.diagnostics is None
+                            else _diagnostics_to_dict(self.diagnostics)),
         }
 
     def to_json(self, **kw):
@@ -175,6 +181,7 @@ class MultiLayerConfiguration:
             gradient_sharing_threshold=d.get("gradient_sharing_threshold",
                                              1e-3),
             dtype_policy=_policy_from_serde(d.get("dtype_policy")),
+            diagnostics=_diagnostics_from_serde(d.get("diagnostics")),
         )
 
     @staticmethod
@@ -194,6 +201,21 @@ def _policy_from_serde(d):
         return None
     from deeplearning4j_tpu.nd.dtype import as_policy
     return as_policy(d)
+
+
+def _diagnostics_to_dict(spec):
+    """Serde form of a diagnostics field value (a DiagnosticsConfig, a
+    spec name, or an already-serialized dict)."""
+    from deeplearning4j_tpu.monitor.diagnostics import as_diagnostics
+    cfg = as_diagnostics(spec)
+    return None if cfg is None else cfg.to_dict()
+
+
+def _diagnostics_from_serde(d):
+    if d is None:
+        return None
+    from deeplearning4j_tpu.monitor.diagnostics import as_diagnostics
+    return as_diagnostics(d)
 
 
 def _family(input_type: InputType) -> str:
@@ -274,6 +296,7 @@ class ListBuilder:
         self._gradient_sharing = "dense"
         self._gradient_sharing_threshold = 1e-3
         self._dtype_policy = global_conf.dtype_policy_value
+        self._diagnostics = getattr(global_conf, "diagnostics_value", None)
 
     def layer(self, layer_or_idx, maybe_layer=None) -> "ListBuilder":
         layer = maybe_layer if maybe_layer is not None else layer_or_idx
@@ -333,6 +356,16 @@ class ListBuilder:
         self._dtype_policy = as_policy(policy)
         return self
 
+    def diagnostics(self, spec) -> "ListBuilder":
+        """In-graph model-internals diagnostics
+        (monitor/diagnostics.py): True/"on" for the defaults, a
+        watchdog policy name ("warn"/"skip"/"halt"), a
+        DiagnosticsConfig, or None/False for off. `DL4J_DIAGNOSTICS`
+        env wins."""
+        from deeplearning4j_tpu.monitor.diagnostics import as_diagnostics
+        self._diagnostics = as_diagnostics(spec)
+        return self
+
     def build(self) -> MultiLayerConfiguration:
         g = self._g
         layers = [l.clone() for l in self._layers]
@@ -379,6 +412,7 @@ class ListBuilder:
             gradient_sharing=self._gradient_sharing,
             gradient_sharing_threshold=self._gradient_sharing_threshold,
             dtype_policy=self._dtype_policy,
+            diagnostics=self._diagnostics,
         )
 
 
@@ -409,6 +443,7 @@ class NeuralNetConfiguration:
         self.max_iterations_value = 5
         self.mini_batch = True
         self.dtype_policy_value = None
+        self.diagnostics_value = None
 
     @staticmethod
     def builder() -> "NeuralNetConfiguration":
@@ -496,6 +531,18 @@ class NeuralNetConfiguration:
         ``DL4J_DTYPE_POLICY`` env override, which beats this field."""
         from deeplearning4j_tpu.nd.dtype import as_policy
         self.dtype_policy_value = as_policy(policy)
+        return self
+
+    def diagnostics(self, spec):
+        """In-graph model-internals diagnostics default threaded into
+        the built configuration (monitor/diagnostics.py): per-layer
+        grad/update/param/activation stats as aux outputs of the fused
+        train step, plus the non-finite watchdog
+        (``"warn"``/``"skip"``/``"halt"``). ``True``/"on" enables the
+        defaults; the ``DL4J_DIAGNOSTICS`` env override beats this
+        field (mirroring DL4J_SCAN_LAYERS)."""
+        from deeplearning4j_tpu.monitor.diagnostics import as_diagnostics
+        self.diagnostics_value = as_diagnostics(spec)
         return self
 
     def constrain_max_norm(self, v: float):

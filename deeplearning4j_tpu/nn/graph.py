@@ -83,6 +83,9 @@ class ComputationGraphConfiguration:
         self.gradient_sharing_threshold: float = 1e-3
         # mixed-precision policy (nd/dtype.py; DL4J_DTYPE_POLICY wins)
         self.dtype_policy = None
+        # in-graph diagnostics (monitor/diagnostics.py;
+        # DL4J_DIAGNOSTICS wins). None = off.
+        self.diagnostics = None
         self.topo_order: List[str] = []
 
     # ------------------------------------------------------------- builder
@@ -138,6 +141,9 @@ class ComputationGraphConfiguration:
             "gradient_sharing_threshold": self.gradient_sharing_threshold,
             "dtype_policy": (None if self.dtype_policy is None
                              else self.dtype_policy.to_dict()),
+            "diagnostics": (None if self.diagnostics is None
+                            else monitor.diagnostics.as_diagnostics(
+                                self.diagnostics).to_dict()),
             "input_types": {k: v.to_dict() for k, v in self.input_types.items()},
             "nodes": [
                 {
@@ -179,6 +185,9 @@ class ComputationGraphConfiguration:
         if d.get("dtype_policy") is not None:
             from deeplearning4j_tpu.nd.dtype import as_policy
             conf.dtype_policy = as_policy(d["dtype_policy"])
+        if d.get("diagnostics") is not None:
+            conf.diagnostics = monitor.diagnostics.as_diagnostics(
+                d["diagnostics"])
         conf.input_types = {k: InputType.from_dict(v)
                             for k, v in d.get("input_types", {}).items()}
         for nd in d["nodes"]:
@@ -265,6 +274,14 @@ class GraphBuilder:
         self._conf.dtype_policy = as_policy(policy)
         return self
 
+    def diagnostics(self, spec) -> "GraphBuilder":
+        """In-graph model-internals diagnostics for this graph
+        (monitor/diagnostics.py): True/"on", a watchdog policy name
+        ("warn"/"skip"/"halt"), a DiagnosticsConfig, or None/False for
+        off. `DL4J_DIAGNOSTICS` env wins."""
+        self._conf.diagnostics = monitor.diagnostics.as_diagnostics(spec)
+        return self
+
     def build(self) -> ComputationGraphConfiguration:
         conf = self._conf
         conf.seed = self._g.seed_value
@@ -275,6 +292,8 @@ class GraphBuilder:
         conf.max_iterations = self._g.max_iterations_value
         if conf.dtype_policy is None:
             conf.dtype_policy = getattr(self._g, "dtype_policy_value", None)
+        if conf.diagnostics is None:
+            conf.diagnostics = getattr(self._g, "diagnostics_value", None)
         conf.topo_order = conf.topological_sort()
         # shape inference + automatic preprocessors (reference
         # GraphBuilder.build → addPreProcessors)
@@ -304,11 +323,18 @@ class GraphBuilder:
 
 class ComputationGraph:
     def __init__(self, conf: ComputationGraphConfiguration,
-                 dtype_policy: DataTypePolicy = None):
+                 dtype_policy: DataTypePolicy = None, diagnostics=None):
         self.conf = conf
         # DL4J_DTYPE_POLICY env > explicit arg > conf.dtype_policy >
         # process default (nd/dtype.py)
         self.dtype = resolve_policy(dtype_policy, conf)
+        # in-graph model-internals diagnostics (monitor/diagnostics.py):
+        # DL4J_DIAGNOSTICS env > explicit arg > conf.diagnostics > off
+        self.diagnostics = monitor.resolve_diagnostics(diagnostics, conf)
+        self._diag = (monitor.Diagnostics(self.diagnostics)
+                      if self.diagnostics is not None else None)
+        self._last_diagnostics = None
+        self._last_group_dv = None
         self.params: Dict[str, Dict[str, jnp.ndarray]] = {}
         self.net_state: Dict[str, Dict[str, jnp.ndarray]] = {}
         self.updater_state: Dict[str, Dict[str, Any]] = {}
@@ -424,7 +450,8 @@ class ComputationGraph:
 
     def _forward_all(self, params, state, inputs: Sequence, *, train, rng,
                      masks: Optional[Sequence] = None, stop_at_loss: bool = False,
-                     carries: Optional[Dict] = None, unrolled: bool = False):
+                     carries: Optional[Dict] = None, unrolled: bool = False,
+                     stats_out=None):
         """Walk topo order. Returns (activations dict, preout dict,
         new_state, mask dict). When `carries` is given (a dict keyed by
         node name), recurrent layers run `forward_with_carry` and the
@@ -476,10 +503,17 @@ class ComputationGraph:
                     if packed is None:
                         packed = scan_stack.stack_params(
                             [params[m] for m in members])
-                    h = scan_stack.scan_forward(
-                        template, packed, h, train=train, rng=rng,
-                        fold_ids=[topo_index[m] for m in members],
-                        mask=mask)
+                    if stats_out is not None:
+                        h, run_stats = scan_stack.scan_forward(
+                            template, packed, h, train=train, rng=rng,
+                            fold_ids=[topo_index[m] for m in members],
+                            mask=mask, collect_stats=True)
+                        stats_out[scan_stack.run_key(members)] = run_stats
+                    else:
+                        h = scan_stack.scan_forward(
+                            template, packed, h, train=train, rng=rng,
+                            fold_ids=[topo_index[m] for m in members],
+                            mask=mask)
                     tail = members[-1]
                     acts[tail] = h
                     mask_map[tail] = mask
@@ -527,18 +561,23 @@ class ComputationGraph:
             if st:
                 new_state[name] = st
             acts[name] = h
+            if stats_out is not None:
+                from deeplearning4j_tpu.monitor.diagnostics import (
+                    activation_stats)
+                stats_out[name] = activation_stats(h)
             mask_map[name] = layer.forward_mask(mask, None)
         return acts, preouts, new_state, mask_map
 
     def _loss_fn(self, params, state, inputs, labels, rng, fmasks, lmasks, *,
-                 train, carries=None):
+                 train, carries=None, act_stats=False):
         if not isinstance(labels, (list, tuple)):
             labels = [labels]
         lmasks = list(lmasks) if lmasks else [None] * len(labels)
         out_carries = None if carries is None else dict(carries)
+        stats_out = {} if act_stats else None
         acts, preouts, new_state, _ = self._forward_all(
             params, state, inputs, train=train, rng=rng, masks=fmasks,
-            stop_at_loss=True, carries=out_carries)
+            stop_at_loss=True, carries=out_carries, stats_out=stats_out)
         total = 0.0
         for oi, name in enumerate(self.output_layer_names):
             layer = self.conf.nodes[name].layer
@@ -570,7 +609,10 @@ class ComputationGraph:
         for st in new_state.values():
             if "aux_loss" in st:
                 total = total + st.pop("aux_loss")
-        return self.dtype.cast_output(total), (new_state, out_carries)
+        total = self.dtype.cast_output(total)
+        if act_stats:
+            return total, (new_state, out_carries, stats_out)
+        return total, (new_state, out_carries)
 
     # ------------------------------------------------------------ train step
     def _packed_runs(self, params):
@@ -622,6 +664,8 @@ class ComputationGraph:
     def _make_train_step(self, tbptt: bool = False):
         gn = self.conf.gradient_normalization
         gn_t = self.conf.gradient_normalization_threshold
+        diag = self._diag
+        want_acts = diag is not None and diag.config.activation_stats
 
         def step_fn(params, upd_state, state, it, xs, ys, rng, fmasks, lmasks,
                     carries=None):
@@ -638,18 +682,28 @@ class ComputationGraph:
                 else:
                     stopped = carries
                 return self._loss_fn(p, state, xs, ys, rng, fmasks, lmasks,
-                                     train=True, carries=stopped)
+                                     train=True, carries=stopped,
+                                     act_stats=want_acts)
 
             # cast outside value_and_grad: bf16 grads under mixed_bf16,
             # fp32 master update below (see MultiLayerNetwork)
-            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+            (loss, aux), grads = jax.value_and_grad(
                 lf, has_aux=True)(self.dtype.cast_params(params))
+            if want_acts:
+                new_state, new_carries, acts = aux
+            else:
+                (new_state, new_carries), acts = aux, None
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd_state, it)
+            new_params, new_upd, new_state, dv = \
+                monitor.diagnostics.collect_and_gate(
+                    diag, "fit", params_old=params, params_new=new_params,
+                    upd_old=upd_state, upd_new=new_upd, state_old=state,
+                    state_new=new_state, grads=grads, loss=loss, acts=acts)
             if runs:
                 new_params = scan_stack.unpack_tree(new_params, runs)
                 new_upd = scan_stack.unpack_tree(new_upd, runs)
-            return new_params, new_upd, new_state, loss, new_carries
+            return new_params, new_upd, new_state, loss, new_carries, dv
 
         return jax.jit(step_fn, donate_argnums=_donate(0, 1, 2))
 
@@ -659,6 +713,8 @@ class ComputationGraph:
         only state keys present at init are carried across steps)."""
         gn = self.conf.gradient_normalization
         gn_t = self.conf.gradient_normalization_threshold
+        diag = self._diag
+        want_acts = diag is not None and diag.config.activation_stats
 
         def one(carry, inp):
             params, upd, state, it = carry
@@ -666,14 +722,23 @@ class ComputationGraph:
 
             def lf(p):
                 return self._loss_fn(p, state, xs, ys, rng, None, None,
-                                     train=True)
+                                     train=True, act_stats=want_acts)
 
-            (loss, (new_state, _)), grads = jax.value_and_grad(
+            (loss, aux), grads = jax.value_and_grad(
                 lf, has_aux=True)(self.dtype.cast_params(params))
+            if want_acts:
+                new_state, _, acts = aux
+            else:
+                (new_state, _), acts = aux, None
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd, it)
+            new_params, new_upd, new_state, dv = \
+                monitor.diagnostics.collect_and_gate(
+                    diag, "fit", params_old=params, params_new=new_params,
+                    upd_old=upd, upd_new=new_upd, state_old=state,
+                    state_new=new_state, grads=grads, loss=loss, acts=acts)
             state = {k: new_state.get(k, v) for k, v in state.items()}
-            return (new_params, new_upd, state, it + 1), loss
+            return (new_params, new_upd, state, it + 1), (loss, dv)
 
         def multi(params, upd, state, it0, xs_stack, ys_stack, rngs):
             # homogeneous chains ride the k-step scan carry stacked —
@@ -683,13 +748,13 @@ class ComputationGraph:
             if runs:
                 params = scan_stack.pack_tree(params, runs)
                 upd = scan_stack.pack_tree(upd, runs)
-            (params, upd, state, _), losses = jax.lax.scan(
+            (params, upd, state, _), (losses, dvs) = jax.lax.scan(
                 one, (params, upd, state, jnp.asarray(it0, jnp.int32)),
                 (xs_stack, ys_stack, rngs))
             if runs:
                 params = scan_stack.unpack_tree(params, runs)
                 upd = scan_stack.unpack_tree(upd, runs)
-            return params, upd, state, losses
+            return params, upd, state, losses, dvs
 
         return multi
 
@@ -708,10 +773,13 @@ class ComputationGraph:
         k = xs_stack[0].shape[0]
         its = jnp.arange(it0, it0 + k)
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng_root, i))(its)
-        (self.params, self.updater_state, self.net_state, losses) = \
+        (self.params, self.updater_state, self.net_state, losses, dvs) = \
             self._jit_multi_step(self.params, self.updater_state,
                                  self.net_state, it0, xs_stack, ys_stack,
                                  rngs)
+        # stacked per-step diag vectors ({} with diagnostics off) — read
+        # by the fit loop at listener cadence, NOT here (no sync)
+        self._last_group_dv = dvs
         return losses
 
     # ------------------------------------------------- AOT observability
@@ -821,8 +889,19 @@ class ComputationGraph:
                 losses = np.asarray(self._run_multi_step(xs_stack, ys_stack,
                                                          self.iteration_count))
             with monitor.span("fit/update", fused_steps=len(pending)):
+                group_stats = None
+                dvs = self._last_group_dv
+                if (self._diag is not None and dvs
+                        and any(self._diag.due(self.iteration_count + j)
+                                for j in range(len(pending)))):
+                    # ONE batched transfer for the whole fused group
+                    group_stats = self._diag.process(
+                        self, dvs, "fit", self.iteration_count)
                 for j, (_, _, n_examples) in enumerate(pending):
                     self.score_value = float(losses[j])
+                    dstats = (group_stats[j] if group_stats is not None
+                              and self._diag.due(self.iteration_count)
+                              else None)
                     listeners.iteration_done(self, self.iteration_count,
                                              self.epoch_count, self.score_value,
                                              batch_size=n_examples,
@@ -835,29 +914,38 @@ class ComputationGraph:
                                              # sees params consistent with the
                                              # iteration count (checkpointable)
                                              step_boundary=(
-                                                 j == len(pending) - 1))
+                                                 j == len(pending) - 1),
+                                             diagnostics=dstats)
                     self.iteration_count += 1
 
         def run_one(xs, ys, fmasks, lmasks, n_examples, etl_ms=0.0):
             rng = jax.random.fold_in(rng_root, self.iteration_count)
+            dv = None
             with monitor.span("fit/forward_backward",
                               iteration=self.iteration_count):
                 if solver is not None:
                     loss = solver.optimize(list(xs), list(ys), list(fmasks),
                                            list(lmasks))
                 elif tbptt and any(x.ndim == 3 for x in xs):
-                    loss = self._fit_tbptt(xs, ys, fmasks, lmasks, rng)
+                    loss, dv = self._fit_tbptt(xs, ys, fmasks, lmasks, rng)
                 else:
-                    (self.params, self.updater_state, new_state, loss, _) = \
+                    (self.params, self.updater_state, new_state, loss, _,
+                     dv) = \
                         self._jit_train_step(
                             self.params, self.updater_state, self.net_state,
                             self.iteration_count, xs, ys, rng, fmasks, lmasks)
                     self.net_state = {**self.net_state, **new_state}
             with monitor.span("fit/update", iteration=self.iteration_count):
                 self.score_value = float(loss)
+                dstats = None
+                if (self._diag is not None and dv
+                        and self._diag.due(self.iteration_count)):
+                    dstats = self._diag.process(
+                        self, dv, "fit", self.iteration_count)[-1]
                 listeners.iteration_done(self, self.iteration_count,
                                          self.epoch_count, self.score_value,
-                                         batch_size=n_examples, etl_ms=etl_ms)
+                                         batch_size=n_examples, etl_ms=etl_ms,
+                                         diagnostics=dstats)
             self.iteration_count += 1
 
         mon_on = monitor.is_enabled()
@@ -940,6 +1028,7 @@ class ComputationGraph:
             return a if (a is None or a.ndim != 3) else a[:, s:s + L]
 
         total_loss, nchunks = 0.0, 0
+        dv = None
         for s in range(0, T, L):
             xc = tuple(chunk(x, s) for x in xs)
             yc = tuple(y[:, s:s + L] if y.ndim == 3 else y for y in ys)
@@ -947,14 +1036,16 @@ class ComputationGraph:
             lm = tuple(None if m is None else
                        (m[:, s:s + L] if m.ndim >= 2 else m) for m in lmasks)
             crng = jax.random.fold_in(rng, s)
-            (self.params, self.updater_state, new_state, loss, carries) = \
+            (self.params, self.updater_state, new_state, loss, carries,
+             dv) = \
                 self._jit_tbptt_step(self.params, self.updater_state,
                                      self.net_state, self.iteration_count,
                                      xc, yc, crng, fm, lm, carries)
             self.net_state = {**self.net_state, **new_state}
             total_loss += float(loss)
             nchunks += 1
-        return total_loss / max(nchunks, 1)
+        # diagnostics reflect the LAST chunk (see MultiLayerNetwork)
+        return total_loss / max(nchunks, 1), dv
 
     # ------------------------------------------------------ rnn streaming
     def rnn_clear_previous_state(self):

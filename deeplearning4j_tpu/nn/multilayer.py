@@ -92,12 +92,20 @@ def validate_param_widths(params):
 
 
 class MultiLayerNetwork:
-    def __init__(self, conf: MultiLayerConfiguration, dtype_policy: DataTypePolicy = None):
+    def __init__(self, conf: MultiLayerConfiguration, dtype_policy: DataTypePolicy = None,
+                 diagnostics=None):
         self.conf = conf
         self.layers: List[Layer] = conf.layers
         # DL4J_DTYPE_POLICY env > explicit arg > conf.dtype_policy >
         # process default (nd/dtype.py)
         self.dtype = resolve_policy(dtype_policy, conf)
+        # in-graph model-internals diagnostics (monitor/diagnostics.py):
+        # DL4J_DIAGNOSTICS env > explicit arg > conf.diagnostics > off
+        self.diagnostics = monitor.resolve_diagnostics(diagnostics, conf)
+        self._diag = (monitor.Diagnostics(self.diagnostics)
+                      if self.diagnostics is not None else None)
+        self._last_diagnostics = None
+        self._last_group_dv = None
         self.params: Dict[str, Dict[str, jnp.ndarray]] = {}
         self.net_state: Dict[str, Dict[str, jnp.ndarray]] = {}
         self.updater_state: Dict[str, Dict[str, Any]] = {}
@@ -200,7 +208,8 @@ class MultiLayerNetwork:
         return plan
 
     def _forward_core(self, params, state, x, *, train, rng, mask=None,
-                      carries=None, upto=None, collect=False):
+                      carries=None, upto=None, collect=False,
+                      stats_out=None):
         """Shared forward pass. Returns (h, new_state, new_carries,
         activations_if_collect, final_mask).
 
@@ -256,6 +265,10 @@ class MultiLayerNetwork:
             mask = layer.forward_mask(mask, None)
             if collect:
                 acts.append(h)
+            if stats_out is not None:
+                from deeplearning4j_tpu.monitor.diagnostics import (
+                    activation_stats)
+                stats_out[si] = activation_stats(h)
             return h, mask
 
         if (carries is None and not collect
@@ -291,17 +304,34 @@ class MultiLayerNetwork:
             if packed is None:
                 packed = scan_stack.stack_params(
                     [params[k] for k in run_keys])
-            h = scan_stack.scan_forward(
-                template, packed, h, train=train, rng=rng,
-                fold_ids=range(start, stop), mask=mask)
+            if stats_out is not None:
+                h, run_stats = scan_stack.scan_forward(
+                    template, packed, h, train=train, rng=rng,
+                    fold_ids=range(start, stop), mask=mask,
+                    collect_stats=True)
+                # per-layer stats of the packed run via the scan ys —
+                # keyed by the run entry, expanded to member layer keys
+                # at the diagnostics boundary (never unpacked here)
+                stats_out[scan_stack.run_key(run_keys)] = run_stats
+            else:
+                h = scan_stack.scan_forward(
+                    template, packed, h, train=train, rng=rng,
+                    fold_ids=range(start, stop), mask=mask)
         return h, new_state, new_carries, acts, mask
 
-    def _loss_fn(self, params, state, x, y, rng, fmask, lmask, *, train, carries=None):
-        """Full loss incl. regularization. Returns (loss, (new_state, new_carries))."""
+    def _loss_fn(self, params, state, x, y, rng, fmask, lmask, *, train,
+                 carries=None, act_stats=False):
+        """Full loss incl. regularization. Returns
+        (loss, (new_state, new_carries)) — with ``act_stats=True`` (the
+        diagnostics train step) the aux grows a third element: the
+        per-layer activation-stats dict, which must leave through the
+        value_and_grad aux channel (a side-effect dict would leak
+        tracers)."""
         n = len(self.layers)
+        stats_out = {} if act_stats else None
         h, new_state, new_carries, _, mask = self._forward_core(
             params, state, x, train=train, rng=rng, mask=fmask,
-            carries=carries, upto=n - 1)
+            carries=carries, upto=n - 1, stats_out=stats_out)
         if (n - 1) in self.conf.input_preprocessors:
             pp = self.conf.input_preprocessors[n - 1]
             h = pp.pre_process(h, mask)
@@ -339,7 +369,10 @@ class MultiLayerNetwork:
         for st in new_state.values():
             if "aux_loss" in st:
                 reg = reg + st.pop("aux_loss")
-        return self.dtype.cast_output(loss) + reg, (new_state, new_carries)
+        total = self.dtype.cast_output(loss) + reg
+        if act_stats:
+            return total, (new_state, new_carries, stats_out)
+        return total, (new_state, new_carries)
 
     # ---------------------------------------------------------- train step
     def _packed_runs(self, params):
@@ -399,6 +432,8 @@ class MultiLayerNetwork:
     def _make_train_step(self, tbptt: bool):
         gn = self.conf.gradient_normalization
         gn_t = self.conf.gradient_normalization_threshold
+        diag = self._diag
+        want_acts = diag is not None and diag.config.activation_stats
 
         def step_fn(params, upd_state, state, it, x, y, rng, fmask, lmask, carries=None):
             # boundary packing (nn/scan_stack.py): homogeneous runs ride
@@ -418,20 +453,33 @@ class MultiLayerNetwork:
                 else:
                     stopped = carries
                 return self._loss_fn(p, state, x, y, rng, fmask, lmask,
-                                     train=True, carries=stopped)
+                                     train=True, carries=stopped,
+                                     act_stats=want_acts)
 
             # differentiate wrt the COMPUTE-dtype tree (cast outside
             # value_and_grad): under mixed_bf16 the gradients — and any
             # data-parallel all-reduce of them — are bf16; the updater
             # below upcasts onto the fp32 master params/state
-            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+            (loss, aux), grads = jax.value_and_grad(
                 lf, has_aux=True)(self.dtype.cast_params(params))
+            if want_acts:
+                new_state, new_carries, acts = aux
+            else:
+                (new_state, new_carries), acts = aux, None
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd_state, it)
+            # aux outputs only: the update/param math above is
+            # untouched, so the trajectory stays bit-identical to
+            # diagnostics-off (except an explicit skip firing)
+            new_params, new_upd, new_state, dv = \
+                monitor.diagnostics.collect_and_gate(
+                    diag, "fit", params_old=params, params_new=new_params,
+                    upd_old=upd_state, upd_new=new_upd, state_old=state,
+                    state_new=new_state, grads=grads, loss=loss, acts=acts)
             if runs:
                 new_params = scan_stack.unpack_tree(new_params, runs)
                 new_upd = scan_stack.unpack_tree(new_upd, runs)
-            return new_params, new_upd, new_state, loss, new_carries
+            return new_params, new_upd, new_state, loss, new_carries, dv
 
         return jax.jit(step_fn, donate_argnums=_donate(0, 1, 2))
 
@@ -448,6 +496,8 @@ class MultiLayerNetwork:
         init (batchnorm running stats, ...) update normally."""
         gn = self.conf.gradient_normalization
         gn_t = self.conf.gradient_normalization_threshold
+        diag = self._diag
+        want_acts = diag is not None and diag.config.activation_stats
 
         def one(carry, inp):
             params, upd, state, it = carry
@@ -455,14 +505,26 @@ class MultiLayerNetwork:
 
             def lf(p):
                 return self._loss_fn(p, state, x, y, rng, None, None,
-                                     train=True)
+                                     train=True, act_stats=want_acts)
 
-            (loss, (new_state, _)), grads = jax.value_and_grad(
+            (loss, aux), grads = jax.value_and_grad(
                 lf, has_aux=True)(self.dtype.cast_params(params))
+            if want_acts:
+                new_state, _, acts = aux
+            else:
+                (new_state, _), acts = aux, None
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = self._apply_updates(params, grads, upd, it)
+            # per-step stats ride the fused scan's ys — stacked [k, K]
+            # at program exit, ONE batched transfer per listener
+            # cadence (the fused-dispatch contract)
+            new_params, new_upd, new_state, dv = \
+                monitor.diagnostics.collect_and_gate(
+                    diag, "fit", params_old=params, params_new=new_params,
+                    upd_old=upd, upd_new=new_upd, state_old=state,
+                    state_new=new_state, grads=grads, loss=loss, acts=acts)
             state = {k: new_state.get(k, v) for k, v in state.items()}
-            return (new_params, new_upd, state, it + 1), loss
+            return (new_params, new_upd, state, it + 1), (loss, dv)
 
         def multi(params, upd, state, it0, xs, ys, rngs):
             # homogeneous runs ride the k-step scan carry as stacked
@@ -472,13 +534,13 @@ class MultiLayerNetwork:
             if runs:
                 params = scan_stack.pack_tree(params, runs)
                 upd = scan_stack.pack_tree(upd, runs)
-            (params, upd, state, _), losses = jax.lax.scan(
+            (params, upd, state, _), (losses, dvs) = jax.lax.scan(
                 one, (params, upd, state, jnp.asarray(it0, jnp.int32)),
                 (xs, ys, rngs))
             if runs:
                 params = scan_stack.unpack_tree(params, runs)
                 upd = scan_stack.unpack_tree(upd, runs)
-            return params, upd, state, losses
+            return params, upd, state, losses, dvs
 
         return multi
 
@@ -503,9 +565,12 @@ class MultiLayerNetwork:
         rng_root = jax.random.PRNGKey(self.conf.seed + 1)
         its = jnp.arange(it0, it0 + xs.shape[0])
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng_root, i))(its)
-        (self.params, self.updater_state, self.net_state, losses) = \
+        (self.params, self.updater_state, self.net_state, losses, dvs) = \
             self._jit_multi_step(self.params, self.updater_state,
                                  self.net_state, it0, xs, ys, rngs)
+        # stacked per-step diag vectors ({} with diagnostics off) — read
+        # by the fit loop at listener cadence, NOT here (no sync)
+        self._last_group_dv = dvs
         return losses
 
     # ------------------------------------------------- AOT observability
@@ -599,6 +664,7 @@ class MultiLayerNetwork:
 
         def fit_one(x, y, fmask, lmask, etl_ms):
             rng = jax.random.fold_in(rng_root, self.iteration_count)
+            dv = None
             # forward_backward covers the step's device dispatch (the
             # fused fwd+bwd+update program); the score readback + host
             # state merge + listener fan-out is the update span. With
@@ -608,20 +674,29 @@ class MultiLayerNetwork:
                 if solver is not None:
                     loss = solver.optimize(x, y, fmask, lmask)
                 elif tbptt and x.ndim == 3:
-                    loss = self._fit_tbptt(x, y, fmask, lmask, rng)
+                    loss, dv = self._fit_tbptt(x, y, fmask, lmask, rng)
                 else:
-                    (self.params, self.updater_state, new_state, loss, _) = \
+                    (self.params, self.updater_state, new_state, loss, _,
+                     dv) = \
                         self._jit_train_step(self.params, self.updater_state,
                                              self.net_state, self.iteration_count,
                                              x, y, rng, fmask, lmask, None)
                     self.net_state = {**self.net_state, **new_state}
             with monitor.span("fit/update", iteration=self.iteration_count):
                 self.score_value = float(loss)
+                dstats = None
+                if (self._diag is not None and dv
+                        and self._diag.due(self.iteration_count)):
+                    # ONE batched device→host transfer at cadence; the
+                    # watchdog's warn/halt/count actions live here
+                    dstats = self._diag.process(
+                        self, dv, "fit", self.iteration_count)[-1]
                 listeners.iteration_done(self, self.iteration_count, self.epoch_count,
                                          self.score_value,
                                          batch_size=int(np.shape(x)[0]),
                                          etl_ms=etl_ms,
-                                         batch=(x, y, fmask, lmask))
+                                         batch=(x, y, fmask, lmask),
+                                         diagnostics=dstats)
             self.iteration_count += 1
 
         def flush(pending, etl_ms):
@@ -638,8 +713,20 @@ class MultiLayerNetwork:
                 losses = np.asarray(self._run_multi_step(xs, ys,
                                                          self.iteration_count))
             with monitor.span("fit/update", fused_steps=len(pending)):
+                group_stats = None
+                dvs = self._last_group_dv
+                if (self._diag is not None and dvs
+                        and any(self._diag.due(self.iteration_count + j)
+                                for j in range(len(pending)))):
+                    # the fused group's stacked stats arrive in ONE
+                    # batched transfer when any step in it is on-cadence
+                    group_stats = self._diag.process(
+                        self, dvs, "fit", self.iteration_count)
                 for j, (x, y) in enumerate(pending):
                     self.score_value = float(losses[j])
+                    dstats = (group_stats[j] if group_stats is not None
+                              and self._diag.due(self.iteration_count)
+                              else None)
                     # mid-group callbacks see POST-group params with a
                     # mid-group iteration count; only the last callback
                     # is a state-consistent step boundary (checkpoint
@@ -650,7 +737,8 @@ class MultiLayerNetwork:
                                              etl_ms=etl_ms if j == 0 else 0.0,
                                              batch=(x, y, None, None),
                                              step_boundary=(
-                                                 j == len(pending) - 1))
+                                                 j == len(pending) - 1),
+                                             diagnostics=dstats)
                     self.iteration_count += 1
 
         mon_on = monitor.is_enabled()
@@ -710,19 +798,23 @@ class MultiLayerNetwork:
                 carries[str(i)] = layer.init_carry(x.shape[0], self.dtype.compute_dtype)
         total_loss = 0.0
         nchunks = 0
+        dv = None
         for s in range(0, T, L):
             xc = x[:, s:s + L]
             yc = y[:, s:s + L] if y.ndim == 3 else y
             fm = None if fmask is None else fmask[:, s:s + L]
             lm = None if lmask is None else (lmask[:, s:s + L] if lmask.ndim >= 2 else lmask)
             crng = jax.random.fold_in(rng, s)
-            (self.params, self.updater_state, new_state, loss, carries) = \
+            (self.params, self.updater_state, new_state, loss, carries,
+             dv) = \
                 self._jit_tbptt_step(self.params, self.updater_state, self.net_state,
                                      self.iteration_count, xc, yc, crng, fm, lm, carries)
             self.net_state = {**self.net_state, **new_state}
             total_loss += float(loss)
             nchunks += 1
-        return total_loss / max(nchunks, 1)
+        # diagnostics reflect the LAST chunk (one iteration spans many
+        # chunks under TBPTT; the skip gate still fires per chunk)
+        return total_loss / max(nchunks, 1), dv
 
     # ------------------------------------------------------------- inference
     def output(self, x, train: bool = False, data_format=None, mask=None):
@@ -892,7 +984,7 @@ class MultiLayerNetwork:
 
     def copy(self) -> "MultiLayerNetwork":
         clone = MultiLayerNetwork(MultiLayerConfiguration.from_dict(self.conf.to_dict()),
-                                 self.dtype)
+                                 self.dtype, diagnostics=self.diagnostics)
         if self._initialized:
             # fresh buffers, not aliases: fit() donates its argument
             # arrays to XLA, which would delete a shared buffer out

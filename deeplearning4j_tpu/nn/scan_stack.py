@@ -318,7 +318,8 @@ def unstack_entry(stacked, n: int) -> List[dict]:
 
 
 def scan_forward(template, stacked, h, *, train: bool, rng,
-                 fold_ids: Sequence[int], mask=None):
+                 fold_ids: Sequence[int], mask=None,
+                 collect_stats: bool = False):
     """Run a homogeneous layer run as one `lax.scan` over its stacked
     params (leading axis = layer position).
 
@@ -326,8 +327,21 @@ def scan_forward(template, stacked, h, *, train: bool, rng,
     loop uses (`jax.random.fold_in(rng, i)`), so dropout/weight-noise
     draws are bit-identical to the unrolled path. The template's remat
     policy wraps the scan body (`prevent_cse=False` — the scan idiom),
-    so activation memory stays O(one block) + O(depth * residual)."""
+    so activation memory stays O(one block) + O(depth * residual).
+
+    ``collect_stats=True`` (the in-graph diagnostics seam —
+    monitor/diagnostics.py) emits each scanned layer's activation
+    mean/std/dead-fraction through the scan ys and returns
+    ``(h, stats)`` with ``stats`` shaped ``[run_length, 3]`` — the
+    per-layer view of a packed run WITHOUT unpacking it."""
     policy = effective_remat_policy(template) if train else None
+
+    def out(hh):
+        if not collect_stats:
+            return None
+        from deeplearning4j_tpu.monitor.diagnostics import activation_stats
+        return activation_stats(hh)
+
     if rng is not None:
         keys = jnp.stack([jax.random.fold_in(rng, i) for i in fold_ids])
 
@@ -337,7 +351,7 @@ def scan_forward(template, stacked, h, *, train: bool, rng,
                 p, train, jax.random.fold_in(lrng, WEIGHT_NOISE_FOLD))
             hh, _ = template.forward(lp, {}, hh, train=train, rng=lrng,
                                      mask=mask)
-            return hh, None
+            return hh, out(hh)
 
         xs = (stacked, keys)
     else:
@@ -345,12 +359,12 @@ def scan_forward(template, stacked, h, *, train: bool, rng,
         def body(hh, p):
             hh, _ = template.forward(p, {}, hh, train=train, rng=None,
                                      mask=mask)
-            return hh, None
+            return hh, out(hh)
 
         xs = stacked
     body = remat_wrap(body, policy, prevent_cse=False)
-    h, _ = jax.lax.scan(body, h, xs)
-    return h
+    h, ys = jax.lax.scan(body, h, xs)
+    return (h, ys) if collect_stats else h
 
 
 # -------------------------------------------------- boundary pack/unpack

@@ -130,22 +130,45 @@ class TimeIterationListener(TrainingListener):
 
 class EvaluativeListener(TrainingListener):
     """Periodic evaluation during training (reference
-    `EvaluativeListener.java` with InvocationType)."""
+    `EvaluativeListener.java` with InvocationType).
+
+    When the telemetry substrate is enabled, every evaluation also
+    lands on the registry as ``evaluative_score{tag=...,metric=...}``
+    gauges (+ ``evaluative_last_iteration``) — the held-out-score tap
+    drift detection / early stopping consumes from `/metrics`."""
 
     def __init__(self, iterator, frequency: int = 1, invocation: str = "epoch_end",
-                 printer: Callable[[str], None] = None):
+                 printer: Callable[[str], None] = None, tag: str = "eval"):
         self.iterator = iterator
         self.frequency = max(1, frequency)
         self.invocation = invocation  # "epoch_end" | "iteration_end"
         self.printer = printer or (lambda s: log.info(s))
+        self.tag = tag
         self.evaluations: List = []
+        self._last_iteration = 0
 
-    def _evaluate(self, model, tag):
+    def _evaluate(self, model, when):
         e = model.evaluate(self.iterator)
         self.evaluations.append(e)
-        self.printer(f"[{tag}] accuracy={e.accuracy():.4f} f1={e.f1():.4f}")
+        acc, f1 = e.accuracy(), e.f1()
+        self.printer(f"[{when}] accuracy={acc:.4f} f1={f1:.4f}")
+        from deeplearning4j_tpu import monitor
+        if monitor.is_enabled():
+            reg = monitor.registry()
+            reg.gauge("evaluative_score",
+                      help="held-out evaluation score from "
+                           "EvaluativeListener",
+                      tag=self.tag, metric="accuracy").set(float(acc))
+            reg.gauge("evaluative_score",
+                      help="held-out evaluation score from "
+                           "EvaluativeListener",
+                      tag=self.tag, metric="f1").set(float(f1))
+            reg.gauge("evaluative_last_iteration",
+                      help="iteration of the last held-out evaluation",
+                      tag=self.tag).set(float(self._last_iteration))
 
     def iteration_done(self, model, iteration, epoch, score, **info):
+        self._last_iteration = iteration
         if self.invocation == "iteration_end" and iteration % self.frequency == 0:
             self._evaluate(model, f"iter {iteration}")
 
@@ -200,9 +223,17 @@ class SleepyTrainingListener(TrainingListener):
 
 class ParamAndGradientIterationListener(TrainingListener):
     """Per-iteration param AND gradient magnitude summaries (reference
-    `ParamAndGradientIterationListener.java`). Gradients are recomputed
-    from the iteration's batch (passed via `info["batch"]`) only on
-    print iterations — off-cadence iterations pay nothing."""
+    `ParamAndGradientIterationListener.java`).
+
+    Gradient magnitudes come from the diagnostics aux of the fused
+    train step (``info["diagnostics"]`` / ``model._last_diagnostics``)
+    — the TRAINING gradients the updater actually consumed. The
+    previous implementation recomputed an entire eager backward pass
+    per print (and evaluated the loss with ``train=False``, so the
+    printed gradients were not even the training gradients); that path
+    is gone. Without a diagnostics seam the listener prints param
+    magnitudes only (one batched readback) and notes — once — how to
+    enable gradients."""
 
     def __init__(self, print_iterations: int = 1, printer=None,
                  print_gradients: bool = True):
@@ -211,29 +242,42 @@ class ParamAndGradientIterationListener(TrainingListener):
         self.print_iterations = max(1, print_iterations)
         self.print_gradients = print_gradients
         self.printer = printer or (lambda s: log.info(s))
+        self._warned_no_diag = False
 
     def iteration_done(self, model, iteration, epoch, score, **info):
         if iteration % self.print_iterations != 0:
             return
         np = self._np
-        grads = None
-        batch = info.get("batch")
-        if self.print_gradients and batch is not None:
-            import jax as _jax
-            x, y, fmask, lmask = batch
-            grads = _jax.grad(
-                lambda p: model._loss_fn(p, model.net_state, x, y, None,
-                                         fmask, lmask, train=False)[0]
-            )(model.params)
+        # explicit diagnostics=None means "off-cadence" — print the
+        # param-only summary rather than relabeling a stale readback
+        # (the model attribute covers callers outside the fit loops)
+        diag = (info["diagnostics"] if "diagnostics" in info
+                else getattr(model, "_last_diagnostics", None))
+        diag_params = (diag or {}).get("params") or {}
         parts = [f"iter {iteration} score {score:.6g}"]
-        for lk, lparams in model.params.items():
-            for pn, arr in lparams.items():
-                a = np.asarray(arr)
-                msg = f"{lk}_{pn}: |p|={np.abs(a).mean():.4g}"
-                if grads is not None:
-                    g = np.asarray(grads[lk][pn])
-                    msg += f" |g|={np.abs(g).mean():.4g}"
+        if diag_params:
+            for key in sorted(diag_params):
+                st = diag_params[key]
+                msg = f"{key}: |p|={st['param_mm']:.4g}"
+                if self.print_gradients and "grad_mm" in st:
+                    msg += f" |g|={st['grad_mm']:.4g}"
                 parts.append(msg)
+        else:
+            if self.print_gradients and not self._warned_no_diag:
+                self._warned_no_diag = True
+                log.warning(
+                    "ParamAndGradientIterationListener: model has no "
+                    "diagnostics seam — gradient magnitudes unavailable; "
+                    "build the model with diagnostics enabled (conf "
+                    ".diagnostics(True) or DL4J_DIAGNOSTICS=1) to see "
+                    "the training gradients")
+            from deeplearning4j_tpu.monitor.diagnostics import (
+                batched_host_tree)
+            host = batched_host_tree(model.params)
+            for lk, lparams in host.items():
+                for pn, arr in lparams.items():
+                    a = np.asarray(arr)
+                    parts.append(f"{lk}_{pn}: |p|={np.abs(a).mean():.4g}")
         self.printer(" | ".join(parts))
 
 

@@ -375,8 +375,47 @@ def _local_loss_fn(model, is_graph: bool):
     return lf
 
 
+def _exchange_diag(model, diag, axis, *, params_old, upd_old, res_old,
+                   tau_old, state_old, params_new, upd_new, res_new,
+                   tau_new, state_new, loss):
+    """Shared diagnostics tail of every exchange-step body: collect the
+    POST-exCHANGE update/param stats (the decoded, applied updates —
+    for the bucketed modes these are exactly what left the VJP-hook
+    channel), fold the error-feedback residual into the finite flags
+    (a non-finite gradient saturates the int encode but poisons the
+    residual, so the flags must see it), and under the ``skip``
+    watchdog discard the WHOLE step in-graph — params, updater state,
+    residual, τ and layer state all keep their previous values, keeping
+    the EF identity consistent. Flags are psum'd over the data axis so
+    every replica gates identically.
+
+    Returns (params, upd, residual, tau, state, dv)."""
+    if diag is None:
+        return params_new, upd_new, res_new, tau_new, state_new, {}
+    from deeplearning4j_tpu.monitor.diagnostics import keep_finite
+    dv, ok = diag.collect(
+        "exchange", params_new=params_new, params_old=params_old,
+        loss=loss, extra_finite=res_new if res_new else None,
+        axis_name=axis)
+    if diag.config.watchdog == "skip":
+        params_new = keep_finite(ok, params_new, params_old)
+        upd_new = keep_finite(ok, upd_new, upd_old)
+        if res_new:
+            res_new = keep_finite(ok, res_new, res_old)
+        if isinstance(tau_new, dict):
+            tau_new = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), tau_new, tau_old)
+        elif tau_new is not None and tau_old is not None:
+            tau_new = jnp.where(ok, tau_new, tau_old)
+        state_new = {k: (keep_finite(ok, v, state_old[k])
+                         if k in state_old else v)
+                     for k, v in state_new.items()}
+    return params_new, upd_new, res_new, tau_new, state_new, dv
+
+
 def make_threshold_core(model, axis: str, cfg: ThresholdConfig, *,
-                        n_workers: int, is_graph: bool = False):
+                        n_workers: int, is_graph: bool = False,
+                        diag=None):
     """Per-replica threshold sync-step body on ALREADY-PACKED trees
     (params/updater-state/residual may contain ``stacked::`` run
     entries — the encoder is elementwise, so a stacked leading axis
@@ -416,15 +455,24 @@ def make_threshold_core(model, axis: str, cfg: ThresholdConfig, *,
         dhat, new_residual, new_tau, sparsity = threshold_exchange(
             deltas, residual, tau, axis, cfg, n_workers=n_workers)
         new_params = apply_decoded_updates(model, is_graph, params, dhat)
-        return (new_params, new_upd, _pmean_state(new_state, axis),
-                new_residual, new_tau, jax.lax.pmean(loss, axis), sparsity)
+        pstate = _pmean_state(new_state, axis)
+        ploss = jax.lax.pmean(loss, axis)
+        (new_params, new_upd, new_residual, new_tau, pstate, dv) = \
+            _exchange_diag(
+                model, diag, axis, params_old=params, upd_old=upd,
+                res_old=residual, tau_old=tau, state_old=state,
+                params_new=new_params, upd_new=new_upd,
+                res_new=new_residual, tau_new=new_tau, state_new=pstate,
+                loss=ploss)
+        return (new_params, new_upd, pstate,
+                new_residual, new_tau, ploss, sparsity, dv)
 
     return core
 
 
 def make_threshold_step(model, axis: str, cfg: ThresholdConfig, *,
                         n_workers: int, is_graph: bool = False,
-                        allow_scan: bool = True):
+                        allow_scan: bool = True, diag=None):
     """One threshold sync step on per-layer (boundary) trees: packs
     ``stacked::`` runs for params, updater state AND residual at entry,
     unpacks at exit — the residual follows updater state through the
@@ -435,7 +483,7 @@ def make_threshold_step(model, axis: str, cfg: ThresholdConfig, *,
     this in a partially-manual shard_map (DP x TP), where jaxlib
     0.4.x's SPMD partitioner crashes on inner scan bodies."""
     core = make_threshold_core(model, axis, cfg, n_workers=n_workers,
-                               is_graph=is_graph)
+                               is_graph=is_graph, diag=diag)
 
     def step(params, upd, state, it, residual, tau, x, y, rng):
         with scan_stack.force_unrolled(not allow_scan):
@@ -445,30 +493,32 @@ def make_threshold_step(model, axis: str, cfg: ThresholdConfig, *,
                 params = scan_stack.pack_tree(params, runs)
                 upd = scan_stack.pack_tree(upd, runs)
                 residual = scan_stack.pack_tree(residual, runs)
-            params, upd, state, residual, tau, loss, sparsity = core(
+            params, upd, state, residual, tau, loss, sparsity, dv = core(
                 params, upd, state, it, residual, tau, x, y, rng)
             if runs:
                 params = scan_stack.unpack_tree(params, runs)
                 upd = scan_stack.unpack_tree(upd, runs)
                 residual = scan_stack.unpack_tree(residual, runs)
-        return params, upd, state, residual, tau, loss, sparsity
+        return params, upd, state, residual, tau, loss, sparsity, dv
 
     return step
 
 
 def make_threshold_multi(model, axis: str, cfg: ThresholdConfig, *,
                          n_workers: int, is_graph: bool = False,
-                         allow_scan: bool = True):
+                         allow_scan: bool = True, diag=None):
     """k fused threshold sync steps: ONE `lax.scan` whose carry is
     (params, updater state, layer state, iteration, residual, τ) — the
     residual and τ ride the carry next to the updater state, and the
     ``stacked::`` run packing happens once per PROGRAM, not per step.
+    Per-step diag vectors ride the scan ys (one batched transfer per
+    listener cadence).
 
     Scan-carry structure rule (same as the containers'
     `_multi_step_fn`): only state keys present at entry survive across
     fused steps."""
     core = make_threshold_core(model, axis, cfg, n_workers=n_workers,
-                               is_graph=is_graph)
+                               is_graph=is_graph, diag=diag)
 
     def multi(params, upd, state, it0, residual, tau, xs, ys, rngs):
         with scan_stack.force_unrolled(not allow_scan):
@@ -482,21 +532,23 @@ def make_threshold_multi(model, axis: str, cfg: ThresholdConfig, *,
             def body(carry, inp):
                 params, upd, state, it, residual, tau = carry
                 x, y, rng = inp
-                params, upd, new_state, residual, tau, loss, sparsity = core(
+                (params, upd, new_state, residual, tau, loss, sparsity,
+                 dv) = core(
                     params, upd, state, it, residual, tau, x, y, rng)
                 state = {k: new_state.get(k, v) for k, v in state.items()}
                 return ((params, upd, state, it + 1, residual, tau),
-                        (loss, sparsity))
+                        (loss, sparsity, dv))
 
             carry = (params, upd, state, jnp.asarray(it0, jnp.int32),
                      residual, jnp.asarray(tau, jnp.float32))
-            (params, upd, state, _, residual, tau), (losses, sparsities) = \
+            ((params, upd, state, _, residual, tau),
+             (losses, sparsities, dvs)) = \
                 jax.lax.scan(body, carry, (xs, ys, rngs))
             if runs:
                 params = scan_stack.unpack_tree(params, runs)
                 upd = scan_stack.unpack_tree(upd, runs)
                 residual = scan_stack.unpack_tree(residual, runs)
-        return params, upd, state, residual, tau, losses, sparsities
+        return params, upd, state, residual, tau, losses, sparsities, dvs
 
     return multi
 
@@ -879,12 +931,17 @@ def _apply_constraints_tree(model, is_graph: bool, new_params):
 
 def make_bucketed_core(model, axis: str, cfg: ThresholdConfig, *,
                        n_workers: int, mode: str, is_graph: bool = False,
-                       rs_plan: Optional[dict] = None):
+                       rs_plan: Optional[dict] = None, diag=None):
     """Per-replica bucketed sync-step body on ALREADY-PACKED trees.
     Uniform signature across the four modes:
 
         core(params, upd, state, it, residual, tau, x, y, rng)
-          -> (params, upd, state, residual, tau, loss, sparsity)
+          -> (params, upd, state, residual, tau, loss, sparsity, dv)
+
+    ``dv`` is the packed diagnostics vector (monitor/diagnostics.py;
+    ``{}`` when diagnostics are off): per-layer POST-EXCHANGE
+    update/param stats — the applied updates that came back through the
+    VJP-hook channel — plus watchdog finite flags.
 
     `tau` is a PER-BUCKET dict of f32 scalars (empty for the dense
     modes, as is `residual`); `upd` is the per-replica updater view for
@@ -919,9 +976,15 @@ def make_bucketed_core(model, axis: str, cfg: ThresholdConfig, *,
             (loss, (new_state, _)), (upd_p, new_upd) = jax.value_and_grad(
                 lf, argnums=(0, 1), has_aux=True)(params, upd)
             new_params = _apply_constraints_tree(model, is_graph, upd_p)
-            return (new_params, new_upd, _pmean_state(new_state, axis),
-                    residual, tau, jax.lax.pmean(loss, axis),
-                    jnp.float32(1.0))
+            pstate = _pmean_state(new_state, axis)
+            ploss = jax.lax.pmean(loss, axis)
+            (new_params, new_upd, _, _, pstate, dv) = _exchange_diag(
+                model, diag, axis, params_old=params, upd_old=upd,
+                res_old=residual, tau_old=tau, state_old=state,
+                params_new=new_params, upd_new=new_upd, res_new={},
+                tau_new={}, state_new=pstate, loss=ploss)
+            return (new_params, new_upd, pstate,
+                    residual, tau, ploss, jnp.float32(1.0), dv)
 
         if mode == "threshold":
             hooks = {lk: _threshold_bucket_hook(
@@ -966,8 +1029,16 @@ def make_bucketed_core(model, axis: str, cfg: ThresholdConfig, *,
         total = tree_elements(params)
         sparsity = sum(new_ctrl[lk][1] * tree_elements(params[lk])
                        for lk in new_ctrl) / total
-        return (new_params, new_upd, _pmean_state(new_state, axis),
-                new_res, new_tau, jax.lax.pmean(loss, axis), sparsity)
+        pstate = _pmean_state(new_state, axis)
+        ploss = jax.lax.pmean(loss, axis)
+        (new_params, new_upd, new_res, new_tau, pstate, dv) = \
+            _exchange_diag(
+                model, diag, axis, params_old=params, upd_old=upd,
+                res_old=residual, tau_old=tau, state_old=state,
+                params_new=new_params, upd_new=new_upd, res_new=new_res,
+                tau_new=new_tau, state_new=pstate, loss=ploss)
+        return (new_params, new_upd, pstate,
+                new_res, new_tau, ploss, sparsity, dv)
 
     return core
 
@@ -1062,7 +1133,7 @@ def tau_scalar(tau) -> float:
 def make_bucketed_step(model, axis: str, cfg: ThresholdConfig, *,
                        n_workers: int, mode: str, is_graph: bool = False,
                        allow_scan: bool = True,
-                       rs_plan: Optional[dict] = None):
+                       rs_plan: Optional[dict] = None, diag=None):
     """One bucketed sync step on per-layer (boundary) trees: packs
     ``stacked::`` runs for params, updater state, residual AND the
     per-bucket τ at entry, unpacks at exit. Signature matches
@@ -1070,7 +1141,7 @@ def make_bucketed_step(model, axis: str, cfg: ThresholdConfig, *,
     dicts for residual/τ in the dense modes)."""
     core = make_bucketed_core(model, axis, cfg, n_workers=n_workers,
                               mode=mode, is_graph=is_graph,
-                              rs_plan=rs_plan)
+                              rs_plan=rs_plan, diag=diag)
     threshold_state = mode in ("threshold", "threshold_rs")
 
     def step(params, upd, state, it, residual, tau, x, y, rng):
@@ -1083,7 +1154,7 @@ def make_bucketed_step(model, axis: str, cfg: ThresholdConfig, *,
                 if threshold_state:
                     residual = scan_stack.pack_tree(residual, runs)
                     tau = _pack_scalar_tree(tau, runs)
-            params, upd, state, residual, tau, loss, sparsity = core(
+            params, upd, state, residual, tau, loss, sparsity, dv = core(
                 params, upd, state, it, residual, tau, x, y, rng)
             if runs:
                 params = scan_stack.unpack_tree(params, runs)
@@ -1091,7 +1162,7 @@ def make_bucketed_step(model, axis: str, cfg: ThresholdConfig, *,
                 if threshold_state:
                     residual = scan_stack.unpack_tree(residual, runs)
                     tau = _unpack_scalar_tree(tau, runs)
-        return params, upd, state, residual, tau, loss, sparsity
+        return params, upd, state, residual, tau, loss, sparsity, dv
 
     return step
 
@@ -1099,16 +1170,16 @@ def make_bucketed_step(model, axis: str, cfg: ThresholdConfig, *,
 def make_bucketed_multi(model, axis: str, cfg: ThresholdConfig, *,
                         n_workers: int, mode: str, is_graph: bool = False,
                         allow_scan: bool = True,
-                        rs_plan: Optional[dict] = None):
+                        rs_plan: Optional[dict] = None, diag=None):
     """k fused bucketed sync steps: ONE `lax.scan` whose carry is
     (params, updater state, layer state, iteration, residual, τ-tree)
     — the per-bucket residual/τ ride the carry next to the updater
     state, and the ``stacked::`` packing happens once per PROGRAM.
-    Bit-identical to k per-step calls (same rng folds, same
-    counters)."""
+    Per-step diag vectors ride the scan ys. Bit-identical to k per-step
+    calls (same rng folds, same counters)."""
     core = make_bucketed_core(model, axis, cfg, n_workers=n_workers,
                               mode=mode, is_graph=is_graph,
-                              rs_plan=rs_plan)
+                              rs_plan=rs_plan, diag=diag)
     threshold_state = mode in ("threshold", "threshold_rs")
 
     def multi(params, upd, state, it0, residual, tau, xs, ys, rngs):
@@ -1128,15 +1199,16 @@ def make_bucketed_multi(model, axis: str, cfg: ThresholdConfig, *,
                 params, upd, state, it, residual, tau = carry
                 x, y, rng = inp
                 (params, upd, new_state, residual, tau, loss,
-                 sparsity) = core(params, upd, state, it, residual, tau,
-                                  x, y, rng)
+                 sparsity, dv) = core(params, upd, state, it, residual,
+                                      tau, x, y, rng)
                 state = {k: new_state.get(k, v) for k, v in state.items()}
                 return ((params, upd, state, it + 1, residual, tau),
-                        (loss, sparsity))
+                        (loss, sparsity, dv))
 
             carry = (params, upd, state, jnp.asarray(it0, jnp.int32),
                      residual, tau)
-            (params, upd, state, _, residual, tau), (losses, sps) = \
+            ((params, upd, state, _, residual, tau),
+             (losses, sps, dvs)) = \
                 jax.lax.scan(body, carry, (xs, ys, rngs))
             if runs:
                 params = scan_stack.unpack_tree(params, runs)
@@ -1144,7 +1216,7 @@ def make_bucketed_multi(model, axis: str, cfg: ThresholdConfig, *,
                 if threshold_state:
                     residual = scan_stack.unpack_tree(residual, runs)
                     tau = _unpack_scalar_tree(tau, runs)
-        return params, upd, state, residual, tau, losses, sps
+        return params, upd, state, residual, tau, losses, sps, dvs
 
     return multi
 

@@ -286,9 +286,11 @@ class PipelineParallelTrainer:
     def _build(self):
         from deeplearning4j_tpu.optimize.gradients import (
             apply_gradient_normalization)
+        from deeplearning4j_tpu.monitor import diagnostics as diagx
         model = self.model
         gn = model.conf.gradient_normalization
         gn_t = model.conf.gradient_normalization_threshold
+        diag = getattr(model, "_diag", None)
 
         def step(params, upd, state, it, x, y, rng):
             (loss, new_state), grads = jax.value_and_grad(
@@ -296,7 +298,14 @@ class PipelineParallelTrainer:
                 has_aux=True)(params)
             grads = apply_gradient_normalization(grads, gn, gn_t)
             new_params, new_upd = model._apply_updates(params, grads, upd, it)
-            return new_params, new_upd, new_state, loss
+            # aux-only per-layer stats of the pipelined step (no
+            # activation stats — interior stage activations live
+            # inside the GPipe schedule)
+            new_params, new_upd, new_state, dv = diagx.collect_and_gate(
+                diag, "pipeline", params_old=params, params_new=new_params,
+                upd_old=upd, upd_new=new_upd, state_old=state,
+                state_new=new_state, grads=grads, loss=loss)
+            return new_params, new_upd, new_state, loss, dv
 
         self._step = jax.jit(step, donate_argnums=_donate(0, 1))
 
@@ -402,7 +411,7 @@ class PipelineParallelTrainer:
                     self._validate_batch(ds.num_examples(), "fit batch")
                     rng = jax.random.fold_in(rng_root, model.iteration_count)
                     t0 = time.perf_counter() if self.stats is not None else 0.0
-                    params, upd, new_state, loss = self._step(
+                    params, upd, new_state, loss, dv = self._step(
                         params, upd, state, model.iteration_count,
                         jnp.asarray(ds.features), jnp.asarray(ds.labels), rng)
                     state = {**state, **new_state}
@@ -413,10 +422,16 @@ class PipelineParallelTrainer:
                                           iteration=model.iteration_count)
                         self.stats.next_round()
                     model.score_value = float(loss)
+                    from deeplearning4j_tpu.monitor import (
+                        diagnostics as diagx)
+                    rows = diagx.process_if_due(model, dv, "pipeline",
+                                                model.iteration_count)
                     listeners.iteration_done(model, model.iteration_count,
                                              model.epoch_count,
                                              model.score_value,
-                                             batch_size=ds.num_examples())
+                                             batch_size=ds.num_examples(),
+                                             diagnostics=rows[-1] if rows
+                                             else None)
                     model.iteration_count += 1
                 listeners.on_epoch_end(model, model.epoch_count)
                 model.epoch_count += 1

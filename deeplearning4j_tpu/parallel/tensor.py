@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.datasets.iterator import as_iterator
+from deeplearning4j_tpu.monitor import diagnostics as _diagmod
 from deeplearning4j_tpu.optimize.listeners import ComposedListeners
 
 
@@ -262,7 +263,8 @@ class ShardedParallelTrainer:
             step,
             in_shardings=(self._psh, self._ush, self._repl, None,
                           self._bsh, self._bsh, None),
-            out_shardings=(self._psh, self._ush, self._repl, None, None),
+            out_shardings=(self._psh, self._ush, self._repl, None, None,
+                           None),
             donate_argnums=_donate(0, 1, 2))
 
     # ------------------------------------------- threshold gradient sharing
@@ -396,6 +398,7 @@ class ShardedParallelTrainer:
         step = maker(
             self.model, axis, self.threshold_config, n_workers=n,
             is_graph=self._is_graph, allow_scan=allow_scan,
+            diag=self.model._diag,
             **({"mode": "threshold"} if self.bucketed else {}))
         self._build_shardings()
         rep = P(axis)
@@ -404,17 +407,18 @@ class ShardedParallelTrainer:
         kwargs = dict(mesh=mesh,
                       in_specs=(P(), rep, P(), None, rep, P(),
                                 P(axis), P(axis), None),
-                      out_specs=(P(), rep, P(), rep, P(), P(), P()),
+                      out_specs=(P(), rep, P(), rep, P(), P(), P(), P()),
                       check_vma=False)
         if autoaxes:
             kwargs["auto"] = autoaxes
 
         @partial(shard_map, **kwargs)
         def thr_step(params, upd_r, state, it, res_r, tau, x, y, rng):
-            params, upd, state, res, tau, loss, sp = step(
+            params, upd, state, res, tau, loss, sp, dv = step(
                 params, strip(upd_r), state, it, strip(res_r), tau,
                 x, y, rng)
-            return params, expand(upd), state, expand(res), tau, loss, sp
+            return (params, expand(upd), state, expand(res), tau, loss,
+                    sp, dv)
 
         self._thr_step = jax.jit(thr_step, donate_argnums=_donate(0, 1, 2, 4))
 
@@ -575,14 +579,14 @@ class ShardedParallelTrainer:
                     rng = jax.random.fold_in(rng_root, model.iteration_count)
                     t0 = time.perf_counter() if self.stats is not None else 0.0
                     if thr:
-                        params, upd, state, res_r, tau, loss, sp = \
+                        params, upd, state, res_r, tau, loss, sp, dv = \
                             self._thr_step(params, upd, state,
                                            model.iteration_count, res_r, tau,
                                            x, y, rng)
                         gs.record_exchange("threshold", wire_b, dense_b, 1,
                                            trainer="sharded")
                     else:
-                        params, upd, state, loss, _ = self._step(
+                        params, upd, state, loss, _, dv = self._step(
                             params, upd, state, model.iteration_count, x, y,
                             rng)
                         gs.record_exchange("dense", dense_b, dense_b, 1,
@@ -595,13 +599,18 @@ class ShardedParallelTrainer:
                         self.stats.next_round()
                     if eager_loss:
                         model.score_value = float(loss)
+                    rows = _diagmod.process_if_due(
+                        model, dv, "exchange" if thr else "fit",
+                        model.iteration_count)
                     # non-eager: NaN = "score not read back this step" (the
                     # monitor listener's sentinel), never a stale score
                     listeners.iteration_done(model, model.iteration_count,
                                              model.epoch_count,
                                              model.score_value if eager_loss
                                              else float("nan"),
-                                             batch_size=ds.num_examples())
+                                             batch_size=ds.num_examples(),
+                                             diagnostics=rows[-1] if rows
+                                             else None)
                     model.iteration_count += 1
                 listeners.on_epoch_end(model, model.epoch_count)
                 model.epoch_count += 1

@@ -38,6 +38,7 @@ from deeplearning4j_tpu.optimize.gradients import apply_gradient_normalization
 from deeplearning4j_tpu.optimize.listeners import ComposedListeners
 from deeplearning4j_tpu.parallel.mesh import device_mesh
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import diagnostics as _diag
 
 
 from deeplearning4j_tpu.nd.donation import donate_argnums as _donate
@@ -199,7 +200,7 @@ class ParallelTrainer:
         self._sync_step = jax.jit(
             step,
             in_shardings=(repl, repl, repl, None, batch_sharded, batch_sharded, None),
-            out_shardings=(repl, repl, repl, None, None),
+            out_shardings=(repl, repl, repl, None, None, None),
             donate_argnums=_donate(0, 1, 2),
         )
 
@@ -215,7 +216,7 @@ class ParallelTrainer:
         self._sync_multi = jax.jit(
             self.model._multi_step_fn(),
             in_shardings=(repl, repl, repl, None, stack_sh, stack_sh, None),
-            out_shardings=(repl, repl, repl, None),
+            out_shardings=(repl, repl, repl, None, None),
             donate_argnums=_donate(0, 1, 2),
         )
 
@@ -234,7 +235,8 @@ class ParallelTrainer:
         mesh, axis = self.mesh, self.data_axis
         step = gs.make_threshold_step(
             self.model, axis, self.threshold_config,
-            n_workers=self.n_workers, is_graph=self._is_graph)
+            n_workers=self.n_workers, is_graph=self._is_graph,
+            diag=self.model._diag)
         rep = P(axis)
         strip = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
         expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
@@ -242,13 +244,14 @@ class ParallelTrainer:
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(), rep, P(), None, rep, P(),
                            P(axis), P(axis), None),
-                 out_specs=(P(), rep, P(), rep, P(), P(), P()),
+                 out_specs=(P(), rep, P(), rep, P(), P(), P(), P()),
                  check_vma=False)
         def thr_step(params, upd_r, state, it, res_r, tau, x, y, rng):
-            params, upd, state, res, tau, loss, sp = step(
+            params, upd, state, res, tau, loss, sp, dv = step(
                 params, strip(upd_r), state, it, strip(res_r), tau,
                 x, y, rng)
-            return params, expand(upd), state, expand(res), tau, loss, sp
+            return (params, expand(upd), state, expand(res), tau, loss,
+                    sp, dv)
 
         self._thr_step = jax.jit(thr_step, donate_argnums=_donate(0, 1, 2, 4))
 
@@ -263,7 +266,8 @@ class ParallelTrainer:
         mesh, axis = self.mesh, self.data_axis
         multi = gs.make_threshold_multi(
             self.model, axis, self.threshold_config,
-            n_workers=self.n_workers, is_graph=self._is_graph)
+            n_workers=self.n_workers, is_graph=self._is_graph,
+            diag=self.model._diag)
         rep = P(axis)
         strip = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
         expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
@@ -271,13 +275,14 @@ class ParallelTrainer:
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(), rep, P(), None, rep, P(),
                            P(None, axis), P(None, axis), None),
-                 out_specs=(P(), rep, P(), rep, P(), P(), P()),
+                 out_specs=(P(), rep, P(), rep, P(), P(), P(), P()),
                  check_vma=False)
         def thr_multi(params, upd_r, state, it0, res_r, tau, xs, ys, rngs):
-            params, upd, state, res, tau, losses, sps = multi(
+            params, upd, state, res, tau, losses, sps, dvs = multi(
                 params, strip(upd_r), state, it0, strip(res_r), tau,
                 xs, ys, rngs)
-            return params, expand(upd), state, expand(res), tau, losses, sps
+            return (params, expand(upd), state, expand(res), tau, losses,
+                    sps, dvs)
 
         self._thr_multi = jax.jit(thr_multi,
                                   donate_argnums=_donate(0, 1, 2, 4))
@@ -382,7 +387,8 @@ class ParallelTrainer:
         maker = gs.make_bucketed_multi if multi else gs.make_bucketed_step
         fn = maker(self.model, axis, self.threshold_config,
                    n_workers=self.n_workers, mode=mode,
-                   is_graph=self._is_graph, rs_plan=rs_plan)
+                   is_graph=self._is_graph, rs_plan=rs_plan,
+                   diag=self.model._diag)
         per_replica_upd = mode != "dense"
         has_thr = mode in ("threshold", "threshold_rs")
         rep = P(axis)
@@ -395,15 +401,16 @@ class ParallelTrainer:
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(), upd_spec, P(), None, res_spec, P(),
                            batch_spec, batch_spec, None),
-                 out_specs=(P(), upd_spec, P(), res_spec, P(), P(), P()),
+                 out_specs=(P(), upd_spec, P(), res_spec, P(), P(), P(),
+                            P()),
                  check_vma=False)
         def run(params, upd_r, state, it, res_r, tau, x, y, rng):
             u = strip(upd_r) if per_replica_upd else upd_r
             r = strip(res_r) if has_thr else res_r
-            params, u, state, r, tau, loss, sp = fn(
+            params, u, state, r, tau, loss, sp, dv = fn(
                 params, u, state, it, r, tau, x, y, rng)
             return (params, expand(u) if per_replica_upd else u, state,
-                    expand(r) if has_thr else r, tau, loss, sp)
+                    expand(r) if has_thr else r, tau, loss, sp, dv)
 
         donate = _donate(0, 1, 2, 4) if has_thr else _donate(0, 1, 2)
         return jax.jit(run, donate_argnums=donate)
@@ -762,7 +769,7 @@ class ParallelTrainer:
             y = _gput(ds.labels, batch_sh)
             rng = jax.random.fold_in(rng_root, model.iteration_count)
             t0 = time.perf_counter()
-            params, upd_r, state, res_r, tau, loss, sp = self._thr_step(
+            params, upd_r, state, res_r, tau, loss, sp, dv = self._thr_step(
                 params, upd_r, state, model.iteration_count, res_r, tau,
                 x, y, rng)
             last_loss, last_sparsity = loss, sp
@@ -772,6 +779,8 @@ class ParallelTrainer:
                 model.score_value = float(loss)
                 gs.record_threshold_stats(float(tau), float(sp),
                                           trainer="parallel")
+            rows = _diag.process_if_due(model, dv, "exchange",
+                                        model.iteration_count)
             if self.stats is not None:
                 self.stats.record("sync_step", time.perf_counter() - t0,
                                   iteration=model.iteration_count)
@@ -780,7 +789,8 @@ class ParallelTrainer:
                                      model.epoch_count,
                                      model.score_value if eager_loss
                                      else float("nan"),
-                                     batch_size=ds.num_examples())
+                                     batch_size=ds.num_examples(),
+                                     diagnostics=rows[-1] if rows else None)
             model.iteration_count += 1
 
         def drain(pending):
@@ -799,7 +809,8 @@ class ParallelTrainer:
             rngs = jax.vmap(lambda i: jax.random.fold_in(rng_root, i))(
                 jnp.arange(it0, it0 + len(pending)))
             t0 = time.perf_counter()
-            params, upd_r, state, res_r, tau, losses, sps = self._thr_multi(
+            (params, upd_r, state, res_r, tau, losses, sps,
+             dvs) = self._thr_multi(
                 params, upd_r, state, it0, res_r, tau, xs, ys, rngs)
             last_loss, last_sparsity = losses, sps
             gs.record_exchange("threshold", wire_b, dense_b, len(pending),
@@ -809,6 +820,8 @@ class ParallelTrainer:
                 gs.record_threshold_stats(float(tau),
                                           float(np.asarray(sps)[-1]),
                                           trainer="parallel")
+            rows = _diag.process_if_due(model, dvs, "exchange", it0,
+                                        steps=len(pending))
             if self.stats is not None:
                 self.stats.record("sync_step", time.perf_counter() - t0,
                                   iteration=it0, fused_steps=len(pending))
@@ -822,7 +835,12 @@ class ParallelTrainer:
                                          else float("nan"),
                                          batch_size=d.num_examples(),
                                          step_boundary=(
-                                             j == len(pending) - 1))
+                                             j == len(pending) - 1),
+                                         diagnostics=(
+                                             rows[j] if rows
+                                             and model._diag.due(
+                                                 model.iteration_count)
+                                             else None))
                 model.iteration_count += 1
 
         model._live_state_provider = live_state
@@ -976,7 +994,7 @@ class ParallelTrainer:
             y = _gput(ds.labels, batch_sh)
             rng = jax.random.fold_in(rng_root, model.iteration_count)
             t0 = time.perf_counter()
-            params, upd_r, state, res_r, tau, loss, sp = self._bkt_step(
+            params, upd_r, state, res_r, tau, loss, sp, dv = self._bkt_step(
                 params, upd_r, state, model.iteration_count, res_r, tau,
                 x, y, rng)
             last_loss, last_sparsity = loss, sp
@@ -987,6 +1005,8 @@ class ParallelTrainer:
                     gs.record_threshold_stats(gs.tau_scalar(tau),
                                               float(sp),
                                               trainer="parallel")
+            rows = _diag.process_if_due(model, dv, "exchange",
+                                        model.iteration_count)
             if self.stats is not None:
                 self.stats.record("sync_step", time.perf_counter() - t0,
                                   iteration=model.iteration_count)
@@ -995,7 +1015,8 @@ class ParallelTrainer:
                                      model.epoch_count,
                                      model.score_value if eager_loss
                                      else float("nan"),
-                                     batch_size=ds.num_examples())
+                                     batch_size=ds.num_examples(),
+                                     diagnostics=rows[-1] if rows else None)
             model.iteration_count += 1
 
         def drain(pending):
@@ -1014,7 +1035,8 @@ class ParallelTrainer:
             rngs = jax.vmap(lambda i: jax.random.fold_in(rng_root, i))(
                 jnp.arange(it0, it0 + len(pending)))
             t0 = time.perf_counter()
-            params, upd_r, state, res_r, tau, losses, sps = self._bkt_multi(
+            (params, upd_r, state, res_r, tau, losses, sps,
+             dvs) = self._bkt_multi(
                 params, upd_r, state, it0, res_r, tau, xs, ys, rngs)
             last_loss, last_sparsity = losses, sps
             record(len(pending))
@@ -1023,6 +1045,8 @@ class ParallelTrainer:
                 gs.record_threshold_stats(gs.tau_scalar(tau),
                                           float(np.asarray(sps)[-1]),
                                           trainer="parallel")
+            rows = _diag.process_if_due(model, dvs, "exchange", it0,
+                                        steps=len(pending))
             if self.stats is not None:
                 self.stats.record("sync_step", time.perf_counter() - t0,
                                   iteration=it0, fused_steps=len(pending))
@@ -1036,7 +1060,12 @@ class ParallelTrainer:
                                          else float("nan"),
                                          batch_size=d.num_examples(),
                                          step_boundary=(
-                                             j == len(pending) - 1))
+                                             j == len(pending) - 1),
+                                         diagnostics=(
+                                             rows[j] if rows
+                                             and model._diag.due(
+                                                 model.iteration_count)
+                                             else None))
                 model.iteration_count += 1
 
         model._live_state_provider = live_state
@@ -1202,13 +1231,15 @@ class ParallelTrainer:
                 y = _gput(ds.labels, batch_sh)
                 rng = jax.random.fold_in(rng_root, model.iteration_count)
                 t0 = time.perf_counter()
-                params, upd, state, loss, _ = self._sync_step(
+                params, upd, state, loss, _, dv = self._sync_step(
                     params, upd, state, model.iteration_count, x, y, rng)
                 gs.record_exchange("dense", dense_b, dense_b, 1,
                                    trainer="parallel")
                 last_loss = loss
                 if eager_loss:
                     model.score_value = float(loss)
+                rows = _diag.process_if_due(model, dv, "fit",
+                                            model.iteration_count)
                 if self.stats is not None:
                     # float(loss) above already synced the step
                     self.stats.record("sync_step",
@@ -1221,7 +1252,9 @@ class ParallelTrainer:
                                          model.epoch_count,
                                          model.score_value if eager_loss
                                          else float("nan"),
-                                         batch_size=ds.num_examples())
+                                         batch_size=ds.num_examples(),
+                                         diagnostics=rows[-1] if rows
+                                         else None)
                 model.iteration_count += 1
 
             def drain(pending):
@@ -1239,12 +1272,14 @@ class ParallelTrainer:
                 rngs = jax.vmap(lambda i: jax.random.fold_in(rng_root, i))(
                     jnp.arange(it0, it0 + len(pending)))
                 t0 = time.perf_counter()
-                params, upd, state, losses = self._sync_multi(
+                params, upd, state, losses, dvs = self._sync_multi(
                     params, upd, state, it0, xs, ys, rngs)
                 gs.record_exchange("dense", dense_b, dense_b, len(pending),
                                    trainer="parallel")
                 last_loss = losses
                 lv = np.asarray(losses) if eager_loss else None
+                rows = _diag.process_if_due(model, dvs, "fit", it0,
+                                            steps=len(pending))
                 if self.stats is not None:
                     self.stats.record("sync_step",
                                       time.perf_counter() - t0, iteration=it0,
@@ -1259,7 +1294,12 @@ class ParallelTrainer:
                                              else float("nan"),
                                              batch_size=d.num_examples(),
                                              step_boundary=(
-                                                 j == len(pending) - 1))
+                                                 j == len(pending) - 1),
+                                             diagnostics=(
+                                                 rows[j] if rows
+                                                 and model._diag.due(
+                                                     model.iteration_count)
+                                                 else None))
                     model.iteration_count += 1
 
             model._live_state_provider = live_state

@@ -25,6 +25,12 @@ _MESSAGES = {
         "profile": "Profile", "title.profile": "AOT Cost / Profile",
         "profile.summary": "cost summary",
         "profile.top_ops": "top ops by FLOPs",
+        "health": "training health",
+        "grad_norm": "mean |grad|",
+        "watchdog": "watchdog non-finite steps",
+        "act_stats": "activation stats",
+        "act_layer": "layer", "act_mean": "mean", "act_std": "std",
+        "act_dead": "dead fraction",
     },
     "ja": {
         "overview": "概要", "model": "モデル", "system": "システム",
@@ -45,6 +51,12 @@ _MESSAGES = {
         "profile": "プロファイル", "title.profile": "AOTコスト / プロファイル",
         "profile.summary": "コスト概要",
         "profile.top_ops": "FLOPs上位オペレーション",
+        "health": "学習ヘルス",
+        "grad_norm": "平均 |勾配|",
+        "watchdog": "ウォッチドッグ非有限ステップ数",
+        "act_stats": "活性化統計",
+        "act_layer": "レイヤー", "act_mean": "平均", "act_std": "標準偏差",
+        "act_dead": "デッド率",
     },
     "zh": {
         "overview": "概览", "model": "模型", "system": "系统",
@@ -65,6 +77,12 @@ _MESSAGES = {
         "profile": "性能分析", "title.profile": "AOT成本 / 性能分析",
         "profile.summary": "成本摘要",
         "profile.top_ops": "按FLOPs排序的算子",
+        "health": "训练健康",
+        "grad_norm": "平均 |梯度|",
+        "watchdog": "看门狗非有限步数",
+        "act_stats": "激活统计",
+        "act_layer": "层", "act_mean": "均值", "act_std": "标准差",
+        "act_dead": "死亡比例",
     },
 }
 

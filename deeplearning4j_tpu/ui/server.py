@@ -230,6 +230,58 @@ class UIServer:
                 perf.add_series(self._tr("examples_per_sec"), xs,
                                 [r.examples_per_sec for r in reports])
                 body.append(perf.render())
+            # training-health strip — REAL in-graph internals from the
+            # diagnostics feed (monitor/diagnostics.py): mean gradient
+            # magnitude across params, mean update:param ratio, and the
+            # watchdog's non-finite step count
+            grad_reports = [r for r in reports
+                            if getattr(r, "gradient_mean_magnitudes", None)]
+            if grad_reports:
+                health = ChartLine(title=self._tr("health"))
+                health.add_series(
+                    self._tr("grad_norm"),
+                    [r.iteration for r in grad_reports],
+                    [float(np.mean(list(r.gradient_mean_magnitudes
+                                        .values())))
+                     for r in grad_reports])
+                # ratios of 0 (frozen layers, zero-grad biases) have no
+                # log — average only the positive ones, and emit a
+                # point only where one exists (a NaN coordinate would
+                # poison the whole chart's axis bounds)
+                ratio_pts = []
+                for r in grad_reports:
+                    pos = [math.log10(v)
+                           for v in getattr(r, "update_ratios",
+                                            {}).values() if v > 0]
+                    if pos:
+                        ratio_pts.append((r.iteration,
+                                          float(np.mean(pos))))
+                if ratio_pts:
+                    health.add_series(
+                        self._tr("update_ratio"),
+                        [p[0] for p in ratio_pts],
+                        [p[1] for p in ratio_pts])
+                body.append(health.render())
+            wd = [r for r in reports
+                  if getattr(r, "watchdog_nonfinite", 0)]
+            if wd:
+                wchart = ChartLine(title=self._tr("watchdog"))
+                wchart.add_series(self._tr("watchdog"),
+                                  [r.iteration for r in wd],
+                                  [float(r.watchdog_nonfinite)
+                                   for r in wd])
+                body.append(wchart.render())
+            act_latest = next(
+                (r for r in reversed(reports)
+                 if getattr(r, "activation_stats", None)), None)
+            if act_latest is not None:
+                body.append(ComponentTable(
+                    [self._tr("act_layer"), self._tr("act_mean"),
+                     self._tr("act_std"), self._tr("act_dead")],
+                    [(k, f"{m:.4g}", f"{s:.4g}", f"{d:.3f}")
+                     for k, (m, s, d)
+                     in sorted(act_latest.activation_stats.items())],
+                    title=self._tr("act_stats")).render())
         if len(body) == 1:
             body.append(f"<p>{self._tr('no_sessions')}</p>")
         return self._page(self._tr("title.overview"), "".join(body))

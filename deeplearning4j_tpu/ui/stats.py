@@ -7,6 +7,23 @@ histograms and mean magnitudes, memory and runtime info, written as a
 (`stats/sbe/UpdateEncoder.java`) become a compact struct-packed binary
 codec here (same role: a stable, versioned wire format the UI and
 storage share).
+
+Since the diagnostics PR, StatsListener consumes the REAL training
+internals: when the model runs with diagnostics enabled
+(monitor/diagnostics.py), the per-layer gradient/update magnitudes,
+update:param ratios and activation stats come from the fused train
+step's aux outputs (`model._last_diagnostics` / the
+``info["diagnostics"]`` callback payload) — true updates, not
+param-delta approximations — and the parameter readback that remains is
+ONE batched device→host transfer (`diagnostics.batched_host_tree`)
+instead of one per leaf. Models without the diagnostics seam fall back
+to the param-delta approximation, exactly as before.
+
+Wire compatibility: the codec is versioned. v1 payloads (pre-
+diagnostics) decode unchanged with empty new tables; v2 appends the
+gradient/ratio/activation tables and the watchdog counter AFTER the v1
+payload, so old decoders reading only their own fields keep working on
+a v2 prefix layout-wise identical to v1.
 """
 
 from __future__ import annotations
@@ -21,7 +38,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 _MAGIC = b"DL4JSTAT"
-_VERSION = 1
+_VERSION = 2
 
 
 def _rss_mb() -> float:
@@ -51,10 +68,19 @@ class StatsReport:
         dataclasses.field(default_factory=dict)
     # system
     memory_rss_mb: float = 0.0
+    # v2 (diagnostics feed): true per-param gradient magnitudes +
+    # update:param ratios, per-layer activation stats, watchdog count
+    gradient_mean_magnitudes: Dict[str, float] = \
+        dataclasses.field(default_factory=dict)
+    update_ratios: Dict[str, float] = dataclasses.field(default_factory=dict)
+    activation_stats: Dict[str, Tuple[float, float, float]] = \
+        dataclasses.field(default_factory=dict)  # (mean, std, dead)
+    watchdog_nonfinite: int = 0
 
     # ------------------------------------------------- binary wire format
     def encode(self) -> bytes:
-        """Compact binary encoding (SBE-equivalent role)."""
+        """Compact binary encoding (SBE-equivalent role). v2 appends
+        the diagnostics tables after the complete v1 payload."""
         def pack_str(s: str) -> bytes:
             b = s.encode("utf-8")
             return struct.pack("<H", len(b)) + b
@@ -77,12 +103,24 @@ class StatsReport:
             out.append(struct.pack("<H", len(counts)))
             out.append(np.asarray(edges, np.float64).tobytes())
             out.append(np.asarray(counts, np.int64).tobytes())
+        # ---- v2 extension block (absent in v1 payloads) ----
+        for table in (self.gradient_mean_magnitudes, self.update_ratios):
+            out.append(struct.pack("<H", len(table)))
+            for k, v in table.items():
+                out.append(pack_str(k))
+                out.append(struct.pack("<d", v))
+        out.append(struct.pack("<H", len(self.activation_stats)))
+        for k, (m, s, d) in self.activation_stats.items():
+            out.append(pack_str(k))
+            out.append(struct.pack("<ddd", m, s, d))
+        out.append(struct.pack("<q", self.watchdog_nonfinite))
         return b"".join(out)
 
     @staticmethod
     def decode(data: bytes) -> "StatsReport":
         if data[:8] != _MAGIC:
             raise ValueError("Not a DL4JSTAT payload (bad magic)")
+        (version,) = struct.unpack_from("<H", data, 8)
         pos = [10]
 
         def unpack_str() -> str:
@@ -92,14 +130,7 @@ class StatsReport:
             pos[0] += n
             return s
 
-        session_id = unpack_str()
-        worker_id = unpack_str()
-        it, ep, ts, score, itms, eps = struct.unpack_from("<qqdddd", data, pos[0])
-        pos[0] += struct.calcsize("<qqdddd")
-        (rss,) = struct.unpack_from("<d", data, pos[0])
-        pos[0] += 8
-        tables = []
-        for _ in range(2):
+        def unpack_table() -> Dict[str, float]:
             (n,) = struct.unpack_from("<H", data, pos[0])
             pos[0] += 2
             t = {}
@@ -108,7 +139,15 @@ class StatsReport:
                 (v,) = struct.unpack_from("<d", data, pos[0])
                 pos[0] += 8
                 t[k] = v
-            tables.append(t)
+            return t
+
+        session_id = unpack_str()
+        worker_id = unpack_str()
+        it, ep, ts, score, itms, eps = struct.unpack_from("<qqdddd", data, pos[0])
+        pos[0] += struct.calcsize("<qqdddd")
+        (rss,) = struct.unpack_from("<d", data, pos[0])
+        pos[0] += 8
+        tables = [unpack_table(), unpack_table()]
         (nh,) = struct.unpack_from("<H", data, pos[0])
         pos[0] += 2
         hists = {}
@@ -121,8 +160,22 @@ class StatsReport:
             counts = np.frombuffer(data, np.int64, nb, pos[0]).tolist()
             pos[0] += 8 * nb
             hists[k] = (edges, counts)
-        return StatsReport(session_id, worker_id, it, ep, ts, score,
-                           itms, eps, tables[0], tables[1], hists, rss)
+        report = StatsReport(session_id, worker_id, it, ep, ts, score,
+                             itms, eps, tables[0], tables[1], hists, rss)
+        if version >= 2:
+            report.gradient_mean_magnitudes = unpack_table()
+            report.update_ratios = unpack_table()
+            (na,) = struct.unpack_from("<H", data, pos[0])
+            pos[0] += 2
+            for _ in range(na):
+                k = unpack_str()
+                m, s, d = struct.unpack_from("<ddd", data, pos[0])
+                pos[0] += 24
+                report.activation_stats[k] = (m, s, d)
+            (report.watchdog_nonfinite,) = struct.unpack_from(
+                "<q", data, pos[0])
+            pos[0] += 8
+        return report
 
 
 class StatsListener:
@@ -130,7 +183,20 @@ class StatsListener:
 
     `update_frequency`: collect every N iterations (reference
     listenerFrequency). Histograms are optional (more device→host
-    traffic)."""
+    traffic).
+
+    Data sources, in order of preference:
+    - the diagnostics aux (``info["diagnostics"]`` /
+      ``model._last_diagnostics``): TRUE per-param gradient/update
+      magnitudes, update:param ratios, activation stats, watchdog
+      count, and (when the diagnostics config enables them) in-graph
+      parameter histograms — zero extra transfers beyond the
+      diagnostics readback the fit loop already performed;
+    - the model's params, fetched in ONE batched transfer
+      (`diagnostics.batched_host_tree`) — used for param magnitudes
+      without a diagnostics seam, for host-side histograms, and for
+      the param-delta update fallback.
+    """
 
     def __init__(self, storage, session_id: str = "default",
                  worker_id: str = "worker0", update_frequency: int = 1,
@@ -162,22 +228,73 @@ class StatsListener:
             examples_per_sec=(batch / (dt_ms / 1e3) if dt_ms > 0 and batch else 0.0),
             memory_rss_mb=_rss_mb(),
         )
+        # on-cadence fit loops pass the fresh readback in the callback;
+        # an EXPLICIT None means "off-cadence this step" — fall back to
+        # the param-delta path rather than relabeling the model's stale
+        # last readback with the current iteration number. The model
+        # attribute is only consulted when the caller never passed the
+        # key at all (listeners driven outside the fit loops).
+        diag = (info["diagnostics"] if "diagnostics" in info
+                else getattr(model, "_last_diagnostics", None))
+        diag_params = (diag or {}).get("params") or {}
+        diag_hists = (diag or {}).get("hists") or {}
+        # host params are needed only when something below reads raw
+        # arrays: no diagnostics seam, or host-side histograms
+        need_host = (not diag_params
+                     or (self.collect_histograms and not diag_hists))
+        host_params = None
+        if need_host:
+            from deeplearning4j_tpu.monitor.diagnostics import (
+                batched_host_tree)
+            host_params = batched_host_tree(model.params)
         for lk, lparams in model.params.items():
-            for pn, arr in lparams.items():
-                a = np.asarray(arr)
+            for pn in lparams:
                 key = f"{lk}_{pn}"
-                report.param_mean_magnitudes[key] = float(np.mean(np.abs(a)))
-                prev = self._prev_params.get(key)
-                if prev is not None and prev.shape == a.shape:
-                    # update magnitude = |param delta| since last report
-                    # (reference BaseStatsListener update stats)
-                    report.update_mean_magnitudes[key] = float(
-                        np.mean(np.abs(a - prev)))
-                self._prev_params[key] = a
+                d = diag_params.get(key)
+                if d is not None:
+                    report.param_mean_magnitudes[key] = float(d["param_mm"])
+                    # TRUE update magnitude from the fused step's aux —
+                    # not a param-delta approximation
+                    report.update_mean_magnitudes[key] = float(d["upd_mm"])
+                    report.update_ratios[key] = float(d["ratio"])
+                    if "grad_mm" in d:
+                        report.gradient_mean_magnitudes[key] = \
+                            float(d["grad_mm"])
+                else:
+                    a = np.asarray(host_params[lk][pn])
+                    report.param_mean_magnitudes[key] = \
+                        float(np.mean(np.abs(a)))
+                    prev = self._prev_params.get(key)
+                    if prev is not None and prev.shape == a.shape:
+                        # fallback: |param delta| since last report
+                        # (reference BaseStatsListener update stats)
+                        report.update_mean_magnitudes[key] = float(
+                            np.mean(np.abs(a - prev)))
+                    self._prev_params[key] = a
                 if self.collect_histograms:
-                    counts, edges = np.histogram(a, bins=self.histogram_bins)
-                    report.param_histograms[key] = (edges.tolist(),
-                                                    counts.tolist())
+                    hv = diag_hists.get(key)
+                    if hv is not None and diag is not None:
+                        # fixed-bin in-graph histogram from the aux
+                        md = getattr(model, "_diag", None)
+                        r = (md.config.histogram_range
+                             if md is not None else 1.0)
+                        edges = np.linspace(-r, r, len(hv) + 1)
+                        report.param_histograms[key] = (
+                            edges.tolist(),
+                            np.asarray(hv, np.int64).tolist())
+                    else:
+                        a = np.asarray(host_params[lk][pn])
+                        counts, edges = np.histogram(
+                            a, bins=self.histogram_bins)
+                        report.param_histograms[key] = (edges.tolist(),
+                                                        counts.tolist())
+        if diag is not None:
+            for lk, st in (diag.get("activations") or {}).items():
+                report.activation_stats[lk] = (
+                    float(st["mean"]), float(st["std"]), float(st["dead"]))
+            md = getattr(model, "_diag", None)
+            if md is not None:
+                report.watchdog_nonfinite = int(md.nonfinite_total)
         self.storage.put_report(report)
 
     def on_epoch_start(self, model, epoch):

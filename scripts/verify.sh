@@ -38,13 +38,21 @@
 #    params/updater state, bf16 gradients, and the fused-Adam Pallas
 #    kernel bit-comparable (inside jit) to the jnp updater path in
 #    interpret mode. The hlo_cost `precision` block (bf16 bytes <
-#    fp32 bytes) is asserted in step [4/7] where the reports are
+#    fp32 bytes) is asserted in step [4/8] where the reports are
 #    already on disk.
+# 8. Diagnostics smoke: tiny-MLP run with an injected lr spike
+#    producing non-finite gradients mid-run — the in-graph watchdog's
+#    `skip` policy must keep the trajectory finite (and training must
+#    recover), `watchdog_nonfinite_total` must increment on /metrics,
+#    `halt` must raise NonFiniteGradientsError naming the offending
+#    layers, and the /train overview must serve the real per-layer
+#    grad/update/activation stats (docs/OBSERVABILITY.md "Model
+#    internals & training health").
 
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== [1/7] tier-1 tests (ROADMAP.md verbatim) =="
+echo "== [1/8] tier-1 tests (ROADMAP.md verbatim) =="
 # stale-report guard: a timeout-killed suite never reaches
 # pytest_sessionfinish, and step [2/3] must not read the previous
 # run's durations as this run's
@@ -52,7 +60,7 @@ rm -f "${DL4J_SUITE_DURATIONS:-/tmp/_t1_durations.json}"
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
 tier1_rc=$?
 
-echo "== [2/7] suite duration budget =="
+echo "== [2/8] suite duration budget =="
 python - <<'EOF'
 import json
 import os
@@ -79,7 +87,7 @@ if total > soft:
           "mark 'slow' the top offenders above before adding tests.")
 EOF
 
-echo "== [3/7] /metrics smoke =="
+echo "== [3/8] /metrics smoke =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import sys
 import urllib.request
@@ -121,7 +129,7 @@ print(f"/metrics smoke OK ({len(body.splitlines())} exposition lines, "
 EOF
 smoke_rc=$?
 
-echo "== [4/7] AOT cost smoke (hlo_cost --all) =="
+echo "== [4/8] AOT cost smoke (hlo_cost --all) =="
 hlo_out=$(mktemp -d)
 timeout -k 10 840 env JAX_PLATFORMS=cpu \
     python -m benchtools.hlo_cost --all --batch 8 --steps 2 --out "$hlo_out"
@@ -205,7 +213,7 @@ EOF
 hlo_rc=$?
 rm -rf "$hlo_out"
 
-echo "== [5/7] gradient-sharing smoke (dense vs threshold) =="
+echo "== [5/8] gradient-sharing smoke (dense vs threshold) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
     timeout -k 10 300 python - <<'PYEOF'
 import numpy as np
@@ -273,7 +281,7 @@ print(f"gradient-sharing smoke OK (init={init:.3f} dense={d:.3f} "
 PYEOF
 gs_rc=$?
 
-echo "== [6/7] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
+echo "== [6/8] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
 # train 30 steps on a tiny MLP in a child process, SIGTERM at step 15
 # (async checkpoint every 5, atomic tmp+fsync+rename commits), auto-
 # resume from the newest valid checkpoint, and require the final
@@ -282,7 +290,7 @@ echo "== [6/7] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python scripts/fault_drill.py --smoke
 drill_rc=$?
 
-echo "== [7/7] mixed-precision smoke (bf16 trajectory + fused-Adam parity) =="
+echo "== [7/8] mixed-precision smoke (bf16 trajectory + fused-Adam parity) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PYEOF'
 import jax
 import jax.numpy as jnp
@@ -371,8 +379,99 @@ print(f"mixed-precision smoke OK (init={init:.3f} fp32={d:.3f} "
 PYEOF
 mp_rc=$?
 
-echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc} mp_rc=${mp_rc}"
-if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ] || [ "$mp_rc" -ne 0 ]; then
+echo "== [8/8] diagnostics smoke (watchdog drill + real UI feed) =="
+JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PYEOF'
+import urllib.request
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.common.updaters import Sgd
+from deeplearning4j_tpu.monitor.diagnostics import NonFiniteGradientsError
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import UIServer
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+from deeplearning4j_tpu.common.schedules import MapSchedule
+
+monitor.enable()
+
+
+def build(watchdog, lr):
+    # lr spike at iteration 5: an inf-scale step turns finite
+    # gradients into a non-finite update (the silent numeric failure
+    # mode arXiv:2606.15870 names; the watchdog's job). `skip` must
+    # discard exactly that step and keep training.
+    b = (NeuralNetConfiguration.builder().seed(7)
+         .updater(Sgd(MapSchedule({0: lr, 5: float("inf"), 6: lr}))))
+    lb = b.list()
+    for _ in range(3):
+        lb = lb.layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+    return MultiLayerNetwork(
+        (lb.layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                              loss="mcxent"))
+           .set_input_type(InputType.feed_forward(16))
+           .diagnostics(watchdog).build())).init()
+
+
+rng = np.random.default_rng(0)
+x = rng.standard_normal((320, 16)).astype(np.float32)
+w = rng.standard_normal((16, 4))
+y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+storage = InMemoryStatsStorage()
+net = build("skip", 0.2)
+init_score = float(net.score(DataSet(x, y)))
+net.set_listeners(StatsListener(storage))
+net.fit(x, y, epochs=3, batch_size=32, shuffle=False)   # 30 steps
+finite = all(np.isfinite(np.asarray(l)).all()
+             for l in jax.tree_util.tree_leaves(net.params))
+assert finite, "skip policy let non-finite values into the params"
+assert net._diag.skipped_total == 1, \
+    f"expected exactly the spike step skipped, got {net._diag.skipped_total}"
+final_score = float(net.score(DataSet(x, y)))
+assert final_score < 0.7 * init_score, \
+    f"training did not recover past the skipped spike: " \
+    f"{init_score} -> {final_score}"
+
+reg = monitor.registry()
+assert reg.counter("watchdog_nonfinite_total").value >= 1
+assert reg.counter("watchdog_skipped_total").value >= 1
+
+# halt must raise a NAMED exception carrying the offending layer keys
+try:
+    build("halt", 0.2).fit(x, y, epochs=1, batch_size=32, shuffle=False)
+    raise SystemExit("halt policy did not raise")
+except NonFiniteGradientsError as e:
+    assert e.layer_keys, e
+
+server = UIServer().start()
+try:
+    server.attach(storage)
+    base = f"http://127.0.0.1:{server.port}"
+    html = urllib.request.urlopen(base + "/train/overview",
+                                  timeout=10).read().decode()
+    assert "training health" in html and "mean |grad|" in html, html[:400]
+    mtext = urllib.request.urlopen(base + "/metrics",
+                                   timeout=10).read().decode()
+    for fam in ("training_update_ratio", "training_grad_l2",
+                "watchdog_nonfinite_total"):
+        assert fam in mtext, f"{fam} missing from /metrics"
+finally:
+    server.stop()
+print(f"diagnostics smoke OK (skipped={net._diag.skipped_total}, "
+      f"nonfinite={net._diag.nonfinite_total}, halt raised, "
+      f"/train + /metrics serve real stats)")
+PYEOF
+diag_rc=$?
+
+echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc} mp_rc=${mp_rc} diag_rc=${diag_rc}"
+if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ] || [ "$mp_rc" -ne 0 ] || [ "$diag_rc" -ne 0 ]; then
     exit 1
 fi
 echo "VERIFY OK"

@@ -1,0 +1,396 @@
+"""In-graph model-internals diagnostics (monitor/diagnostics.py).
+
+Contracts under test (ISSUE 8 acceptance criteria):
+- trajectory BIT-parity diagnostics-on vs -off: plain, fused spe=3,
+  scan_layers deep stacks, mixed_bf16, threshold gradient sharing,
+  graph container — watchdog "warn" included;
+- packed-run per-layer keying: stats are keyed per layer and agree
+  whether or not the run executes as a `lax.scan` (scan-config
+  independence, like checkpoints);
+- watchdog policies: warn counts + logs, skip discards the bad update
+  in-graph and counts it, halt raises NonFiniteGradientsError naming
+  the offending layers;
+- transfer contract: at listener cadence the stats arrive in ≤1
+  batched d2h transfer (asserted on the jax_transfers_total counter),
+  off-cadence steps add ZERO transfers;
+- resolution/serde: DL4J_DIAGNOSTICS env > arg > conf, config
+  round-trips through both configurations' serde.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.monitor.diagnostics import (
+    DiagnosticsConfig,
+    NonFiniteGradientsError,
+    as_diagnostics,
+    resolve_diagnostics,
+)
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _mlp_conf(depth=3, diagnostics=None, scan_layers=True, policy=None,
+              updater=None):
+    b = NeuralNetConfiguration.builder().seed(7)
+    if updater is not None:
+        b = b.updater(updater)
+    if policy is not None:
+        b = b.dtype_policy(policy)
+    lb = b.list()
+    for _ in range(depth):
+        lb = lb.layer(DenseLayer(n_in=8, n_out=8, activation="tanh"))
+    lb = lb.layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss="mcxent"))
+    lb = lb.scan_layers(scan_layers)
+    if diagnostics is not None:
+        lb = lb.diagnostics(diagnostics)
+    return lb.build()
+
+
+def _net(**kw):
+    return MultiLayerNetwork(_mlp_conf(**kw)).init()
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+def _bit_equal(a, b):
+    la = jax.tree_util.tree_leaves(a.params)
+    lb = jax.tree_util.tree_leaves(b.params)
+    return all(np.array_equal(np.asarray(u), np.asarray(v))
+               for u, v in zip(la, lb))
+
+
+class TestBitParity:
+    """Enabling diagnostics must not move a single bit of the
+    trajectory (aux outputs only) — including watchdog 'warn'."""
+
+    def test_plain(self):
+        x, y = _data()
+        off = _net()
+        off.fit(x, y, epochs=2, batch_size=8, shuffle=False)
+        on = _net(diagnostics="warn")
+        on.fit(x, y, epochs=2, batch_size=8, shuffle=False)
+        assert _bit_equal(off, on)
+        d = on._last_diagnostics
+        assert set(d["params"]["0_W"]) == {
+            "grad_mm", "grad_l2", "upd_mm", "upd_l2", "param_mm",
+            "param_l2", "ratio"}
+        assert not d["nonfinite"]
+
+    def test_fused_spe3(self):
+        x, y = _data(48)
+        off = _net()
+        off.fit(x, y, epochs=2, batch_size=8, shuffle=False,
+                steps_per_execution=3)
+        on = _net(diagnostics="warn")
+        on.fit(x, y, epochs=2, batch_size=8, shuffle=False,
+               steps_per_execution=3)
+        assert _bit_equal(off, on)
+        assert on._last_diagnostics is not None
+
+    def test_scan_deep_stack(self):
+        x, y = _data()
+        off = _net(depth=6)
+        off.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        on = _net(depth=6, diagnostics=True)
+        on.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        assert _bit_equal(off, on)
+        # per-layer keys despite the stacked:: packed run
+        assert {f"{i}_W" for i in range(6)} <= set(
+            on._last_diagnostics["params"])
+
+    def test_mixed_bf16(self):
+        x, y = _data()
+        off = _net(policy="mixed_bf16")
+        off.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        on = _net(policy="mixed_bf16", diagnostics="warn")
+        on.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        assert _bit_equal(off, on)
+        # stats computed fp32 (host dicts are python floats; the check
+        # is that values are finite and sane, not bf16-quantized zeros)
+        st = on._last_diagnostics["params"]["0_W"]
+        assert st["grad_l2"] > 0 and np.isfinite(st["ratio"])
+
+    def test_graph_container(self):
+        def build(diag=None):
+            gb = (ComputationGraphConfiguration.graph_builder(
+                NeuralNetConfiguration.builder().seed(3))
+                .add_inputs("in"))
+            prev = "in"
+            for i in range(3):
+                gb.add_layer(f"d{i}",
+                             DenseLayer(n_in=8, n_out=8,
+                                        activation="tanh"), prev)
+                prev = f"d{i}"
+            gb.add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                            activation="softmax",
+                                            loss="mcxent"), prev)
+            gb.set_outputs("out")
+            if diag is not None:
+                gb.diagnostics(diag)
+            return ComputationGraph(gb.build()).init()
+
+        x, y = _data()
+        off = build()
+        off.fit(x, y, epochs=1, batch_size=8, steps_per_execution=2)
+        on = build("warn")
+        on.fit(x, y, epochs=1, batch_size=8, steps_per_execution=2)
+        assert _bit_equal(off, on)
+        assert "d1_W" in on._last_diagnostics["params"]
+        assert "d0" in on._last_diagnostics["activations"]
+
+    def test_threshold_gradient_sharing(self):
+        from deeplearning4j_tpu.parallel.mesh import device_mesh
+        from deeplearning4j_tpu.parallel.trainer import ParallelTrainer
+
+        x, y = _data(64)
+        off = _net(updater=Adam(0.01))
+        ParallelTrainer(off, device_mesh(), mode="sync",
+                        gradient_sharing="threshold").fit(
+            x, y, epochs=1, batch_size=16, steps_per_execution=2)
+        on = _net(updater=Adam(0.01), diagnostics="warn")
+        ParallelTrainer(on, device_mesh(), mode="sync",
+                        gradient_sharing="threshold").fit(
+            x, y, epochs=1, batch_size=16, steps_per_execution=2)
+        assert _bit_equal(off, on)
+        # exchange-path stats: POST-exchange updates (no grad stats —
+        # gradients live inside the VJP hooks)
+        st = on._last_diagnostics["params"]["0_W"]
+        assert "upd_mm" in st and "grad_mm" not in st
+
+
+class TestPackedRunKeying:
+    """Per-layer stats must be independent of the scan configuration
+    (axis-0 reductions over the packed run — never unpacked)."""
+
+    def test_scan_on_off_same_keys_same_values(self):
+        x, y = _data()
+        scan = _net(depth=5, diagnostics=True, scan_layers=True)
+        scan.fit(x, y, epochs=1, batch_size=32, shuffle=False)
+        unrolled = _net(depth=5, diagnostics=True, scan_layers=False)
+        unrolled.fit(x, y, epochs=1, batch_size=32, shuffle=False)
+        ds, du = scan._last_diagnostics, unrolled._last_diagnostics
+        assert set(ds["params"]) == set(du["params"])
+        for key in ds["params"]:
+            for st in ds["params"][key]:
+                np.testing.assert_allclose(
+                    ds["params"][key][st], du["params"][key][st],
+                    rtol=2e-4, atol=1e-7, err_msg=f"{key}.{st}")
+        assert set(ds["activations"]) == set(du["activations"])
+        for lk in ds["activations"]:
+            for st in ds["activations"][lk]:
+                np.testing.assert_allclose(
+                    ds["activations"][lk][st],
+                    du["activations"][lk][st], rtol=2e-4, atol=1e-7)
+
+
+class TestWatchdog:
+    def _poisoned(self):
+        x, y = _data(24)
+        xb = x.copy()
+        xb[8:16] = np.inf  # second batch non-finite
+        return xb, y
+
+    def test_warn_counts_and_preserves_trajectory(self):
+        xb, y = self._poisoned()
+        plain = _net()
+        plain.fit(xb, y, epochs=1, batch_size=8, shuffle=False)
+        warn = _net(diagnostics="warn")
+        warn.fit(xb, y, epochs=1, batch_size=8, shuffle=False)
+        # warn never touches the update — trajectories match even
+        # through the non-finite region (NaN == NaN positionally)
+        for u, v in zip(jax.tree_util.tree_leaves(plain.params),
+                        jax.tree_util.tree_leaves(warn.params)):
+            assert np.array_equal(np.asarray(u), np.asarray(v),
+                                  equal_nan=True)
+        assert warn._diag.nonfinite_total >= 1
+        assert warn._diag.skipped_total == 0
+
+    def test_skip_discards_in_graph_and_counts(self):
+        xb, y = self._poisoned()
+        net = _net(diagnostics="skip")
+        net.fit(xb, y, epochs=1, batch_size=8, shuffle=False)
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree_util.tree_leaves(net.params))
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree_util.tree_leaves(net.updater_state))
+        assert net._diag.skipped_total == 1
+        assert net._diag.nonfinite_total == 1
+
+    def test_skip_healthy_trajectory_bit_identical(self):
+        x, y = _data()
+        off = _net()
+        off.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        on = _net(diagnostics="skip")
+        on.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        assert _bit_equal(off, on)
+        assert on._diag.skipped_total == 0
+
+    def test_halt_raises_with_layer_keys(self):
+        xb, y = self._poisoned()
+        net = _net(diagnostics="halt")
+        with pytest.raises(NonFiniteGradientsError) as ei:
+            net.fit(xb, y, epochs=1, batch_size=8, shuffle=False)
+        assert ei.value.iteration == 1
+        assert ei.value.layer_keys  # offending layers named
+
+    def test_skip_in_fused_group(self):
+        xb, y = self._poisoned()
+        net = _net(diagnostics="skip")
+        net.fit(xb, y, epochs=1, batch_size=8, shuffle=False,
+                steps_per_execution=3)
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree_util.tree_leaves(net.params))
+        assert net._diag.skipped_total == 1
+
+    def test_watchdog_registry_counters(self):
+        reg = MetricsRegistry()
+        monitor.enable(registry=reg)
+        try:
+            xb, y = self._poisoned()
+            net = _net(diagnostics="skip")
+            net.fit(xb, y, epochs=1, batch_size=8, shuffle=False)
+            assert reg.counter("watchdog_nonfinite_total").value == 1
+            assert reg.counter("watchdog_skipped_total").value == 1
+            assert "watchdog_nonfinite_total 1" in reg.exposition()
+        finally:
+            monitor.disable()
+
+
+class TestTransferContract:
+    """≤1 batched d2h transfer per report cadence; zero off-cadence."""
+
+    def _d2h(self, reg):
+        return reg.counter("jax_transfers_total", direction="d2h").value
+
+    def test_per_step_cadence(self):
+        reg = MetricsRegistry()
+        monitor.enable(registry=reg)
+        try:
+            x, y = _data()
+            cfg = DiagnosticsConfig(report_frequency=2)
+            net = _net(diagnostics=cfg)
+            before = self._d2h(reg)
+            net.fit(x, y, epochs=1, batch_size=8, shuffle=False)  # 4 its
+            # iterations 0 and 2 are on cadence -> exactly 2 transfers
+            assert self._d2h(reg) - before == 2
+        finally:
+            monitor.disable()
+
+    def test_fused_group_single_transfer(self):
+        reg = MetricsRegistry()
+        monitor.enable(registry=reg)
+        try:
+            x, y = _data(48)
+            net = _net(diagnostics=True)
+            before = self._d2h(reg)
+            # 6 iterations in 2 fused groups -> 2 batched transfers
+            net.fit(x, y, epochs=1, batch_size=8, shuffle=False,
+                    steps_per_execution=3)
+            assert self._d2h(reg) - before == 2
+        finally:
+            monitor.disable()
+
+    def test_disabled_zero_transfers(self):
+        reg = MetricsRegistry()
+        monitor.enable(registry=reg)
+        try:
+            x, y = _data()
+            net = _net()
+            before = self._d2h(reg)
+            net.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+            assert self._d2h(reg) - before == 0
+        finally:
+            monitor.disable()
+
+
+class TestResolutionAndSerde:
+    def test_as_diagnostics_forms(self):
+        assert as_diagnostics(None) is None
+        assert as_diagnostics(False) is None
+        assert as_diagnostics("off") is None
+        assert as_diagnostics(True) == DiagnosticsConfig()
+        assert as_diagnostics("skip").watchdog == "skip"
+        cfg = DiagnosticsConfig(histograms=True)
+        assert as_diagnostics(cfg) is cfg
+        assert as_diagnostics(cfg.to_dict()) == cfg
+        with pytest.raises(ValueError):
+            as_diagnostics("bogus")
+        with pytest.raises(ValueError):
+            DiagnosticsConfig(watchdog="explode")
+
+    def test_env_overrides(self, monkeypatch):
+        conf = _mlp_conf(diagnostics="warn")
+        assert resolve_diagnostics(None, conf).watchdog == "warn"
+        monkeypatch.setenv("DL4J_DIAGNOSTICS", "0")
+        assert resolve_diagnostics("skip", conf) is None
+        monkeypatch.setenv("DL4J_DIAGNOSTICS", "halt")
+        assert resolve_diagnostics(None, conf).watchdog == "halt"
+        monkeypatch.setenv("DL4J_DIAGNOSTICS", "sideways")
+        with pytest.raises(ValueError):
+            resolve_diagnostics(None, conf)
+
+    def test_arg_beats_conf(self):
+        conf = _mlp_conf(diagnostics="warn")
+        net = MultiLayerNetwork(conf, diagnostics="skip")
+        assert net.diagnostics.watchdog == "skip"
+        net2 = MultiLayerNetwork(conf)
+        assert net2.diagnostics.watchdog == "warn"
+
+    def test_mlc_serde_roundtrip(self):
+        conf = _mlp_conf(diagnostics=DiagnosticsConfig(
+            watchdog="skip", histograms=True, report_frequency=5))
+        rt = MultiLayerConfiguration.from_dict(conf.to_dict())
+        assert rt.diagnostics == conf.diagnostics
+        plain = _mlp_conf()
+        assert MultiLayerConfiguration.from_dict(
+            plain.to_dict()).diagnostics is None
+
+    def test_graph_serde_roundtrip(self):
+        gb = (ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder())
+            .add_inputs("in"))
+        gb.add_layer("d", DenseLayer(n_in=4, n_out=4), "in")
+        gb.add_layer("out", OutputLayer(n_in=4, n_out=2), "d")
+        gb.set_outputs("out").diagnostics("halt")
+        conf = gb.build()
+        rt = ComputationGraphConfiguration.from_dict(conf.to_dict())
+        assert rt.diagnostics.watchdog == "halt"
+
+    def test_checkpoint_meta_preserves_active_config(self):
+        # an ARG-selected watchdog (not in the conf) must survive
+        # fault-runtime resume — under `skip` it is trajectory-bearing
+        from deeplearning4j_tpu.fault import state as fs
+        net = MultiLayerNetwork(_mlp_conf(), diagnostics="skip").init()
+        snap = fs.capture_training_state(net)
+        rebuilt = fs.build_model(snap["meta"])
+        assert rebuilt.diagnostics.watchdog == "skip"
+        plain = MultiLayerNetwork(_mlp_conf()).init()
+        snap2 = fs.capture_training_state(plain)
+        assert fs.build_model(snap2["meta"]).diagnostics is None
+
+    def test_histograms_in_aux(self):
+        x, y = _data()
+        cfg = DiagnosticsConfig(histograms=True, histogram_bins=8,
+                                histogram_range=2.0)
+        net = _net(diagnostics=cfg)
+        net.fit(x, y, epochs=1, batch_size=32, shuffle=False)
+        h = net._last_diagnostics["hists"]["0_W"]
+        assert len(h) == 8
+        assert float(np.sum(h)) == 64.0  # every 8x8 weight counted

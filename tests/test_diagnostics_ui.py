@@ -1,0 +1,222 @@
+"""Satellites of the diagnostics PR: StatsListener/StatsReport wire
+format, ParamAndGradientIterationListener aux consumption,
+EvaluativeListener registry gauges, and the /train training-health UI.
+"""
+
+import struct
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.common.updaters import Adam
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import (
+    EvaluativeListener,
+    ParamAndGradientIterationListener,
+)
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+
+def _net(diagnostics=None, depth=2):
+    lb = (NeuralNetConfiguration.builder().seed(11)
+          .updater(Adam(0.01)).list())
+    for _ in range(depth):
+        lb = lb.layer(DenseLayer(n_in=8, n_out=8, activation="relu"))
+    lb = lb.layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss="mcxent"))
+    if diagnostics is not None:
+        lb = lb.diagnostics(diagnostics)
+    return MultiLayerNetwork(lb.build()).init()
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+def _encode_v1(r: StatsReport) -> bytes:
+    """A genuine v1 payload (the pre-diagnostics codec) — what an old
+    remote worker would POST to /remote."""
+    def pack_str(s):
+        b = s.encode("utf-8")
+        return struct.pack("<H", len(b)) + b
+
+    out = [b"DL4JSTAT", struct.pack("<H", 1), pack_str(r.session_id),
+           pack_str(r.worker_id),
+           struct.pack("<qqdddd", r.iteration, r.epoch, r.timestamp,
+                       r.score, r.iteration_time_ms, r.examples_per_sec),
+           struct.pack("<d", r.memory_rss_mb)]
+    for table in (r.param_mean_magnitudes, r.update_mean_magnitudes):
+        out.append(struct.pack("<H", len(table)))
+        for k, v in table.items():
+            out.append(pack_str(k))
+            out.append(struct.pack("<d", v))
+    out.append(struct.pack("<H", len(r.param_histograms)))
+    for k, (edges, counts) in r.param_histograms.items():
+        out.append(pack_str(k))
+        out.append(struct.pack("<H", len(counts)))
+        out.append(np.asarray(edges, np.float64).tobytes())
+        out.append(np.asarray(counts, np.int64).tobytes())
+    return b"".join(out)
+
+
+class TestStatsReportWire:
+    def _report(self):
+        return StatsReport(
+            session_id="s", worker_id="w", iteration=3, epoch=1,
+            timestamp=123.0, score=0.5, iteration_time_ms=7.5,
+            examples_per_sec=1024.0,
+            param_mean_magnitudes={"0_W": 0.1, "0_b": 0.01},
+            update_mean_magnitudes={"0_W": 1e-3},
+            param_histograms={"0_W": ([-1.0, 0.0, 1.0], [3, 5])},
+            memory_rss_mb=42.0,
+            gradient_mean_magnitudes={"0_W": 0.02},
+            update_ratios={"0_W": 0.01},
+            activation_stats={"0": (0.4, 0.5, 0.25)},
+            watchdog_nonfinite=2)
+
+    def test_v2_roundtrip(self):
+        r = self._report()
+        rt = StatsReport.decode(r.encode())
+        assert rt == r
+
+    def test_v1_payload_still_decodes(self):
+        r = self._report()
+        rt = StatsReport.decode(_encode_v1(r))
+        # v1 fields survive; v2 fields default empty
+        assert rt.param_mean_magnitudes == r.param_mean_magnitudes
+        assert rt.update_mean_magnitudes == r.update_mean_magnitudes
+        assert rt.param_histograms == r.param_histograms
+        assert rt.gradient_mean_magnitudes == {}
+        assert rt.activation_stats == {}
+        assert rt.watchdog_nonfinite == 0
+
+
+class TestStatsListener:
+    def test_true_update_magnitudes_from_aux(self):
+        x, y = _data()
+        net = _net(diagnostics=True)
+        storage = InMemoryStatsStorage()
+        net.set_listeners(StatsListener(storage))
+        net.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        r = storage.latest_report("default")
+        d = net._last_diagnostics["params"]
+        assert r.update_mean_magnitudes["0_W"] == \
+            pytest.approx(d["0_W"]["upd_mm"])
+        assert r.gradient_mean_magnitudes["1_W"] == \
+            pytest.approx(d["1_W"]["grad_mm"])
+        assert r.update_ratios["0_W"] == pytest.approx(d["0_W"]["ratio"])
+        assert "0" in r.activation_stats
+
+    def test_batched_readback_single_transfer(self):
+        reg = MetricsRegistry()
+        monitor.enable(registry=reg)
+        try:
+            x, y = _data()
+            net = _net()  # NO diagnostics seam -> host param readback
+            storage = InMemoryStatsStorage()
+            net.set_listeners(StatsListener(storage,
+                                            update_frequency=4))
+            before = reg.counter("jax_transfers_total",
+                                 direction="d2h").value
+            net.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+            # one report (iteration 0) -> ONE batched transfer, not
+            # one per param leaf (6 leaves here)
+            assert reg.counter("jax_transfers_total",
+                               direction="d2h").value - before == 1
+            r = storage.latest_report("default")
+            assert len(r.param_mean_magnitudes) == 6
+        finally:
+            monitor.disable()
+
+    def test_param_delta_fallback_without_seam(self):
+        x, y = _data()
+        net = _net()
+        storage = InMemoryStatsStorage()
+        net.set_listeners(StatsListener(storage))
+        net.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        reports = storage.get_reports("default")
+        # first report has no previous params -> no update magnitudes;
+        # later ones carry the param-delta approximation
+        assert reports[-1].update_mean_magnitudes
+        assert reports[-1].gradient_mean_magnitudes == {}
+
+
+class TestParamAndGradientListener:
+    def test_reads_gradients_from_aux(self):
+        x, y = _data()
+        net = _net(diagnostics=True)
+        lines = []
+        net.set_listeners(ParamAndGradientIterationListener(
+            printer=lines.append))
+        net.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        assert lines and "|g|=" in lines[-1] and "|p|=" in lines[-1]
+
+    def test_no_seam_prints_params_only(self):
+        x, y = _data()
+        net = _net()
+        lines = []
+        net.set_listeners(ParamAndGradientIterationListener(
+            printer=lines.append))
+        net.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        assert lines and "|p|=" in lines[-1] and "|g|=" not in lines[-1]
+
+
+class TestEvaluativeListenerGauges:
+    def test_scores_published_as_gauges(self):
+        reg = MetricsRegistry()
+        monitor.enable(registry=reg)
+        try:
+            from deeplearning4j_tpu.datasets.dataset import DataSet
+            x, y = _data()
+            net = _net()
+            net.set_listeners(EvaluativeListener(
+                DataSet(x, y), invocation="epoch_end", tag="holdout",
+                printer=lambda s: None))
+            net.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+            acc = reg.gauge("evaluative_score", tag="holdout",
+                            metric="accuracy").value
+            f1 = reg.gauge("evaluative_score", tag="holdout",
+                           metric="f1").value
+            assert 0.0 <= acc <= 1.0 and 0.0 <= f1 <= 1.0
+            assert 'evaluative_score{metric="accuracy",tag="holdout"}' \
+                in reg.exposition()
+        finally:
+            monitor.disable()
+
+
+class TestTrainingHealthUI:
+    def test_overview_serves_real_stats(self):
+        x, y = _data()
+        net = _net(diagnostics=True)
+        storage = InMemoryStatsStorage()
+        net.set_listeners(StatsListener(storage))
+        net.fit(x, y, epochs=1, batch_size=8, shuffle=False)
+        server = UIServer().start()
+        try:
+            server.attach(storage)
+            base = f"http://127.0.0.1:{server.port}"
+            html = urllib.request.urlopen(
+                base + "/train/overview", timeout=10).read().decode()
+            assert "training health" in html
+            assert "mean |grad|" in html
+            assert "activation stats" in html
+            ja = urllib.request.urlopen(
+                base + "/train/overview?lang=ja",
+                timeout=10).read().decode()
+            assert "学習ヘルス" in ja
+            zh = urllib.request.urlopen(
+                base + "/train/overview?lang=zh",
+                timeout=10).read().decode()
+            assert "训练健康" in zh
+        finally:
+            server.stop()
