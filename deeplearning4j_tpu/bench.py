@@ -1174,6 +1174,11 @@ GATE_TOLERANCES = {
     # a bf16 baseline's 2.0) gates as a regression instead of
     # masquerading as a bf16 win
     "resnet50_bf16_wire_reduction": 0.02,
+    # serving-side numbers ride host thread scheduling (the loadtest
+    # drives N client threads against the scheduler thread) — wider
+    # bands than the pure-device metrics
+    "serving_tokens_per_sec": 0.25,
+    "serving_speedup_vs_sequential": 0.25,
 }
 _GATE_HEADLINE = "resnet50_images_per_sec"
 
@@ -1201,6 +1206,12 @@ def _gate_metrics(rec):
     take("transformer_long_context_tokens_per_sec",
          "extras", "transformer_lm", "long_context", "value")
     take("word2vec_words_per_sec", "extras", "word2vec", "value")
+    # serving ledger (scripts/serve_loadtest.py writes these): the
+    # continuous-batching throughput and its margin over sequential
+    # whole-batch generate() round-trips gate like training metrics
+    take("serving_tokens_per_sec", "extras", "serving", "tokens_per_sec")
+    take("serving_speedup_vs_sequential",
+         "extras", "serving", "speedup_vs_sequential")
     return out
 
 

@@ -207,18 +207,74 @@ class MultiHeadAttention(Layer):
             k_cache, k.astype(k_cache.dtype), pos, 1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(
             v_cache, v.astype(v_cache.dtype), pos, 1)
-        T, L = x.shape[1], k_cache.shape[1]
-        scale = 1.0 / jnp.sqrt(jnp.asarray(self.head_dim, x.dtype))
-        s = jnp.einsum("bqhd,bkhd->bhqk", q,
-                       k_cache.astype(q.dtype)) * scale
-        q_pos = pos + jnp.arange(T)
-        valid = jnp.arange(L)[None, :] <= q_pos[:, None]   # [T, L]
-        s = jnp.where(valid[None, None], s, -jnp.inf)
-        w = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", w, v_cache.astype(q.dtype))
-        o = o.reshape(x.shape[0], T, -1)
-        return (self.activation(self._project(params, o, "Wo")),
+        B, T = x.shape[0], x.shape[1]
+        q_pos = jnp.broadcast_to(pos + jnp.arange(T), (B, T))
+        return (self._attend_cached(params, q, k_cache, v_cache, q_pos),
                 k_cache, v_cache)
+
+    def _attend_cached(self, params, q, k_seq, v_seq, q_pos):
+        """Shared masked-softmax attention core for BOTH cached decode
+        paths (monolithic carry and paged pool): `q` [B, T, H, Dh]
+        against a cache view `k_seq`/`v_seq` [B, L, H, Dh], with
+        per-row query positions `q_pos` [B, T] hiding every cache slot
+        past the row's stream position. One body, one set of numerics
+        — the serving bit-parity contract (docs/SERVING.md) holds by
+        construction instead of by hand-synchronized copies."""
+        B, T = q.shape[0], q.shape[1]
+        L = k_seq.shape[1]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(self.head_dim, q.dtype))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q,
+                       k_seq.astype(q.dtype)) * scale
+        valid = jnp.arange(L)[None, None, :] <= q_pos[:, :, None]
+        s = jnp.where(valid[:, None], s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", w, v_seq.astype(q.dtype))
+        return self.activation(
+            self._project(params, o.reshape(B, T, -1), "Wo"))
+
+    def forward_with_paged_cache(self, params, x, k_pool, v_pool,
+                                 block_table, pos):
+        """Incremental causal attention over a PAGED KV-cache pool — the
+        continuous-batching serving mode (`cache_pages=`): instead of one
+        monolithic `[B, L, H, Dh]` buffer per sequence, K/V live in a
+        shared pool of fixed-size blocks `[n_blocks, block_len, H, Dh]`
+        and each slot addresses its blocks through a block table.
+
+        `x` [S, 1, D] holds ONE new token per serving slot; `pos` [S]
+        is each slot's own stream position (slots decode different
+        sequences at different depths — the per-slot generalization of
+        `forward_with_cache`'s single scalar `pos`). `block_table`
+        [S, max_blocks] maps slot-local block index -> pool block id.
+        Returns (y, k_pool', v_pool').
+
+        Invariants the scheduler maintains (serving/paged.py): active
+        slots own disjoint block sets; block id 0 is the reserved
+        garbage block that inactive slots and table padding point at —
+        every gathered position past a slot's `pos` is masked to -inf
+        before the softmax, so garbage content never reaches the
+        output (0-weight * finite garbage == exactly 0.0, which is
+        what keeps this path bit-identical to the monolithic cache)."""
+        assert self.causal, "paged KV-cache decoding requires causal=True"
+        S, bl = x.shape[0], k_pool.shape[1]
+        q = self.heads(self._project(params, x, "Wq"))   # [S,1,H,Dh]
+        k = self.heads(self._project(params, x, "Wk"))
+        v = self.heads(self._project(params, x, "Wv"))
+        blk = block_table[jnp.arange(S), pos // bl]      # [S] pool ids
+        off = pos % bl
+        k_pool = k_pool.at[blk, off].set(k[:, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[blk, off].set(v[:, 0].astype(v_pool.dtype))
+        # gather-by-block-table view: [S, maxB, bl, H, Dh] -> [S, L, ...]
+        # with L = maxB * bl; position p of slot s sits at gathered
+        # index p (tables map position-space blocks in order), so the
+        # layout — and therefore the attention math — matches the
+        # monolithic cache exactly
+        k_seq = k_pool[block_table]
+        k_seq = k_seq.reshape(S, -1, *k_seq.shape[3:])
+        v_seq = v_pool[block_table]
+        v_seq = v_seq.reshape(S, -1, *v_seq.shape[3:])
+        return (self._attend_cached(params, q, k_seq, v_seq,
+                                    pos[:, None]),
+                k_pool, v_pool)
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self.apply_input_dropout(x, train, rng)

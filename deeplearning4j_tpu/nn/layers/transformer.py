@@ -75,6 +75,18 @@ class PositionalEncodingLayer(BaseRecurrentLayer):
         sl = jax.lax.dynamic_slice_in_dim(table, carry, T, 0)
         return x + sl, state, carry + T
 
+    def forward_at_positions(self, params, state, x, positions):
+        """Per-slot positional signal for continuous-batching decode:
+        `x` [S, 1, D] holds one token per serving slot and
+        `positions` [S] each slot's OWN stream position — the carry
+        path's scalar offset assumes every row sits at the same depth,
+        which stops being true the moment sequences admit/evict
+        mid-stream. Same table rows as the carry path (gather instead
+        of dynamic_slice), so the added signal is bit-identical."""
+        D = x.shape[2]
+        table = self._table(self.max_len, D, x.dtype)
+        return x + table[positions][:, None, :], state
+
 
 @register_layer
 @dataclasses.dataclass(eq=False)
@@ -239,15 +251,32 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
                                         rng=rng)
         return y, {}, new_carry
 
-    def _carry_impl(self, params, x, carry, *, train, rng):
-        from deeplearning4j_tpu.common.activations import get_activation
-
+    def forward_paged(self, params, x, k_pool, v_pool, block_table, pos,
+                      *, train=False, rng=None):
+        """Paged-KV decode step (`cache_pages=` mode): the same pre-LN
+        block as `_carry_impl`, with attention reading/writing the
+        shared block pool through this slot-batch's block table
+        (`MultiHeadAttention.forward_with_paged_cache`). `pos` [S] is
+        per-slot — sequences admitted mid-stream sit at different
+        depths. The non-attention math IS the carry path's
+        (`_stream_tail` — one body, not a synchronized copy), which is
+        what the serving tier's decode-parity contract (docs/SERVING.md)
+        rests on. Returns (y, k_pool', v_pool')."""
         if self._mha is None:
             self._build_sublayers()
-        k_cache, v_cache, pos = carry
         h, _ = self._ln1.forward(self._sub(params, "ln1"), {}, x)
-        h, k_cache, v_cache = self._mha.forward_with_cache(
-            self._sub(params, "attn"), h, k_cache, v_cache, pos)
+        h, k_pool, v_pool = self._mha.forward_with_paged_cache(
+            self._sub(params, "attn"), h, k_pool, v_pool, block_table, pos)
+        return (self._stream_tail(params, x, h, train=train, rng=rng),
+                k_pool, v_pool)
+
+    def _stream_tail(self, params, x, h, *, train, rng):
+        """Post-attention half of the streaming block — sublayer
+        dropout, residual, LN2, FFN, residual — shared verbatim by the
+        monolithic-carry and paged decode paths (the kernels_enabled
+        fused-LN fast path applies to the full `forward` only)."""
+        from deeplearning4j_tpu.common.activations import get_activation
+
         h = self.apply_input_dropout(h, train,
                                      None if rng is None
                                      else jax.random.fold_in(rng, 2))
@@ -259,7 +288,17 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
         h = self.apply_input_dropout(h, train,
                                      None if rng is None
                                      else jax.random.fold_in(rng, 3))
-        return x + h, (k_cache, v_cache, pos + x.shape[1])
+        return x + h
+
+    def _carry_impl(self, params, x, carry, *, train, rng):
+        if self._mha is None:
+            self._build_sublayers()
+        k_cache, v_cache, pos = carry
+        h, _ = self._ln1.forward(self._sub(params, "ln1"), {}, x)
+        h, k_cache, v_cache = self._mha.forward_with_cache(
+            self._sub(params, "attn"), h, k_cache, v_cache, pos)
+        y = self._stream_tail(params, x, h, train=train, rng=rng)
+        return y, (k_cache, v_cache, pos + x.shape[1])
 
 
 def stream_budget(layers):

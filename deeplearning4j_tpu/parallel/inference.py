@@ -61,6 +61,53 @@ class ParallelInference:
         self._fwd = jax.jit(fwd, in_shardings=(repl, repl, sharded),
                             out_shardings=sharded)
 
+    def _ensure_built(self):
+        """Build the jitted forward + init the model exactly once, even
+        under concurrent cold starts: two threads racing a cold
+        `output()` would both trace/compile the forward (and could both
+        run `model.init()`, one clobbering params the other is already
+        using). Double-checked under `self._lock`; the publish of
+        `self._fwd` is the release point."""
+        if self._fwd is not None and self.model._initialized:
+            return
+        with self._lock:
+            if not self.model._initialized:
+                self.model.init()
+            if self._fwd is None:
+                self._build()
+
+    def _resolve_metrics(self, cache_attr, build):
+        """Shared resolve-and-cache for hot-loop metric families (this
+        collector and the GenerationServer scheduler): None when
+        monitoring is off; otherwise the families `build(registry)`
+        returns, resolved ONCE per active registry — child lookups hit
+        the registry lock, and an `enable(registry=)` swap invalidates
+        the cache by identity."""
+        from deeplearning4j_tpu import monitor
+        if not monitor.is_enabled():
+            return None
+        reg = monitor.registry()
+        cache = getattr(self, cache_attr, None)
+        if cache is not None and cache[0] is reg:
+            return cache[1]
+        m = build(reg)
+        setattr(self, cache_attr, (reg, m))
+        return m
+
+    def _metrics(self):
+        """The coalescing signal plane (ROADMAP names these as the
+        shedding inputs)."""
+        return self._resolve_metrics("_metrics_by_registry", lambda reg: (
+            reg.timer("inference_request_latency_seconds",
+                      "enqueue-to-result latency per output_async "
+                      "request"),
+            reg.gauge("inference_queue_depth",
+                      "requests waiting to join a coalesced batch"),
+            reg.histogram("inference_batch_size",
+                          "rows per executed device batch",
+                          buckets=(1, 2, 4, 8, 16, 32, 64, 128,
+                                   256, 512))))
+
     def _bucket(self, n: int) -> int:
         mesh_n = self.mesh.shape[self.data_axis]
         for b in self._buckets:
@@ -71,11 +118,8 @@ class ParallelInference:
     def output(self, x):
         """Single-call inference; pads the batch to a bucket size that
         divides the mesh, trims the result."""
-        if self._fwd is None:
-            self._build()
+        self._ensure_built()
         model = self.model
-        if not model._initialized:
-            model.init()
         x = np.asarray(x)
         n = x.shape[0]
         b = self._bucket(n)
@@ -95,10 +139,7 @@ class ParallelInference:
             raise RuntimeError("ParallelInference is shut down")
         if self._running:
             return self
-        if self._fwd is None:
-            self._build()
-        if not self.model._initialized:
-            self.model.init()
+        self._ensure_built()
         self._running = True
         self._collector = threading.Thread(target=self._collect_loop,
                                            daemon=True)
@@ -154,7 +195,7 @@ class ParallelInference:
         if not self._running:
             raise RuntimeError("call start() before output_async()")
         fut: Future = Future()
-        self._queue.put((np.asarray(x), fut))
+        self._queue.put((np.asarray(x), fut, time.monotonic()))
         # enqueue/teardown race: shutdown() may have completed between
         # the flag check and the put — no collector will ever drain this
         # request, so fail it ourselves (the queue is the sync point; a
@@ -165,6 +206,9 @@ class ParallelInference:
 
     def _collect_loop(self):
         while self._running:
+            m = self._metrics()
+            if m is not None:
+                m[1].set(self._queue.qsize())
             try:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
@@ -189,13 +233,22 @@ class ParallelInference:
             self._execute(batch)
 
     def _execute(self, batch):
-        futs = [f for _, f in batch]
+        futs = [item[1] for item in batch]
         try:
-            self.batch_size_history.append(
-                sum(x.shape[0] for x, _ in batch))
-            outs = self.output_batched([x for x, _ in batch])
-            for (_, f), o in zip(batch, outs):
-                f.set_result(o)
+            n_rows = sum(item[0].shape[0] for item in batch)
+            self.batch_size_history.append(n_rows)
+            outs = self.output_batched([item[0] for item in batch])
+            done_t = time.monotonic()
+            # collector-thread metric emission: wall-clock math on
+            # already-materialized host arrays — ZERO added device syncs
+            # (the monitor overhead contract, docs/OBSERVABILITY.md)
+            m = self._metrics()
+            if m is not None:
+                m[2].observe(n_rows)
+            for item, o in zip(batch, outs):
+                item[1].set_result(o)
+                if m is not None and len(item) > 2:
+                    m[0].observe(done_t - item[2])
         except Exception as e:  # propagate to every waiting caller
             for f in futs:
                 if not f.done():

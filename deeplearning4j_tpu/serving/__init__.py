@@ -1,0 +1,28 @@
+"""Serving tier: continuous-batching generation over a paged KV pool.
+
+- `serving.paged`  — block pools + host free/used accounting
+- `serving.engine` — the jitted decode/prefill programs + slot state
+- `serving.server` — the threaded scheduler (`GenerationServer`),
+  token streams, SLO-aware shedding
+
+See docs/SERVING.md for the scheduler model, the paged-pool
+invariants, the shedding policy, and the decode-parity contract.
+"""
+
+from deeplearning4j_tpu.serving.paged import (
+    GARBAGE_BLOCK,
+    BlockAllocator,
+    PagedKVPool,
+    blocks_needed,
+)
+from deeplearning4j_tpu.serving.engine import PagedDecodeEngine
+from deeplearning4j_tpu.serving.server import (
+    GenerationServer,
+    ShedError,
+    TokenStream,
+)
+
+__all__ = [
+    "GARBAGE_BLOCK", "BlockAllocator", "PagedKVPool", "blocks_needed",
+    "PagedDecodeEngine", "GenerationServer", "ShedError", "TokenStream",
+]

@@ -1,0 +1,528 @@
+"""Paged continuous-batching decode engine.
+
+The device-program half of the serving tier (the threaded scheduler
+lives in serving/server.py): a fixed set of `n_slots` serving slots
+advances ONE token per jitted dispatch over the paged KV pool — static
+slot count means ONE XLA program no matter which sequences are in
+flight; empty slots decode garbage into the reserved block and are
+masked out on the host.
+
+Per dispatch:
+
+- `decode_step(params, state, kv, block_tables, token_ids, slot_state)
+  -> (kv', next_ids, done_flags)` — embedding -> per-slot positional
+  signal -> paged transformer blocks -> per-position softmax, then
+  greedy argmax or per-slot sampled next token. Inputs ride h2d once
+  per step (they are a few `[S]` vectors + the `[S, max_blocks]`
+  tables); the pools stay device-resident (donated where the backend
+  supports it).
+- admission prefills a prompt through the SAME cached `prefill` jit
+  `generate()` uses (zoo/transformer.get_prefill), then scatters the
+  filled monolithic carries into the sequence's pool blocks — so
+  prefill numerics are `generate()`'s by construction.
+
+Decode-parity contract (docs/SERVING.md): for the same prompt and
+sampling config, the token stream is identical to whole-batch
+`generate()` — greedy is exact (test-enforced bit-equality); sampled
+mode derives token t's key as `fold_in(request_key, t)`, which makes a
+request's stream deterministic REGARDLESS of what else is in flight
+(whole-batch `generate()` draws per-batch, so its sampled streams
+change with batch composition — the serving tier deliberately does
+not reproduce that).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nd.donation import donate_argnums
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.nn.layers.transformer import (
+    PositionalEncodingLayer,
+    TransformerEncoderBlock,
+    stream_budget,
+)
+from deeplearning4j_tpu.serving.paged import (
+    GARBAGE_BLOCK,
+    PagedKVPool,
+    blocks_needed,
+)
+
+
+class Slot:
+    """Host mirror of one serving slot's in-flight sequence."""
+
+    __slots__ = ("request_id", "blocks", "prompt_len", "n_tokens",
+                 "emitted", "pos")
+
+    def __init__(self, request_id, blocks, prompt_len, n_tokens):
+        self.request_id = request_id
+        self.blocks = blocks
+        self.prompt_len = prompt_len
+        self.n_tokens = n_tokens
+        self.emitted = 0
+        self.pos = prompt_len
+
+
+class PagedDecodeEngine:
+    """Continuous-batching decode over a `PagedKVPool`.
+
+    Synchronous and single-threaded by design — every method must be
+    called from one scheduler thread (serving/server.py owns that
+    thread; tests drive the engine directly for determinism).
+
+    `top_k` is engine-static (lax.top_k needs a static k — same
+    constraint `generate()` documents); temperature and top_p are
+    per-request traced values, so mixed greedy/sampled batches share
+    the one decode program.
+    """
+
+    def __init__(self, net, *, n_slots: int = 8, n_blocks: int = 64,
+                 block_len: int = 16, top_k: Optional[int] = None,
+                 steps_per_dispatch: int = 1):
+        if not getattr(net, "_initialized", False):
+            net.init()
+        self.net = net
+        self.n_slots = int(n_slots)
+        self.steps_per_dispatch = int(steps_per_dispatch)
+        if self.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1; got {steps_per_dispatch}")
+        self.top_k = None if top_k is None else int(top_k)
+        if self.n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1; got {n_slots}")
+        budget = stream_budget(net.layers)
+        if budget is None:
+            raise ValueError(
+                "net has no bounded stream budget (no TransformerEncoder"
+                "Block / PositionalEncodingLayer) — nothing to page")
+        if budget % block_len != 0:
+            raise ValueError(
+                f"block_len {block_len} must divide the stream budget "
+                f"{budget} (KV cache_len / positional max_len): the "
+                f"gathered page view must have the same length as the "
+                f"monolithic cache for decode parity")
+        vocab = getattr(net.layers[-1], "n_out", None)
+        if self.top_k is not None and not (1 <= self.top_k <=
+                                           (vocab or self.top_k)):
+            raise ValueError(f"top_k must be in [1, vocab={vocab}]; "
+                             f"got {top_k}")
+        self.max_blocks = budget // int(block_len)
+        self.max_total_tokens = budget
+        self.pool = PagedKVPool(net, n_blocks, block_len)
+        self.block_len = int(block_len)
+        # a serving "plan": how each layer participates in the paged
+        # decode walk. Input preprocessors would silently change the
+        # math mid-walk — reject loudly (the zoo LMs have none).
+        if net.conf.input_preprocessors:
+            raise ValueError(
+                "paged decode does not support input preprocessors "
+                f"(found at {sorted(net.conf.input_preprocessors)})")
+        self._plan: List[Tuple] = []
+        pool_j = 0
+        for i, layer in enumerate(net.layers):
+            if isinstance(layer, TransformerEncoderBlock):
+                self._plan.append(("block", i, pool_j))
+                pool_j += 1
+            elif isinstance(layer, PositionalEncodingLayer):
+                self._plan.append(("pos", i))
+            elif isinstance(layer, BaseRecurrentLayer):
+                raise ValueError(
+                    f"layer {i} ({type(layer).__name__}) carries "
+                    "recurrent state but has no paged decode path")
+            else:
+                self._plan.append(("plain", i))
+        # host slot state (uploaded per step; a few [S] vectors)
+        S = self.n_slots
+        self.block_tables = np.zeros((S, self.max_blocks), np.int32)
+        self.pos = np.zeros(S, np.int32)
+        self.active = np.zeros(S, bool)
+        self.remaining = np.zeros(S, np.int32)
+        self.emit_idx = np.zeros(S, np.int32)
+        self.last_token = np.zeros(S, np.int32)
+        self.keys = np.zeros((S, 2), np.uint32)
+        self.temp = np.zeros(S, np.float32)
+        self.top_p = np.ones(S, np.float32)
+        self.slots: List[Optional[Slot]] = [None] * S
+        self._decode_full = None      # greedy + sampling chain
+        self._decode_greedy = None    # argmax only (no sort/rng ops)
+        self._admit_finish = {}       # k -> fused write-pages+first-token
+
+    # ------------------------------------------------------------ queries
+    @property
+    def free_slots(self) -> int:
+        return sum(1 for s in self.slots if s is None)
+
+    @property
+    def active_slots(self) -> int:
+        return int(self.active.sum())
+
+    @property
+    def free_blocks(self) -> int:
+        return self.pool.free_blocks
+
+    def can_admit(self, prompt_len: int, n_tokens: int) -> bool:
+        return (any(s is None for s in self.slots)
+                and blocks_needed(prompt_len + n_tokens, self.block_len)
+                <= self.pool.free_blocks)
+
+    def check_budget(self, prompt_len: int, n_tokens: int):
+        """Reject requests that can NEVER be admitted — distinct from
+        `can_admit` (not right now): over the per-sequence page budget,
+        or needing more blocks than the whole pool owns (a queued
+        request waiting on capacity that cannot exist would deadlock
+        its consumer)."""
+        total = prompt_len + n_tokens
+        if n_tokens < 1:
+            raise ValueError(f"n_tokens must be >= 1; got {n_tokens}")
+        if total > self.max_total_tokens:
+            raise ValueError(
+                f"prompt ({prompt_len}) + n_tokens ({n_tokens}) = {total} "
+                f"exceeds the per-sequence page budget "
+                f"{self.max_total_tokens} (max_blocks {self.max_blocks} x "
+                f"block_len {self.block_len}); this request can never be "
+                f"admitted — rebuild the model with a larger max_len")
+        usable = self.pool.n_blocks - 1      # id 0 is the garbage block
+        if blocks_needed(total, self.block_len) > usable:
+            raise ValueError(
+                f"request needs {blocks_needed(total, self.block_len)} "
+                f"pool blocks but the pool only has {usable} usable "
+                f"(n_blocks {self.pool.n_blocks} incl. the reserved "
+                f"garbage block); it can never be admitted — grow "
+                f"n_blocks or shorten the request")
+
+    # ----------------------------------------------------------- sampling
+    def _sample_ids(self, probs, keys, emit_idx, temp, top_p,
+                    greedy_only: bool = False):
+        """Next token per row of `probs` [S, V]: greedy argmax where
+        temp == 0 (bit-identical to `generate(temperature=0)`), else
+        the same log/clip/filter/categorical chain `generate` runs —
+        with a PER-SLOT key folded by emit index, the serving rng
+        contract. `greedy_only=True` (a STATIC program variant the
+        scheduler picks when no sampled request is in flight) skips
+        the sort/threefry chain entirely — measured at ~half the
+        decode chunk on the CPU sandbox."""
+        greedy_ids = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+        if greedy_only:
+            return greedy_ids
+        from deeplearning4j_tpu.zoo.transformer import filter_logits
+        safe_t = jnp.where(temp > 0, temp, 1.0)
+        logits = jnp.log(jnp.clip(probs, 1e-9, None)) / safe_t[:, None]
+        # generate()'s own filter body, with per-slot traced p
+        # (p=1.0 keeps everything)
+        logits = filter_logits(logits, self.top_k, top_p[:, None])
+        skeys = jax.vmap(jax.random.fold_in)(keys, emit_idx)
+        sampled = jax.vmap(jax.random.categorical)(skeys, logits)
+        return jnp.where(temp > 0, sampled.astype(jnp.int32), greedy_ids)
+
+    # ------------------------------------------------------ jit builders
+    def _build_decode(self, greedy_only: bool):
+        net, layers, plan = self.net, self.net.layers, self._plan
+        J = self.steps_per_dispatch
+
+        def one_token(params, state, kv, block_tables, token_ids, pos,
+                      keys, emit_idx, temp, top_p):
+            h = token_ids[:, None]            # [S, 1] int ids
+            kv = list(kv)
+            for entry in plan:
+                kind, i = entry[0], entry[1]
+                layer = layers[i]
+                lp = params.get(str(i), {})
+                ls = state.get(str(i), {})
+                if kind == "plain":
+                    h, _ = layer.forward(lp, ls, h, train=False, rng=None)
+                elif kind == "pos":
+                    h, _ = layer.forward_at_positions(lp, ls, h, pos)
+                else:
+                    j = entry[2]
+                    k_pool, v_pool = kv[j]
+                    h, k_pool, v_pool = layer.forward_paged(
+                        lp, h, k_pool, v_pool, block_tables, pos)
+                    kv[j] = (k_pool, v_pool)
+            probs = h[:, -1]                   # [S, V]
+            return tuple(kv), self._sample_ids(probs, keys, emit_idx,
+                                               temp, top_p,
+                                               greedy_only=greedy_only)
+
+        def decode_step(params, state, kv, block_tables, token_ids,
+                        pos, remaining, keys, emit_idx, temp, top_p):
+            """`steps_per_dispatch` micro-steps fused into ONE program
+            via lax.scan: host round-trip and dispatch overhead
+            amortize over J tokens x S slots (the continuous-batching
+            counterpart of `generate()`'s fused decode scan). A slot
+            finishing mid-chunk keeps decoding — into its own pages or
+            the garbage block, never another slot's — and the `valids`
+            mask tells the host which emissions are real. J=1 is the
+            admit-every-token schedule the scheduler defaults to."""
+            params = net.dtype.cast_params(params)
+
+            def micro(carry, _):
+                kv, tok, pos, rem, emit = carry
+                kv, nxt = one_token(params, state, kv, block_tables,
+                                    tok, pos, keys, emit, temp, top_p)
+                return ((kv, nxt, pos + 1, rem - 1, emit + 1),
+                        (nxt, rem > 0))
+
+            carry = (kv, token_ids, pos, remaining, emit_idx)
+            (kv, _, _, _, _), (toks, valids) = jax.lax.scan(
+                micro, carry, None, length=J)
+            return kv, toks, valids            # [J, S] each
+
+        return jax.jit(decode_step, donate_argnums=donate_argnums(2))
+
+    def _build_admit_finish(self, k: int, greedy_only: bool):
+        """One fused dispatch completing a k-wide admission wave:
+        scatter every sequence's monolithic prefill K/V into its pool
+        pages AND sample the wave's first tokens from the prefill
+        probs. Separate per-request dispatches here were measured to
+        cost as much as a whole `generate()` call each on the CPU
+        sandbox — admission overhead is exactly what the sequential
+        baseline pays, so it must be amortized for continuous batching
+        to win."""
+        bl = self.block_len
+
+        def admit_finish(kv, rows, block_carries, probs, keys, temp,
+                         top_p):
+            # rows [k, max_rows]; block_carries: per layer (k_cache,
+            # v_cache) with leading dim k; probs [k, V]
+            out = []
+            for (k_pool, v_pool), (k_cache, v_cache) in zip(
+                    kv, block_carries):
+                C = k_cache.shape[1]
+                shape = (k * (C // bl), bl) + k_cache.shape[2:]
+                flat_rows = rows[:, :C // bl].reshape(-1)
+                out.append((
+                    k_pool.at[flat_rows].set(
+                        k_cache.reshape(shape).astype(k_pool.dtype)),
+                    v_pool.at[flat_rows].set(
+                        v_cache.reshape(shape).astype(v_pool.dtype)),
+                ))
+            firsts = self._sample_ids(probs, keys,
+                                      jnp.zeros((k,), jnp.int32),
+                                      temp, top_p,
+                                      greedy_only=greedy_only)
+            return tuple(out), firsts
+
+        return jax.jit(admit_finish, donate_argnums=donate_argnums(0))
+
+    # ---------------------------------------------------------- admission
+    def admit(self, prompt_ids, n_tokens: int, *, request_id=None,
+              temperature: float = 0.0, top_p: Optional[float] = None,
+              rng=None):
+        """Single-request admission (a k=1 `admit_many` wave). Returns
+        (slot index, first emitted token, done) or None when capacity
+        can't take the request right now."""
+        out = self.admit_many([dict(prompt_ids=prompt_ids,
+                                    n_tokens=n_tokens,
+                                    request_id=request_id,
+                                    temperature=temperature,
+                                    top_p=top_p, rng=rng)])
+        return out[0] if out else None
+
+    def admit_many(self, requests: List[dict]):
+        """Admission wave: prefill up to len(requests) SAME-LENGTH
+        prompts as one batch through the cached `prefill` jit
+        (zoo/transformer.get_prefill — `generate()`'s own program, so
+        prefill numerics are its by construction), then one fused
+        dispatch writes all their pool pages and samples all their
+        first tokens. Requests beyond the wave's slot/block capacity
+        are left unadmitted (the returned list is a PREFIX of the
+        input — FIFO order preserved).
+
+        Each request dict: prompt_ids, n_tokens, and optionally
+        request_id, temperature, top_p, rng. Returns
+        [(slot, first_token, done), ...] for the admitted prefix."""
+        if not requests:
+            return []
+        wave = []
+        try:
+            P = None
+            for r in requests:
+                prompt = np.asarray(r["prompt_ids"])
+                if prompt.ndim == 2 and prompt.shape[0] == 1:
+                    prompt = prompt[0]
+                if prompt.ndim != 1 or prompt.size == 0:
+                    raise ValueError(
+                        f"prompt must be a non-empty 1-D id sequence; "
+                        f"got shape {prompt.shape}")
+                if P is None:
+                    P = int(prompt.shape[0])
+                elif int(prompt.shape[0]) != P:
+                    break    # caller groups by length; stop the wave
+                n_tokens = int(r["n_tokens"])
+                self.check_budget(P, n_tokens)
+                slot = next((i for i, s in enumerate(self.slots)
+                             if s is None
+                             and all(i != w[0] for w in wave)),
+                            None)
+                if slot is None:
+                    break
+                nb = blocks_needed(P + n_tokens, self.block_len)
+                blocks = self.pool.allocator.allocate(nb)
+                if blocks is None:
+                    break
+                wave.append((slot, prompt, n_tokens, nb, blocks, r))
+            if not wave:
+                return []
+            return self._admit_wave(wave)
+        except Exception:
+            # a mid-wave failure (validation of a later request, a
+            # prefill/admit dispatch error) must return the wave's
+            # already-allocated blocks — no Slot owns them yet, so
+            # _release could never recover them and the pool would
+            # shrink permanently (capacity leak -> eventual silent
+            # starvation of every later admission). Entries a Slot DID
+            # take ownership of (partial bookkeeping) keep theirs —
+            # the normal release path frees those.
+            for slot, _, _, _, blocks, _ in wave:
+                s = self.slots[slot]
+                if s is None or s.blocks is not blocks:
+                    try:
+                        self.pool.allocator.free(blocks)
+                    except ValueError:
+                        pass   # already back in the pool
+            raise
+
+    def _admit_wave(self, wave):
+        k = len(wave)
+        # pad the wave to the next power of two: every distinct batch
+        # width costs a prefill + admit_finish COMPILE, and free-slot
+        # counts vary chunk to chunk — unquantized widths were measured
+        # as a compile storm that dwarfed the serving itself. Dummy
+        # rows repeat the last prompt, scatter only into the garbage
+        # block, and their sampled firsts are discarded.
+        k2 = 1
+        while k2 < k:
+            k2 *= 2
+
+        net = self.net
+        from deeplearning4j_tpu.zoo.transformer import get_prefill
+        prefill = get_prefill(net)
+        carries = {str(i): layer.init_carry(k2, net.dtype.compute_dtype)
+                   for i, layer in enumerate(net.layers)
+                   if isinstance(layer, BaseRecurrentLayer)}
+        prompts = np.stack([w[1] for w in wave]
+                           + [wave[-1][1]] * (k2 - k)).astype(np.int32)
+        probs, carries = prefill(net.params, net.net_state,
+                                 jnp.asarray(prompts), carries)
+
+        block_carries = [carries[str(i)] for i in self.pool.layer_indices]
+        max_rows = max(c[0].shape[1] // self.block_len
+                       for c in block_carries)
+        rows = np.full((k2, max_rows), GARBAGE_BLOCK, np.int32)
+        keys = np.zeros((k2, 2), np.uint32)
+        temps = np.zeros(k2, np.float32)
+        top_ps = np.ones(k2, np.float32)
+        for j, (slot, prompt, n_tokens, nb, blocks, r) in enumerate(wave):
+            rows[j, :nb] = blocks
+            if r.get("rng") is not None:
+                keys[j] = np.asarray(r["rng"], np.uint32).reshape(2)
+            temps[j] = r.get("temperature") or 0.0
+            p = r.get("top_p")
+            top_ps[j] = 1.0 if p is None else p
+        # all-greedy waves skip the sampling chain (sort + threefry) on
+        # the TTFT-critical path — same static-variant split the
+        # decode program uses
+        greedy = not bool((temps > 0).any())
+        fin = self._admit_finish.get((k2, greedy))
+        if fin is None:
+            fin = self._admit_finish[(k2, greedy)] = \
+                self._build_admit_finish(k2, greedy)
+        self.pool.kv, firsts = fin(
+            self.pool.kv, jnp.asarray(rows),
+            tuple((c[0], c[1]) for c in block_carries), probs,
+            jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(top_ps))
+        firsts = np.asarray(firsts)
+
+        out = []
+        for j, (slot, prompt, n_tokens, nb, blocks, r) in enumerate(wave):
+            first = int(firsts[j])
+            done = n_tokens == 1
+            self.slots[slot] = Slot(r.get("request_id"), blocks,
+                                    len(prompt), n_tokens)
+            self.slots[slot].emitted = 1
+            self.block_tables[slot] = GARBAGE_BLOCK
+            self.block_tables[slot, :nb] = blocks
+            self.pos[slot] = len(prompt)
+            self.remaining[slot] = n_tokens - 1
+            self.emit_idx[slot] = 1
+            self.last_token[slot] = first
+            self.keys[slot] = keys[j]
+            self.temp[slot] = temps[j]
+            self.top_p[slot] = top_ps[j]
+            self.active[slot] = not done
+            if done:
+                self._release(slot)
+            out.append((slot, first, done))
+        return out
+
+    # ------------------------------------------------------------- decode
+    def step(self) -> Tuple[Dict[int, List[int]], List[int]]:
+        """One continuous-batching dispatch: every active slot advances
+        up to `steps_per_dispatch` tokens. Returns ({slot: [tokens
+        emitted this dispatch]}, [slots that finished and were
+        released])."""
+        if not self.active.any():
+            return {}, []
+        # two static program variants: the greedy-only decode skips the
+        # sampling chain (sort + threefry) — picked whenever no sampled
+        # request is in flight, the common serving case
+        if (self.temp[self.active] > 0).any():
+            if self._decode_full is None:
+                self._decode_full = self._build_decode(greedy_only=False)
+            decode = self._decode_full
+        else:
+            if self._decode_greedy is None:
+                self._decode_greedy = self._build_decode(greedy_only=True)
+            decode = self._decode_greedy
+        kv, toks, valids = decode(
+            self.net.params, self.net.net_state, self.pool.kv,
+            jnp.asarray(self.block_tables), jnp.asarray(self.last_token),
+            jnp.asarray(self.pos), jnp.asarray(self.remaining),
+            jnp.asarray(self.keys), jnp.asarray(self.emit_idx),
+            jnp.asarray(self.temp), jnp.asarray(self.top_p))
+        self.pool.kv = kv
+        toks = np.asarray(toks)                     # [J, S]
+        valids = np.asarray(valids)
+        taken = valids.sum(axis=0).astype(np.int32)  # [S] tokens emitted
+        act = self.active
+        last_idx = np.clip(taken - 1, 0, None)
+        self.last_token = np.where(
+            act & (taken > 0), toks[last_idx, np.arange(toks.shape[1])],
+            self.last_token)
+        self.pos = self.pos + np.where(act, taken, 0)
+        self.emit_idx = self.emit_idx + np.where(act, taken, 0)
+        self.remaining = self.remaining - np.where(act, taken, 0)
+        emitted: Dict[int, List[int]] = {}
+        finished = []
+        for i in np.flatnonzero(act):
+            i = int(i)
+            emitted[i] = [int(t) for t in toks[valids[:, i], i]]
+            self.slots[i].emitted += int(taken[i])
+            self.slots[i].pos = int(self.pos[i])
+            if self.remaining[i] <= 0:
+                finished.append(i)
+                self._release(i)
+        return emitted, finished
+
+    # ------------------------------------------------------------ evict
+    def evict(self, slot: int):
+        """Mid-stream eviction (cancel/timeout): free the slot and its
+        blocks immediately; the pool pages become garbage the moment
+        the table row is retired (no device work — the next gather by
+        a reusing sequence overwrites them via its own prefill)."""
+        if self.slots[slot] is None:
+            raise ValueError(f"slot {slot} is not in use")
+        self._release(slot)
+
+    def _release(self, slot: int):
+        s = self.slots[slot]
+        self.pool.allocator.free(s.blocks)
+        self.slots[slot] = None
+        self.active[slot] = False
+        self.remaining[slot] = 0
+        self.block_tables[slot] = GARBAGE_BLOCK

@@ -1,0 +1,131 @@
+"""Paged KV-cache pool: fixed-size blocks + host-side accounting.
+
+The serving tier's memory plane (ROADMAP "production inference tier";
+the design TF-Serving layered over the TF runtime, PAPERS.md §serving):
+instead of one monolithic `[cache_len, H, Dh]` buffer pinned per
+sequence for its whole lifetime, K/V live in a shared pool of
+fixed-size blocks `[n_blocks, block_len, H, Dh]` per transformer
+layer. A sequence owns `ceil((prompt + n_tokens) / block_len)` blocks,
+addressed through a per-slot block table — so `stream_budget` becomes
+a POOL-capacity question (how many sequences fit at once) instead of a
+per-sequence clamp, and a finished sequence's blocks immediately serve
+the next admission.
+
+Split of responsibilities:
+
+- device: the block pools (one (K, V) pair per transformer block
+  layer, all dtype = the net's compute dtype) and the gather/scatter
+  attention path (`MultiHeadAttention.forward_with_paged_cache`);
+- host: free/used accounting (`BlockAllocator`) and the block tables,
+  which ride h2d once per scheduler step.
+
+Block id 0 is RESERVED as the garbage block: inactive slots and block-
+table padding point at it, so masked scatter lanes always have a legal
+target and freed blocks can be retired from a table without reshaping
+anything. The allocator never hands it out.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.transformer import TransformerEncoderBlock
+
+GARBAGE_BLOCK = 0
+
+
+def blocks_needed(total_tokens: int, block_len: int) -> int:
+    """Blocks a sequence of `total_tokens` (prompt + generated) owns."""
+    return -(-int(total_tokens) // int(block_len))
+
+
+class BlockAllocator:
+    """Host-side free-list over pool block ids 1..n_blocks-1 (id 0 is
+    the reserved garbage block). Allocation is all-or-nothing: a
+    request either gets its full block set or stays queued — partial
+    grants would deadlock two half-admitted sequences against each
+    other. LIFO reuse keeps freshly-freed blocks hot."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(
+                f"need at least 2 pool blocks (1 usable + the reserved "
+                f"garbage block); got {n_blocks}")
+        self.n_blocks = int(n_blocks)
+        # pop() order: 1, 2, 3, ... for a fresh pool
+        self._free: List[int] = list(range(self.n_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.n_blocks - 1) - len(self._free)
+
+    def allocate(self, n: int) -> Optional[List[int]]:
+        """`n` block ids, or None if the pool can't cover the request
+        right now (caller keeps it queued)."""
+        if n <= 0:
+            raise ValueError(f"allocate(n={n})")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: List[int]):
+        for b in blocks:
+            b = int(b)
+            if not (0 < b < self.n_blocks):
+                raise ValueError(f"freeing invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double-free of block {b}")
+            self._free.append(b)
+
+
+class PagedKVPool:
+    """The per-layer block pools for one model + the shared allocator.
+
+    `kv` is a flat tuple of (k_pool, v_pool) pairs — one per
+    TransformerEncoderBlock in layer order — shaped
+    `[n_blocks, block_len, n_heads, head_dim]` in the net's compute
+    dtype (the same dtype `init_carry` gives the monolithic caches, so
+    prefill copies are exact). It is a plain pytree: jitted programs
+    take it as an argument and return the updated pools."""
+
+    def __init__(self, net, n_blocks: int, block_len: int):
+        if block_len < 1:
+            raise ValueError(f"block_len must be >= 1; got {block_len}")
+        self.block_len = int(block_len)
+        self.n_blocks = int(n_blocks)
+        self.layer_indices = [i for i, l in enumerate(net.layers)
+                              if isinstance(l, TransformerEncoderBlock)]
+        if not self.layer_indices:
+            raise ValueError(
+                "PagedKVPool needs at least one TransformerEncoderBlock "
+                f"layer; got {[type(l).__name__ for l in net.layers]}")
+        dtype = net.dtype.compute_dtype
+        kv = []
+        for i in self.layer_indices:
+            layer = net.layers[i]
+            shape = (self.n_blocks, self.block_len, layer.n_heads,
+                     layer.n_in // layer.n_heads)
+            kv.append((jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)))
+        self.kv: Tuple = tuple(kv)
+        self.allocator = BlockAllocator(self.n_blocks)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    @property
+    def used_blocks(self) -> int:
+        return self.allocator.used_blocks
+
+    def device_bytes(self) -> int:
+        total = 0
+        for k, v in self.kv:
+            total += k.size * k.dtype.itemsize + v.size * v.dtype.itemsize
+        return total

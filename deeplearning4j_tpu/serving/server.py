@@ -1,0 +1,537 @@
+"""Continuous-batching generation server.
+
+The request plane of the serving tier (the device programs live in
+serving/engine.py): `GenerationServer` EXTENDS `ParallelInference` —
+same request queue, Future resolution, start/stop/shutdown lifecycle
+and drain-on-teardown semantics — but replaces the coalesce-one-batch
+collector with a continuous-batching scheduler: every loop iteration
+admits newly queued prompts into free slots (prefill), advances ALL
+active slots one token (one jitted dispatch), streams the new tokens
+out per request, and retires finished/cancelled sequences so their
+pool blocks serve the next admission. A single long generation no
+longer blocks the batch — this is what TF-Serving's async batching
+added on top of the TF runtime (PAPERS.md §serving), rebuilt over a
+paged KV pool.
+
+SLO-aware shedding: with `slo_ttft_s` set, a request whose PROJECTED
+queue delay (outstanding decode work / measured token throughput)
+exceeds the SLO is fast-failed with `ShedError` at admission time
+instead of queueing into certain lateness; `max_queue` is the hard
+backstop when no throughput estimate exists yet. Both fire the
+`serving_shed_total` counter — the registry is the signal plane
+(docs/OBSERVABILITY.md "Serving").
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.serving.engine import PagedDecodeEngine
+from deeplearning4j_tpu.serving.paged import blocks_needed
+
+_DONE = object()
+
+
+class ShedError(RuntimeError):
+    """Request fast-failed by the SLO admission policy (shed, not
+    queued): retry against another replica or with backoff."""
+
+
+class TokenStream:
+    """Per-request token stream: iterate for tokens as they decode, or
+    block on `result()` for the full array (the Future face —
+    `ParallelInference.output_async` compatibility)."""
+
+    def __init__(self, fut, prompt_len: int, n_tokens: int):
+        self._fut = fut
+        self._q: "queue.Queue" = queue.Queue()
+        self.prompt_len = prompt_len
+        self.n_tokens = n_tokens
+        self.tokens: List[int] = []
+        self.cancelled = False
+        self.t_submit = time.monotonic()
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
+
+    # ------------------------------------------------------------ consumer
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            item = self._q.get()
+            if item is _DONE:
+                # surface a shed/teardown error to iterating consumers
+                # too, not only result() callers
+                exc = self._fut.exception(timeout=0)
+                if exc is not None and not self.cancelled:
+                    raise exc
+                return
+            # tokens arrive in per-dispatch batches: one queue wakeup
+            # per CHUNK, not per token — with many iterating consumer
+            # threads, per-token wakeups were measured to collapse
+            # aggregate throughput ~20x (GIL convoy against the
+            # scheduler thread)
+            yield from item
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Full generated-id array [n_emitted]; raises ShedError /
+        teardown errors like a Future."""
+        return self._fut.result(timeout)
+
+    def cancel(self):
+        """Evict this request mid-stream: the scheduler frees its slot
+        and pool blocks at the next loop iteration; `result()` resolves
+        with the tokens emitted so far."""
+        self.cancelled = True
+
+    # ----------------------------------------------------------- producer
+    def _emit(self, token: int, now: float):
+        self._emit_many([token], now)
+
+    def _emit_many(self, toks, now: float):
+        if not toks:
+            return
+        if self.t_first is None:
+            self.t_first = now
+        self.t_last = now
+        toks = [int(t) for t in toks]
+        self.tokens.extend(toks)
+        self._q.put(toks)
+
+    def _finish(self):
+        if not self._fut.done():
+            self._fut.set_result(np.asarray(self.tokens, np.int32))
+        self._q.put(_DONE)
+
+    def _fail(self, exc: BaseException):
+        if not self._fut.done():
+            self._fut.set_exception(exc)
+        self._q.put(_DONE)
+
+
+class _Request:
+    __slots__ = ("prompt", "n_tokens", "temperature", "top_p", "rng",
+                 "stream", "slot")
+
+    def __init__(self, prompt, n_tokens, temperature, top_p, rng, stream):
+        self.prompt = prompt
+        self.n_tokens = n_tokens
+        self.temperature = temperature
+        self.top_p = top_p
+        self.rng = rng
+        self.stream = stream
+        self.slot = None
+
+
+class GenerationServer(ParallelInference):
+    """Continuous-batching autoregressive serving over a paged KV pool.
+
+    `generate_async(prompt, n_tokens) -> TokenStream` from any thread;
+    the scheduler thread (started by `start()`, the inherited
+    lifecycle) owns the engine. `top_k` is server-static (one XLA
+    decode program); temperature/top_p/rng are per-request.
+    """
+
+    def __init__(self, net, *, n_slots: int = 8, n_blocks: int = 64,
+                 block_len: int = 16, top_k: Optional[int] = None,
+                 steps_per_dispatch: int = 1,
+                 slo_ttft_s: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 idle_wait_s: float = 0.05):
+        super().__init__(net)
+        self.engine = PagedDecodeEngine(
+            net, n_slots=n_slots, n_blocks=n_blocks, block_len=block_len,
+            top_k=top_k, steps_per_dispatch=steps_per_dispatch)
+        self._metrics_cache = None
+        self.slo_ttft_s = slo_ttft_s
+        self.max_queue = max_queue
+        self.idle_wait_s = idle_wait_s
+        self._pending: List = []          # admission order, after _queue
+        self._slot2req = {}
+        # shedding estimator: EWMA of aggregate decode throughput
+        self._ewma_tok_s: Optional[float] = None
+
+    def output_async(self, x):
+        """Not supported here: the scheduler queue carries generation
+        requests, not raw feature batches — a ParallelInference-style
+        enqueue would poison the scheduler loop. Use `generate_async`
+        (token streams) or a separate `ParallelInference` for
+        single-shot forwards."""
+        raise NotImplementedError(
+            "GenerationServer serves token streams: use "
+            "generate_async(prompt_ids, n_tokens); for single-shot "
+            "batched forwards use ParallelInference")
+
+    # ------------------------------------------------------------- warmup
+    def warmup(self, prompt_len: int, n_tokens: int = 2):
+        """Compile the serving programs for one prompt length OUTSIDE
+        the serving path: one admission wave per power-of-two wave
+        width up to the slot count (async arrival means real waves
+        take EVERY quantized width, and each width is its own
+        batched-prefill/admit program) plus the greedy decode chunk.
+        Call BEFORE start() — an XLA compile inside a live admission
+        wave stalls every queued request behind ~seconds of tracing
+        (measured as a p50==p99 TTFT cliff on the CPU sandbox; stack
+        sampling showed the scheduler thread pinned in
+        backend_compile)."""
+        if self._running:
+            raise RuntimeError("warmup() must run before start()")
+        eng = self.engine
+        n_tokens = max(2, int(n_tokens))
+        self.engine.check_budget(int(prompt_len), n_tokens)
+        widths = []
+        w = 1
+        while w < eng.n_slots:
+            widths.append(w)
+            w *= 2
+        widths.append(eng.n_slots)
+        # each width warms BOTH admit variants (all-greedy and the
+        # sampling chain) — a mixed wave keys a different program —
+        # and the first sampled wave also compiles the sampled decode
+        # chunk, so a temperature>0 request never stalls live streams
+        # on a mid-serving trace
+        for k in widths:
+            for sampled_head in (False, True):
+                reqs = [dict(prompt_ids=np.zeros(int(prompt_len),
+                                                 np.int32),
+                             n_tokens=n_tokens)
+                        for _ in range(k)]
+                if sampled_head:
+                    reqs[0].update(temperature=1.0,
+                                   rng=np.zeros(2, np.uint32))
+                admitted = eng.admit_many(reqs)
+                while eng.active.any():
+                    eng.step()
+                for slot, _, done in admitted:
+                    if not done and eng.slots[slot] is not None:
+                        eng.evict(slot)
+            if len(admitted) < k:
+                # pool too small for this width even at warmup's
+                # minimal n_tokens — real waves of this width compile
+                # mid-serving if requests ever need fewer blocks each
+                import logging
+                logging.getLogger(__name__).warning(
+                    "warmup admitted only %d of the width-%d wave "
+                    "(pool %d blocks): wave widths above %d are NOT "
+                    "pre-compiled — grow n_blocks or expect a one-off "
+                    "compile stall on the first wider wave",
+                    len(admitted), k, eng.pool.n_blocks, len(admitted))
+                break
+        return self
+
+    # ------------------------------------------------------------- submit
+    def generate_async(self, prompt_ids, n_tokens: int, *,
+                       temperature: float = 0.0,
+                       top_p: Optional[float] = None,
+                       rng=None) -> TokenStream:
+        """Enqueue one generation request; returns its token stream.
+        Eager validation (the `generate()` pattern): impossible
+        requests fail HERE, not as a scheduler-thread error."""
+        if getattr(self, "_shutdown", False):
+            raise RuntimeError("GenerationServer is shut down")
+        if not self._running:
+            raise RuntimeError("call start() before generate_async()")
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError(f"prompt must be a non-empty 1-D id "
+                             f"sequence; got shape {prompt.shape}")
+        self.engine.check_budget(int(prompt.shape[0]), int(n_tokens))
+        if top_p is not None and not (0.0 < float(top_p) <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0; got {temperature}")
+        if temperature > 0 and rng is None:
+            # every no-rng sampled request must draw a DISTINCT stream:
+            # the engine's deterministic default (zero key) would make
+            # concurrent same-prompt requests emit identical "samples".
+            # Pass rng explicitly for a reproducible stream (the
+            # fold-per-position contract, docs/SERVING.md).
+            rng = np.frombuffer(os.urandom(8), np.uint32).copy()
+        from concurrent.futures import Future
+        fut = Future()
+        stream = TokenStream(fut, int(prompt.shape[0]), int(n_tokens))
+        req = _Request(prompt.astype(np.int64), int(n_tokens),
+                       float(temperature), top_p, rng, stream)
+        self._queue.put((req, fut, stream.t_submit))
+        if getattr(self, "_shutdown", False):
+            self._fail_pending()
+        return stream
+
+    # ------------------------------------------------------------ metrics
+    def _serving_metrics(self):
+        return self._resolve_metrics("_metrics_cache",
+                                     self._build_serving_metrics)
+
+    @staticmethod
+    def _build_serving_metrics(reg):
+        return {
+            "queue": reg.gauge("serving_queue_depth",
+                               "generation requests awaiting admission"),
+            "slots": reg.gauge("serving_active_slots",
+                               "serving slots decoding right now"),
+            "blocks": reg.gauge("serving_free_blocks",
+                                "free KV-pool blocks"),
+            "requests": reg.counter("serving_requests_total",
+                                    "generation requests admitted"),
+            "tokens": reg.counter("serving_tokens_total",
+                                  "tokens emitted by the decode loop"),
+            "shed": reg.counter("serving_shed_total",
+                                "requests fast-failed by the SLO "
+                                "admission policy"),
+            "evicted": reg.counter("serving_evicted_total",
+                                   "sequences evicted mid-stream"),
+            "ttft": reg.timer("serving_ttft_seconds",
+                              "submit-to-first-token latency"),
+            "tpot": reg.timer("serving_tpot_seconds",
+                              "mean per-token decode latency per "
+                              "finished request"),
+            "step": reg.timer("serving_step_seconds",
+                              "one continuous-batching decode dispatch"),
+        }
+
+    # ----------------------------------------------------------- shedding
+    def _outstanding_tokens(self) -> int:
+        eng = self.engine
+        out = int(eng.remaining[eng.active].sum())
+        for req, _, _ in self._pending:
+            out += req.n_tokens + blocks_needed(
+                len(req.prompt), eng.block_len)  # prefill cost proxy
+        return out
+
+    def _should_shed(self, req) -> Optional[str]:
+        if self.max_queue is not None and len(self._pending) >= self.max_queue:
+            return (f"admission queue full ({len(self._pending)} >= "
+                    f"max_queue {self.max_queue})")
+        if self.slo_ttft_s is not None and self._ewma_tok_s:
+            projected = self._outstanding_tokens() / self._ewma_tok_s
+            if projected > self.slo_ttft_s:
+                return (f"projected queue delay {projected:.2f}s exceeds "
+                        f"the {self.slo_ttft_s:.2f}s TTFT SLO at "
+                        f"{self._ewma_tok_s:.1f} tok/s")
+        return None
+
+    # ---------------------------------------------------------- scheduler
+    def _collect_loop(self):
+        """The scheduler loop (replaces the coalescing collector):
+        admissions, one decode dispatch, stream fan-out, eviction,
+        gauges — then block on the queue only when fully idle."""
+        eng = self.engine
+        while self._running:
+            try:
+                progressed = self._schedule_once(eng)
+            except Exception as e:  # noqa: BLE001 — a poisoned dispatch
+                # must fail every waiting consumer, not hang them on a
+                # dead scheduler (ParallelInference._execute's contract)
+                self._fail_all(e)
+                continue
+            if not progressed:
+                # fully idle: park on the queue (a submit wakes us)
+                try:
+                    item = self._queue.get(timeout=self.idle_wait_s)
+                except queue.Empty:
+                    continue
+                if item is not None:
+                    self._pending.append(item)
+
+    def _fail_all(self, exc: BaseException):
+        for slot, (req, fut, _) in list(self._slot2req.items()):
+            try:
+                self.engine.evict(slot)
+            except Exception:  # noqa: BLE001 — engine state may be torn
+                pass
+            req.stream._fail(exc)
+        self._slot2req.clear()
+        for item in self._pending:
+            # defensive: a foreign queue item without a stream must not
+            # re-raise out of the failure path and kill the scheduler
+            stream = getattr(item[0], "stream", None)
+            if stream is not None:
+                stream._fail(exc)
+            elif len(item) > 1 and hasattr(item[1], "set_exception") \
+                    and not item[1].done():
+                item[1].set_exception(exc)
+        self._pending.clear()
+
+    def _schedule_once(self, eng) -> bool:
+        m = self._serving_metrics()
+        progressed = False
+        # -------------------------------------------- cancellations
+        for slot, (req, fut, _) in list(self._slot2req.items()):
+            if req.stream.cancelled:
+                eng.evict(slot)
+                del self._slot2req[slot]
+                if m is not None:
+                    m["evicted"].inc()
+                req.stream._finish()   # partial tokens, clean close
+                progressed = True
+        # cancelled while QUEUED: reap anywhere in line, not only at
+        # the head — stranded entries otherwise keep counting toward
+        # max_queue and the shed projection, shedding real requests
+        # on phantom load
+        if any(item[0].stream.cancelled for item in self._pending):
+            kept = []
+            for item in self._pending:
+                if item[0].stream.cancelled:
+                    item[0].stream._finish()
+                    progressed = True
+                else:
+                    kept.append(item)
+            self._pending = kept
+        # ----------------------------------------------- admissions
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            req = item[0]
+            if req.stream.cancelled:
+                req.stream._finish()
+                continue
+            reason = self._should_shed(req)
+            if reason is not None:
+                if m is not None:
+                    m["shed"].inc()
+                req.stream._fail(ShedError(reason))
+                continue
+            self._pending.append(item)
+        while self._pending:
+            head = self._pending[0]
+            if head[0].stream.cancelled:
+                self._pending.pop(0)
+                head[0].stream._finish()
+                continue
+            if not eng.can_admit(len(head[0].prompt),
+                                 head[0].n_tokens):
+                break    # FIFO: never leapfrog the head request
+            # admission WAVE: the longest FIFO prefix sharing the
+            # head's prompt length goes through ONE batched prefill
+            # + ONE fused pages/first-token dispatch (engine stops
+            # the wave itself at a length change or capacity)
+            P = len(head[0].prompt)
+            wave = []
+            for item in self._pending:
+                if (len(item[0].prompt) != P
+                        or item[0].stream.cancelled):
+                    break
+                wave.append(item)
+            admitted = eng.admit_many([
+                dict(prompt_ids=it[0].prompt,
+                     n_tokens=it[0].n_tokens, request_id=id(it[0]),
+                     temperature=it[0].temperature,
+                     top_p=it[0].top_p, rng=it[0].rng)
+                for it in wave])
+            if not admitted:
+                break
+            now = time.monotonic()
+            for (slot, first, done), (req, fut, t_submit) in zip(
+                    admitted, wave):
+                self._pending.pop(0)
+                req.stream._emit(first, now)
+                if m is not None:
+                    m["requests"].inc()
+                    m["tokens"].inc()
+                    m["ttft"].observe(now - t_submit)
+                if done:
+                    self._finish(req, m)
+                else:
+                    req.slot = slot
+                    self._slot2req[slot] = (req, fut, t_submit)
+            progressed = True
+        # --------------------------------------------------- decode
+        if eng.active.any():
+            t0 = time.perf_counter()
+            emitted, finished = eng.step()
+            dt = time.perf_counter() - t0
+            now = time.monotonic()
+            n_tok = sum(len(ts) for ts in emitted.values())
+            if m is not None and n_tok:
+                m["step"].observe(dt)
+                m["tokens"].inc(n_tok)
+            if n_tok and dt > 0:
+                rate = n_tok / dt
+                self._ewma_tok_s = (rate if self._ewma_tok_s is None
+                                    else 0.8 * self._ewma_tok_s
+                                    + 0.2 * rate)
+            for slot, toks in emitted.items():
+                self._slot2req[slot][0].stream._emit_many(toks, now)
+            for slot in finished:
+                req, fut, _ = self._slot2req.pop(slot)
+                self._finish(req, m)
+            progressed = True
+        # --------------------------------------------------- gauges
+        if m is not None:
+            m["queue"].set(len(self._pending) + self._queue.qsize())
+            m["slots"].set(eng.active_slots)
+            m["blocks"].set(eng.free_blocks)
+        return progressed
+
+    def _finish(self, req, m):
+        req.stream._finish()
+        if m is not None and req.stream.t_first is not None:
+            n = len(req.stream.tokens)
+            if n > 1:
+                m["tpot"].observe(
+                    (req.stream.t_last - req.stream.t_first) / (n - 1))
+
+    # ---------------------------------------------------------- lifecycle
+    def stop(self):
+        # inherited stop() joins with a 5 s cap and proceeds — here a
+        # single decode chunk can legitimately run longer (large model
+        # x steps_per_dispatch), and mutating engine/slot state while
+        # _schedule_once is still inside eng.step() corrupts the
+        # allocator and fails streams with spurious errors. Wait the
+        # scheduler out; only touch the engine once its thread is dead.
+        self._running = False
+        scheduler_dead = True
+        if self._collector is not None:
+            self._queue.put(None)   # wake an idle park
+            self._collector.join(timeout=600)
+            scheduler_dead = not self._collector.is_alive()
+            self._collector = None
+        self._fail_pending()        # drains + fails anything queued
+        # in-flight sequences: evict and fail their streams so no
+        # consumer hangs on an iterator that will never close
+        for slot, (req, fut, _) in list(self._slot2req.items()):
+            if scheduler_dead:
+                try:
+                    self.engine.evict(slot)
+                except ValueError:
+                    pass
+            req.stream._fail(RuntimeError(
+                "GenerationServer stopped before this request finished"))
+        self._slot2req.clear()
+        for req, fut, _ in self._pending:
+            req.stream._fail(RuntimeError(
+                "GenerationServer stopped before this request was "
+                "admitted"))
+        self._pending.clear()
+
+    def _fail_pending(self):
+        """Queue items here are (request, future, t) — fail the STREAM
+        (which resolves the future and closes the iterator), not just
+        the future."""
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            req = item[0]
+            if hasattr(req, "stream"):
+                req.stream._fail(RuntimeError(
+                    "GenerationServer stopped before this request was "
+                    "executed"))
+            elif not item[1].done():
+                item[1].set_exception(RuntimeError(
+                    "GenerationServer stopped before this request was "
+                    "executed"))

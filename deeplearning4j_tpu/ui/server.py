@@ -105,6 +105,11 @@ class UIServer:
                     # scrape endpoint — see monitor/ and docs/OBSERVABILITY.md)
                     self._send(200, outer.metrics_text(),
                                "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/serving":
+                    # continuous-batching server health (serving/ tier:
+                    # queue depth, slots, pool blocks, TTFT/TPOT, sheds
+                    # — docs/SERVING.md + OBSERVABILITY.md "Serving")
+                    self._send(200, outer._serving_html())
                 elif path == "/profile":
                     # AOT cost tables + roofline (benchtools/hlo_cost.py
                     # publishes; committed PROFILE_*/cost_*.json fill in)
@@ -202,7 +207,8 @@ class UIServer:
         qs = self._qs()
         pages = [("overview", "/train/overview"), ("model", "/train/model"),
                  ("system", "/train/system"), ("tsne", "/tsne"),
-                 ("activations", "/activations"), ("profile", "/profile")]
+                 ("activations", "/activations"), ("profile", "/profile"),
+                 ("serving", "/serving")]
         links = "".join(
             f'<a href="{url}{qs}" style="margin-right:16px;'
             f'{"font-weight:bold" if p == active else ""}">'
@@ -371,6 +377,57 @@ class UIServer:
                          [r.iteration_time_ms for r in reports])
             body.append(t.render())
         return self._page(self._tr("title.system"), "".join(body))
+
+    def _serving_html(self):
+        """Continuous-batching serving health from the live metrics
+        registry (the same families /metrics exports — one source of
+        truth, rendered instead of scraped)."""
+        from deeplearning4j_tpu import monitor
+
+        body = [self._nav("serving")]
+        snap = (self._registry or monitor.registry()).snapshot()
+
+        def val(name, default="–"):
+            fam = snap.get(name)
+            if not fam or not fam.get("values"):
+                return default
+            v = fam["values"][0].get("value", default)
+            if isinstance(v, float) and v.is_integer():
+                return int(v)
+            return v
+
+        def hist(name):
+            fam = snap.get(name)
+            if not fam or not fam.get("values"):
+                return "–"
+            e = fam["values"][0]
+            n = e.get("count", 0)
+            if not n:
+                return "–"
+            return f"{1e3 * e['sum'] / n:.1f} ms avg over {n}"
+
+        rows = [
+            ("queue depth", val("serving_queue_depth")),
+            ("active slots", val("serving_active_slots")),
+            ("free pool blocks", val("serving_free_blocks")),
+            ("requests admitted", val("serving_requests_total", 0)),
+            ("tokens emitted", val("serving_tokens_total", 0)),
+            ("requests shed (SLO)", val("serving_shed_total", 0)),
+            ("evicted mid-stream", val("serving_evicted_total", 0)),
+            ("TTFT", hist("serving_ttft_seconds")),
+            ("per-token (TPOT)", hist("serving_tpot_seconds")),
+            ("decode dispatch", hist("serving_step_seconds")),
+        ]
+        if "serving_requests_total" not in snap:
+            body.append("<p>no generation server has reported yet — "
+                        "start a <code>GenerationServer</code> with "
+                        "monitoring enabled</p>")
+        body.append("<table border='1' cellpadding='4'>")
+        for k, v in rows:
+            body.append(f"<tr><td>{_html.escape(k)}</td>"
+                        f"<td>{_html.escape(str(v))}</td></tr>")
+        body.append("</table>")
+        return self._page("serving", "".join(body))
 
     def _tsne_html(self):
         body = [self._nav("tsne")]

@@ -130,6 +130,49 @@ def _check_cache_budget(net, prompt_len: int, n_tokens: int):
             f"tokens or rebuild with a larger max_len")
 
 
+def filter_logits(logits, top_k, top_p):
+    """Shared vocabulary filters for sampled decoding — `generate()`'s
+    fused scan AND the serving engine's per-slot sampler run THIS body
+    (one copy; the chains must not drift). `top_k` is static
+    (lax.top_k), `top_p` rides TRACED — a scalar (generate: sweeping p
+    reuses one executable) or a per-row column (serving: per-slot p).
+    Nucleus rule: keep tokens whose PRECEDING cumulative mass is < p
+    (the most probable token always survives)."""
+    import jax
+    import jax.numpy as jnp
+
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p is not None:
+        sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+        sp = jax.nn.softmax(sorted_l, axis=-1)
+        keep_sorted = (jnp.cumsum(sp, axis=-1) - sp) < top_p
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_l, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    return logits
+
+
+def get_prefill(net: MultiLayerNetwork):
+    """The cached prompt-prefill jit shared by `generate`, `beam_search`
+    and the serving tier's admission path (serving/engine.py): one XLA
+    program per (batch, prompt-length) shape that runs the full forward
+    with KV-cache carries and returns ([B, V] next-token probs, the
+    filled carries)."""
+    import jax
+
+    jit_cache = net.__dict__.setdefault("_transformer_gen_jit", {})
+    if "prefill" not in jit_cache:
+        @jax.jit
+        def prefill(params, state, x, carries):
+            h, _, new_carries, _, _ = net._forward_core(
+                params, state, x, train=False, rng=None, carries=carries)
+            return h[:, -1], new_carries      # [B, V] next-token probs
+        jit_cache["prefill"] = prefill
+    return jit_cache["prefill"]
+
+
 def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
              temperature: float = 1.0, top_k: int = None,
              top_p: float = None, rng=None):
@@ -154,7 +197,11 @@ def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
 
     from jax import lax
 
-    prompt = jnp.asarray(np.asarray(prompt_ids), jnp.float32)
+    # ids stay INTEGER while carried standalone: a float32 round-trip
+    # silently collapses ids at the 2^24 precision edge (16777217.0 ==
+    # 16777216.0) — the embedding gather is the only consumer and it
+    # indexes with int32 either way
+    prompt = jnp.asarray(np.asarray(prompt_ids), jnp.int32)
     B = prompt.shape[0]
     _check_cache_budget(net, prompt.shape[1], n_tokens)
     carries = {str(i): layer.init_carry(B, net.dtype.compute_dtype)
@@ -165,14 +212,7 @@ def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
     # re-trace every generate(), measured as ~4 s of fixed overhead per
     # call over the tunnel vs ~2 ms/token of actual decode compute)
     jit_cache = net.__dict__.setdefault("_transformer_gen_jit", {})
-    if "prefill" not in jit_cache:
-        @jax.jit
-        def prefill(params, state, x, carries):
-            h, _, new_carries, _, _ = net._forward_core(
-                params, state, x, train=False, rng=None, carries=carries)
-            return h[:, -1], new_carries      # [B, V] next-token probs
-        jit_cache["prefill"] = prefill
-    prefill = jit_cache["prefill"]
+    prefill = get_prefill(net)
 
     # eager argument validation (same pattern as the cache budget above:
     # a bad value must fail HERE, not as a cryptic trace error — or
@@ -196,24 +236,12 @@ def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
         @jax.jit
         def decode(params, state, probs0, carries, rng0, top_p_val):
             def filt(logits):
-                # static-shape vocabulary filters (masked, not gathered)
-                if top_k is not None:
-                    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-                    logits = jnp.where(logits >= kth, logits, -jnp.inf)
-                if top_p is not None:
-                    sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
-                    sp = jax.nn.softmax(sorted_l, axis=-1)
-                    # smallest set reaching top_p: keep tokens whose
-                    # PRECEDING cumulative mass is < p (the most
-                    # probable token is always kept)
-                    keep_sorted = (jnp.cumsum(sp, axis=-1) - sp
-                                   < top_p_val)
-                    cutoff = jnp.min(jnp.where(keep_sorted, sorted_l,
-                                               jnp.inf), axis=-1,
-                                     keepdims=True)
-                    logits = jnp.where(logits >= cutoff, logits,
-                                       -jnp.inf)
-                return logits
+                # static-shape vocabulary filters (masked, not
+                # gathered) — the ONE filter body the serving engine
+                # shares (filter_logits)
+                return filter_logits(logits, top_k,
+                                     top_p_val if top_p is not None
+                                     else None)
 
             def body(carry, _):
                 probs, carries, rng = carry
@@ -225,7 +253,7 @@ def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
                         jnp.clip(probs, 1e-9, None)) / temperature
                     nxt = jax.random.categorical(k, filt(logits))
                 h, _, new_carries, _, _ = net._forward_core(
-                    params, state, nxt[:, None].astype(jnp.float32),
+                    params, state, nxt[:, None],
                     train=False, rng=None, carries=carries)
                 return (h[:, -1], new_carries, rng), nxt
             _, toks = lax.scan(body, (probs0, carries, rng0), None,
@@ -264,7 +292,7 @@ def beam_search(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
 
     from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
 
-    prompt = jnp.asarray(np.asarray(prompt_ids), jnp.float32)
+    prompt = jnp.asarray(np.asarray(prompt_ids), jnp.int32)
     B, Tp = prompt.shape
     W = int(beam_width)
     _check_cache_budget(net, Tp, n_tokens)
@@ -336,8 +364,7 @@ def beam_search(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
                     return gather(aw, beam_idx).reshape(a.shape)
                 carries = jax.tree_util.tree_map(regather, carries)
                 h, _, carries, _, _ = net._forward_core(
-                    params, state,
-                    token.reshape(B * W, 1).astype(jnp.float32),
+                    params, state, token.reshape(B * W, 1),
                     train=False, rng=None, carries=carries)
                 logp = jnp.log(jnp.clip(h[:, -1], 1e-9, None)
                                ).reshape(B, W, V)

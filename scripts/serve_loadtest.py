@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Serving load test: continuous batching vs sequential generate().
+
+Drives N concurrent client threads against a `GenerationServer` on a
+small TransformerLM (CPU sandbox shapes), then runs the SAME request
+set as sequential whole-batch `generate()` round-trips — the
+pre-serving-tier deployment model, where every request pays a full
+B=1 decode dispatch chain and nobody shares a batch. Writes a
+BENCH-style ledger block (`extras.serving`) that
+`bench.compare_bench` gates like the training metrics, plus a
+deliberate-overload phase proving the SLO shedding path fires.
+
+Hard asserts (exit nonzero — verify.sh step [9/9] runs this in
+--smoke mode):
+
+- greedy parity: every continuous-batched stream bit-equal to its
+  whole-batch `generate()` row (staggered admissions included, since
+  n_streams >> n_slots forces mid-stream admits/retires);
+- continuous aggregate tokens/s beats sequential round-trips;
+- p99 TTFT bounded;
+- the overload phase sheds at least one request.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_net(vocab, d_model, n_layers, n_heads, max_len, seed=11):
+    from deeplearning4j_tpu.zoo.transformer import TransformerLM
+    return TransformerLM(vocab_size=vocab, d_model=d_model,
+                         n_layers=n_layers, n_heads=n_heads,
+                         max_len=max_len, seed=seed).init()
+
+
+def run_continuous(net, prompts, n_tokens, *, n_slots, n_blocks,
+                   block_len, steps_per_dispatch):
+    from deeplearning4j_tpu.serving import GenerationServer
+    n = prompts.shape[0]
+    results = [None] * n
+    ttft_ms = [None] * n
+    server = GenerationServer(
+        net, n_slots=n_slots, n_blocks=n_blocks, block_len=block_len,
+        steps_per_dispatch=steps_per_dispatch)
+    # compile the wave/decode programs outside the timed window (the
+    # sequential baseline gets the same courtesy via generate()'s
+    # jit cache)
+    server.warmup(prompts.shape[1], n_tokens).start()
+
+    errors = [None] * n
+    barrier = threading.Barrier(n + 1)
+
+    def client(i):
+        barrier.wait()
+        try:
+            t0 = time.monotonic()
+            stream = server.generate_async(prompts[i], n_tokens)
+            toks = []
+            for t, tok in enumerate(stream):
+                if t == 0:
+                    ttft_ms[i] = (time.monotonic() - t0) * 1e3
+                toks.append(tok)
+            results[i] = toks
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors[i] = e
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    barrier.wait()          # thread creation outside the timed window
+    t0 = time.monotonic()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    server.stop()
+    # a failed stream must surface ITS error, not a ragged-array
+    # TypeError from np.asarray over None rows
+    failed = [(i, e) for i, e in enumerate(errors) if e is not None]
+    failed += [(i, "no tokens") for i, r in enumerate(results)
+               if r is None and errors[i] is None]
+    if failed:
+        detail = "; ".join(f"stream {i}: {e!r}" for i, e in failed[:5])
+        raise RuntimeError(
+            f"{len(failed)}/{n} client streams failed — {detail}")
+    return (np.asarray(results, np.int64), np.asarray(ttft_ms, float),
+            wall)
+
+
+def run_sequential(net, prompts, n_tokens):
+    """The pre-serving baseline under the SAME concurrent-client
+    harness: N client threads, a server-side worker that answers each
+    request with one whole-batch B=1 `generate()` round-trip, one
+    after another (a size-1 batch holds its full fixed-length cache
+    for its whole lifetime; nobody shares a dispatch). Same client
+    threading both sides keeps the comparison honest — the GIL tax of
+    64 waiting consumers is part of serving 64 concurrent streams, not
+    a continuous-batching artifact."""
+    from deeplearning4j_tpu.zoo.transformer import generate
+    generate(net, prompts[:1], n_tokens, temperature=0)  # warm jits
+    n = prompts.shape[0]
+    results = [None] * n
+    req_q: "queue.Queue" = queue.Queue()
+
+    def worker():
+        while True:
+            item = req_q.get()
+            if item is None:
+                return
+            i, done = item
+            results[i] = generate(net, prompts[i:i + 1], n_tokens,
+                                  temperature=0)[0]
+            done.set()
+
+    barrier = threading.Barrier(n + 1)
+
+    def client(i):
+        barrier.wait()
+        done = threading.Event()
+        req_q.put((i, done))
+        done.wait()
+
+    w = threading.Thread(target=worker)
+    w.start()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.monotonic()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    req_q.put(None)
+    w.join()
+    return np.asarray(results, np.int64), wall
+
+
+def run_overload(net, prompts, n_tokens, *, block_len):
+    """Deliberate overload: a 1-slot, minimum-pool server with a tiny
+    queue cap + SLO takes a burst it cannot possibly serve — the
+    admission policy must shed rather than queue into certain
+    lateness."""
+    from deeplearning4j_tpu.serving import GenerationServer, ShedError
+    nb = -(-(prompts.shape[1] + n_tokens) // block_len) + 1
+    server = GenerationServer(net, n_slots=1, n_blocks=nb,
+                              block_len=block_len, max_queue=2,
+                              slo_ttft_s=1e-3).start()
+    streams = [server.generate_async(prompts[i % prompts.shape[0]],
+                                     n_tokens)
+               for i in range(16)]
+    shed = served = 0
+    for s in streams:
+        try:
+            s.result(timeout=600)
+            served += 1
+        except ShedError:
+            shed += 1
+    server.stop()
+    return shed, served
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=64)
+    ap.add_argument("--n-tokens", type=int, default=48)
+    ap.add_argument("--prompt-len", type=int, default=8)
+    ap.add_argument("--n-slots", type=int, default=16)
+    ap.add_argument("--block-len", type=int, default=8)
+    ap.add_argument("--steps-per-dispatch", type=int, default=16,
+                    help="decode micro-steps fused per dispatch "
+                         "(amortizes the per-step host round-trip; 16 "
+                         "keeps 48-token default streams spanning 3 "
+                         "chunks, so admissions still interleave "
+                         "mid-stream)")
+    ap.add_argument("--vocab", type=int, default=101)
+    ap.add_argument("--d-model", type=int, default=32)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--max-p99-ttft-s", type=float, default=60.0,
+                    help="hard bound on p99 TTFT (CPU sandbox scale)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="verify.sh scale: smaller model, same >=64 "
+                         "streams, same hard asserts")
+    ap.add_argument("--out", default="BENCH_serving.json")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        # still >= 64 streams and every hard assert; smaller model and
+        # shorter streams, but long enough that decode (where
+        # continuous batching wins) dominates the per-request prefill.
+        # J=12 with 24-token streams keeps every request spanning >= 2
+        # chunks, so admissions genuinely interleave mid-stream
+        args.d_model, args.n_tokens, args.prompt_len = 16, 24, 4
+        args.n_slots, args.block_len = 8, 4
+        args.steps_per_dispatch = 12
+
+    from deeplearning4j_tpu import monitor
+    monitor.enable()
+
+    max_len = args.prompt_len + args.n_tokens + args.block_len
+    max_len += (-max_len) % args.block_len     # budget % block_len == 0
+    net = build_net(args.vocab, args.d_model, args.n_layers,
+                    args.n_heads, max_len)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, args.vocab,
+                           (args.streams, args.prompt_len))
+    # pool: enough blocks to keep every slot busy, far fewer than
+    # streams * blocks-per-seq — admissions recycle retired blocks
+    bps = -(-(args.prompt_len + args.n_tokens) // args.block_len)
+    n_blocks = args.n_slots * bps + 1
+
+    from deeplearning4j_tpu.zoo.transformer import generate
+    ref = generate(net, prompts, args.n_tokens, temperature=0)
+
+    cont, ttft_ms, cont_wall = run_continuous(
+        net, prompts, args.n_tokens, n_slots=args.n_slots,
+        n_blocks=n_blocks, block_len=args.block_len,
+        steps_per_dispatch=args.steps_per_dispatch)
+    seq, seq_wall = run_sequential(net, prompts, args.n_tokens)
+
+    total_tokens = args.streams * args.n_tokens
+    cont_tps = total_tokens / cont_wall
+    seq_tps = total_tokens / seq_wall
+    p50, p99 = np.percentile(ttft_ms, [50, 99])
+    shed, served = run_overload(net, prompts, args.n_tokens,
+                                block_len=args.block_len)
+
+    parity = bool(np.array_equal(ref, cont))
+    seq_parity = bool(np.array_equal(ref, seq))
+    record = {
+        "kind": "serving_loadtest",
+        "platform": "cpu-sandbox",
+        "config": {
+            "streams": args.streams, "n_tokens": args.n_tokens,
+            "prompt_len": args.prompt_len, "n_slots": args.n_slots,
+            "block_len": args.block_len, "n_blocks": n_blocks,
+            "steps_per_dispatch": args.steps_per_dispatch,
+            "vocab": args.vocab, "d_model": args.d_model,
+            "n_layers": args.n_layers, "max_len": max_len,
+        },
+        "extras": {"serving": {
+            "tokens_per_sec": round(cont_tps, 2),
+            "sequential_tokens_per_sec": round(seq_tps, 2),
+            "speedup_vs_sequential": round(cont_tps / seq_tps, 3),
+            "p50_ttft_ms": round(float(p50), 1),
+            "p99_ttft_ms": round(float(p99), 1),
+            "wall_seconds": round(cont_wall, 3),
+            "sequential_wall_seconds": round(seq_wall, 3),
+            "n_streams": args.streams,
+            "overload_shed": shed, "overload_served": served,
+            "greedy_parity": "exact" if parity else "BROKEN",
+        }},
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+    s = record["extras"]["serving"]
+    print(f"continuous: {s['tokens_per_sec']} tok/s "
+          f"(p50 TTFT {s['p50_ttft_ms']}ms, p99 {s['p99_ttft_ms']}ms) | "
+          f"sequential: {s['sequential_tokens_per_sec']} tok/s | "
+          f"speedup {s['speedup_vs_sequential']}x | "
+          f"overload shed {shed}/{shed + served} | parity {s['greedy_parity']}")
+    print(f"ledger -> {args.out}")
+
+    failures = []
+    if not parity:
+        failures.append("continuous-batched tokens diverge from "
+                        "whole-batch generate()")
+    if not seq_parity:
+        failures.append("sequential baseline diverges from whole-batch "
+                        "generate() (harness bug)")
+    if cont_tps <= seq_tps:
+        failures.append(f"continuous batching ({cont_tps:.1f} tok/s) "
+                        f"does not beat sequential ({seq_tps:.1f})")
+    if p99 > args.max_p99_ttft_s * 1e3:
+        failures.append(f"p99 TTFT {p99:.0f}ms exceeds the "
+                        f"{args.max_p99_ttft_s}s bound")
+    if shed < 1:
+        failures.append("overload phase shed nothing")
+    if failures:
+        for f_ in failures:
+            print(f"FAIL: {f_}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,8 +38,16 @@
 #    params/updater state, bf16 gradients, and the fused-Adam Pallas
 #    kernel bit-comparable (inside jit) to the jnp updater path in
 #    interpret mode. The hlo_cost `precision` block (bf16 bytes <
-#    fp32 bytes) is asserted in step [4/8] where the reports are
+#    fp32 bytes) is asserted in step [4/9] where the reports are
 #    already on disk.
+# 9. Serving smoke: `scripts/serve_loadtest.py --smoke` — >=64
+#    concurrent streams continuously batched over the paged KV pool on
+#    a tiny TransformerLM. Hard asserts inside the script: every
+#    stream bit-equal to whole-batch `generate()` (greedy decode
+#    parity, docs/SERVING.md), aggregate tokens/s beats sequential
+#    whole-batch round-trips under the same client harness, p99 TTFT
+#    bounded, and the deliberate-overload phase sheds at least one
+#    request (SLO admission policy; `serving_shed_total`).
 # 8. Diagnostics smoke: tiny-MLP run with an injected lr spike
 #    producing non-finite gradients mid-run — the in-graph watchdog's
 #    `skip` policy must keep the trajectory finite (and training must
@@ -52,7 +60,7 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== [1/8] tier-1 tests (ROADMAP.md verbatim) =="
+echo "== [1/9] tier-1 tests (ROADMAP.md verbatim) =="
 # stale-report guard: a timeout-killed suite never reaches
 # pytest_sessionfinish, and step [2/3] must not read the previous
 # run's durations as this run's
@@ -60,7 +68,7 @@ rm -f "${DL4J_SUITE_DURATIONS:-/tmp/_t1_durations.json}"
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
 tier1_rc=$?
 
-echo "== [2/8] suite duration budget =="
+echo "== [2/9] suite duration budget =="
 python - <<'EOF'
 import json
 import os
@@ -87,7 +95,7 @@ if total > soft:
           "mark 'slow' the top offenders above before adding tests.")
 EOF
 
-echo "== [3/8] /metrics smoke =="
+echo "== [3/9] /metrics smoke =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import sys
 import urllib.request
@@ -129,7 +137,7 @@ print(f"/metrics smoke OK ({len(body.splitlines())} exposition lines, "
 EOF
 smoke_rc=$?
 
-echo "== [4/8] AOT cost smoke (hlo_cost --all) =="
+echo "== [4/9] AOT cost smoke (hlo_cost --all) =="
 hlo_out=$(mktemp -d)
 timeout -k 10 840 env JAX_PLATFORMS=cpu \
     python -m benchtools.hlo_cost --all --batch 8 --steps 2 --out "$hlo_out"
@@ -213,7 +221,7 @@ EOF
 hlo_rc=$?
 rm -rf "$hlo_out"
 
-echo "== [5/8] gradient-sharing smoke (dense vs threshold) =="
+echo "== [5/9] gradient-sharing smoke (dense vs threshold) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
     timeout -k 10 300 python - <<'PYEOF'
 import numpy as np
@@ -281,7 +289,7 @@ print(f"gradient-sharing smoke OK (init={init:.3f} dense={d:.3f} "
 PYEOF
 gs_rc=$?
 
-echo "== [6/8] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
+echo "== [6/9] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
 # train 30 steps on a tiny MLP in a child process, SIGTERM at step 15
 # (async checkpoint every 5, atomic tmp+fsync+rename commits), auto-
 # resume from the newest valid checkpoint, and require the final
@@ -290,7 +298,7 @@ echo "== [6/8] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python scripts/fault_drill.py --smoke
 drill_rc=$?
 
-echo "== [7/8] mixed-precision smoke (bf16 trajectory + fused-Adam parity) =="
+echo "== [7/9] mixed-precision smoke (bf16 trajectory + fused-Adam parity) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PYEOF'
 import jax
 import jax.numpy as jnp
@@ -379,7 +387,7 @@ print(f"mixed-precision smoke OK (init={init:.3f} fp32={d:.3f} "
 PYEOF
 mp_rc=$?
 
-echo "== [8/8] diagnostics smoke (watchdog drill + real UI feed) =="
+echo "== [8/9] diagnostics smoke (watchdog drill + real UI feed) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PYEOF'
 import urllib.request
 
@@ -470,8 +478,15 @@ print(f"diagnostics smoke OK (skipped={net._diag.skipped_total}, "
 PYEOF
 diag_rc=$?
 
-echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc} mp_rc=${mp_rc} diag_rc=${diag_rc}"
-if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ] || [ "$mp_rc" -ne 0 ] || [ "$diag_rc" -ne 0 ]; then
+echo "== [9/9] serving smoke (continuous batching, parity + SLO shed) =="
+serving_out=$(mktemp /tmp/_serving_smoke_XXXX.json)
+JAX_PLATFORMS=cpu timeout -k 10 420 \
+    python scripts/serve_loadtest.py --smoke --out "$serving_out"
+serving_rc=$?
+rm -f "$serving_out"
+
+echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc} mp_rc=${mp_rc} diag_rc=${diag_rc} serving_rc=${serving_rc}"
+if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ] || [ "$mp_rc" -ne 0 ] || [ "$diag_rc" -ne 0 ] || [ "$serving_rc" -ne 0 ]; then
     exit 1
 fi
 echo "VERIFY OK"

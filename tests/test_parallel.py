@@ -295,3 +295,108 @@ class TestParallelInferenceCoalescing:
             pi.output_async(np.zeros((1, 4), np.float32))
         with pytest.raises(RuntimeError, match="shut down"):
             pi.start()
+
+
+class TestColdStartRace:
+    def test_concurrent_cold_output_builds_once(self):
+        """Two threads racing a COLD output() must share one
+        trace/compile and one model.init() — the `_lock` created in
+        __init__ was never acquired before the fix, so both raced
+        through `_build()` (and could clobber each other's params
+        mid-flight)."""
+        import threading
+
+        net = MultiLayerNetwork(mlp_conf()).init()
+        pi = ParallelInference(net, device_mesh())
+        builds = []
+        orig_build = ParallelInference._build
+
+        def counting_build(self):
+            builds.append(threading.get_ident())
+            import time
+            time.sleep(0.05)      # widen the race window
+            return orig_build(self)
+
+        pi._build = counting_build.__get__(pi)
+        n = 6
+        outs = [None] * n
+        barrier = threading.Barrier(n)
+
+        def call(i):
+            barrier.wait()
+            outs[i] = pi.output(np.ones((2, 4), np.float32))
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(builds) == 1, (
+            f"{len(builds)} concurrent builds ran — the cold-start "
+            "race is back")
+        ref = np.asarray(net.output(np.ones((2, 4), np.float32)))
+        for o in outs:
+            np.testing.assert_allclose(o, ref, atol=1e-5)
+
+
+class TestInferenceRegistryMetrics:
+    def test_latency_queue_batchsize_emitted_without_device_sync(self):
+        """The serving signal plane: request-latency histogram,
+        queue-depth gauge, coalesced-batch-size histogram — emitted
+        from the collector thread, visible on the registry, and (the
+        PR-1 zero-sync contract) adding no device syncs beyond what
+        output() itself already does."""
+        import threading
+
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+
+        reg = monitor.enable(registry=MetricsRegistry())
+        try:
+            net = MultiLayerNetwork(mlp_conf()).init()
+            pi = ParallelInference(net, device_mesh(),
+                                   batch_limit=64, queue_limit_ms=40.0)
+            n = 8
+            with pi:
+                pi.output(np.zeros((4, 4), np.float32))   # warm compile
+                futs = [None] * n
+                barrier = threading.Barrier(n)
+
+                def call(i):
+                    barrier.wait()
+                    futs[i] = pi.output_async(
+                        np.ones((2, 4), np.float32))
+
+                threads = [threading.Thread(target=call, args=(i,))
+                           for i in range(n)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                for f in futs:
+                    f.result(timeout=30)
+            lat = reg.timer("inference_request_latency_seconds")
+            assert lat.count == n
+            assert 0 < lat.sum < 60
+            bs = reg.histogram("inference_batch_size")
+            assert bs.count >= 1 and bs.sum == 2 * n
+            # gauge exists and holds a sane point-in-time value
+            assert reg.gauge("inference_queue_depth").value >= 0
+            for fam in ("inference_request_latency_seconds",
+                        "inference_batch_size", "inference_queue_depth"):
+                assert fam in reg.exposition()
+        finally:
+            monitor.disable()
+
+    def test_metrics_off_when_monitoring_disabled(self):
+        from deeplearning4j_tpu import monitor
+
+        monitor.disable()
+        net = MultiLayerNetwork(mlp_conf()).init()
+        pi = ParallelInference(net, device_mesh(), queue_limit_ms=5.0)
+        with pi:
+            assert pi.output_async(
+                np.zeros((2, 4), np.float32)).result(timeout=30) \
+                .shape == (2, 3)
+        assert pi._metrics() is None

@@ -1,0 +1,574 @@
+"""Serving tier: paged KV-cache pool + continuous-batching scheduler.
+
+The decode-parity contract (docs/SERVING.md) is the spine of this
+suite: continuous-batched decode must emit EXACTLY the tokens
+whole-batch `generate()` emits — greedy bit-equal — including
+sequences that join/leave mid-stream, blocks that get freed and
+reused, and pools too small to hold every request at once.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.serving import (
+    GARBAGE_BLOCK,
+    BlockAllocator,
+    GenerationServer,
+    PagedDecodeEngine,
+    ShedError,
+    blocks_needed,
+)
+from deeplearning4j_tpu.zoo.transformer import TransformerLM, generate
+
+V, D, HEADS, LAYERS, MAXLEN = 23, 16, 4, 2, 16
+BL = 4          # block_len; MAXLEN/BL = 4 blocks per full sequence
+
+
+def tiny_lm(seed=3):
+    return TransformerLM(vocab_size=V, d_model=D, n_layers=LAYERS,
+                         n_heads=HEADS, max_len=MAXLEN, seed=seed).init()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return tiny_lm()
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return np.random.default_rng(5).integers(0, V, (6, 3))
+
+
+@pytest.fixture(scope="module")
+def ref_tokens(net, prompts):
+    return generate(net, prompts, 6, temperature=0)     # [6, 6]
+
+
+def drain_engine(eng, slot2req, out):
+    """Step until idle, routing emissions into `out[request]`."""
+    guard = 0
+    while eng.active.any():
+        emitted, finished = eng.step()
+        for slot, toks in emitted.items():
+            out[slot2req[slot]].extend(toks)
+        for slot in finished:
+            del slot2req[slot]
+        guard += 1
+        assert guard < 200, "engine failed to drain"
+
+
+class TestBlockAllocator:
+    def test_allocate_free_cycle(self):
+        a = BlockAllocator(8)            # 7 usable, id 0 reserved
+        assert a.free_blocks == 7
+        got = a.allocate(3)
+        assert got is not None and len(got) == 3
+        assert GARBAGE_BLOCK not in got
+        assert a.allocate(5) is None     # all-or-nothing
+        assert a.free_blocks == 4
+        a.free(got)
+        assert a.free_blocks == 7
+
+    def test_double_free_and_bad_ids_rejected(self):
+        a = BlockAllocator(4)
+        got = a.allocate(1)
+        a.free(got)
+        with pytest.raises(ValueError, match="double-free"):
+            a.free(got)
+        with pytest.raises(ValueError, match="invalid block"):
+            a.free([0])
+
+    def test_blocks_needed(self):
+        assert blocks_needed(1, 4) == 1
+        assert blocks_needed(4, 4) == 1
+        assert blocks_needed(5, 4) == 2
+
+
+class TestPagedAttentionParity:
+    def test_paged_block_matches_monolithic_carry(self, net):
+        """Stepwise: the paged path (non-contiguous blocks, garbage in
+        every unowned page) must be BIT-equal to the monolithic KV
+        carry — the property the serving parity contract rests on."""
+        blk_i = 2     # first encoder block in the stack
+        blk = net.layers[blk_i]
+        params = net.params[str(blk_i)]
+        rng = np.random.default_rng(0)
+        B, N = 2, 12
+        shape = (N, BL, HEADS, D // HEADS)
+        k_pool = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        v_pool = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        bt = jnp.asarray([[3, 5, 7, 9], [2, 4, 6, 8]], jnp.int32)
+        pos = jnp.zeros(B, jnp.int32)
+        carry = blk.init_carry(B, jnp.float32)
+        for _ in range(5):
+            x = jnp.asarray(rng.standard_normal((B, 1, D)), jnp.float32)
+            y_mono, _, carry = blk.forward_with_carry(
+                params, {}, x, carry)
+            y_paged, k_pool, v_pool = blk.forward_paged(
+                params, x, k_pool, v_pool, bt, pos)
+            pos = pos + 1
+            np.testing.assert_array_equal(np.asarray(y_mono),
+                                          np.asarray(y_paged))
+
+    def test_positional_at_positions_matches_carry(self, net):
+        pe = net.layers[1]
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((3, 1, D)), jnp.float32)
+        for p in (0, 3, 9):
+            want, _, _ = pe.forward_with_carry(
+                {}, {}, x[:1], jnp.asarray(p, jnp.int32))
+            got, _ = pe.forward_at_positions(
+                {}, {}, x[:1], jnp.asarray([p], jnp.int32))
+            np.testing.assert_array_equal(np.asarray(want),
+                                          np.asarray(got))
+
+
+class TestEngineGreedyParity:
+    def test_staggered_admissions_bit_equal(self, net, prompts,
+                                            ref_tokens):
+        """2 slots, 4 requests: sequences join as others finish —
+        every stream must match its whole-batch generate() row."""
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=16,
+                                block_len=BL)
+        out = {r: [] for r in range(4)}
+        slot2req = {}
+        pending = list(range(4))
+        guard = 0
+        while pending or eng.active.any():
+            while pending and eng.can_admit(prompts.shape[1], 6):
+                r = pending.pop(0)
+                (slot, first, done), = eng.admit_many(
+                    [dict(prompt_ids=prompts[r], n_tokens=6)])
+                out[r].append(first)
+                if not done:
+                    slot2req[slot] = r
+            emitted, finished = eng.step()
+            for slot, toks in emitted.items():
+                out[slot2req[slot]].extend(toks)
+            for slot in finished:
+                del slot2req[slot]
+            guard += 1
+            assert guard < 100
+        got = np.asarray([out[r] for r in range(4)])
+        np.testing.assert_array_equal(got, ref_tokens[:4])
+
+    def test_chunked_dispatch_same_tokens(self, net, prompts,
+                                          ref_tokens):
+        """steps_per_dispatch > 1 (fused micro-step scan) emits the
+        same streams as one-token-per-dispatch, including a slot
+        finishing mid-chunk (6 tokens, J=4 -> 2nd chunk half-valid)."""
+        for J in (4, 8):
+            eng = PagedDecodeEngine(net, n_slots=4, n_blocks=16,
+                                    block_len=BL, steps_per_dispatch=J)
+            out = {r: [] for r in range(4)}
+            slot2req = {}
+            admitted = eng.admit_many(
+                [dict(prompt_ids=prompts[r], n_tokens=6)
+                 for r in range(4)])
+            for r, (slot, first, done) in enumerate(admitted):
+                out[r].append(first)
+                if not done:
+                    slot2req[slot] = r
+            drain_engine(eng, slot2req, out)
+            got = np.asarray([out[r] for r in range(4)])
+            np.testing.assert_array_equal(got, ref_tokens[:4], err_msg=f"J={J}")
+
+    def test_evict_readmit_reuses_blocks_correctly(self, net, prompts,
+                                                   ref_tokens):
+        """Mid-stream eviction frees blocks; a new sequence admitted
+        into those SAME pool blocks must decode exactly (the freed
+        pages' stale content is dead weight, not state)."""
+        eng = PagedDecodeEngine(net, n_slots=1, n_blocks=4,
+                                block_len=BL)   # 3 usable blocks
+        (slot, first, done), = eng.admit_many(
+            [dict(prompt_ids=prompts[0], n_tokens=6)])
+        blocks_first = list(eng.slots[slot].blocks)
+        eng.step()
+        eng.evict(slot)                  # mid-stream cancel
+        assert eng.free_blocks == 3
+        # readmit a DIFFERENT request: must land on the same block ids
+        (slot2, first2, _), = eng.admit_many(
+            [dict(prompt_ids=prompts[1], n_tokens=6)])
+        assert set(eng.slots[slot2].blocks) & set(blocks_first), \
+            "allocator did not reuse the freed blocks"
+        out = {1: [first2]}
+        drain_engine(eng, {slot2: 1}, out)
+        np.testing.assert_array_equal(np.asarray(out[1]), ref_tokens[1])
+
+    def test_admission_wave_batched_prefill_parity(self, net, prompts,
+                                                   ref_tokens):
+        """A k>1 admission wave (one batched prefill + one fused
+        page-write/first-token dispatch) admits every request with the
+        same tokens as separate k=1 admissions."""
+        eng = PagedDecodeEngine(net, n_slots=4, n_blocks=16,
+                                block_len=BL)
+        admitted = eng.admit_many([
+            dict(prompt_ids=prompts[r], n_tokens=6) for r in range(4)])
+        assert len(admitted) == 4
+        out = {r: [admitted[r][1]] for r in range(4)}
+        drain_engine(eng, {admitted[r][0]: r for r in range(4)}, out)
+        got = np.asarray([out[r] for r in range(4)])
+        np.testing.assert_array_equal(got, ref_tokens[:4])
+
+    def test_pool_exhaustion_admits_prefix_only(self, net, prompts):
+        eng = PagedDecodeEngine(net, n_slots=4, n_blocks=7,
+                                block_len=BL)   # 6 usable = 2 seqs
+        admitted = eng.admit_many([
+            dict(prompt_ids=prompts[r], n_tokens=6) for r in range(4)])
+        assert len(admitted) == 2
+        assert eng.free_blocks == 0
+
+    def test_budget_rejected_eagerly(self, net):
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=16,
+                                block_len=BL)
+        with pytest.raises(ValueError, match="page budget"):
+            eng.check_budget(10, 10)    # 20 > 16
+        with pytest.raises(ValueError, match="must divide"):
+            PagedDecodeEngine(net, n_slots=2, n_blocks=16, block_len=5)
+
+
+class TestSampledDeterminism:
+    def test_same_stream_alone_or_batched(self, net, prompts):
+        """The serving rng contract: token t of a request derives from
+        fold_in(request_key, t) — the stream must not depend on what
+        else is in flight (whole-batch generate() cannot offer this;
+        the serving tier guarantees it)."""
+        key = np.asarray([7, 9], np.uint32)
+
+        def run(extra):
+            eng = PagedDecodeEngine(net, n_slots=4, n_blocks=24,
+                                    block_len=BL)
+            reqs = [dict(prompt_ids=prompts[0], n_tokens=6,
+                         temperature=1.0, top_p=0.9, rng=key)]
+            for e in range(extra):
+                reqs.append(dict(prompt_ids=prompts[e + 1], n_tokens=6,
+                                 temperature=0.7,
+                                 rng=np.asarray([e, e], np.uint32)))
+            admitted = eng.admit_many(reqs)
+            out = {r: [admitted[r][1]] for r in range(len(reqs))}
+            drain_engine(
+                eng, {admitted[r][0]: r for r in range(len(reqs))}, out)
+            return out[0]
+
+        alone = run(0)
+        batched = run(3)
+        assert alone == batched
+        assert all(0 <= t < V for t in alone)
+
+    def test_greedy_and_sampled_mix_keeps_greedy_exact(self, net,
+                                                       prompts,
+                                                       ref_tokens):
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=16,
+                                block_len=BL)
+        admitted = eng.admit_many([
+            dict(prompt_ids=prompts[0], n_tokens=6),    # greedy
+            dict(prompt_ids=prompts[1], n_tokens=6, temperature=1.0,
+                 rng=np.asarray([1, 2], np.uint32)),
+        ])
+        out = {r: [admitted[r][1]] for r in range(2)}
+        drain_engine(eng, {admitted[r][0]: r for r in range(2)}, out)
+        np.testing.assert_array_equal(np.asarray(out[0]), ref_tokens[0])
+
+
+class TestGenerationServer:
+    def test_concurrent_streams_greedy_parity(self, net, prompts,
+                                              ref_tokens):
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            streams = [srv.generate_async(prompts[r], 6)
+                       for r in range(6)]
+            got = np.stack([s.result(timeout=120) for s in streams])
+        finally:
+            srv.stop()
+        np.testing.assert_array_equal(got, ref_tokens)
+
+    def test_iterator_streams_tokens_incrementally(self, net, prompts,
+                                                   ref_tokens):
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            toks = list(srv.generate_async(prompts[0], 6))
+        finally:
+            srv.stop()
+        assert toks == list(ref_tokens[0])
+
+    def test_pool_exhaustion_queues_not_corrupts(self, net, prompts,
+                                                 ref_tokens):
+        """Pool holds ONE sequence: 4 concurrent requests must all
+        complete exactly (later ones wait for blocks; nothing reads
+        another sequence's pages)."""
+        srv = GenerationServer(net, n_slots=4, n_blocks=4,
+                               block_len=BL).start()
+        try:
+            streams = [srv.generate_async(prompts[r], 6)
+                       for r in range(4)]
+            got = np.stack([s.result(timeout=120) for s in streams])
+        finally:
+            srv.stop()
+        np.testing.assert_array_equal(got, ref_tokens[:4])
+
+    def test_cancel_midstream_and_while_queued(self, net, prompts):
+        srv = GenerationServer(net, n_slots=1, n_blocks=5,
+                               block_len=BL,
+                               steps_per_dispatch=1).start()
+        try:
+            # A holds the only slot; B is necessarily still queued
+            # (pool fits ONE sequence) — cancelling B must retire it
+            # without it ever touching a slot
+            a = srv.generate_async(prompts[0], 12)
+            b = srv.generate_async(prompts[1], 12)
+            it = iter(a)
+            first = next(it)
+            b.cancel()
+            a.cancel()                       # mid-stream (best effort)
+            got = [first] + list(it)
+            assert 1 <= len(got) <= 12
+            assert list(a.result(timeout=30)) == got
+            assert list(b.result(timeout=30)) == []
+            # slot + blocks are free again: a new request runs fully
+            s2 = srv.generate_async(prompts[2], 6)
+            assert len(s2.result(timeout=120)) == 6
+        finally:
+            srv.stop()
+
+    def test_shed_under_overload(self, net, prompts):
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        reg = monitor.enable(registry=MetricsRegistry())
+        srv = GenerationServer(net, n_slots=1, n_blocks=4,
+                               block_len=BL, max_queue=1,
+                               slo_ttft_s=1e-3).start()
+        try:
+            streams = [srv.generate_async(prompts[r % 6], 6)
+                       for r in range(8)]
+            shed = ok = 0
+            for s in streams:
+                try:
+                    s.result(timeout=120)
+                    ok += 1
+                except ShedError:
+                    shed += 1
+        finally:
+            srv.stop()
+            monitor.disable()
+        assert shed >= 1 and ok >= 1
+        assert reg.counter("serving_shed_total").value == shed
+
+    def test_serving_metrics_families(self, net, prompts):
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        reg = monitor.enable(registry=MetricsRegistry())
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            streams = [srv.generate_async(prompts[r], 6)
+                       for r in range(3)]
+            for s in streams:
+                s.result(timeout=120)
+            deadline = time.monotonic() + 5
+            while (reg.timer("serving_tpot_seconds").count < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        finally:
+            srv.stop()
+            monitor.disable()
+        assert reg.counter("serving_requests_total").value == 3
+        assert reg.counter("serving_tokens_total").value == 18
+        assert reg.timer("serving_ttft_seconds").count == 3
+        assert reg.timer("serving_tpot_seconds").count == 3
+        assert reg.counter("serving_shed_total").value == 0
+        exposition = reg.exposition()
+        for fam in ("serving_queue_depth", "serving_active_slots",
+                    "serving_free_blocks", "serving_ttft_seconds"):
+            assert fam in exposition
+
+    def test_stop_fails_inflight_and_queued(self, net, prompts):
+        srv = GenerationServer(net, n_slots=1, n_blocks=4,
+                               block_len=BL).start()
+        streams = [srv.generate_async(prompts[r % 6], 6)
+                   for r in range(4)]
+        srv.stop()
+        outcomes = []
+        for s in streams:
+            try:
+                s.result(timeout=10)
+                outcomes.append("ok")
+            except RuntimeError:
+                outcomes.append("failed")
+        # nothing may HANG; at least the queued tail must have failed
+        assert len(outcomes) == 4 and "failed" in outcomes
+
+    def test_validation_eager(self, net, prompts):
+        srv = GenerationServer(net, n_slots=1, n_blocks=8, block_len=BL)
+        with pytest.raises(RuntimeError, match="start"):
+            srv.generate_async(prompts[0], 6)
+        srv.start()
+        try:
+            with pytest.raises(ValueError, match="page budget"):
+                srv.generate_async(prompts[0], MAXLEN + 1)
+            # within the page budget but needing more blocks than the
+            # whole pool owns: must fail at submit, not deadlock queued
+            small = GenerationServer(net, n_slots=1, n_blocks=3,
+                                     block_len=BL)
+            with pytest.raises(ValueError, match="never be admitted"):
+                small.engine.check_budget(3, 12)   # 4 blocks > 2 usable
+            with pytest.raises(ValueError, match="top_p"):
+                srv.generate_async(prompts[0], 4, top_p=0.0)
+            with pytest.raises(ValueError, match="non-empty"):
+                srv.generate_async(np.zeros((0,), np.int32), 4)
+        finally:
+            srv.stop()
+
+    def test_warmup_compiles_before_start(self, net, prompts,
+                                          ref_tokens):
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL)
+        srv.warmup(prompts.shape[1], 6).start()
+        try:
+            got = srv.generate_async(prompts[0], 6).result(timeout=120)
+        finally:
+            srv.stop()
+        np.testing.assert_array_equal(got, ref_tokens[0])
+        with pytest.raises(RuntimeError, match="before start"):
+            GenerationServer(net, n_slots=2, n_blocks=16,
+                             block_len=BL).start().warmup(3)
+
+
+class TestServingBenchGate:
+    def test_compare_bench_gates_serving_metrics(self):
+        from deeplearning4j_tpu.bench import compare_bench
+
+        def rec(tps, speedup):
+            return {"platform": "cpu-sandbox", "value": 100.0,
+                    "extras": {"serving": {
+                        "tokens_per_sec": tps,
+                        "speedup_vs_sequential": speedup}}}
+
+        base = rec(5000.0, 1.5)
+        assert compare_bench(rec(4900.0, 1.45), base)["status"] == "pass"
+        verdict = compare_bench(rec(2000.0, 1.5), base)
+        assert verdict["status"] == "regression"
+        assert any(r["metric"] == "serving_tokens_per_sec"
+                   for r in verdict["regressions"])
+        verdict = compare_bench(rec(5000.0, 0.9), base)
+        assert verdict["status"] == "regression"
+        assert any(r["metric"] == "serving_speedup_vs_sequential"
+                   for r in verdict["regressions"])
+
+
+class TestServingUI:
+    def test_serving_page_renders_registry_state(self, net, prompts):
+        import urllib.request
+
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        from deeplearning4j_tpu.ui import UIServer
+
+        reg = monitor.enable(registry=MetricsRegistry())
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            srv.generate_async(prompts[0], 6).result(timeout=120)
+        finally:
+            srv.stop()
+            monitor.disable()
+        ui = UIServer(registry=reg).start()
+        try:
+            base = f"http://127.0.0.1:{ui.port}"
+            html = urllib.request.urlopen(base + "/serving",
+                                          timeout=10).read().decode()
+            assert "requests admitted" in html
+            assert "free pool blocks" in html
+            mtext = urllib.request.urlopen(base + "/metrics",
+                                           timeout=10).read().decode()
+            assert "serving_ttft_seconds" in mtext
+        finally:
+            ui.stop()
+
+
+class TestReviewHardening:
+    def test_midwave_failure_returns_allocated_blocks(self, net,
+                                                      prompts):
+        """A wave interrupted AFTER earlier requests' blocks were
+        allocated (here: a later request failing validation) must
+        return them to the pool — no Slot owns them yet, so nothing
+        else ever could (the capacity-leak -> silent-starvation
+        failure)."""
+        eng = PagedDecodeEngine(net, n_slots=4, n_blocks=16,
+                                block_len=BL)
+        before = eng.free_blocks
+        with pytest.raises(ValueError, match="non-empty"):
+            eng.admit_many([
+                dict(prompt_ids=prompts[0], n_tokens=6),
+                dict(prompt_ids=np.zeros((0,), np.int32), n_tokens=6),
+            ])
+        assert eng.free_blocks == before, "mid-wave failure leaked blocks"
+        # pool still fully serviceable
+        admitted = eng.admit_many(
+            [dict(prompt_ids=prompts[0], n_tokens=6)])
+        assert len(admitted) == 1
+
+    def test_output_async_refused_on_generation_server(self, net):
+        srv = GenerationServer(net, n_slots=1, n_blocks=8,
+                               block_len=BL).start()
+        try:
+            with pytest.raises(NotImplementedError, match="generate_async"):
+                srv.output_async(np.zeros((1, 3), np.float32))
+        finally:
+            srv.stop()
+
+    def test_warmup_covers_sampled_decode_program(self, net):
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL)
+        srv.warmup(3)
+        assert srv.engine._decode_greedy is not None
+        assert srv.engine._decode_full is not None, (
+            "warmup left the sampled decode program uncompiled — the "
+            "first temperature>0 request would stall live streams")
+
+    def test_default_sampled_requests_draw_distinct_streams(self, net,
+                                                            prompts):
+        """rng=None + temperature>0 must NOT collapse onto the
+        engine's deterministic zero key: two concurrent no-rng sampled
+        requests for the SAME prompt get distinct streams (pass rng
+        explicitly for reproducibility)."""
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            a = srv.generate_async(prompts[0], 8, temperature=1.0)
+            b = srv.generate_async(prompts[0], 8, temperature=1.0)
+            ta = list(a.result(timeout=120))
+            tb = list(b.result(timeout=120))
+        finally:
+            srv.stop()
+        assert ta != tb, "no-rng sampled requests shared one key"
+
+    def test_cancelled_queued_requests_do_not_shed_fresh_ones(self, net,
+                                                              prompts):
+        """Cancelled entries stranded mid-queue must stop counting
+        toward max_queue / the shed projection — phantom load must not
+        shed real requests."""
+        srv = GenerationServer(net, n_slots=1, n_blocks=5,
+                               block_len=BL, max_queue=2,
+                               steps_per_dispatch=1).start()
+        try:
+            a = srv.generate_async(prompts[0], 12)   # holds the slot
+            queued = [srv.generate_async(prompts[1], 6)
+                      for _ in range(2)]             # fills max_queue
+            for s in queued:
+                s.cancel()
+            # give the scheduler a beat to reap the cancelled entries
+            for s in queued:
+                s.result(timeout=30)
+            fresh = srv.generate_async(prompts[2], 6)
+            got = fresh.result(timeout=120)          # must NOT ShedError
+            assert len(got) == 6
+            a.result(timeout=120)
+        finally:
+            srv.stop()

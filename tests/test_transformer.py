@@ -679,3 +679,78 @@ class TestTransformerStreamingDepth:
         ns0 = [norm_score(ids0[0, w], s0[0, w]) for w in range(3)]
         if np.argmax(ns0) != 0:
             assert tuple(ids1[0, 0]) != tuple(ids0[0, 0])
+
+
+class TestIntegerIdCarry:
+    """generate()/beam_search() keep token ids INTEGER while carried
+    standalone: a float32 round-trip silently collapses ids at the
+    2^24 precision edge (16777217.0 == 16777216.0) — only the
+    embedding gather consumes them, and it indexes with int32 either
+    way."""
+
+    def test_generate_feeds_integer_ids_to_embedding(self, monkeypatch):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers.feedforward import (
+            EmbeddingLayer)
+        from deeplearning4j_tpu.zoo.transformer import (
+            TransformerLM, generate)
+
+        seen = []
+        orig = EmbeddingLayer.forward
+
+        def spy(self, params, state, x, **kw):
+            seen.append(jnp.asarray(x).dtype)
+            return orig(self, params, state, x, **kw)
+
+        monkeypatch.setattr(EmbeddingLayer, "forward", spy)
+        # fresh net -> fresh jit cache -> the prefill/decode traces run
+        # through the spy exactly once each
+        net = TransformerLM(vocab_size=17, d_model=16, n_layers=1,
+                            n_heads=4, max_len=12, seed=9).init()
+        out = generate(net, np.zeros((1, 3), np.int64), 4, temperature=0)
+        assert out.shape == (1, 4)
+        assert seen, "embedding never traced"
+        assert all(np.issubdtype(d, np.integer) for d in seen), (
+            f"token ids reached the embedding as {seen} — the float "
+            "carry corrupts ids at the 2^24 edge")
+
+    def test_embedding_gather_exact_at_float_precision_edge(self):
+        """Ids straddling 2^24, gathered through a huge-vocab embedding
+        table: the int path must hit exact rows where a float32 carry
+        provably collapses neighbors."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers.feedforward import (
+            EmbeddingLayer)
+
+        edge = 2 ** 24
+        V = edge + 8
+        layer = EmbeddingLayer(n_in=V, n_out=1, has_bias=False)
+        layer.time_series_input = True
+        # rows distinguishable mod 7 without allocating V*D rands
+        W = (jnp.arange(V, dtype=jnp.int32) % 7).astype(
+            jnp.float32)[:, None]
+        ids = np.asarray([[edge - 1, edge, edge + 1, edge + 3]],
+                         np.int64)
+        out, _ = layer.forward({"W": W}, {}, jnp.asarray(ids))
+        want = (ids % 7).astype(np.float32)[..., None]
+        np.testing.assert_array_equal(np.asarray(out), want)
+        # the float32 carry this guards against IS lossy here
+        as_f32 = ids.astype(np.float32).astype(np.int64)
+        assert (as_f32 != ids).any()
+
+    def test_generate_beam_unchanged_by_int_carry(self):
+        """Trajectory regression: greedy generate and beam_search stay
+        deterministic and in-vocab after the int-id change (numerics
+        must be untouched — the gather rows are identical)."""
+        from deeplearning4j_tpu.zoo.transformer import (
+            TransformerLM, beam_search, generate)
+
+        net = TransformerLM(vocab_size=17, d_model=16, n_layers=2,
+                            n_heads=4, max_len=12, seed=4).init()
+        prompt = np.asarray([[3, 5, 1], [2, 2, 4]])
+        g1 = generate(net, prompt, 5, temperature=0)
+        g2 = generate(net, prompt.astype(np.float32), 5, temperature=0)
+        np.testing.assert_array_equal(g1, g2)   # float prompts still ok
+        seqs, scores = beam_search(net, prompt, 5, beam_width=2)
+        assert seqs.shape == (2, 2, 5)
+        np.testing.assert_array_equal(seqs[:, 0], g1)  # top beam = greedy
