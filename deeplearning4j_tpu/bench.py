@@ -1174,12 +1174,24 @@ GATE_TOLERANCES = {
     # a bf16 baseline's 2.0) gates as a regression instead of
     # masquerading as a bf16 win
     "resnet50_bf16_wire_reduction": 0.02,
-    # serving-side numbers ride host thread scheduling (the loadtest
-    # drives N client threads against the scheduler thread) — wider
-    # bands than the pure-device metrics
+    # serving-side numbers ride host thread scheduling (the loadtest's
+    # event-driven clients still contend with the scheduler thread) —
+    # wider bands than the pure-device metrics
     "serving_tokens_per_sec": 0.25,
     "serving_speedup_vs_sequential": 0.25,
+    "serving_quantized_tokens_per_sec": 0.25,
+    # STRUCTURAL (weight-tree shape/dtype math, not a timing): a run
+    # that silently fell back to fp weights reports ~1.0 against an
+    # int8 baseline's ~3.6 and gates as a regression instead of
+    # masquerading as a quantized win (the bf16 wire-reduction pattern)
+    "serving_quantized_weight_bytes_reduction": 0.02,
+    # TTFT under mixed-length bucketed admission (lower is better —
+    # see GATE_LOWER_IS_BETTER); p50 of a host-scheduled latency
+    "serving_mixed_p50_ttft_ms": 0.5,
 }
+# metrics where a RISE past tolerance is the regression (latencies);
+# compare_bench inverts the ratio so the shared gate math applies
+GATE_LOWER_IS_BETTER = {"serving_mixed_p50_ttft_ms"}
 _GATE_HEADLINE = "resnet50_images_per_sec"
 
 
@@ -1212,6 +1224,15 @@ def _gate_metrics(rec):
     take("serving_tokens_per_sec", "extras", "serving", "tokens_per_sec")
     take("serving_speedup_vs_sequential",
          "extras", "serving", "speedup_vs_sequential")
+    # the mixed-length + int8-quantized loadtest phase: throughput,
+    # the structural weight-byte reduction of the decode program, and
+    # bucketed-admission TTFT (lower-is-better)
+    take("serving_quantized_tokens_per_sec",
+         "extras", "serving_mixed_quantized", "tokens_per_sec")
+    take("serving_quantized_weight_bytes_reduction",
+         "extras", "serving_mixed_quantized", "weight_bytes_reduction")
+    take("serving_mixed_p50_ttft_ms",
+         "extras", "serving_mixed_quantized", "p50_ttft_ms")
     return out
 
 
@@ -1268,7 +1289,13 @@ def compare_bench(fresh, baseline, default_tolerance=GATE_DEFAULT_TOLERANCE,
             missing.append(name)
             continue
         checked.append(name)
-        delta = val / base - 1.0
+        # lower-is-better metrics (latencies) invert the ratio so the
+        # same "delta < -t is a regression" arithmetic applies: a TTFT
+        # that ROSE past tolerance yields a negative delta here
+        if name in GATE_LOWER_IS_BETTER:
+            delta = base / val - 1.0
+        else:
+            delta = val / base - 1.0
         entry = {"metric": name, "baseline": base, "fresh": val,
                  "delta_pct": round(100.0 * delta, 2),
                  "tolerance_pct": round(100.0 * t, 1)}

@@ -9,16 +9,22 @@ concatenated into one [rows, 128] lane-aligned buffer, and a single
 grid sweep read-modify-writes param/m/v together — one kernel launch
 per run instead of ~6 XLA ops per leaf.
 
-Honest cost note: the operand assembly is NOT free — the
-concatenate/pad in, slice out adds full-tree copies around the kernel
-(Pallas operands must be contiguous), so the net HBM win over a
-well-fused XLA elementwise chain depends on how many per-leaf kernels
-XLA would otherwise launch and on leaf count/size; the structural win
-(one launch, one sweep) is what's provable device-free. The follow-up
-that removes the relayout entirely — storing the packed run's
-optimizer state pre-flattened so no per-step concat happens — is
-recorded in ROADMAP.md; compiled-mode numbers need the next live
-tunnel window.
+Operand-assembly cost, and the pre-flattened state layout: params and
+grads MUST be raveled+concatenated per step (the model needs params in
+layer layout; autodiff emits grads in layer layout), but m/v belong to
+the optimizer alone — so the containers keep a packed run's m/v in the
+kernel's lane-aligned ``[rows, 128]`` layout BETWEEN steps
+(`flatten_opt_state` at the scan_stack pack boundary, inverse at
+unpack). Inside a fused multi-step program the flat m/v ride the
+`lax.scan` carry untouched: the per-micro-step concat/ravel/slice
+relayout of the optimizer state disappears entirely, halving the
+assembly traffic around the kernel. The conversion is an exact
+relayout (pad lanes stay zero under the Adam recurrence because the
+padded grads are zero), so numerics are bit-identical to the
+per-leaf-state path — test-enforced. Checkpoints are unaffected: the
+flat form exists only between pack/unpack inside the jitted step
+programs, and the state the containers persist stays per-layer-keyed
+(the fault-runtime contract).
 
 Numerics are BIT-comparable to `common.updaters.Adam.apply` + the
 containers' ``param - upd`` application (test-enforced in interpret
@@ -52,12 +58,21 @@ from deeplearning4j_tpu.kernels.flash_attention import (
 _LANES = 128
 _SUBLANES = 8
 
+# marker key of the pre-flattened optimizer-state form: the packed
+# run's m/v as single lane-aligned [rows, 128] buffers instead of
+# per-param-key dicts (kept between steps; see module docstring)
+FLAT_KEY = "__fused_flat__"
+
 
 def fused_adam_eligible(updater) -> bool:
     """Packed-run fast-path gate: exactly the Adam rule (subclasses
     like Nadam change the update math) and kernels enabled."""
     from deeplearning4j_tpu.kernels import kernels_enabled
     return type(updater) is Adam and kernels_enabled()
+
+
+def is_flat_state(state) -> bool:
+    return isinstance(state, dict) and FLAT_KEY in state
 
 
 def _adam_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, bc1_ref, bc2_ref,
@@ -79,21 +94,6 @@ def _adam_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, bc1_ref, bc2_ref,
     v_out[...] = v
 
 
-def _flatten_run(params, grads, state):
-    """Concatenate every leaf (sorted by param name) of the packed
-    run's param/grad/m/v trees into four 1-D buffers; grads upcast to
-    the master dtype (the jnp path's `g.astype(param.dtype)`)."""
-    keys = sorted(params)
-    shapes = [np.shape(params[k]) for k in keys]
-    sizes = [int(np.prod(s)) for s in shapes]
-    dt = params[keys[0]].dtype
-    p = jnp.concatenate([params[k].reshape(-1) for k in keys])
-    g = jnp.concatenate([grads[k].reshape(-1).astype(dt) for k in keys])
-    m = jnp.concatenate([state[k]["m"].reshape(-1) for k in keys])
-    v = jnp.concatenate([state[k]["v"].reshape(-1) for k in keys])
-    return keys, shapes, sizes, p, g, m, v
-
-
 def _unflatten(flat, keys, shapes, sizes):
     out, off = {}, 0
     for k, shape, n in zip(keys, shapes, sizes):
@@ -102,15 +102,140 @@ def _unflatten(flat, keys, shapes, sizes):
     return out
 
 
+def _layout(n: int, block_rows: int = 512):
+    """The kernel's lane-aligned padded layout for `n` elements:
+    (npad, padded rows, block rows). Shared by the per-step assembly
+    AND the persistent pre-flattened state so both agree bit-for-bit
+    on where every element lives."""
+    npad = _ceil_to(max(n, 1), _LANES * _SUBLANES)
+    rows = npad // _LANES
+    br = min(block_rows, _ceil_to(rows, _SUBLANES))
+    rowsp = _ceil_to(rows, br)
+    if rowsp * _LANES != npad:
+        npad = rowsp * _LANES
+    return npad, rowsp, br
+
+
+def _to2d(a, n, npad, rowsp):
+    if npad != n:
+        a = jnp.pad(a, (0, npad - n))
+    return a.reshape(rowsp, _LANES)
+
+
+def flatten_opt_state(params, state, *, block_rows: int = 512):
+    """Per-leaf {key: {m, v}} -> the pre-flattened form: m/v each ONE
+    lane-aligned [rows, 128] buffer in the kernel's exact layout (pad
+    lanes zero — they stay zero under the Adam recurrence because the
+    per-step grads are padded with zeros). Identity when already
+    flat."""
+    if is_flat_state(state):
+        return state
+    keys = sorted(params)
+    sizes = [int(np.prod(np.shape(params[k]))) for k in keys]
+    n = sum(sizes)
+    npad, rowsp, _ = _layout(n, block_rows)
+    m = jnp.concatenate([state[k]["m"].reshape(-1) for k in keys])
+    v = jnp.concatenate([state[k]["v"].reshape(-1) for k in keys])
+    return {FLAT_KEY: {"m": _to2d(m, n, npad, rowsp),
+                       "v": _to2d(v, n, npad, rowsp)}}
+
+
+def unflatten_opt_state(params, state, *, block_rows: int = 512):
+    """Inverse relayout: flat [rows, 128] m/v back to the per-leaf
+    {key: {m, v}} dicts the containers persist (checkpoints stay
+    per-layer-keyed — the fault-runtime contract). Identity when
+    already per-leaf."""
+    if not is_flat_state(state):
+        return state
+    keys = sorted(params)
+    shapes = [np.shape(params[k]) for k in keys]
+    sizes = [int(np.prod(s)) for s in shapes]
+    n = sum(sizes)
+    m = state[FLAT_KEY]["m"].reshape(-1)[:n]
+    v = state[FLAT_KEY]["v"].reshape(-1)[:n]
+    new_m = _unflatten(m, keys, shapes, sizes)
+    new_v = _unflatten(v, keys, shapes, sizes)
+    return {k: {"m": new_m[k], "v": new_v[k]} for k in keys}
+
+
+def flatten_run_states(params, state, run_keys):
+    """Pre-flatten the eligible packed runs' optimizer state (called
+    right after `scan_stack.pack_tree` at the step/program boundary —
+    inside a fused multi-step program the flat m/v then ride the scan
+    carry with NO per-micro-step relayout)."""
+    if not run_keys:
+        return state
+    out = dict(state)
+    for rk in run_keys:
+        out[rk] = flatten_opt_state(params[rk], state[rk])
+    return out
+
+
+def unflatten_run_states(params, state, run_keys):
+    """Inverse of `flatten_run_states` (called right before
+    `scan_stack.unpack_tree`)."""
+    if not run_keys:
+        return state
+    out = dict(state)
+    for rk in run_keys:
+        out[rk] = unflatten_opt_state(params[rk], state[rk])
+    return out
+
+
+def pack_run_trees(params, upd_state, runs, fused_runs):
+    """The containers' step/program entry boundary in ONE place:
+    `scan_stack.pack_tree` on params AND updater state, then the
+    fused-eligible runs' m/v flattened into the kernel layout. The
+    ordering contract — flatten AFTER pack, over the PACKED params —
+    lives here so the four container call sites cannot drift."""
+    from deeplearning4j_tpu.nn import scan_stack
+    params = scan_stack.pack_tree(params, runs)
+    upd_state = scan_stack.pack_tree(upd_state, runs)
+    return params, flatten_run_states(params, upd_state, fused_runs)
+
+
+def unpack_run_trees(params, upd_state, runs, fused_runs):
+    """Inverse boundary: unflatten BEFORE unpack, over the
+    still-packed params."""
+    from deeplearning4j_tpu.nn import scan_stack
+    upd_state = unflatten_run_states(params, upd_state, fused_runs)
+    return (scan_stack.unpack_tree(params, runs),
+            scan_stack.unpack_tree(upd_state, runs))
+
+
 def adam_update_packed(updater: Adam, params, grads, state, step, *,
                        block_rows: int = 512,
                        interpret: bool | None = None):
     """One fused-kernel Adam update of a packed run entry. Returns
     (new_params, new_updater_state) shaped like the inputs — drop-in
-    for the per-leaf loop in the containers' `_apply_updates`."""
+    for the per-leaf loop in the containers' `_apply_updates`. `state`
+    may be per-leaf {key: {m, v}} or the pre-flattened form
+    (`flatten_opt_state`); the output keeps the input's form, so the
+    flat m/v ride a fused program's scan carry without any per-step
+    concat/ravel/slice."""
     interpret = _resolve_interpret(interpret)
-    keys, shapes, sizes, p, g, m, v = _flatten_run(params, grads, state)
-    n = p.shape[0]
+    flat_in = is_flat_state(state)
+    keys = sorted(params)
+    shapes = [np.shape(params[k]) for k in keys]
+    sizes = [int(np.prod(s)) for s in shapes]
+    n = sum(sizes)
+    npad, rowsp, br = _layout(n, block_rows)
+    dt = params[keys[0]].dtype
+    p = jnp.concatenate([params[k].reshape(-1) for k in keys])
+    g = jnp.concatenate([grads[k].reshape(-1).astype(dt) for k in keys])
+    p2 = _to2d(p, n, npad, rowsp)
+    g2 = _to2d(g, n, npad, rowsp)
+    if flat_in:
+        m2, v2 = state[FLAT_KEY]["m"], state[FLAT_KEY]["v"]
+        if m2.shape != (rowsp, _LANES):
+            raise ValueError(
+                f"pre-flattened m/v layout {m2.shape} does not match "
+                f"the run's kernel layout {(rowsp, _LANES)}")
+    else:
+        m = jnp.concatenate([state[k]["m"].reshape(-1) for k in keys])
+        v = jnp.concatenate([state[k]["v"].reshape(-1) for k in keys])
+        m2 = _to2d(m, n, npad, rowsp)
+        v2 = _to2d(v, n, npad, rowsp)
     # the EXACT scalar expressions Adam.apply evaluates — dividing by
     # the same scalars keeps the kernel bit-comparable to the jnp path
     t = jnp.asarray(step, jnp.float32) + 1.0
@@ -119,22 +244,8 @@ def adam_update_packed(updater: Adam, params, grads, state, step, *,
     lr = jnp.asarray(_lr(updater.learning_rate, step),
                      jnp.float32).reshape(1, 1)
 
-    npad = _ceil_to(max(n, 1), _LANES * _SUBLANES)
-    rows = npad // _LANES
-    br = min(block_rows, _ceil_to(rows, _SUBLANES))
-    rowsp = _ceil_to(rows, br)
-    if rowsp * _LANES != npad:
-        npad = rowsp * _LANES
-
-    def to2d(a):
-        if npad != n:
-            a = jnp.pad(a, (0, npad - n))
-        return a.reshape(rowsp, _LANES)
-
-    p2, g2, m2, v2 = (to2d(a) for a in (p, g, m, v))
     row_blk = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
     scal_blk = pl.BlockSpec((1, 1), lambda i: (0, 0))
-    dt = p2.dtype
     p_new, m_new, v_new = pl.pallas_call(
         functools.partial(_adam_kernel, beta1=float(updater.beta1),
                           beta2=float(updater.beta2),
@@ -146,9 +257,10 @@ def adam_update_packed(updater: Adam, params, grads, state, step, *,
         interpret=interpret,
     )(p2, g2, m2, v2, lr, bc1, bc2)
 
-    p_new, m_new, v_new = (a.reshape(-1)[:n]
-                           for a in (p_new, m_new, v_new))
-    new_params = _unflatten(p_new, keys, shapes, sizes)
+    new_params = _unflatten(p_new.reshape(-1)[:n], keys, shapes, sizes)
+    if flat_in:
+        return new_params, {FLAT_KEY: {"m": m_new, "v": v_new}}
+    m_new, v_new = (a.reshape(-1)[:n] for a in (m_new, v_new))
     new_m = _unflatten(m_new, keys, shapes, sizes)
     new_v = _unflatten(v_new, keys, shapes, sizes)
     new_state = {k: {"m": new_m[k], "v": new_v[k]} for k in keys}

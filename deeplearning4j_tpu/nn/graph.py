@@ -627,6 +627,14 @@ class ComputationGraph:
             self._packed_runs_cache = runs
         return runs
 
+    def _fused_state_runs(self, runs):
+        """Fused-Adam packed chains whose m/v ride the step programs
+        pre-flattened — see MultiLayerNetwork._fused_state_runs."""
+        from deeplearning4j_tpu.kernels import fused_adam as fa
+        return [scan_stack.run_key(keys) for keys in runs
+                if fa.fused_adam_eligible(
+                    self.conf.nodes[keys[0]].layer.updater or Sgd(1e-3))]
+
     def _apply_updates(self, params, grads, upd_state, step):
         from deeplearning4j_tpu.kernels import fused_adam as fa
         new_params, new_upd = {}, {}
@@ -672,9 +680,12 @@ class ComputationGraph:
             # boundary packing — see MultiLayerNetwork._make_train_step
             runs = ([] if tbptt or not scan_stack.scan_enabled(self.conf)
                     else self._packed_runs(params))
+            fused_runs = []
             if runs:
-                params = scan_stack.pack_tree(params, runs)
-                upd_state = scan_stack.pack_tree(upd_state, runs)
+                from deeplearning4j_tpu.kernels import fused_adam as fa
+                fused_runs = self._fused_state_runs(runs)
+                params, upd_state = fa.pack_run_trees(
+                    params, upd_state, runs, fused_runs)
 
             def lf(p):
                 if tbptt and carries is not None:
@@ -701,8 +712,9 @@ class ComputationGraph:
                     upd_old=upd_state, upd_new=new_upd, state_old=state,
                     state_new=new_state, grads=grads, loss=loss, acts=acts)
             if runs:
-                new_params = scan_stack.unpack_tree(new_params, runs)
-                new_upd = scan_stack.unpack_tree(new_upd, runs)
+                from deeplearning4j_tpu.kernels import fused_adam as fa
+                new_params, new_upd = fa.unpack_run_trees(
+                    new_params, new_upd, runs, fused_runs)
             return new_params, new_upd, new_state, loss, new_carries, dv
 
         return jax.jit(step_fn, donate_argnums=_donate(0, 1, 2))
@@ -742,18 +754,23 @@ class ComputationGraph:
 
         def multi(params, upd, state, it0, xs_stack, ys_stack, rngs):
             # homogeneous chains ride the k-step scan carry stacked —
-            # packed/unpacked once per PROGRAM (see scan_stack)
+            # packed/unpacked once per PROGRAM (see scan_stack); fused-
+            # Adam chains carry m/v pre-flattened (kernels/fused_adam)
             runs = (self._packed_runs(params)
                     if scan_stack.scan_enabled(self.conf) else [])
+            fused_runs = []
             if runs:
-                params = scan_stack.pack_tree(params, runs)
-                upd = scan_stack.pack_tree(upd, runs)
+                from deeplearning4j_tpu.kernels import fused_adam as fa
+                fused_runs = self._fused_state_runs(runs)
+                params, upd = fa.pack_run_trees(params, upd, runs,
+                                                fused_runs)
             (params, upd, state, _), (losses, dvs) = jax.lax.scan(
                 one, (params, upd, state, jnp.asarray(it0, jnp.int32)),
                 (xs_stack, ys_stack, rngs))
             if runs:
-                params = scan_stack.unpack_tree(params, runs)
-                upd = scan_stack.unpack_tree(upd, runs)
+                from deeplearning4j_tpu.kernels import fused_adam as fa
+                params, upd = fa.unpack_run_trees(params, upd, runs,
+                                                  fused_runs)
             return params, upd, state, losses, dvs
 
         return multi

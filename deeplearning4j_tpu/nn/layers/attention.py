@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.common.weights import init_weights
+from deeplearning4j_tpu.nd import quant
 from deeplearning4j_tpu.nn.conf.inputs import InputType, InputTypeRecurrent
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
 
@@ -175,8 +176,13 @@ class MultiHeadAttention(Layer):
                 params["b" + name[1:]] = jnp.zeros((n_o,), dtype)
         return params
 
+    def quantizable_weights(self):
+        # qkv/out projections: the decode-path HBM heavyweights
+        # (nd/quant.py int8 serving quantization; biases stay fp)
+        return ("Wq", "Wk", "Wv", "Wo")
+
     def _project(self, params, x, name):
-        z = x @ params[name]
+        z = quant.matmul(x, params[name])
         if self.has_bias:
             z = z + params["b" + name[1:]]
         return z

@@ -201,6 +201,15 @@ class Layer:
         mask = jax.random.bernoulli(rng, self.dropout, x.shape)
         return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
+    # ---- inference quantization (nd/quant.py) ----------------------------
+    def quantizable_weights(self):
+        """Param keys whose leaves are 2-D matmul weights safe to serve
+        as per-output-channel int8 (`nd.quant.quantize_net_params`).
+        Default: none — layers whose forward routes the weight through
+        the `nd.quant.matmul` seam override this. Biases, norm
+        gain/shift and embedding tables stay floating."""
+        return ()
+
     # ---- weight noise (container calls before forward during training) ---
     def apply_weight_noise(self, params, train: bool, rng):
         if not train or self.weight_noise is None or rng is None or not params:

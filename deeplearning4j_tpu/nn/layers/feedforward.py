@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.common.activations import get_activation
 from deeplearning4j_tpu.common.losses import LossFunction, get_loss
 from deeplearning4j_tpu.common.weights import init_weights
+from deeplearning4j_tpu.nd import quant
 from deeplearning4j_tpu.nn.conf.inputs import (
     InputType,
     InputTypeFeedForward,
@@ -59,8 +60,13 @@ class DenseLayer(Layer):
             params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
         return params
 
+    def quantizable_weights(self):
+        # the dense head matmul ("W") — covers OutputLayer and
+        # RnnOutputLayer (tied or untied LM heads) via inheritance
+        return ("W",)
+
     def pre_output(self, params, x):
-        z = x @ params["W"]
+        z = quant.matmul(x, params["W"])
         if self.has_bias:
             z = z + params["b"]
         return z
@@ -204,12 +210,24 @@ class EmbeddingLayer(Layer):
             params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
         return params
 
+    def quantizable_weights(self):
+        # the table gather reads ONE int8 row per token and scales by
+        # the per-channel fp32 scale after the read — exact, and it
+        # keeps the serving params tree ~4x smaller end to end (tied
+        # heads share this table with the output matmul)
+        return ("W",)
+
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         idx = x.astype(jnp.int32)
         if (idx.ndim == 2 and idx.shape[-1] == 1
                 and not self.time_series_input):
             idx = idx[:, 0]   # FF column-of-indices [B, 1] → [B]
-        z = jnp.take(params["W"], idx, axis=0)
+        W = params["W"]
+        if isinstance(W, quant.QuantizedTensor):
+            z = (jnp.take(W.q, idx, axis=0).astype(W.scale.dtype)
+                 * W.scale[0])
+        else:
+            z = jnp.take(W, idx, axis=0)
         if self.has_bias:
             z = z + params["b"]
         return self.activation(z), state

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.common.weights import init_weights
+from deeplearning4j_tpu.nd import quant
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
@@ -214,12 +215,19 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
             x = x + h
             h, _ = self._ln2.forward(self._sub(params, "ln2"), {}, x)
         act = get_activation(self.ff_activation)
-        h = act(h @ params["ff_W1"] + params["ff_b1"])
-        h = h @ params["ff_W2"] + params["ff_b2"]
+        h = act(quant.matmul(h, params["ff_W1"]) + params["ff_b1"])
+        h = quant.matmul(h, params["ff_W2"]) + params["ff_b2"]
         h = self.apply_input_dropout(h, train,
                                      None if rng is None
                                      else jax.random.fold_in(rng, 3))
         return x + h
+
+    def quantizable_weights(self):
+        # the block's matmul weights: attention projections (prefixed
+        # sublayer params) + the FF pair. LN gain/shift and biases
+        # stay floating (nd/quant.py).
+        return ("attn_Wq", "attn_Wk", "attn_Wv", "attn_Wo",
+                "ff_W1", "ff_W2")
 
     def init_carry(self, batch, dtype=jnp.float32):
         if self._mha is None:
@@ -283,8 +291,8 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
         x = x + h
         h, _ = self._ln2.forward(self._sub(params, "ln2"), {}, x)
         act = get_activation(self.ff_activation)
-        h = act(h @ params["ff_W1"] + params["ff_b1"])
-        h = h @ params["ff_W2"] + params["ff_b2"]
+        h = act(quant.matmul(h, params["ff_W1"]) + params["ff_b1"])
+        h = quant.matmul(h, params["ff_W2"]) + params["ff_b2"]
         h = self.apply_input_dropout(h, train,
                                      None if rng is None
                                      else jax.random.fold_in(rng, 3))

@@ -391,6 +391,17 @@ class MultiLayerNetwork:
             self._packed_runs_cache = runs
         return runs
 
+    def _fused_state_runs(self, runs):
+        """Packed runs whose updater takes the fused-Adam kernel —
+        their m/v ride the step programs in the kernel's pre-flattened
+        [rows, 128] layout (kernels/fused_adam.py: the relayout that
+        used to happen around the kernel every micro-step now happens
+        once per program, at the pack/unpack boundary)."""
+        from deeplearning4j_tpu.kernels import fused_adam as fa
+        return [scan_stack.run_key(keys) for keys in runs
+                if fa.fused_adam_eligible(
+                    self.layers[int(keys[0])].updater or Sgd(1e-3))]
+
     def _apply_updates(self, params, grads, upd_state, step):
         from deeplearning4j_tpu.kernels import fused_adam as fa
         new_params, new_upd = {}, {}
@@ -443,9 +454,12 @@ class MultiLayerNetwork:
             # keeps the per-layer tree.
             runs = ([] if tbptt or not scan_stack.scan_enabled(self.conf)
                     else self._packed_runs(params))
+            fused_runs = []
             if runs:
-                params = scan_stack.pack_tree(params, runs)
-                upd_state = scan_stack.pack_tree(upd_state, runs)
+                from deeplearning4j_tpu.kernels import fused_adam as fa
+                fused_runs = self._fused_state_runs(runs)
+                params, upd_state = fa.pack_run_trees(
+                    params, upd_state, runs, fused_runs)
 
             def lf(p):
                 if tbptt and carries is not None:
@@ -477,8 +491,9 @@ class MultiLayerNetwork:
                     upd_old=upd_state, upd_new=new_upd, state_old=state,
                     state_new=new_state, grads=grads, loss=loss, acts=acts)
             if runs:
-                new_params = scan_stack.unpack_tree(new_params, runs)
-                new_upd = scan_stack.unpack_tree(new_upd, runs)
+                from deeplearning4j_tpu.kernels import fused_adam as fa
+                new_params, new_upd = fa.unpack_run_trees(
+                    new_params, new_upd, runs, fused_runs)
             return new_params, new_upd, new_state, loss, new_carries, dv
 
         return jax.jit(step_fn, donate_argnums=_donate(0, 1, 2))
@@ -528,18 +543,25 @@ class MultiLayerNetwork:
 
         def multi(params, upd, state, it0, xs, ys, rngs):
             # homogeneous runs ride the k-step scan carry as stacked
-            # entries — packed/unpacked once per PROGRAM, not per step
+            # entries — packed/unpacked once per PROGRAM, not per step.
+            # Fused-Adam runs additionally carry m/v in the kernel's
+            # pre-flattened [rows, 128] layout, so the per-micro-step
+            # optimizer-state relayout disappears from the scan body.
             runs = (self._packed_runs(params)
                     if scan_stack.scan_enabled(self.conf) else [])
+            fused_runs = []
             if runs:
-                params = scan_stack.pack_tree(params, runs)
-                upd = scan_stack.pack_tree(upd, runs)
+                from deeplearning4j_tpu.kernels import fused_adam as fa
+                fused_runs = self._fused_state_runs(runs)
+                params, upd = fa.pack_run_trees(params, upd, runs,
+                                                fused_runs)
             (params, upd, state, _), (losses, dvs) = jax.lax.scan(
                 one, (params, upd, state, jnp.asarray(it0, jnp.int32)),
                 (xs, ys, rngs))
             if runs:
-                params = scan_stack.unpack_tree(params, runs)
-                upd = scan_stack.unpack_tree(upd, runs)
+                from deeplearning4j_tpu.kernels import fused_adam as fa
+                params, upd = fa.unpack_run_trees(params, upd, runs,
+                                                  fused_runs)
             return params, upd, state, losses, dvs
 
         return multi

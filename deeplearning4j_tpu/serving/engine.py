@@ -16,19 +16,37 @@ Per dispatch:
   per step (they are a few `[S]` vectors + the `[S, max_blocks]`
   tables); the pools stay device-resident (donated where the backend
   supports it).
-- admission prefills a prompt through the SAME cached `prefill` jit
-  `generate()` uses (zoo/transformer.get_prefill), then scatters the
-  filled monolithic carries into the sequence's pool blocks — so
-  prefill numerics are `generate()`'s by construction.
+- admission prefills a WAVE of prompts — heterogeneous lengths
+  bucket-padded to one shape (`zoo.transformer.get_prefill_bucketed`,
+  per-slot last-position gather) — then scatters the filled monolithic
+  carries into each sequence's pool blocks. Prefill numerics are
+  `generate()`'s by construction; right padding is sound because the
+  blocks are causal and every read past a slot's position is masked.
+
+Block allocation (`allocation="incremental"`, the default): admission
+grants only the blocks the PROMPT occupies; `step()` grows a slot's
+block table lazily as its position crosses block boundaries. Under
+pool pressure the lowest-progress slot is evicted and handed back to
+the scheduler for requeue (`drain_preempted`) instead of deadlocking —
+effective concurrency rises ~budget/actual_length for short
+generations at the same pool size. `allocation="upfront"` restores the
+PR-9 grant-everything-at-admission behavior (the A/B baseline the
+concurrency tests compare against).
+
+Weights (`quantize="int8"`): the decode/prefill/admission programs
+read per-output-channel int8 matmul weights (nd/quant.py) from HBM and
+compute in the policy's compute dtype — autoregressive decode is
+bandwidth-bound, so the ~4x weight-byte cut is the serving throughput
+lever. `net.params` (the training master) is untouched.
 
 Decode-parity contract (docs/SERVING.md): for the same prompt and
 sampling config, the token stream is identical to whole-batch
-`generate()` — greedy is exact (test-enforced bit-equality); sampled
-mode derives token t's key as `fold_in(request_key, t)`, which makes a
-request's stream deterministic REGARDLESS of what else is in flight
-(whole-batch `generate()` draws per-batch, so its sampled streams
-change with batch composition — the serving tier deliberately does
-not reproduce that).
+`generate()` — greedy is exact (test-enforced bit-equality; with
+`quantize=` the reference is `generate(quantize=...)`); sampled mode
+derives token t's key as `fold_in(request_key, t)`, which makes a
+request's stream deterministic REGARDLESS of what else is in flight —
+including across a preempt-and-requeue, whose continuation re-admits
+at the same emit offset.
 """
 
 from __future__ import annotations
@@ -39,6 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.nd import quant
 from deeplearning4j_tpu.nd.donation import donate_argnums
 from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
 from deeplearning4j_tpu.nn.layers.transformer import (
@@ -53,19 +72,41 @@ from deeplearning4j_tpu.serving.paged import (
 )
 
 
+def bucket_len(n: int, cap: int) -> int:
+    """Pad length for mixed-length prefill: the next power of two >= n,
+    clamped to `cap` (the stream budget). Quantized lengths bound the
+    prefill program grid exactly like power-of-two wave widths bound
+    the admission programs."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
 class Slot:
     """Host mirror of one serving slot's in-flight sequence."""
 
     __slots__ = ("request_id", "blocks", "prompt_len", "n_tokens",
-                 "emitted", "pos")
+                 "emitted", "pos", "emit_base")
 
-    def __init__(self, request_id, blocks, prompt_len, n_tokens):
+    def __init__(self, request_id, blocks, prompt_len, n_tokens,
+                 emit_base=0):
         self.request_id = request_id
         self.blocks = blocks
         self.prompt_len = prompt_len
         self.n_tokens = n_tokens
         self.emitted = 0
         self.pos = prompt_len
+        # tokens the request emitted in EARLIER admissions (a requeued
+        # continuation) — progress ordering and the sampled-rng emit
+        # offset both count from here
+        self.emit_base = emit_base
+
+    @property
+    def progress(self) -> int:
+        """Total tokens this REQUEST has emitted (across preemptions)
+        — the eviction policy's ordering key."""
+        return self.emit_base + self.emitted
 
 
 class PagedDecodeEngine:
@@ -83,7 +124,9 @@ class PagedDecodeEngine:
 
     def __init__(self, net, *, n_slots: int = 8, n_blocks: int = 64,
                  block_len: int = 16, top_k: Optional[int] = None,
-                 steps_per_dispatch: int = 1):
+                 steps_per_dispatch: int = 1,
+                 quantize: Optional[str] = None,
+                 allocation: str = "incremental"):
         if not getattr(net, "_initialized", False):
             net.init()
         self.net = net
@@ -95,6 +138,16 @@ class PagedDecodeEngine:
         self.top_k = None if top_k is None else int(top_k)
         if self.n_slots < 1:
             raise ValueError(f"n_slots must be >= 1; got {n_slots}")
+        if allocation not in ("incremental", "upfront"):
+            raise ValueError(
+                f"allocation must be 'incremental' or 'upfront'; "
+                f"got {allocation!r}")
+        self.allocation = allocation
+        self.quantize = quantize
+        # pay the quantization pass NOW, not inside the first live
+        # dispatch (the tree itself is resolved per dispatch — see
+        # the _params property)
+        quant.serving_params(net, quantize)
         budget = stream_budget(net.layers)
         if budget is None:
             raise ValueError(
@@ -151,8 +204,24 @@ class PagedDecodeEngine:
         self._decode_full = None      # greedy + sampling chain
         self._decode_greedy = None    # argmax only (no sort/rng ops)
         self._admit_finish = {}       # k -> fused write-pages+first-token
+        # allocator observability (host ints — the scheduler mirrors
+        # them onto the metrics registry) + preemption notices the
+        # scheduler drains for requeue
+        self.block_grants_total = 0
+        self.evict_requeue_total = 0
+        self._preempted: List[dict] = []
 
     # ------------------------------------------------------------ queries
+    @property
+    def _params(self):
+        """The params tree every serving program reads: int8-quantized
+        matmul weights under quantize="int8" (nd/quant.py), the net's
+        own tree otherwise — resolved PER DISPATCH, so a fit()/restore
+        between dispatches serves the fresh weights (serving_params'
+        identity-keyed cache makes this a dict lookup; quantization
+        re-runs only when net.params was reassigned)."""
+        return quant.serving_params(self.net, self.quantize)
+
     @property
     def free_slots(self) -> int:
         return sum(1 for s in self.slots if s is None)
@@ -165,17 +234,26 @@ class PagedDecodeEngine:
     def free_blocks(self) -> int:
         return self.pool.free_blocks
 
+    def _admit_blocks(self, prompt_len: int, n_tokens: int) -> int:
+        """Blocks an admission grants NOW: the prompt's footprint under
+        incremental allocation (decode growth is lazy), the request's
+        whole budget under the PR-9 upfront policy."""
+        if self.allocation == "incremental":
+            return blocks_needed(prompt_len, self.block_len)
+        return blocks_needed(prompt_len + n_tokens, self.block_len)
+
     def can_admit(self, prompt_len: int, n_tokens: int) -> bool:
         return (any(s is None for s in self.slots)
-                and blocks_needed(prompt_len + n_tokens, self.block_len)
+                and self._admit_blocks(prompt_len, n_tokens)
                 <= self.pool.free_blocks)
 
     def check_budget(self, prompt_len: int, n_tokens: int):
         """Reject requests that can NEVER be admitted — distinct from
         `can_admit` (not right now): over the per-sequence page budget,
-        or needing more blocks than the whole pool owns (a queued
-        request waiting on capacity that cannot exist would deadlock
-        its consumer)."""
+        or needing more blocks AT THE END than the whole pool owns
+        (under incremental allocation a request must still be able to
+        finish alone in the pool — pool-pressure preemption can evict
+        every OTHER slot, never conjure capacity)."""
         total = prompt_len + n_tokens
         if n_tokens < 1:
             raise ValueError(f"n_tokens must be >= 1; got {n_tokens}")
@@ -220,7 +298,10 @@ class PagedDecodeEngine:
         return jnp.where(temp > 0, sampled.astype(jnp.int32), greedy_ids)
 
     # ------------------------------------------------------ jit builders
-    def _build_decode(self, greedy_only: bool):
+    def _decode_body(self, greedy_only: bool):
+        """The decode-chunk python body (jitted by `_build_decode`;
+        traced directly by `decode_cost_report` for the byte-table
+        evidence)."""
         net, layers, plan = self.net, self.net.layers, self._plan
         J = self.steps_per_dispatch
 
@@ -272,7 +353,41 @@ class PagedDecodeEngine:
                 micro, carry, None, length=J)
             return kv, toks, valids            # [J, S] each
 
-        return jax.jit(decode_step, donate_argnums=donate_argnums(2))
+        return decode_step
+
+    def _build_decode(self, greedy_only: bool):
+        return jax.jit(self._decode_body(greedy_only),
+                       donate_argnums=donate_argnums(2))
+
+    def decode_cost_report(self) -> dict:
+        """Byte accounting of the REAL decode program (greedy variant)
+        via the hlo_cost per-op tables — the quantization ledger's
+        evidence seam: weight HBM bytes of the params tree the program
+        reads, split matmul-weights vs total, plus the per-op
+        operand+result byte totals of one traced decode chunk."""
+        from benchtools import hlo_cost
+
+        S = self.n_slots
+        args = (self._params, self.net.net_state, self.pool.kv,
+                jnp.asarray(self.block_tables), jnp.asarray(self.last_token),
+                jnp.asarray(self.pos), jnp.asarray(self.remaining),
+                jnp.asarray(self.keys), jnp.asarray(self.emit_idx),
+                jnp.asarray(self.temp), jnp.asarray(self.top_p))
+        jaxpr = jax.make_jaxpr(self._decode_body(greedy_only=True))(*args)
+        table = hlo_cost.per_op_table(jaxpr,
+                                      fused_steps=self.steps_per_dispatch)
+        mm_keys = quant.quantized_weight_keys(self.net)
+        mm_bytes = quant.weight_bytes(
+            {lk: {pk: self._params[lk][pk] for pk in pks}
+             for lk, pks in mm_keys.items()})
+        return {
+            "quantize": self.quantize,
+            "weight_bytes": quant.weight_bytes(self._params),
+            "matmul_weight_bytes": mm_bytes,
+            "decode_bytes_per_step": table["total_bytes_per_step"],
+            "decode_flops_per_step": table["total_flops_per_step"],
+            "n_slots": S,
+        }
 
     def _build_admit_finish(self, k: int, greedy_only: bool):
         """One fused dispatch completing a k-wide admission wave:
@@ -285,10 +400,13 @@ class PagedDecodeEngine:
         to win."""
         bl = self.block_len
 
-        def admit_finish(kv, rows, block_carries, probs, keys, temp,
-                         top_p):
+        def admit_finish(kv, rows, block_carries, probs, keys, emit0,
+                         temp, top_p):
             # rows [k, max_rows]; block_carries: per layer (k_cache,
-            # v_cache) with leading dim k; probs [k, V]
+            # v_cache) with leading dim k; probs [k, V]; emit0 [k] is
+            # the sampled-rng emit offset (nonzero for a requeued
+            # continuation — its stream keeps the fold_in(key, t)
+            # indices it would have had uninterrupted)
             out = []
             for (k_pool, v_pool), (k_cache, v_cache) in zip(
                     kv, block_carries):
@@ -301,9 +419,7 @@ class PagedDecodeEngine:
                     v_pool.at[flat_rows].set(
                         v_cache.reshape(shape).astype(v_pool.dtype)),
                 ))
-            firsts = self._sample_ids(probs, keys,
-                                      jnp.zeros((k,), jnp.int32),
-                                      temp, top_p,
+            firsts = self._sample_ids(probs, keys, emit0, temp, top_p,
                                       greedy_only=greedy_only)
             return tuple(out), firsts
 
@@ -324,23 +440,26 @@ class PagedDecodeEngine:
         return out[0] if out else None
 
     def admit_many(self, requests: List[dict]):
-        """Admission wave: prefill up to len(requests) SAME-LENGTH
-        prompts as one batch through the cached `prefill` jit
-        (zoo/transformer.get_prefill — `generate()`'s own program, so
-        prefill numerics are its by construction), then one fused
-        dispatch writes all their pool pages and samples all their
-        first tokens. Requests beyond the wave's slot/block capacity
-        are left unadmitted (the returned list is a PREFIX of the
-        input — FIFO order preserved).
+        """Admission wave: prefill up to len(requests) prompts — of
+        HETEROGENEOUS lengths, right-padded to one power-of-two bucket
+        — as one batch through the cached bucketed-prefill jit
+        (zoo/transformer.get_prefill_bucketed: `generate()`'s forward
+        with a per-slot last-position gather, so prefill numerics are
+        its by construction), then one fused dispatch writes all their
+        pool pages and samples all their first tokens. Requests beyond
+        the wave's slot/block capacity are left unadmitted (the
+        returned list is a PREFIX of the input — FIFO order
+        preserved).
 
         Each request dict: prompt_ids, n_tokens, and optionally
-        request_id, temperature, top_p, rng. Returns
+        request_id, temperature, top_p, rng, emit_start (a requeued
+        continuation's already-emitted token count — offsets the
+        sampled-rng fold and the progress ordering). Returns
         [(slot, first_token, done), ...] for the admitted prefix."""
         if not requests:
             return []
         wave = []
         try:
-            P = None
             for r in requests:
                 prompt = np.asarray(r["prompt_ids"])
                 if prompt.ndim == 2 and prompt.shape[0] == 1:
@@ -349,10 +468,7 @@ class PagedDecodeEngine:
                     raise ValueError(
                         f"prompt must be a non-empty 1-D id sequence; "
                         f"got shape {prompt.shape}")
-                if P is None:
-                    P = int(prompt.shape[0])
-                elif int(prompt.shape[0]) != P:
-                    break    # caller groups by length; stop the wave
+                P = int(prompt.shape[0])
                 n_tokens = int(r["n_tokens"])
                 self.check_budget(P, n_tokens)
                 slot = next((i for i, s in enumerate(self.slots)
@@ -361,7 +477,7 @@ class PagedDecodeEngine:
                             None)
                 if slot is None:
                     break
-                nb = blocks_needed(P + n_tokens, self.block_len)
+                nb = self._admit_blocks(P, n_tokens)
                 blocks = self.pool.allocator.allocate(nb)
                 if blocks is None:
                     break
@@ -389,38 +505,54 @@ class PagedDecodeEngine:
 
     def _admit_wave(self, wave):
         k = len(wave)
-        # pad the wave to the next power of two: every distinct batch
-        # width costs a prefill + admit_finish COMPILE, and free-slot
-        # counts vary chunk to chunk — unquantized widths were measured
-        # as a compile storm that dwarfed the serving itself. Dummy
-        # rows repeat the last prompt, scatter only into the garbage
-        # block, and their sampled firsts are discarded.
+        # pad the wave WIDTH to the next power of two: every distinct
+        # batch width costs a prefill + admit_finish COMPILE, and
+        # free-slot counts vary chunk to chunk — unquantized widths
+        # were measured as a compile storm that dwarfed the serving
+        # itself. Dummy rows repeat the last prompt, scatter only into
+        # the garbage block, and their sampled firsts are discarded.
         k2 = 1
         while k2 < k:
             k2 *= 2
+        # pad the prompt LENGTHS to one power-of-two bucket (mixed-
+        # length waves — the same-length restriction serialized
+        # admissions under realistic traffic): right padding is sound
+        # because the blocks are causal and the padding rows' K/V land
+        # past each slot's position, where every later read masks them
+        Pb = bucket_len(max(int(w[1].shape[0]) for w in wave),
+                        self.max_total_tokens)
 
         net = self.net
-        from deeplearning4j_tpu.zoo.transformer import get_prefill
-        prefill = get_prefill(net)
+        from deeplearning4j_tpu.zoo.transformer import get_prefill_bucketed
+        prefill = get_prefill_bucketed(net)
         carries = {str(i): layer.init_carry(k2, net.dtype.compute_dtype)
                    for i, layer in enumerate(net.layers)
                    if isinstance(layer, BaseRecurrentLayer)}
-        prompts = np.stack([w[1] for w in wave]
-                           + [wave[-1][1]] * (k2 - k)).astype(np.int32)
-        probs, carries = prefill(net.params, net.net_state,
-                                 jnp.asarray(prompts), carries)
+        prompts = np.zeros((k2, Pb), np.int32)
+        last_idx = np.zeros(k2, np.int32)
+        for j, w in enumerate(wave):
+            prompts[j, :w[1].shape[0]] = w[1]
+            last_idx[j] = w[1].shape[0] - 1
+        for j in range(k, k2):                # dummy width-padding rows
+            prompts[j] = prompts[k - 1]
+            last_idx[j] = last_idx[k - 1]
+        probs, carries = prefill(self._params, net.net_state,
+                                 jnp.asarray(prompts), carries,
+                                 jnp.asarray(last_idx))
 
         block_carries = [carries[str(i)] for i in self.pool.layer_indices]
         max_rows = max(c[0].shape[1] // self.block_len
                        for c in block_carries)
         rows = np.full((k2, max_rows), GARBAGE_BLOCK, np.int32)
         keys = np.zeros((k2, 2), np.uint32)
+        emit0 = np.zeros(k2, np.int32)
         temps = np.zeros(k2, np.float32)
         top_ps = np.ones(k2, np.float32)
         for j, (slot, prompt, n_tokens, nb, blocks, r) in enumerate(wave):
             rows[j, :nb] = blocks
             if r.get("rng") is not None:
                 keys[j] = np.asarray(r["rng"], np.uint32).reshape(2)
+            emit0[j] = int(r.get("emit_start") or 0)
             temps[j] = r.get("temperature") or 0.0
             p = r.get("top_p")
             top_ps[j] = 1.0 if p is None else p
@@ -435,7 +567,8 @@ class PagedDecodeEngine:
         self.pool.kv, firsts = fin(
             self.pool.kv, jnp.asarray(rows),
             tuple((c[0], c[1]) for c in block_carries), probs,
-            jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(top_ps))
+            jnp.asarray(keys), jnp.asarray(emit0), jnp.asarray(temps),
+            jnp.asarray(top_ps))
         firsts = np.asarray(firsts)
 
         out = []
@@ -443,29 +576,100 @@ class PagedDecodeEngine:
             first = int(firsts[j])
             done = n_tokens == 1
             self.slots[slot] = Slot(r.get("request_id"), blocks,
-                                    len(prompt), n_tokens)
+                                    len(prompt), n_tokens,
+                                    emit_base=int(emit0[j]))
             self.slots[slot].emitted = 1
             self.block_tables[slot] = GARBAGE_BLOCK
             self.block_tables[slot, :nb] = blocks
             self.pos[slot] = len(prompt)
             self.remaining[slot] = n_tokens - 1
-            self.emit_idx[slot] = 1
+            self.emit_idx[slot] = int(emit0[j]) + 1
             self.last_token[slot] = first
             self.keys[slot] = keys[j]
             self.temp[slot] = temps[j]
             self.top_p[slot] = top_ps[j]
             self.active[slot] = not done
+            self.block_grants_total += nb
             if done:
                 self._release(slot)
             out.append((slot, first, done))
         return out
+
+    # -------------------------------------------- incremental block grants
+    def _lowest_progress_active(self) -> int:
+        """The pool-pressure eviction victim: the active slot whose
+        REQUEST has emitted the fewest tokens (requeue costs it the
+        least re-prefill work). Ties break toward the higher slot
+        INDEX — an arbitrary but deterministic order (slot index is
+        not admission order once retired slots are reused)."""
+        best, best_p = -1, None
+        for i in np.flatnonzero(self.active):
+            i = int(i)
+            p = self.slots[i].progress
+            if best_p is None or p <= best_p:
+                best, best_p = i, p
+        return best
+
+    def _preempt(self, slot: int):
+        s = self.slots[slot]
+        self._preempted.append({
+            "slot": slot, "request_id": s.request_id,
+            "emitted": s.progress,
+        })
+        self.evict_requeue_total += 1
+        self._release(slot)
+
+    def drain_preempted(self) -> List[dict]:
+        """Preemption notices since the last drain: [{slot, request_id,
+        emitted}] — the scheduler requeues each request as a
+        continuation (prompt + its emitted tokens, emit_start set) at
+        the head of the admission queue."""
+        out, self._preempted = self._preempted, []
+        return out
+
+    def _grow_block_tables(self):
+        """Lazy block grants before a decode dispatch: every active
+        slot gets the blocks the chunk's writes will cross into. Under
+        pool pressure the lowest-progress slot is evicted (requeue, not
+        deadlock); eviction frees at least one block per round, and
+        check_budget guarantees a slot left alone in the pool can
+        always finish — so this terminates with every surviving slot
+        fully granted."""
+        J = self.steps_per_dispatch
+        for s in range(self.n_slots):
+            if not self.active[s] or self.slots[s] is None:
+                continue
+            slot = self.slots[s]
+            tokens = min(J, int(self.remaining[s]))
+            needed = blocks_needed(int(self.pos[s]) + tokens,
+                                   self.block_len)
+            have = len(slot.blocks)
+            if needed <= have:
+                continue
+            got = self.pool.allocator.allocate(needed - have)
+            while got is None:
+                victim = self._lowest_progress_active()
+                self._preempt(victim)
+                if victim == s:
+                    break              # s itself lost the pool race
+                got = self.pool.allocator.allocate(needed - have)
+            if got is None or self.slots[s] is None:
+                continue
+            slot.blocks.extend(got)
+            self.block_tables[s, have:needed] = got
+            self.block_grants_total += len(got)
 
     # ------------------------------------------------------------- decode
     def step(self) -> Tuple[Dict[int, List[int]], List[int]]:
         """One continuous-batching dispatch: every active slot advances
         up to `steps_per_dispatch` tokens. Returns ({slot: [tokens
         emitted this dispatch]}, [slots that finished and were
-        released])."""
+        released]). Under incremental allocation, slots whose next
+        writes cross a block boundary are granted blocks first — and
+        pool pressure preempts the lowest-progress slot into
+        `drain_preempted()` instead of deadlocking."""
+        if self.allocation == "incremental":
+            self._grow_block_tables()
         if not self.active.any():
             return {}, []
         # two static program variants: the greedy-only decode skips the
@@ -480,7 +684,7 @@ class PagedDecodeEngine:
                 self._decode_greedy = self._build_decode(greedy_only=True)
             decode = self._decode_greedy
         kv, toks, valids = decode(
-            self.net.params, self.net.net_state, self.pool.kv,
+            self._params, self.net.net_state, self.pool.kv,
             jnp.asarray(self.block_tables), jnp.asarray(self.last_token),
             jnp.asarray(self.pos), jnp.asarray(self.remaining),
             jnp.asarray(self.keys), jnp.asarray(self.emit_idx),

@@ -127,6 +127,29 @@ class _Request:
         self.stream = stream
         self.slot = None
 
+    # ---- preempt-and-requeue continuation (incremental allocation):
+    # a pool-pressure eviction re-admits the request as its original
+    # prompt EXTENDED by every token already streamed, generating only
+    # the remainder — greedy continuations are bit-consistent (prefill
+    # of the extended prompt reproduces the decode-path numerics, the
+    # parity contract) and sampled ones keep their fold_in(key, t)
+    # indices via emit_start.
+    def effective_prompt(self):
+        import numpy as np
+        done = self.stream.tokens
+        if not done:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(done, self.prompt.dtype)])
+
+    @property
+    def emitted(self) -> int:
+        return len(self.stream.tokens)
+
+    @property
+    def n_left(self) -> int:
+        return self.n_tokens - self.emitted
+
 
 class GenerationServer(ParallelInference):
     """Continuous-batching autoregressive serving over a paged KV pool.
@@ -142,11 +165,14 @@ class GenerationServer(ParallelInference):
                  steps_per_dispatch: int = 1,
                  slo_ttft_s: Optional[float] = None,
                  max_queue: Optional[int] = None,
-                 idle_wait_s: float = 0.05):
+                 idle_wait_s: float = 0.05,
+                 quantize: Optional[str] = None,
+                 allocation: str = "incremental"):
         super().__init__(net)
         self.engine = PagedDecodeEngine(
             net, n_slots=n_slots, n_blocks=n_blocks, block_len=block_len,
-            top_k=top_k, steps_per_dispatch=steps_per_dispatch)
+            top_k=top_k, steps_per_dispatch=steps_per_dispatch,
+            quantize=quantize, allocation=allocation)
         self._metrics_cache = None
         self.slo_ttft_s = slo_ttft_s
         self.max_queue = max_queue
@@ -155,6 +181,10 @@ class GenerationServer(ParallelInference):
         self._slot2req = {}
         # shedding estimator: EWMA of aggregate decode throughput
         self._ewma_tok_s: Optional[float] = None
+        # counter mirrors: the engine keeps host ints (it has no
+        # registry); the scheduler publishes the deltas each loop
+        self._grants_seen = 0
+        self._requeue_seen = 0
 
     def output_async(self, x):
         """Not supported here: the scheduler queue carries generation
@@ -169,16 +199,18 @@ class GenerationServer(ParallelInference):
 
     # ------------------------------------------------------------- warmup
     def warmup(self, prompt_len: int, n_tokens: int = 2):
-        """Compile the serving programs for one prompt length OUTSIDE
-        the serving path: one admission wave per power-of-two wave
-        width up to the slot count (async arrival means real waves
-        take EVERY quantized width, and each width is its own
-        batched-prefill/admit program) plus the greedy decode chunk.
-        Call BEFORE start() — an XLA compile inside a live admission
-        wave stalls every queued request behind ~seconds of tracing
-        (measured as a p50==p99 TTFT cliff on the CPU sandbox; stack
-        sampling showed the scheduler thread pinned in
-        backend_compile)."""
+        """Compile the serving programs OUTSIDE the serving path: the
+        full (wave-width-pow2 x prompt-length-bucket) program grid up
+        to the slot count and `bucket_len(prompt_len)` — async arrival
+        means real waves take EVERY quantized width, mixed-length
+        traffic takes every length bucket, and each (width, bucket)
+        pair is its own batched-prefill program (the admit_finish and
+        decode programs key on width alone). Call BEFORE start() — an
+        XLA compile inside a live admission wave stalls every queued
+        request behind ~seconds of tracing (measured as a p50==p99
+        TTFT cliff on the CPU sandbox; stack sampling showed the
+        scheduler thread pinned in backend_compile)."""
+        from deeplearning4j_tpu.serving.engine import bucket_len
         if self._running:
             raise RuntimeError("warmup() must run before start()")
         eng = self.engine
@@ -190,38 +222,71 @@ class GenerationServer(ParallelInference):
             widths.append(w)
             w *= 2
         widths.append(eng.n_slots)
-        # each width warms BOTH admit variants (all-greedy and the
-        # sampling chain) — a mixed wave keys a different program —
-        # and the first sampled wave also compiles the sampled decode
-        # chunk, so a temperature>0 request never stalls live streams
-        # on a mid-serving trace
+        top_bucket = bucket_len(int(prompt_len), eng.max_total_tokens)
+        buckets = []
+        b = 1
+        while b <= top_bucket:
+            buckets.append(b)
+            b *= 2
+        if buckets[-1] != top_bucket:
+            buckets.append(top_bucket)     # budget-clamped odd bucket
+        # each (width, bucket) warms BOTH admit variants (all-greedy
+        # and the sampling chain) — a mixed wave keys a different
+        # program — and the first sampled wave also compiles the
+        # sampled decode chunk, so a temperature>0 request never
+        # stalls live streams on a mid-serving trace
+        short_wave = None      # narrowest under-admitted wave seen
         for k in widths:
-            for sampled_head in (False, True):
-                reqs = [dict(prompt_ids=np.zeros(int(prompt_len),
-                                                 np.int32),
-                             n_tokens=n_tokens)
-                        for _ in range(k)]
-                if sampled_head:
-                    reqs[0].update(temperature=1.0,
-                                   rng=np.zeros(2, np.uint32))
-                admitted = eng.admit_many(reqs)
-                while eng.active.any():
-                    eng.step()
-                for slot, _, done in admitted:
-                    if not done and eng.slots[slot] is not None:
-                        eng.evict(slot)
-            if len(admitted) < k:
-                # pool too small for this width even at warmup's
-                # minimal n_tokens — real waves of this width compile
-                # mid-serving if requests ever need fewer blocks each
+            for pl in buckets:
+                # a bucket rounded past the prompt may leave less token
+                # headroom than requested — admission-only warmup (n=1)
+                # still compiles that bucket's prefill/admit programs
+                pw = int(pl)
+                n_b = min(n_tokens, eng.max_total_tokens - pw)
+                if n_b < 1:
+                    # the budget-clamped TOP bucket: a one-shorter
+                    # prompt still PADS to this bucket, so the same
+                    # (width, bucket) prefill program compiles — a
+                    # real budget-edge request must not be the first
+                    # to trace it
+                    pw, n_b = pw - 1, 1
+                    if pw < 1:
+                        continue
+                for sampled_head in (False, True):
+                    reqs = [dict(prompt_ids=np.zeros(pw, np.int32),
+                                 n_tokens=n_b)
+                            for _ in range(k)]
+                    if sampled_head:
+                        reqs[0].update(temperature=1.0,
+                                       rng=np.zeros(2, np.uint32))
+                    admitted = eng.admit_many(reqs)
+                    while eng.active.any():
+                        eng.step()
+                    eng.drain_preempted()   # warmup traffic isn't real
+                    for slot, _, done in admitted:
+                        if not done and eng.slots[slot] is not None:
+                            eng.evict(slot)
+                    if len(admitted) < k and short_wave is None:
+                        short_wave = (len(admitted), k)
+            if short_wave is not None:
+                # pool too small for this width (at SOME bucket) even
+                # at warmup's minimal n_tokens — real waves of this
+                # width compile mid-serving if requests ever need
+                # fewer blocks each
                 import logging
                 logging.getLogger(__name__).warning(
-                    "warmup admitted only %d of the width-%d wave "
+                    "warmup admitted only %d of a width-%d wave "
                     "(pool %d blocks): wave widths above %d are NOT "
-                    "pre-compiled — grow n_blocks or expect a one-off "
-                    "compile stall on the first wider wave",
-                    len(admitted), k, eng.pool.n_blocks, len(admitted))
+                    "fully pre-compiled — grow n_blocks or expect a "
+                    "one-off compile stall on the first wider wave",
+                    short_wave[0], short_wave[1], eng.pool.n_blocks,
+                    short_wave[0])
                 break
+        # the warmup grid's grants/preemptions are not serving traffic:
+        # reset the engine totals so the registry deltas (_drain) and
+        # ledger reads count real requests only
+        eng.block_grants_total = 0
+        eng.evict_requeue_total = 0
         return self
 
     # ------------------------------------------------------------- submit
@@ -287,6 +352,17 @@ class GenerationServer(ParallelInference):
                                 "admission policy"),
             "evicted": reg.counter("serving_evicted_total",
                                    "sequences evicted mid-stream"),
+            "pool_free": reg.gauge("serving_pool_blocks_free",
+                                   "free KV-pool blocks (allocator "
+                                   "view)"),
+            "pool_used": reg.gauge("serving_pool_blocks_used",
+                                   "granted KV-pool blocks"),
+            "grants": reg.counter("serving_block_grants_total",
+                                  "pool blocks granted (admission + "
+                                  "lazy decode growth)"),
+            "requeue": reg.counter("serving_evict_requeue_total",
+                                   "pool-pressure preemptions requeued "
+                                   "as continuations"),
             "ttft": reg.timer("serving_ttft_seconds",
                               "submit-to-first-token latency"),
             "tpot": reg.timer("serving_tpot_seconds",
@@ -298,11 +374,17 @@ class GenerationServer(ParallelInference):
 
     # ----------------------------------------------------------- shedding
     def _outstanding_tokens(self) -> int:
+        """Outstanding decode work, from ACTUAL occupancy: live slots'
+        remaining tokens plus, per queued request, the tokens it still
+        owes (`n_left` — a requeued continuation owes only its tail)
+        and a prefill cost proxy."""
         eng = self.engine
         out = int(eng.remaining[eng.active].sum())
         for req, _, _ in self._pending:
-            out += req.n_tokens + blocks_needed(
-                len(req.prompt), eng.block_len)  # prefill cost proxy
+            # a continuation's effective prompt is prompt + emitted;
+            # only the LENGTH matters here — don't materialize it
+            out += req.n_left + blocks_needed(
+                len(req.prompt) + req.emitted, eng.block_len)
         return out
 
     def _should_shed(self, req) -> Optional[str]:
@@ -341,6 +423,10 @@ class GenerationServer(ParallelInference):
                     self._pending.append(item)
 
     def _fail_all(self, exc: BaseException):
+        try:
+            self.engine.drain_preempted()   # notices die with their reqs
+        except Exception:  # noqa: BLE001 — engine state may be torn
+            pass
         for slot, (req, fut, _) in list(self._slot2req.items()):
             try:
                 self.engine.evict(slot)
@@ -409,25 +495,30 @@ class GenerationServer(ParallelInference):
                 self._pending.pop(0)
                 head[0].stream._finish()
                 continue
-            if not eng.can_admit(len(head[0].prompt),
-                                 head[0].n_tokens):
+            # continuation length = prompt + emitted; only the LENGTH
+            # matters for the capacity check — don't materialize it
+            if not eng.can_admit(len(head[0].prompt) + head[0].emitted,
+                                 head[0].n_left):
                 break    # FIFO: never leapfrog the head request
-            # admission WAVE: the longest FIFO prefix sharing the
-            # head's prompt length goes through ONE batched prefill
-            # + ONE fused pages/first-token dispatch (engine stops
-            # the wave itself at a length change or capacity)
-            P = len(head[0].prompt)
+            # admission WAVE: the FIFO prefix — prompt lengths may be
+            # HETEROGENEOUS (the engine bucket-pads them into one
+            # prefill dispatch) — goes through ONE batched prefill +
+            # ONE fused pages/first-token dispatch (the engine stops
+            # the wave itself at slot/block capacity)
             wave = []
             for item in self._pending:
-                if (len(item[0].prompt) != P
-                        or item[0].stream.cancelled):
+                if item[0].stream.cancelled:
                     break
                 wave.append(item)
+                if len(wave) >= eng.free_slots:
+                    break   # admission can never exceed free slots —
+                    # don't build request dicts for a deep backlog
             admitted = eng.admit_many([
-                dict(prompt_ids=it[0].prompt,
-                     n_tokens=it[0].n_tokens, request_id=id(it[0]),
+                dict(prompt_ids=it[0].effective_prompt(),
+                     n_tokens=it[0].n_left, request_id=id(it[0]),
                      temperature=it[0].temperature,
-                     top_p=it[0].top_p, rng=it[0].rng)
+                     top_p=it[0].top_p, rng=it[0].rng,
+                     emit_start=it[0].emitted)
                 for it in wave])
             if not admitted:
                 break
@@ -435,11 +526,15 @@ class GenerationServer(ParallelInference):
             for (slot, first, done), (req, fut, t_submit) in zip(
                     admitted, wave):
                 self._pending.pop(0)
+                fresh = req.stream.t_first is None
                 req.stream._emit(first, now)
                 if m is not None:
-                    m["requests"].inc()
                     m["tokens"].inc()
-                    m["ttft"].observe(now - t_submit)
+                    if fresh:
+                        # a requeued continuation was already counted
+                        # (and its TTFT observed) at first admission
+                        m["requests"].inc()
+                        m["ttft"].observe(now - t_submit)
                 if done:
                     self._finish(req, m)
                 else:
@@ -452,6 +547,20 @@ class GenerationServer(ParallelInference):
             emitted, finished = eng.step()
             dt = time.perf_counter() - t0
             now = time.monotonic()
+            # pool-pressure preemptions (incremental allocation):
+            # requeue each evicted request as a continuation at the
+            # HEAD of the admission queue — it predates everything
+            # queued, and its emitted tokens stand (the engine
+            # re-admits prompt+emitted at the same rng emit offset)
+            preempted = eng.drain_preempted()
+            if preempted:
+                requeued = []
+                for note in preempted:
+                    entry = self._slot2req.pop(note["slot"], None)
+                    if entry is not None:
+                        requeued.append(entry)
+                self._pending[:0] = requeued
+                progressed = True
             n_tok = sum(len(ts) for ts in emitted.values())
             if m is not None and n_tok:
                 m["step"].observe(dt)
@@ -472,6 +581,16 @@ class GenerationServer(ParallelInference):
             m["queue"].set(len(self._pending) + self._queue.qsize())
             m["slots"].set(eng.active_slots)
             m["blocks"].set(eng.free_blocks)
+            m["pool_free"].set(eng.pool.free_blocks)
+            m["pool_used"].set(eng.pool.used_blocks)
+            if eng.block_grants_total > self._grants_seen:
+                m["grants"].inc(eng.block_grants_total
+                                - self._grants_seen)
+                self._grants_seen = eng.block_grants_total
+            if eng.evict_requeue_total > self._requeue_seen:
+                m["requeue"].inc(eng.evict_requeue_total
+                                 - self._requeue_seen)
+                self._requeue_seen = eng.evict_requeue_total
         return progressed
 
     def _finish(self, req, m):

@@ -406,10 +406,22 @@ class UIServer:
                 return "–"
             return f"{1e3 * e['sum'] / n:.1f} ms avg over {n}"
 
+        # pool occupancy: used/free from the allocator-view gauges
+        # (incremental block grants — docs/SERVING.md)
+        used, free = (val("serving_pool_blocks_used"),
+                      val("serving_pool_blocks_free"))
+        if isinstance(used, int) and isinstance(free, int) and used + free:
+            occupancy = (f"{used} used / {free} free "
+                         f"({100.0 * used / (used + free):.0f}%)")
+        else:
+            occupancy = "–"
         rows = [
             ("queue depth", val("serving_queue_depth")),
             ("active slots", val("serving_active_slots")),
             ("free pool blocks", val("serving_free_blocks")),
+            ("pool occupancy", occupancy),
+            ("blocks granted", val("serving_block_grants_total", 0)),
+            ("preempt-requeues", val("serving_evict_requeue_total", 0)),
             ("requests admitted", val("serving_requests_total", 0)),
             ("tokens emitted", val("serving_tokens_total", 0)),
             ("requests shed (SLO)", val("serving_shed_total", 0)),

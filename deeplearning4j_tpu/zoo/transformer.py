@@ -159,7 +159,9 @@ def get_prefill(net: MultiLayerNetwork):
     and the serving tier's admission path (serving/engine.py): one XLA
     program per (batch, prompt-length) shape that runs the full forward
     with KV-cache carries and returns ([B, V] next-token probs, the
-    filled carries)."""
+    filled carries). Int8-quantized params trees (nd/quant.py) key
+    their own trace of the same jit — the program then reads int8
+    weights from HBM."""
     import jax
 
     jit_cache = net.__dict__.setdefault("_transformer_gen_jit", {})
@@ -173,9 +175,38 @@ def get_prefill(net: MultiLayerNetwork):
     return jit_cache["prefill"]
 
 
+def get_prefill_bucketed(net: MultiLayerNetwork):
+    """Mixed-length prefill (the serving tier's bucketed admission
+    waves, serving/engine.py): `x` [B, Pb] holds prompts RIGHT-padded
+    to a shared bucket length and `last_idx` [B] each row's final real
+    position (`P_b - 1`). Returns that row's next-token probs plus the
+    filled carries.
+
+    Right padding is sound because the blocks are causal: position
+    `P_b - 1`'s activations never see the padding tokens behind it,
+    and the padding rows' K/V land at cache positions `>= P_b` which
+    every later read masks by the slot's own position (the same
+    0-weight-x-garbage invariant the paged pool rests on). The probs
+    gather is the only difference from `get_prefill` — the forward is
+    the same program family."""
+    import jax
+    import jax.numpy as jnp
+
+    jit_cache = net.__dict__.setdefault("_transformer_gen_jit", {})
+    if "prefill_bucketed" not in jit_cache:
+        @jax.jit
+        def prefill_bucketed(params, state, x, carries, last_idx):
+            h, _, new_carries, _, _ = net._forward_core(
+                params, state, x, train=False, rng=None, carries=carries)
+            probs = h[jnp.arange(h.shape[0]), last_idx]   # [B, V]
+            return probs, new_carries
+        jit_cache["prefill_bucketed"] = prefill_bucketed
+    return jit_cache["prefill_bucketed"]
+
+
 def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
              temperature: float = 1.0, top_k: int = None,
-             top_p: float = None, rng=None):
+             top_p: float = None, rng=None, quantize: str = None):
     """Autoregressive decoding with per-layer KV caches — the
     transformer counterpart of the reference's `rnnTimeStep` sampling
     loop (`MultiLayerNetwork.rnnTimeStep` :2605; the char-LM examples
@@ -188,10 +219,20 @@ def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
     sampled ids. `temperature=0` → greedy argmax; `top_k` keeps only
     the k most probable tokens; `top_p` nucleus sampling keeps the
     smallest set of tokens whose cumulative probability reaches p
-    (both filters run on-device inside the fused scan)."""
+    (both filters run on-device inside the fused scan).
+
+    `quantize="int8"` serves the decode from per-output-channel int8
+    matmul weights (nd/quant.py) — the prefill AND the fused decode
+    scan read int8 from HBM and compute in the policy's compute dtype.
+    The quantized tree is cached on the net; `net.params` (the
+    training master) is untouched. Greedy decode agrees top-1 with the
+    fp path over full generations (the serving parity contract,
+    docs/SERVING.md)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    from deeplearning4j_tpu.nd import quant
 
     from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
 
@@ -262,9 +303,10 @@ def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
         jit_cache[key] = decode
     decode = jit_cache[key]
 
-    probs, carries = prefill(net.params, net.net_state, prompt, carries)
+    params = quant.serving_params(net, quantize)
+    probs, carries = prefill(params, net.net_state, prompt, carries)
     rng = jax.random.PRNGKey(0) if rng is None else rng
-    return np.asarray(decode(net.params, net.net_state, probs, carries,
+    return np.asarray(decode(params, net.net_state, probs, carries,
                              rng, 1.0 if top_p is None else top_p))
 
 

@@ -451,6 +451,60 @@ class TestFusedAdamKernel:
         monkeypatch.setenv("DL4J_PALLAS_KERNELS", "0")
         assert not fused_adam_eligible(Adam(0.01))
 
+    def test_flat_state_round_trip(self):
+        # pre-flattened m/v ([rows, 128] lane-aligned, kept between
+        # steps) must be an EXACT relayout of the per-leaf dicts
+        from deeplearning4j_tpu.kernels.fused_adam import (
+            FLAT_KEY,
+            flatten_opt_state,
+            is_flat_state,
+            unflatten_opt_state,
+        )
+        params, _, state = self._run()
+        flat = flatten_opt_state(params, state)
+        assert is_flat_state(flat) and not is_flat_state(state)
+        assert flat[FLAT_KEY]["m"].shape[1] == 128
+        # idempotent both ways
+        assert flatten_opt_state(params, flat) is flat
+        assert unflatten_opt_state(params, state) is state
+        back = unflatten_opt_state(params, flat)
+        for pk in state:
+            for s in ("m", "v"):
+                assert np.array_equal(np.asarray(back[pk][s]),
+                                      np.asarray(state[pk][s]))
+
+    def test_flat_state_multi_step_bit_parity(self):
+        # three consecutive updates carrying the FLAT form (what rides
+        # a fused program's scan carry) vs three per-leaf-state updates
+        # — params and (unflattened) m/v bit-identical, and the flat
+        # path's output stays flat (no per-step relayout)
+        from deeplearning4j_tpu.common.updaters import Adam
+        from deeplearning4j_tpu.kernels.fused_adam import (
+            adam_update_packed,
+            flatten_opt_state,
+            is_flat_state,
+            unflatten_opt_state,
+        )
+        upd = Adam(0.01)
+        params, grads, state = self._run(seed=11)
+
+        @jax.jit
+        def steps(p, s):
+            for t in range(3):
+                p, s = adam_update_packed(upd, p, grads, s, t,
+                                          interpret=True)
+            return p, s
+
+        fp, fs = steps(params, flatten_opt_state(params, state))
+        rp, rs = steps(params, state)
+        assert is_flat_state(fs) and not is_flat_state(rs)
+        fs = unflatten_opt_state(fp, fs)
+        for pk in params:
+            assert np.array_equal(np.asarray(fp[pk]), np.asarray(rp[pk]))
+            for s in ("m", "v"):
+                assert np.array_equal(np.asarray(fs[pk][s]),
+                                      np.asarray(rs[pk][s]))
+
     def test_container_on_off_bit_identical(self, monkeypatch):
         # whole train loop: fused-Adam kernel vs jnp path over a packed
         # deep-MLP run — params AND updater state bit-identical

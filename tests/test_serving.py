@@ -216,12 +216,22 @@ class TestEngineGreedyParity:
         np.testing.assert_array_equal(got, ref_tokens[:4])
 
     def test_pool_exhaustion_admits_prefix_only(self, net, prompts):
+        # upfront (the PR-9 policy): each request reserves its FULL
+        # 9-token budget = 3 blocks -> 6 usable blocks admit only 2
         eng = PagedDecodeEngine(net, n_slots=4, n_blocks=7,
-                                block_len=BL)   # 6 usable = 2 seqs
+                                block_len=BL, allocation="upfront")
         admitted = eng.admit_many([
             dict(prompt_ids=prompts[r], n_tokens=6) for r in range(4)])
         assert len(admitted) == 2
         assert eng.free_blocks == 0
+        # incremental (default): admission grants only the PROMPT
+        # footprint (3 tokens = 1 block) — the same pool admits all 4
+        eng = PagedDecodeEngine(net, n_slots=4, n_blocks=7,
+                                block_len=BL)
+        admitted = eng.admit_many([
+            dict(prompt_ids=prompts[r], n_tokens=6) for r in range(4)])
+        assert len(admitted) == 4
+        assert eng.free_blocks == 2
 
     def test_budget_rejected_eagerly(self, net):
         eng = PagedDecodeEngine(net, n_slots=2, n_blocks=16,
@@ -230,6 +240,369 @@ class TestEngineGreedyParity:
             eng.check_budget(10, 10)    # 20 > 16
         with pytest.raises(ValueError, match="must divide"):
             PagedDecodeEngine(net, n_slots=2, n_blocks=16, block_len=5)
+
+
+class TestIncrementalAllocation:
+    """allocation="incremental" (the default): admission grants only
+    the PROMPT footprint, `step()` grows block tables lazily as writes
+    cross block boundaries, and pool pressure preempts-and-requeues the
+    lowest-progress slot instead of deadlocking (ISSUE 10 tentpole b)."""
+
+    def test_lazy_growth_across_block_boundaries(self, net, prompts):
+        """One slot, 13 generated tokens (3 + 13 = 16 = 4 blocks):
+        the table must track the write frontier exactly — after every
+        step, owned blocks == blocks_needed(pos) — and the lazily-grown
+        stream must stay bit-equal to whole-batch generate()."""
+        ref = generate(net, prompts[:1], 13, temperature=0)[0]
+        eng = PagedDecodeEngine(net, n_slots=1, n_blocks=8, block_len=BL)
+        (slot, first, done), = eng.admit_many(
+            [dict(prompt_ids=prompts[0], n_tokens=13)])
+        assert not done
+        assert len(eng.slots[slot].blocks) == 1      # prompt (3) only
+        out, guard = [first], 0
+        while eng.active.any():
+            emitted, _ = eng.step()
+            out.extend(emitted.get(slot, []))
+            if eng.slots[slot] is not None:
+                assert len(eng.slots[slot].blocks) == blocks_needed(
+                    int(eng.pos[slot]), BL)
+            guard += 1
+            assert guard < 40
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        assert eng.block_grants_total == 4           # 1 admit + 3 lazy
+        assert eng.evict_requeue_total == 0          # no pressure here
+
+    def test_concurrency_2x_vs_upfront_same_pool(self, net, prompts):
+        """The acceptance bar: at the SAME pool size, incremental
+        allocation admits >= 2x the up-front-grant baseline's
+        concurrent short-generation streams (each stream's budget is 4
+        blocks but its prompt occupies 1)."""
+
+        def burst(allocation):
+            eng = PagedDecodeEngine(net, n_slots=4, n_blocks=9,
+                                    block_len=BL, allocation=allocation)
+            return len(eng.admit_many(
+                [dict(prompt_ids=prompts[r], n_tokens=13)
+                 for r in range(4)]))
+
+        upfront, incremental = burst("upfront"), burst("incremental")
+        assert upfront == 2                  # 8 usable // 4-block grants
+        assert incremental == 4              # prompt footprint only
+        assert incremental >= 2 * upfront
+
+    def test_pool_pressure_preempts_lowest_progress(self, net, prompts):
+        """Growth under a full pool must evict the slot whose request
+        emitted the FEWEST tokens (requeue costs it the least re-prefill
+        work), hand it to drain_preempted(), and let the survivor
+        finish exactly."""
+        ref_a = generate(net, prompts[:1], 13, temperature=0)[0]
+        ref_b = generate(net, prompts[1:2], 6, temperature=0)[0]
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=5,
+                                block_len=BL)   # 4 usable
+        (sa, fa, _), = eng.admit_many(
+            [dict(prompt_ids=prompts[0], n_tokens=13, request_id="A")])
+        out_a = [fa]
+        for _ in range(3):                   # A builds a progress lead
+            emitted, _ = eng.step()
+            out_a.extend(emitted.get(sa, []))
+        (sb, fb, _), = eng.admit_many(
+            [dict(prompt_ids=prompts[1], n_tokens=6, request_id="B")])
+        out_b = [fb]
+        guard = 0
+        while eng.active.any():
+            emitted, _ = eng.step()
+            out_a.extend(emitted.get(sa, []))
+            out_b.extend(emitted.get(sb, []))
+            guard += 1
+            assert guard < 40
+        # A (emitted 5+) and B (emitted 2) both needed growth with the
+        # pool exhausted: the LOWEST-progress slot must be the victim
+        notes = eng.drain_preempted()
+        assert [n["request_id"] for n in notes] == ["B"], \
+            "pool pressure must evict the lowest-progress slot"
+        assert notes[0]["emitted"] == len(out_b)
+        assert 1 <= len(out_b) < 6            # preempted mid-stream
+        np.testing.assert_array_equal(np.asarray(out_a), ref_a)
+        # requeue B as a continuation: original prompt + every emitted
+        # token, generating the remainder — the stream must complete
+        # exactly as if never interrupted
+        cont = np.concatenate([prompts[1], np.asarray(out_b)])
+        (sb2, f2, _), = eng.admit_many(
+            [dict(prompt_ids=cont, n_tokens=6 - len(out_b),
+                  request_id="B", emit_start=len(out_b))])
+        out_b.append(f2)
+        drain_engine(eng, {sb2: 0}, {0: out_b})
+        np.testing.assert_array_equal(np.asarray(out_b), ref_b)
+        assert eng.evict_requeue_total == 1
+
+    def test_fragmented_free_list_churn(self):
+        """Evict/readmit reuse across a FRAGMENTED free list: grants
+        interleave with frees, all-or-nothing holds at every point, and
+        the double-free guard survives the churn."""
+        a = BlockAllocator(10)               # 9 usable
+        s1, s2, s3 = a.allocate(3), a.allocate(3), a.allocate(3)
+        a.free(s1)
+        a.free(s3)                           # free list now fragmented
+        assert a.free_blocks == 6
+        got = a.allocate(5)                  # spans both fragments
+        assert got is not None and len(set(got)) == 5
+        assert set(got) <= set(s1) | set(s3)
+        assert a.allocate(2) is None         # 1 left: all-or-nothing
+        a.free(got[:1])
+        with pytest.raises(ValueError, match="double-free"):
+            a.free(got[:1])                  # churn must not erode it
+        a.free(got[1:])
+        a.free(s2)
+        assert a.free_blocks == 9            # full pool recovered
+
+    def test_server_requeue_completes_with_parity(self, net, prompts,
+                                                  ref_tokens):
+        """End-to-end: a pool too small for every stream's full length
+        forces preempt-and-requeue mid-serving; every stream must still
+        complete bit-equal to whole-batch generate() (continuation
+        prefill reproduces the decode-path numerics)."""
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        reg = monitor.enable(registry=MetricsRegistry())
+        srv = GenerationServer(net, n_slots=4, n_blocks=5,
+                               block_len=BL).start()   # 4 usable blocks
+        try:
+            streams = [srv.generate_async(prompts[r], 6)
+                       for r in range(4)]
+            got = np.stack([s.result(timeout=120) for s in streams])
+        finally:
+            srv.stop()
+            monitor.disable()
+        np.testing.assert_array_equal(got, ref_tokens[:4])
+        assert srv.engine.evict_requeue_total >= 1, \
+            "pool pressure never fired — the test pool is too large"
+        assert (reg.counter("serving_evict_requeue_total").value
+                == srv.engine.evict_requeue_total)
+        assert (reg.counter("serving_block_grants_total").value
+                == srv.engine.block_grants_total)
+        expo = reg.exposition()
+        assert "serving_pool_blocks_free" in expo
+        assert "serving_pool_blocks_used" in expo
+
+
+class TestQuantizedDecode:
+    """Int8 weight-only quantization (nd/quant.py): the parity contract
+    is greedy top-1 agreement over FULL generations on the zoo LM plus
+    bounded logit error, and the engine must serve quantized weights
+    bit-equal to `generate(quantize="int8")` (ISSUE 10 tentpole a)."""
+
+    def test_quantize_roundtrip_and_seam_units(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nd import quant
+        rng = np.random.default_rng(2)
+        w = jnp.asarray(rng.standard_normal((24, 16)), jnp.float32)
+        qt = quant.quantize(w)
+        assert qt.q.dtype == jnp.int8 and qt.shape == w.shape
+        # symmetric per-output-channel: |error| <= scale/2 everywhere
+        deq = quant.dequantize(qt)
+        assert np.all(np.abs(np.asarray(deq - w))
+                      <= np.asarray(qt.scale) / 2 + 1e-7)
+        # the matmul seam scales AFTER the contraction — numerically
+        # the same product (per-channel scale commutes with the sum)
+        x = jnp.asarray(rng.standard_normal((5, 24)), jnp.float32)
+        np.testing.assert_allclose(np.asarray(quant.matmul(x, qt)),
+                                   np.asarray(x @ deq), rtol=1e-5,
+                                   atol=1e-6)
+        # an all-zero output channel must quantize exactly, not NaN
+        wz = w.at[:, 3].set(0.0)
+        qz = quant.quantize(wz)
+        assert np.all(np.asarray(qz.q)[:, 3] == 0)
+        assert np.isfinite(np.asarray(qz.scale)).all()
+        with pytest.raises(ValueError, match="ndim"):
+            quant.quantize(jnp.zeros(7))
+        with pytest.raises(ValueError, match="unknown quantization"):
+            quant.quantize_net_params(tiny_lm(), "int4")
+
+    def test_quantized_params_tree_and_bytes(self, net):
+        from deeplearning4j_tpu.nd import quant
+        qp = quant.serving_params(net, "int8")
+        plan = quant.quantized_weight_keys(net)
+        assert plan, "zoo LM declared no quantizable weights"
+        for lk, pks in plan.items():
+            for pk in pks:
+                assert isinstance(qp[lk][pk], quant.QuantizedTensor)
+                # the training master is untouched
+                assert not isinstance(net.params[lk][pk],
+                                      quant.QuantizedTensor)
+        # one quantization pass per net per mode (admission + decode +
+        # prefill all share the tree)
+        assert quant.serving_params(net, "int8") is qp
+        assert quant.serving_params(net, None) is net.params
+        mm_fp = quant.weight_bytes(
+            {lk: {pk: net.params[lk][pk] for pk in pks}
+             for lk, pks in plan.items()})
+        mm_q = quant.weight_bytes(
+            {lk: {pk: qp[lk][pk] for pk in pks}
+             for lk, pks in plan.items()})
+        # int8 + per-channel fp32 scale vs fp32: ~3.9x on the matmul
+        # weights themselves (the tiny d16 test net bounds it lower)
+        assert mm_fp / mm_q > 3.0, (mm_fp, mm_q)
+        assert (quant.weight_bytes(net.params)
+                / quant.weight_bytes(qp)) > 2.5
+
+    def test_quantized_cache_invalidates_on_fit(self):
+        """serving_params caches per net — but fit() reassigns
+        net.params, and the cache MUST follow: a fine-tuned net must
+        never silently serve pre-training int8 weights while its fp
+        path serves the fresh ones."""
+        from deeplearning4j_tpu.nd import quant
+        net = tiny_lm(seed=5)
+        qp1 = quant.serving_params(net, "int8")
+        assert quant.serving_params(net, "int8") is qp1   # cached
+        rng = np.random.default_rng(0)
+        X = rng.integers(0, V, (8, 4)).astype(np.float32)
+        Y = np.eye(V, dtype=np.float32)[rng.integers(0, V, (8, 4))]
+        net.fit(X, Y, epochs=1, batch_size=8)
+        qp2 = quant.serving_params(net, "int8")
+        assert qp2 is not qp1, "stale quantized cache survived fit()"
+        w_new = np.asarray(quant.dequantize(qp2["0"]["W"]))
+        w_old = np.asarray(quant.dequantize(qp1["0"]["W"]))
+        assert not np.array_equal(w_new, w_old), \
+            "refreshed quantized tree does not reflect the new weights"
+
+    def test_engine_serves_live_params_after_fit(self):
+        """The engine resolves its params tree PER DISPATCH: a fit()
+        (or checkpoint restore) between engine construction and decode
+        must serve the fresh weights, not a construction-time
+        snapshot — in fp mode (identity with net.params) and int8 mode
+        (re-quantized via the identity-keyed cache)."""
+        from deeplearning4j_tpu.nd import quant
+        net = tiny_lm(seed=8)
+        eng = PagedDecodeEngine(net, n_slots=1, n_blocks=8,
+                                block_len=BL)
+        qeng = PagedDecodeEngine(net, n_slots=1, n_blocks=8,
+                                 block_len=BL, quantize="int8")
+        assert eng._params is net.params
+        qp_before = qeng._params
+        rng = np.random.default_rng(1)
+        X = rng.integers(0, V, (8, 4)).astype(np.float32)
+        Y = np.eye(V, dtype=np.float32)[rng.integers(0, V, (8, 4))]
+        net.fit(X, Y, epochs=1, batch_size=8)
+        assert eng._params is net.params, \
+            "engine kept a stale fp params snapshot across fit()"
+        assert qeng._params is not qp_before, \
+            "engine kept stale int8 weights across fit()"
+        assert quant.serving_params(net, "int8") is qeng._params
+
+    def test_greedy_top1_agreement_trained_lm(self):
+        """The parity contract on a TRAINED zoo LM (random-init logits
+        are near-ties — argmax there measures noise, not the
+        quantization): full-generation top-1 agreement, plus the
+        bounded-probability-error clause."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nd import quant
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            BaseRecurrentLayer)
+        from deeplearning4j_tpu.zoo.transformer import get_prefill
+        net = TransformerLM(vocab_size=V, d_model=32, n_layers=2,
+                            n_heads=4, max_len=24, seed=11).init()
+        corpus = (np.arange(512) * 3) % V    # learnable cyclic stream
+        X = np.stack([corpus[i:i + 8] for i in range(0, 500, 2)])
+        Y = np.stack([corpus[i + 1:i + 9] for i in range(0, 500, 2)])
+        net.fit(X.astype(np.float32), np.eye(V, dtype=np.float32)[Y],
+                epochs=20, batch_size=50, shuffle=False)
+        pr = np.stack([corpus[i:i + 4]
+                       for i in (0, 7, 20, 33, 46, 59, 72, 85)])
+        fp = generate(net, pr, 16, temperature=0)
+        q8 = generate(net, pr, 16, temperature=0, quantize="int8")
+        agree = float((fp == q8).mean())
+        assert agree == 1.0, \
+            f"greedy top-1 agreement {agree:.3f} < 1.0 over full " \
+            f"generations:\nfp={fp}\nint8={q8}"
+        # bounded logit error: next-token distributions of the same
+        # prefill program under fp vs int8 weights (measured ~4e-4 on
+        # this config; the bound leaves a 10x margin)
+        prefill = get_prefill(net)
+
+        def carries():
+            return {str(i): l.init_carry(len(pr),
+                                         net.dtype.compute_dtype)
+                    for i, l in enumerate(net.layers)
+                    if isinstance(l, BaseRecurrentLayer)}
+
+        p_fp, _ = prefill(net.params, net.net_state, jnp.asarray(pr),
+                          carries())
+        p_q8, _ = prefill(quant.serving_params(net, "int8"),
+                          net.net_state, jnp.asarray(pr), carries())
+        err = float(jnp.abs(p_fp - p_q8).max())
+        assert err < 5e-3, f"probability error {err} out of bound"
+
+    def test_quantized_engine_bit_equal_noise_pools(self, net, prompts):
+        """The engine's quantized decode must be BIT-equal to
+        `generate(quantize='int8')` — through noise-filled pools and a
+        non-contiguous, fragmented block table (garbage pages must
+        contribute exactly 0.0)."""
+        import jax.numpy as jnp
+        qref = generate(net, prompts[:3], 6, temperature=0,
+                        quantize="int8")
+        eng = PagedDecodeEngine(net, n_slots=3, n_blocks=16,
+                                block_len=BL, quantize="int8")
+        key = np.random.default_rng(9)
+        eng.pool.kv = tuple(
+            (k + jnp.asarray(key.standard_normal(k.shape), k.dtype),
+             v + jnp.asarray(key.standard_normal(v.shape), v.dtype))
+            for k, v in eng.pool.kv)
+        # fragment the free list so the real requests' tables are
+        # non-contiguous
+        decoys = eng.admit_many(
+            [dict(prompt_ids=prompts[3], n_tokens=6),
+             dict(prompt_ids=prompts[4], n_tokens=6)])
+        for slot, _, _ in decoys:
+            eng.evict(slot)
+        admitted = eng.admit_many(
+            [dict(prompt_ids=prompts[r], n_tokens=6) for r in range(3)])
+        assert len(admitted) == 3
+        out = {r: [admitted[r][1]] for r in range(3)}
+        drain_engine(eng, {admitted[r][0]: r for r in range(3)}, out)
+        got = np.asarray([out[r] for r in range(3)])
+        np.testing.assert_array_equal(got, qref)
+
+    def test_mixed_length_wave_admits_heterogeneous_prompts(self, net):
+        """ONE admission wave with three DIFFERENT prompt lengths
+        (bucket-padded into a single prefill dispatch) must admit all
+        of them with streams equal to their whole-batch generate()
+        rows — the same-length-wave restriction is gone (tentpole c)."""
+        rng = np.random.default_rng(4)
+        mixed = [rng.integers(0, V, n) for n in (2, 3, 5)]
+        refs = [generate(net, p[None], 6, temperature=0)[0]
+                for p in mixed]
+        eng = PagedDecodeEngine(net, n_slots=4, n_blocks=16,
+                                block_len=BL)
+        admitted = eng.admit_many(
+            [dict(prompt_ids=p, n_tokens=6) for p in mixed])
+        assert len(admitted) == 3
+        out = {r: [admitted[r][1]] for r in range(3)}
+        drain_engine(eng, {admitted[r][0]: r for r in range(3)}, out)
+        for r, ref in enumerate(refs):
+            np.testing.assert_array_equal(np.asarray(out[r]), ref,
+                                          err_msg=f"prompt len "
+                                          f"{mixed[r].shape[0]}")
+
+    def test_server_quantized_mixed_length_parity(self, net):
+        """Server-level: quantize='int8' + heterogeneous prompt lengths
+        submitted concurrently — every stream bit-equal to
+        generate(quantize='int8') of its own prompt."""
+        rng = np.random.default_rng(6)
+        mixed = [rng.integers(0, V, (3, 2, 5, 3, 2, 5)[r])
+                 for r in range(6)]
+        refs = [generate(net, p[None], 6, temperature=0,
+                         quantize="int8")[0] for p in mixed]
+        srv = GenerationServer(net, n_slots=4, n_blocks=16,
+                               block_len=BL, quantize="int8").start()
+        try:
+            streams = [srv.generate_async(p, 6) for p in mixed]
+            got = [s.result(timeout=120) for s in streams]
+        finally:
+            srv.stop()
+        for r, ref in enumerate(refs):
+            np.testing.assert_array_equal(got[r], ref)
 
 
 class TestSampledDeterminism:
@@ -429,15 +802,37 @@ class TestGenerationServer:
                                           ref_tokens):
         srv = GenerationServer(net, n_slots=2, n_blocks=16,
                                block_len=BL)
-        srv.warmup(prompts.shape[1], 6).start()
+        srv.warmup(prompts.shape[1], 6)
+        # the warmup grid's synthetic grants/preemptions must not leak
+        # into the serving-traffic counters (registry deltas + ledger)
+        assert srv.engine.block_grants_total == 0
+        assert srv.engine.evict_requeue_total == 0
+        srv.start()
         try:
             got = srv.generate_async(prompts[0], 6).result(timeout=120)
         finally:
             srv.stop()
         np.testing.assert_array_equal(got, ref_tokens[0])
+        assert srv.engine.block_grants_total > 0
         with pytest.raises(RuntimeError, match="before start"):
             GenerationServer(net, n_slots=2, n_blocks=16,
                              block_len=BL).start().warmup(3)
+
+    def test_warmup_covers_budget_clamped_top_bucket(self, net):
+        """A prompt that buckets to the FULL stream budget leaves no
+        token headroom at that bucket — warmup must still compile the
+        (width, budget-bucket) prefill programs (with a one-shorter
+        prompt that pads to the same bucket), or the first budget-edge
+        request stalls live streams on a trace."""
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL)
+        srv.warmup(MAXLEN - 2, 2).start()   # top bucket == MAXLEN
+        try:
+            got = srv.generate_async(
+                np.zeros(MAXLEN - 2, np.int32), 2).result(timeout=120)
+            assert len(got) == 2
+        finally:
+            srv.stop()
 
 
 class TestServingBenchGate:
@@ -460,6 +855,41 @@ class TestServingBenchGate:
         assert verdict["status"] == "regression"
         assert any(r["metric"] == "serving_speedup_vs_sequential"
                    for r in verdict["regressions"])
+
+    def test_compare_bench_gates_quantized_serving(self):
+        from deeplearning4j_tpu.bench import compare_bench
+
+        def rec(tps, reduction, ttft):
+            return {"platform": "cpu-sandbox", "value": 100.0,
+                    "extras": {"serving_mixed_quantized": {
+                        "tokens_per_sec": tps,
+                        "weight_bytes_reduction": reduction,
+                        "p50_ttft_ms": ttft}}}
+
+        base = rec(8000.0, 3.6, 40.0)
+        assert compare_bench(rec(7800.0, 3.62, 42.0),
+                             base)["status"] == "pass"
+        # quantized throughput collapse gates
+        v = compare_bench(rec(3000.0, 3.6, 40.0), base)
+        assert v["status"] == "regression"
+        assert any(r["metric"] == "serving_quantized_tokens_per_sec"
+                   for r in v["regressions"])
+        # STALE-FALLBACK detection: a run that silently served fp
+        # weights reports ~1.0x against the int8 baseline's ~3.6x —
+        # the structural 2% band catches it even if throughput held
+        v = compare_bench(rec(8000.0, 1.0, 40.0), base)
+        assert v["status"] == "regression"
+        assert any(
+            r["metric"] == "serving_quantized_weight_bytes_reduction"
+            for r in v["regressions"])
+        # TTFT is lower-is-better: a RISE past tolerance gates...
+        v = compare_bench(rec(8000.0, 3.6, 100.0), base)
+        assert v["status"] == "regression"
+        assert any(r["metric"] == "serving_mixed_p50_ttft_ms"
+                   for r in v["regressions"])
+        # ...while a big DROP (improvement) passes
+        assert compare_bench(rec(8000.0, 3.6, 10.0),
+                             base)["status"] == "pass"
 
 
 class TestServingUI:
@@ -485,6 +915,8 @@ class TestServingUI:
                                           timeout=10).read().decode()
             assert "requests admitted" in html
             assert "free pool blocks" in html
+            assert "pool occupancy" in html
+            assert "blocks granted" in html
             mtext = urllib.request.urlopen(base + "/metrics",
                                            timeout=10).read().decode()
             assert "serving_ttft_seconds" in mtext
