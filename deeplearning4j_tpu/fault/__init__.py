@@ -42,6 +42,8 @@ from deeplearning4j_tpu.fault.drill import (
 )
 from deeplearning4j_tpu.fault.errors import (
     CheckpointCorruptError,
+    ElasticMembershipError,
+    ElasticReconfiguration,
     SimulatedPreemption,
 )
 from deeplearning4j_tpu.fault.listener import CheckpointListener
@@ -55,6 +57,7 @@ from deeplearning4j_tpu.fault.state import (
 
 __all__ = [
     "AsyncCheckpointer", "CheckpointListener", "CheckpointCorruptError",
+    "ElasticMembershipError", "ElasticReconfiguration",
     "SimulatedPreemption", "PreemptionListener",
     "capture_training_state", "restore_training_state",
     "restore_normalizer", "reshard_replica_stack",

@@ -284,25 +284,48 @@ class AsyncCheckpointer:
 
     def _merge_and_commit(self, step: int, tmp: Path, final: Path,
                           nprocs: int):
+        # the barrier is a RANK SET, not a file count: an elastic
+        # re-checkpoint of the same step at a smaller process count can
+        # find stale higher-rank manifests from an aborted wider-world
+        # attempt in the same tmp dir — those must neither satisfy nor
+        # pollute the commit
+        want = set(range(nprocs))
         deadline = time.time() + self.merge_timeout_s
-        manifests = []
         while True:
-            manifests = sorted(tmp.glob("manifest-*.json"))
-            if len(manifests) >= nprocs:
+            # parse inside the wait loop: a manifest observed mid-write
+            # (another rank's fsync not landed) or yanked away (a
+            # concurrent committer renamed tmp — elastic world handoff)
+            # counts as "not arrived yet", not corruption
+            have = {}
+            for mp in sorted(tmp.glob("manifest-*.json")):
+                try:
+                    rank = int(mp.name[len("manifest-"):-len(".json")])
+                except ValueError:
+                    continue
+                if rank not in want:
+                    continue
+                try:
+                    with open(mp) as f:
+                        have[rank] = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
+            if set(have) == want:
                 break
+            if not tmp.exists() and final.exists():
+                # a concurrent committer of the SAME step renamed our
+                # shared tmp into place; its checkpoint stands
+                return
             if time.time() > deadline:
                 raise CheckpointCorruptError(
-                    f"checkpoint step {step}: only {len(manifests)}/"
-                    f"{nprocs} process shards arrived within "
+                    f"checkpoint step {step}: only ranks "
+                    f"{sorted(have)} of {nprocs} arrived within "
                     f"{self.merge_timeout_s}s")
             time.sleep(0.05)
         merged: Dict[str, Any] = {
             "format_version": fstate.STATE_FORMAT_VERSION,
             "step": step, "process_count": nprocs,
             "shards": [], "checksums": {}, "meta": None}
-        for mp in manifests:
-            with open(mp) as f:
-                pm = json.load(f)
+        for _, pm in sorted(have.items()):
             if pm.get("shard"):
                 merged["shards"].append(pm["shard"])
                 merged["checksums"].update(pm["checksums"])
@@ -314,10 +337,33 @@ class AsyncCheckpointer:
             f.flush()
             os.fsync(f.fileno())
         _fsync_dir(tmp)
-        if final.exists():       # re-checkpoint of the same step: replace
-            shutil.rmtree(final)
-        os.rename(tmp, final)
+        self._replace_commit(step, tmp, final)
         _fsync_dir(self.directory)
+
+    def _replace_commit(self, step: int, tmp: Path, final: Path):
+        """Rename tmp into place, replacing an existing commit of the
+        same step — tolerant of a CONCURRENT committer (during an
+        elastic world handoff the draining world's rank 0 and the new
+        world's rank 0 can both re-checkpoint the same step; both hold
+        equivalent state, so whichever rename wins is a valid commit)."""
+        if final.exists():
+            trash = final.parent / f"{_TMP_PREFIX}trash-{os.getpid()}-" \
+                                   f"{final.name}"
+            try:
+                os.rename(final, trash)
+            except FileNotFoundError:
+                pass                # the other committer replaced it first
+            shutil.rmtree(trash, ignore_errors=True)
+        try:
+            os.rename(tmp, final)
+        except OSError as e:
+            if final.exists():
+                log.warning("checkpoint step %d: concurrent commit won "
+                            "the replace race (%s); dropping this "
+                            "attempt's tmp", step, e)
+                shutil.rmtree(tmp, ignore_errors=True)
+            else:
+                raise
 
     # ----------------------------------------------------------- retention
     def _retained(self, steps: List[int]) -> set:

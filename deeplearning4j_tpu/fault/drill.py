@@ -47,8 +47,9 @@ class PreemptionListener(TrainingListener):
 
     def __init__(self, kill_at_step: int, *, mode: str = "exception",
                  wait_for_checkpointer=None):
-        if mode not in ("exception", "sigterm"):
-            raise ValueError(f"mode must be exception|sigterm, got {mode}")
+        if mode not in ("exception", "sigterm", "sigkill"):
+            raise ValueError(
+                f"mode must be exception|sigterm|sigkill, got {mode}")
         self.kill_at_step = int(kill_at_step)
         self.mode = mode
         # optional: drain this AsyncCheckpointer before dying — drills
@@ -69,6 +70,11 @@ class PreemptionListener(TrainingListener):
                     self.mode)
         if self.mode == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
+        elif self.mode == "sigkill":
+            # the elastic shrink drill: an instant, ungraceful death the
+            # process cannot observe — no drain, no final checkpoint;
+            # survivors must detect it and re-form the mesh without us
+            os.kill(os.getpid(), signal.SIGKILL)
         raise SimulatedPreemption(iteration + 1)
 
 

@@ -12,6 +12,29 @@ class CheckpointCorruptError(RuntimeError):
     fallback."""
 
 
+class ElasticMembershipError(RuntimeError):
+    """The elastic membership control plane is unreachable or rejected
+    a request after bounded retries (parallel/elastic.py). Distinct
+    from training/runtime failures so callers can decide whether to
+    keep training on the last known topology or abort."""
+
+
+class ElasticReconfiguration(Exception):
+    """Control-flow signal of the elastic runtime: the membership
+    generation changed and every process agreed (via the in-band drain
+    sync) to leave the fit at the SAME step boundary, after a drain
+    checkpoint committed. Raised by the drain listener inside fit;
+    caught by `ElasticTrainer`, which tears the distributed runtime
+    down and re-forms the mesh for the new generation. Not an error."""
+
+    def __init__(self, generation: int, step: int = -1):
+        super().__init__(
+            f"elastic reconfiguration to generation {generation} "
+            f"(drained at step {step})")
+        self.generation = generation
+        self.step = step
+
+
 class SimulatedPreemption(BaseException):
     """Raised by the fault-injection drill at the scripted step.
 

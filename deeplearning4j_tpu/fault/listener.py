@@ -66,6 +66,14 @@ class CheckpointListener(TrainingListener):
         self.checkpointer.save(state, step)
         self._last_saved_step = step
 
+    def save_now(self, model, step: int, epoch: int):
+        """Out-of-cadence checkpoint at an externally-chosen STEP
+        BOUNDARY — the elastic runtime's drain checkpoint (every
+        process calls this at the same agreed step, so the
+        multi-process commit barrier lines up). The cadence clock
+        advances so the next periodic save counts from here."""
+        self._save(model, int(step), int(epoch))
+
     def iteration_done(self, model, iteration, epoch, score, **info):
         if not info.get("step_boundary", True):
             return

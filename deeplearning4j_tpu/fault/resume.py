@@ -37,7 +37,7 @@ def load_latest_valid(directory, *, max_step: Optional[int] = None
     if not steps:
         raise FileNotFoundError(
             f"no committed checkpoints under {directory}")
-    last_err: Optional[CheckpointCorruptError] = None
+    tried = []
     for step in reversed(steps):
         try:
             return load_checkpoint(directory, step), step
@@ -45,10 +45,14 @@ def load_latest_valid(directory, *, max_step: Optional[int] = None
             log.warning(
                 "checkpoint step %d under %s is corrupt (%s); falling "
                 "back to the previous checkpoint", step, directory, e)
-            last_err = e
+            tried.append((step, e))
+    # name EVERY candidate tried — an elastic resume that lands here has
+    # no recovery path left, and the operator needs the full damage
+    # report, not just the newest failure
+    detail = "; ".join(f"step {s}: {e}" for s, e in tried)
     raise CheckpointCorruptError(
         f"every committed checkpoint under {directory} failed "
-        f"verification; newest error: {last_err}")
+        f"verification ({len(tried)} candidates tried) — {detail}")
 
 
 def resume(directory, model=None, *, trainer=None, iterator=None,

@@ -76,7 +76,11 @@ def flatten_arrays(tree, prefix: str = "") -> Dict[str, np.ndarray]:
                     f"separator U+001F")
             out.update(flatten_arrays(v, f"{prefix}{k}{SEP}"))
         return out
-    if not getattr(tree, "is_fully_addressable", True):
+    if (not getattr(tree, "is_fully_addressable", True)
+            and not getattr(tree, "is_fully_replicated", False)):
+        # fully-REPLICATED multi-process arrays are fine: every process
+        # holds a complete local copy, np.asarray reads it without any
+        # cross-host traffic (the elastic multi-process capture path)
         raise ValueError(
             f"array at {prefix[:-1]!r} spans processes this host cannot "
             f"address (multi-host tensor-sharded state); the fault "

@@ -56,6 +56,15 @@ from deeplearning4j_tpu.parallel.stats import TrainingMasterStats
 from deeplearning4j_tpu.parallel.multihost import (
     initialize_multihost,
     is_main_process,
+    multihost_active,
     process_count,
     process_index,
+    shutdown_multihost,
+)
+from deeplearning4j_tpu.parallel.elastic import (
+    ElasticConfig,
+    ElasticCoordinator,
+    ElasticClient,
+    ElasticTrainer,
+    elastic_fit,
 )

@@ -191,9 +191,38 @@ def _parse_eval(out: str):
     return _parse_tag(out, "EVALJSON")
 
 
-def run_smoke(n: int = 2, timeout: int = 420) -> dict:
+class _PortBindRace(RuntimeError):
+    """The jax coordinator lost the race for its pre-probed port (a
+    parallel CI job re-grabbed it between `_free_port` and bind)."""
+
+
+_BIND_MARKERS = ("Address already in use", "address already in use",
+                 "Failed to bind")
+
+
+def run_smoke(n: int = 2, timeout: int = 420, *,
+              bind_attempts: int = 3) -> dict:
     """Orchestrate: n distributed workers + 1 single-process reference,
-    compare loss trajectories. Returns a report dict; raises on fail."""
+    compare loss trajectories. Returns a report dict; raises on fail.
+
+    The coordinator port is probed-then-bound, which is a race under
+    parallel CI — a bind failure retries the whole worker cycle on a
+    fresh port, `bind_attempts` times."""
+    last: Exception = RuntimeError("unreachable")
+    for attempt in range(max(1, int(bind_attempts))):
+        try:
+            return _run_smoke_once(n, timeout)
+        except _PortBindRace as e:
+            last = e
+            import logging
+            logging.getLogger(__name__).warning(
+                "coordinator port bind race (attempt %d/%d): %s — "
+                "retrying on a fresh port", attempt + 1, bind_attempts,
+                str(e)[-200:])
+    raise last
+
+
+def _run_smoke_once(n: int, timeout: int) -> dict:
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     procs = []
@@ -209,6 +238,8 @@ def run_smoke(n: int = 2, timeout: int = 420) -> dict:
         for w in workers:
             out, err = w.communicate(timeout=timeout)
             if w.returncode != 0:
+                if any(m in err for m in _BIND_MARKERS):
+                    raise _PortBindRace(err[-400:])
                 raise RuntimeError(
                     f"worker failed rc={w.returncode}: {err[-800:]}")
             results.append(_parse_losses(out))

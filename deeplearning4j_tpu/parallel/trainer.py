@@ -415,13 +415,29 @@ class ParallelTrainer:
         donate = _donate(0, 1, 2, 4) if has_thr else _donate(0, 1, 2)
         return jax.jit(run, donate_argnums=donate)
 
+    def _replicated_view(self, tree):
+        """Gather a per-replica (data-axis-sharded) device tree into
+        replicated form so every PROCESS can address the full stack —
+        the multi-process capture path for checkpoints of residual/τ
+        and per-replica updater stacks (a data-axis-sharded leaf is not
+        fully addressable from any one host, and `flatten_arrays`
+        rejects it). One all-gather per capture, at checkpoint cadence
+        only; a no-op reshard under a single process."""
+        if getattr(self, "_rep_view_fn", None) is None:
+            repl = NamedSharding(self.mesh, P())
+            self._rep_view_fn = jax.jit(lambda t: t, out_shardings=repl)
+        return self._rep_view_fn(tree)
+
     def threshold_residual(self):
         """Host view of the per-replica error-feedback residual
         (per-LAYER keys — the ``stacked::`` packing exists only inside
         the step program), or None before the first threshold step."""
         if self._thr_residual_r is None:
             return None
-        return jax.tree_util.tree_map(np.asarray, self._thr_residual_r)
+        tree = self._thr_residual_r
+        if jax.process_count() > 1:
+            tree = self._replicated_view(tree)
+        return jax.tree_util.tree_map(np.asarray, tree)
 
     # -------------------------------------------------------- averaging mode
     def _make_local_one_step(self):
@@ -753,11 +769,14 @@ class ParallelTrainer:
             # fault/ checkpointing: the fit's device-local trees are the
             # live training state (model attributes are stale until fit
             # returns); the per-replica updater stack and residual/τ
-            # ride along for exact resume
+            # ride along for exact resume — gathered replicated so every
+            # process can address them (multi-process elastic capture)
             return {"params": params, "net_state": state,
                     "updater_state": rep0(upd_r),
-                    "trainer_arrays": {"upd_r": upd_r,
-                                       "residual_r": res_r, "tau": tau},
+                    "trainer_arrays": {
+                        "upd_r": self._replicated_view(upd_r),
+                        "residual_r": self._replicated_view(res_r),
+                        "tau": tau},
                     "trainer_meta": {"kind": "threshold",
                                      "trainer": "parallel",
                                      "n_workers": self.n_workers}}
@@ -977,9 +996,13 @@ class ParallelTrainer:
                                     "bucketed": True,
                                     "n_workers": self.n_workers}}
             if has_thr:
-                arrays = {"residual_r": res_r, "tau": tau}
+                # per-replica stacks gathered replicated so every
+                # process can address them (multi-process elastic
+                # capture); τ is replicated by construction
+                arrays = {"residual_r": self._replicated_view(res_r),
+                          "tau": tau}
                 if mode == "threshold":
-                    arrays["upd_r"] = upd_r
+                    arrays["upd_r"] = self._replicated_view(upd_r)
                 src["trainer_arrays"] = arrays
             return src
 
@@ -1364,9 +1387,10 @@ class ParallelTrainer:
             # replica 0 stands in for the model-level view
             return {"params": rep0(params_r), "net_state": rep0(state_r),
                     "updater_state": rep0(upd_r),
-                    "trainer_arrays": {"params_r": params_r,
-                                       "upd_r": upd_r,
-                                       "state_r": state_r},
+                    "trainer_arrays": {
+                        "params_r": self._replicated_view(params_r),
+                        "upd_r": self._replicated_view(upd_r),
+                        "state_r": self._replicated_view(state_r)},
                     "trainer_meta": {"kind": "averaging",
                                      "trainer": "parallel",
                                      "since_avg": int(since_avg),

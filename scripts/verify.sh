@@ -38,7 +38,7 @@
 #    params/updater state, bf16 gradients, and the fused-Adam Pallas
 #    kernel bit-comparable (inside jit) to the jnp updater path in
 #    interpret mode. The hlo_cost `precision` block (bf16 bytes <
-#    fp32 bytes) is asserted in step [4/10] where the reports are
+#    fp32 bytes) is asserted in step [4/11] where the reports are
 #    already on disk.
 # 9. Serving smoke: `scripts/serve_loadtest.py --smoke` — >=64
 #    concurrent streams continuously batched over the paged KV pool on
@@ -50,7 +50,7 @@
 #    request (SLO admission policy; `serving_shed_total`). The smoke
 #    ledger now also carries the mixed-length + int8-quantized phase
 #    and the incremental-vs-upfront admission A/B.
-# 10. Quantized-serving gate: re-asserts the [9/10] ledger's three
+# 10. Quantized-serving gate: re-asserts the [9/11] ledger's three
 #    perf-lever evidence fields (greedy parity exact fp AND int8,
 #    mixed-length wave admission, incremental >= 2x upfront
 #    concurrency, weight-byte reduction) and proves compare_bench
@@ -58,6 +58,15 @@
 #    stale-fallback band (a silent fp-weight fallback reports ~1.0x
 #    against an int8 baseline and must gate) and the lower-is-better
 #    TTFT inversion (docs/SERVING.md).
+# 11. Elastic-drill smoke: 4-process gloo run with the membership
+#    coordinator; one worker is SIGKILLed at step ~15 (survivors
+#    detect the death, re-form a 3-process mesh from the newest valid
+#    checkpoint with re-sharded residual/τ, and keep training), then a
+#    grow drill re-adds it (4-wide final generation). Asserts loss-
+#    trajectory parity vs an uninterrupted 4-replica reference and
+#    that `elastic_reconfigurations_total`/`elastic_live_processes`
+#    appear on /metrics (docs/FAULT_TOLERANCE.md "Elastic
+#    membership").
 # 8. Diagnostics smoke: tiny-MLP run with an injected lr spike
 #    producing non-finite gradients mid-run — the in-graph watchdog's
 #    `skip` policy must keep the trajectory finite (and training must
@@ -70,7 +79,7 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== [1/10] tier-1 tests (ROADMAP.md verbatim) =="
+echo "== [1/11] tier-1 tests (ROADMAP.md verbatim) =="
 # stale-report guard: a timeout-killed suite never reaches
 # pytest_sessionfinish, and step [2/3] must not read the previous
 # run's durations as this run's
@@ -78,7 +87,7 @@ rm -f "${DL4J_SUITE_DURATIONS:-/tmp/_t1_durations.json}"
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
 tier1_rc=$?
 
-echo "== [2/10] suite duration budget =="
+echo "== [2/11] suite duration budget =="
 python - <<'EOF'
 import json
 import os
@@ -105,7 +114,7 @@ if total > soft:
           "mark 'slow' the top offenders above before adding tests.")
 EOF
 
-echo "== [3/10] /metrics smoke =="
+echo "== [3/11] /metrics smoke =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import sys
 import urllib.request
@@ -147,7 +156,7 @@ print(f"/metrics smoke OK ({len(body.splitlines())} exposition lines, "
 EOF
 smoke_rc=$?
 
-echo "== [4/10] AOT cost smoke (hlo_cost --all) =="
+echo "== [4/11] AOT cost smoke (hlo_cost --all) =="
 hlo_out=$(mktemp -d)
 timeout -k 10 840 env JAX_PLATFORMS=cpu \
     python -m benchtools.hlo_cost --all --batch 8 --steps 2 --out "$hlo_out"
@@ -231,7 +240,7 @@ EOF
 hlo_rc=$?
 rm -rf "$hlo_out"
 
-echo "== [5/10] gradient-sharing smoke (dense vs threshold) =="
+echo "== [5/11] gradient-sharing smoke (dense vs threshold) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
     timeout -k 10 300 python - <<'PYEOF'
 import numpy as np
@@ -299,7 +308,7 @@ print(f"gradient-sharing smoke OK (init={init:.3f} dense={d:.3f} "
 PYEOF
 gs_rc=$?
 
-echo "== [6/10] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
+echo "== [6/11] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
 # train 30 steps on a tiny MLP in a child process, SIGTERM at step 15
 # (async checkpoint every 5, atomic tmp+fsync+rename commits), auto-
 # resume from the newest valid checkpoint, and require the final
@@ -308,7 +317,7 @@ echo "== [6/10] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python scripts/fault_drill.py --smoke
 drill_rc=$?
 
-echo "== [7/10] mixed-precision smoke (bf16 trajectory + fused-Adam parity) =="
+echo "== [7/11] mixed-precision smoke (bf16 trajectory + fused-Adam parity) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PYEOF'
 import jax
 import jax.numpy as jnp
@@ -397,7 +406,7 @@ print(f"mixed-precision smoke OK (init={init:.3f} fp32={d:.3f} "
 PYEOF
 mp_rc=$?
 
-echo "== [8/10] diagnostics smoke (watchdog drill + real UI feed) =="
+echo "== [8/11] diagnostics smoke (watchdog drill + real UI feed) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PYEOF'
 import urllib.request
 
@@ -488,14 +497,14 @@ print(f"diagnostics smoke OK (skipped={net._diag.skipped_total}, "
 PYEOF
 diag_rc=$?
 
-echo "== [9/10] serving smoke (continuous batching, parity + SLO shed) =="
+echo "== [9/11] serving smoke (continuous batching, parity + SLO shed) =="
 serving_out=$(mktemp /tmp/_serving_smoke_XXXX.json)
 JAX_PLATFORMS=cpu timeout -k 10 420 \
     python scripts/serve_loadtest.py --smoke --out "$serving_out"
 serving_rc=$?
 
-echo "== [10/10] quantized-serving gate (ledger + compare_bench) =="
-# the smoke ledger [9/10] just wrote carries the quantized / mixed-
+echo "== [10/11] quantized-serving gate (ledger + compare_bench) =="
+# the smoke ledger [9/11] just wrote carries the quantized / mixed-
 # length / incremental-allocation phase: re-assert the three levers'
 # evidence HERE (independent of the loadtest's own exit code) and
 # prove compare_bench gates them — including the structural stale-
@@ -549,8 +558,20 @@ EOF
 qgate_rc=$?
 rm -f "$serving_out"
 
-echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc} mp_rc=${mp_rc} diag_rc=${diag_rc} serving_rc=${serving_rc} qgate_rc=${qgate_rc}"
-if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ] || [ "$mp_rc" -ne 0 ] || [ "$diag_rc" -ne 0 ] || [ "$serving_rc" -ne 0 ] || [ "$qgate_rc" -ne 0 ]; then
+echo "== [11/11] elastic-drill smoke (SIGKILL shrink + grow, membership) =="
+# 4 gloo worker processes under the membership coordinator; SIGKILL
+# one at step ~15 (shrink to a re-formed 3-process mesh, resumed from
+# the newest valid checkpoint with re-sharded threshold residual/τ),
+# re-add it once the fleet passes step ~20 (grow back to 4). The
+# drill's own verdict asserts trajectory parity vs the uninterrupted
+# 4-replica reference, >=3 membership generations, cross-worker final-
+# param bit-equality, and the elastic_* gauges on /metrics.
+JAX_PLATFORMS=cpu timeout -k 10 560 \
+    python scripts/fault_drill.py --elastic-smoke
+elastic_rc=$?
+
+echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc} mp_rc=${mp_rc} diag_rc=${diag_rc} serving_rc=${serving_rc} qgate_rc=${qgate_rc} elastic_rc=${elastic_rc}"
+if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ] || [ "$mp_rc" -ne 0 ] || [ "$diag_rc" -ne 0 ] || [ "$serving_rc" -ne 0 ] || [ "$qgate_rc" -ne 0 ] || [ "$elastic_rc" -ne 0 ]; then
     exit 1
 fi
 echo "VERIFY OK"
