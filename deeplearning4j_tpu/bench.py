@@ -1188,10 +1188,19 @@ GATE_TOLERANCES = {
     # TTFT under mixed-length bucketed admission (lower is better —
     # see GATE_LOWER_IS_BETTER); p50 of a host-scheduled latency
     "serving_mixed_p50_ttft_ms": 0.5,
+    # fleet phase: sustained concurrency is STRUCTURAL (how many
+    # streams were simultaneously open across the fleet — a scheduler
+    # or drain regression that drops/serializes streams collapses it),
+    # swap-window TTFT is the no-compile-cliff evidence (successor
+    # warmed before drain; lower is better, host-scheduled band)
+    "fleet_streams_sustained": 0.05,
+    "fleet_swap_p99_ttft_ms": 0.5,
+    "fleet_tokens_per_sec": 0.25,
 }
 # metrics where a RISE past tolerance is the regression (latencies);
 # compare_bench inverts the ratio so the shared gate math applies
-GATE_LOWER_IS_BETTER = {"serving_mixed_p50_ttft_ms"}
+GATE_LOWER_IS_BETTER = {"serving_mixed_p50_ttft_ms",
+                        "fleet_swap_p99_ttft_ms"}
 _GATE_HEADLINE = "resnet50_images_per_sec"
 
 
@@ -1233,6 +1242,15 @@ def _gate_metrics(rec):
          "extras", "serving_mixed_quantized", "weight_bytes_reduction")
     take("serving_mixed_p50_ttft_ms",
          "extras", "serving_mixed_quantized", "p50_ttft_ms")
+    # the multi-model fleet phase (>10k streams, 2 models, mid-run
+    # hot-swap): peak simultaneously-open streams across the fleet and
+    # the p99 TTFT of admissions landing in the swap window
+    take("fleet_streams_sustained",
+         "extras", "serving_fleet", "streams_sustained")
+    take("fleet_swap_p99_ttft_ms",
+         "extras", "serving_fleet", "swap_p99_ttft_ms")
+    take("fleet_tokens_per_sec",
+         "extras", "serving_fleet", "tokens_per_sec")
     return out
 
 

@@ -151,6 +151,26 @@ def registry() -> MetricsRegistry:
     return _STATE.registry
 
 
+def resolve_cached_metrics(obj, cache_attr: str, build):
+    """Shared resolve-and-cache for hot-loop metric families (the
+    serving scheduler, fleet publisher, router, registry and the
+    ParallelInference collector all use this): None when monitoring is
+    off; otherwise whatever `build(registry)` returns, resolved ONCE
+    per active registry — child lookups hit the registry lock, and an
+    `enable(registry=)` swap invalidates the cache by identity. The
+    cache lives on `obj.<cache_attr>` as an `(registry, families)`
+    pair."""
+    if not is_enabled():
+        return None
+    reg = _STATE.registry
+    cache = getattr(obj, cache_attr, None)
+    if cache is not None and cache[0] is reg:
+        return cache[1]
+    m = build(reg)
+    setattr(obj, cache_attr, (reg, m))
+    return m
+
+
 def tracer() -> Tracer:
     return _STATE.tracer
 

@@ -78,21 +78,10 @@ class ParallelInference:
 
     def _resolve_metrics(self, cache_attr, build):
         """Shared resolve-and-cache for hot-loop metric families (this
-        collector and the GenerationServer scheduler): None when
-        monitoring is off; otherwise the families `build(registry)`
-        returns, resolved ONCE per active registry — child lookups hit
-        the registry lock, and an `enable(registry=)` swap invalidates
-        the cache by identity."""
+        collector and the GenerationServer scheduler) — the ONE memo
+        rule lives in `monitor.resolve_cached_metrics`."""
         from deeplearning4j_tpu import monitor
-        if not monitor.is_enabled():
-            return None
-        reg = monitor.registry()
-        cache = getattr(self, cache_attr, None)
-        if cache is not None and cache[0] is reg:
-            return cache[1]
-        m = build(reg)
-        setattr(self, cache_attr, (reg, m))
-        return m
+        return monitor.resolve_cached_metrics(self, cache_attr, build)
 
     def _metrics(self):
         """The coalescing signal plane (ROADMAP names these as the

@@ -1,12 +1,23 @@
-"""Serving tier: continuous-batching generation over a paged KV pool.
+"""Serving tier: continuous-batching generation over a paged KV pool,
+plus the fleet deployment plane around it.
 
-- `serving.paged`  — block pools + host free/used accounting
-- `serving.engine` — the jitted decode/prefill programs + slot state
-- `serving.server` — the threaded scheduler (`GenerationServer`),
-  token streams, SLO-aware shedding
+- `serving.paged`    — block pools + host free/used accounting
+- `serving.engine`   — the jitted decode/prefill programs + slot state
+- `serving.server`   — the threaded scheduler (`GenerationServer`),
+  token streams, SLO-aware shedding, the `drain()` hot-swap seam
+- `serving.registry` — versioned `ModelRegistry` over ModelSerializer
+  zips (one-winner publish, corrupt fallback, pinned retention,
+  checkpoint-as-publish listener)
+- `serving.fleet`    — `FleetServer` multi-model hosting with
+  zero-downtime hot-swap + `FleetAutoscaler`
+- `serving.router`   — `FleetRouter` front end (weighted SLO shedding,
+  transport request plane) + `FleetClient`
+- `serving.wire`     — request/reply frames over the streaming
+  transports' ndarray wire format
 
 See docs/SERVING.md for the scheduler model, the paged-pool
-invariants, the shedding policy, and the decode-parity contract.
+invariants, the shedding policy, the decode-parity contract, and the
+fleet swap state machine.
 """
 
 from deeplearning4j_tpu.serving.paged import (
@@ -18,11 +29,29 @@ from deeplearning4j_tpu.serving.paged import (
 from deeplearning4j_tpu.serving.engine import PagedDecodeEngine
 from deeplearning4j_tpu.serving.server import (
     GenerationServer,
+    ServerDrainingError,
+    ServerStoppedError,
     ShedError,
     TokenStream,
+)
+from deeplearning4j_tpu.serving.registry import (
+    ModelRegistry,
+    RegistryPublishListener,
+    VersionConflictError,
+)
+from deeplearning4j_tpu.serving.fleet import FleetAutoscaler, FleetServer
+from deeplearning4j_tpu.serving.router import (
+    FleetClient,
+    FleetRouter,
+    RemoteTokenStream,
+    UnknownModelError,
 )
 
 __all__ = [
     "GARBAGE_BLOCK", "BlockAllocator", "PagedKVPool", "blocks_needed",
     "PagedDecodeEngine", "GenerationServer", "ShedError", "TokenStream",
+    "ServerDrainingError", "ServerStoppedError",
+    "ModelRegistry", "RegistryPublishListener", "VersionConflictError",
+    "FleetServer", "FleetAutoscaler",
+    "FleetRouter", "FleetClient", "RemoteTokenStream", "UnknownModelError",
 ]

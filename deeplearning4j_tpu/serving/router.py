@@ -1,0 +1,466 @@
+"""FleetRouter — the fleet's front-end request plane.
+
+One router fronts N named models (generation backends hosted by a
+`FleetServer`, plus plain `.output()` models for the forward-serving
+routes): it resolves the ACTIVE server per request — which is what
+makes hot-swap invisible to clients, a submit that races the swap
+pointer-flip retries against the freshly-resolved successor — applies
+the admission policy, and tags every stream with the (model, version)
+it was served by.
+
+Admission policy (weighted SLO shedding across models): each model's
+projected queue delay is the serving tier's existing EWMA estimator —
+outstanding decode work / measured token throughput
+(`GenerationServer._should_shed`'s math) — but the router compares it
+against ``slo_ttft_s * weight(model)``: a weight-2 model tolerates
+twice the delay a weight-1 model does, so under fleet-wide pressure
+the low-priority models shed FIRST while the high-priority ones keep
+admitting. `max_queue` is the per-model hard backstop before any
+throughput estimate exists. Shed requests raise `ShedError` (locally)
+or carry it across the wire (`wire.reply_error`).
+
+Transport plane: `serve()` starts a pump thread consuming
+`<prefix>.requests` frames from a `streaming.Transport` and a relay
+thread fanning each stream's token chunks onto
+`<prefix>.replies.<request_id>` — clients (`FleetClient`) hold only a
+transport, never a server reference. The relay forwards per-CHUNK (the
+scheduler already batches emissions per dispatch), so the transport
+sees one message per decode chunk, not per token.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+import uuid
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.serving import wire
+from deeplearning4j_tpu.serving.server import (
+    ServerDrainingError,
+    ShedError,
+    TokenStream,
+)
+
+log = logging.getLogger("deeplearning4j_tpu.serving.router")
+
+
+class UnknownModelError(RuntimeError):
+    """Request named a model the router doesn't front."""
+
+
+class FleetRouter:
+    def __init__(self, fleet=None, *, slo_ttft_s: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 transport=None, prefix: str = "fleet",
+                 poll_s: float = 0.005):
+        self.fleet = fleet
+        self.slo_ttft_s = slo_ttft_s
+        self.max_queue = max_queue
+        self.weights = dict(weights or {})
+        self.transport = transport
+        self.prefix = prefix
+        self.poll_s = float(poll_s)
+        self._outputs: Dict[str, object] = {}
+        self._out_inflight: Dict[str, int] = {}
+        self._out_lock = threading.Lock()
+        self._metrics_cache = None
+        # transport-plane threads + active remote streams
+        self._running = False
+        self._pump: Optional[threading.Thread] = None
+        self._relay: Optional[threading.Thread] = None
+        self._active: Dict[str, dict] = {}
+        self._active_lock = threading.Lock()
+
+    # ------------------------------------------------------------ metrics
+    def _metrics(self):
+        from deeplearning4j_tpu import monitor
+        return monitor.resolve_cached_metrics(
+            self, "_metrics_cache", lambda reg: {
+                "streams": lambda name: reg.counter(
+                    "fleet_streams_total",
+                    "generation streams routed per model", model=name),
+                "shed": lambda name: reg.counter(
+                    "fleet_shed_total",
+                    "requests shed by the router admission policy",
+                    model=name),
+                "outputs": lambda name: reg.counter(
+                    "fleet_output_requests_total",
+                    "one-shot output() requests routed per model",
+                    model=name),
+            })
+
+    def set_weight(self, name: str, weight: float):
+        """Shedding priority: model `name` tolerates
+        `slo_ttft_s * weight` of projected delay before shedding
+        (weight > 1 sheds later than the fleet default, < 1 earlier)."""
+        if weight <= 0:
+            raise ValueError(f"weight must be > 0; got {weight}")
+        self.weights[name] = float(weight)
+
+    # ----------------------------------------------------------- resolve
+    def _resolve(self, name: str):
+        """(server, version) of the ACTIVE backend for `name` — one
+        atomic read of the fleet's swap pointer."""
+        if self.fleet is None or not self.fleet.has(name):
+            known = ([] if self.fleet is None
+                     else self.fleet.names()) + sorted(self._outputs)
+            raise UnknownModelError(
+                f"router fronts no generation model {name!r} "
+                f"(known: {known})")
+        return self.fleet.active(name)
+
+    # ---------------------------------------------------------- shedding
+    @staticmethod
+    def _outstanding_tokens(server) -> int:
+        """The server's own outstanding-work estimate PLUS the tokens
+        owed by requests still sitting in its submit queue: the server
+        computes its projection on the scheduler thread after moving
+        queue items to the pending list, but the router projects from
+        OUTSIDE — at submit time a just-enqueued request lives in
+        `_queue`, which `server._outstanding_tokens()` cannot see.
+        `queued_tokens` is the server's O(1) running counter — copying
+        a 10k-deep queue under its mutex per submit would make the
+        projection itself the bottleneck."""
+        return server._outstanding_tokens() + server.queued_tokens
+
+    def _should_shed(self, name: str, server) -> Optional[str]:
+        depth = len(server._pending) + server._queue.qsize()
+        if self.max_queue is not None and depth >= self.max_queue:
+            return (f"model {name!r} admission queue full "
+                    f"({depth} >= max_queue {self.max_queue})")
+        if self.slo_ttft_s is not None and server._ewma_tok_s:
+            # the serving tier's own projected-delay estimator, scaled
+            # by the model's weight — fleet-wide pressure sheds the
+            # low-weight models first
+            budget = self.slo_ttft_s * self.weights.get(name, 1.0)
+            projected = (self._outstanding_tokens(server)
+                         / server._ewma_tok_s)
+            if projected > budget:
+                return (f"model {name!r} projected delay "
+                        f"{projected:.2f}s exceeds its weighted "
+                        f"{budget:.2f}s TTFT budget at "
+                        f"{server._ewma_tok_s:.1f} tok/s")
+        return None
+
+    # ------------------------------------------------------------ submit
+    def submit(self, name: str, prompt_ids, n_tokens: int, *,
+               temperature: float = 0.0, top_p: Optional[float] = None,
+               rng=None) -> TokenStream:
+        """Route one generation request to `name`'s active server;
+        returns its TokenStream tagged with ``.model``/``.version``.
+        A submit racing a hot-swap's pointer flip sees the incumbent's
+        `ServerDrainingError` and retries against the successor — the
+        zero-dropped-streams contract covers the flip window."""
+        m = self._metrics()
+        for _ in range(64):
+            server, version = self._resolve(name)
+            reason = self._should_shed(name, server)
+            if reason is not None:
+                if m is not None:
+                    m["shed"](name).inc()
+                raise ShedError(reason)
+            try:
+                stream = server.generate_async(
+                    prompt_ids, n_tokens, temperature=temperature,
+                    top_p=top_p, rng=rng)
+            except ServerDrainingError:
+                # swap in progress: the pointer flip happens before the
+                # incumbent drains, so the next resolve sees the warmed
+                # successor
+                time.sleep(0.002)
+                continue
+            stream.model = name
+            stream.version = version
+            if m is not None:
+                m["streams"](name).inc()
+            return stream
+        raise RuntimeError(
+            f"model {name!r} stayed in draining state across retries — "
+            f"is a swap stuck without a successor?")
+
+    # ------------------------------------------------------- output plane
+    def attach_output(self, name: str, model):
+        """Front a plain forward model (anything with `.output(x)`) —
+        the `ServingRoute` backend kind. Shares the router's naming,
+        counters and max_queue backstop with the generation plane."""
+        self._outputs[name] = model
+        self._out_inflight.setdefault(name, 0)
+
+    def route_output(self, name: str, x) -> np.ndarray:
+        model = self._outputs.get(name)
+        if model is None:
+            raise UnknownModelError(
+                f"router fronts no output model {name!r} "
+                f"(known: {sorted(self._outputs)})")
+        m = self._metrics()
+        with self._out_lock:
+            if (self.max_queue is not None
+                    and self._out_inflight[name] >= self.max_queue):
+                if m is not None:
+                    m["shed"](name).inc()
+                raise ShedError(
+                    f"output model {name!r} has "
+                    f"{self._out_inflight[name]} requests in flight "
+                    f"(max_queue {self.max_queue})")
+            self._out_inflight[name] += 1
+        try:
+            if m is not None:
+                m["outputs"](name).inc()
+            return np.asarray(model.output(x))
+        finally:
+            with self._out_lock:
+                self._out_inflight[name] -= 1
+
+    # ------------------------------------------------------ transport plane
+    def serve(self) -> "FleetRouter":
+        """Start consuming `<prefix>.requests` from the transport and
+        relaying token chunks to each request's reply topic."""
+        if self.transport is None:
+            raise ValueError("router has no transport — pass transport= "
+                             "to serve the request plane")
+        if self._running:
+            return self
+        self._running = True
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True)
+        self._relay = threading.Thread(target=self._relay_loop, daemon=True)
+        self._pump.start()
+        self._relay.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        for t in (self._pump, self._relay):
+            if t is not None:
+                t.join(timeout=10)
+        self._pump = self._relay = None
+        # fail whatever was mid-relay so remote consumers don't hang
+        with self._active_lock:
+            active, self._active = self._active, {}
+        for rid, ent in active.items():
+            self._publish_final(rid, ent, RuntimeError(
+                "FleetRouter stopped before this stream finished"))
+
+    def _reply_topic(self, rid: str) -> str:
+        return f"{self.prefix}.replies.{rid}"
+
+    def _pump_loop(self):
+        topic = f"{self.prefix}.requests"
+        while self._running:
+            try:
+                data = self.transport.receive(topic, timeout=self.poll_s)
+            except (TimeoutError, queue.Empty):
+                continue
+            except Exception:  # noqa: BLE001 — broker hiccup: keep serving
+                log.exception("request-plane receive error (continuing)")
+                time.sleep(self.poll_s)
+                continue
+            rid = None
+            try:
+                header, prompt = wire.decode_request(data)
+                rid = header["request_id"]
+                stream = self.submit(
+                    header["model"], prompt, header["n_tokens"],
+                    temperature=header.get("temperature") or 0.0,
+                    top_p=header.get("top_p"), rng=header.get("rng"))
+            except Exception as e:  # noqa: BLE001 — fail THAT request only
+                if rid is not None:
+                    try:
+                        self.transport.send(
+                            self._reply_topic(rid),
+                            wire.encode_reply(rid, 0, None, done=True,
+                                              error=e))
+                    except Exception:  # noqa: BLE001 — the error-reply
+                        # send is a broker touchpoint too: it failing
+                        # must not kill the pump thread (the client
+                        # times out instead — degraded, not dead)
+                        log.exception("error-reply publish failed "
+                                      "for %s", rid)
+                else:
+                    log.exception("undecodable request frame dropped")
+                continue
+            with self._active_lock:
+                self._active[rid] = {"stream": stream, "cursor": 0,
+                                     "seq": 0}
+
+    def _relay_loop(self):
+        while self._running:
+            with self._active_lock:
+                items = list(self._active.items())
+            progressed = False
+            for rid, ent in items:
+                stream: TokenStream = ent["stream"]
+                try:
+                    # a chunk is FROZEN (tokens + seq) before its first
+                    # send attempt and re-sent VERBATIM after a failed
+                    # one: re-slicing the live token list under the
+                    # same seq would combine with the client's seq
+                    # dedup to silently drop whatever grew between the
+                    # attempts
+                    pend = ent.get("pending")
+                    toks = stream.tokens
+                    if pend is None and len(toks) > ent["cursor"]:
+                        end = len(toks)
+                        pend = ent["pending"] = (
+                            ent["seq"], toks[ent["cursor"]:end], end)
+                    if pend is not None:
+                        seq, chunk, end = pend
+                        self.transport.send(
+                            self._reply_topic(rid),
+                            wire.encode_reply(rid, seq, chunk,
+                                              done=False,
+                                              model=stream.model,
+                                              version=stream.version))
+                        # advance ONLY after a successful send
+                        ent["pending"] = None
+                        ent["cursor"] = end
+                        ent["seq"] = seq + 1
+                        progressed = True
+                    # terminal frame: only once every token chunk is
+                    # out, popped from _active only on a SUCCESSFUL
+                    # send — the done frame is the one the client
+                    # cannot make progress without, so it gets the
+                    # same retry discipline as interior chunks (a
+                    # transient error here retries next tick instead
+                    # of stranding the client until its timeout)
+                    if (stream._fut.done()
+                            and ent.get("pending") is None
+                            and ent["cursor"] == len(stream.tokens)):
+                        exc = stream._fut.exception(timeout=0)
+                        self.transport.send(
+                            self._reply_topic(rid),
+                            wire.encode_reply(
+                                rid, ent["seq"], [], done=True,
+                                model=stream.model,
+                                version=stream.version, error=exc))
+                        with self._active_lock:
+                            self._active.pop(rid, None)
+                        progressed = True
+                except Exception:  # noqa: BLE001 — one stream's broker
+                    # error must not kill the relay for every OTHER
+                    # stream; this one retries next tick
+                    log.exception("relay error for %s (will retry)",
+                                  rid)
+            if not progressed:
+                time.sleep(self.poll_s)
+
+    def _publish_final(self, rid: str, ent: dict,
+                       exc: Optional[BaseException], tail=None):
+        stream = ent["stream"]
+        try:
+            self.transport.send(
+                self._reply_topic(rid),
+                wire.encode_reply(rid, ent["seq"], tail or [], done=True,
+                                  model=getattr(stream, "model", None),
+                                  version=getattr(stream, "version", None),
+                                  error=exc))
+        except Exception:  # noqa: BLE001 — teardown must not throw
+            log.exception("reply publish failed for %s", rid)
+
+
+# ------------------------------------------------------------------ client
+class RemoteTokenStream:
+    """Client face of one routed generation: iterate for token chunks
+    as they arrive on the reply topic, or `result()` for the full
+    array. Mirrors `TokenStream`'s two faces over the transport."""
+
+    def __init__(self, transport, topic: str, *, timeout: float = 600.0):
+        self.transport = transport
+        self.topic = topic
+        self.timeout = float(timeout)
+        self.tokens = []
+        self.model = None
+        self.version = None
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._last_seq = -1
+
+    def _pull(self, timeout: Optional[float] = None) -> np.ndarray:
+        wait = self.timeout if timeout is None else timeout
+        try:
+            data = self.transport.receive(self.topic, timeout=wait)
+        except queue.Empty as e:
+            # LocalQueueTransport signals timeout as queue.Empty;
+            # normalize so remote consumers see one timeout type
+            raise TimeoutError(
+                f"no reply on {self.topic} within {wait}s") from e
+        header, chunk = wire.decode_reply(data)
+        if header.get("model") is not None:
+            self.model = header["model"]
+            self.version = header["version"]
+        # de-duplicate by seq: the relay retries a chunk whose send
+        # failed AFTER the broker durably accepted it (at-least-once
+        # transports — Kafka's flush can time out post-accept), so a
+        # replayed ordinal must not extend the token array twice
+        seq = int(header.get("seq", 0))
+        if seq > self._last_seq:
+            self._last_seq = seq
+            self.tokens.extend(int(t) for t in chunk)
+        else:
+            chunk = chunk[:0]
+        if header["done"]:
+            self._done = True
+            self._error = wire.reply_error(header)
+            # one reply topic per request: release its transport
+            # resources (queue / Kafka consumer) the moment the
+            # terminal frame lands, or a long-lived client leaks one
+            # per finished request
+            try:
+                self.transport.close(self.topic)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+        return chunk
+
+    def __iter__(self):
+        while not self._done:
+            yield from (int(t) for t in self._pull())
+        if self._error is not None:
+            raise self._error
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+        while not self._done:
+            if deadline is None:
+                self._pull()
+            else:
+                # each pull is bounded by the REMAINING deadline, not
+                # the per-stream default — result(timeout=5) must
+                # surface within ~5 s even when no reply ever arrives
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no terminal reply on {self.topic}")
+                self._pull(timeout=min(self.timeout, remaining))
+        if self._error is not None:
+            raise self._error
+        return np.asarray(self.tokens, np.int32)
+
+
+class FleetClient:
+    """Submit generation requests over a `streaming.Transport` — no
+    server reference, only topics. One client may serve many threads;
+    each request gets its own reply topic keyed by request id."""
+
+    def __init__(self, transport, prefix: str = "fleet"):
+        self.transport = transport
+        self.prefix = prefix
+
+    def generate(self, model: str, prompt_ids, n_tokens: int, *,
+                 temperature: float = 0.0, top_p: Optional[float] = None,
+                 rng=None, request_id: Optional[str] = None,
+                 timeout: float = 600.0) -> RemoteTokenStream:
+        rid = request_id or uuid.uuid4().hex
+        self.transport.send(
+            f"{self.prefix}.requests",
+            wire.encode_request(model, rid, prompt_ids, n_tokens,
+                                temperature=temperature, top_p=top_p,
+                                rng=rng))
+        return RemoteTokenStream(self.transport,
+                                 f"{self.prefix}.replies.{rid}",
+                                 timeout=timeout)

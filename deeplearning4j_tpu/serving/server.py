@@ -44,12 +44,27 @@ class ShedError(RuntimeError):
     queued): retry against another replica or with backoff."""
 
 
+class ServerDrainingError(RuntimeError):
+    """Admission refused because the server is draining (`drain()` —
+    the hot-swap handoff): in-flight streams finish, new requests
+    belong on the successor. A `FleetRouter` retries against the
+    freshly-resolved active server; direct callers should re-resolve."""
+
+
+class ServerStoppedError(RuntimeError):
+    """`start()` after `stop()`: a stopped GenerationServer's engine
+    has failed its in-flight streams and retired their slots —
+    restarting the scheduler over that state would corrupt the
+    allocator bookkeeping. Build a fresh server instead."""
+
+
 class TokenStream:
     """Per-request token stream: iterate for tokens as they decode, or
     block on `result()` for the full array (the Future face —
     `ParallelInference.output_async` compatibility)."""
 
-    def __init__(self, fut, prompt_len: int, n_tokens: int):
+    def __init__(self, fut, prompt_len: int, n_tokens: int,
+                 on_close=None):
         self._fut = fut
         self._q: "queue.Queue" = queue.Queue()
         self.prompt_len = prompt_len
@@ -59,6 +74,13 @@ class TokenStream:
         self.t_submit = time.monotonic()
         self.t_first: Optional[float] = None
         self.t_last: Optional[float] = None
+        # close hook (fires exactly once, on finish OR failure): the
+        # server's open-stream accounting — what makes drain() a
+        # zero-dropped-streams barrier instead of a scheduler-state
+        # guess (a request between queue.get and _pending.append is
+        # visible nowhere else)
+        self._on_close = on_close
+        self._closed = False
 
     # ------------------------------------------------------------ consumer
     def __iter__(self) -> Iterator[int]:
@@ -103,15 +125,24 @@ class TokenStream:
         self.tokens.extend(toks)
         self._q.put(toks)
 
+    def _close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._on_close is not None:
+            self._on_close()
+
     def _finish(self):
         if not self._fut.done():
             self._fut.set_result(np.asarray(self.tokens, np.int32))
         self._q.put(_DONE)
+        self._close()
 
     def _fail(self, exc: BaseException):
         if not self._fut.done():
             self._fut.set_exception(exc)
         self._q.put(_DONE)
+        self._close()
 
 
 class _Request:
@@ -185,6 +216,46 @@ class GenerationServer(ParallelInference):
         # registry); the scheduler publishes the deltas each loop
         self._grants_seen = 0
         self._requeue_seen = 0
+        # lifecycle: draining refuses admissions while in-flight
+        # streams finish (the hot-swap handoff); stopped is terminal
+        self._draining = False
+        self._stopped = False
+        self._open_streams = 0
+        self._queued_tokens = 0
+        self._open_lock = threading.Lock()
+
+    # ---------------------------------------------------- open-stream book
+    def _stream_closed(self):
+        with self._open_lock:
+            self._open_streams -= 1
+
+    @property
+    def open_streams(self) -> int:
+        """Streams submitted and not yet finished/failed — counted at
+        the TokenStream close hook, so a request is visible here from
+        `generate_async` until its future resolves (including the
+        scheduler-internal limbo between queue and pending list)."""
+        with self._open_lock:
+            return self._open_streams
+
+    @property
+    def queued_tokens(self) -> int:
+        """Tokens owed by requests still in the SUBMIT queue (not yet
+        taken by the scheduler): a running counter — incremented at
+        `generate_async`, decremented when the scheduler (or teardown)
+        takes the item — so an external projected-delay estimator (the
+        FleetRouter) reads it O(1) instead of copying the queue under
+        its mutex on every submit."""
+        with self._open_lock:
+            return max(0, self._queued_tokens)
+
+    def _queue_item_taken(self, item):
+        """Bookkeeping for every item removed from `_queue` (None
+        sentinels excluded — they were never counted)."""
+        if item is None:
+            return
+        with self._open_lock:
+            self._queued_tokens -= int(getattr(item[0], "n_tokens", 0))
 
     def output_async(self, x):
         """Not supported here: the scheduler queue carries generation
@@ -299,6 +370,11 @@ class GenerationServer(ParallelInference):
         requests fail HERE, not as a scheduler-thread error."""
         if getattr(self, "_shutdown", False):
             raise RuntimeError("GenerationServer is shut down")
+        if self._draining:
+            raise ServerDrainingError(
+                "GenerationServer is draining: in-flight streams are "
+                "finishing but admissions are closed — submit to the "
+                "successor (FleetRouter re-resolves automatically)")
         if not self._running:
             raise RuntimeError("call start() before generate_async()")
         prompt = np.asarray(prompt_ids)
@@ -321,7 +397,24 @@ class GenerationServer(ParallelInference):
             rng = np.frombuffer(os.urandom(8), np.uint32).copy()
         from concurrent.futures import Future
         fut = Future()
-        stream = TokenStream(fut, int(prompt.shape[0]), int(n_tokens))
+        stream = TokenStream(fut, int(prompt.shape[0]), int(n_tokens),
+                             on_close=self._stream_closed)
+        with self._open_lock:
+            # re-check the drain flag ATOMICALLY with the open-stream
+            # increment: drain() sets the flag and reads the count
+            # under this same lock, so a submit either increments
+            # before drain reads (drain waits for it) or sees the flag
+            # and raises — it can never slip a request into a server
+            # drain already declared empty (the stream would hang
+            # unserviced after the subsequent stop())
+            if self._draining:
+                raise ServerDrainingError(
+                    "GenerationServer is draining: in-flight streams "
+                    "are finishing but admissions are closed — submit "
+                    "to the successor (FleetRouter re-resolves "
+                    "automatically)")
+            self._open_streams += 1
+            self._queued_tokens += int(n_tokens)
         req = _Request(prompt.astype(np.int64), int(n_tokens),
                        float(temperature), top_p, rng, stream)
         self._queue.put((req, fut, stream.t_submit))
@@ -419,6 +512,7 @@ class GenerationServer(ParallelInference):
                     item = self._queue.get(timeout=self.idle_wait_s)
                 except queue.Empty:
                     continue
+                self._queue_item_taken(item)
                 if item is not None:
                     self._pending.append(item)
 
@@ -476,6 +570,7 @@ class GenerationServer(ParallelInference):
                 item = self._queue.get_nowait()
             except queue.Empty:
                 break
+            self._queue_item_taken(item)
             if item is None:
                 continue
             req = item[0]
@@ -602,7 +697,56 @@ class GenerationServer(ParallelInference):
                     (req.stream.t_last - req.stream.t_first) / (n - 1))
 
     # ---------------------------------------------------------- lifecycle
+    def start(self):
+        # a restarted scheduler would run over an engine whose slots
+        # were force-retired by stop() and whose streams were failed —
+        # refuse loudly instead of corrupting the allocator
+        if self._stopped:
+            raise ServerStoppedError(
+                "GenerationServer was stopped; start() cannot revive it "
+                "— build a fresh server (the engine's slot/allocator "
+                "state was retired at stop())")
+        return super().start()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Zero-downtime handoff seam: close admissions (new
+        `generate_async` raises `ServerDrainingError`) and block until
+        every already-submitted stream — queued AND in-flight — has
+        finished. Returns True when fully drained, False on timeout
+        (admissions stay closed either way).
+
+        The barrier is the open-stream count (TokenStream close hooks),
+        not scheduler-state inspection: a request between the queue and
+        the pending list is invisible to both, and declaring drained
+        while it's in limbo would drop a stream at the subsequent
+        stop(). The engine is never touched from here — the warmup
+        counter-reset and incremental-allocation invariants
+        (docs/SERVING.md) belong to the scheduler thread alone."""
+        with self._open_lock:
+            # flag-set and count-read share the submit path's lock:
+            # see the generate_async re-check
+            self._draining = True
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+        while self.open_streams > 0:
+            if not self._running:
+                # scheduler gone (stop() raced us): whatever is left
+                # has been failed — drained in the "nothing in flight"
+                # sense, but not cleanly
+                return self.open_streams == 0
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
     def stop(self):
+        # idempotent: a second stop() (or stop() after shutdown()) is a
+        # no-op — the first one already failed every stream and joined
+        # the scheduler; re-running the teardown over cleared state
+        # must not raise or double-fail anything
+        if self._stopped:
+            return
+        self._stopped = True
         # inherited stop() joins with a 5 s cap and proceeds — here a
         # single decode chunk can legitimately run longer (large model
         # x steps_per_dispatch), and mutating engine/slot state while
@@ -643,6 +787,7 @@ class GenerationServer(ParallelInference):
                 item = self._queue.get_nowait()
             except queue.Empty:
                 break
+            self._queue_item_taken(item)
             if item is None:
                 continue
             req = item[0]

@@ -1,0 +1,126 @@
+"""Fleet request-plane wire format.
+
+Requests and streamed tokens ride the `streaming/` transports
+(`LocalQueueTransport` in-tree, `KafkaTransport` gated on
+kafka-python) so clients never hold a server reference — the
+decoupling the reference stack got from its Kafka/Camel serving routes
+(dl4j-streaming) and TF-Serving got from gRPC. Each message is a JSON
+header (routing metadata) followed by the EXISTING ndarray wire bytes
+(`streaming.ndarray.serialize_ndarray` — magic, dtype code, dims,
+buffer), so the payload half is byte-identical to what every other
+route on the transport carries and the transports stay payload-blind.
+
+Topics (one request topic per router, one reply topic per request):
+
+    <prefix>.requests                 client -> router
+    <prefix>.replies.<request_id>     router -> client (token chunks)
+
+Frames:
+
+    b"DLFQ" <u32 header_len> <header json> <ND4T prompt bytes>
+    b"DLFR" <u32 header_len> <header json> <ND4T token-chunk bytes>
+
+A reply header carries ``seq`` (chunk ordinal), ``done``, the serving
+``model``/``version`` tag, and on failure ``error_type``/``error`` —
+`decode_reply` re-raises ShedError by name so a shed request fails the
+same way remotely as locally.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.streaming.ndarray import (
+    deserialize_ndarray,
+    serialize_ndarray,
+)
+
+REQUEST_MAGIC = b"DLFQ"
+REPLY_MAGIC = b"DLFR"
+
+
+def _frame(magic: bytes, header: dict, arr: Optional[np.ndarray]) -> bytes:
+    hb = json.dumps(header, sort_keys=True).encode()
+    payload = b"" if arr is None else serialize_ndarray(np.ascontiguousarray(arr))
+    return magic + struct.pack("<I", len(hb)) + hb + payload
+
+
+def _unframe(magic: bytes, data: bytes) -> Tuple[dict, Optional[np.ndarray]]:
+    if data[:4] != magic:
+        raise ValueError(
+            f"not a {magic.decode()} frame (magic {data[:4]!r})")
+    (hlen,) = struct.unpack_from("<I", data, 4)
+    header = json.loads(data[8:8 + hlen].decode())
+    rest = data[8 + hlen:]
+    return header, (deserialize_ndarray(rest) if rest else None)
+
+
+# ------------------------------------------------------------- requests
+def encode_request(model: str, request_id: str, prompt_ids, n_tokens: int,
+                   *, temperature: float = 0.0,
+                   top_p: Optional[float] = None, rng=None) -> bytes:
+    header = {
+        "model": str(model),
+        "request_id": str(request_id),
+        "n_tokens": int(n_tokens),
+        "temperature": float(temperature),
+        "top_p": None if top_p is None else float(top_p),
+        "rng": None if rng is None else
+               [int(x) for x in np.asarray(rng, np.uint32).reshape(2)],
+    }
+    return _frame(REQUEST_MAGIC, header,
+                  np.asarray(prompt_ids, np.int64))
+
+
+def decode_request(data: bytes) -> Tuple[dict, np.ndarray]:
+    """(header, prompt_ids). Raises ValueError on a non-request frame."""
+    header, prompt = _unframe(REQUEST_MAGIC, data)
+    if prompt is None:
+        raise ValueError("request frame carries no prompt payload")
+    if header.get("rng") is not None:
+        header["rng"] = np.asarray(header["rng"], np.uint32)
+    return header, prompt
+
+
+# --------------------------------------------------------------- replies
+def encode_reply(request_id: str, seq: int, tokens, *, done: bool,
+                 model: Optional[str] = None,
+                 version: Optional[int] = None,
+                 error: Optional[BaseException] = None) -> bytes:
+    header = {
+        "request_id": str(request_id),
+        "seq": int(seq),
+        "done": bool(done),
+        "model": model,
+        "version": version,
+    }
+    if error is not None:
+        header["error_type"] = type(error).__name__
+        header["error"] = str(error)
+    toks = np.asarray([] if tokens is None else tokens, np.int32)
+    return _frame(REPLY_MAGIC, header, toks)
+
+
+def decode_reply(data: bytes) -> Tuple[dict, np.ndarray]:
+    """(header, token_chunk). The header's error fields are left to the
+    caller (`RemoteTokenStream` maps error_type == "ShedError" back to
+    ShedError, everything else to RuntimeError)."""
+    header, toks = _unframe(REPLY_MAGIC, data)
+    return header, (np.zeros(0, np.int32) if toks is None
+                    else toks.astype(np.int32))
+
+
+def reply_error(header: dict) -> Optional[BaseException]:
+    """Rehydrate a reply header's error, preserving the shed/failure
+    distinction across the wire."""
+    if "error_type" not in header:
+        return None
+    from deeplearning4j_tpu.serving.server import ShedError
+    msg = f"{header.get('error', '')} (remote {header['error_type']})"
+    if header["error_type"] == "ShedError":
+        return ShedError(msg)
+    return RuntimeError(msg)

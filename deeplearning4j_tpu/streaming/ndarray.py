@@ -15,8 +15,16 @@ from typing import Dict, Optional
 
 import numpy as np
 
+# bfloat16 rides jax's bundled ml_dtypes (no new dependency): serving
+# activations and the mixed_bf16 training wire are bf16, and the
+# request plane must carry them without a silent fp32 up-cast doubling
+# every payload. int8 carries quantized serving payloads (nd/quant.py)
+# for the same reason.
+from ml_dtypes import bfloat16 as _bf16
+
 _MAGIC = b"ND4T"
-_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64,
+           4: _bf16, 5: np.int8}
 _DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
 
 
@@ -24,7 +32,9 @@ def serialize_ndarray(arr: np.ndarray) -> bytes:
     arr = np.ascontiguousarray(arr)
     code = _DTYPE_CODES.get(arr.dtype)
     if code is None:
-        raise TypeError(f"Unsupported dtype {arr.dtype}")
+        raise TypeError(
+            f"Unsupported dtype {arr.dtype}; the ND4T wire carries "
+            f"{sorted(str(np.dtype(d)) for d in _DTYPES.values())}")
     header = _MAGIC + struct.pack("<BB", code, arr.ndim)
     header += struct.pack(f"<{arr.ndim}q", *arr.shape)
     return header + arr.tobytes()
@@ -34,6 +44,13 @@ def deserialize_ndarray(data: bytes) -> np.ndarray:
     if data[:4] != _MAGIC:
         raise ValueError("Not an ND4T payload (bad magic)")
     code, ndim = struct.unpack_from("<BB", data, 4)
+    if code not in _DTYPES:
+        # name the offending code: a payload from a NEWER wire revision
+        # must fail diagnosably, not as a KeyError deep in numpy
+        raise ValueError(
+            f"Unknown ND4T dtype code {code} (this reader knows codes "
+            f"{sorted(_DTYPES)}); payload written by a newer wire "
+            f"revision?")
     dims = struct.unpack_from(f"<{ndim}q", data, 6)
     off = 6 + 8 * ndim
     return np.frombuffer(data, _DTYPES[code], int(np.prod(dims)),
@@ -46,6 +63,18 @@ class Transport:
 
     def receive(self, topic: str, timeout: Optional[float] = None) -> bytes:
         raise NotImplementedError
+
+    def close(self, topic: str) -> None:
+        """Release per-topic resources (consumers, buffers). The fleet
+        request plane allocates ONE reply topic per request — without
+        this hook a long-lived client leaks a queue (local) or an open
+        consumer socket (Kafka) per finished request."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
 
 
 class LocalQueueTransport(Transport):
@@ -62,6 +91,9 @@ class LocalQueueTransport(Transport):
 
     def receive(self, topic, timeout=None):
         return self._q(topic).get(timeout=timeout)
+
+    def close(self, topic):
+        self._queues.pop(topic, None)
 
 
 class KafkaTransport(Transport):
@@ -94,6 +126,11 @@ class KafkaTransport(Transport):
         for records in batch.values():
             return records[0].value
         raise TimeoutError(f"No message on {topic}")
+
+    def close(self, topic):
+        consumer = self._consumers.pop(topic, None)
+        if consumer is not None:
+            consumer.close()
 
 
 class NDArrayPublisher:

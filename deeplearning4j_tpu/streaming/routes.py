@@ -26,16 +26,28 @@ from deeplearning4j_tpu.streaming.ndarray import (
 
 
 class ServingRoute:
-    """consume(topic) → before → model.output → final → publish(topic).
+    """consume(topic) → before → route through the fleet router →
+    final → publish(topic).
 
     `model`: anything with `.output(x)` (MultiLayerNetwork or
     ComputationGraph — pass `model_uri` instead to lazy-restore from a
-    checkpoint zip, the reference's `modelUri` mode)."""
+    checkpoint zip, the reference's `modelUri` mode).
+
+    The forward itself goes through a `FleetRouter` output backend
+    (`serving/router.py`) — the route is a transport adapter over the
+    same front end the generation fleet uses, so a plain forward-
+    serving route shares the router's per-model request accounting
+    (`fleet_output_requests_total{model=}`), its `max_queue` shed
+    backstop, and (when `router=` is a shared instance) a single
+    admission plane with the generation models. By default each route
+    owns a private single-model router named `model_name`."""
 
     def __init__(self, transport: Transport, consuming_topic: str,
                  output_topic: str, model=None, model_uri: Optional[str] = None,
                  before: Optional[Callable[[np.ndarray], np.ndarray]] = None,
-                 final: Optional[Callable[[np.ndarray], np.ndarray]] = None):
+                 final: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+                 router=None, model_name: Optional[str] = None,
+                 max_queue: Optional[int] = None):
         if model is None and model_uri is None:
             raise ValueError("need model or model_uri")
         self.transport = transport
@@ -45,6 +57,12 @@ class ServingRoute:
         self.model_uri = model_uri
         self.before = before
         self.final = final
+        self.model_name = model_name or f"route:{consuming_topic}"
+        if router is None:
+            from deeplearning4j_tpu.serving.router import FleetRouter
+            router = FleetRouter(max_queue=max_queue)
+        self.router = router
+        self._attached = False
         self._consumer = NDArrayConsumer(transport, consuming_topic)
         self._publisher = NDArrayPublisher(transport, output_topic)
         self._stop = threading.Event()
@@ -56,6 +74,14 @@ class ServingRoute:
             from deeplearning4j_tpu.util.serializer import ModelSerializer
             self._model = ModelSerializer.restore_model(self.model_uri)
         return self._model
+
+    def _router_backend(self):
+        """Attach the (possibly lazily-restored) model to the router as
+        an output backend exactly once."""
+        if not self._attached:
+            self.router.attach_output(self.model_name, self.model)
+            self._attached = True
+        return self.router
 
     # ---------------------------------------------------------- processing
     def process_one(self, timeout: Optional[float] = None) -> bool:
@@ -71,7 +97,7 @@ class ServingRoute:
             return False
         if self.before is not None:
             x = self.before(x)
-        out = np.asarray(self.model.output(x))
+        out = self._router_backend().route_output(self.model_name, x)
         if self.final is not None:
             out = self.final(out)
         self._publisher.publish(np.asarray(out))

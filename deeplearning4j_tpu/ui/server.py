@@ -379,13 +379,67 @@ class UIServer:
         return self._page(self._tr("title.system"), "".join(body))
 
     def _serving_html(self):
-        """Continuous-batching serving health from the live metrics
-        registry (the same families /metrics exports — one source of
-        truth, rendered instead of scraped)."""
+        """Serving health from the live metrics registry (the same
+        families /metrics exports — one source of truth, rendered
+        instead of scraped): one row PER FLEET MODEL (name, version,
+        queue depth, active slots, shed count — the `fleet_*` labeled
+        families), then the single-server engine snapshot for the
+        non-fleet `GenerationServer` case."""
         from deeplearning4j_tpu import monitor
 
         body = [self._nav("serving")]
         snap = (self._registry or monitor.registry()).snapshot()
+
+        def by_model(fam):
+            out = {}
+            for e in (snap.get(fam) or {}).get("values", []):
+                model = e.get("labels", {}).get("model")
+                if model is not None:
+                    out[model] = e.get("value")
+            return out
+
+        fleet_rows = {}
+        for fam, col in (("fleet_model_version", "version"),
+                         ("fleet_queue_depth", "queue depth"),
+                         ("fleet_active_slots", "active slots"),
+                         ("fleet_slot_count", "slots"),
+                         ("fleet_open_streams", "open streams"),
+                         ("fleet_pool_blocks_used", "pool used"),
+                         ("fleet_pool_blocks_free", "pool free"),
+                         ("fleet_streams_total", "streams"),
+                         ("fleet_shed_total", "shed"),
+                         ("fleet_swaps_total", "swaps")):
+            for model, v in by_model(fam).items():
+                if isinstance(v, float) and v.is_integer():
+                    v = int(v)
+                fleet_rows.setdefault(model, {})[col] = v
+        # version 0 marks a RETIRED model (the fleet zeroes an
+        # undeployed model's gauges; the registry can't remove label
+        # children) — don't render it as a live row
+        fleet_rows = {name: row for name, row in fleet_rows.items()
+                      if row.get("version", 0) != 0}
+        if fleet_rows:
+            cols = ["model", "version", "queue depth", "active slots",
+                    "slots", "open streams", "pool used", "pool free",
+                    "streams", "shed", "swaps"]
+            body.append("<h3>fleet</h3>")
+            body.append("<table border='1' cellpadding='4'><tr>")
+            body.extend(f"<th>{_html.escape(c)}</th>" for c in cols)
+            body.append("</tr>")
+            for model in sorted(fleet_rows):
+                row = fleet_rows[model]
+                body.append("<tr><td>" + _html.escape(model) + "</td>")
+                body.extend(
+                    f"<td>{_html.escape(str(row.get(c, 0)))}</td>"
+                    for c in cols[1:])
+                body.append("</tr>")
+            body.append("</table>")
+            reg_pub = snap.get("registry_published_total")
+            if reg_pub and reg_pub.get("values"):
+                published = sum(e.get("value", 0)
+                                for e in reg_pub["values"])
+                body.append(f"<p>registry: "
+                            f"{int(published)} versions published</p>")
 
         def val(name, default="–"):
             fam = snap.get(name)

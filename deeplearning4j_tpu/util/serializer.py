@@ -22,6 +22,7 @@ import io
 import json
 import os
 import zipfile
+import zlib
 from pathlib import Path
 from typing import Union
 
@@ -167,7 +168,12 @@ class ModelSerializer:
                 state_flat = _load_npz(zf, "state.npz")
                 upd_flat = _load_npz(zf, "updater.npz") if load_updater else {}
             except (zipfile.BadZipFile, ValueError, KeyError,
-                    EOFError, OSError) as e:
+                    EOFError, OSError, zlib.error) as e:
+                # zlib.error: a bit-flip inside a deflated member fails
+                # the DECOMPRESSOR before the crc check ever runs — it
+                # is corruption all the same and must degrade the same
+                # way (registry/resume fallback), not as a raw zlib
+                # traceback
                 raise CheckpointCorruptError(
                     f"{path}: model zip is corrupt or truncated "
                     f"({e})") from e

@@ -47,6 +47,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -157,6 +158,283 @@ def concurrency_ab(net, prompt_len, n_tokens, *, n_slots, n_blocks,
     return counts
 
 
+def run_fleet(args, *, metrics_check=False):
+    """Fleet phase: >10k concurrent streams across TWO registry-served
+    models with a mid-run zero-downtime hot-swap and gauge-driven
+    autoscaling.
+
+    Timeline (all on the event-driven client — no per-stream thread):
+
+    1. publish alpha v1 + beta v1 into a ModelRegistry, deploy both
+       behind a FleetServer (full warmup grids), front with a
+       FleetRouter;
+    2. a probe burst against the deliberately-undersized beta backs its
+       queue up; the FleetAutoscaler reads the per-model queue-depth /
+       pool gauges and resizes beta through the swap machinery (same
+       version — parity preserved across the resize);
+    3. the main flood: `--fleet-streams` requests alternating
+       alpha/beta, all outstanding at once (a sampler thread records
+       peak simultaneously-open streams);
+    4. MID-FLOOD, publish alpha v2 and swap in a background thread:
+       the successor warms its full program grid while v1 still
+       serves, the pointer flips, and post-flip admissions (submitted
+       while the v1 incumbent is still draining its in-flight
+       streams) measure the swap-window TTFT — warmed successor means
+       no compile cliff;
+    5. await every stream: ZERO drops, and every stream checks
+       bit-equal against the reference of the version it was SERVED by
+       (the version tag the router stamps).
+
+    Returns (fleet_block, failures)."""
+    import tempfile
+
+    from deeplearning4j_tpu.serving import (
+        FleetAutoscaler,
+        FleetRouter,
+        FleetServer,
+        ModelRegistry,
+    )
+    from deeplearning4j_tpu.zoo.transformer import generate
+
+    n_tok = args.fleet_tokens
+    prompt_len = 6
+    max_len = prompt_len + n_tok + 8
+    max_len += (-max_len) % 8                     # block_len 8 divides
+    mk = lambda seed: build_net(args.vocab, args.fleet_d_model, 1,
+                                args.n_heads, max_len, seed=seed)
+    alpha_v1, alpha_v2, beta_v1 = mk(21), mk(22), mk(23)
+
+    rng = np.random.default_rng(7)
+    distinct = [rng.integers(0, args.vocab, prompt_len)
+                for _ in range(16)]
+    refs = {}
+    for key, net in (("alpha", alpha_v1), ("alpha2", alpha_v2),
+                     ("beta", beta_v1)):
+        refs[key] = generate(net, np.stack(distinct), n_tok,
+                             temperature=0)
+
+    root = tempfile.mkdtemp(prefix="fleet-registry-")
+    registry = ModelRegistry(root, keep_last=2)
+    registry.publish("alpha", alpha_v1)
+    registry.publish("beta", beta_v1)
+    fleet = FleetServer(registry)
+    router = FleetRouter(fleet)
+    bps = -(-(prompt_len + n_tok) // 8)
+    slots = args.n_slots
+    t_deploy0 = time.monotonic()
+    fleet.deploy("alpha", n_slots=slots, n_blocks=slots * bps + 1,
+                 block_len=8, steps_per_dispatch=args.steps_per_dispatch,
+                 warmup_prompt_len=prompt_len)
+    # beta starts at HALF capacity — the autoscaler's job to fix
+    beta_slots = max(2, slots // 2)
+    fleet.deploy("beta", n_slots=beta_slots,
+                 n_blocks=beta_slots * bps + 1, block_len=8,
+                 steps_per_dispatch=args.steps_per_dispatch,
+                 warmup_prompt_len=prompt_len)
+    deploy_s = time.monotonic() - t_deploy0
+    scaler = FleetAutoscaler(fleet, queue_depth_high=beta_slots * 2,
+                             factor=2, max_slots=slots,
+                             max_blocks=slots * bps + 1)
+
+    failures = []
+    streams = []          # (stream, model, ref_idx)
+
+    def submit(model, i, n=n_tok):
+        s = router.submit(model, distinct[i % 16], n)
+        streams.append((s, model, i % 16))
+        return s
+
+    # ---- autoscale probe: back beta's queue up, let the gauges scale it
+    probe = [submit("beta", i) for i in range(beta_slots * 4)]
+    fleet.publish_gauges()
+    decisions = scaler.check(["beta"])
+    if not decisions:
+        failures.append("autoscaler did not react to beta queue "
+                        "pressure")
+        autoscale = {"triggered": False}
+    else:
+        d = decisions[0]
+        autoscale = {"triggered": True, "reason": d["reason"],
+                     "before_slots": d["before"]["n_slots"],
+                     "after_slots": d["after"]["n_slots"]}
+        if d["after"]["n_slots"] <= d["before"]["n_slots"]:
+            failures.append(f"autoscale did not grow beta: {d}")
+
+    # ---- concurrency sampler (peak simultaneously-open streams)
+    sustained = [0]
+    sampling = [True]
+
+    def sample():
+        while sampling[0]:
+            open_now = sum(1 for s, _, _ in streams
+                           if not s._fut.done())
+            if open_now > sustained[0]:
+                sustained[0] = open_now
+            time.sleep(0.005)
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+
+    # ---- main flood across both models
+    t0 = time.monotonic()
+    for i in range(args.fleet_streams):
+        submit("alpha" if i % 2 == 0 else "beta", i)
+
+    # ---- mid-flood hot-swap: publish v2, warm + flip in background
+    registry.publish("alpha", alpha_v2)
+    swap_info = {}
+    swap_done = threading.Event()
+
+    def do_swap():
+        ts = time.monotonic()
+        try:
+            swap_info["version"] = fleet.swap("alpha")
+        except Exception as e:  # noqa: BLE001 — surfaced via failures
+            swap_info["error"] = repr(e)
+        swap_info["seconds"] = round(time.monotonic() - ts, 3)
+        swap_done.set()
+
+    threading.Thread(target=do_swap, daemon=True).start()
+    # wait for the POINTER FLIP (not the drain): post-flip admissions
+    # go to the warmed v2 successor while v1 still decodes its backlog.
+    # Production traffic keeps ARRIVING while the successor warms — a
+    # steady trickle holds a floor of open alpha streams until the
+    # flip, so the flip always lands mid-traffic (at smoke scale the
+    # one-shot flood can drain faster than a full warmup grid
+    # compiles; at full scale the flood itself outlasts the warmup and
+    # the trickle submits little or nothing)
+    trickle_floor, t_i = 32, 0
+    while fleet.version("alpha") != 2 and not swap_done.is_set():
+        open_alpha = sum(1 for s, m, _ in streams
+                         if m == "alpha" and not s._fut.done())
+        if open_alpha < trickle_floor:
+            for _ in range(trickle_floor - open_alpha):
+                submit("alpha", t_i)
+                t_i += 1
+        time.sleep(0.005)
+    inflight_at_flip = sum(1 for s, m, _ in streams
+                           if m == "alpha" and not s._fut.done())
+    post_swap = [submit("alpha", i) for i in range(args.fleet_post_swap)]
+
+    # ---- await everything: the zero-dropped-streams contract
+    errors = 0
+    for s, _, _ in streams:
+        try:
+            s.result(timeout=900)
+        except Exception as e:  # noqa: BLE001 — counted, reported below
+            errors += 1
+            if errors <= 3:
+                failures.append(f"fleet stream failed: {e!r}")
+    wall = time.monotonic() - t0
+    sampling[0] = False
+    sampler.join(timeout=5)
+    swap_done.wait(timeout=900)
+    if "error" in swap_info:
+        failures.append(f"hot-swap failed: {swap_info['error']}")
+
+    # ---- version-tagged parity: each stream vs the reference of the
+    # version it was served by
+    bad = 0
+    for s, model, ri in streams:
+        if s._fut.exception(timeout=0) is not None:
+            continue
+        key = model if getattr(s, "version", 1) == 1 else "alpha2"
+        if not np.array_equal(np.asarray(s.result(timeout=0), np.int64),
+                              np.asarray(refs[key][ri], np.int64)):
+            bad += 1
+    v1_alpha = sum(1 for s, m, _ in streams
+                   if m == "alpha" and getattr(s, "version", 0) == 1)
+    v2_alpha = sum(1 for s, m, _ in streams
+                   if m == "alpha" and getattr(s, "version", 0) == 2)
+    ttft = np.asarray([(s.t_first - s.t_submit) * 1e3
+                       for s, _, _ in streams
+                       if s.t_first is not None])
+    # NB: streams[-0:] would be the WHOLE list — guard the empty case
+    post_tail = streams[-len(post_swap):] if post_swap else []
+    post_ttft = np.asarray([(s.t_first - s.t_submit) * 1e3
+                            for s, _, _ in post_tail
+                            if s.t_first is not None])
+    swap_p50, swap_p99 = (np.percentile(post_ttft, [50, 99])
+                          if post_ttft.size else (float("nan"),) * 2)
+    total_emitted = sum(len(s.tokens) for s, _, _ in streams)
+
+    fleet_block = {
+        "models": 2,
+        "streams_total": len(streams),
+        "streams_sustained": int(sustained[0]),
+        "n_tokens": n_tok,
+        "tokens_emitted": int(total_emitted),
+        "tokens_per_sec": round(total_emitted / wall, 2),
+        "wall_seconds": round(wall, 3),
+        "deploy_warmup_seconds": round(deploy_s, 3),
+        "zero_dropped": errors == 0,
+        "parity_version_tagged": "exact" if bad == 0 else
+            f"BROKEN ({bad} streams)",
+        "swap": {
+            "from_version": 1, "to_version": swap_info.get("version"),
+            "inflight_at_flip": int(inflight_at_flip),
+            "alpha_streams_v1": v1_alpha, "alpha_streams_v2": v2_alpha,
+            "seconds": swap_info.get("seconds"),
+            "post_swap_streams": len(post_swap),
+        },
+        "swap_p50_ttft_ms": round(float(swap_p50), 1),
+        "swap_p99_ttft_ms": round(float(swap_p99), 1),
+        "p99_ttft_ms": round(float(np.percentile(ttft, 99)), 1)
+            if ttft.size else None,
+        "autoscale": autoscale,
+    }
+
+    # ---- hard asserts
+    if errors:
+        failures.append(f"{errors} fleet streams dropped/failed — the "
+                        f"zero-dropped-streams contract is broken")
+    if bad:
+        failures.append(f"{bad} fleet streams broke version-tagged "
+                        f"parity")
+    if sustained[0] < args.fleet_min_sustained:
+        failures.append(
+            f"fleet sustained only {sustained[0]} concurrent streams "
+            f"(< {args.fleet_min_sustained})")
+    if inflight_at_flip < 1:
+        failures.append("hot-swap was not mid-run: no alpha stream was "
+                        "in flight at the pointer flip")
+    if v2_alpha < 1:
+        failures.append("no stream was served by alpha v2 post-swap")
+    if post_ttft.size and swap_p99 > args.max_p99_ttft_s * 1e3:
+        failures.append(
+            f"post-swap p99 TTFT {swap_p99:.0f}ms exceeds the "
+            f"{args.max_p99_ttft_s}s bound (compile cliff? the "
+            f"successor must be warmed before the flip)")
+
+    if metrics_check:
+        # the [12/12] acceptance surface: the fleet/registry gauge
+        # families must be live on /metrics
+        import urllib.request
+
+        from deeplearning4j_tpu.ui import UIServer
+        fleet.publish_gauges()
+        ui = UIServer().start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/metrics",
+                timeout=10).read().decode()
+            for fam in ("fleet_active_models", "fleet_queue_depth",
+                        "fleet_model_version", "fleet_swaps_total",
+                        "registry_published_total"):
+                if fam not in body:
+                    failures.append(f"{fam} missing from /metrics")
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/serving",
+                timeout=10).read().decode()
+            if "alpha" not in page or "beta" not in page:
+                failures.append("/serving page lacks per-model rows")
+        finally:
+            ui.stop()
+
+    fleet.stop()
+    return fleet_block, failures
+
+
 def run_overload(net, prompts, n_tokens, *, block_len):
     """Deliberate overload: a 1-slot, minimum-pool server with a tiny
     queue cap + SLO takes a burst it cannot possibly serve — the
@@ -209,8 +487,47 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="verify.sh scale: smaller model, same >=64 "
                          "streams, same hard asserts")
+    ap.add_argument("--fleet-streams", type=int, default=12288,
+                    help="main-flood streams for the fleet phase "
+                         "(split across 2 models; >10k concurrent is "
+                         "the acceptance bar)")
+    ap.add_argument("--fleet-tokens", type=int, default=32)
+    ap.add_argument("--fleet-post-swap", type=int, default=512,
+                    help="admissions submitted right after the swap "
+                         "pointer flip (the swap-window TTFT sample)")
+    ap.add_argument("--fleet-d-model", type=int, default=16,
+                    help="fleet-phase models are deliberately tiny — "
+                         "the phase measures the deployment plane "
+                         "(streams/swap/scale), not model speed")
+    ap.add_argument("--fleet-min-sustained", type=int, default=10000)
+    ap.add_argument("--skip-fleet", action="store_true",
+                    help="run only the single-server phases 1-3")
+    ap.add_argument("--fleet-smoke", action="store_true",
+                    help="verify.sh [12/12]: ONLY the fleet phase at "
+                         "smoke scale, plus the /metrics + /serving "
+                         "acceptance checks")
     ap.add_argument("--out", default="BENCH_serving.json")
     args = ap.parse_args(argv)
+    if args.smoke or args.fleet_smoke:
+        args.fleet_streams = 256
+        args.fleet_tokens = 16
+        args.fleet_post_swap = 64
+        args.fleet_min_sustained = 128
+    if args.fleet_smoke:
+        from deeplearning4j_tpu import monitor
+        monitor.enable()
+        fleet_block, failures = run_fleet(args, metrics_check=True)
+        print(json.dumps({"serving_fleet": fleet_block}, indent=2,
+                         sort_keys=True))
+        if failures:
+            for f_ in failures:
+                print(f"FAIL: {f_}", file=sys.stderr)
+            return 1
+        print(f"fleet smoke OK ({fleet_block['streams_sustained']} "
+              f"concurrent streams, swap p99 TTFT "
+              f"{fleet_block['swap_p99_ttft_ms']}ms, autoscale "
+              f"{fleet_block['autoscale']})")
+        return 0
     if args.smoke:
         # still >= 64 streams and every hard assert; smaller model and
         # shorter streams, but long enough that decode (where
@@ -303,6 +620,10 @@ def main(argv=None):
     shed, served = run_overload(net, prompts, args.n_tokens,
                                 block_len=args.block_len)
 
+    # --------------------------- phase 4: multi-model fleet + hot-swap
+    fleet_block, fleet_failures = (
+        ({}, []) if args.skip_fleet else run_fleet(args))
+
     record = {
         "kind": "serving_loadtest",
         "platform": "cpu-sandbox",
@@ -354,6 +675,8 @@ def main(argv=None):
             },
         },
     }
+    if fleet_block:
+        record["extras"]["serving_fleet"] = fleet_block
     with open(args.out, "w") as f:
         json.dump(record, f, indent=2, sort_keys=True)
     s = record["extras"]["serving"]
@@ -372,6 +695,17 @@ def main(argv=None):
           f"{q['admitted_incremental']} vs {q['admitted_upfront']} "
           f"upfront | parity {q['greedy_parity_vs_quantized_generate']}")
     print(f"overload shed {shed}/{shed + served}")
+    if fleet_block:
+        fb = fleet_block
+        print(f"phase4 (fleet): {fb['streams_total']} streams over "
+              f"{fb['models']} models, sustained "
+              f"{fb['streams_sustained']} concurrent | "
+              f"{fb['tokens_per_sec']} tok/s | swap v1->v"
+              f"{fb['swap']['to_version']} with "
+              f"{fb['swap']['inflight_at_flip']} in flight, post-swap "
+              f"p99 TTFT {fb['swap_p99_ttft_ms']}ms | autoscale "
+              f"{fb['autoscale']} | parity "
+              f"{fb['parity_version_tagged']}")
     print(f"ledger -> {args.out}")
 
     failures = []
@@ -403,6 +737,7 @@ def main(argv=None):
         failures.append("mixed phase degenerated to one prompt length")
     if shed < 1:
         failures.append("overload phase shed nothing")
+    failures.extend(fleet_failures)
     if failures:
         for f_ in failures:
             print(f"FAIL: {f_}", file=sys.stderr)

@@ -1,0 +1,689 @@
+"""Fleet serving: versioned ModelRegistry, zero-downtime hot-swap,
+router request plane, autoscaling.
+
+The spine of this suite is the VERSION-TAGGED parity contract
+(docs/SERVING.md "Fleet"): during a hot-swap, streams admitted on
+version v finish bit-equal to an unswapped v reference (they complete
+on the old weights), post-swap admissions are bit-equal to the v+1
+reference, and ZERO streams are dropped or reset — plus the registry
+durability contracts (one-winner publish, corrupt-zip fallback,
+retention that never collects the served version).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.fault.errors import CheckpointCorruptError
+from deeplearning4j_tpu.serving import (
+    FleetAutoscaler,
+    FleetClient,
+    FleetRouter,
+    FleetServer,
+    GenerationServer,
+    ModelRegistry,
+    ServerDrainingError,
+    ServerStoppedError,
+    ShedError,
+    UnknownModelError,
+    VersionConflictError,
+)
+from deeplearning4j_tpu.zoo.transformer import TransformerLM, generate
+
+V, D, HEADS, LAYERS, MAXLEN = 23, 16, 4, 2, 16
+BL = 4
+
+
+def tiny_lm(seed=3):
+    return TransformerLM(vocab_size=V, d_model=D, n_layers=LAYERS,
+                         n_heads=HEADS, max_len=MAXLEN, seed=seed).init()
+
+
+@pytest.fixture(scope="module")
+def net_v1():
+    return tiny_lm(seed=3)
+
+
+@pytest.fixture(scope="module")
+def net_v2():
+    return tiny_lm(seed=9)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return np.random.default_rng(5).integers(0, V, (8, 3))
+
+
+@pytest.fixture(scope="module")
+def ref_v1(net_v1, prompts):
+    return generate(net_v1, prompts, 6, temperature=0)
+
+
+@pytest.fixture(scope="module")
+def ref_v2(net_v2, prompts):
+    return generate(net_v2, prompts, 6, temperature=0)
+
+
+def tiny_mlp(seed=7):
+    """Cheap non-transformer model for registry-only tests."""
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _corrupt(path, offset_frac=0.5, n=64):
+    data = bytearray(path.read_bytes())
+    mid = int(len(data) * offset_frac)
+    for i in range(mid, min(mid + n, len(data))):
+        data[i] ^= 0xFF
+    path.write_bytes(data)
+
+
+def _params_equal(a, b):
+    import jax
+    la = jax.tree_util.tree_leaves(a.params)
+    lb = jax.tree_util.tree_leaves(b.params)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+# ======================================================== ModelRegistry
+class TestModelRegistry:
+    def test_publish_resolve_roundtrip(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        net = tiny_mlp()
+        v = reg.publish("mlp", net)
+        assert v == 1
+        assert reg.versions("mlp") == [1]
+        assert reg.models() == ["mlp"]
+        restored, rv = reg.resolve("mlp")
+        assert rv == 1 and _params_equal(restored, net)
+        x = np.random.default_rng(0).standard_normal((4, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(restored.output(x)),
+                                   np.asarray(net.output(x)), rtol=1e-6)
+
+    def test_auto_versions_monotonic(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        net = tiny_mlp()
+        assert [reg.publish("m", net) for _ in range(3)] == [1, 2, 3]
+        assert reg.latest("m") == 3
+
+    def test_explicit_version_conflict_one_winner(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        a, b = tiny_mlp(seed=1), tiny_mlp(seed=2)
+        reg.publish("m", a, version=7)
+        with pytest.raises(VersionConflictError, match="v7"):
+            reg.publish("m", b, version=7)
+        restored, _ = reg.resolve("m", 7)
+        assert _params_equal(restored, a) and not _params_equal(restored, b)
+
+    def test_concurrent_same_version_exactly_one_winner(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        nets = [tiny_mlp(seed=s) for s in (1, 2, 3, 4)]
+        outcomes = [None] * 4
+        barrier = threading.Barrier(4)
+
+        def pub(i):
+            barrier.wait()
+            try:
+                reg.publish("m", nets[i], version=5)
+                outcomes[i] = "won"
+            except VersionConflictError:
+                outcomes[i] = "lost"
+            except Exception as e:  # noqa: BLE001 — a loser crashing
+                # any other way (e.g. its tmp GC'd mid-claim) breaks
+                # the one-winner contract
+                outcomes[i] = f"crashed: {e!r}"
+
+        threads = [threading.Thread(target=pub, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # every publisher gets a DEFINED outcome: one winner, the
+        # rest the NAMED conflict error — never a crash
+        assert outcomes.count("won") == 1, outcomes
+        assert outcomes.count("lost") == 3, outcomes
+        winner = nets[outcomes.index("won")]
+        restored, _ = reg.resolve("m", 5)
+        assert _params_equal(restored, winner)
+        # no tmp orphans left behind
+        assert not list(reg.model_dir("m").glob(".publish-*"))
+
+    def test_corrupt_latest_falls_back_with_warning(self, tmp_path,
+                                                    caplog):
+        reg = ModelRegistry(tmp_path)
+        a, b = tiny_mlp(seed=1), tiny_mlp(seed=2)
+        reg.publish("m", a)
+        reg.publish("m", b)
+        _corrupt(reg.path("m", 2))
+        import logging
+        with caplog.at_level(logging.WARNING,
+                             logger="deeplearning4j_tpu.serving.registry"):
+            restored, v = reg.resolve("m")
+        assert v == 1 and _params_equal(restored, a)
+        assert any("corrupt" in r.message and "falling back" in r.message
+                   for r in caplog.records)
+
+    def test_explicit_corrupt_version_raises(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        reg.publish("m", tiny_mlp(seed=1))
+        reg.publish("m", tiny_mlp(seed=2))
+        _corrupt(reg.path("m", 2))
+        # an explicit pin must fail hard, never silently substitute
+        with pytest.raises(CheckpointCorruptError):
+            reg.resolve("m", 2)
+        # latest still works via fallback
+        assert reg.resolve("m")[1] == 1
+
+    def test_all_corrupt_raises_naming_candidates(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        reg.publish("m", tiny_mlp(seed=1))
+        reg.publish("m", tiny_mlp(seed=2))
+        _corrupt(reg.path("m", 1))
+        _corrupt(reg.path("m", 2))
+        with pytest.raises(CheckpointCorruptError) as ei:
+            reg.resolve("m")
+        assert "v1" in str(ei.value) and "v2" in str(ei.value)
+
+    def test_retention_keep_last_and_keep_every(self, tmp_path):
+        reg = ModelRegistry(tmp_path, keep_last=2, keep_every=3)
+        net = tiny_mlp()
+        for _ in range(7):
+            reg.publish("m", net)
+        # newest 2 {6,7} + every 3rd {3,6}
+        assert reg.versions("m") == [3, 6, 7]
+
+    def test_retention_never_deletes_pinned(self, tmp_path):
+        reg = ModelRegistry(tmp_path, keep_last=1)
+        net = tiny_mlp()
+        reg.publish("m", net)
+        reg.pin("m", 1)           # the currently-served version
+        for _ in range(4):
+            reg.publish("m", net)
+        assert 1 in reg.versions("m")        # survived 4 GC passes
+        assert reg.versions("m") == [1, 5]
+        reg.unpin("m", 1)                    # unpin sweeps
+        assert reg.versions("m") == [5]
+
+    def test_pin_markers_protect_across_registry_instances(self,
+                                                           tmp_path):
+        """The checkpoint-as-publish layout: a trainer PROCESS runs
+        retention over the same root a serving process reads — its
+        in-memory pin set is empty, so the serving process's pins must
+        ride on-disk markers or GC deletes live weights."""
+        serving = ModelRegistry(tmp_path, keep_last=1)
+        net = tiny_mlp()
+        serving.publish("m", net)
+        serving.pin("m", 1)                   # the served version
+        # the "trainer process": a separate instance, no in-memory pins
+        trainer = ModelRegistry(tmp_path, keep_last=1)
+        for _ in range(3):
+            trainer.publish("m", net)
+        assert 1 in trainer.versions("m")     # marker protected it
+        serving.unpin("m", 1)
+        trainer.publish("m", net)
+        assert 1 not in trainer.versions("m")
+
+    def test_stale_pin_marker_from_dead_pid_is_swept(self, tmp_path):
+        reg = ModelRegistry(tmp_path, keep_last=1)
+        net = tiny_mlp()
+        reg.publish("m", net)
+        # forge a marker from a long-dead process
+        (reg.model_dir("m") / ".pin-v1.999999999").touch()
+        for _ in range(2):
+            reg.publish("m", net)
+        assert reg.versions("m") == [3]       # stale marker ignored
+        assert not list(reg.model_dir("m").glob(".pin-v1.*"))
+
+    def test_resolve_missing(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        with pytest.raises(FileNotFoundError, match="no published"):
+            reg.resolve("ghost")
+        reg.publish("m", tiny_mlp())
+        with pytest.raises(FileNotFoundError, match="v9"):
+            reg.resolve("m", 9)
+        with pytest.raises(ValueError, match="invalid model name"):
+            reg.publish("../escape", tiny_mlp())
+
+    def test_publish_listener_checkpoint_as_publish(self, tmp_path):
+        """The one-liner: attach `registry.publish_listener(...)` to a
+        fit loop and every N steps becomes a served release."""
+        reg = ModelRegistry(tmp_path)
+        net = tiny_mlp()
+        listener = reg.publish_listener("mlp", frequency=4)
+        net.add_listener(listener)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        net.fit(x, y, epochs=1, batch_size=4)            # 8 steps
+        assert len(listener.published_versions) >= 2     # step 4, 8
+        restored, v = reg.resolve("mlp")
+        assert v == reg.latest("mlp")
+        # the final publish carries the POST-fit params
+        assert _params_equal(restored, net)
+
+
+# ====================================================== drain lifecycle
+class TestDrainAndLifecycle:
+    def test_drain_finishes_inflight_blocks_admissions(self, net_v1,
+                                                       prompts, ref_v1):
+        srv = GenerationServer(net_v1, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            streams = [srv.generate_async(prompts[i], 6)
+                       for i in range(4)]
+            assert srv.drain(timeout=120) is True
+            # every already-submitted stream finished, bit-equal
+            got = np.stack([s.result(timeout=0) for s in streams])
+            np.testing.assert_array_equal(got, ref_v1[:4])
+            assert srv.open_streams == 0
+            # admissions are closed with the NAMED error
+            with pytest.raises(ServerDrainingError):
+                srv.generate_async(prompts[0], 6)
+        finally:
+            srv.stop()
+
+    def test_drain_idle_server_immediate(self, net_v1):
+        srv = GenerationServer(net_v1, n_slots=1, n_blocks=8,
+                               block_len=BL).start()
+        try:
+            assert srv.drain(timeout=5) is True
+        finally:
+            srv.stop()
+
+    def test_stop_idempotent(self, net_v1, prompts):
+        srv = GenerationServer(net_v1, n_slots=1, n_blocks=8,
+                               block_len=BL).start()
+        s = srv.generate_async(prompts[0], 6)
+        srv.stop()
+        srv.stop()                       # second stop: clean no-op
+        srv.shutdown()                   # and shutdown after stop too
+        with pytest.raises(RuntimeError):
+            s.result(timeout=10)
+
+    def test_start_after_stop_raises_named_error(self, net_v1):
+        srv = GenerationServer(net_v1, n_slots=1, n_blocks=8,
+                               block_len=BL).start()
+        srv.stop()
+        with pytest.raises(ServerStoppedError, match="fresh server"):
+            srv.start()
+        # and the scheduler thread was NOT restarted by the attempt
+        assert srv._collector is None and not srv._running
+
+
+# ============================================================= hot-swap
+class TestHotSwap:
+    def test_swap_zero_drop_version_parity(self, tmp_path, net_v1,
+                                           net_v2, prompts, ref_v1,
+                                           ref_v2):
+        """The fleet acceptance drill at test scale: in-flight v1
+        streams finish bit-equal to the unswapped v1 reference,
+        post-swap admissions match v2, nothing drops."""
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        router = FleetRouter(fleet)
+        try:
+            assert fleet.deploy("lm", n_slots=2, n_blocks=16,
+                                block_len=BL) == 1
+            pre = [router.submit("lm", prompts[i], 6) for i in range(6)]
+            assert {s.version for s in pre} == {1}
+            reg.publish("lm", net_v2)
+            assert fleet.swap("lm") == 2
+            post = [router.submit("lm", prompts[i], 6)
+                    for i in range(6)]
+            assert {s.version for s in post} == {2}
+            got_pre = np.stack([s.result(timeout=120) for s in pre])
+            got_post = np.stack([s.result(timeout=120) for s in post])
+        finally:
+            fleet.stop()
+        np.testing.assert_array_equal(got_pre, ref_v1[:6])
+        np.testing.assert_array_equal(got_post, ref_v2[:6])
+
+    def test_swap_pins_served_and_unpins_old(self, tmp_path, net_v1,
+                                             net_v2):
+        reg = ModelRegistry(tmp_path, keep_last=1)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            assert ("lm", 1) in reg.pinned()
+            # keep_last=1 would GC v1 on the v2 publish — the pin is
+            # what keeps the SERVED version's zip alive
+            reg.publish("lm", net_v2)
+            assert 1 in reg.versions("lm")
+            fleet.swap("lm")
+            assert ("lm", 2) in reg.pinned()
+            assert ("lm", 1) not in reg.pinned()
+            # unpinned v1 is collectable now
+            assert reg.versions("lm") == [2]
+        finally:
+            fleet.stop()
+        assert reg.pinned() == set()
+
+    def test_scale_resize_keeps_parity(self, tmp_path, net_v1, prompts,
+                                       ref_v1):
+        """Autoscale's primitive: same-version resize through the swap
+        machinery — streams before and after all parity-exact."""
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        router = FleetRouter(fleet)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            pre = [router.submit("lm", prompts[i], 6) for i in range(3)]
+            rec = fleet.scale("lm", n_slots=4, n_blocks=16)
+            assert rec["before"]["n_slots"] == 1
+            assert rec["after"]["n_slots"] == 4
+            assert fleet.server("lm").engine.n_slots == 4
+            assert fleet.version("lm") == 1          # same weights
+            post = [router.submit("lm", prompts[3 + i], 6)
+                    for i in range(3)]
+            got = np.stack([s.result(timeout=120)
+                            for s in pre + post])
+        finally:
+            fleet.stop()
+        np.testing.assert_array_equal(got, ref_v1[:6])
+
+    def test_deploy_duplicate_and_swap_unknown(self, tmp_path, net_v1):
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            with pytest.raises(ValueError, match="already deployed"):
+                fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            with pytest.raises(KeyError, match="ghost"):
+                fleet.swap("ghost")
+        finally:
+            fleet.stop()
+
+
+# =============================================================== router
+class TestFleetRouter:
+    def test_unknown_model_names_known(self, tmp_path, net_v1):
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        router = FleetRouter(fleet)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            with pytest.raises(UnknownModelError, match="lm"):
+                router.submit("ghost", np.zeros(3, np.int32), 4)
+        finally:
+            fleet.stop()
+
+    def test_weighted_shedding(self, tmp_path, net_v1, prompts):
+        """Fleet-wide pressure: the low-weight model's projected delay
+        exceeds ITS weighted budget while the high-weight model keeps
+        admitting — weighted SLO shedding across models."""
+        reg = ModelRegistry(tmp_path)
+        reg.publish("hi", net_v1)
+        reg.publish("lo", net_v1)
+        fleet = FleetServer(reg)
+        router = FleetRouter(fleet, slo_ttft_s=0.05,
+                             weights={"hi": 1e6, "lo": 1e-9})
+        try:
+            fleet.deploy("hi", n_slots=1, n_blocks=8, block_len=BL)
+            fleet.deploy("lo", n_slots=1, n_blocks=8, block_len=BL)
+            # prime both EWMA estimators
+            for n in ("hi", "lo"):
+                router.submit(n, prompts[0], 6).result(timeout=120)
+
+            def flood(name, k=6):
+                streams, sheds = [], 0
+                for i in range(k):
+                    try:
+                        streams.append(
+                            router.submit(name, prompts[i % 8], 12))
+                    except ShedError as e:
+                        assert "weighted" in str(e)
+                        sheds += 1
+                return streams, sheds
+
+            # the SAME burst against both models: hi's budget
+            # (slo * 1e6 seconds) is unmissable, lo's (slo * 1e-9)
+            # unmeetable once anything is outstanding — low-weight
+            # models shed first under identical pressure
+            hi_streams, hi_sheds = flood("hi")
+            lo_streams, lo_sheds = flood("lo")
+            assert hi_sheds == 0 and len(hi_streams) == 6
+            assert lo_sheds >= 1
+            for s in hi_streams + lo_streams:
+                s.result(timeout=120)
+        finally:
+            fleet.stop()
+
+    def test_max_queue_backstop(self, tmp_path, net_v1, prompts):
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        router = FleetRouter(fleet, max_queue=1)
+        try:
+            # pool fits ONE sequence: later submits queue
+            fleet.deploy("lm", n_slots=4, n_blocks=4, block_len=BL)
+            streams = [router.submit("lm", prompts[0], 6)]
+            shed = 0
+            for _ in range(8):
+                try:
+                    streams.append(router.submit("lm", prompts[0], 6))
+                except ShedError:
+                    shed += 1
+            for s in streams:
+                s.result(timeout=120)
+        finally:
+            fleet.stop()
+        assert shed >= 1
+
+
+# ======================================================== request plane
+class TestRequestPlane:
+    def test_wire_roundtrip(self):
+        from deeplearning4j_tpu.serving import wire
+        prompt = np.arange(5, dtype=np.int64)
+        data = wire.encode_request("lm", "rid1", prompt, 8,
+                                   temperature=0.5, top_p=0.9,
+                                   rng=np.asarray([1, 2], np.uint32))
+        header, p = wire.decode_request(data)
+        np.testing.assert_array_equal(p, prompt)
+        assert header["model"] == "lm" and header["n_tokens"] == 8
+        assert header["temperature"] == 0.5 and header["top_p"] == 0.9
+        np.testing.assert_array_equal(header["rng"],
+                                      np.asarray([1, 2], np.uint32))
+        rep = wire.encode_reply("rid1", 3, [7, 8, 9], done=True,
+                                model="lm", version=2)
+        rh, toks = wire.decode_reply(rep)
+        assert rh["seq"] == 3 and rh["done"] and rh["version"] == 2
+        np.testing.assert_array_equal(toks, [7, 8, 9])
+        assert wire.reply_error(rh) is None
+        # error rehydration preserves the shed/failure split
+        rep = wire.encode_reply("rid1", 0, None, done=True,
+                                error=ShedError("too busy"))
+        rh, _ = wire.decode_reply(rep)
+        assert isinstance(wire.reply_error(rh), ShedError)
+        with pytest.raises(ValueError, match="DLFQ"):
+            wire.decode_request(rep)
+
+    def test_remote_client_end_to_end(self, tmp_path, net_v1, prompts,
+                                      ref_v1):
+        """Clients hold a transport, never a server reference: request
+        + streamed tokens ride the ndarray wire format end to end."""
+        from deeplearning4j_tpu.streaming import LocalQueueTransport
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        tr = LocalQueueTransport()
+        router = FleetRouter(fleet, transport=tr)
+        try:
+            fleet.deploy("lm", n_slots=2, n_blocks=16, block_len=BL)
+            router.serve()
+            client = FleetClient(tr)
+            remote = [client.generate("lm", prompts[i], 6)
+                      for i in range(4)]
+            got = np.stack([r.result(timeout=120) for r in remote])
+            np.testing.assert_array_equal(got, ref_v1[:4])
+            assert {r.version for r in remote} == {1}
+            # iterator face streams too
+            it = list(client.generate("lm", prompts[0], 6))
+            assert it == list(ref_v1[0])
+            # unknown model fails remotely with the router's error
+            bad = client.generate("ghost", prompts[0], 4)
+            with pytest.raises(RuntimeError, match="ghost"):
+                bad.result(timeout=60)
+        finally:
+            router.stop()
+            fleet.stop()
+
+    def test_remote_shed_crosses_wire_as_shed(self, tmp_path, net_v1,
+                                              prompts):
+        from deeplearning4j_tpu.streaming import LocalQueueTransport
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        tr = LocalQueueTransport()
+        router = FleetRouter(fleet, transport=tr, max_queue=0)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            router.serve()
+            remote = FleetClient(tr).generate("lm", prompts[0], 6)
+            with pytest.raises(ShedError):
+                remote.result(timeout=60)
+        finally:
+            router.stop()
+            fleet.stop()
+
+
+# =========================================================== autoscaler
+class TestAutoscaler:
+    def test_scales_up_on_queue_pressure_zero_drop(self, tmp_path,
+                                                   net_v1, prompts,
+                                                   ref_v1):
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        monitor.enable(registry=MetricsRegistry())
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        router = FleetRouter(fleet)
+        scaler = FleetAutoscaler(fleet, queue_depth_high=2, factor=4,
+                                 max_slots=4, max_blocks=32)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            # a backlog deeper than queue_depth_high
+            streams = [router.submit("lm", prompts[i % 8], 6)
+                       for i in range(8)]
+            fleet.publish_gauges()       # the decision's signal plane
+            made = scaler.check()
+            assert len(made) == 1
+            assert made[0]["after"]["n_slots"] == 4
+            assert "queue_depth" in made[0]["reason"]
+            assert fleet.server("lm").engine.n_slots == 4
+            # the resize dropped nothing and kept parity
+            got = np.stack([s.result(timeout=120) for s in streams])
+            np.testing.assert_array_equal(
+                got, np.stack([ref_v1[i % 8] for i in range(8)]))
+            # cap respected: pressure again cannot exceed max_slots
+            fleet.publish_gauges()
+            assert scaler.check() == []
+        finally:
+            fleet.stop()
+            monitor.disable()
+
+    def test_idle_fleet_never_scales(self, tmp_path, net_v1):
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        scaler = FleetAutoscaler(fleet, queue_depth_high=2)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            assert scaler.check() == []
+            assert fleet.server("lm").engine.n_slots == 1
+        finally:
+            fleet.stop()
+
+
+# ======================================================= UI + bench gate
+class TestFleetObservability:
+    def test_serving_page_per_model_rows_and_metrics(self, tmp_path,
+                                                     net_v1, net_v2,
+                                                     prompts):
+        import urllib.request
+
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        from deeplearning4j_tpu.ui import UIServer
+
+        mreg = monitor.enable(registry=MetricsRegistry())
+        reg = ModelRegistry(tmp_path)
+        reg.publish("alpha", net_v1)
+        reg.publish("beta", net_v2)
+        fleet = FleetServer(reg)
+        router = FleetRouter(fleet)
+        ui = UIServer(registry=mreg).start()
+        try:
+            fleet.deploy("alpha", n_slots=1, n_blocks=8, block_len=BL)
+            fleet.deploy("beta", n_slots=2, n_blocks=8, block_len=BL)
+            router.submit("alpha", prompts[0], 4).result(timeout=120)
+            fleet.publish_gauges()
+            base = f"http://127.0.0.1:{ui.port}"
+            html = urllib.request.urlopen(base + "/serving",
+                                          timeout=10).read().decode()
+            # per-model rows: name, version, queue depth, active
+            # slots, shed — the fleet table
+            for frag in ("fleet", "alpha", "beta", "version",
+                         "queue depth", "active slots", "shed"):
+                assert frag in html, f"{frag!r} missing from /serving"
+            mtext = urllib.request.urlopen(base + "/metrics",
+                                           timeout=10).read().decode()
+            for fam in ("fleet_active_models", "fleet_queue_depth",
+                        "fleet_model_version", "fleet_streams_total",
+                        "registry_published_total"):
+                assert fam in mtext, f"{fam} missing from /metrics"
+            assert 'model="alpha"' in mtext
+            # undeploying zeroes a model's gauges (version=0 marks the
+            # row retired) and the page drops it — no stale
+            # live-looking rows for retired models
+            fleet.undeploy("beta")
+            html = urllib.request.urlopen(base + "/serving",
+                                          timeout=10).read().decode()
+            assert "alpha" in html
+            assert "<td>beta</td>" not in html
+        finally:
+            fleet.stop()
+            monitor.disable()
+            ui.stop()
+
+    def test_compare_bench_gates_fleet_metrics(self):
+        from deeplearning4j_tpu.bench import compare_bench
+
+        def rec(sustained, swap_p99, tps=20000.0):
+            return {"platform": "cpu-sandbox", "value": 100.0,
+                    "extras": {"serving_fleet": {
+                        "streams_sustained": sustained,
+                        "swap_p99_ttft_ms": swap_p99,
+                        "tokens_per_sec": tps}}}
+
+        base = rec(10240, 250.0)
+        assert compare_bench(rec(10200, 260.0), base)["status"] == "pass"
+        # a concurrency collapse gates (structural 5% band)
+        v = compare_bench(rec(6000, 250.0), base)
+        assert v["status"] == "regression"
+        assert any(r["metric"] == "fleet_streams_sustained"
+                   for r in v["regressions"])
+        # swap-window TTFT is lower-is-better: a compile-cliff RISE
+        # gates, a drop passes
+        v = compare_bench(rec(10240, 2500.0), base)
+        assert v["status"] == "regression"
+        assert any(r["metric"] == "fleet_swap_p99_ttft_ms"
+                   for r in v["regressions"])
+        assert compare_bench(rec(10240, 50.0), base)["status"] == "pass"

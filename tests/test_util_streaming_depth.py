@@ -76,6 +76,58 @@ class TestDiskBasedQueue:
             q.remove()
 
 
+class TestNDArrayWireDtypes:
+    """The request-plane payload contract: bf16 (serving activations /
+    mixed_bf16 wire) and int8 (quantized payloads) ride the ND4T wire
+    byte-exactly; an unknown dtype code fails NAMING the code."""
+
+    def test_bf16_roundtrip(self):
+        from ml_dtypes import bfloat16
+        from deeplearning4j_tpu.streaming.ndarray import (
+            deserialize_ndarray, serialize_ndarray)
+        a = np.random.default_rng(0).standard_normal(
+            (3, 5)).astype(bfloat16)
+        b = deserialize_ndarray(serialize_ndarray(a))
+        assert b.dtype == np.dtype(bfloat16)
+        assert b.tobytes() == a.tobytes()        # bit-exact, no up-cast
+
+    def test_int8_roundtrip(self):
+        from deeplearning4j_tpu.streaming.ndarray import (
+            deserialize_ndarray, serialize_ndarray)
+        a = np.random.default_rng(1).integers(
+            -128, 128, (4, 7), dtype=np.int8)
+        b = deserialize_ndarray(serialize_ndarray(a))
+        assert b.dtype == np.int8
+        np.testing.assert_array_equal(a, b)
+
+    def test_transport_carries_new_dtypes(self):
+        from ml_dtypes import bfloat16
+        tr = LocalQueueTransport()
+        pub = NDArrayPublisher(tr, "t")
+        sub = NDArrayConsumer(tr, "t")
+        for arr in (np.ones((2, 2), bfloat16) * 1.5,
+                    np.arange(-4, 4, dtype=np.int8)):
+            pub.publish(arr)
+            out = sub.consume(timeout=1.0)
+            assert out.dtype == arr.dtype
+            assert out.tobytes() == arr.tobytes()
+
+    def test_unknown_code_error_names_the_code(self):
+        import pytest
+        from deeplearning4j_tpu.streaming.ndarray import (
+            deserialize_ndarray, serialize_ndarray)
+        data = bytearray(serialize_ndarray(np.zeros(2, np.float32)))
+        data[4] = 250                       # forge a future dtype code
+        with pytest.raises(ValueError, match="code 250"):
+            deserialize_ndarray(bytes(data))
+
+    def test_unsupported_dtype_serialize_rejected(self):
+        import pytest
+        from deeplearning4j_tpu.streaming.ndarray import serialize_ndarray
+        with pytest.raises(TypeError, match="float16"):
+            serialize_ndarray(np.zeros(2, np.float16))
+
+
 def _trained_xor_net():
     x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
     y = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
