@@ -173,7 +173,16 @@ class AsyncDataSetIterator(DataSetIterator):
     the bounded `q.put` — the generator's finally clause signals the
     stop event, drains the queue so any in-flight put completes, and
     joins the worker, so no daemon thread (or its grip on the base
-    iterator) outlives the consumer."""
+    iterator) outlives the consumer.
+
+    Unbounded bases (online/iterator.py): the worker may be blocked
+    INSIDE the base's `next()` — a streaming iterator's watermark wait,
+    not the bounded put — where the stop event is invisible. Bases
+    expose an ``abandon()`` hook for exactly this; the teardown calls
+    it (when present) before joining, so the prefetch thread unblocks
+    within one poll slice instead of hanging until the watermark
+    timeout or the next record. The hook aborts only the CURRENT pass;
+    re-iterating starts fresh."""
 
     _SENTINEL = object()
 
@@ -236,8 +245,13 @@ class AsyncDataSetIterator(DataSetIterator):
         finally:
             # GeneratorExit (consumer break/close) and normal exhaustion
             # both land here: stop the worker, unblock any pending put,
-            # and reap the thread
+            # and reap the thread. An unbounded base's blocking read is
+            # interrupted through its abandon() hook — the stop event
+            # only covers the put side.
             stop.set()
+            abandon = getattr(self.base, "abandon", None)
+            if abandon is not None:
+                abandon()
             while True:
                 try:
                     q.get_nowait()

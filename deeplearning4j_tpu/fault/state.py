@@ -19,6 +19,17 @@ Capture sources:
   the capture goes through it (including per-replica updater state and
   the threshold residual/τ, which never exist on the model at all).
 
+Iterator cursors come in two families under one contract: finite
+iterators pin ``{epoch, batch, seed}`` (shuffle permutations re-derived
+by replaying Generator draws), and UNBOUNDED streaming iterators
+(`online/iterator.py`) pin the transport offset — ``batch`` counts
+batches CONSUMED by the training loop, ``offset = batch * batch_size``
+is the first unconsumed record, and `seek()` is replay-from-offset
+over a retained log (records held back for a ragged tail, or
+prefetched but unconsumed by `AsyncDataSetIterator`, sit past the
+cursor by construction and replay). Both are json-safe dicts captured
+in ``meta["iterator"]``.
+
 Trees are flattened to npz-friendly flat dicts with `\\x1f`-joined path
 keys (the ASCII unit separator cannot appear in layer indices or graph
 node names) and carry a crc32 per array so restore can detect silent
@@ -275,7 +286,8 @@ def restore_training_state(model, state: Dict[str, Any], *,
                 f"checkpoint carries an iterator cursor but "
                 f"{type(iterator).__name__} does not implement the "
                 f"cursor()/seek() position contract "
-                f"(ArrayDataSetIterator and AsyncDataSetIterator do)"
+                f"(ArrayDataSetIterator, AsyncDataSetIterator and "
+                f"StreamingDataSetIterator do)"
             ) from e
     return model
 

@@ -1,0 +1,34 @@
+"""Online-learning runtime: unbounded-stream training that publishes
+into the serving fleet.
+
+- `online.iterator`   — `StreamingDataSetIterator`: the unbounded
+  `DataSetIterator` contract over the `streaming/` transports
+  (cursor = transport offset, watermark-bounded blocking reads,
+  fixed-shape batches with ragged-tail hold-back)
+- `online.normalizer` — `WindowedStandardize`: sliding-window
+  standardize statistics with versioned `snapshot()`-per-publish
+- `online.trainer`    — `OnlineTrainer` (continuous fit → registry
+  publish → fault checkpoint, resume-from-offset bit-parity) and
+  `DriftGate` (held-out regression band that pauses publishing,
+  never training)
+
+See docs/STREAMING_TRAINING.md for the iterator contract, the
+watermark semantics, and the publish/drift-gate state machine; the
+end-to-end train→publish→hot-swap harness is scripts/online_loop.py.
+"""
+
+from deeplearning4j_tpu.online.iterator import (
+    StreamingDataSetIterator,
+    lm_example,
+)
+from deeplearning4j_tpu.online.normalizer import (
+    StandardizeSnapshot,
+    WindowedStandardize,
+)
+from deeplearning4j_tpu.online.trainer import DriftGate, OnlineTrainer
+
+__all__ = [
+    "StreamingDataSetIterator", "lm_example",
+    "WindowedStandardize", "StandardizeSnapshot",
+    "OnlineTrainer", "DriftGate",
+]

@@ -154,7 +154,7 @@ class ModelRegistry:
 
     # ------------------------------------------------------------ publish
     def publish(self, name: str, net, *, version: Optional[int] = None,
-                save_updater: bool = False) -> int:
+                save_updater: bool = False, normalizer=None) -> int:
         """Publish `net` as a new version of `name`; returns the version
         committed. `version=None` takes the next free number (retrying
         past concurrent publishers); an explicit `version` that already
@@ -163,12 +163,20 @@ class ModelRegistry:
 
         `save_updater=False` by default: a served release needs weights
         and normalizer state, not optimizer slots (pass True to keep
-        the zip resumable as a training checkpoint too)."""
+        the zip resumable as a training checkpoint too).
+
+        `normalizer`: a fitted DataNormalization (e.g. a
+        `WindowedStandardize.snapshot()`) baked INTO the zip before the
+        claim — the release carries the input statistics it trained
+        under (`ModelSerializer.restore_normalizer_from_file` reads it
+        back)."""
         d = self.model_dir(name)
         d.mkdir(parents=True, exist_ok=True)
         tmp = d / f".publish-{os.getpid()}-{uuid.uuid4().hex[:8]}.tmp.zip"
         try:
             ModelSerializer.write_model(net, tmp, save_updater=save_updater)
+            if normalizer is not None:
+                ModelSerializer.add_normalizer_to_model(tmp, normalizer)
             if version is not None:
                 committed = self._claim(tmp, name, int(version))
                 if committed is None:
@@ -376,17 +384,27 @@ class ModelRegistry:
     def publish_listener(self, name: str, *, frequency: int = 100,
                          epoch_frequency: Optional[int] = None,
                          save_updater: bool = False,
-                         publish_at_fit_end: bool = True):
+                         publish_at_fit_end: bool = True,
+                         gate=None, normalizer_provider=None):
         """A TrainingListener that publishes the training model into
         this registry every `frequency` completed steps — checkpoint-
         as-publish as a one-liner:
 
             net.add_listener(registry.publish_listener("lm", frequency=500))
-        """
+
+        `gate`: callable → bool consulted before every publish (the
+        drift gate of `online/trainer.py`): False skips the publish
+        WITHOUT advancing the cadence clock, so the next legal step
+        boundary after the gate reopens publishes immediately — pause
+        publishing, never training. `normalizer_provider`: callable →
+        normalizer-or-None evaluated AT publish time (a
+        `WindowedStandardize.snapshot` bound method), so each release
+        carries the statistics of its own training window."""
         return RegistryPublishListener(
             self, name, frequency=frequency,
             epoch_frequency=epoch_frequency, save_updater=save_updater,
-            publish_at_fit_end=publish_at_fit_end)
+            publish_at_fit_end=publish_at_fit_end, gate=gate,
+            normalizer_provider=normalizer_provider)
 
 
 class RegistryPublishListener(TrainingListener):
@@ -402,21 +420,78 @@ class RegistryPublishListener(TrainingListener):
                  frequency: int = 100,
                  epoch_frequency: Optional[int] = None,
                  save_updater: bool = False,
-                 publish_at_fit_end: bool = True):
+                 publish_at_fit_end: bool = True,
+                 gate=None, normalizer_provider=None):
         self.registry = registry
         self.name = name
         self.frequency = max(1, int(frequency))
         self.epoch_frequency = epoch_frequency
         self.save_updater = save_updater
         self.publish_at_fit_end = publish_at_fit_end
+        self.gate = gate
+        self.normalizer_provider = normalizer_provider
         self._last_published_step = 0
+        self._last_gated_log_step = 0
+        self._anchored = False
         self.published_versions: List[int] = []
+        self.published_steps: List[int] = []
+        self.gated_skips = 0
+
+    def on_fit_start(self, model):
+        # anchor the cadence clock at the CURRENT counter once: a
+        # warm-started / resumed model (iteration_count >> 0) must wait
+        # a full `frequency` of NEW steps for its first publish, not
+        # publish immediately because the clock still reads 0
+        if not self._anchored:
+            self._anchored = True
+            self._last_published_step = max(
+                self._last_published_step, int(model.iteration_count))
+
+    def _gated(self, step: int, *, windowed: bool = True) -> bool:
+        """True when the gate currently refuses publishing. The
+        cadence clock does NOT advance on a refusal — publishing
+        resumes at the first legal boundary after recovery.
+
+        The skip COUNT advances once per cadence WINDOW on the
+        iteration path (`windowed=True`): while the gate stays closed
+        every step boundary re-enters here (the frozen clock keeps the
+        publish overdue), and counting each would over-report one
+        refused release as `frequency` refusals. Epoch-end / fit-end
+        refusals are discrete events (`windowed=False`) and count once
+        per step."""
+        if self.gate is None or self.gate():
+            return False
+        since = step - max(self._last_published_step,
+                           self._last_gated_log_step)
+        if (since >= self.frequency if windowed
+                else step > self._last_gated_log_step):
+            self._last_gated_log_step = step
+            self.gated_skips += 1
+            from deeplearning4j_tpu import monitor
+            if monitor.is_enabled():
+                monitor.registry().counter(
+                    "online_publishes_skipped_total",
+                    help="publishes refused by the drift gate (one "
+                         "per refused cadence window / fit boundary)",
+                    model=self.name).inc()
+        return True
 
     def _publish(self, model, step: int):
+        normalizer = (self.normalizer_provider()
+                      if self.normalizer_provider is not None else None)
         v = self.registry.publish(self.name, model,
-                                  save_updater=self.save_updater)
+                                  save_updater=self.save_updater,
+                                  normalizer=normalizer)
         self.published_versions.append(v)
+        self.published_steps.append(step)
         self._last_published_step = step
+        from deeplearning4j_tpu import monitor
+        if monitor.is_enabled():
+            monitor.registry().counter(
+                "online_publishes_total",
+                help="model snapshots published into the serving "
+                     "registry from a training loop",
+                model=self.name).inc()
 
     def iteration_done(self, model, iteration, epoch, score, **info):
         if not info.get("step_boundary", True):
@@ -424,14 +499,24 @@ class RegistryPublishListener(TrainingListener):
         step = iteration + 1
         if step - self._last_published_step < self.frequency:
             return
+        if self._gated(step):
+            return
         self._publish(model, step)
 
     def on_epoch_end(self, model, epoch):
         if (self.epoch_frequency
-                and (epoch + 1) % self.epoch_frequency == 0):
+                and (epoch + 1) % self.epoch_frequency == 0
+                and not self._gated(int(model.iteration_count),
+                                    windowed=False)):
             self._publish(model, int(model.iteration_count))
 
     def on_fit_end(self, model):
+        # online runs stop at arbitrary steps: the final snapshot
+        # publishes even when the stop iteration is off-cadence (the
+        # drift gate still applies — a degraded final model must not
+        # ship just because the stream ended while it was degraded)
         if self.publish_at_fit_end and \
-                int(model.iteration_count) > self._last_published_step:
+                int(model.iteration_count) > self._last_published_step \
+                and not self._gated(int(model.iteration_count),
+                                    windowed=False):
             self._publish(model, int(model.iteration_count))

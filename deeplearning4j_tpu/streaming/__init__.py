@@ -2,13 +2,17 @@
 pub/sub + CSV→DataSet conversion, `streaming/kafka/NDArrayKafkaClient.java`).
 
 The transport is pluggable: `LocalQueueTransport` is the in-process
-implementation (and the test double); `KafkaTransport` gates on the
-optional kafka-python dependency, which is not bundled in this image —
-the wire format (ndarray → bytes) is transport-independent.
+implementation (and the test double); `LocalLogTransport` is its
+offset-addressable sibling (append-only retained log, `read(topic,
+offset)` — the replay-from-offset primitive the online-training cursor
+contract rides); `KafkaTransport` gates on the optional kafka-python
+dependency, which is not bundled in this image — the wire format
+(ndarray → bytes) is transport-independent.
 """
 
 from deeplearning4j_tpu.streaming.ndarray import (
     KafkaTransport,
+    LocalLogTransport,
     LocalQueueTransport,
     NDArrayConsumer,
     NDArrayPublisher,

@@ -96,6 +96,81 @@ class LocalQueueTransport(Transport):
         self._queues.pop(topic, None)
 
 
+class LocalLogTransport(Transport):
+    """In-process append-only log transport — the offset-addressable
+    variant of `LocalQueueTransport` (a Kafka partition's semantics
+    without the broker): `send` appends, messages are never destroyed
+    by consumption, and `read(topic, offset)` addresses any retained
+    message by position.
+
+    This is what makes the online-training resume contract testable
+    in-tree: a `StreamingDataSetIterator` cursor is a transport offset,
+    and replay-from-offset after a crash means re-reading the SAME
+    record sequence — impossible over a destructive queue. `receive()`
+    stays Transport-compatible (one shared consumer cursor advancing
+    through the log), so everything that runs over LocalQueueTransport
+    runs over this unchanged.
+    """
+
+    def __init__(self):
+        import threading
+        self._logs: Dict[str, list] = {}
+        self._cursors: Dict[str, int] = {}
+        self._cond = threading.Condition()
+
+    def send(self, topic, payload):
+        with self._cond:
+            self._logs.setdefault(topic, []).append(payload)
+            self._cond.notify_all()
+
+    def producer_offset(self, topic: str) -> int:
+        """Messages appended so far — the head the consumer lag
+        (`streaming_lag_records`) is measured against."""
+        with self._cond:
+            return len(self._logs.get(topic, ()))
+
+    def read(self, topic: str, offset: int,
+             timeout: Optional[float] = None) -> bytes:
+        """Blocking offset-addressed read: the message at `offset`
+        (0-based append order), waiting up to `timeout` for the
+        producer to reach it. Raises TimeoutError like `receive`."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._cond:
+            while len(self._logs.get(topic, ())) <= offset:
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"no message at offset {offset} on {topic}")
+                self._cond.wait(remaining if remaining is not None
+                                else 1.0)
+            return self._logs[topic][offset]
+
+    def receive(self, topic, timeout=None):
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._cond:
+            # claim-under-lock: concurrent receivers each take a
+            # distinct offset (queue semantics over the retained log)
+            while len(self._logs.get(topic, ())) <= \
+                    self._cursors.get(topic, 0):
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(f"No message on {topic}")
+                self._cond.wait(remaining if remaining is not None
+                                else 1.0)
+            off = self._cursors.get(topic, 0)
+            self._cursors[topic] = off + 1
+            return self._logs[topic][off]
+
+    def close(self, topic):
+        with self._cond:
+            self._logs.pop(topic, None)
+            self._cursors.pop(topic, None)
+
+
 class KafkaTransport(Transport):
     """Kafka-backed transport; requires kafka-python (not bundled)."""
 
@@ -127,10 +202,43 @@ class KafkaTransport(Transport):
             return records[0].value
         raise TimeoutError(f"No message on {topic}")
 
+    def read(self, topic: str, offset: int,
+             timeout: Optional[float] = None) -> bytes:
+        """Offset-addressed read via a dedicated seeking consumer —
+        the replay-from-offset primitive the online-training cursor
+        contract needs (`StreamingDataSetIterator.seek`). Wired but
+        NOT exercised in CI: the image ships no broker (see
+        docs/STREAMING_TRAINING.md, honest limits)."""
+        from kafka import KafkaConsumer, TopicPartition
+        key = f"{topic}\x00seek"
+        if key not in self._consumers:
+            c = KafkaConsumer(bootstrap_servers=self._bootstrap)
+            c.assign([TopicPartition(topic, 0)])
+            self._consumers[key] = c
+        c = self._consumers[key]
+        c.seek(TopicPartition(topic, 0), int(offset))
+        ms = int((timeout or 10) * 1000)
+        batch = c.poll(timeout_ms=ms, max_records=1)
+        for records in batch.values():
+            return records[0].value
+        raise TimeoutError(f"No message at offset {offset} on {topic}")
+
+    def producer_offset(self, topic: str) -> int:
+        """The partition's end offset (producer head) — the lag
+        gauge's reference point."""
+        from kafka import KafkaConsumer, TopicPartition
+        c = KafkaConsumer(bootstrap_servers=self._bootstrap)
+        try:
+            tp = TopicPartition(topic, 0)
+            return int(c.end_offsets([tp])[tp])
+        finally:
+            c.close()
+
     def close(self, topic):
-        consumer = self._consumers.pop(topic, None)
-        if consumer is not None:
-            consumer.close()
+        for key in (topic, f"{topic}\x00seek"):
+            consumer = self._consumers.pop(key, None)
+            if consumer is not None:
+                consumer.close()
 
 
 class NDArrayPublisher:

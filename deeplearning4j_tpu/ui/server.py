@@ -288,9 +288,67 @@ class UIServer:
                      for k, (m, s, d)
                      in sorted(act_latest.activation_stats.items())],
                     title=self._tr("act_stats")).render())
+        stream = self._streaming_rows()
+        if stream:
+            body.append(ComponentTable(
+                [self._tr("stream_source"), self._tr("stream_records"),
+                 self._tr("stream_lag"), self._tr("stream_age"),
+                 self._tr("stream_publishes"), self._tr("stream_paused")],
+                stream, title=self._tr("stream_health")).render())
         if len(body) == 1:
             body.append(f"<p>{self._tr('no_sessions')}</p>")
         return self._page(self._tr("title.overview"), "".join(body))
+
+    def _streaming_rows(self):
+        """Online-training staleness rows for the overview, read from
+        the live monitor registry (the same `streaming_*`/`online_*`
+        families `/metrics` exports; docs/OBSERVABILITY.md "Streaming /
+        online training"). Row kinds are SEPARATE — one per stream
+        topic (records consumed, consumer lag, watermark age), one per
+        published model (publish count), one per drift-gate tag (gate
+        state) — because the registry knows no topic↔model↔tag
+        mapping, and smearing a global sum / any-paused flag across
+        topic rows would misattribute state the moment two streams are
+        live."""
+        from deeplearning4j_tpu import monitor
+        snap = (self._registry or monitor.registry()).snapshot()
+
+        def by_label(fam, label):
+            out = {}
+            for e in (snap.get(fam) or {}).get("values", []):
+                key = e.get("labels", {}).get(label)
+                if key is not None:
+                    out[key] = e.get("value")
+            return out
+
+        records = by_label("streaming_records_consumed_total", "topic")
+        if not records:
+            return []
+        lag = by_label("streaming_lag_records", "topic")
+        age = by_label("streaming_watermark_age_seconds", "topic")
+        pubs = by_label("online_publishes_total", "model")
+        paused = by_label("online_publish_paused", "tag")
+
+        def fmt(v, suffix=""):
+            if v is None or (isinstance(v, float) and v != v):
+                return "—"
+            if isinstance(v, float) and v.is_integer():
+                v = int(v)
+            return (f"{v:.1f}{suffix}" if isinstance(v, float)
+                    else f"{v}{suffix}")
+
+        rows = [(topic, fmt(records.get(topic)), fmt(lag.get(topic)),
+                 fmt(age.get(topic), "s"), "—", "—")
+                for topic in sorted(records)]
+        rows.extend((f"{self._tr('stream_model')} {model}", "—", "—",
+                     "—", fmt(pubs.get(model)), "—")
+                    for model in sorted(pubs))
+        rows.extend((f"{self._tr('stream_gate')} {tag}", "—", "—", "—",
+                     "—",
+                     self._tr("stream_paused_yes") if paused.get(tag)
+                     else self._tr("stream_paused_no"))
+                    for tag in sorted(paused))
+        return rows
 
     def _model_html(self):
         """Per-layer drill-down: mean-magnitude timelines for params and

@@ -1,0 +1,652 @@
+"""Online-learning runtime (deeplearning4j_tpu/online/): the
+unbounded-iterator contract, watermarked windowed normalizer stats,
+drift-gated publish listener, OnlineTrainer, and the
+resume-from-offset bit-parity guarantee."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.common.updaters import Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator
+from deeplearning4j_tpu.datasets.normalizers import (
+    NormalizerStandardize,
+    normalizer_from_meta,
+)
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.online import (
+    DriftGate,
+    OnlineTrainer,
+    StreamingDataSetIterator,
+    WindowedStandardize,
+)
+from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+from deeplearning4j_tpu.serving import ModelRegistry
+from deeplearning4j_tpu.streaming import (
+    LocalLogTransport,
+    LocalQueueTransport,
+    serialize_ndarray,
+)
+
+F, C, B = 6, 3, 8
+
+
+def tiny_net(seed=7, lr=0.1):
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr))
+    lb = b.list().layer(DenseLayer(n_in=F, n_out=8, activation="tanh"))
+    return MultiLayerNetwork(
+        lb.layer(OutputLayer(n_in=8, n_out=C, activation="softmax",
+                             loss="mcxent"))
+          .set_input_type(InputType.feed_forward(F)).build()).init()
+
+
+_W_TRUE = np.random.default_rng(42).standard_normal((F, C))
+
+
+def make_records(n, seed, shuffle_labels=False):
+    """Record = [features F | one-hot label C] concatenated."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.standard_normal(F).astype(np.float32)
+        cls = (int(rng.integers(0, C)) if shuffle_labels
+               else int(np.argmax(x @ _W_TRUE)))
+        y = np.zeros(C, np.float32)
+        y[cls] = 1.0
+        out.append(np.concatenate([x, y]))
+    return out
+
+
+def split_record(r):
+    return r[:F], r[F:]
+
+
+def fill_log(records, topic="train", transport=None):
+    t = transport or LocalLogTransport()
+    for r in records:
+        t.send(topic, serialize_ndarray(r))
+    return t
+
+
+def make_stream(transport, topic="train", batch_size=B, **kw):
+    kw.setdefault("watermark_timeout_s", 0.4)
+    kw.setdefault("poll_s", 0.02)
+    return StreamingDataSetIterator(
+        transport, topic, batch_size=batch_size,
+        record_to_example=split_record, **kw)
+
+
+def params_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+# ===================================================== LocalLogTransport
+class TestLocalLogTransport:
+    def test_offset_reads_are_stable(self):
+        t = LocalLogTransport()
+        for i in range(5):
+            t.send("a", bytes([i]))
+        assert t.read("a", 2) == bytes([2])
+        assert t.read("a", 2) == bytes([2])     # retained, re-readable
+        assert t.producer_offset("a") == 5
+
+    def test_read_blocks_until_producer_reaches_offset(self):
+        t = LocalLogTransport()
+
+        def late_send():
+            time.sleep(0.1)
+            t.send("a", b"x")
+
+        threading.Thread(target=late_send, daemon=True).start()
+        assert t.read("a", 0, timeout=5.0) == b"x"
+        with pytest.raises(TimeoutError):
+            t.read("a", 7, timeout=0.05)
+
+    def test_receive_is_queue_compatible(self):
+        t = LocalLogTransport()
+        t.send("a", b"0")
+        t.send("a", b"1")
+        assert t.receive("a") == b"0"
+        assert t.receive("a") == b"1"
+        with pytest.raises(TimeoutError):
+            t.receive("a", timeout=0.05)
+        # the log is retained: offset reads still see consumed messages
+        assert t.read("a", 0) == b"0"
+
+    def test_close_drops_topic(self):
+        t = LocalLogTransport()
+        t.send("a", b"0")
+        t.close("a")
+        assert t.producer_offset("a") == 0
+
+
+# ================================================ StreamingDataSetIterator
+class TestStreamingIterator:
+    def test_fixed_shape_batches_with_ragged_holdback(self):
+        t = fill_log(make_records(2 * B + 3, seed=0))
+        it = make_stream(t)
+        batches = list(it)
+        # 3 tail records held back — never dispatched as a short batch
+        assert len(batches) == 2
+        assert batches[0].features.shape == (B, F)
+        assert batches[0].labels.shape == (B, C)
+        assert it.cursor()["batch"] == 2
+        assert it.cursor()["offset"] == 2 * B
+
+    def test_cursor_counts_before_yield(self):
+        t = fill_log(make_records(2 * B, seed=1))
+        it = make_stream(t)
+        gen = iter(it)
+        next(gen)
+        # the consumer HOLDS batch 1 — the cursor must include it
+        assert it.cursor()["batch"] == 1
+        gen.close()
+
+    def test_watermark_timeout_ends_pass_then_resumes(self):
+        t = fill_log(make_records(B, seed=2))
+        it = make_stream(t)
+        assert len(list(it)) == 1       # quiesced after the watermark
+        fill_log(make_records(B, seed=3), transport=t)
+        assert len(list(it)) == 1       # a later pass picks up new data
+        assert it.cursor()["batch"] == 2
+
+    def test_stop_ends_stream_at_batch_boundary(self):
+        t = fill_log(make_records(8 * B, seed=4))
+        it = make_stream(t, watermark_timeout_s=5.0)
+        got = []
+        for ds in it:
+            got.append(ds)
+            if len(got) == 2:
+                it.stop()
+        assert len(got) == 2
+
+    def test_seek_replays_identical_batches(self):
+        t = fill_log(make_records(4 * B, seed=5))
+        ref = list(make_stream(t))
+        it = make_stream(t)
+        it.seek({"batch": 2, "batch_size": B})
+        replay = list(it)
+        assert len(replay) == 2
+        for a, b_ in zip(ref[2:], replay):
+            np.testing.assert_array_equal(a.features, b_.features)
+            np.testing.assert_array_equal(a.labels, b_.labels)
+
+    def test_seek_batch_size_mismatch_raises(self):
+        it = make_stream(LocalLogTransport())
+        with pytest.raises(ValueError, match="batch_size"):
+            it.seek({"batch": 1, "batch_size": B + 1})
+
+    def test_seek_over_destructive_queue_skips_replayed_prefix(self):
+        # replay-from-offset over a destructive transport = the
+        # producer republishes from the start and the iterator skips
+        # the consumed prefix
+        records = make_records(3 * B, seed=6)
+        t = LocalQueueTransport()
+        ref = list(make_stream(fill_log(records)))
+        for r in records:
+            t.send("train", serialize_ndarray(r))
+        it = make_stream(t)
+        it.seek({"batch": 1, "batch_size": B})
+        replay = list(it)
+        assert len(replay) == 2
+        np.testing.assert_array_equal(replay[0].features,
+                                      ref[1].features)
+
+    def test_shape_change_mid_stream_is_loud(self):
+        t = LocalLogTransport()
+        t.send("train", serialize_ndarray(
+            np.zeros(F + C, np.float32)))
+        t.send("train", serialize_ndarray(
+            np.zeros(F + C + 1, np.float32)))
+        it = make_stream(t)
+        with pytest.raises(ValueError, match="fixed-shape"):
+            list(it)
+
+    def test_metrics_families(self):
+        reg = MetricsRegistry()
+        monitor.enable(registry=reg)
+        try:
+            t = fill_log(make_records(2 * B, seed=7), topic="m1")
+            it = make_stream(t, topic="m1")
+            list(it)
+            snap = reg.snapshot()
+            rec = snap["streaming_records_consumed_total"]["values"]
+            assert any(e["labels"].get("topic") == "m1"
+                       and e["value"] == 2 * B for e in rec)
+            lag = snap["streaming_lag_records"]["values"]
+            assert any(e["labels"].get("topic") == "m1"
+                       and e["value"] == 0 for e in lag)
+            age = snap["streaming_watermark_age_seconds"]["values"]
+            assert any(e["labels"].get("topic") == "m1"
+                       and e["value"] >= 0 for e in age)
+        finally:
+            monitor.disable()
+
+
+# ============================================= async over unbounded source
+class TestAsyncOverUnbounded:
+    def test_abandon_does_not_strand_prefetch_thread(self):
+        """Satellite regression: a consumer breaking out while the
+        prefetch worker is blocked in a WATERMARK wait (not the
+        bounded put) must unblock it promptly via the abandon hook."""
+        t = fill_log(make_records(3 * B, seed=8))
+        base = make_stream(t, watermark_timeout_s=60.0)   # would hang
+        ait = AsyncDataSetIterator(base, prefetch=2)
+        before = threading.active_count()
+        t0 = time.monotonic()
+        for i, _ in enumerate(ait):
+            if i == 1:
+                break                        # early abandon
+        assert time.monotonic() - t0 < 5.0
+        time.sleep(0.2)
+        assert threading.active_count() <= before
+
+    def test_cursor_counts_consumed_not_prefetched(self):
+        t = fill_log(make_records(5 * B, seed=9))
+        base = make_stream(t, watermark_timeout_s=60.0)
+        ait = AsyncDataSetIterator(base, prefetch=4)
+        gen = iter(ait)
+        for _ in range(2):
+            next(gen)
+        # the worker ran ahead; the checkpointable position is what the
+        # CONSUMER took — prefetched batches must replay after restore
+        deadline = time.monotonic() + 5.0
+        while (base.cursor()["batch"] <= 2
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert base.cursor()["batch"] > 2
+        assert ait.cursor()["batch"] == 2
+        gen.close()
+
+    def test_seek_through_async_wrapper(self):
+        t = fill_log(make_records(4 * B, seed=10))
+        ref = list(make_stream(t))
+        base = make_stream(t)
+        ait = AsyncDataSetIterator(base, prefetch=2)
+        ait.seek({"batch": 3, "batch_size": B})
+        got = list(ait)
+        assert len(got) == 1
+        np.testing.assert_array_equal(got[0].features, ref[3].features)
+        assert ait.cursor()["batch"] == 4
+
+
+# ==================================================== WindowedStandardize
+class TestWindowedStandardize:
+    def test_window_matches_direct_stats_over_last_batches(self):
+        rng = np.random.default_rng(0)
+        w = WindowedStandardize(window=3)
+        batches = [rng.standard_normal((B, F)) + i for i in range(6)]
+        for x in batches:
+            w.observe(x)
+        tail = np.concatenate(batches[-3:])
+        np.testing.assert_allclose(w.mean, tail.mean(axis=0),
+                                   rtol=1e-10)
+        np.testing.assert_allclose(w.std, tail.std(axis=0),
+                                   rtol=1e-6)
+
+    def test_transform_before_data_is_loud(self):
+        with pytest.raises(ValueError, match="no data"):
+            WindowedStandardize().transform(np.zeros((2, F)))
+
+    def test_snapshot_is_frozen_and_versioned(self):
+        rng = np.random.default_rng(1)
+        w = WindowedStandardize(window=2)
+        w.observe(rng.standard_normal((B, F)))
+        s1 = w.snapshot()
+        mean1 = np.array(s1.mean)
+        w.observe(rng.standard_normal((B, F)) + 10.0)
+        s2 = w.snapshot()
+        np.testing.assert_array_equal(s1.mean, mean1)   # frozen
+        assert (s1.version, s2.version) == (1, 2)
+        assert s2.records_seen == 2 * B
+        assert not np.allclose(s1.mean, s2.mean)
+
+    def test_live_window_state_round_trip(self):
+        rng = np.random.default_rng(2)
+        w = WindowedStandardize(window=4)
+        for i in range(6):
+            w.observe(rng.standard_normal((B, F)) * (i + 1))
+        w.snapshot()
+        meta, arrays = w.state()
+        w2 = normalizer_from_meta(meta, arrays)
+        np.testing.assert_array_equal(w2.mean, w.mean)
+        np.testing.assert_array_equal(w2.std, w.std)
+        assert w2.snapshot_version == w.snapshot_version
+        assert w2.records_seen == w.records_seen
+        # the restored window EVICTS identically as new data arrives
+        x = rng.standard_normal((B, F))
+        w.observe(x)
+        w2.observe(x)
+        np.testing.assert_array_equal(w2.mean, w.mean)
+
+    def test_snapshot_rides_the_published_zip(self, tmp_path):
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+        rng = np.random.default_rng(3)
+        w = WindowedStandardize(window=2)
+        w.observe(rng.standard_normal((B, F)))
+        reg = ModelRegistry(tmp_path)
+        v = reg.publish("m", tiny_net(), normalizer=w.snapshot())
+        restored = ModelSerializer.restore_normalizer_from_file(
+            reg.path("m", v))
+        np.testing.assert_array_equal(restored.mean, w.mean)
+        assert restored.version == 1
+        assert restored.records_seen == B
+        # and transforms like a plain standardizer
+        assert isinstance(restored, NormalizerStandardize)
+
+    def test_fit_protocol_and_masks(self):
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((4, 5, F))
+        mask = np.zeros((4, 5), np.float32)
+        mask[:, :3] = 1.0
+        w = WindowedStandardize(window=8)
+        w.fit(DataSet(x, None, mask))
+        ref = NormalizerStandardize().fit(DataSet(x, None, mask))
+        np.testing.assert_allclose(w.mean, ref.mean, rtol=1e-12)
+        np.testing.assert_allclose(w.std, ref.std, rtol=1e-12)
+
+
+# ===================================== publish listener online semantics
+class TestPublishListenerOnline:
+    def test_final_publish_at_off_cadence_fit_end(self, tmp_path):
+        """Satellite regression: an online run stops at an arbitrary
+        step — the final snapshot publishes from on_fit_end even when
+        the stop iteration is off-cadence."""
+        reg = ModelRegistry(tmp_path)
+        net = tiny_net()
+        listener = reg.publish_listener("m", frequency=100)
+        net.add_listener(listener)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((7 * 4, F)).astype(np.float32)
+        y = np.eye(C, dtype=np.float32)[rng.integers(0, C, 7 * 4)]
+        net.fit(x, y, epochs=1, batch_size=4)      # 7 steps, cadence 100
+        assert listener.published_versions == [1]
+        assert listener.published_steps == [7]
+        restored, _ = reg.resolve("m")
+        assert params_equal(restored.params, net.params)
+
+    def test_gate_pauses_without_advancing_cadence(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        net = tiny_net()
+        allow = {"ok": True}
+        listener = reg.publish_listener("m", frequency=3,
+                                        gate=lambda: allow["ok"])
+        # cadence boundary with the gate CLOSED: skipped, clock frozen
+        allow["ok"] = False
+        net.iteration_count = 3
+        listener.iteration_done(net, 2, 0, 0.0)
+        assert listener.published_versions == []
+        assert listener.gated_skips == 1
+        # gate reopens: the NEXT boundary publishes immediately (no
+        # full fresh cadence owed)
+        allow["ok"] = True
+        net.iteration_count = 4
+        listener.iteration_done(net, 3, 0, 0.0)
+        assert listener.published_versions == [1]
+        assert listener.published_steps == [4]
+
+    def test_gated_skips_count_windows_not_iterations(self, tmp_path):
+        """A closed gate makes EVERY step boundary overdue (the frozen
+        clock); the skip counter must advance once per refused cadence
+        window, not once per iteration."""
+        reg = ModelRegistry(tmp_path)
+        net = tiny_net()
+        listener = reg.publish_listener("m", frequency=5,
+                                        gate=lambda: False)
+        for it in range(4, 14):            # steps 5..14, all overdue
+            net.iteration_count = it + 1
+            listener.iteration_done(net, it, 0, 0.0)
+        # two refused windows (5 and 10), not ten refused iterations
+        assert listener.gated_skips == 2
+
+    def test_gate_applies_to_fit_end(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        net = tiny_net()
+        listener = reg.publish_listener("m", frequency=100,
+                                        gate=lambda: False)
+        net.iteration_count = 9
+        listener.on_fit_end(net)
+        assert listener.published_versions == []
+        assert listener.gated_skips == 1
+
+    def test_cadence_anchors_at_warm_start(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        net = tiny_net()
+        net.iteration_count = 200          # resumed / warm-started
+        listener = reg.publish_listener("m", frequency=10)
+        listener.on_fit_start(net)
+        listener.iteration_done(net, 200, 0, 0.0)   # 1 new step only
+        assert listener.published_versions == []
+        listener.iteration_done(net, 209, 0, 0.0)   # 10 new steps
+        assert listener.published_versions == [1]
+
+
+# ================================================= drift gate integration
+class TestDriftGate:
+    def test_trip_and_recovery_through_real_evaluation(self):
+        reg = MetricsRegistry()
+        monitor.enable(registry=reg)
+        try:
+            rng = np.random.default_rng(0)
+            hx = rng.standard_normal((48, F)).astype(np.float32)
+            hy = np.eye(C, dtype=np.float32)[
+                np.argmax(hx @ _W_TRUE, axis=1)]
+            heldout = DataSet(hx, hy)
+            net = tiny_net()
+            # train to decent held-out accuracy
+            x = rng.standard_normal((40 * B, F)).astype(np.float32)
+            y = np.eye(C, dtype=np.float32)[
+                np.argmax(x @ _W_TRUE, axis=1)]
+            net.fit(x, y, epochs=2, batch_size=B, shuffle=False)
+            gate = DriftGate(heldout, frequency=1, band=0.2,
+                             printer=lambda s: None)
+            gate.iteration_done(net, 0, 0, 0.0)
+            assert gate.best_score is not None and not gate.paused
+            # corrupt the model -> held-out collapse -> trip
+            good_params = jax.tree_util.tree_map(np.asarray, net.params)
+            net.params = jax.tree_util.tree_map(
+                lambda a: a * 0.0, net.params)
+            gate.iteration_done(net, 1, 0, 0.0)
+            assert gate.paused and gate.trips == 1
+            assert not gate.allow_publish()
+            # restore -> recovery reopens the gate
+            import jax.numpy as jnp
+            net.params = jax.tree_util.tree_map(jnp.asarray,
+                                                good_params)
+            gate.iteration_done(net, 2, 0, 0.0)
+            assert not gate.paused and gate.allow_publish()
+            assert gate.trips == 1
+            snap = reg.snapshot()
+            paused = snap["online_publish_paused"]["values"]
+            assert any(e["value"] == 0.0 for e in paused)
+            trips = snap["online_drift_trips_total"]["values"]
+            assert any(e["value"] == 1 for e in trips)
+            # the EvaluativeListener tap fed the score gauges too
+            assert "evaluative_score" in snap
+        finally:
+            monitor.disable()
+
+
+# ========================================================= OnlineTrainer
+class TestOnlineTrainer:
+    def test_stream_run_publishes_and_checkpoints(self, tmp_path):
+        t = fill_log(make_records(30 * B, seed=11))
+        it = make_stream(t)
+        reg = ModelRegistry(tmp_path / "reg", keep_last=50)
+        trainer = OnlineTrainer(
+            tiny_net(), it, registry=reg, model_name="m",
+            publish_frequency=10,
+            checkpoint_dir=tmp_path / "ckpt", checkpoint_frequency=8)
+        s = trainer.run(max_steps=24)
+        assert s["iterations"] == 24
+        # cadence publishes at 10, 20 + the off-cadence final at 24
+        assert s["published_steps"] == [10, 20, 24]
+        assert reg.versions("m") == [1, 2, 3]
+        from deeplearning4j_tpu.fault.checkpointer import (
+            list_checkpoints)
+        steps = list_checkpoints(tmp_path / "ckpt")
+        assert 8 in steps and 16 in steps and 24 in steps
+        assert s["cursor"]["batch"] == 24
+
+    def test_listeners_detach_after_run(self, tmp_path):
+        t = fill_log(make_records(4 * B, seed=12))
+        it = make_stream(t)
+        net = tiny_net()
+        n_before = len(net.listeners)
+        OnlineTrainer(net, it, registry=ModelRegistry(tmp_path),
+                      model_name="m", publish_frequency=100).run(
+                          max_steps=2)
+        assert len(net.listeners) == n_before
+
+    def test_run_twice_over_the_same_iterator(self, tmp_path):
+        """max_steps ends a run by stopping the ITERATOR; the stop flag
+        is per-pass, so a second run() continues the stream instead of
+        silently training zero steps."""
+        t = fill_log(make_records(10 * B, seed=17))
+        it = make_stream(t)
+        trainer = OnlineTrainer(tiny_net(), it)
+        assert trainer.run(max_steps=3)["iterations"] == 3
+        s = trainer.run(max_steps=4)
+        assert s["iterations"] == 4
+        assert it.cursor()["batch"] == 7
+
+    def test_windowed_normalizer_wires_into_stream(self, tmp_path):
+        t = fill_log(make_records(6 * B, seed=13))
+        w = WindowedStandardize(window=4)
+        it = make_stream(t, normalizer=None)
+        reg = ModelRegistry(tmp_path)
+        trainer = OnlineTrainer(tiny_net(), it, registry=reg,
+                                model_name="m", publish_frequency=3,
+                                normalizer=w)
+        assert it.normalizer is w
+        s = trainer.run(max_steps=6)
+        assert w.records_seen == 6 * B
+        # every published zip carries the snapshot of ITS window
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+        versions = s["published_versions"]
+        assert len(versions) >= 2
+        snaps = [ModelSerializer.restore_normalizer_from_file(
+            reg.path("m", v)) for v in versions]
+        assert [s_.version for s_ in snaps] == \
+            list(range(1, len(versions) + 1))
+        # later snapshots saw more records (the watermark advanced)
+        assert snaps[-1].records_seen > snaps[0].records_seen
+
+
+# ===================================== resume-from-offset bit-parity
+class TestResumeFromOffsetParity:
+    def test_interrupted_run_resumes_bit_equal(self, tmp_path):
+        """Satellite: interrupt an OnlineTrainer mid-stream, resume via
+        fault/ with the transport replayed from the checkpoint cursor —
+        trajectory bit-equality with an uninterrupted run over the same
+        record sequence."""
+        records = make_records(24 * B, seed=14)
+        total = 24
+
+        # --- reference: uninterrupted
+        tA = fill_log(records)
+        scoresA = CollectScoresListener()
+        netA = tiny_net()
+        netA.add_listener(scoresA)
+        OnlineTrainer(netA, make_stream(tA)).run(max_steps=total)
+
+        # --- interrupted at 16; newest checkpoint is MID-STREAM at 12
+        tB = fill_log(records)
+        netB = tiny_net()
+        OnlineTrainer(netB, make_stream(tB),
+                      checkpoint_dir=tmp_path,
+                      checkpoint_frequency=12,
+                      checkpoint_at_fit_end=False).run(max_steps=16)
+        del netB          # the "kill": nothing survives but the ckpt
+
+        # --- resume: fresh everything, transport replayed from offset
+        tC = fill_log(records)
+        itC = make_stream(tC)
+        trC = OnlineTrainer.resume(tmp_path, itC)
+        assert trC.net.iteration_count == 12
+        assert itC.cursor() == {"kind": "stream", "topic": "train",
+                                "batch": 12, "batch_size": B,
+                                "offset": 12 * B}
+        scoresC = CollectScoresListener()
+        trC.net.add_listener(scoresC)
+        trC.run(max_steps=total - 12)
+        assert trC.net.iteration_count == total
+
+        # params bit-equal AND the post-resume score trajectory
+        # bit-equal to the reference's same steps (12..23): batches
+        # 12..15 — trained by the interrupted run after its last
+        # checkpoint — replayed from the offset, not skipped
+        assert params_equal(netA.params, trC.net.params)
+        refA = {it: s for it, s in scoresA.scores}
+        for it, s in scoresC.scores:
+            assert s == refA[it], (it, s, refA[it])
+
+    def test_resume_restores_live_normalizer_window(self, tmp_path):
+        records = make_records(16 * B, seed=15)
+        tA = fill_log(records)
+        wA = WindowedStandardize(window=3)
+        netA = tiny_net()
+        OnlineTrainer(netA, make_stream(tA),
+                      normalizer=wA).run(max_steps=12)
+
+        tB = fill_log(records)
+        wB = WindowedStandardize(window=3)
+        OnlineTrainer(tiny_net(), make_stream(tB), normalizer=wB,
+                      checkpoint_dir=tmp_path, checkpoint_frequency=6,
+                      checkpoint_at_fit_end=False).run(max_steps=9)
+        tC = fill_log(records)
+        itC = make_stream(tC)
+        trC = OnlineTrainer.resume(tmp_path, itC)
+        # the restored WINDOW (not just aggregate) resumed at step 6;
+        # replaying to 12 reproduces the reference stats bit-exactly
+        assert isinstance(trC.normalizer, WindowedStandardize)
+        assert itC.normalizer is trC.normalizer
+        trC.run(max_steps=6)
+        np.testing.assert_array_equal(trC.normalizer.mean, wA.mean)
+        np.testing.assert_array_equal(trC.normalizer.std, wA.std)
+        assert params_equal(netA.params, trC.net.params)
+
+
+# ============================================= /train staleness row (UI)
+class TestStreamingUI:
+    def test_overview_renders_staleness_row(self):
+        reg = MetricsRegistry()
+        monitor.enable(registry=reg)
+        try:
+            t = fill_log(make_records(2 * B, seed=16), topic="ui")
+            list(make_stream(t, topic="ui"))
+            reg.counter("online_publishes_total",
+                        help="", model="m").inc(3)
+            reg.gauge("online_publish_paused", help="",
+                      tag="heldout").set(0.0)
+            import urllib.request
+
+            from deeplearning4j_tpu.ui import UIServer
+            server = UIServer().start()
+            try:
+                html = urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/train/overview",
+                    timeout=10).read().decode()
+                assert "streaming / online training" in html
+                assert "ui" in html and "records consumed" in html
+                # separate attribution rows: per-model publishes and
+                # per-tag gate state (no cross-topic smearing)
+                assert "model m" in html
+                assert "gate heldout" in html
+                assert "open" in html         # gate not paused
+            finally:
+                server.stop()
+        finally:
+            monitor.disable()
