@@ -1196,6 +1196,15 @@ GATE_TOLERANCES = {
     "fleet_streams_sustained": 0.05,
     "fleet_swap_p99_ttft_ms": 0.5,
     "fleet_tokens_per_sec": 0.25,
+    # speculative decode on the acceptance-friendly workload: a
+    # host-timing number (wide band), but a silently-disabled drafting
+    # path halves it far past the band
+    "serving_speculative_tokens_per_sec": 0.25,
+    # STRUCTURAL (prompt-token accounting, not a timing): shared-prefix
+    # CoW silently falling back to private-block prefills reports ~1.0
+    # against a shared baseline's >2 and gates as a regression instead
+    # of masquerading as a sharing win (the int8/bf16 pattern)
+    "serving_prefix_prefill_reduction": 0.02,
 }
 # metrics where a RISE past tolerance is the regression (latencies);
 # compare_bench inverts the ratio so the shared gate math applies
@@ -1251,6 +1260,11 @@ def _gate_metrics(rec):
          "extras", "serving_fleet", "swap_p99_ttft_ms")
     take("fleet_tokens_per_sec",
          "extras", "serving_fleet", "tokens_per_sec")
+    # speculative decoding + shared-prefix CoW (loadtest phases 5+6)
+    take("serving_speculative_tokens_per_sec",
+         "extras", "serving_speculative", "tokens_per_sec")
+    take("serving_prefix_prefill_reduction",
+         "extras", "serving_prefix", "prefill_reduction")
     return out
 
 

@@ -1,0 +1,85 @@
+"""Persistent XLA compile cache — the DL4J_COMPILE_CACHE_DIR env seam.
+
+`nd/cache.enable_compilation_cache` (PR 1) is the mechanism; this
+module is the DEPLOYMENT seam: `DL4J_COMPILE_CACHE_DIR` names a
+directory that survives the process, and the two call sites that
+re-pay whole program grids route through here — fleet swap warmup
+(`GenerationServer.warmup`: every successor re-compiles the same
+(wave-width x length-bucket x variant) grid as its incumbent) and
+elastic mesh re-formation (`initialize_multihost`: every membership
+generation re-jits the train step for a usually-seen replica count).
+Both are ROADMAP-named levers; with the env var set, a revisited
+configuration deserializes its executables instead of re-compiling.
+
+Without the env var (or an explicit directory) nothing changes — the
+seam never turns itself on, because a shared cache directory is a
+deployment decision (cache poisoning / disk growth are operator
+concerns, docs/SERVING.md).
+
+One jax sharp edge this seam owns: jax builds its cache object LAZILY
+at first use and keeps it in a module global — merely updating
+`jax_compilation_cache_dir` after any compile has happened is silently
+ignored. Re-pointing therefore resets the cache instance too.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from pathlib import Path
+from typing import Optional
+
+from deeplearning4j_tpu.nd.cache import enable_compilation_cache
+
+log = logging.getLogger("deeplearning4j_tpu.nd.compile_cache")
+
+_ENV = "DL4J_COMPILE_CACHE_DIR"
+_enabled_dir: Optional[str] = None
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache at `cache_dir` (or
+    `$DL4J_COMPILE_CACHE_DIR` when not given). Returns the directory
+    in effect, or None when neither names one (no-op). Idempotent; a
+    DIFFERENT directory on a later call re-points the cache (resetting
+    jax's lazily-built cache instance — see module docstring) and
+    logs.
+
+    The minimum-compile-time threshold is zeroed: serving grids are
+    many SMALL programs (admission widths, length buckets, score
+    depths) whose individual compiles sit under jax's default 1s
+    threshold — exactly the programs a swap re-pays by the dozen."""
+    d = cache_dir if cache_dir is not None else os.environ.get(_ENV)
+    if not d:
+        return None
+    d = str(Path(d).expanduser())
+    global _enabled_dir
+    if _enabled_dir == d:
+        return d
+    out = enable_compilation_cache(d, min_compile_time_secs=0.0)
+    _reset_cache_instance()
+    if _enabled_dir is not None:
+        log.info("compile cache re-pointed %s -> %s", _enabled_dir, out)
+    else:
+        log.info("persistent XLA compile cache enabled at %s", out)
+    _enabled_dir = out
+    return out
+
+
+def _reset_cache_instance():
+    """Drop jax's lazily-initialized cache object so the next compile
+    re-reads `jax_compilation_cache_dir` — without this, enabling (or
+    re-pointing) after ANY prior compile silently keeps the old
+    destination."""
+    try:
+        from jax._src import compilation_cache as cc
+        cc.reset_cache()
+    except Exception as e:  # noqa: BLE001 — private-API drift tolerant
+        log.warning("compilation-cache instance reset unavailable (%s); "
+                    "a cache enabled after prior compiles may not take "
+                    "effect until the next process", e)
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The directory the env seam last enabled, or None."""
+    return _enabled_dir

@@ -282,6 +282,52 @@ class MultiHeadAttention(Layer):
                                     pos[:, None]),
                 k_pool, v_pool)
 
+    def forward_with_paged_cache_multi(self, params, x, k_pool, v_pool,
+                                       block_table, pos, n_valid):
+        """K-POSITION causal attention over the paged pool — the score
+        program of speculative decoding and the suffix-extension path
+        of copy-on-write shared-prefix admission (docs/SERVING.md).
+
+        `x` [S, K, D] holds K consecutive tokens per slot occupying
+        stream positions `pos[s] .. pos[s]+K-1`; `n_valid` [S] is how
+        many of those K are REAL for each slot (0 = the slot does not
+        participate in this dispatch). Writes for lanes `j >= n_valid`
+        are redirected to the reserved garbage block — position-space
+        indices past a slot's granted table (the budget edge of a dead
+        lane) are clamped BEFORE the table lookup so an out-of-range
+        gather can never alias a live block. Real lanes scatter exactly
+        where the single-token path would have, one dispatch later at a
+        time: lane j's K/V is the same projection of the same
+        activations, and its query attends over `<= pos+j` — so K
+        sequential single-token dispatches and one K-wide dispatch
+        write the same bytes and read the same masked view, which is
+        what makes the speculative greedy contract BIT-equality rather
+        than tolerance. Returns (y [S, K, D], k_pool', v_pool')."""
+        assert self.causal, "paged KV-cache decoding requires causal=True"
+        S, K = x.shape[0], x.shape[1]
+        bl = k_pool.shape[1]
+        q = self.heads(self._project(params, x, "Wq"))   # [S,K,H,Dh]
+        k = self.heads(self._project(params, x, "Wk"))
+        v = self.heads(self._project(params, x, "Wv"))
+        j = jnp.arange(K)[None, :]                       # [1, K]
+        posj = pos[:, None] + j                          # [S, K]
+        blk_idx = jnp.minimum(posj // bl, block_table.shape[1] - 1)
+        blk = jnp.take_along_axis(block_table, blk_idx, axis=1)
+        live = j < n_valid[:, None]
+        blk = jnp.where(live, blk, 0)                    # garbage block
+        off = posj % bl
+        # dead lanes may collide on (garbage, off) — scatter order is
+        # unspecified there, and irrelevant: garbage content is never
+        # read (every gather masks by the reader's own position)
+        k_pool = k_pool.at[blk, off].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[blk, off].set(v.astype(v_pool.dtype))
+        k_seq = k_pool[block_table]
+        k_seq = k_seq.reshape(S, -1, *k_seq.shape[3:])
+        v_seq = v_pool[block_table]
+        v_seq = v_seq.reshape(S, -1, *v_seq.shape[3:])
+        return (self._attend_cached(params, q, k_seq, v_seq, posj),
+                k_pool, v_pool)
+
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self.apply_input_dropout(x, train, rng)
         q = self.heads(self._project(params, x, "Wq"))   # [B,T,H,Dh]

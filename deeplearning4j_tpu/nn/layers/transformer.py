@@ -83,9 +83,18 @@ class PositionalEncodingLayer(BaseRecurrentLayer):
         path's scalar offset assumes every row sits at the same depth,
         which stops being true the moment sequences admit/evict
         mid-stream. Same table rows as the carry path (gather instead
-        of dynamic_slice), so the added signal is bit-identical."""
+        of dynamic_slice), so the added signal is bit-identical.
+
+        A 2-D `positions` [S, K] pairs with `x` [S, K, D] — the
+        K-position score program (speculative decoding / shared-prefix
+        suffix extension): each of a slot's K tokens gets its own
+        table row. Positions past `max_len` (dead score lanes at the
+        budget edge) clamp inside the gather; their outputs are
+        discarded by the caller."""
         D = x.shape[2]
         table = self._table(self.max_len, D, x.dtype)
+        if positions.ndim == 2:
+            return x + table[positions], state
         return x + table[positions][:, None, :], state
 
 
@@ -275,6 +284,27 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
         h, _ = self._ln1.forward(self._sub(params, "ln1"), {}, x)
         h, k_pool, v_pool = self._mha.forward_with_paged_cache(
             self._sub(params, "attn"), h, k_pool, v_pool, block_table, pos)
+        return (self._stream_tail(params, x, h, train=train, rng=rng),
+                k_pool, v_pool)
+
+    def forward_paged_multi(self, params, x, k_pool, v_pool, block_table,
+                            pos, n_valid, *, train=False, rng=None):
+        """K-position paged decode step (the speculative score program
+        and the CoW suffix-extension path): `x` [S, K, D] carries K
+        consecutive tokens per slot at positions `pos[s]..pos[s]+K-1`,
+        `n_valid` [S] bounds each slot's real lanes (writes past it go
+        to the garbage block — `MultiHeadAttention.forward_with_paged_
+        cache_multi`). The non-attention math is `_stream_tail`, the
+        same single body the one-token paged path and the monolithic
+        carry path run — per-lane outputs are therefore bit-equal to K
+        sequential `forward_paged` calls, the speculative parity
+        contract's layer-level half."""
+        if self._mha is None:
+            self._build_sublayers()
+        h, _ = self._ln1.forward(self._sub(params, "ln1"), {}, x)
+        h, k_pool, v_pool = self._mha.forward_with_paged_cache_multi(
+            self._sub(params, "attn"), h, k_pool, v_pool, block_table,
+            pos, n_valid)
         return (self._stream_tail(params, x, h, train=train, rng=rng),
                 k_pool, v_pool)
 

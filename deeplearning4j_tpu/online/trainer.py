@@ -42,7 +42,14 @@ class DriftGate(EvaluativeListener):
     below ``best - band``. `allow_publish()` is the gate callable the
     registry publish listener consults; `paused` flips back to False
     the moment the score recovers into the band. Training itself is
-    never touched."""
+    never touched.
+
+    ``metric="loss"`` is the LOSS-BAND mode: the held-out LOSS (mean
+    per-example `model.score`, masked-example aware through the loss
+    fn) replaces the classification score, best is the MINIMUM seen,
+    and the gate trips when loss RISES past ``best + band`` — which is
+    what regression and LM-perplexity online loops gate on, where
+    accuracy/f1 mean nothing."""
 
     def __init__(self, heldout, *, frequency: int = 50,
                  band: float = 0.1, metric: str = "accuracy",
@@ -53,6 +60,10 @@ class DriftGate(EvaluativeListener):
                          printer=printer or (lambda s: log.info(s)))
         if band <= 0:
             raise ValueError(f"band must be > 0, got {band}")
+        if metric not in ("accuracy", "f1", "loss"):
+            raise ValueError(
+                f"metric must be 'accuracy', 'f1' or 'loss'; "
+                f"got {metric!r}")
         self.metric = metric
         self.band = float(band)
         self.min_evals_before_gating = int(min_evals_before_gating)
@@ -84,21 +95,63 @@ class DriftGate(EvaluativeListener):
                     "gate", tag=self.tag),
             })
 
+    def _heldout_loss(self, model) -> float:
+        """Example-weighted mean loss over the held-out iterator (or a
+        single DataSet) through `model.score` — the exact training
+        objective, so the band compares like against like."""
+        import numpy as np
+
+        it = self.iterator
+        if hasattr(it, "features"):            # a bare DataSet
+            batches = [it]
+        else:
+            if hasattr(it, "reset"):
+                it.reset()
+            batches = it
+        total, n = 0.0, 0
+        for ds in batches:
+            b = int(np.asarray(ds.features).shape[0])
+            total += float(model.score(ds)) * b
+            n += b
+        if n == 0:
+            raise ValueError("held-out iterator yielded no examples")
+        return total / n
+
     def _evaluate(self, model, when):
-        super()._evaluate(model, when)
-        score = self._current_score(self.evaluations[-1])
+        loss_mode = self.metric == "loss"
+        if loss_mode:
+            score = self._heldout_loss(model)
+            self.printer(f"[{when}] heldout loss={score:.4f}")
+            from deeplearning4j_tpu import monitor
+            if monitor.is_enabled():
+                reg = monitor.registry()
+                reg.gauge("evaluative_score",
+                          help="held-out evaluation score from "
+                               "EvaluativeListener",
+                          tag=self.tag, metric="loss").set(float(score))
+                reg.gauge("evaluative_last_iteration",
+                          help="iteration of the last held-out "
+                               "evaluation",
+                          tag=self.tag).set(float(self._last_iteration))
+        else:
+            super()._evaluate(model, when)
+            score = self._current_score(self.evaluations[-1])
         self.last_score = score
         self._evals += 1
-        if self.best_score is None or score > self.best_score:
+        better = (score < self.best_score if loss_mode
+                  else score > self.best_score) \
+            if self.best_score is not None else True
+        if better:
             self.best_score = score
-        degraded = score < self.best_score - self.band
+        degraded = (score > self.best_score + self.band if loss_mode
+                    else score < self.best_score - self.band)
         if (degraded and not self.paused
                 and self._evals >= self.min_evals_before_gating):
             self.paused = True
             self.trips += 1
             log.warning(
-                "drift gate TRIPPED at %s: held-out %s %.4f fell more "
-                "than %.3f below best %.4f — publishing paused "
+                "drift gate TRIPPED at %s: held-out %s %.4f moved more "
+                "than %.3f past best %.4f — publishing paused "
                 "(training continues)", when, self.metric, score,
                 self.band, self.best_score)
             m = self._gate_metrics()

@@ -156,6 +156,13 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     changed (the elastic membership path)."""
     if getattr(initialize_multihost, "_done", False):
         return
+    # persistent XLA compile cache (DL4J_COMPILE_CACHE_DIR): elastic
+    # re-formation re-jits the train step per membership generation —
+    # revisited replica counts load their executables from disk
+    # instead of paying the full re-compile (the ROADMAP's
+    # per-width-compile-cache lever; no-op without the env var)
+    from deeplearning4j_tpu.nd.compile_cache import enable_compile_cache
+    enable_compile_cache()
     _enable_cpu_collectives()
     last_err: Optional[BaseException] = None
     for attempt in range(max(1, int(max_attempts))):

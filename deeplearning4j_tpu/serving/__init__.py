@@ -2,7 +2,9 @@
 plus the fleet deployment plane around it.
 
 - `serving.paged`    — block pools + host free/used accounting
-- `serving.engine`   — the jitted decode/prefill programs + slot state
+- `serving.engine`   — the jitted decode/prefill/score programs +
+  slot state (speculative draft-accept decoding, copy-on-write
+  shared-prefix admission)
 - `serving.server`   — the threaded scheduler (`GenerationServer`),
   token streams, SLO-aware shedding, the `drain()` hot-swap seam
 - `serving.registry` — versioned `ModelRegistry` over ModelSerializer

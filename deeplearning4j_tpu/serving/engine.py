@@ -87,10 +87,10 @@ class Slot:
     """Host mirror of one serving slot's in-flight sequence."""
 
     __slots__ = ("request_id", "blocks", "prompt_len", "n_tokens",
-                 "emitted", "pos", "emit_base")
+                 "emitted", "pos", "emit_base", "history")
 
     def __init__(self, request_id, blocks, prompt_len, n_tokens,
-                 emit_base=0):
+                 emit_base=0, history=None):
         self.request_id = request_id
         self.blocks = blocks
         self.prompt_len = prompt_len
@@ -101,6 +101,9 @@ class Slot:
         # continuation) — progress ordering and the sampled-rng emit
         # offset both count from here
         self.emit_base = emit_base
+        # full token history (prompt + every emitted token): the
+        # self-drafting proposer's n-gram suffix cache reads it
+        self.history: List[int] = history if history is not None else []
 
     @property
     def progress(self) -> int:
@@ -126,7 +129,9 @@ class PagedDecodeEngine:
                  block_len: int = 16, top_k: Optional[int] = None,
                  steps_per_dispatch: int = 1,
                  quantize: Optional[str] = None,
-                 allocation: str = "incremental"):
+                 allocation: str = "incremental",
+                 speculative: Optional[int] = None,
+                 spec_max_ngram: int = 3):
         if not getattr(net, "_initialized", False):
             net.init()
         self.net = net
@@ -144,6 +149,14 @@ class PagedDecodeEngine:
                 f"got {allocation!r}")
         self.allocation = allocation
         self.quantize = quantize
+        if speculative is not None:
+            speculative = int(speculative)
+            if speculative < 2:
+                raise ValueError(
+                    f"speculative (the draft depth k) must be >= 2 — "
+                    f"k=1 is ordinary decode; got {speculative}")
+        self.spec_k = speculative
+        self.spec_max_ngram = int(spec_max_ngram)
         # pay the quantization pass NOW, not inside the first live
         # dispatch (the tree itself is resolved per dispatch — see
         # the _params property)
@@ -166,6 +179,11 @@ class PagedDecodeEngine:
                              f"got {top_k}")
         self.max_blocks = budget // int(block_len)
         self.max_total_tokens = budget
+        if self.spec_k is not None and self.spec_k > budget:
+            raise ValueError(
+                f"speculative depth {self.spec_k} exceeds the stream "
+                f"budget {budget} — no slot could ever take a full-"
+                f"depth dispatch")
         self.pool = PagedKVPool(net, n_blocks, block_len)
         self.block_len = int(block_len)
         # a serving "plan": how each layer participates in the paged
@@ -204,11 +222,36 @@ class PagedDecodeEngine:
         self._decode_full = None      # greedy + sampling chain
         self._decode_greedy = None    # argmax only (no sort/rng ops)
         self._admit_finish = {}       # k -> fused write-pages+first-token
+        # K-position score programs (speculative decode + CoW suffix
+        # extension), keyed (K, greedy_only — K is baked into the
+        # array shapes, but the variants differ in OPS); the fork copy
+        # and the first-token samplers (exact prefix-match admission,
+        # keyed greedy_only) are shape-polymorphic single jits — jit's
+        # own per-shape cache covers every pow2 width
+        self._score = {}
+        self._fork = None
+        self._first_token = {}
+        # copy-on-write shared-prefix registry: key (token-id tuple) ->
+        # {tokens, len, blocks, probs}; the cache itself holds one
+        # allocator reference per block so registered prefixes survive
+        # every slot release
+        self._prefixes: Dict[tuple, dict] = {}
+        self.prefix_pinned_blocks = 0
         # allocator observability (host ints — the scheduler mirrors
         # them onto the metrics registry) + preemption notices the
         # scheduler drains for requeue
         self.block_grants_total = 0
         self.evict_requeue_total = 0
+        # speculative-decoding accounting (host ints; the scheduler's
+        # accept-rate EWMA and the serving_spec_* gauges read them)
+        self.spec_dispatches_total = 0
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        self.spec_emitted_total = 0
+        # shared-prefix accounting
+        self.prefix_hits_total = 0
+        self.prefix_tokens_saved_total = 0
+        self.prefix_forks_total = 0
         self._preempted: List[dict] = []
 
     # ------------------------------------------------------------ queries
@@ -242,18 +285,69 @@ class PagedDecodeEngine:
             return blocks_needed(prompt_len, self.block_len)
         return blocks_needed(prompt_len + n_tokens, self.block_len)
 
-    def can_admit(self, prompt_len: int, n_tokens: int) -> bool:
-        return (any(s is None for s in self.slots)
-                and self._admit_blocks(prompt_len, n_tokens)
-                <= self.pool.free_blocks)
+    @property
+    def has_prefixes(self) -> bool:
+        return bool(self._prefixes)
 
-    def check_budget(self, prompt_len: int, n_tokens: int):
+    def _match_prefix(self, prompt) -> Optional[dict]:
+        """The LONGEST registered prefix that prefixes `prompt`, or
+        None. O(#prefixes x prefix_len) numpy compares — the registry
+        holds a handful of warmed system prompts, not a trie."""
+        if not self._prefixes:
+            return None
+        best = None
+        # list() snapshot: submitter threads run this through
+        # check_budget while the scheduler thread applies register/
+        # release control requests — iterating the live dict would
+        # raise "changed size during iteration" in an innocent submit
+        for e in list(self._prefixes.values()):
+            P = e["len"]
+            if P > prompt.shape[0]:
+                continue
+            if best is not None and P <= best["len"]:
+                continue
+            if np.array_equal(np.asarray(prompt[:P], np.int64),
+                              e["tokens"]):
+                best = e
+        return best
+
+    def _cow_fresh_blocks(self, entry: dict, map_tokens: int) -> int:
+        """Fresh (non-shared) blocks a CoW admission mapping
+        `map_tokens` positions must allocate: the full map minus the
+        shared prefix blocks, plus one for the forked tail when the
+        prefix ends mid-block (copy-on-first-write — the fork target
+        is a fresh block; the slot's reference on the shared source is
+        dropped at fork time)."""
+        nb_sh = blocks_needed(entry["len"], self.block_len)
+        fork = 0 if entry["len"] % self.block_len == 0 else 1
+        return blocks_needed(map_tokens, self.block_len) - nb_sh + fork
+
+    def can_admit(self, prompt_len: int, n_tokens: int,
+                  prompt_ids=None) -> bool:
+        if not any(s is None for s in self.slots):
+            return False
+        if prompt_ids is not None and self._prefixes:
+            entry = self._match_prefix(np.asarray(prompt_ids))
+            if entry is not None:
+                map_tokens = (prompt_len if self.allocation == "incremental"
+                              else prompt_len + n_tokens)
+                return (self._cow_fresh_blocks(entry, map_tokens)
+                        <= self.pool.free_blocks)
+        return self._admit_blocks(prompt_len, n_tokens) \
+            <= self.pool.free_blocks
+
+    def check_budget(self, prompt_len: int, n_tokens: int,
+                     prompt_ids=None):
         """Reject requests that can NEVER be admitted — distinct from
         `can_admit` (not right now): over the per-sequence page budget,
-        or needing more blocks AT THE END than the whole pool owns
-        (under incremental allocation a request must still be able to
-        finish alone in the pool — pool-pressure preemption can evict
-        every OTHER slot, never conjure capacity)."""
+        or needing more blocks AT THE END than the pool can ever free
+        up (under incremental allocation a request must still be able
+        to finish alone in the pool — pool-pressure preemption can
+        evict every OTHER slot, never conjure capacity, and blocks
+        pinned by the shared-prefix cache never free). With
+        `prompt_ids`, a request that RIDES a registered prefix is
+        charged only its fresh blocks — sharing is exactly what makes
+        an otherwise-oversized request admittable."""
         total = prompt_len + n_tokens
         if n_tokens < 1:
             raise ValueError(f"n_tokens must be >= 1; got {n_tokens}")
@@ -264,14 +358,21 @@ class PagedDecodeEngine:
                 f"{self.max_total_tokens} (max_blocks {self.max_blocks} x "
                 f"block_len {self.block_len}); this request can never be "
                 f"admitted — rebuild the model with a larger max_len")
-        usable = self.pool.n_blocks - 1      # id 0 is the garbage block
-        if blocks_needed(total, self.block_len) > usable:
+        # id 0 is the garbage block; prefix-cache pins never free
+        usable = self.pool.n_blocks - 1 - self.prefix_pinned_blocks
+        needed = blocks_needed(total, self.block_len)
+        if prompt_ids is not None and self._prefixes:
+            entry = self._match_prefix(np.asarray(prompt_ids))
+            if entry is not None:
+                needed = self._cow_fresh_blocks(entry, total)
+        if needed > usable:
             raise ValueError(
-                f"request needs {blocks_needed(total, self.block_len)} "
+                f"request needs {needed} "
                 f"pool blocks but the pool only has {usable} usable "
                 f"(n_blocks {self.pool.n_blocks} incl. the reserved "
-                f"garbage block); it can never be admitted — grow "
-                f"n_blocks or shorten the request")
+                f"garbage block and {self.prefix_pinned_blocks} pinned "
+                f"by registered prefixes); it can never be admitted — "
+                f"grow n_blocks or shorten the request")
 
     # ----------------------------------------------------------- sampling
     def _sample_ids(self, probs, keys, emit_idx, temp, top_p,
@@ -425,6 +526,172 @@ class PagedDecodeEngine:
 
         return jax.jit(admit_finish, donate_argnums=donate_argnums(0))
 
+    def _score_body(self, greedy_only: bool):
+        """The K-position score program (zoo.transformer.
+        paged_score_forward): ONE target-model dispatch scores K
+        proposed tokens per slot — speculative decoding's target half
+        — or extends a shared prefix by a K-bucketed suffix (CoW
+        admission). Returns (kv', greedy_mat [S, K] — the target's
+        argmax after each position, the acceptance oracle — and
+        chosen [S], the sampled/greedy token at each slot's LAST valid
+        position, which is the first emitted token on the suffix
+        path and the sampled-slot token on the speculative path)."""
+        net, plan = self.net, self._plan
+        from deeplearning4j_tpu.zoo.transformer import paged_score_forward
+
+        def score(params, state, kv, block_tables, token_mat, pos,
+                  n_valid, keys, emit_idx, temp, top_p):
+            params = net.dtype.cast_params(params)
+            kv, probs = paged_score_forward(
+                net, plan, params, state, kv, block_tables, token_mat,
+                pos, n_valid)
+            greedy_mat = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            last = jnp.take_along_axis(
+                probs, jnp.maximum(n_valid - 1, 0)[:, None, None],
+                axis=1)[:, 0]                              # [S, V]
+            chosen = self._sample_ids(last, keys, emit_idx, temp, top_p,
+                                      greedy_only=greedy_only)
+            return kv, greedy_mat, chosen
+
+        return score
+
+    def _get_score(self, K: int, greedy_only: bool):
+        key = (int(K), bool(greedy_only))
+        fn = self._score.get(key)
+        if fn is None:
+            fn = self._score[key] = jax.jit(
+                self._score_body(greedy_only),
+                donate_argnums=donate_argnums(2))
+        return fn
+
+    def _build_fork(self):
+        """Copy-on-write block fork: one dispatch copies a vector of
+        pool blocks src -> dst across every layer's K and V pool.
+        Unused lanes point both ids at the garbage block (a garbage-
+        to-garbage self-copy — the one block whose content is never
+        read). One jit; each pow2 pair-vector width is its own
+        shape-keyed executable."""
+
+        def fork(kv, src, dst):
+            out = []
+            for k_pool, v_pool in kv:
+                out.append((k_pool.at[dst].set(k_pool[src]),
+                            v_pool.at[dst].set(v_pool[src])))
+            return tuple(out)
+
+        return jax.jit(fork, donate_argnums=donate_argnums(0))
+
+    def _run_fork(self, pairs):
+        w = 1
+        while w < len(pairs):
+            w *= 2
+        src = np.full(w, GARBAGE_BLOCK, np.int32)
+        dst = np.full(w, GARBAGE_BLOCK, np.int32)
+        for i, (s, d) in enumerate(pairs):
+            src[i], dst[i] = s, d
+        if self._fork is None:
+            self._fork = self._build_fork()
+        self.pool.kv = self._fork(self.pool.kv, jnp.asarray(src),
+                                  jnp.asarray(dst))
+        self.prefix_forks_total += len(pairs)
+
+    def _build_first_token(self, greedy_only: bool):
+        """Sampling tail alone (no forward): first tokens of exact-
+        prefix-match admissions, whose next-token distribution was
+        cached at registration. The same `_sample_ids` chain the
+        admit/decode programs run — same math, same bits."""
+
+        def first(probs, keys, emit0, temp, top_p):
+            return self._sample_ids(probs, keys, emit0, temp, top_p,
+                                    greedy_only=greedy_only)
+
+        return jax.jit(first)
+
+    # ------------------------------------------------- shared prefixes
+    def register_prefix(self, token_ids) -> tuple:
+        """Warm a shared prompt prefix into the pool ONCE: prefill it
+        (the same bucketed-prefill program family admission waves
+        run), scatter its K/V into dedicated pool blocks, and pin
+        those blocks under a cache-held allocator reference. Every
+        later admission whose prompt starts with these ids maps the
+        blocks instead of re-prefilling them (`serving_prefix_hits_
+        total` / `serving_prefix_blocks_shared`). Idempotent per id
+        sequence; returns the registry key. Raises when the pool
+        cannot host the prefix right now — registration is a capacity
+        commitment, not a best-effort hint."""
+        prompt = np.asarray(token_ids)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError(
+                f"prefix must be a non-empty 1-D id sequence; got "
+                f"shape {prompt.shape}")
+        P = int(prompt.shape[0])
+        if P >= self.max_total_tokens:
+            raise ValueError(
+                f"prefix of {P} tokens leaves no room to generate "
+                f"under the {self.max_total_tokens}-token page budget")
+        key = tuple(int(t) for t in prompt)
+        if key in self._prefixes:
+            return key
+        nb = blocks_needed(P, self.block_len)
+        blocks = self.pool.allocator.allocate(nb)
+        if blocks is None:
+            raise ValueError(
+                f"pool cannot host a {nb}-block prefix right now "
+                f"({self.pool.free_blocks} free) — register prefixes "
+                f"before admitting traffic, or grow n_blocks")
+        try:
+            from deeplearning4j_tpu.zoo.transformer import (
+                get_prefill_bucketed)
+            net = self.net
+            Pb = bucket_len(P, self.max_total_tokens)
+            prompts = np.zeros((1, Pb), np.int32)
+            prompts[0, :P] = prompt
+            carries = {str(i): layer.init_carry(1, net.dtype.compute_dtype)
+                       for i, layer in enumerate(net.layers)
+                       if isinstance(layer, BaseRecurrentLayer)}
+            probs, carries = get_prefill_bucketed(net)(
+                self._params, net.net_state, jnp.asarray(prompts),
+                carries, jnp.asarray([P - 1], np.int32))
+            block_carries = [carries[str(i)]
+                             for i in self.pool.layer_indices]
+            max_rows = max(c[0].shape[1] // self.block_len
+                           for c in block_carries)
+            rows = np.full((1, max_rows), GARBAGE_BLOCK, np.int32)
+            rows[0, :nb] = blocks
+            # the admit_finish program scatters the pages; its sampled
+            # first token is discarded (registration emits nothing) —
+            # but the LAST-position probs are kept: an exact-match
+            # admission samples its first token from them with no
+            # forward pass at all
+            fin = self._admit_finish.get((1, True))
+            if fin is None:
+                fin = self._admit_finish[(1, True)] = \
+                    self._build_admit_finish(1, True)
+            self.pool.kv, _ = fin(
+                self.pool.kv, jnp.asarray(rows),
+                tuple((c[0], c[1]) for c in block_carries), probs,
+                jnp.zeros((1, 2), np.uint32), jnp.zeros(1, np.int32),
+                jnp.zeros(1, np.float32), jnp.ones(1, np.float32))
+        except Exception:
+            self.pool.allocator.free(blocks)
+            raise
+        self._prefixes[key] = dict(
+            tokens=np.asarray(prompt, np.int64), len=P, blocks=blocks,
+            probs=np.asarray(probs[0]))
+        self.prefix_pinned_blocks += nb
+        self.block_grants_total += nb
+        return key
+
+    def release_prefix(self, key: tuple):
+        """Unpin a registered prefix: the cache's block references
+        drop; blocks still mapped by in-flight slots stay granted
+        until those slots release (the refcount contract)."""
+        entry = self._prefixes.pop(tuple(key))
+        self.pool.allocator.free(entry["blocks"])
+        self.prefix_pinned_blocks -= len(entry["blocks"])
+
     # ---------------------------------------------------------- admission
     def admit(self, prompt_ids, n_tokens: int, *, request_id=None,
               temperature: float = 0.0, top_p: Optional[float] = None,
@@ -470,21 +737,30 @@ class PagedDecodeEngine:
                         f"got shape {prompt.shape}")
                 P = int(prompt.shape[0])
                 n_tokens = int(r["n_tokens"])
-                self.check_budget(P, n_tokens)
+                self.check_budget(P, n_tokens, prompt_ids=prompt)
                 slot = next((i for i, s in enumerate(self.slots)
                              if s is None
-                             and all(i != w[0] for w in wave)),
+                             and all(i != w["slot"] for w in wave)),
                             None)
                 if slot is None:
                     break
-                nb = self._admit_blocks(P, n_tokens)
-                blocks = self.pool.allocator.allocate(nb)
-                if blocks is None:
-                    break
-                wave.append((slot, prompt, n_tokens, nb, blocks, r))
+                entry = self._match_prefix(prompt)
+                if entry is None:
+                    nb = self._admit_blocks(P, n_tokens)
+                    blocks = self.pool.allocator.allocate(nb)
+                    if blocks is None:
+                        break
+                    w = dict(blocks=blocks, grants=nb, entry=None,
+                             fork=None)
+                else:
+                    w = self._cow_admit_blocks(entry, P, n_tokens)
+                    if w is None:
+                        break
+                w.update(slot=slot, prompt=prompt, n_tokens=n_tokens, r=r)
+                wave.append(w)
             if not wave:
                 return []
-            return self._admit_wave(wave)
+            return self._admit_dispatch(wave)
         except Exception:
             # a mid-wave failure (validation of a later request, a
             # prefill/admit dispatch error) must return the wave's
@@ -493,17 +769,69 @@ class PagedDecodeEngine:
             # shrink permanently (capacity leak -> eventual silent
             # starvation of every later admission). Entries a Slot DID
             # take ownership of (partial bookkeeping) keep theirs —
-            # the normal release path frees those.
-            for slot, _, _, _, blocks, _ in wave:
-                s = self.slots[slot]
-                if s is None or s.blocks is not blocks:
+            # the normal release path frees those. A CoW entry's list
+            # mixes fresh blocks and shared-prefix references; `free`
+            # handles both uniformly (fresh return to the free list,
+            # shares decrement back to the cache's own reference).
+            for w in wave:
+                s = self.slots[w["slot"]]
+                if s is None or s.blocks is not w["blocks"]:
                     try:
-                        self.pool.allocator.free(blocks)
+                        self.pool.allocator.free(w["blocks"])
                     except ValueError:
                         pass   # already back in the pool
             raise
 
-    def _admit_wave(self, wave):
+    def _cow_admit_blocks(self, entry: dict, prompt_len: int,
+                          n_tokens: int) -> Optional[dict]:
+        """Block grants for a shared-prefix admission: take one
+        allocator reference per shared prefix block, allocate the fresh
+        remainder, and — when the prefix ends mid-block — fork the
+        partially-filled tail NOW (copy-on-first-write realized at
+        admission: the very next write, suffix prefill or first decode
+        token, lands in that block, and a write into a block someone
+        else still maps would corrupt every other reader). The fork
+        drops this slot's just-taken reference on the shared source
+        (the refcount-decrement half of the CoW contract); the cache's
+        own reference keeps the source alive for the next admission.
+        Returns the wave-entry dict, or None when the pool can't cover
+        the fresh blocks right now."""
+        alloc = self.pool.allocator
+        bl = self.block_len
+        P = entry["len"]
+        nb_sh = blocks_needed(P, bl)
+        map_tokens = (prompt_len if self.allocation == "incremental"
+                      else prompt_len + n_tokens)
+        n_fresh = self._cow_fresh_blocks(entry, map_tokens)
+        fresh = [] if n_fresh == 0 else alloc.allocate(n_fresh)
+        if fresh is None:
+            return None
+        alloc.share(entry["blocks"][:nb_sh])
+        if P % bl == 0:
+            blocks = list(entry["blocks"][:nb_sh]) + fresh
+            fork = None
+        else:
+            src, dst = entry["blocks"][nb_sh - 1], fresh[0]
+            alloc.free([src])                # drop OUR tail reference
+            fork = (src, dst)
+            blocks = list(entry["blocks"][:nb_sh - 1]) + [dst] + fresh[1:]
+        return dict(blocks=blocks, grants=n_fresh, entry=entry, fork=fork)
+
+    def _admit_dispatch(self, wave):
+        """Route one capacity-granted admission wave through its
+        dispatch paths — full prefill for fresh prompts, fork + suffix
+        extension for shared-prefix hits — and return results in the
+        wave's (FIFO) input order."""
+        results = {}
+        norm = [w for w in wave if w["entry"] is None]
+        cow = [w for w in wave if w["entry"] is not None]
+        if norm:
+            self._admit_wave(norm, results)
+        if cow:
+            self._admit_wave_shared(cow, results)
+        return [results[w["slot"]] for w in wave]
+
+    def _admit_wave(self, wave, results):
         k = len(wave)
         # pad the wave WIDTH to the next power of two: every distinct
         # batch width costs a prefill + admit_finish COMPILE, and
@@ -519,7 +847,7 @@ class PagedDecodeEngine:
         # admissions under realistic traffic): right padding is sound
         # because the blocks are causal and the padding rows' K/V land
         # past each slot's position, where every later read masks them
-        Pb = bucket_len(max(int(w[1].shape[0]) for w in wave),
+        Pb = bucket_len(max(int(w["prompt"].shape[0]) for w in wave),
                         self.max_total_tokens)
 
         net = self.net
@@ -531,8 +859,8 @@ class PagedDecodeEngine:
         prompts = np.zeros((k2, Pb), np.int32)
         last_idx = np.zeros(k2, np.int32)
         for j, w in enumerate(wave):
-            prompts[j, :w[1].shape[0]] = w[1]
-            last_idx[j] = w[1].shape[0] - 1
+            prompts[j, :w["prompt"].shape[0]] = w["prompt"]
+            last_idx[j] = w["prompt"].shape[0] - 1
         for j in range(k, k2):                # dummy width-padding rows
             prompts[j] = prompts[k - 1]
             last_idx[j] = last_idx[k - 1]
@@ -548,8 +876,9 @@ class PagedDecodeEngine:
         emit0 = np.zeros(k2, np.int32)
         temps = np.zeros(k2, np.float32)
         top_ps = np.ones(k2, np.float32)
-        for j, (slot, prompt, n_tokens, nb, blocks, r) in enumerate(wave):
-            rows[j, :nb] = blocks
+        for j, w in enumerate(wave):
+            rows[j, :len(w["blocks"])] = w["blocks"]
+            r = w["r"]
             if r.get("rng") is not None:
                 keys[j] = np.asarray(r["rng"], np.uint32).reshape(2)
             emit0[j] = int(r.get("emit_start") or 0)
@@ -571,29 +900,139 @@ class PagedDecodeEngine:
             jnp.asarray(top_ps))
         firsts = np.asarray(firsts)
 
-        out = []
-        for j, (slot, prompt, n_tokens, nb, blocks, r) in enumerate(wave):
-            first = int(firsts[j])
-            done = n_tokens == 1
-            self.slots[slot] = Slot(r.get("request_id"), blocks,
-                                    len(prompt), n_tokens,
-                                    emit_base=int(emit0[j]))
-            self.slots[slot].emitted = 1
+        for j, w in enumerate(wave):
+            self._finish_admission(w, int(firsts[j]), keys[j], results)
+
+    def _finish_admission(self, w, first, key, results):
+        """Slot bookkeeping shared by the fresh-prefill and shared-
+        prefix admission paths (one body — the two must not drift)."""
+        slot, prompt, blocks = w["slot"], w["prompt"], w["blocks"]
+        n_tokens, r = w["n_tokens"], w["r"]
+        emit0 = int(r.get("emit_start") or 0)
+        done = n_tokens == 1
+        # token history feeds the self-drafting proposer only — a
+        # non-speculative server skips the per-admission O(prompt)
+        # copy and the per-dispatch extends entirely
+        s = Slot(r.get("request_id"), blocks, len(prompt), n_tokens,
+                 emit_base=emit0,
+                 history=([int(t) for t in prompt] + [first]
+                          if self.spec_k else []))
+        s.emitted = 1
+        self.slots[slot] = s
+        self.block_tables[slot] = GARBAGE_BLOCK
+        self.block_tables[slot, :len(blocks)] = blocks
+        self.pos[slot] = len(prompt)
+        self.remaining[slot] = n_tokens - 1
+        self.emit_idx[slot] = emit0 + 1
+        self.last_token[slot] = first
+        self.keys[slot] = key
+        self.temp[slot] = r.get("temperature") or 0.0
+        p = r.get("top_p")
+        self.top_p[slot] = 1.0 if p is None else p
+        self.active[slot] = not done
+        self.block_grants_total += w["grants"]
+        if w["entry"] is not None:
+            self.prefix_hits_total += 1
+            self.prefix_tokens_saved_total += w["entry"]["len"]
+        if done:
+            self._release(slot)
+        results[slot] = (slot, first, done)
+
+    def _admit_wave_shared(self, wave, results):
+        """Shared-prefix (CoW) admission: the prefix blocks are already
+        in the pool — fork any mid-block tails, run the K-position
+        score program over the suffixes (ONE dispatch extends every
+        hit past its shared region, attending the shared blocks
+        through the slot's table), and sample first tokens — from the
+        suffix scores, or from the prefix's cached last-position probs
+        when the prompt IS the prefix. No monolithic prefill runs at
+        all: that is the `serving_prefix_prefill_reduction` lever."""
+        # fork copies must land BEFORE any suffix/decode write reaches
+        # a block another holder still maps
+        pairs = [w["fork"] for w in wave if w["fork"] is not None]
+        if pairs:
+            self._run_fork(pairs)
+        for w in wave:
+            slot = w["slot"]
             self.block_tables[slot] = GARBAGE_BLOCK
-            self.block_tables[slot, :nb] = blocks
-            self.pos[slot] = len(prompt)
-            self.remaining[slot] = n_tokens - 1
-            self.emit_idx[slot] = int(emit0[j]) + 1
-            self.last_token[slot] = first
-            self.keys[slot] = keys[j]
-            self.temp[slot] = temps[j]
-            self.top_p[slot] = top_ps[j]
-            self.active[slot] = not done
-            self.block_grants_total += nb
-            if done:
-                self._release(slot)
-            out.append((slot, first, done))
-        return out
+            self.block_tables[slot, :len(w["blocks"])] = w["blocks"]
+            w["suffix"] = w["prompt"][w["entry"]["len"]:]
+        keys_by_slot = {}
+        firsts = {}
+        ext = [w for w in wave if w["suffix"].shape[0] > 0]
+        if ext:
+            S = self.n_slots
+            K = bucket_len(max(int(w["suffix"].shape[0]) for w in ext),
+                           self.max_total_tokens)
+            token_mat = np.zeros((S, K), np.int32)
+            n_valid = np.zeros(S, np.int32)
+            pos = np.zeros(S, np.int32)
+            keys = np.zeros((S, 2), np.uint32)
+            emit0 = np.zeros(S, np.int32)
+            temps = np.zeros(S, np.float32)
+            top_ps = np.ones(S, np.float32)
+            for w in ext:
+                s, r = w["slot"], w["r"]
+                Ts = int(w["suffix"].shape[0])
+                token_mat[s, :Ts] = w["suffix"]
+                n_valid[s] = Ts
+                pos[s] = w["entry"]["len"]
+                if r.get("rng") is not None:
+                    keys[s] = np.asarray(r["rng"], np.uint32).reshape(2)
+                emit0[s] = int(r.get("emit_start") or 0)
+                temps[s] = r.get("temperature") or 0.0
+                p = r.get("top_p")
+                top_ps[s] = 1.0 if p is None else p
+                keys_by_slot[s] = keys[s].copy()
+            greedy = not bool((temps > 0).any())
+            score = self._get_score(K, greedy)
+            kv, _, chosen = score(
+                self._params, self.net.net_state, self.pool.kv,
+                jnp.asarray(self.block_tables), jnp.asarray(token_mat),
+                jnp.asarray(pos), jnp.asarray(n_valid),
+                jnp.asarray(keys), jnp.asarray(emit0),
+                jnp.asarray(temps), jnp.asarray(top_ps))
+            self.pool.kv = kv
+            chosen = np.asarray(chosen)
+            for w in ext:
+                firsts[w["slot"]] = int(chosen[w["slot"]])
+        # exact-match admissions (prompt == prefix): next-token probs
+        # were computed ONCE at registration — nothing to prefill,
+        # just run the sampling tail on the cached distribution
+        empt = [w for w in wave if w["suffix"].shape[0] == 0]
+        if empt:
+            width = 1
+            while width < len(empt):
+                width *= 2
+            probs0 = empt[0]["entry"]["probs"]
+            probs = np.zeros((width,) + probs0.shape, probs0.dtype)
+            keys = np.zeros((width, 2), np.uint32)
+            emit0 = np.zeros(width, np.int32)
+            temps = np.zeros(width, np.float32)
+            top_ps = np.ones(width, np.float32)
+            for j, w in enumerate(empt):
+                r = w["r"]
+                probs[j] = w["entry"]["probs"]
+                if r.get("rng") is not None:
+                    keys[j] = np.asarray(r["rng"], np.uint32).reshape(2)
+                emit0[j] = int(r.get("emit_start") or 0)
+                temps[j] = r.get("temperature") or 0.0
+                p = r.get("top_p")
+                top_ps[j] = 1.0 if p is None else p
+                keys_by_slot[w["slot"]] = keys[j].copy()
+            greedy = not bool((temps > 0).any())
+            fn = self._first_token.get(greedy)
+            if fn is None:
+                fn = self._first_token[greedy] = \
+                    self._build_first_token(greedy)
+            ids = np.asarray(fn(jnp.asarray(probs), jnp.asarray(keys),
+                                jnp.asarray(emit0), jnp.asarray(temps),
+                                jnp.asarray(top_ps)))
+            for j, w in enumerate(empt):
+                firsts[w["slot"]] = int(ids[j])
+        for w in wave:
+            self._finish_admission(w, firsts[w["slot"]],
+                                   keys_by_slot[w["slot"]], results)
 
     # -------------------------------------------- incremental block grants
     def _lowest_progress_active(self) -> int:
@@ -627,48 +1066,102 @@ class PagedDecodeEngine:
         out, self._preempted = self._preempted, []
         return out
 
-    def _grow_block_tables(self):
-        """Lazy block grants before a decode dispatch: every active
-        slot gets the blocks the chunk's writes will cross into. Under
-        pool pressure the lowest-progress slot is evicted (requeue, not
-        deadlock); eviction frees at least one block per round, and
-        check_budget guarantees a slot left alone in the pool can
-        always finish — so this terminates with every surviving slot
-        fully granted."""
+    def _allocate_under_pressure(self, s: int, n: int):
+        """Allocate `n` blocks for slot `s`, preempting the lowest-
+        progress slot under pool pressure (requeue, not deadlock);
+        returns None when `s` itself lost the pool race (it has been
+        preempted and released)."""
+        got = self.pool.allocator.allocate(n)
+        while got is None:
+            victim = self._lowest_progress_active()
+            self._preempt(victim)
+            if victim == s:
+                return None            # s itself lost the pool race
+            got = self.pool.allocator.allocate(n)
+        return got
+
+    def _grow_block_tables(self, tokens_by_slot=None):
+        """Pre-dispatch block grants: every active slot gets the blocks
+        its write window `[pos, pos + tokens)` will cross into (lazy
+        growth, incremental allocation), and any window block the slot
+        does NOT own exclusively — refcount > 1: still mapped by the
+        shared-prefix cache or another slot — is FORKED first
+        (copy-on-first-write: fresh block, device copy, the slot's
+        reference on the shared source dropped). Admission forks the
+        common case eagerly; this pass is the invariant's enforcement
+        point — no dispatch may ever write a block another holder
+        reads. Under pool pressure the lowest-progress slot is evicted
+        (requeue, not deadlock); check_budget guarantees a slot left
+        alone in the pool can always finish — prefix-pinned blocks
+        excluded — so this terminates with every surviving slot fully
+        granted and exclusively owning its window."""
         J = self.steps_per_dispatch
+        fork_pairs = []
         for s in range(self.n_slots):
             if not self.active[s] or self.slots[s] is None:
                 continue
             slot = self.slots[s]
-            tokens = min(J, int(self.remaining[s]))
+            if tokens_by_slot is None:
+                tokens = min(J, int(self.remaining[s]))
+            else:
+                tokens = int(tokens_by_slot.get(s, 0))
+            if tokens < 1:
+                continue
             needed = blocks_needed(int(self.pos[s]) + tokens,
                                    self.block_len)
             have = len(slot.blocks)
-            if needed <= have:
-                continue
-            got = self.pool.allocator.allocate(needed - have)
-            while got is None:
-                victim = self._lowest_progress_active()
-                self._preempt(victim)
-                if victim == s:
-                    break              # s itself lost the pool race
-                got = self.pool.allocator.allocate(needed - have)
-            if got is None or self.slots[s] is None:
-                continue
-            slot.blocks.extend(got)
-            self.block_tables[s, have:needed] = got
-            self.block_grants_total += len(got)
+            if needed > have:
+                got = self._allocate_under_pressure(s, needed - have)
+                if got is None or self.slots[s] is None:
+                    continue
+                slot.blocks.extend(got)
+                self.block_tables[s, have:needed] = got
+                self.block_grants_total += len(got)
+            # copy-on-first-write fork of shared write-window blocks
+            first_b = int(self.pos[s]) // self.block_len
+            last_b = (int(self.pos[s]) + tokens - 1) // self.block_len
+            for bi in range(first_b, min(last_b + 1, len(slot.blocks))):
+                src = slot.blocks[bi]
+                if self.pool.allocator.refcount(src) <= 1:
+                    continue
+                got = self._allocate_under_pressure(s, 1)
+                if got is None or self.slots[s] is None:
+                    break              # s lost the pool race mid-fork
+                dst = got[0]
+                fork_pairs.append((s, src, dst))
+                slot.blocks[bi] = dst
+                self.block_tables[s, bi] = dst
+                self.pool.allocator.free([src])   # drop OUR reference
+                self.block_grants_total += 1
+        # a slot preempted AFTER recording a fork has already freed its
+        # dst block (maybe even re-granted to a later slot this pass) —
+        # copying into it now would corrupt the new owner; only live
+        # slots' forks dispatch
+        fork_pairs = [(src, dst) for s, src, dst in fork_pairs
+                      if self.slots[s] is not None]
+        if fork_pairs:
+            self._run_fork(fork_pairs)
 
     # ------------------------------------------------------------- decode
-    def step(self) -> Tuple[Dict[int, List[int]], List[int]]:
+    def step(self, *, speculate: Optional[bool] = None
+             ) -> Tuple[Dict[int, List[int]], List[int]]:
         """One continuous-batching dispatch: every active slot advances
-        up to `steps_per_dispatch` tokens. Returns ({slot: [tokens
-        emitted this dispatch]}, [slots that finished and were
+        up to `steps_per_dispatch` tokens — or, with `speculative=k`
+        configured (and `speculate` not overridden to False by the
+        scheduler's accept-rate policy), up to k tokens through ONE
+        k-position score dispatch (`_spec_step`). Returns ({slot:
+        [tokens emitted this dispatch]}, [slots that finished and were
         released]). Under incremental allocation, slots whose next
         writes cross a block boundary are granted blocks first — and
         pool pressure preempts the lowest-progress slot into
         `drain_preempted()` instead of deadlocking."""
-        if self.allocation == "incremental":
+        if speculate is None:
+            speculate = self.spec_k is not None
+        if speculate and self.spec_k:
+            return self._spec_step()
+        if self.allocation == "incremental" or self._prefixes:
+            # upfront allocation never grows, but the CoW fork pass
+            # (shared write-window blocks) must still run
             self._grow_block_tables()
         if not self.active.any():
             return {}, []
@@ -708,9 +1201,142 @@ class PagedDecodeEngine:
             emitted[i] = [int(t) for t in toks[valids[:, i], i]]
             self.slots[i].emitted += int(taken[i])
             self.slots[i].pos = int(self.pos[i])
+            if self.spec_k:
+                self.slots[i].history.extend(emitted[i])
             if self.remaining[i] <= 0:
                 finished.append(i)
                 self._release(i)
+        return emitted, finished
+
+    # ------------------------------------------------- speculative decode
+    def _propose(self, s: int, max_draft: int) -> List[int]:
+        """Self-drafting proposer: an n-gram suffix cache over the
+        slot's own token history (prompt + emitted). The continuation
+        that followed the MOST RECENT earlier occurrence of the
+        current suffix n-gram is the draft — longest n first
+        (`spec_max_ngram`), nothing matched proposes nothing (the slot
+        decodes one verified token, exactly vanilla). Free of model
+        cost by construction: the 'draft model' is a numpy substring
+        search, and the acceptance oracle (the target's own argmax)
+        makes any bad draft cost only its rejected lanes.
+
+        Host cost per call is a full-history windowed scan —
+        O(len(history) x spec_max_ngram) numpy compares — which the
+        page budget bounds at max_total_tokens per slot per dispatch;
+        an incremental ngram -> last-occurrence map updated at
+        history.extend would make it O(spec_max_ngram) if budgets
+        grow past the point where this scan shows up in TPOT."""
+        if max_draft <= 0:
+            return []
+        hist = self.slots[s].history
+        L = len(hist)
+        if L < 2:
+            return []
+        h = np.asarray(hist, np.int64)
+        for n in range(min(self.spec_max_ngram, L - 1), 0, -1):
+            suffix = h[L - n:]
+            # candidate occurrences must end before the history's last
+            # token so at least one continuation token exists
+            win = np.lib.stride_tricks.sliding_window_view(h[:L - 1], n)
+            hits = np.flatnonzero((win == suffix).all(axis=1))
+            if hits.size:
+                # most recent occurrence WITH a full-depth continuation
+                # wins; matches hugging the end of history only offer a
+                # one-or-two-token draft (on a converged cycle — the
+                # common serving tail — that recency bias was measured
+                # to cap acceptance near 0.4 where a full-depth draft
+                # of the same cycle scores near 1.0)
+                full = hits[hits + n + max_draft <= L]
+                i = int(full[-1]) if full.size else int(hits[-1])
+                cont = h[i + n:i + n + max_draft]
+                if cont.size:
+                    return [int(t) for t in cont]
+        return []
+
+    def _spec_step(self) -> Tuple[Dict[int, List[int]], List[int]]:
+        """One speculative dispatch: the proposer drafts up to k-1
+        tokens per greedy slot, ONE k-position score dispatch
+        (`_get_score`) runs the target over [last_token, d1..d_{k-1}],
+        and the host accepts the longest draft prefix the target's own
+        argmax agrees with — the first disagreement truncates and
+        emits the TARGET's token, so the emitted stream is the
+        target's greedy stream bit-for-bit no matter what the drafts
+        were (rejected lanes' K/V writes sit beyond the advanced `pos`
+        and are overwritten by the dispatch that reaches them, the
+        same write-before-read discipline the garbage block rests on).
+        Sampled slots ride the same dispatch at depth 1 — their token
+        comes from the `chosen` sampling tail, untouched by
+        speculation. Emits 1..k tokens per slot per dispatch."""
+        if not self.active.any():
+            return {}, []
+        K = self.spec_k
+        S = self.n_slots
+        token_mat = np.zeros((S, K), np.int32)
+        n_valid = np.zeros(S, np.int32)
+        for s in np.flatnonzero(self.active):
+            s = int(s)
+            token_mat[s, 0] = self.last_token[s]
+            if self.temp[s] > 0:
+                n_valid[s] = 1          # sampling has no greedy oracle
+                continue
+            depth = int(min(K, self.remaining[s]))
+            draft = self._propose(s, depth - 1)
+            n_valid[s] = 1 + len(draft)
+            if draft:
+                token_mat[s, 1:1 + len(draft)] = draft
+        # grant (and CoW-fork) each slot's write window [pos,
+        # pos+n_valid) — pool pressure preempts exactly like the
+        # chunked path
+        self._grow_block_tables(
+            {int(s): int(n_valid[s]) for s in np.flatnonzero(self.active)})
+        n_valid = np.where(self.active, n_valid, 0).astype(np.int32)
+        if not self.active.any():
+            return {}, []
+        greedy_only = not bool((self.temp[self.active] > 0).any())
+        score = self._get_score(K, greedy_only)
+        kv, greedy_mat, chosen = score(
+            self._params, self.net.net_state, self.pool.kv,
+            jnp.asarray(self.block_tables), jnp.asarray(token_mat),
+            jnp.asarray(self.pos), jnp.asarray(n_valid),
+            jnp.asarray(self.keys), jnp.asarray(self.emit_idx),
+            jnp.asarray(self.temp), jnp.asarray(self.top_p))
+        self.pool.kv = kv
+        greedy_mat = np.asarray(greedy_mat)
+        chosen = np.asarray(chosen)
+        self.spec_dispatches_total += 1
+        emitted: Dict[int, List[int]] = {}
+        finished = []
+        for s in np.flatnonzero(self.active):
+            s = int(s)
+            v = int(n_valid[s])
+            if self.temp[s] > 0:
+                toks = [int(chosen[s])]
+            else:
+                # acceptance: draft j survives iff it EQUALS the
+                # target's argmax after position j-1; the first miss
+                # truncates and the target's token takes its place
+                row = greedy_mat[s]
+                toks = [int(row[0])]
+                for j in range(1, v):
+                    if int(token_mat[s, j]) != toks[-1]:
+                        break
+                    toks.append(int(row[j]))
+                self.spec_proposed_total += v - 1
+                self.spec_accepted_total += len(toks) - 1
+            n = len(toks)
+            self.spec_emitted_total += n
+            self.pos[s] += n
+            self.emit_idx[s] += n
+            self.remaining[s] -= n
+            self.last_token[s] = toks[-1]
+            slot = self.slots[s]
+            slot.emitted += n
+            slot.pos = int(self.pos[s])
+            slot.history.extend(toks)
+            emitted[s] = toks
+            if self.remaining[s] <= 0:
+                finished.append(s)
+                self._release(s)
         return emitted, finished
 
     # ------------------------------------------------------------ evict

@@ -76,6 +76,12 @@ class FleetServer:
         # exporting its last live-looking values forever)
         self._gauged: set = set()
         self._lock = threading.Lock()
+        # shared-prefix registrations per model NAME: re-applied to
+        # every successor a swap/scale builds, so a warmed system
+        # prompt survives version flips — each application prefills
+        # under the SUCCESSOR's weights, which is what keys the cache
+        # on (token ids, model version) by construction
+        self._prefixes: Dict[str, List] = {}
         # one RLock per model name serializing the whole
         # build→flip→drain sequence: a version swap racing an
         # autoscale resize would otherwise both replace the same
@@ -232,6 +238,13 @@ class FleetServer:
                     reg.unpin(name, target)
                     pinned_here.remove(target)
             server = GenerationServer(net, **server_kw)
+            # shared prefixes registered for this NAME re-apply to the
+            # successor BEFORE warmup (prefill under the new weights;
+            # warmup then pre-compiles the suffix-extension programs)
+            with self._lock:
+                prefixes = list(self._prefixes.get(name, ()))
+            for ids in prefixes:
+                server.register_prefix(ids)
             if warm_len is not None:
                 # the FULL (width x bucket x variant) grid — compiling
                 # inside a live admission wave is the p99 cliff the
@@ -269,6 +282,13 @@ class FleetServer:
                     name, v, server, dict(server_kw), warmup_prompt_len,
                     warmup_tokens)
                 self._swap_locks.setdefault(name, threading.RLock())
+            # registrations that raced the build (after _build_server's
+            # prefix snapshot, before the swap lock existed) re-apply
+            # idempotently now that the deployment is addressable
+            with self._lock:
+                missed = list(self._prefixes.get(name, ()))
+            for ids in missed:
+                server.register_prefix(ids)
         finally:
             with self._lock:
                 self._deploying.discard(name)
@@ -276,6 +296,38 @@ class FleetServer:
         self.publish_gauges()
         log.info("deployed %s v%d", name, v)
         return v
+
+    def register_prefix(self, name: str, token_ids) -> tuple:
+        """Register a shared prompt prefix for model `name`: the
+        ACTIVE server warms it now (copy-on-write block reuse,
+        `GenerationServer.register_prefix`), and every successor a
+        later `swap()`/`scale()` builds re-registers it automatically
+        — re-prefilled under the successor's weights, so the cache is
+        effectively keyed on (token ids, model version). Registration
+        is remembered even for a not-yet-deployed name (applied at
+        deploy)."""
+        import numpy as np
+
+        ids = np.asarray(token_ids)
+        if ids.ndim == 2 and ids.shape[0] == 1:
+            ids = ids[0]
+        with self._lock:
+            known = self._prefixes.setdefault(name, [])
+            if not any(np.array_equal(ids, k) for k in known):
+                known.append(ids)
+            swap_lock = self._swap_locks.get(name)
+        # serialize against swap()/scale(): a registration racing a
+        # mid-build swap would otherwise apply only to the RETIRING
+        # incumbent (the successor snapshotted _prefixes before this
+        # entry landed) and the successor would silently serve without
+        # it — waiting out the swap applies it to the live winner
+        if swap_lock is not None:
+            with swap_lock:
+                with self._lock:
+                    d = self._models.get(name)
+                if d is not None:
+                    return d.server.register_prefix(ids)
+        return tuple(int(t) for t in ids)
 
     # --------------------------------------------------------------- swap
     def swap(self, name: str, version="latest", *,

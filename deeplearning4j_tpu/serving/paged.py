@@ -27,7 +27,7 @@ anything. The allocator never hands it out.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -46,7 +46,17 @@ class BlockAllocator:
     the reserved garbage block). Allocation is all-or-nothing: a
     request either gets its full block set or stays queued — partial
     grants would deadlock two half-admitted sequences against each
-    other. LIFO reuse keeps freshly-freed blocks hot."""
+    other. LIFO reuse keeps freshly-freed blocks hot.
+
+    Grants are REFCOUNTED (the copy-on-write shared-prefix plane,
+    docs/SERVING.md): `allocate` hands out blocks at refcount 1;
+    `share` takes an additional reference on already-granted blocks
+    (multiple slots — and the server's prefix cache — mapping the same
+    physical prefix block); `free` drops one reference and only
+    returns the block to the free list when the last holder lets go.
+    The double-free guard generalizes: dropping a reference a block
+    does not carry is the same bug class as the PR-9 free-list
+    double-append, and raises the same way."""
 
     def __init__(self, n_blocks: int):
         if n_blocks < 2:
@@ -56,6 +66,7 @@ class BlockAllocator:
         self.n_blocks = int(n_blocks)
         # pop() order: 1, 2, 3, ... for a fresh pool
         self._free: List[int] = list(range(self.n_blocks - 1, 0, -1))
+        self._refs: Dict[int, int] = {}      # granted block -> refcount
 
     @property
     def free_blocks(self) -> int:
@@ -65,24 +76,61 @@ class BlockAllocator:
     def used_blocks(self) -> int:
         return (self.n_blocks - 1) - len(self._free)
 
+    @property
+    def shared_blocks(self) -> int:
+        """Physical blocks currently mapped by more than one holder
+        (refcount > 1) — the `serving_prefix_blocks_shared` gauge."""
+        return sum(1 for r in self._refs.values() if r > 1)
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(int(block), 0)
+
     def allocate(self, n: int) -> Optional[List[int]]:
-        """`n` block ids, or None if the pool can't cover the request
-        right now (caller keeps it queued)."""
+        """`n` block ids (each at refcount 1), or None if the pool
+        can't cover the request right now (caller keeps it queued)."""
         if n <= 0:
             raise ValueError(f"allocate(n={n})")
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
         return out
 
+    def share(self, blocks: List[int]):
+        """Take one more reference on each of `blocks` — they must be
+        granted already (a share of a free block would alias whatever
+        sequence the free list hands it to next)."""
+        for b in blocks:
+            b = int(b)
+            if self._refs.get(b, 0) < 1:
+                raise ValueError(
+                    f"share of block {b} which is not granted (free or "
+                    f"out of range) — a stale grant reference")
+        for b in blocks:
+            self._refs[int(b)] += 1
+
     def free(self, blocks: List[int]):
+        # validate the WHOLE batch before mutating anything: a double-
+        # free halfway through a list must not leave the allocator in a
+        # half-freed state (the PR-9 guard, extended to refcounts —
+        # a list naming one block more times than it holds references
+        # is the same bug)
+        need: Dict[int, int] = {}
         for b in blocks:
             b = int(b)
             if not (0 < b < self.n_blocks):
                 raise ValueError(f"freeing invalid block id {b}")
-            if b in self._free:
+            need[b] = need.get(b, 0) + 1
+        for b, n in need.items():
+            if self._refs.get(b, 0) < n:
                 raise ValueError(f"double-free of block {b}")
-            self._free.append(b)
+        for b in blocks:
+            b = int(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
 
 
 class PagedKVPool:

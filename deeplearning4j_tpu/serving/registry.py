@@ -383,6 +383,7 @@ class ModelRegistry:
     # -------------------------------------------------- publish listener
     def publish_listener(self, name: str, *, frequency: int = 100,
                          epoch_frequency: Optional[int] = None,
+                         every_s: Optional[float] = None,
                          save_updater: bool = False,
                          publish_at_fit_end: bool = True,
                          gate=None, normalizer_provider=None):
@@ -399,10 +400,22 @@ class ModelRegistry:
         publishing, never training. `normalizer_provider`: callable →
         normalizer-or-None evaluated AT publish time (a
         `WindowedStandardize.snapshot` bound method), so each release
-        carries the statistics of its own training window."""
+        carries the statistics of its own training window.
+
+        `every_s`: WALL-CLOCK cadence alongside the step cadence — "a
+        fresh model every N seconds regardless of throughput", the
+        freshness promise a production fleet actually makes. A step
+        boundary publishes when EITHER cadence is due; a slow stream
+        (few steps per wall-second) publishes on the clock, a fast one
+        on the step count. The clock anchors at fit start (a
+        warm-started run owes a full period) and only advances on an
+        ACTUAL publish — a gate refusal freezes it exactly like the
+        step clock, so recovery publishes at the first legal
+        boundary."""
         return RegistryPublishListener(
             self, name, frequency=frequency,
-            epoch_frequency=epoch_frequency, save_updater=save_updater,
+            epoch_frequency=epoch_frequency, every_s=every_s,
+            save_updater=save_updater,
             publish_at_fit_end=publish_at_fit_end, gate=gate,
             normalizer_provider=normalizer_provider)
 
@@ -419,6 +432,7 @@ class RegistryPublishListener(TrainingListener):
     def __init__(self, registry: ModelRegistry, name: str, *,
                  frequency: int = 100,
                  epoch_frequency: Optional[int] = None,
+                 every_s: Optional[float] = None,
                  save_updater: bool = False,
                  publish_at_fit_end: bool = True,
                  gate=None, normalizer_provider=None):
@@ -426,11 +440,15 @@ class RegistryPublishListener(TrainingListener):
         self.name = name
         self.frequency = max(1, int(frequency))
         self.epoch_frequency = epoch_frequency
+        if every_s is not None and float(every_s) <= 0:
+            raise ValueError(f"every_s must be > 0; got {every_s}")
+        self.every_s = None if every_s is None else float(every_s)
         self.save_updater = save_updater
         self.publish_at_fit_end = publish_at_fit_end
         self.gate = gate
         self.normalizer_provider = normalizer_provider
         self._last_published_step = 0
+        self._last_published_time: Optional[float] = None
         self._last_gated_log_step = 0
         self._anchored = False
         self.published_versions: List[int] = []
@@ -446,6 +464,24 @@ class RegistryPublishListener(TrainingListener):
             self._anchored = True
             self._last_published_step = max(
                 self._last_published_step, int(model.iteration_count))
+            if self.every_s is not None:
+                import time
+                self._last_published_time = time.monotonic()
+
+    def _clock_due(self) -> bool:
+        """True when `every_s` wall-clock seconds passed since the
+        last publish (or the fit-start anchor). Without an anchor yet
+        (a listener driven outside a fit loop), the first boundary
+        anchors the clock instead of publishing — the warm-start
+        discipline applied to time."""
+        if self.every_s is None:
+            return False
+        import time
+        now = time.monotonic()
+        if self._last_published_time is None:
+            self._last_published_time = now
+            return False
+        return now - self._last_published_time >= self.every_s
 
     def _gated(self, step: int, *, windowed: bool = True) -> bool:
         """True when the gate currently refuses publishing. The
@@ -485,6 +521,9 @@ class RegistryPublishListener(TrainingListener):
         self.published_versions.append(v)
         self.published_steps.append(step)
         self._last_published_step = step
+        if self.every_s is not None:
+            import time
+            self._last_published_time = time.monotonic()
         from deeplearning4j_tpu import monitor
         if monitor.is_enabled():
             monitor.registry().counter(
@@ -497,7 +536,8 @@ class RegistryPublishListener(TrainingListener):
         if not info.get("step_boundary", True):
             return
         step = iteration + 1
-        if step - self._last_published_step < self.frequency:
+        due_steps = step - self._last_published_step >= self.frequency
+        if not due_steps and not self._clock_due():
             return
         if self._gated(step):
             return

@@ -198,13 +198,41 @@ class GenerationServer(ParallelInference):
                  max_queue: Optional[int] = None,
                  idle_wait_s: float = 0.05,
                  quantize: Optional[str] = None,
-                 allocation: str = "incremental"):
+                 allocation: str = "incremental",
+                 speculative: Optional[int] = None,
+                 spec_accept_floor: float = 0.3,
+                 spec_probe_every: int = 50):
         super().__init__(net)
         self.engine = PagedDecodeEngine(
             net, n_slots=n_slots, n_blocks=n_blocks, block_len=block_len,
             top_k=top_k, steps_per_dispatch=steps_per_dispatch,
-            quantize=quantize, allocation=allocation)
+            quantize=quantize, allocation=allocation,
+            speculative=speculative)
         self._metrics_cache = None
+        # speculative-decoding policy: drafting is only worth its
+        # k-wide scoring dispatch while the proposer's tokens actually
+        # get accepted — the scheduler tracks an acceptance-rate EWMA
+        # and falls back to the chunked decode program when it sinks
+        # below `spec_accept_floor`, re-probing one speculative
+        # dispatch every `spec_probe_every` dispatches so a workload
+        # shift (e.g. traffic turning repetitive again) re-enables it
+        self.spec_accept_floor = float(spec_accept_floor)
+        self.spec_probe_every = max(1, int(spec_probe_every))
+        self._spec_accept_ewma: Optional[float] = None
+        self._spec_tpd_ewma: Optional[float] = None
+        self._spec_disabled = False
+        self._spec_probe_in = 0
+        self._spec_proposed_seen = 0
+        self._spec_accepted_seen = 0
+        self._spec_emitted_seen = 0
+        self._spec_dispatches_seen = 0
+        self._prefix_hits_seen = 0
+        self._prefix_saved_seen = 0
+        # prefix registrations from foreign threads ride a control
+        # queue the scheduler drains at each loop top (the engine is
+        # single-threaded by contract); before start() they apply
+        # directly
+        self._control: "queue.Queue" = queue.Queue()
         self.slo_ttft_s = slo_ttft_s
         self.max_queue = max_queue
         self.idle_wait_s = idle_wait_s
@@ -268,6 +296,52 @@ class GenerationServer(ParallelInference):
             "generate_async(prompt_ids, n_tokens); for single-shot "
             "batched forwards use ParallelInference")
 
+    # ------------------------------------------------------ shared prefix
+    def register_prefix(self, token_ids, *, timeout: Optional[float] = 600.0
+                        ) -> tuple:
+        """Warm a shared prompt prefix (system prompt) into the paged
+        pool ONCE: later requests whose prompt starts with these ids
+        map the warmed blocks copy-on-write instead of re-prefilling
+        them (`PagedDecodeEngine.register_prefix`; docs/SERVING.md).
+        Thread-safe: before `start()` the registration applies
+        directly (the usual deploy order — register, `warmup()`,
+        `start()` — so warmup can pre-compile the suffix-extension
+        programs); on a RUNNING server it rides a control queue the
+        scheduler drains, and this call blocks until applied."""
+        if getattr(self, "_shutdown", False) or self._stopped:
+            raise RuntimeError("GenerationServer is shut down")
+        if not self._running:
+            return self.engine.register_prefix(token_ids)
+        from concurrent.futures import Future
+        fut = Future()
+        self._control.put(("register_prefix", token_ids, fut))
+        # re-check teardown AFTER the put: a stop() landing between the
+        # checks above and the enqueue has already drained the control
+        # queue — our item would sit unresolved forever. Draining once
+        # more here races benignly with the scheduler (get_nowait on
+        # both sides) and guarantees the future resolves either way.
+        if self._stopped or getattr(self, "_shutdown", False) \
+                or not self._running:
+            self._fail_control()
+        return fut.result(timeout)
+
+    def _drain_control(self, eng) -> bool:
+        progressed = False
+        while True:
+            try:
+                op, arg, fut = self._control.get_nowait()
+            except queue.Empty:
+                return progressed
+            progressed = True
+            try:
+                if op == "register_prefix":
+                    fut.set_result(eng.register_prefix(arg))
+                else:
+                    raise ValueError(f"unknown control op {op!r}")
+            except Exception as e:  # noqa: BLE001 — surfaced to caller
+                if not fut.done():
+                    fut.set_exception(e)
+
     # ------------------------------------------------------------- warmup
     def warmup(self, prompt_len: int, n_tokens: int = 2):
         """Compile the serving programs OUTSIDE the serving path: the
@@ -284,6 +358,12 @@ class GenerationServer(ParallelInference):
         from deeplearning4j_tpu.serving.engine import bucket_len
         if self._running:
             raise RuntimeError("warmup() must run before start()")
+        # persistent XLA compile cache (DL4J_COMPILE_CACHE_DIR): a
+        # fleet successor re-warming the same (width x bucket) grid
+        # loads executables from disk instead of re-tracing them —
+        # near-instant swap warmup on revisited configurations
+        from deeplearning4j_tpu.nd.compile_cache import enable_compile_cache
+        enable_compile_cache()
         eng = self.engine
         n_tokens = max(2, int(n_tokens))
         self.engine.check_budget(int(prompt_len), n_tokens)
@@ -305,59 +385,134 @@ class GenerationServer(ParallelInference):
         # and the sampling chain) — a mixed wave keys a different
         # program — and the first sampled wave also compiles the
         # sampled decode chunk, so a temperature>0 request never
-        # stalls live streams on a mid-serving trace
+        # stalls live streams on a mid-serving trace. Prefix matching
+        # is suspended for the grid: a registered prefix that happens
+        # to match the synthetic zero prompts would route these waves
+        # through the CoW path and leave the REAL full-prefill
+        # programs cold for live traffic of that shape.
+        saved_prefixes, eng._prefixes = eng._prefixes, {}
         short_wave = None      # narrowest under-admitted wave seen
-        for k in widths:
-            for pl in buckets:
-                # a bucket rounded past the prompt may leave less token
-                # headroom than requested — admission-only warmup (n=1)
-                # still compiles that bucket's prefill/admit programs
-                pw = int(pl)
-                n_b = min(n_tokens, eng.max_total_tokens - pw)
-                if n_b < 1:
-                    # the budget-clamped TOP bucket: a one-shorter
-                    # prompt still PADS to this bucket, so the same
-                    # (width, bucket) prefill program compiles — a
-                    # real budget-edge request must not be the first
-                    # to trace it
-                    pw, n_b = pw - 1, 1
-                    if pw < 1:
-                        continue
-                for sampled_head in (False, True):
-                    reqs = [dict(prompt_ids=np.zeros(pw, np.int32),
-                                 n_tokens=n_b)
-                            for _ in range(k)]
-                    if sampled_head:
-                        reqs[0].update(temperature=1.0,
-                                       rng=np.zeros(2, np.uint32))
-                    admitted = eng.admit_many(reqs)
-                    while eng.active.any():
-                        eng.step()
-                    eng.drain_preempted()   # warmup traffic isn't real
-                    for slot, _, done in admitted:
-                        if not done and eng.slots[slot] is not None:
-                            eng.evict(slot)
-                    if len(admitted) < k and short_wave is None:
-                        short_wave = (len(admitted), k)
-            if short_wave is not None:
-                # pool too small for this width (at SOME bucket) even
-                # at warmup's minimal n_tokens — real waves of this
-                # width compile mid-serving if requests ever need
-                # fewer blocks each
-                import logging
-                logging.getLogger(__name__).warning(
-                    "warmup admitted only %d of a width-%d wave "
-                    "(pool %d blocks): wave widths above %d are NOT "
-                    "fully pre-compiled — grow n_blocks or expect a "
-                    "one-off compile stall on the first wider wave",
-                    short_wave[0], short_wave[1], eng.pool.n_blocks,
-                    short_wave[0])
-                break
+        try:
+            for k in widths:
+                for pl in buckets:
+                    # a bucket rounded past the prompt may leave less
+                    # token headroom than requested — admission-only
+                    # warmup (n=1) still compiles that bucket's
+                    # prefill/admit programs
+                    pw = int(pl)
+                    n_b = min(n_tokens, eng.max_total_tokens - pw)
+                    if n_b < 1:
+                        # the budget-clamped TOP bucket: a one-shorter
+                        # prompt still PADS to this bucket, so the same
+                        # (width, bucket) prefill program compiles — a
+                        # real budget-edge request must not be the first
+                        # to trace it
+                        pw, n_b = pw - 1, 1
+                        if pw < 1:
+                            continue
+                    for sampled_head in (False, True):
+                        reqs = [dict(prompt_ids=np.zeros(pw, np.int32),
+                                     n_tokens=n_b)
+                                for _ in range(k)]
+                        if sampled_head:
+                            reqs[0].update(temperature=1.0,
+                                           rng=np.zeros(2, np.uint32))
+                        admitted = eng.admit_many(reqs)
+                        while eng.active.any():
+                            # speculate=False: the grid warms the
+                            # CHUNKED decode programs — the accept-rate
+                            # fallback path must be as cold-start-free
+                            # as the speculative one (warmed below)
+                            eng.step(speculate=False)
+                        eng.drain_preempted()  # warmup traffic isn't real
+                        for slot, _, done in admitted:
+                            if not done and eng.slots[slot] is not None:
+                                eng.evict(slot)
+                        if len(admitted) < k and short_wave is None:
+                            short_wave = (len(admitted), k)
+                if short_wave is not None:
+                    # pool too small for this width (at SOME bucket)
+                    # even at warmup's minimal n_tokens — real waves of
+                    # this width compile mid-serving if requests ever
+                    # need fewer blocks each
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "warmup admitted only %d of a width-%d wave "
+                        "(pool %d blocks): wave widths above %d are NOT "
+                        "fully pre-compiled — grow n_blocks or expect a "
+                        "one-off compile stall on the first wider wave",
+                        short_wave[0], short_wave[1], eng.pool.n_blocks,
+                        short_wave[0])
+                    break
+        finally:
+            eng._prefixes = saved_prefixes
+        import jax.numpy as jnp
+        # speculative + shared-prefix programs: the K-position score
+        # program (both sampling variants), the CoW fork copy, and the
+        # exact-match first-token sampler — compiled via DEAD dispatches
+        # (n_valid all zero / garbage-to-garbage copies), which write
+        # only the garbage block and leave every pool invariant intact
+        score_ks = []
+        if eng.spec_k:
+            score_ks.append(eng.spec_k)
+        if eng.has_prefixes:
+            # suffix-extension buckets: every pow2 up to the prompt
+            # bucket (a hit's suffix is at most prompt minus prefix)
+            b = 1
+            while b <= bucket_len(int(prompt_len), eng.max_total_tokens):
+                score_ks.append(b)
+                b *= 2
+        S = eng.n_slots
+        for K in sorted(set(score_ks)):
+            for greedy in (True, False):
+                score = eng._get_score(K, greedy)
+                eng.pool.kv = score(
+                    eng._params, eng.net.net_state, eng.pool.kv,
+                    jnp.asarray(eng.block_tables),
+                    jnp.zeros((S, K), jnp.int32),
+                    jnp.zeros(S, jnp.int32), jnp.zeros(S, jnp.int32),
+                    jnp.zeros((S, 2), jnp.uint32),
+                    jnp.zeros(S, jnp.int32), jnp.zeros(S, jnp.float32),
+                    jnp.ones(S, jnp.float32))[0]
+        if eng.has_prefixes:
+            # fork widths up to a full wave of mid-block tails (every
+            # admission in a wave can fork one) — garbage self-copies
+            w = 1
+            while True:
+                self.engine._run_fork([(0, 0)] * w)
+                if w >= S:
+                    break
+                w *= 2
+            vocab = getattr(eng.net.layers[-1], "n_out", 0)
+            # pow2 CEIL of the slot count (like the fork loop above):
+            # a 5-wide exact-match wave on a 6-slot server pads to
+            # width 8 — `while w <= S` would leave that width to
+            # compile mid-serving, the TTFT-cliff class warmup exists
+            # to prevent
+            w = 1
+            while True:
+                for greedy in (True, False):
+                    fn = eng._first_token.get(greedy)
+                    if fn is None:
+                        fn = eng._first_token[greedy] = \
+                            eng._build_first_token(greedy)
+                    fn(jnp.zeros((w, vocab),
+                                 eng.net.dtype.compute_dtype),
+                       jnp.zeros((w, 2), jnp.uint32),
+                       jnp.zeros(w, jnp.int32),
+                       jnp.zeros(w, jnp.float32), jnp.ones(w, jnp.float32))
+                if w >= S:
+                    break
+                w *= 2
         # the warmup grid's grants/preemptions are not serving traffic:
         # reset the engine totals so the registry deltas (_drain) and
-        # ledger reads count real requests only
+        # ledger reads count real requests only (prefix pins and their
+        # hit/fork counters predate traffic too)
         eng.block_grants_total = 0
         eng.evict_requeue_total = 0
+        eng.prefix_forks_total = 0
+        eng.prefix_hits_total = 0
+        eng.prefix_tokens_saved_total = 0
         return self
 
     # ------------------------------------------------------------- submit
@@ -383,7 +538,8 @@ class GenerationServer(ParallelInference):
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError(f"prompt must be a non-empty 1-D id "
                              f"sequence; got shape {prompt.shape}")
-        self.engine.check_budget(int(prompt.shape[0]), int(n_tokens))
+        self.engine.check_budget(int(prompt.shape[0]), int(n_tokens),
+                                 prompt_ids=prompt)
         if top_p is not None and not (0.0 < float(top_p) <= 1.0):
             raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
         if temperature < 0:
@@ -456,6 +612,25 @@ class GenerationServer(ParallelInference):
             "requeue": reg.counter("serving_evict_requeue_total",
                                    "pool-pressure preemptions requeued "
                                    "as continuations"),
+            "spec_accept": reg.gauge(
+                "serving_spec_accept_rate",
+                "EWMA of the draft-token acceptance rate (speculative "
+                "decoding; drives the auto-disable policy)"),
+            "spec_tpd": reg.gauge(
+                "serving_spec_tokens_per_dispatch",
+                "EWMA of tokens emitted per speculative dispatch"),
+            "prefix_shared": reg.gauge(
+                "serving_prefix_blocks_shared",
+                "pool blocks currently mapped by more than one holder "
+                "(shared-prefix CoW)"),
+            "prefix_hits": reg.counter(
+                "serving_prefix_hits_total",
+                "admissions that mapped a registered shared prefix "
+                "instead of prefilling it"),
+            "prefix_saved": reg.counter(
+                "serving_prefix_tokens_saved_total",
+                "prompt tokens NOT prefilled thanks to shared-prefix "
+                "block reuse"),
             "ttft": reg.timer("serving_ttft_seconds",
                               "submit-to-first-token latency"),
             "tpot": reg.timer("serving_tpot_seconds",
@@ -542,6 +717,11 @@ class GenerationServer(ParallelInference):
     def _schedule_once(self, eng) -> bool:
         m = self._serving_metrics()
         progressed = False
+        # ------------------------------------------ control requests
+        # (prefix registrations from foreign threads — the engine is
+        # scheduler-thread-only by contract)
+        if self._drain_control(eng):
+            progressed = True
         # -------------------------------------------- cancellations
         for slot, (req, fut, _) in list(self._slot2req.items()):
             if req.stream.cancelled:
@@ -592,8 +772,36 @@ class GenerationServer(ParallelInference):
                 continue
             # continuation length = prompt + emitted; only the LENGTH
             # matters for the capacity check — don't materialize it
+            # UNLESS prefixes are registered (a head request riding a
+            # shared prefix needs far fewer fresh blocks than its
+            # length suggests; judging it by length alone could stall
+            # the queue forever behind a perfectly admittable head)
             if not eng.can_admit(len(head[0].prompt) + head[0].emitted,
-                                 head[0].n_left):
+                                 head[0].n_left,
+                                 prompt_ids=(head[0].effective_prompt()
+                                             if eng.has_prefixes
+                                             else None)):
+                # a head that can NEVER be admitted must shed, not
+                # wait — waiting would wedge the FIFO queue (and
+                # everything behind it) forever. Under today's sharing
+                # model this cannot fire (releasing a prefix returns
+                # exactly the blocks a rider stops sharing, so a
+                # request accepted via check_budget stays admissible);
+                # the re-check is the INVARIANT'S enforcement point, so
+                # a future sharing mode that breaks the arithmetic
+                # degrades to a clean ShedError instead of a hang
+                try:
+                    eng.check_budget(
+                        len(head[0].prompt) + head[0].emitted,
+                        head[0].n_left,
+                        prompt_ids=head[0].effective_prompt())
+                except ValueError as e:
+                    self._pending.pop(0)
+                    if m is not None:
+                        m["shed"].inc()
+                    head[0].stream._fail(ShedError(str(e)))
+                    progressed = True
+                    continue
                 break    # FIFO: never leapfrog the head request
             # admission WAVE: the FIFO prefix — prompt lengths may be
             # HETEROGENEOUS (the engine bucket-pads them into one
@@ -639,8 +847,9 @@ class GenerationServer(ParallelInference):
         # --------------------------------------------------- decode
         if eng.active.any():
             t0 = time.perf_counter()
-            emitted, finished = eng.step()
+            emitted, finished = eng.step(speculate=self._spec_policy())
             dt = time.perf_counter() - t0
+            self._spec_update(m)
             now = time.monotonic()
             # pool-pressure preemptions (incremental allocation):
             # requeue each evicted request as a continuation at the
@@ -686,7 +895,73 @@ class GenerationServer(ParallelInference):
                 m["requeue"].inc(eng.evict_requeue_total
                                  - self._requeue_seen)
                 self._requeue_seen = eng.evict_requeue_total
+            if eng.has_prefixes or eng.prefix_hits_total:
+                m["prefix_shared"].set(eng.pool.allocator.shared_blocks)
+                if eng.prefix_hits_total > self._prefix_hits_seen:
+                    m["prefix_hits"].inc(eng.prefix_hits_total
+                                         - self._prefix_hits_seen)
+                    m["prefix_saved"].inc(eng.prefix_tokens_saved_total
+                                          - self._prefix_saved_seen)
+                    self._prefix_saved_seen = eng.prefix_tokens_saved_total
+                    self._prefix_hits_seen = eng.prefix_hits_total
         return progressed
+
+    # ------------------------------------------------ speculative policy
+    def _spec_policy(self) -> Optional[bool]:
+        """Whether the next dispatch drafts: None (engine default) when
+        speculation is off or healthy; False while the accept-rate EWMA
+        sits under `spec_accept_floor` — except for one probe dispatch
+        every `spec_probe_every`, which re-measures the workload."""
+        if not self.engine.spec_k:
+            return None
+        if not self._spec_disabled:
+            return True
+        self._spec_probe_in -= 1
+        if self._spec_probe_in <= 0:
+            self._spec_probe_in = self.spec_probe_every
+            return True                      # probe dispatch
+        return False
+
+    def _spec_update(self, m):
+        """Fold the engine's per-dispatch speculative counters into the
+        acceptance EWMA and flip the auto-disable latch."""
+        eng = self.engine
+        if not eng.spec_k:
+            return
+        d_prop = eng.spec_proposed_total - self._spec_proposed_seen
+        d_acc = eng.spec_accepted_total - self._spec_accepted_seen
+        d_emit = eng.spec_emitted_total - self._spec_emitted_seen
+        d_disp = eng.spec_dispatches_total - self._spec_dispatches_seen
+        self._spec_proposed_seen = eng.spec_proposed_total
+        self._spec_accepted_seen = eng.spec_accepted_total
+        self._spec_emitted_seen = eng.spec_emitted_total
+        self._spec_dispatches_seen = eng.spec_dispatches_total
+        if d_disp < 1:
+            return                           # chunked dispatch — no data
+        # a dispatch where the proposer drafted NOTHING is also
+        # evidence against speculation: it paid the K-wide score
+        # program for one token per slot. Counting it as acceptance 0
+        # lets the auto-disable engage on non-repetitive traffic the
+        # suffix cache can't draft on — otherwise the EWMA never
+        # updates and drafting runs at 1 token/dispatch forever
+        rate = d_acc / d_prop if d_prop > 0 else 0.0
+        self._spec_accept_ewma = (
+            rate if self._spec_accept_ewma is None
+            else 0.8 * self._spec_accept_ewma + 0.2 * rate)
+        self._spec_tpd_ewma = (
+            d_emit / d_disp if self._spec_tpd_ewma is None
+            else 0.8 * self._spec_tpd_ewma + 0.2 * d_emit / d_disp)
+        if not self._spec_disabled \
+                and self._spec_accept_ewma < self.spec_accept_floor:
+            self._spec_disabled = True
+            self._spec_probe_in = self.spec_probe_every
+        elif self._spec_disabled \
+                and self._spec_accept_ewma >= self.spec_accept_floor:
+            self._spec_disabled = False
+        if m is not None:
+            m["spec_accept"].set(self._spec_accept_ewma)
+            if self._spec_tpd_ewma is not None:
+                m["spec_tpd"].set(self._spec_tpd_ewma)
 
     def _finish(self, req, m):
         req.stream._finish()
@@ -777,6 +1052,20 @@ class GenerationServer(ParallelInference):
                 "GenerationServer stopped before this request was "
                 "admitted"))
         self._pending.clear()
+        # control requests (prefix registrations) still queued: fail
+        # their futures so no caller blocks on a dead scheduler
+        self._fail_control()
+
+    def _fail_control(self):
+        while True:
+            try:
+                _, _, fut = self._control.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(RuntimeError(
+                    "GenerationServer stopped before this control "
+                    "request was applied"))
 
     def _fail_pending(self):
         """Queue items here are (request, future, t) — fail the STREAM

@@ -204,6 +204,51 @@ def get_prefill_bucketed(net: MultiLayerNetwork):
     return jit_cache["prefill_bucketed"]
 
 
+def paged_score_forward(net, plan, params, state, kv, block_tables,
+                        token_mat, pos, n_valid):
+    """The K-POSITION score forward over the paged pool — one program
+    scoring k proposed tokens per slot instead of k programs (the
+    dataflow-batching argument applied to the decode loop): the target
+    model's half of speculative decoding AND the suffix-extension
+    prefill of copy-on-write shared-prefix admission
+    (serving/engine.py; docs/SERVING.md).
+
+    `token_mat` [S, K] holds K consecutive tokens per slot occupying
+    positions `pos[s] .. pos[s]+K-1`; `n_valid` [S] bounds each slot's
+    real lanes (0 = slot sits this dispatch out — its writes land in
+    the garbage block, its output rows are discarded). `plan` is the
+    engine's layer walk (("plain"|"pos", i) / ("block", i, pool_j)).
+    Returns (kv', probs [S, K, V]) where probs[s, j] is the target's
+    next-token distribution AFTER consuming token j — per-lane
+    bit-equal to K sequential single-token decode dispatches, which is
+    the acceptance oracle's whole foundation. Lives next to
+    `get_prefill`/`get_prefill_bucketed` because it is the same program
+    family: the engine jits it per (K, sampling-variant)."""
+    import jax.numpy as jnp
+
+    layers = net.layers
+    K = token_mat.shape[1]
+    positions = pos[:, None] + jnp.arange(K)[None, :]    # [S, K]
+    h = token_mat                                        # [S, K] int ids
+    kv = list(kv)
+    for entry in plan:
+        kind, i = entry[0], entry[1]
+        layer = layers[i]
+        lp = params.get(str(i), {})
+        ls = state.get(str(i), {})
+        if kind == "plain":
+            h, _ = layer.forward(lp, ls, h, train=False, rng=None)
+        elif kind == "pos":
+            h, _ = layer.forward_at_positions(lp, ls, h, positions)
+        else:
+            j = entry[2]
+            k_pool, v_pool = kv[j]
+            h, k_pool, v_pool = layer.forward_paged_multi(
+                lp, h, k_pool, v_pool, block_tables, pos, n_valid)
+            kv[j] = (k_pool, v_pool)
+    return tuple(kv), h                                  # [S, K, V]
+
+
 def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
              temperature: float = 1.0, top_k: int = None,
              top_p: float = None, rng=None, quantize: str = None):

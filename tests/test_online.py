@@ -650,3 +650,161 @@ class TestStreamingUI:
                 server.stop()
         finally:
             monitor.disable()
+
+
+# ================================================ drift gate: loss band
+class TestDriftGateLossBand:
+    """Satellite: `metric="loss"` gates on held-out LOSS rising past
+    ``best + band`` — the regression / LM-perplexity form of the gate,
+    where accuracy means nothing."""
+
+    def _heldout(self, seed=0, n=48):
+        rng = np.random.default_rng(seed)
+        hx = rng.standard_normal((n, F)).astype(np.float32)
+        hy = np.eye(C, dtype=np.float32)[np.argmax(hx @ _W_TRUE, axis=1)]
+        return DataSet(hx, hy)
+
+    def test_trip_on_loss_rise_and_recovery(self):
+        reg = MetricsRegistry()
+        monitor.enable(registry=reg)
+        try:
+            heldout = self._heldout()
+            net = tiny_net()
+            rng = np.random.default_rng(1)
+            x = rng.standard_normal((40 * B, F)).astype(np.float32)
+            y = np.eye(C, dtype=np.float32)[np.argmax(x @ _W_TRUE, axis=1)]
+            net.fit(x, y, epochs=2, batch_size=B, shuffle=False)
+            gate = DriftGate(heldout, frequency=1, band=0.3,
+                             metric="loss", printer=lambda s: None)
+            gate.iteration_done(net, 0, 0, 0.0)
+            assert gate.best_score is not None and not gate.paused
+            base_loss = gate.best_score
+            # corrupt the model -> held-out loss EXPLODES -> trip
+            good_params = jax.tree_util.tree_map(np.asarray, net.params)
+            net.params = jax.tree_util.tree_map(
+                lambda a: a * 17.0, net.params)
+            gate.iteration_done(net, 1, 0, 0.0)
+            assert gate.paused and gate.trips == 1
+            assert gate.last_score > base_loss + 0.3
+            assert not gate.allow_publish()
+            # best tracked the MINIMUM, not the latest
+            assert gate.best_score == base_loss
+            import jax.numpy as jnp
+            net.params = jax.tree_util.tree_map(jnp.asarray, good_params)
+            gate.iteration_done(net, 2, 0, 0.0)
+            assert not gate.paused and gate.allow_publish()
+            snap = reg.snapshot()
+            scores = snap["evaluative_score"]["values"]
+            assert any(e["labels"].get("metric") == "loss"
+                       for e in scores)
+        finally:
+            monitor.disable()
+
+    def test_loss_gate_drives_publish_listener(self, tmp_path):
+        """End to end: the loss gate refuses a degraded publish and
+        reopens after recovery — wired exactly like the accuracy
+        gate."""
+        registry = ModelRegistry(tmp_path)
+        heldout = self._heldout()
+        net = tiny_net()
+        gate = DriftGate(heldout, frequency=1, band=0.5, metric="loss",
+                         printer=lambda s: None)
+        listener = registry.publish_listener("m", frequency=2,
+                                             gate=gate.allow_publish)
+        gate.iteration_done(net, 0, 0, 0.0)
+        net.iteration_count = 2
+        listener.iteration_done(net, 1, 0, 0.0)
+        assert listener.published_versions == [1]
+        net.params = jax.tree_util.tree_map(lambda a: a * 29.0,
+                                            net.params)
+        gate.iteration_done(net, 2, 0, 0.0)
+        assert gate.paused
+        net.iteration_count = 4
+        listener.iteration_done(net, 3, 0, 0.0)
+        assert listener.published_versions == [1]   # refused
+        assert listener.gated_skips == 1
+
+    def test_unknown_metric_rejected(self):
+        with pytest.raises(ValueError, match="metric"):
+            DriftGate(self._heldout(), metric="auc")
+
+
+# ====================================== publish listener: wall-clock cadence
+class TestPublishListenerEveryS:
+    def test_clock_publishes_regardless_of_step_cadence(self, tmp_path):
+        """frequency too high to ever fire; the wall clock alone must
+        publish — "a fresh model every N seconds regardless of
+        throughput"."""
+        reg = ModelRegistry(tmp_path)
+        net = tiny_net()
+        # 0.4 s period: the not-yet-due assertions tolerate hundreds
+        # of ms of incidental work (zip publish, loaded CI core)
+        # between calls without going flaky
+        listener = reg.publish_listener("m", frequency=10_000,
+                                        every_s=0.4)
+        listener.on_fit_start(net)          # anchors the clock
+        net.iteration_count = 1
+        listener.iteration_done(net, 0, 0, 0.0)
+        assert listener.published_versions == []    # period not yet up
+        time.sleep(0.45)
+        net.iteration_count = 2
+        listener.iteration_done(net, 1, 0, 0.0)
+        assert listener.published_versions == [1]
+        # the clock re-arms at the publish: the very next boundary is
+        # NOT due again
+        net.iteration_count = 3
+        listener.iteration_done(net, 2, 0, 0.0)
+        assert listener.published_versions == [1]
+        time.sleep(0.45)
+        net.iteration_count = 4
+        listener.iteration_done(net, 3, 0, 0.0)
+        assert listener.published_versions == [1, 2]
+
+    def test_step_cadence_still_applies_alongside(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        net = tiny_net()
+        listener = reg.publish_listener("m", frequency=2,
+                                        every_s=3600.0)
+        listener.on_fit_start(net)
+        net.iteration_count = 2
+        listener.iteration_done(net, 1, 0, 0.0)   # 2 steps -> due
+        assert listener.published_versions == [1]
+
+    def test_gate_refusal_freezes_the_clock(self, tmp_path):
+        """A refused clock publish does NOT advance the clock: the
+        first boundary after the gate reopens publishes immediately."""
+        reg = ModelRegistry(tmp_path)
+        net = tiny_net()
+        allow = {"ok": False}
+        listener = reg.publish_listener("m", frequency=10_000,
+                                        every_s=0.2,
+                                        gate=lambda: allow["ok"])
+        listener.on_fit_start(net)
+        time.sleep(0.25)
+        net.iteration_count = 1
+        listener.iteration_done(net, 0, 0, 0.0)
+        assert listener.published_versions == []    # refused
+        allow["ok"] = True
+        net.iteration_count = 2
+        listener.iteration_done(net, 1, 0, 0.0)     # still overdue
+        assert listener.published_versions == [1]
+
+    def test_off_cadence_fit_end_publish_preserved(self, tmp_path):
+        """every_s must not break the fit-end off-cadence publish
+        contract (nor fire when nothing new trained)."""
+        reg = ModelRegistry(tmp_path)
+        net = tiny_net()
+        listener = reg.publish_listener("m", frequency=10_000,
+                                        every_s=3600.0)
+        net.add_listener(listener)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((7 * 4, F)).astype(np.float32)
+        y = np.eye(C, dtype=np.float32)[rng.integers(0, C, 7 * 4)]
+        net.fit(x, y, epochs=1, batch_size=4)
+        assert listener.published_versions == [1]   # on_fit_end only
+        assert listener.published_steps == [7]
+
+    def test_invalid_every_s_rejected(self, tmp_path):
+        reg = ModelRegistry(tmp_path)
+        with pytest.raises(ValueError, match="every_s"):
+            reg.publish_listener("m", every_s=0)

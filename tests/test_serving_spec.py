@@ -1,0 +1,619 @@
+"""Speculative decoding + copy-on-write shared-prefix block reuse.
+
+Two contracts anchor this suite (docs/SERVING.md):
+
+- SPECULATIVE GREEDY IS VANILLA GREEDY, bit-for-bit: the n-gram
+  proposer's drafts are scored by one k-position target dispatch and
+  the first disagreement truncates to the target's own token — so
+  whatever the drafts were, the emitted stream equals whole-batch
+  `generate()` exactly (staggered admissions, chunk/spec interleaving,
+  preempt-requeue continuations, mixed greedy+sampled waves included).
+- SHARED-PREFIX STREAMS ARE PRIVATE-BLOCK STREAMS, bit-for-bit: a
+  prefix prefilled once and mapped copy-on-write must emit exactly
+  what a full private prefill would — through noise-filled pools,
+  mid-block tail forks, evictions while shared, and exact-match
+  admissions that never run a forward pass at all.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.serving import (
+    BlockAllocator,
+    GenerationServer,
+    PagedDecodeEngine,
+)
+from deeplearning4j_tpu.zoo.transformer import TransformerLM, generate
+
+V, D, HEADS, LAYERS, MAXLEN = 23, 16, 4, 2, 32
+BL = 4
+
+
+def tiny_lm(seed=3):
+    return TransformerLM(vocab_size=V, d_model=D, n_layers=LAYERS,
+                         n_heads=HEADS, max_len=MAXLEN, seed=seed).init()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return tiny_lm()
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return np.random.default_rng(5).integers(0, V, (6, 5))
+
+
+@pytest.fixture(scope="module")
+def ref_tokens(net, prompts):
+    return generate(net, prompts, 20, temperature=0)    # [6, 20]
+
+
+def drain(eng, slot2req, out, **step_kw):
+    guard = 0
+    while eng.active.any():
+        emitted, finished = eng.step(**step_kw)
+        for slot, toks in emitted.items():
+            out[slot2req[slot]].extend(toks)
+        for slot in finished:
+            del slot2req[slot]
+        guard += 1
+        assert guard < 400, "engine failed to drain"
+
+
+def admit_all(eng, reqs):
+    """Admit every request (asserts capacity), returning ({slot: req
+    index}, {req index: [first token]})."""
+    admitted = eng.admit_many(reqs)
+    assert len(admitted) == len(reqs)
+    s2r, out = {}, {}
+    for i, (slot, first, done) in enumerate(admitted):
+        out[i] = [first]
+        if not done:
+            s2r[slot] = i
+    return s2r, out
+
+
+# --------------------------------------------------------------------------
+class TestAllocatorRefcounts:
+    def test_share_free_cycle(self):
+        a = BlockAllocator(8)
+        got = a.allocate(3)
+        assert all(a.refcount(b) == 1 for b in got)
+        a.share(got)
+        assert all(a.refcount(b) == 2 for b in got)
+        assert a.shared_blocks == 3
+        assert a.free_blocks == 4
+        a.free(got)                      # one holder lets go
+        assert a.free_blocks == 4        # still granted to the other
+        assert a.shared_blocks == 0
+        a.free(got)                      # last holder
+        assert a.free_blocks == 7
+        assert all(a.refcount(b) == 0 for b in got)
+
+    def test_share_of_free_block_rejected(self):
+        a = BlockAllocator(4)
+        got = a.allocate(1)
+        a.free(got)
+        with pytest.raises(ValueError, match="not granted"):
+            a.share(got)
+
+    def test_double_free_under_sharing(self):
+        """The double-free guard extends to refcounts: dropping more
+        references than held raises, and the failed batch mutates
+        NOTHING (no half-freed allocator state)."""
+        a = BlockAllocator(8)
+        got = a.allocate(2)
+        a.share([got[0]])                # got[0] rc=2, got[1] rc=1
+        a.free(got)                      # rc 1 / 0
+        with pytest.raises(ValueError, match="double-free"):
+            a.free(got)                  # got[1] has no refs left
+        # the batch failed atomically: got[0]'s surviving ref intact
+        assert a.refcount(got[0]) == 1
+        a.free([got[0]])
+        assert a.free_blocks == 7
+        # a list naming one block more times than it holds refs
+        b = a.allocate(1)
+        with pytest.raises(ValueError, match="double-free"):
+            a.free(b + b)
+        assert a.refcount(b[0]) == 1
+
+    def test_fragmented_churn_with_refcounts(self):
+        """Interleaved allocate/share/free churn: the free list must
+        never hand out a block that still carries references, and the
+        accounting must come back to a full pool."""
+        a = BlockAllocator(16)
+        rng = np.random.default_rng(0)
+        held = []                        # lists of blocks with one ref
+        for _ in range(200):
+            op = rng.integers(0, 3)
+            if op == 0:
+                got = a.allocate(int(rng.integers(1, 4)))
+                if got is not None:
+                    assert all(a.refcount(b) == 1 for b in got)
+                    held.append(got)
+            elif op == 1 and held:
+                blocks = held[int(rng.integers(len(held)))]
+                a.share(blocks)
+                held.append(list(blocks))
+            elif op == 2 and held:
+                blocks = held.pop(int(rng.integers(len(held))))
+                a.free(blocks)
+            # free list and refs never overlap
+            assert all(a.refcount(b) == 0 for b in a._free)
+        for blocks in held:
+            a.free(blocks)
+        assert a.free_blocks == 15
+
+
+# --------------------------------------------------------------------------
+class TestProposer:
+    def _eng(self, net):
+        return PagedDecodeEngine(net, n_slots=2, n_blocks=24,
+                                 block_len=BL, speculative=4)
+
+    def test_ngram_continuation_and_recency(self, net):
+        eng = self._eng(net)
+        s2r, out = admit_all(eng, [dict(prompt_ids=np.arange(5) % V,
+                                        n_tokens=2)])
+        slot = next(iter(s2r))
+        # history ends ...7, 8] with an earlier [7, 8, 9] and a LATER
+        # [7, 8, 5]: the most recent occurrence wins
+        eng.slots[slot].history = [1, 7, 8, 9, 2, 7, 8, 5, 6, 7, 8]
+        assert eng._propose(slot, 3) == [5, 6, 7]
+        # longest n-gram wins over a shorter, more recent one
+        eng.slots[slot].history = [3, 7, 8, 4, 1, 3, 7, 8, 9, 3, 7, 8]
+        assert eng._propose(slot, 2) == [9, 3]
+
+    def test_no_match_proposes_nothing(self, net):
+        eng = self._eng(net)
+        s2r, out = admit_all(eng, [dict(prompt_ids=np.arange(5) % V,
+                                        n_tokens=2)])
+        slot = next(iter(s2r))
+        eng.slots[slot].history = [1, 2, 3, 4, 5]
+        assert eng._propose(slot, 3) == []
+        assert eng._propose(slot, 0) == []
+
+    def test_cyclic_history_is_acceptance_friendly(self, net):
+        """A repeating tail — what greedy decode of a converged stream
+        looks like — drafts the whole cycle ahead."""
+        eng = self._eng(net)
+        s2r, out = admit_all(eng, [dict(prompt_ids=np.arange(5) % V,
+                                        n_tokens=2)])
+        slot = next(iter(s2r))
+        eng.slots[slot].history = [9, 4, 5, 4, 5, 4, 5]
+        # the continuation runs to the end of history (the proposer
+        # copies, it does not extrapolate the cycle)
+        assert eng._propose(slot, 3) == [4, 5]
+        assert eng._propose(slot, 1) == [4]
+
+
+# --------------------------------------------------------------------------
+class TestSpeculativeParity:
+    def test_spec_greedy_bit_equal_generate(self, net, prompts,
+                                            ref_tokens):
+        eng = PagedDecodeEngine(net, n_slots=6, n_blocks=64,
+                                block_len=BL, speculative=4)
+        s2r, out = admit_all(eng, [dict(prompt_ids=prompts[i],
+                                        n_tokens=20) for i in range(6)])
+        drain(eng, s2r, out)
+        got = np.asarray([out[i] for i in range(6)])
+        np.testing.assert_array_equal(got, ref_tokens)
+        assert eng.spec_dispatches_total > 0
+        # every post-admission token (19 per stream — admission emits
+        # the first) went through the speculative dispatch path
+        assert eng.spec_emitted_total == 6 * 19
+
+    def test_staggered_admissions_and_chunk_interleaving(
+            self, net, prompts, ref_tokens):
+        """Admissions landing mid-speculation plus alternating
+        speculative and chunked dispatches — the scheduler's
+        accept-rate fallback does exactly this."""
+        eng = PagedDecodeEngine(net, n_slots=4, n_blocks=64,
+                                block_len=BL, speculative=4,
+                                steps_per_dispatch=3)
+        s2r, out = admit_all(eng, [dict(prompt_ids=prompts[i],
+                                        n_tokens=20) for i in range(2)])
+        emitted, _ = eng.step(speculate=True)
+        for slot, toks in emitted.items():
+            out[s2r[slot]].extend(toks)
+        more = eng.admit_many([dict(prompt_ids=prompts[i], n_tokens=20)
+                               for i in (2, 3)])
+        assert len(more) == 2
+        for j, (slot, first, done) in enumerate(more):
+            out[2 + j] = [first]
+            s2r[slot] = 2 + j
+        flip = [True]
+        guard = 0
+        while eng.active.any():
+            flip[0] = not flip[0]
+            emitted, finished = eng.step(speculate=flip[0])
+            for slot, toks in emitted.items():
+                out[s2r[slot]].extend(toks)
+            for slot in finished:
+                del s2r[slot]
+            guard += 1
+            assert guard < 400
+        got = np.asarray([out[i] for i in range(4)])
+        np.testing.assert_array_equal(got, ref_tokens[:4])
+
+    def test_mixed_greedy_sampled_wave(self, net, prompts):
+        """Sampled slots ride the speculative dispatch at depth 1 and
+        keep the fold_in(key, t) stream EXACTLY as they would without
+        speculation; greedy slots stay bit-equal to generate()."""
+        key = np.asarray([7, 11], np.uint32)
+        # the sampled reference: same request alone on a spec-free
+        # engine (the batch-composition-independence contract)
+        ref_eng = PagedDecodeEngine(net, n_slots=1, n_blocks=16,
+                                    block_len=BL)
+        s2r, ref_out = admit_all(ref_eng, [dict(
+            prompt_ids=prompts[0], n_tokens=20, temperature=0.9,
+            rng=key)])
+        drain(ref_eng, s2r, ref_out)
+        greedy_ref = generate(net, prompts[1:3], 20, temperature=0)
+
+        eng = PagedDecodeEngine(net, n_slots=3, n_blocks=48,
+                                block_len=BL, speculative=4)
+        s2r, out = admit_all(eng, [
+            dict(prompt_ids=prompts[0], n_tokens=20, temperature=0.9,
+                 rng=key),
+            dict(prompt_ids=prompts[1], n_tokens=20),
+            dict(prompt_ids=prompts[2], n_tokens=20)])
+        drain(eng, s2r, out)
+        np.testing.assert_array_equal(np.asarray(out[0]),
+                                      np.asarray(ref_out[0]))
+        np.testing.assert_array_equal(np.asarray(out[1]), greedy_ref[0])
+        np.testing.assert_array_equal(np.asarray(out[2]), greedy_ref[1])
+
+    def test_preempt_requeue_continuation_under_speculation(self, net):
+        """Pool pressure during a speculative grow preempts the
+        lowest-progress slot; its requeued continuation must still be
+        bit-equal (server-level — the scheduler owns requeue)."""
+        rng = np.random.default_rng(11)
+        ps = [rng.integers(0, V, 4) for _ in range(4)]
+        refs = [generate(net, p[None], 16, temperature=0)[0] for p in ps]
+        # pool sized so 4 growing streams cannot all finish resident
+        srv = GenerationServer(net, n_slots=4, n_blocks=9, block_len=BL,
+                               speculative=4)
+        srv.warmup(4, 16).start()
+        streams = [srv.generate_async(p, 16) for p in ps]
+        res = [s.result(timeout=300) for s in streams]
+        srv.stop()
+        for r, want in zip(res, refs):
+            np.testing.assert_array_equal(np.asarray(r, np.int64),
+                                          np.asarray(want, np.int64))
+
+    def test_spec_depth_respects_remaining(self, net, prompts):
+        """A slot 1 token from completion takes a depth-1 dispatch —
+        never emits past n_tokens."""
+        eng = PagedDecodeEngine(net, n_slots=1, n_blocks=16,
+                                block_len=BL, speculative=4)
+        s2r, out = admit_all(eng, [dict(prompt_ids=prompts[0],
+                                        n_tokens=2)])
+        drain(eng, s2r, out)
+        assert len(out[0]) == 2
+        ref = generate(net, prompts[:1], 2, temperature=0)
+        np.testing.assert_array_equal(np.asarray([out[0]]), ref)
+
+
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def shared_prefix():
+    return np.random.default_rng(21).integers(0, V, 6)   # 6 % BL != 0
+
+
+class TestSharedPrefixCoW:
+    def _noise(self, eng, seed=9):
+        key = np.random.default_rng(seed)
+        eng.pool.kv = tuple(
+            (k + jnp.asarray(key.standard_normal(k.shape), k.dtype),
+             v + jnp.asarray(key.standard_normal(v.shape), v.dtype))
+            for k, v in eng.pool.kv)
+
+    def test_shared_streams_bit_equal_private_noise_pool(
+            self, net, shared_prefix):
+        """Suffix lengths {0, 1, 3, 5} (exact match, sub-block, and
+        multi-block extension) through a NOISE-filled pool: every
+        stream bit-equal to its whole-batch generate() row, one prefix
+        prefill for the whole set."""
+        rng = np.random.default_rng(31)
+        ps = [np.concatenate([shared_prefix, rng.integers(0, V, k)])
+              for k in (0, 1, 3, 5)]
+        refs = [generate(net, p[None], 16, temperature=0)[0] for p in ps]
+        eng = PagedDecodeEngine(net, n_slots=4, n_blocks=48,
+                                block_len=BL)
+        self._noise(eng)
+        eng.register_prefix(shared_prefix)
+        s2r, out = admit_all(eng, [dict(prompt_ids=p, n_tokens=16)
+                                   for p in ps])
+        drain(eng, s2r, out)
+        for i, want in enumerate(refs):
+            np.testing.assert_array_equal(np.asarray(out[i]),
+                                          np.asarray(want))
+        assert eng.prefix_hits_total == 4
+        assert eng.prefix_tokens_saved_total == 4 * 6
+        # prefix len 6, BL 4: every hit forks the mid-block tail
+        assert eng.prefix_forks_total == 4
+
+    def test_fork_at_boundary(self, net):
+        """A prefix ending ON a block boundary shares cleanly — no
+        fork at all; a mid-block prefix forks exactly once per hit."""
+        rng = np.random.default_rng(33)
+        aligned = rng.integers(0, V, 8)          # 8 % BL == 0
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=32,
+                                block_len=BL)
+        eng.register_prefix(aligned)
+        p = np.concatenate([aligned, rng.integers(0, V, 2)])
+        ref = generate(net, p[None], 10, temperature=0)[0]
+        s2r, out = admit_all(eng, [dict(prompt_ids=p, n_tokens=10)])
+        drain(eng, s2r, out)
+        np.testing.assert_array_equal(np.asarray(out[0]), ref)
+        assert eng.prefix_forks_total == 0
+        assert eng.prefix_hits_total == 1
+
+    def test_evict_while_shared(self, net, shared_prefix):
+        """Evicting a CoW stream mid-flight returns its references:
+        the cache's pins survive, fresh blocks return to the pool, and
+        the next admission reuses the prefix with full parity."""
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=32,
+                                block_len=BL)
+        eng.register_prefix(shared_prefix)
+        free0 = eng.pool.free_blocks
+        rng = np.random.default_rng(35)
+        p = np.concatenate([shared_prefix, rng.integers(0, V, 3)])
+        admitted = eng.admit_many([dict(prompt_ids=p, n_tokens=16)])
+        eng.step()
+        eng.evict(admitted[0][0])
+        assert eng.pool.free_blocks == free0
+        # shared blocks still granted to the cache (refcount 1 each)
+        for b in eng._prefixes[tuple(int(t) for t in shared_prefix)][
+                "blocks"]:
+            assert eng.pool.allocator.refcount(b) == 1
+        ref = generate(net, p[None], 16, temperature=0)[0]
+        s2r, out = admit_all(eng, [dict(prompt_ids=p, n_tokens=16)])
+        drain(eng, s2r, out)
+        np.testing.assert_array_equal(np.asarray(out[0]), ref)
+
+    def test_release_prefix_returns_blocks(self, net, shared_prefix):
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=32,
+                                block_len=BL)
+        free0 = eng.pool.free_blocks
+        key = eng.register_prefix(shared_prefix)
+        assert eng.pool.free_blocks == free0 - 2     # ceil(6/4)
+        assert eng.prefix_pinned_blocks == 2
+        eng.release_prefix(key)
+        assert eng.pool.free_blocks == free0
+        assert eng.prefix_pinned_blocks == 0
+
+    def test_sampled_cow_stream_matches_private(self, net,
+                                                shared_prefix):
+        """Sampling over a shared prefix: same request key, same
+        fold_in(key, t) chain, bit-equal to the private-block stream
+        (probs equality is what the CoW contract guarantees; the
+        sampling tail is shared code)."""
+        key = np.asarray([3, 19], np.uint32)
+        rng = np.random.default_rng(37)
+        p = np.concatenate([shared_prefix, rng.integers(0, V, 2)])
+        ref_eng = PagedDecodeEngine(net, n_slots=1, n_blocks=16,
+                                    block_len=BL)
+        s2r, ref_out = admit_all(ref_eng, [dict(
+            prompt_ids=p, n_tokens=14, temperature=0.8, rng=key)])
+        drain(ref_eng, s2r, ref_out)
+        eng = PagedDecodeEngine(net, n_slots=1, n_blocks=16,
+                                block_len=BL)
+        eng.register_prefix(shared_prefix)
+        s2r, out = admit_all(eng, [dict(
+            prompt_ids=p, n_tokens=14, temperature=0.8, rng=key)])
+        drain(eng, s2r, out)
+        np.testing.assert_array_equal(np.asarray(out[0]),
+                                      np.asarray(ref_out[0]))
+        assert eng.prefix_hits_total == 1
+
+    def test_exact_match_sampled(self, net, shared_prefix):
+        """Prompt == prefix exactly: the first token comes from the
+        REGISTRATION-cached distribution (no forward at all) and must
+        still match the private stream — greedy and sampled."""
+        key = np.asarray([5, 23], np.uint32)
+        for kw in (dict(), dict(temperature=0.7, rng=key)):
+            ref_eng = PagedDecodeEngine(net, n_slots=1, n_blocks=16,
+                                        block_len=BL)
+            s2r, ref_out = admit_all(ref_eng, [dict(
+                prompt_ids=shared_prefix, n_tokens=12, **kw)])
+            drain(ref_eng, s2r, ref_out)
+            eng = PagedDecodeEngine(net, n_slots=1, n_blocks=16,
+                                    block_len=BL)
+            eng.register_prefix(shared_prefix)
+            s2r, out = admit_all(eng, [dict(
+                prompt_ids=shared_prefix, n_tokens=12, **kw)])
+            drain(eng, s2r, out)
+            np.testing.assert_array_equal(np.asarray(out[0]),
+                                          np.asarray(ref_out[0]))
+
+    def test_preempt_requeue_cow_slot(self, net, shared_prefix):
+        """A CoW slot preempted under pool pressure requeues as a
+        continuation, re-matches the prefix on re-admission, and
+        completes bit-equal (server-level requeue)."""
+        rng = np.random.default_rng(39)
+        ps = [np.concatenate([shared_prefix, rng.integers(0, V, 2)])
+              for _ in range(3)]
+        refs = [generate(net, p[None], 16, temperature=0)[0] for p in ps]
+        # prefix pins 2 blocks; 3 growing streams over 8 usable fresh
+        # blocks force preemption before all finish
+        srv = GenerationServer(net, n_slots=3, n_blocks=11, block_len=BL)
+        srv.register_prefix(shared_prefix)
+        srv.warmup(8, 16).start()
+        streams = [srv.generate_async(p, 16) for p in ps]
+        res = [s.result(timeout=300) for s in streams]
+        assert srv.engine.evict_requeue_total > 0, \
+            "pool never pressured — the test lost its point"
+        srv.stop()
+        for r, want in zip(res, refs):
+            np.testing.assert_array_equal(np.asarray(r, np.int64),
+                                          np.asarray(want, np.int64))
+        assert srv.engine.prefix_hits_total >= 3   # requeues re-hit
+
+    def test_budget_check_is_prefix_aware(self, net, shared_prefix):
+        """A request whose total footprint exceeds the unpinned pool is
+        only admittable RIDING the prefix — check_budget must pass it
+        with the prompt and reject the same lengths without."""
+        # 8 total blocks usable; prefix pins 2 -> 6 unpinned; a
+        # 28-token request needs 7 blocks alone but only 6 fresh ones
+        # when 6 of its tokens ride the shared prefix
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=9,
+                                block_len=BL)
+        eng.register_prefix(shared_prefix)
+        p = np.concatenate([shared_prefix,
+                            np.random.default_rng(41).integers(0, V, 2)])
+        eng.check_budget(8, 20, prompt_ids=p)        # rides the prefix
+        with pytest.raises(ValueError, match="pinned"):
+            eng.check_budget(8, 20)                  # judged by length
+
+    def test_decode_time_fork_safety_net(self, net, shared_prefix):
+        """The pre-dispatch fork pass is the INVARIANT's enforcement
+        point, not just an admission optimization: hand a decoding
+        slot a shared frontier block and the next step must fork it
+        rather than write through the sharing."""
+        eng = PagedDecodeEngine(net, n_slots=1, n_blocks=24,
+                                block_len=BL)
+        p = np.random.default_rng(43).integers(0, V, 4)
+        admitted = eng.admit_many([dict(prompt_ids=p, n_tokens=12)])
+        slot = admitted[0][0]
+        eng.step()    # grow into the write block, decode one token
+        # artificially share the block the NEXT write lands in (as a
+        # second holder would)
+        frontier = eng.slots[slot].blocks[int(eng.pos[slot])
+                                          // BL]
+        eng.pool.allocator.share([frontier])
+        forks0 = eng.prefix_forks_total
+        eng.step()
+        assert eng.prefix_forks_total == forks0 + 1
+        assert eng.slots[slot].blocks[-1] != frontier
+        assert eng.pool.allocator.refcount(frontier) == 1
+        eng.pool.allocator.free([frontier])          # drop our handle
+
+    def test_register_prefix_capacity_errors(self, net):
+        eng = PagedDecodeEngine(net, n_slots=1, n_blocks=4,
+                                block_len=BL)
+        with pytest.raises(ValueError, match="pool cannot host"):
+            eng.register_prefix(np.zeros(16, np.int32))
+        with pytest.raises(ValueError, match="no room to generate"):
+            eng.register_prefix(np.zeros(MAXLEN, np.int32))
+
+
+# --------------------------------------------------------------------------
+class TestSpecCoWComposition:
+    def test_speculative_over_shared_prefix(self, net, shared_prefix):
+        """Both levers at once: drafts scored over CoW-mapped blocks,
+        still bit-equal to generate()."""
+        rng = np.random.default_rng(45)
+        ps = [np.concatenate([shared_prefix, rng.integers(0, V, k)])
+              for k in (0, 2, 4)]
+        refs = [generate(net, p[None], 16, temperature=0)[0] for p in ps]
+        srv = GenerationServer(net, n_slots=3, n_blocks=48,
+                               block_len=BL, speculative=4)
+        srv.register_prefix(shared_prefix)
+        srv.warmup(10, 16).start()
+        streams = [srv.generate_async(p, 16) for p in ps]
+        res = [s.result(timeout=300) for s in streams]
+        srv.stop()
+        for r, want in zip(res, refs):
+            np.testing.assert_array_equal(np.asarray(r, np.int64),
+                                          np.asarray(want, np.int64))
+        assert srv.engine.prefix_hits_total == 3
+        assert srv.engine.spec_dispatches_total > 0
+
+
+# --------------------------------------------------------------------------
+class TestSchedulerSpecPolicy:
+    def _srv(self, net):
+        return GenerationServer(net, n_slots=2, n_blocks=32,
+                                block_len=BL, speculative=4,
+                                spec_accept_floor=0.5,
+                                spec_probe_every=3)
+
+    def test_auto_disable_and_probe_reenable(self, net):
+        """Feed the EWMA by hand through the engine counters: a bad
+        acceptance run latches drafting off; probes keep sampling the
+        workload and a good run re-enables."""
+        srv = self._srv(net)
+        eng = srv.engine
+
+        def dispatch(proposed, accepted, emitted):
+            eng.spec_dispatches_total += 1
+            eng.spec_proposed_total += proposed
+            eng.spec_accepted_total += accepted
+            eng.spec_emitted_total += emitted
+            srv._spec_update(None)
+
+        assert srv._spec_policy() is True
+        for _ in range(12):
+            dispatch(3, 0, 1)            # nothing accepted
+        assert srv._spec_disabled
+        # disabled: chunked dispatches except one probe every 3rd
+        polls = [srv._spec_policy() for _ in range(6)]
+        assert polls.count(True) == 2 and polls.count(False) == 4
+        # probes with perfect acceptance recover the EWMA
+        for _ in range(12):
+            dispatch(3, 3, 4)
+        assert not srv._spec_disabled
+        assert srv._spec_policy() is True
+        assert srv._spec_accept_ewma > 0.5
+
+    def test_spec_gauges_live(self, net, prompts):
+        from deeplearning4j_tpu import monitor
+        monitor.enable(registry=monitor.MetricsRegistry())
+        try:
+            srv = GenerationServer(net, n_slots=2, n_blocks=32,
+                                   block_len=BL, speculative=4)
+            srv.warmup(5, 8).start()
+            pref = np.random.default_rng(47).integers(0, V, 6)
+            srv.register_prefix(pref)
+            p = np.concatenate([pref, [1, 2]])
+            srv.generate_async(p, 8).result(timeout=120)
+            srv.generate_async(prompts[0], 8).result(timeout=120)
+            srv.stop()
+            reg = monitor.registry()
+            assert reg.counter("serving_prefix_hits_total").value >= 1
+            assert reg.counter(
+                "serving_prefix_tokens_saved_total").value >= 6
+            # accept-rate gauge exists and carries a finite value
+            assert reg.gauge(
+                "serving_spec_accept_rate").value is not None
+        finally:
+            monitor.disable()
+
+
+# --------------------------------------------------------------------------
+class TestFleetPrefixReRegistration:
+    def test_prefix_survives_swap(self, tmp_path):
+        """A fleet-registered prefix re-applies to every successor —
+        prefilled under the NEW weights, so post-swap streams keep
+        version-tagged parity AND the prefix hit path."""
+        from deeplearning4j_tpu.serving import FleetServer, ModelRegistry
+
+        v1, v2 = tiny_lm(seed=50), tiny_lm(seed=51)
+        registry = ModelRegistry(tmp_path / "reg")
+        registry.publish("lm", v1)
+        fleet = FleetServer(registry)
+        pref = np.random.default_rng(49).integers(0, V, 6)
+        fleet.register_prefix("lm", pref)
+        fleet.deploy("lm", n_slots=2, n_blocks=32, block_len=BL)
+        p = np.concatenate([pref, [3, 4]])
+        try:
+            ref1 = generate(v1, p[None], 8, temperature=0)[0]
+            s = fleet.server("lm").generate_async(p, 8)
+            np.testing.assert_array_equal(
+                np.asarray(s.result(timeout=120), np.int64), ref1)
+            assert fleet.server("lm").engine.prefix_hits_total == 1
+            registry.publish("lm", v2)
+            fleet.swap("lm")
+            ref2 = generate(v2, p[None], 8, temperature=0)[0]
+            s = fleet.server("lm").generate_async(p, 8)
+            np.testing.assert_array_equal(
+                np.asarray(s.result(timeout=120), np.int64), ref2)
+            # the successor re-registered and re-prefilled the prefix
+            assert fleet.server("lm").engine.prefix_hits_total == 1
+        finally:
+            fleet.stop()
