@@ -66,6 +66,28 @@ from deeplearning4j_tpu.monitor.xprof import (
     publish_cost_report,
     roofline,
 )
+from deeplearning4j_tpu.monitor import reqtrace
+from deeplearning4j_tpu.monitor.reqtrace import (
+    RequestTrace,
+    clear_exemplar_sink,
+    mint_trace_id,
+    set_exemplar_sink,
+)
+from deeplearning4j_tpu.monitor import federate
+from deeplearning4j_tpu.monitor.federate import (
+    FederationCollector,
+    FederationPublisher,
+    MetricsAggregator,
+    export_snapshot,
+)
+from deeplearning4j_tpu.monitor import slo
+from deeplearning4j_tpu.monitor.slo import SLOObjective, SLOTracker
+from deeplearning4j_tpu.monitor import flightrec
+from deeplearning4j_tpu.monitor.flightrec import (
+    GLOBAL_FLIGHT_RECORDER,
+    FlightRecorder,
+    flight_recorder,
+)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "Timer",
@@ -77,6 +99,13 @@ __all__ = [
     "xprof", "ProfilerCapture", "roofline", "publish_cost_report",
     "diagnostics", "Diagnostics", "DiagnosticsConfig",
     "NonFiniteGradientsError", "resolve_diagnostics",
+    "reqtrace", "RequestTrace", "mint_trace_id",
+    "set_exemplar_sink", "clear_exemplar_sink",
+    "federate", "MetricsAggregator", "FederationPublisher",
+    "FederationCollector", "export_snapshot",
+    "slo", "SLOObjective", "SLOTracker",
+    "flightrec", "FlightRecorder", "flight_recorder",
+    "GLOBAL_FLIGHT_RECORDER",
 ]
 
 
@@ -108,6 +137,11 @@ def enable(registry: Optional[MetricsRegistry] = None,
         if tracer is not None:
             _STATE.tracer = tracer
         _STATE.tracer.enabled = True
+        # surface ring-buffer overflow: the tracer drops its OLDEST
+        # event silently, so the loss count must be a visible metric
+        _STATE.tracer._drop_counter = _STATE.registry.counter(
+            "tracer_events_dropped_total",
+            help="trace events evicted by the tracer ring buffer")
         _STATE.listener = MonitorListener(_STATE.registry)
         # a collector pointed at a superseded registry must be torn down
         # (jax's listener list is append-only: an orphaned active

@@ -484,6 +484,12 @@ class Diagnostics:
                     "%d (layers %s) — update SKIPPED in-graph",
                     it0 + i, sorted(bad))
             elif policy == "halt":
+                from deeplearning4j_tpu.monitor.flightrec import (
+                    GLOBAL_FLIGHT_RECORDER,
+                )
+                GLOBAL_FLIGHT_RECORDER.record(
+                    "watchdog_halt", layers=sorted(bad),
+                    iteration=int(it0 + i))
                 raise NonFiniteGradientsError(bad, it0 + i)
             else:  # warn (and None: count only)
                 if policy == "warn":

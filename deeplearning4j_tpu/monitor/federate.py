@@ -1,0 +1,320 @@
+"""Fleet telemetry federation: many worker registries, one /metrics view.
+
+The push/pull seam between per-process `MetricsRegistry` instances and a
+fleet-level scrape endpoint (the streamz-federation role in TensorFlow's
+fleet instrumentation, arXiv:1605.08695 §5; the fleet-health aggregation
+TPU pods depend on, arXiv:2606.15870):
+
+- `export_snapshot(registry, worker)` — one worker's labeled snapshot
+  (a JSON-friendly dict; histograms carry their bucket layout so the
+  merge is a true bucket merge, not a lossy sum/count).
+- `MetricsAggregator` — ingests worker exports (last snapshot per worker
+  wins) and renders ONE exposition: every series re-labeled with
+  `worker=<name>`, plus cross-worker merged series (counters summed,
+  gauges last-write by snapshot time, histograms bucket-merged when the
+  layouts match) without the worker label.
+- `FederationPublisher` / `FederationCollector` — the push pipe over any
+  `streaming.Transport` (local queue in tests, Kafka in a real fleet):
+  publisher serializes exports onto a topic, collector drains them into
+  an aggregator.
+- elastic integration: training workers ride the heartbeat info channel
+  (`ElasticClient.federate_metrics()`), and `ingest_elastic_status`
+  lifts member info out of a coordinator `status()` into an aggregator.
+
+Transports are duck-typed (`send`/`receive` of bytes) so this module
+stays stdlib-only.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .registry import (MetricsRegistry, _escape_help, _fmt_labels,
+                       _fmt_value, _label_key)
+
+
+def export_snapshot(registry: MetricsRegistry, worker: str) -> Dict:
+    """One worker's federation payload."""
+    return {"worker": str(worker), "ts": time.time(),
+            "metrics": registry.snapshot()}
+
+
+def _exposition_lines(name: str, kind: str, entry: Dict,
+                      extra_labels: Dict[str, str]) -> List[str]:
+    """Render one snapshot entry (a single labeled child) as exposition
+    lines, with `extra_labels` merged in."""
+    labels = dict(entry.get("labels") or {})
+    labels.update(extra_labels)
+    key = _label_key(labels)
+    lines: List[str] = []
+    if kind in ("histogram", "timer"):
+        buckets = entry.get("buckets")
+        counts = entry.get("bucket_counts")
+        if buckets is not None and counts is not None:
+            acc = 0
+            for b, c in zip(buckets, counts):
+                acc += c
+                bkey = key + (("le", _fmt_value(b)),)
+                lines.append(f"{name}_bucket{_fmt_labels(bkey)} {acc}")
+            acc += counts[-1] if len(counts) > len(buckets) else 0
+            ikey = key + (("le", "+Inf"),)
+            lines.append(f"{name}_bucket{_fmt_labels(ikey)} {acc}")
+        lines.append(f"{name}_sum{_fmt_labels(key)} "
+                     f"{_fmt_value(entry.get('sum', 0.0))}")
+        lines.append(f"{name}_count{_fmt_labels(key)} "
+                     f"{int(entry.get('count', 0))}")
+    else:
+        lines.append(f"{name}{_fmt_labels(key)} "
+                     f"{_fmt_value(entry.get('value', 0.0))}")
+    return lines
+
+
+class MetricsAggregator:
+    """Merge worker snapshots into one exposition.
+
+    Duck-compatible with the slice of `MetricsRegistry` the UIServer's
+    `/metrics` route needs (`exposition()`, `snapshot()`), so it can be
+    attached via `UIServer.attach_registry(aggregator)` directly.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # worker -> {"ts": float, "metrics": snapshot-dict}
+        self._exports: Dict[str, Dict] = {}
+
+    # ------------------------------------------------------------- ingest
+    def ingest(self, export: Dict) -> str:
+        """Absorb one `export_snapshot` payload (dict or JSON str/bytes).
+        Last snapshot per worker wins (by export ts). Returns the worker
+        name."""
+        if isinstance(export, (bytes, bytearray)):
+            export = export.decode("utf-8")
+        if isinstance(export, str):
+            export = json.loads(export)
+        worker = str(export["worker"])
+        ts = float(export.get("ts", time.time()))
+        with self._lock:
+            prev = self._exports.get(worker)
+            if prev is None or ts >= prev["ts"]:
+                self._exports[worker] = {"ts": ts,
+                                         "metrics": export["metrics"]}
+        return worker
+
+    def ingest_registry(self, registry: MetricsRegistry, worker: str) -> str:
+        return self.ingest(export_snapshot(registry, worker))
+
+    def workers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._exports)
+
+    def clear(self):
+        with self._lock:
+            self._exports.clear()
+
+    # -------------------------------------------------------------- merge
+    def _merged_families(self) -> Dict[str, Dict]:
+        """family name -> {"type", "help", "per_worker": [(worker, entry)],
+        "merged": [entry]} — merged entries keyed by the original label
+        set: counters summed, gauges last-write (newest snapshot wins),
+        histograms bucket-merged when layouts match (sum/count-only
+        entry otherwise)."""
+        with self._lock:
+            exports = {w: dict(e) for w, e in self._exports.items()}
+        fams: Dict[str, Dict] = {}
+        for worker in sorted(exports):
+            ts = exports[worker]["ts"]
+            for name, fam in exports[worker]["metrics"].items():
+                slot = fams.setdefault(
+                    name, {"type": fam.get("type", "gauge"),
+                           "help": fam.get("help", ""),
+                           "per_worker": [], "_merge": {}})
+                if fam.get("help") and not slot["help"]:
+                    slot["help"] = fam["help"]
+                for entry in fam.get("values", ()):
+                    slot["per_worker"].append((worker, entry))
+                    lkey = _label_key(entry.get("labels") or {})
+                    m = slot["_merge"].get(lkey)
+                    kind = slot["type"]
+                    if kind == "counter":
+                        if m is None:
+                            m = {"labels": dict(entry.get("labels") or {}),
+                                 "value": 0.0}
+                            slot["_merge"][lkey] = m
+                        m["value"] += float(entry.get("value", 0.0))
+                    elif kind in ("histogram", "timer"):
+                        if m is None:
+                            m = {"labels": dict(entry.get("labels") or {}),
+                                 "sum": 0.0, "count": 0,
+                                 "buckets": entry.get("buckets"),
+                                 "bucket_counts":
+                                     (list(entry["bucket_counts"])
+                                      if entry.get("bucket_counts")
+                                      else None)}
+                            slot["_merge"][lkey] = m
+                        elif (m.get("buckets") is not None
+                                and entry.get("buckets") == m["buckets"]
+                                and entry.get("bucket_counts")):
+                            m["bucket_counts"] = [
+                                a + b for a, b in
+                                zip(m["bucket_counts"],
+                                    entry["bucket_counts"])]
+                        else:
+                            # layout mismatch: degrade to sum/count
+                            m["buckets"] = None
+                            m["bucket_counts"] = None
+                        m["sum"] += float(entry.get("sum", 0.0))
+                        m["count"] += int(entry.get("count", 0))
+                    else:  # gauge: last write wins, newest snapshot ts
+                        if m is None or ts >= m["_ts"]:
+                            slot["_merge"][lkey] = {
+                                "labels": dict(entry.get("labels") or {}),
+                                "value": entry.get("value", 0.0),
+                                "_ts": ts}
+        for slot in fams.values():
+            merged = []
+            for lkey in sorted(slot["_merge"]):
+                e = dict(slot["_merge"][lkey])
+                e.pop("_ts", None)
+                if e.get("buckets") is None:
+                    e.pop("buckets", None)
+                    e.pop("bucket_counts", None)
+                merged.append(e)
+            slot["merged"] = merged
+            del slot["_merge"]
+        return fams
+
+    # ------------------------------------------------------------- export
+    def exposition(self) -> str:
+        """Prometheus text 0.0.4: per-worker series carry `worker=`
+        labels; merged cross-worker series carry none."""
+        lines: List[str] = []
+        fams = self._merged_families()
+        for name in sorted(fams):
+            slot = fams[name]
+            ptype = "histogram" if slot["type"] == "timer" else slot["type"]
+            if slot["help"]:
+                lines.append(f"# HELP {name} {_escape_help(slot['help'])}")
+            lines.append(f"# TYPE {name} {ptype}")
+            for worker, entry in slot["per_worker"]:
+                lines.extend(_exposition_lines(
+                    name, slot["type"], entry, {"worker": worker}))
+            for entry in slot["merged"]:
+                lines.extend(_exposition_lines(name, slot["type"],
+                                               entry, {}))
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict:
+        """Merged cross-worker view in `MetricsRegistry.snapshot()`
+        shape (what the UI JSON routes consume)."""
+        out: Dict = {}
+        for name, slot in self._merged_families().items():
+            out[name] = {"type": slot["type"], "help": slot["help"],
+                         "values": [dict(e) for e in slot["merged"]]}
+        return out
+
+
+# =====================================================================
+# transport pipe
+# =====================================================================
+class FederationPublisher:
+    """Push side: serialize this process's registry onto a transport
+    topic, once or on a daemon interval."""
+
+    def __init__(self, transport, topic: str, worker: str,
+                 registry: Optional[MetricsRegistry] = None,
+                 interval_s: float = 1.0):
+        self.transport = transport
+        self.topic = topic
+        self.worker = str(worker)
+        self._registry = registry
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.published_total = 0
+
+    def _resolve_registry(self) -> MetricsRegistry:
+        if self._registry is not None:
+            return self._registry
+        from deeplearning4j_tpu import monitor
+        return monitor.registry()
+
+    def publish_once(self):
+        payload = json.dumps(
+            export_snapshot(self._resolve_registry(), self.worker),
+            default=str).encode("utf-8")
+        self.transport.send(self.topic, payload)
+        self.published_total += 1
+
+    def start(self) -> "FederationPublisher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"fed-pub-{self.worker}")
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.publish_once()
+            except Exception:  # noqa: BLE001 — telemetry must not crash
+                pass
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2 * self.interval_s + 1)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class FederationCollector:
+    """Pull side: drain exports off the topic into an aggregator."""
+
+    def __init__(self, transport, topic: str,
+                 aggregator: Optional[MetricsAggregator] = None):
+        self.transport = transport
+        self.topic = topic
+        self.aggregator = aggregator or MetricsAggregator()
+        self.ingested_total = 0
+
+    def poll(self, timeout: float = 0.05, max_msgs: int = 1000) -> int:
+        """Ingest up to `max_msgs` waiting exports; returns how many."""
+        n = 0
+        for _ in range(int(max_msgs)):
+            try:
+                payload = self.transport.receive(self.topic, timeout)
+            except Exception:  # queue.Empty / TimeoutError — drained
+                break
+            self.aggregator.ingest(payload)
+            self.ingested_total += 1
+            n += 1
+        return n
+
+
+# =====================================================================
+# elastic heartbeat integration
+# =====================================================================
+def ingest_elastic_status(status: Dict,
+                          aggregator: MetricsAggregator) -> int:
+    """Lift federated metrics out of an `ElasticCoordinator.status()`
+    view: any member whose heartbeat info carries a `"metrics"` export
+    (see `ElasticClient.federate_metrics`) is ingested. Returns how many
+    members contributed."""
+    n = 0
+    for token, member in (status.get("members") or {}).items():
+        export = (member.get("info") or {}).get("metrics")
+        if export:
+            aggregator.ingest(export)
+            n += 1
+    return n

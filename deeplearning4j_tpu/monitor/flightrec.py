@@ -1,0 +1,115 @@
+"""Control-plane flight recorder: a bounded durable ring of the events
+that explain an incident after the fact.
+
+The metrics registry answers "what is the p99 now"; the flight recorder
+answers "what did the control plane DO in the last ten minutes" —
+publishes, swaps, drains, autoscale decisions, drift-gate trips, elastic
+reconfigurations, watchdog halts, shed bursts. This is the postmortem
+half of the fleet-health machinery TPU fleets lean on (arXiv:2606.15870):
+when a swap strands requests or an autoscaler flaps, the first question
+is the ordered event log, not a gauge.
+
+Design points:
+
+- **Always on.** Control-plane events are rare (Hz, not kHz) and tiny,
+  so recording does not route through `monitor.is_enabled()` — a crash
+  in a run that never enabled metrics still leaves a usable ring.
+- **Bounded.** A deque ring (default 4096) caps memory; `dropped`
+  counts evictions so a dump is honest about missing history.
+- **Durable (optional).** `path=` appends every event as one JSONL line
+  at record time — the ring survives the process only if asked to.
+- **Dump on error.** `dump(path)` writes the current ring; callers hang
+  it off their exception paths.
+
+Pure stdlib, thread-safe, no JAX imports.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 4096, path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._path = path
+        self._seq = 0
+        #: events evicted from the ring (still in the durable log, if any)
+        self.dropped = 0
+
+    # ---------------------------------------------------------- recording
+    def record(self, kind: str, **fields) -> Dict:
+        """Append one event. `kind` is the event type (e.g. "swap",
+        "drift_trip"); fields are JSON-friendly details."""
+        ev = {"ts": time.time(), "kind": str(kind), **fields}
+        line = None
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(ev)
+            if self._path is not None:
+                line = json.dumps(ev, default=str)
+        if line is not None:
+            try:
+                with open(self._path, "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                pass  # the recorder must never take down the control plane
+        return ev
+
+    def attach_file(self, path: Optional[str]):
+        """Point (or un-point) the durable JSONL sink."""
+        with self._lock:
+            self._path = path
+
+    # ------------------------------------------------------------ queries
+    def events(self, kind: Optional[str] = None,
+               last: Optional[int] = None) -> List[Dict]:
+        with self._lock:
+            evs = list(self._ring)
+        if kind is not None:
+            evs = [e for e in evs if e["kind"] == kind]
+        if last is not None:
+            evs = evs[-int(last):]
+        return evs
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # ------------------------------------------------------------- export
+    def dump(self, path: Optional[str] = None) -> str:
+        """Serialize the ring; write JSONL to `path` if given, return the
+        text either way. Called from error paths, so it never raises on
+        I/O failure."""
+        evs = self.events()
+        text = "\n".join(json.dumps(e, default=str) for e in evs)
+        if text:
+            text += "\n"
+        if path is not None:
+            try:
+                with open(path, "w") as f:
+                    f.write(text)
+            except OSError:
+                pass
+        return text
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+
+#: process-global recorder — control-plane call sites record here
+GLOBAL_FLIGHT_RECORDER = FlightRecorder()
+
+
+def flight_recorder() -> FlightRecorder:
+    return GLOBAL_FLIGHT_RECORDER

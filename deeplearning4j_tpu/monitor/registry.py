@@ -31,6 +31,31 @@ def _escape_label_value(v: str) -> str:
     return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
 
 
+def _escape_help(v: str) -> str:
+    # exposition-format 0.0.4 HELP escaping: backslash and newline only
+    # (no quote escaping — HELP text is not quoted)
+    return v.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _unescape_label_value(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ("\\", '"'):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
 def _fmt_labels(key: _LabelKey) -> str:
     if not key:
         return ""
@@ -235,7 +260,7 @@ class MetricsRegistry:
             for fam in families:
                 ptype = "histogram" if fam.kind == "timer" else fam.kind
                 if fam.help:
-                    lines.append(f"# HELP {fam.name} {fam.help}")
+                    lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
                 lines.append(f"# TYPE {fam.name} {ptype}")
                 for key, child in sorted(fam.children.items()):
                     if isinstance(child, Histogram):
@@ -265,12 +290,18 @@ class MetricsRegistry:
                 for key, child in fam.children.items():
                     labels = dict(key)
                     if isinstance(child, Histogram):
+                        # bucket layout rides along so a federation
+                        # aggregator can bucket-merge, not just sum/count
                         entries.append({"labels": labels, "sum": child.sum,
-                                        "count": child.count})
+                                        "count": child.count,
+                                        "buckets": list(child.buckets),
+                                        "bucket_counts":
+                                            list(child.bucket_counts)})
                     else:
                         entries.append({"labels": labels,
                                         "value": child.value})
-                out[name] = {"type": fam.kind, "values": entries}
+                out[name] = {"type": fam.kind, "help": fam.help,
+                             "values": entries}
         return out
 
     def dump_jsonl(self, path: str, **meta):

@@ -1,0 +1,195 @@
+"""Per-request lifecycle traces for the serving request plane.
+
+A `RequestTrace` is a host-side accumulator: the scheduler loop stamps
+`time.perf_counter()` phases onto it (queued, prefill, decode chunks,
+preempt-requeue, drain-at-swap, shed, finish) as plain list appends —
+no locks on the hot path, no device syncs, no allocation beyond the
+dicts themselves. At `finish()` the accrued phases flush to the active
+`Tracer` in one pass on a synthetic per-request track (tid derived from
+the trace id), so a Chrome-trace/Perfetto export shows one lane per
+request — the per-phase runtime-timeline discipline of arXiv:1605.08695
+applied to requests instead of ops.
+
+Trace context crosses the ND4T wire as a header field (`wire.py`), so a
+remote stream through `FleetClient` and the router-side trace share one
+trace id and stitch into one timeline.
+
+A sampled-exemplar JSONL sink (`set_exemplar_sink`) persists every Nth
+finished trace — enough to answer "show me a slow request" without
+writing every request to disk.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from . import tracer as _tracer_mod
+
+
+def mint_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def _tid_for(trace_id: str) -> int:
+    # a stable synthetic track id per trace; keep it positive and well
+    # away from real thread idents' low range
+    try:
+        return (int(trace_id[:8], 16) & 0x7FFFFFFF) | 0x40000000
+    except ValueError:
+        return (abs(hash(trace_id)) & 0x7FFFFFFF) | 0x40000000
+
+
+class RequestTrace:
+    """Host-side per-request span accumulator.
+
+    All mutators are plain list/dict appends (GIL-atomic, cheap); the
+    only costful work — flushing to the Tracer and the exemplar sink —
+    happens once, in `finish()`.
+    """
+
+    __slots__ = ("trace_id", "parent_id", "remote", "model", "meta",
+                 "phases", "events", "status", "t_created", "t_finished",
+                 "_finished")
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None, remote: bool = False,
+                 model: Optional[str] = None, **meta):
+        self.trace_id = trace_id or mint_trace_id()
+        self.parent_id = parent_id
+        self.remote = bool(remote)
+        self.model = model
+        self.meta: Dict = dict(meta)
+        self.phases: List[Dict] = []
+        self.events: List[Dict] = []
+        self.status: Optional[str] = None
+        self.t_created = time.perf_counter()
+        self.t_finished: Optional[float] = None
+        self._finished = False
+
+    # ---------------------------------------------------------- recording
+    def phase(self, name: str, t0: float, t1: float, **args):
+        """One timed phase from two `time.perf_counter()` readings."""
+        self.phases.append({"name": name, "t0": t0, "t1": t1,
+                            "args": args})
+
+    def event(self, name: str, **args):
+        """Zero-duration marker (shed decision, preempt-requeue, ...)."""
+        self.events.append({"name": name, "t": time.perf_counter(),
+                            "args": args})
+
+    def annotate(self, **meta):
+        self.meta.update(meta)
+
+    # ------------------------------------------------------------- finish
+    def finish(self, status: str = "ok", **args):
+        """Seal the trace: flush phases/events to the active Tracer on a
+        per-request track and offer the trace to the exemplar sink.
+        Idempotent — a second finish is a no-op."""
+        if self._finished:
+            return
+        self._finished = True
+        self.status = status
+        self.t_finished = time.perf_counter()
+        if args:
+            self.meta.update(args)
+        self._flush_to_tracer()
+        _offer_exemplar(self)
+
+    @property
+    def finished(self) -> bool:
+        return self._finished
+
+    @property
+    def duration_s(self) -> float:
+        end = self.t_finished if self.t_finished is not None \
+            else time.perf_counter()
+        return end - self.t_created
+
+    def _flush_to_tracer(self):
+        from . import _STATE  # late: avoid import cycle at module load
+        tr = _STATE.tracer
+        if tr is None or not tr.enabled:
+            return
+        tid = _tid_for(self.trace_id)
+        label = f"req:{self.trace_id}"
+        if self.model:
+            label += f" [{self.model}]"
+        if self.remote:
+            label += " (remote)"
+        tr.set_thread_name(tid, label)
+        base = {"trace_id": self.trace_id}
+        if self.parent_id:
+            base["parent_id"] = self.parent_id
+        for p in self.phases:
+            tr.complete_between(f"req/{p['name']}", p["t0"], p["t1"],
+                                tid=tid, **base, **p["args"])
+        for e in self.events:
+            tr.complete_between(f"req/{e['name']}", e["t"], e["t"],
+                                tid=tid, **base, **e["args"])
+        tr.complete_between("req/lifetime", self.t_created,
+                            self.t_finished, tid=tid,
+                            status=self.status, **base, **self.meta)
+
+    # ------------------------------------------------------------- export
+    def to_dict(self) -> Dict:
+        return {
+            "trace_id": self.trace_id,
+            "parent_id": self.parent_id,
+            "remote": self.remote,
+            "model": self.model,
+            "status": self.status,
+            "t_created": self.t_created,
+            "t_finished": self.t_finished,
+            "meta": dict(self.meta),
+            "phases": [dict(p) for p in self.phases],
+            "events": [dict(e) for e in self.events],
+        }
+
+
+# =====================================================================
+# sampled-exemplar JSONL sink
+# =====================================================================
+_sink_lock = threading.Lock()
+_sink_path: Optional[str] = None
+_sink_every = 16
+_sink_seen = 0
+
+
+def set_exemplar_sink(path: str, sample_every: int = 16):
+    """Persist every `sample_every`-th finished trace as one JSONL line.
+    `sample_every=1` keeps every trace (smoke tests / debugging)."""
+    global _sink_path, _sink_every, _sink_seen
+    with _sink_lock:
+        _sink_path = path
+        _sink_every = max(1, int(sample_every))
+        _sink_seen = 0
+
+
+def clear_exemplar_sink():
+    global _sink_path
+    with _sink_lock:
+        _sink_path = None
+
+
+def _offer_exemplar(trace: RequestTrace):
+    global _sink_seen
+    with _sink_lock:
+        if _sink_path is None:
+            return
+        _sink_seen += 1
+        if _sink_seen % _sink_every != 0:
+            return
+        path = _sink_path
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(trace.to_dict(), default=str) + "\n")
+    except OSError:
+        pass  # an unwritable sink must never fail a request
+
+
+# re-export for callers that want the raw tracer types alongside
+Tracer = _tracer_mod.Tracer

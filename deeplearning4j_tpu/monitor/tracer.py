@@ -84,6 +84,21 @@ class Tracer:
         self._events: deque = deque(maxlen=max_events)
         self._origin_ns = time.perf_counter_ns()
         self._pid = os.getpid()
+        #: spans lost to ring-buffer overflow — the deque drops the
+        #: OLDEST event silently, so exports must say how much history
+        #: is missing or a truncated trace reads as a complete one
+        self.events_dropped = 0
+        # optional registry counter wired by monitor.enable()
+        self._drop_counter = None
+
+    def _note_drop(self):
+        # lock held by caller; the registry RLock is taken INSIDE the
+        # tracer lock (safe: the registry never calls into the tracer)
+        if len(self._events) == self._events.maxlen:
+            self.events_dropped += 1
+            c = self._drop_counter
+            if c is not None:
+                c.inc()
 
     # ---------------------------------------------------------- recording
     def span(self, name: str, **args) -> Span:
@@ -93,6 +108,7 @@ class Tracer:
 
     def _commit(self, span: Span):
         with self._lock:
+            self._note_drop()
             self._events.append({
                 "name": span.name,
                 "ph": "X",
@@ -111,6 +127,7 @@ class Tracer:
         if not self.enabled:
             return
         with self._lock:
+            self._note_drop()
             self._events.append({
                 "name": name, "ph": "X",
                 "ts": start_s * 1e6, "dur": duration_s * 1e6,
@@ -119,32 +136,51 @@ class Tracer:
             })
 
     def complete_between(self, name: str, t0_perf: float, t1_perf: float,
-                         **args):
+                         tid: Optional[int] = None, **args):
         """Record a span from two `time.perf_counter()` readings (same
         monotonic clock as the tracer origin), e.g. an ETL window the
-        iterator timed itself."""
+        iterator timed itself. `tid` overrides the track id — request
+        traces use one synthetic track per request so Perfetto renders
+        each request's lifecycle as its own lane."""
         if not self.enabled:
             return
         start_ns = int(t0_perf * 1e9) - self._origin_ns
         with self._lock:
+            self._note_drop()
             self._events.append({
                 "name": name, "ph": "X",
                 "ts": start_ns / 1e3,
                 "dur": max(0.0, (t1_perf - t0_perf) * 1e6),
-                "pid": self._pid, "tid": threading.get_ident(),
+                "pid": self._pid,
+                "tid": threading.get_ident() if tid is None else int(tid),
                 "args": args,
             })
 
-    def instant(self, name: str, **args):
+    def instant(self, name: str, tid: Optional[int] = None, **args):
         """Zero-duration marker (Chrome 'i' event)."""
         if not self.enabled:
             return
         with self._lock:
+            self._note_drop()
             self._events.append({
                 "name": name, "ph": "i", "s": "t",
                 "ts": (time.perf_counter_ns() - self._origin_ns) / 1e3,
-                "pid": self._pid, "tid": threading.get_ident(),
+                "pid": self._pid,
+                "tid": threading.get_ident() if tid is None else int(tid),
                 "args": args,
+            })
+
+    def set_thread_name(self, tid: int, name: str):
+        """Label a track (Chrome 'M' thread_name metadata event) — how a
+        synthetic per-request track gets its trace id as the lane name."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._note_drop()
+            self._events.append({
+                "name": "thread_name", "ph": "M",
+                "pid": self._pid, "tid": int(tid),
+                "args": {"name": name},
             })
 
     # ------------------------------------------------------------ queries
@@ -163,6 +199,7 @@ class Tracer:
         with self._lock:
             self._events.clear()
             self._origin_ns = time.perf_counter_ns()
+            self.events_dropped = 0
 
     # ------------------------------------------------------------- export
     def export_chrome_trace(self, path: Optional[str] = None) -> str:
@@ -172,7 +209,8 @@ class Tracer:
         doc = {
             "traceEvents": self.events(),
             "displayTimeUnit": "ms",
-            "otherData": {"exporter": "deeplearning4j_tpu.monitor"},
+            "otherData": {"exporter": "deeplearning4j_tpu.monitor",
+                          "events_dropped": self.events_dropped},
         }
         text = json.dumps(doc)
         if path is not None:

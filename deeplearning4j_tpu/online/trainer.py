@@ -149,6 +149,14 @@ class DriftGate(EvaluativeListener):
                 and self._evals >= self.min_evals_before_gating):
             self.paused = True
             self.trips += 1
+            from deeplearning4j_tpu.monitor.flightrec import (
+                GLOBAL_FLIGHT_RECORDER,
+            )
+            GLOBAL_FLIGHT_RECORDER.record(
+                "drift_trip", tag=self.tag, metric=self.metric,
+                score=float(score), best=float(self.best_score),
+                band=float(self.band),
+                iteration=int(self._last_iteration))
             log.warning(
                 "drift gate TRIPPED at %s: held-out %s %.4f moved more "
                 "than %.3f past best %.4f — publishing paused "

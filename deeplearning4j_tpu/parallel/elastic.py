@@ -334,6 +334,12 @@ class ElasticCoordinator:
                                     if members else None),
         }
         self._dirty_since = None
+        from deeplearning4j_tpu.monitor.flightrec import (
+            GLOBAL_FLIGHT_RECORDER,
+        )
+        GLOBAL_FLIGHT_RECORDER.record(
+            "elastic_reconfiguration", generation=self._generation,
+            members=[m.token for m in members])
         log.info("committed generation %d: %s", self._generation,
                  [m.token for m in members])
         self._record_metrics()
@@ -393,6 +399,7 @@ class ElasticClient:
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._unreachable_since: Optional[float] = None
+        self._federate_worker: Optional[str] = None
 
     # ------------------------------------------------------------------ io
     def _request(self, payload: dict) -> dict:
@@ -437,6 +444,7 @@ class ElasticClient:
 
     def _beat_loop(self):
         while not self._stopped.wait(self.heartbeat_interval_s):
+            self._refresh_federated_metrics()
             with self._lock:
                 payload = {"op": "heartbeat", "token": self.token,
                            "generation": self._generation,
@@ -475,6 +483,29 @@ class ElasticClient:
     def set_info(self, **info):
         with self._lock:
             self._info.update(info)
+
+    def federate_metrics(self, worker: Optional[str] = None):
+        """Piggyback this worker's metrics registry on the heartbeat
+        info channel: every beat refreshes ``info["metrics"]`` with a
+        `monitor.federate.export_snapshot`, so the coordinator's
+        `status()` carries one labeled snapshot per live member and
+        `monitor.federate.ingest_elastic_status` can merge the whole
+        training fleet into a single /metrics view — no extra
+        transport, no extra sockets."""
+        self._federate_worker = worker or self.token
+        self._refresh_federated_metrics()
+
+    def _refresh_federated_metrics(self):
+        if self._federate_worker is None:
+            return
+        from deeplearning4j_tpu import monitor
+        if not monitor.is_enabled():
+            return
+        from deeplearning4j_tpu.monitor.federate import export_snapshot
+        snap = export_snapshot(monitor.registry(),
+                               worker=self._federate_worker)
+        with self._lock:
+            self._info["metrics"] = snap
 
     def generation(self) -> int:
         with self._lock:

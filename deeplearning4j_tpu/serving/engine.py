@@ -253,6 +253,10 @@ class PagedDecodeEngine:
         self.prefix_tokens_saved_total = 0
         self.prefix_forks_total = 0
         self._preempted: List[dict] = []
+        # per-slot attribution for the LAST admit_many wave (host-side
+        # bookkeeping only — what request tracing reads to say whether
+        # an admission rode a shared prefix / forked CoW blocks)
+        self.admit_info: dict = {}
 
     # ------------------------------------------------------------ queries
     @property
@@ -725,6 +729,7 @@ class PagedDecodeEngine:
         [(slot, first_token, done), ...] for the admitted prefix."""
         if not requests:
             return []
+        self.admit_info = {}
         wave = []
         try:
             for r in requests:
@@ -931,6 +936,13 @@ class PagedDecodeEngine:
         self.top_p[slot] = 1.0 if p is None else p
         self.active[slot] = not done
         self.block_grants_total += w["grants"]
+        self.admit_info[slot] = {
+            "grants": int(w["grants"]),
+            "prefix_hit": w["entry"] is not None,
+            "tokens_saved": (int(w["entry"]["len"])
+                             if w["entry"] is not None else 0),
+            "cow_fork": w.get("fork") is not None,
+        }
         if w["entry"] is not None:
             self.prefix_hits_total += 1
             self.prefix_tokens_saved_total += w["entry"]["len"]

@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from deeplearning4j_tpu.monitor.flightrec import GLOBAL_FLIGHT_RECORDER
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 from deeplearning4j_tpu.serving.server import GenerationServer
 
@@ -237,6 +238,11 @@ class FleetServer:
                 if target in pinned_here:
                     reg.unpin(name, target)
                     pinned_here.remove(target)
+            # label the server's serving_* metric families: two fleet
+            # deployments share one process registry and must not
+            # collide on unlabeled series (callers may still override)
+            server_kw = dict(server_kw)
+            server_kw.setdefault("name", name)
             server = GenerationServer(net, **server_kw)
             # shared prefixes registered for this NAME re-apply to the
             # successor BEFORE warmup (prefill under the new weights;
@@ -294,6 +300,7 @@ class FleetServer:
                 self._deploying.discard(name)
         self._ensure_gauge_thread()
         self.publish_gauges()
+        GLOBAL_FLIGHT_RECORDER.record("deploy", model=name, version=v)
         log.info("deployed %s v%d", name, v)
         return v
 
@@ -370,6 +377,10 @@ class FleetServer:
                 with self._lock:
                     self._retired.append((name, old_version,
                                           old_server))
+                GLOBAL_FLIGHT_RECORDER.record(
+                    "drain_timeout", model=name, version=old_version,
+                    timeout_s=drain_timeout,
+                    open_streams=old_server.open_streams)
                 raise RuntimeError(
                     f"{name!r} incumbent (v{old_version}) did not drain "
                     f"within {drain_timeout}s — it is left running "
@@ -381,6 +392,8 @@ class FleetServer:
         m = self._metrics()
         if m is not None:
             m["swaps"](name).inc()
+        GLOBAL_FLIGHT_RECORDER.record(
+            "swap", model=name, from_version=old_version, to_version=v)
         self.publish_gauges()
         log.info("swapped %s v%d -> v%d (drained clean)", name,
                  old_version, v)
@@ -418,6 +431,9 @@ class FleetServer:
                       drain_timeout=drain_timeout, **overrides)
             after = {"n_slots": self.server(name).engine.n_slots,
                      "n_blocks": self.server(name).engine.pool.n_blocks}
+        GLOBAL_FLIGHT_RECORDER.record(
+            "scale", model=name, version=version, before=before,
+            after=after)
         return {"name": name, "version": version, "before": before,
                 "after": after}
 
@@ -442,6 +458,9 @@ class FleetServer:
                 # server still needs
                 if (name, version) not in live:
                     self.registry.unpin(name, version)
+                GLOBAL_FLIGHT_RECORDER.record(
+                    "reap_retired", model=name, version=version,
+                    forced=bool(force))
                 reaped += 1
             else:
                 kept.append((name, version, server))
@@ -479,6 +498,9 @@ class FleetServer:
                 self._models.pop(name, None)
             d.server.stop()
             self.registry.unpin(name, d.version)
+        GLOBAL_FLIGHT_RECORDER.record(
+            "undeploy", model=name, version=d.version,
+            drained=bool(drain))
         self.publish_gauges()
 
     def stop(self, *, drain: bool = False,
@@ -628,6 +650,9 @@ class FleetAutoscaler:
             rec["signal"] = sig
             self._last_scaled[name] = time.monotonic()
             self.decisions.append(rec)
+            GLOBAL_FLIGHT_RECORDER.record(
+                "autoscale", model=name, before=rec["before"],
+                after=rec["after"], reason=rec["reason"])
             made.append(rec)
             log.info("autoscaled %s: %s -> %s (%s)", name,
                      rec["before"], rec["after"], rec["reason"])

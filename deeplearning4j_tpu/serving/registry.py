@@ -51,6 +51,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple, Union
 
 from deeplearning4j_tpu.fault.errors import CheckpointCorruptError
+from deeplearning4j_tpu.monitor.flightrec import GLOBAL_FLIGHT_RECORDER
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.util.serializer import ModelSerializer
 
@@ -199,6 +200,8 @@ class ModelRegistry:
             m["published"](name).inc()
         self._gc(name, m)
         self._publish_gauges(name, m)
+        GLOBAL_FLIGHT_RECORDER.record("publish", model=name,
+                                      version=committed)
         log.info("published %s v%d -> %s", name, committed,
                  self.path(name, committed))
         return committed

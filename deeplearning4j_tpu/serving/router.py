@@ -39,6 +39,9 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor.flightrec import GLOBAL_FLIGHT_RECORDER
+from deeplearning4j_tpu.monitor.reqtrace import RequestTrace
 from deeplearning4j_tpu.serving import wire
 from deeplearning4j_tpu.serving.server import (
     ServerDrainingError,
@@ -76,6 +79,9 @@ class FleetRouter:
         self._relay: Optional[threading.Thread] = None
         self._active: Dict[str, dict] = {}
         self._active_lock = threading.Lock()
+        # shed-burst flight-recorder rate limit (≤1 event/s)
+        self._shed_recent = 0
+        self._shed_last_emit = 0.0
 
     # ------------------------------------------------------------ metrics
     def _metrics(self):
@@ -151,38 +157,66 @@ class FleetRouter:
     # ------------------------------------------------------------ submit
     def submit(self, name: str, prompt_ids, n_tokens: int, *,
                temperature: float = 0.0, top_p: Optional[float] = None,
-               rng=None) -> TokenStream:
+               rng=None,
+               trace: Optional[RequestTrace] = None) -> TokenStream:
         """Route one generation request to `name`'s active server;
         returns its TokenStream tagged with ``.model``/``.version``.
         A submit racing a hot-swap's pointer flip sees the incumbent's
         `ServerDrainingError` and retries against the successor — the
-        zero-dropped-streams contract covers the flip window."""
+        zero-dropped-streams contract covers the flip window.
+
+        `trace` is upstream trace context (a pump-rehydrated remote
+        trace); without one, the router mints the request's trace here
+        — the earliest point that sees the routing decision, so a shed
+        is annotated into the trace it rejected."""
         m = self._metrics()
+        if trace is None and monitor.is_enabled():
+            trace = RequestTrace(model=name)
         for _ in range(64):
             server, version = self._resolve(name)
             reason = self._should_shed(name, server)
             if reason is not None:
                 if m is not None:
                     m["shed"](name).inc()
+                if trace is not None:
+                    # the router's shed decision, auditable per request
+                    trace.event("shed", reason=reason, router=True)
+                    trace.finish(status="shed")
+                self._note_shed_burst(name, reason)
                 raise ShedError(reason)
             try:
                 stream = server.generate_async(
                     prompt_ids, n_tokens, temperature=temperature,
-                    top_p=top_p, rng=rng)
+                    top_p=top_p, rng=rng, trace=trace)
             except ServerDrainingError:
                 # swap in progress: the pointer flip happens before the
                 # incumbent drains, so the next resolve sees the warmed
                 # successor
+                if trace is not None:
+                    trace.event("drain_retry", model=name,
+                                version=version)
                 time.sleep(0.002)
                 continue
             stream.model = name
             stream.version = version
+            if trace is not None:
+                trace.annotate(version=version)
             if m is not None:
                 m["streams"](name).inc()
             return stream
         raise RuntimeError(
             f"model {name!r} stayed in draining state across retries — "
             f"is a swap stuck without a successor?")
+
+    def _note_shed_burst(self, name: str, reason: str):
+        self._shed_recent += 1
+        now = time.monotonic()
+        if now - self._shed_last_emit >= 1.0:
+            GLOBAL_FLIGHT_RECORDER.record(
+                "shed_burst", source="router", model=name,
+                count=self._shed_recent, reason=reason)
+            self._shed_recent = 0
+            self._shed_last_emit = now
 
     # ------------------------------------------------------- output plane
     def attach_output(self, name: str, model):
@@ -264,10 +298,19 @@ class FleetRouter:
             try:
                 header, prompt = wire.decode_request(data)
                 rid = header["request_id"]
+                # rehydrate wire trace context: server-side spans land
+                # under the CLIENT-minted trace id (one stitched
+                # timeline per request across the wire)
+                trace = None
+                if header.get("trace_id") and monitor.is_enabled():
+                    trace = RequestTrace(trace_id=header["trace_id"],
+                                         remote=True,
+                                         model=header["model"])
                 stream = self.submit(
                     header["model"], prompt, header["n_tokens"],
                     temperature=header.get("temperature") or 0.0,
-                    top_p=header.get("top_p"), rng=header.get("rng"))
+                    top_p=header.get("top_p"), rng=header.get("rng"),
+                    trace=trace)
             except Exception as e:  # noqa: BLE001 — fail THAT request only
                 if rid is not None:
                     try:
@@ -369,13 +412,19 @@ class RemoteTokenStream:
     as they arrive on the reply topic, or `result()` for the full
     array. Mirrors `TokenStream`'s two faces over the transport."""
 
-    def __init__(self, transport, topic: str, *, timeout: float = 600.0):
+    def __init__(self, transport, topic: str, *, timeout: float = 600.0,
+                 trace: Optional[RequestTrace] = None):
         self.transport = transport
         self.topic = topic
         self.timeout = float(timeout)
         self.tokens = []
         self.model = None
         self.version = None
+        # client half of the stitched timeline: same trace id as the
+        # server-side spans (the wire's trace_id header field)
+        self.trace = trace
+        self.trace_id = None if trace is None else trace.trace_id
+        self._got_first = False
         self._done = False
         self._error: Optional[BaseException] = None
         self._last_seq = -1
@@ -401,11 +450,23 @@ class RemoteTokenStream:
         if seq > self._last_seq:
             self._last_seq = seq
             self.tokens.extend(int(t) for t in chunk)
+            if len(chunk) and not self._got_first:
+                self._got_first = True
+                if self.trace is not None:
+                    self.trace.event("first_chunk")
         else:
             chunk = chunk[:0]
         if header["done"]:
             self._done = True
             self._error = wire.reply_error(header)
+            tr = self.trace
+            if tr is not None:
+                tr.phase("remote_stream", tr.t_created,
+                         time.perf_counter(), tokens=len(self.tokens))
+                err = self._error
+                tr.finish(status=("shed" if isinstance(err, ShedError)
+                                  else "error" if err is not None
+                                  else "ok"))
             # one reply topic per request: release its transport
             # resources (queue / Kafka consumer) the moment the
             # terminal frame lands, or a long-lived client leaks one
@@ -454,13 +515,25 @@ class FleetClient:
     def generate(self, model: str, prompt_ids, n_tokens: int, *,
                  temperature: float = 0.0, top_p: Optional[float] = None,
                  rng=None, request_id: Optional[str] = None,
-                 timeout: float = 600.0) -> RemoteTokenStream:
+                 timeout: float = 600.0,
+                 trace_id: Optional[str] = None) -> RemoteTokenStream:
         rid = request_id or uuid.uuid4().hex
+        # mint trace context client-side: the id crosses the wire and
+        # the router/server spans stitch under it; the client keeps its
+        # own wire-level trace on the same id
+        trace = None
+        if monitor.is_enabled():
+            trace = RequestTrace(trace_id=trace_id, model=model,
+                                 remote=False)
+            trace.event("wire_submit", request_id=rid)
+            trace_id = trace.trace_id
         self.transport.send(
             f"{self.prefix}.requests",
             wire.encode_request(model, rid, prompt_ids, n_tokens,
                                 temperature=temperature, top_p=top_p,
-                                rng=rng))
-        return RemoteTokenStream(self.transport,
-                                 f"{self.prefix}.replies.{rid}",
-                                 timeout=timeout)
+                                rng=rng, trace_id=trace_id))
+        stream = RemoteTokenStream(self.transport,
+                                   f"{self.prefix}.replies.{rid}",
+                                   timeout=timeout, trace=trace)
+        stream.trace_id = trace_id
+        return stream

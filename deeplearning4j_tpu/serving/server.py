@@ -32,6 +32,10 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor.flightrec import GLOBAL_FLIGHT_RECORDER
+from deeplearning4j_tpu.monitor.reqtrace import RequestTrace
+from deeplearning4j_tpu.monitor.slo import SLOObjective, SLOTracker
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.serving.engine import PagedDecodeEngine
 from deeplearning4j_tpu.serving.paged import blocks_needed
@@ -71,6 +75,10 @@ class TokenStream:
         self.n_tokens = n_tokens
         self.tokens: List[int] = []
         self.cancelled = False
+        # per-request lifecycle trace (None when monitoring is off and
+        # no upstream trace context arrived): the scheduler stamps
+        # phases onto it; finish/fail seal it
+        self.trace: Optional[RequestTrace] = None
         self.t_submit = time.monotonic()
         self.t_first: Optional[float] = None
         self.t_last: Optional[float] = None
@@ -136,12 +144,22 @@ class TokenStream:
         if not self._fut.done():
             self._fut.set_result(np.asarray(self.tokens, np.int32))
         self._q.put(_DONE)
+        if self.trace is not None:
+            # idempotent: the scheduler's richer finish (ttft/slo args)
+            # already sealed it on the normal path
+            self.trace.finish(
+                status="cancelled" if self.cancelled else "ok",
+                tokens=len(self.tokens))
         self._close()
 
     def _fail(self, exc: BaseException):
         if not self._fut.done():
             self._fut.set_exception(exc)
         self._q.put(_DONE)
+        if self.trace is not None:
+            self.trace.finish(
+                status="shed" if isinstance(exc, ShedError) else "error",
+                error=type(exc).__name__)
         self._close()
 
 
@@ -201,8 +219,22 @@ class GenerationServer(ParallelInference):
                  allocation: str = "incremental",
                  speculative: Optional[int] = None,
                  spec_accept_floor: float = 0.3,
-                 spec_probe_every: int = 50):
+                 spec_probe_every: int = 50,
+                 name: Optional[str] = None,
+                 slo: Optional[SLOObjective] = None):
         super().__init__(net)
+        # optional server label: `serving_*` families carry
+        # `server=<name>` so two servers in one process (a fleet) don't
+        # collide; the single-server path stays unlabeled (PR-12 note)
+        self.name = name
+        # optional SLO objective: good/bad counters + burn-rate gauge
+        # evaluated per finished request (shed counts as bad)
+        self._slo_tracker = (SLOTracker(slo, model=name or "default")
+                            if slo is not None else None)
+        self._slo_cache = None
+        # shed-burst flight-recorder rate limit (≤1 event/s)
+        self._shed_recent = 0
+        self._shed_last_emit = 0.0
         self.engine = PagedDecodeEngine(
             net, n_slots=n_slots, n_blocks=n_blocks, block_len=block_len,
             top_k=top_k, steps_per_dispatch=steps_per_dispatch,
@@ -519,10 +551,17 @@ class GenerationServer(ParallelInference):
     def generate_async(self, prompt_ids, n_tokens: int, *,
                        temperature: float = 0.0,
                        top_p: Optional[float] = None,
-                       rng=None) -> TokenStream:
+                       rng=None,
+                       trace: Optional[RequestTrace] = None) -> TokenStream:
         """Enqueue one generation request; returns its token stream.
         Eager validation (the `generate()` pattern): impossible
-        requests fail HERE, not as a scheduler-thread error."""
+        requests fail HERE, not as a scheduler-thread error.
+
+        `trace` carries upstream trace context (a router-side
+        RequestTrace or one rehydrated from the wire); with monitoring
+        enabled and no upstream context, a fresh trace is minted here —
+        trace-off serving emits the same tokens bit-for-bit (tracing is
+        host-side timestamps only, it never touches rng or devices)."""
         if getattr(self, "_shutdown", False):
             raise RuntimeError("GenerationServer is shut down")
         if self._draining:
@@ -555,6 +594,14 @@ class GenerationServer(ParallelInference):
         fut = Future()
         stream = TokenStream(fut, int(prompt.shape[0]), int(n_tokens),
                              on_close=self._stream_closed)
+        if trace is None and monitor.is_enabled():
+            trace = RequestTrace(model=self.name)
+        if trace is not None:
+            trace.annotate(prompt_len=int(prompt.shape[0]),
+                           n_tokens=int(n_tokens))
+            if trace.model is None:
+                trace.model = self.name
+        stream.trace = trace
         with self._open_lock:
             # re-check the drain flag ATOMICALLY with the open-stream
             # increment: drain() sets the flag and reads the count
@@ -583,61 +630,86 @@ class GenerationServer(ParallelInference):
         return self._resolve_metrics("_metrics_cache",
                                      self._build_serving_metrics)
 
-    @staticmethod
-    def _build_serving_metrics(reg):
+    def _build_serving_metrics(self, reg):
+        # optional `server=` label (satellite of PR 16): two servers in
+        # one process (the fleet path) get distinct children; a
+        # name-less server keeps the original unlabeled series
+        lbl = {"server": self.name} if self.name else {}
         return {
             "queue": reg.gauge("serving_queue_depth",
-                               "generation requests awaiting admission"),
+                               "generation requests awaiting admission",
+                               **lbl),
             "slots": reg.gauge("serving_active_slots",
-                               "serving slots decoding right now"),
+                               "serving slots decoding right now", **lbl),
             "blocks": reg.gauge("serving_free_blocks",
-                                "free KV-pool blocks"),
+                                "free KV-pool blocks", **lbl),
             "requests": reg.counter("serving_requests_total",
-                                    "generation requests admitted"),
+                                    "generation requests admitted",
+                                    **lbl),
             "tokens": reg.counter("serving_tokens_total",
-                                  "tokens emitted by the decode loop"),
+                                  "tokens emitted by the decode loop",
+                                  **lbl),
             "shed": reg.counter("serving_shed_total",
                                 "requests fast-failed by the SLO "
-                                "admission policy"),
+                                "admission policy", **lbl),
             "evicted": reg.counter("serving_evicted_total",
-                                   "sequences evicted mid-stream"),
+                                   "sequences evicted mid-stream", **lbl),
             "pool_free": reg.gauge("serving_pool_blocks_free",
                                    "free KV-pool blocks (allocator "
-                                   "view)"),
+                                   "view)", **lbl),
             "pool_used": reg.gauge("serving_pool_blocks_used",
-                                   "granted KV-pool blocks"),
+                                   "granted KV-pool blocks", **lbl),
             "grants": reg.counter("serving_block_grants_total",
                                   "pool blocks granted (admission + "
-                                  "lazy decode growth)"),
+                                  "lazy decode growth)", **lbl),
             "requeue": reg.counter("serving_evict_requeue_total",
                                    "pool-pressure preemptions requeued "
-                                   "as continuations"),
+                                   "as continuations", **lbl),
             "spec_accept": reg.gauge(
                 "serving_spec_accept_rate",
                 "EWMA of the draft-token acceptance rate (speculative "
-                "decoding; drives the auto-disable policy)"),
+                "decoding; drives the auto-disable policy)", **lbl),
             "spec_tpd": reg.gauge(
                 "serving_spec_tokens_per_dispatch",
-                "EWMA of tokens emitted per speculative dispatch"),
+                "EWMA of tokens emitted per speculative dispatch",
+                **lbl),
             "prefix_shared": reg.gauge(
                 "serving_prefix_blocks_shared",
                 "pool blocks currently mapped by more than one holder "
-                "(shared-prefix CoW)"),
+                "(shared-prefix CoW)", **lbl),
             "prefix_hits": reg.counter(
                 "serving_prefix_hits_total",
                 "admissions that mapped a registered shared prefix "
-                "instead of prefilling it"),
+                "instead of prefilling it", **lbl),
             "prefix_saved": reg.counter(
                 "serving_prefix_tokens_saved_total",
                 "prompt tokens NOT prefilled thanks to shared-prefix "
-                "block reuse"),
+                "block reuse", **lbl),
             "ttft": reg.timer("serving_ttft_seconds",
-                              "submit-to-first-token latency"),
+                              "submit-to-first-token latency", **lbl),
             "tpot": reg.timer("serving_tpot_seconds",
                               "mean per-token decode latency per "
-                              "finished request"),
+                              "finished request", **lbl),
             "step": reg.timer("serving_step_seconds",
-                              "one continuous-batching decode dispatch"),
+                              "one continuous-batching decode dispatch",
+                              **lbl),
+        }
+
+    def _slo_metrics(self):
+        return self._resolve_metrics("_slo_cache", self._build_slo_metrics)
+
+    def _build_slo_metrics(self, reg):
+        lbl = {"model": self.name or "default"}
+        return {
+            "good": reg.counter("slo_requests_good_total",
+                                "finished requests meeting the SLO",
+                                **lbl),
+            "bad": reg.counter("slo_requests_bad_total",
+                               "requests missing the SLO (sheds "
+                               "included)", **lbl),
+            "burn": reg.gauge("slo_burn_rate",
+                              "rolling-window error-budget burn rate "
+                              "(1.0 = sustainable)", **lbl),
         }
 
     # ----------------------------------------------------------- shedding
@@ -761,6 +833,7 @@ class GenerationServer(ParallelInference):
             if reason is not None:
                 if m is not None:
                     m["shed"].inc()
+                self._note_shed(req, reason)
                 req.stream._fail(ShedError(reason))
                 continue
             self._pending.append(item)
@@ -799,6 +872,7 @@ class GenerationServer(ParallelInference):
                     self._pending.pop(0)
                     if m is not None:
                         m["shed"].inc()
+                    self._note_shed(head[0], str(e))
                     head[0].stream._fail(ShedError(str(e)))
                     progressed = True
                     continue
@@ -816,6 +890,7 @@ class GenerationServer(ParallelInference):
                 if len(wave) >= eng.free_slots:
                     break   # admission can never exceed free slots —
                     # don't build request dicts for a deep backlog
+            t0p = time.perf_counter()
             admitted = eng.admit_many([
                 dict(prompt_ids=it[0].effective_prompt(),
                      n_tokens=it[0].n_left, request_id=id(it[0]),
@@ -825,12 +900,25 @@ class GenerationServer(ParallelInference):
                 for it in wave])
             if not admitted:
                 break
+            t1p = time.perf_counter()
             now = time.monotonic()
             for (slot, first, done), (req, fut, t_submit) in zip(
                     admitted, wave):
                 self._pending.pop(0)
                 fresh = req.stream.t_first is None
                 req.stream._emit(first, now)
+                tr = req.stream.trace
+                if tr is not None:
+                    # host-side stamps only — the wave's device work is
+                    # already timed by t0p/t1p, no extra syncs
+                    info = eng.admit_info.get(slot) or {}
+                    if fresh:
+                        tr.phase("queued", tr.t_created, t0p)
+                    tr.phase("prefill", t0p, t1p,
+                             wave_width=len(admitted), slot=slot,
+                             continuation=not fresh, **info)
+                    if info.get("cow_fork"):
+                        tr.event("cow_fork", slot=slot)
                 if m is not None:
                     m["tokens"].inc()
                     if fresh:
@@ -849,6 +937,10 @@ class GenerationServer(ParallelInference):
             t0 = time.perf_counter()
             emitted, finished = eng.step(speculate=self._spec_policy())
             dt = time.perf_counter() - t0
+            # dispatch-level speculative deltas for trace attribution —
+            # read BEFORE _spec_update advances the *_seen cursors
+            d_spec_prop = eng.spec_proposed_total - self._spec_proposed_seen
+            d_spec_acc = eng.spec_accepted_total - self._spec_accepted_seen
             self._spec_update(m)
             now = time.monotonic()
             # pool-pressure preemptions (incremental allocation):
@@ -863,6 +955,10 @@ class GenerationServer(ParallelInference):
                     entry = self._slot2req.pop(note["slot"], None)
                     if entry is not None:
                         requeued.append(entry)
+                        tr = entry[0].stream.trace
+                        if tr is not None:
+                            tr.event("preempt_requeue",
+                                     emitted=int(note.get("emitted", 0)))
                 self._pending[:0] = requeued
                 progressed = True
             n_tok = sum(len(ts) for ts in emitted.values())
@@ -874,8 +970,17 @@ class GenerationServer(ParallelInference):
                 self._ewma_tok_s = (rate if self._ewma_tok_s is None
                                     else 0.8 * self._ewma_tok_s
                                     + 0.2 * rate)
+            t1 = t0 + dt
             for slot, toks in emitted.items():
-                self._slot2req[slot][0].stream._emit_many(toks, now)
+                stream = self._slot2req[slot][0].stream
+                stream._emit_many(toks, now)
+                tr = stream.trace
+                if tr is not None:
+                    args = {"tokens": len(toks)}
+                    if d_spec_prop:
+                        args["spec_proposed"] = d_spec_prop
+                        args["spec_accepted"] = d_spec_acc
+                    tr.phase("decode", t0, t1, **args)
             for slot in finished:
                 req, fut, _ = self._slot2req.pop(slot)
                 self._finish(req, m)
@@ -963,13 +1068,55 @@ class GenerationServer(ParallelInference):
             if self._spec_tpd_ewma is not None:
                 m["spec_tpd"].set(self._spec_tpd_ewma)
 
+    def _note_shed(self, req, reason: str):
+        """Shed bookkeeping beyond the counter: trace annotation (the
+        router's/scheduler's decision becomes auditable per request),
+        SLO budget spend, and a rate-limited flight-recorder event."""
+        tr = req.stream.trace
+        if tr is not None:
+            tr.event("shed", reason=reason)
+        slo = self._slo_tracker
+        if slo is not None:
+            slo.record_shed()
+            sm = self._slo_metrics()
+            if sm is not None:
+                sm["bad"].inc()
+                sm["burn"].set(slo.burn_rate())
+        # shed BURSTS are a control-plane signal; single events at
+        # request rate would flood the ring, so coalesce to ≤1/s
+        self._shed_recent += 1
+        now = time.monotonic()
+        if now - self._shed_last_emit >= 1.0:
+            GLOBAL_FLIGHT_RECORDER.record(
+                "shed_burst", server=self.name,
+                count=self._shed_recent, reason=reason)
+            self._shed_recent = 0
+            self._shed_last_emit = now
+
     def _finish(self, req, m):
-        req.stream._finish()
-        if m is not None and req.stream.t_first is not None:
-            n = len(req.stream.tokens)
-            if n > 1:
-                m["tpot"].observe(
-                    (req.stream.t_last - req.stream.t_first) / (n - 1))
+        st = req.stream
+        n = len(st.tokens)
+        ttft = (st.t_first - st.t_submit) if st.t_first is not None \
+            else None
+        tpot = ((st.t_last - st.t_first) / (n - 1)
+                if st.t_first is not None and n > 1 else None)
+        tr = st.trace
+        if tr is not None:
+            if self._draining:
+                tr.event("drain_at_swap")
+            tr.annotate(ttft_s=ttft, tpot_s=tpot)
+        slo = self._slo_tracker
+        if slo is not None:
+            good = slo.record(ttft=ttft, tpot=tpot)
+            sm = self._slo_metrics()
+            if sm is not None:
+                sm["good" if good else "bad"].inc()
+                sm["burn"].set(slo.burn_rate())
+            if tr is not None:
+                tr.annotate(slo_good=good)
+        st._finish()
+        if m is not None and st.t_first is not None and n > 1:
+            m["tpot"].observe((st.t_last - st.t_first) / (n - 1))
 
     # ---------------------------------------------------------- lifecycle
     def start(self):

@@ -62,7 +62,12 @@ def _unframe(magic: bytes, data: bytes) -> Tuple[dict, Optional[np.ndarray]]:
 # ------------------------------------------------------------- requests
 def encode_request(model: str, request_id: str, prompt_ids, n_tokens: int,
                    *, temperature: float = 0.0,
-                   top_p: Optional[float] = None, rng=None) -> bytes:
+                   top_p: Optional[float] = None, rng=None,
+                   trace_id: Optional[str] = None) -> bytes:
+    """`trace_id` is the distributed-tracing context field: a client-
+    minted id the router rehydrates into a `RequestTrace`, so the
+    remote request's server-side spans land on the SAME timeline as the
+    client's (one stitched trace per request across the wire)."""
     header = {
         "model": str(model),
         "request_id": str(request_id),
@@ -72,6 +77,8 @@ def encode_request(model: str, request_id: str, prompt_ids, n_tokens: int,
         "rng": None if rng is None else
                [int(x) for x in np.asarray(rng, np.uint32).reshape(2)],
     }
+    if trace_id is not None:
+        header["trace_id"] = str(trace_id)
     return _frame(REQUEST_MAGIC, header,
                   np.asarray(prompt_ids, np.int64))
 

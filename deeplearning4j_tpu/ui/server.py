@@ -110,6 +110,16 @@ class UIServer:
                     # queue depth, slots, pool blocks, TTFT/TPOT, sheds
                     # — docs/SERVING.md + OBSERVABILITY.md "Serving")
                     self._send(200, outer._serving_html())
+                elif path == "/events":
+                    # control-plane flight recorder (monitor/flightrec.py):
+                    # publishes, swaps, drains, autoscales, drift trips —
+                    # ?kind= filters, ?last= bounds, ?format=json for
+                    # machine consumers
+                    if q.get("format", [""])[0] == "json":
+                        self._send(200, outer._events_json(q),
+                                   "application/json")
+                    else:
+                        self._send(200, outer._events_html(q))
                 elif path == "/profile":
                     # AOT cost tables + roofline (benchtools/hlo_cost.py
                     # publishes; committed PROFILE_*/cost_*.json fill in)
@@ -208,7 +218,7 @@ class UIServer:
         pages = [("overview", "/train/overview"), ("model", "/train/model"),
                  ("system", "/train/system"), ("tsne", "/tsne"),
                  ("activations", "/activations"), ("profile", "/profile"),
-                 ("serving", "/serving")]
+                 ("serving", "/serving"), ("events", "/events")]
         links = "".join(
             f'<a href="{url}{qs}" style="margin-right:16px;'
             f'{"font-weight:bold" if p == active else ""}">'
@@ -553,6 +563,53 @@ class UIServer:
         body.append("</table>")
         return self._page("serving", "".join(body))
 
+    def _events_query(self, q):
+        kind = q.get("kind", [None])[0] or None
+        try:
+            last = int(q.get("last", ["200"])[0])
+        except ValueError:
+            last = 200
+        from deeplearning4j_tpu.monitor.flightrec import flight_recorder
+        rec = flight_recorder()
+        return rec, rec.events(kind=kind, last=max(1, last))
+
+    def _events_json(self, q):
+        rec, evs = self._events_query(q)
+        return json.dumps({"dropped": rec.dropped, "events": evs},
+                          default=str)
+
+    def _events_html(self, q):
+        """Flight-recorder view (monitor/flightrec.py): the ordered
+        control-plane event log — publish/swap/drain/autoscale/
+        drift-trip/elastic/watchdog/shed-burst — newest last, the first
+        thing an incident review reads (docs/OBSERVABILITY.md "Flight
+        recorder")."""
+        import time as _time
+        rec, evs = self._events_query(q)
+        body = [self._nav("events")]
+        if rec.dropped:
+            body.append(f"<p>{rec.dropped} older events evicted from "
+                        f"the ring</p>")
+        if not evs:
+            body.append(f"<p>{self._tr('no_events')}</p>")
+        else:
+            body.append("<table border='1' cellpadding='4'>"
+                        "<tr><th>seq</th><th>time</th><th>kind</th>"
+                        "<th>details</th></tr>")
+            for e in evs:
+                detail = {k: v for k, v in e.items()
+                          if k not in ("ts", "seq", "kind")}
+                when = _time.strftime("%H:%M:%S",
+                                      _time.localtime(e["ts"]))
+                body.append(
+                    f"<tr><td>{int(e['seq'])}</td>"
+                    f"<td>{when}</td>"
+                    f"<td>{_html.escape(str(e['kind']))}</td>"
+                    f"<td><code>{_html.escape(json.dumps(detail, default=str))}"
+                    f"</code></td></tr>")
+            body.append("</table>")
+        return self._page(self._tr("title.events"), "".join(body))
+
     def _tsne_html(self):
         body = [self._nav("tsne")]
         with self._module_lock:
@@ -665,8 +722,10 @@ class UIServer:
         return self
 
     def attach_registry(self, registry):
-        """Serve `/metrics` from this MetricsRegistry instead of the
-        process-global one."""
+        """Serve `/metrics` from this MetricsRegistry — or a federation
+        `MetricsAggregator` (monitor/federate.py), turning this UI into
+        the fleet-wide scrape endpoint — instead of the process-global
+        registry."""
         self._registry = registry
         return self
 
@@ -676,8 +735,10 @@ class UIServer:
             else monitor.registry()
         # refresh lazy device gauges right before the scrape, into the
         # registry actually being served (no-op on backends without
-        # memory_stats, and when monitoring is off)
-        if monitor.is_enabled():
+        # memory_stats, when monitoring is off, and when the source is
+        # a federation MetricsAggregator — a merged read-only view with
+        # no gauge() to refresh into)
+        if monitor.is_enabled() and hasattr(reg, "gauge"):
             mc = monitor.memory_collector()
             if mc is None or mc.registry is not reg:
                 mc = monitor.DeviceMemoryCollector(reg)

@@ -1,0 +1,423 @@
+"""Observability plane: per-request traces, fleet telemetry federation,
+SLO burn-rate, and the control-plane flight recorder (monitor/reqtrace,
+monitor/federate, monitor/slo, monitor/flightrec) — plus the tracer's
+ring-overflow accounting and the exposition escaping round trip.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import (
+    FlightRecorder,
+    MetricsAggregator,
+    FederationCollector,
+    FederationPublisher,
+    MetricsRegistry,
+    RequestTrace,
+    SLOObjective,
+    SLOTracker,
+    Tracer,
+    export_snapshot,
+    mint_trace_id,
+)
+from deeplearning4j_tpu.monitor.federate import ingest_elastic_status
+from deeplearning4j_tpu.monitor.registry import (
+    _escape_label_value,
+    _unescape_label_value,
+)
+from deeplearning4j_tpu.monitor.reqtrace import _tid_for
+from deeplearning4j_tpu.streaming.ndarray import LocalQueueTransport
+
+
+@pytest.fixture
+def mon():
+    """Fresh registry+tracer swapped in globally; full restore after."""
+    reg, tr = MetricsRegistry(), Tracer()
+    monitor.enable(registry=reg, tracer=tr)
+    yield reg, tr
+    monitor.disable()
+    monitor._STATE.registry = monitor.GLOBAL_REGISTRY
+    monitor._STATE.tracer = monitor.GLOBAL_TRACER
+
+
+# the exposition grammar we promise scrapers (Prometheus text 0.0.4)
+_EXPO_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(\\.|[^\"\\])*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(\\.|[^\"\\])*\")*\})?"
+    r" (\+Inf|-Inf|NaN|[-+0-9.e]+)$")
+
+
+def _assert_exposition_parses(text):
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _EXPO_LINE.match(line), f"bad exposition line: {line!r}"
+
+
+# =====================================================================
+# tracer ring-overflow accounting (tracer_events_dropped_total)
+# =====================================================================
+class TestTracerDropAccounting:
+    def test_ring_overflow_counts_drops(self, tmp_path):
+        tr = Tracer(max_events=4)
+        tr.enabled = True
+        for i in range(6):
+            tr.complete_between(f"s{i}", 0.0, 1.0)
+        assert tr.events_dropped == 2
+        out = tmp_path / "t.json"
+        tr.export_chrome_trace(str(out))
+        doc = json.loads(out.read_text())
+        assert doc["otherData"]["events_dropped"] == 2
+        tr.clear()
+        assert tr.events_dropped == 0
+
+    def test_drop_counter_metric_wired_by_enable(self, mon):
+        reg, tr = mon
+        # shrink the ring in place and overflow it
+        from collections import deque
+        tr._events = deque(maxlen=2)
+        for i in range(5):
+            tr.instant(f"i{i}")
+        fam = reg.snapshot().get("tracer_events_dropped_total")
+        assert fam is not None
+        assert fam["values"][0]["value"] == 3
+
+
+# =====================================================================
+# exposition escaping round trip (label values per Prometheus 0.0.4)
+# =====================================================================
+class TestExpositionEscaping:
+    @pytest.mark.parametrize("raw", [
+        'plain', 'quo"te', 'back\\slash', 'new\nline',
+        'all\\of"them\ntogether', '\\n literal', ''])
+    def test_label_value_round_trip(self, raw):
+        assert _unescape_label_value(_escape_label_value(raw)) == raw
+
+    def test_escaped_values_scrape_clean(self):
+        reg = MetricsRegistry()
+        reg.counter("odd_total", model='a"b\\c\nd').inc()
+        text = reg.exposition()
+        _assert_exposition_parses(text)
+        line = next(l for l in text.splitlines()
+                    if l.startswith("odd_total"))
+        val = line[line.index('model="') + len('model="'):line.rindex('"')]
+        assert _unescape_label_value(val) == 'a"b\\c\nd'
+
+    def test_help_newlines_escaped(self):
+        reg = MetricsRegistry()
+        reg.counter("h_total", help="line one\nline two").inc()
+        text = reg.exposition()
+        assert "# HELP h_total line one\\nline two" in text
+        _assert_exposition_parses(text)
+
+
+# =====================================================================
+# flight recorder
+# =====================================================================
+class TestFlightRecorder:
+    def test_ring_bounds_and_drop_count(self):
+        rec = FlightRecorder(capacity=3)
+        for i in range(5):
+            rec.record("tick", i=i)
+        assert len(rec) == 3
+        assert rec.dropped == 2
+        seqs = [e["seq"] for e in rec.events()]
+        assert seqs == [3, 4, 5]
+
+    def test_kind_filter_and_last(self):
+        rec = FlightRecorder()
+        rec.record("swap", model="m")
+        rec.record("publish", model="m")
+        rec.record("swap", model="n")
+        assert [e["model"] for e in rec.events(kind="swap")] == ["m", "n"]
+        assert len(rec.events(last=1)) == 1
+
+    def test_durable_jsonl_and_dump(self, tmp_path):
+        live = tmp_path / "live.jsonl"
+        rec = FlightRecorder(capacity=8, path=str(live))
+        rec.record("deploy", model="m", version=1)
+        rec.record("swap", model="m", from_version=1, to_version=2)
+        lines = [json.loads(l) for l in live.read_text().splitlines()]
+        assert [e["kind"] for e in lines] == ["deploy", "swap"]
+        out = tmp_path / "dump.jsonl"
+        text = rec.dump(str(out))
+        assert text == out.read_text()
+        assert json.loads(text.splitlines()[-1])["to_version"] == 2
+
+    def test_never_raises_on_bad_path(self):
+        rec = FlightRecorder(path="/nonexistent-dir/x.jsonl")
+        rec.record("tick")             # swallowed OSError
+        rec.dump("/nonexistent-dir/y.jsonl")
+        assert len(rec) == 1
+
+    def test_registry_publish_records_event(self, tmp_path):
+        from deeplearning4j_tpu.monitor.flightrec import (
+            GLOBAL_FLIGHT_RECORDER,
+        )
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(1).list()
+            .layer(DenseLayer(n_in=2, n_out=3))
+            .layer(OutputLayer(n_in=3, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build()).init()
+        before = len(GLOBAL_FLIGHT_RECORDER.events(kind="publish"))
+        reg = ModelRegistry(tmp_path / "models")
+        v = reg.publish("flightrec-probe", net)
+        evs = GLOBAL_FLIGHT_RECORDER.events(kind="publish")
+        assert len(evs) == before + 1
+        assert evs[-1]["model"] == "flightrec-probe"
+        assert evs[-1]["version"] == v
+
+
+# =====================================================================
+# SLO objective + burn rate
+# =====================================================================
+class TestSLO:
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            SLOObjective()                      # no axis
+        with pytest.raises(ValueError):
+            SLOObjective(ttft_s=1.0, target=1.0)
+
+    def test_judge_axes(self):
+        o = SLOObjective(ttft_s=0.5, tpot_s=0.1)
+        assert o.judge(0.4, 0.05)
+        assert not o.judge(0.6, 0.05)           # ttft blown
+        assert not o.judge(0.4, 0.2)            # tpot blown
+        assert o.judge(0.4, None)               # single-token: no tpot
+        assert not o.judge(None, 0.05)          # judged axis missing
+
+    def test_burn_rate_math(self):
+        t = SLOTracker(SLOObjective(ttft_s=0.5, target=0.9,
+                                    window_s=60.0))
+        now = 1000.0
+        for _ in range(9):
+            t.record(ttft=0.1, now=now)
+        t.record(ttft=9.0, now=now)             # 1 bad of 10
+        # bad fraction 0.1 / budget 0.1 → burning exactly at rate
+        assert t.burn_rate(now=now) == pytest.approx(1.0)
+        assert t.good_total == 9 and t.bad_total == 1
+        assert t.window_counts(now=now) == {"good": 9, "bad": 1}
+
+    def test_shed_spends_budget_and_window_prunes(self):
+        t = SLOTracker(SLOObjective(ttft_s=0.5, target=0.5,
+                                    window_s=10.0))
+        t.record_shed(now=0.0)
+        assert t.burn_rate(now=0.0) == pytest.approx(2.0)
+        # the shed ages out of the window; totals keep it
+        assert t.burn_rate(now=100.0) == 0.0
+        assert t.bad_total == 1
+
+
+# =====================================================================
+# request traces (unit level — serving integration in test_serving_trace)
+# =====================================================================
+class TestRequestTrace:
+    def test_phases_flush_to_tracer_on_one_track(self, mon):
+        _, tr = mon
+        t = RequestTrace(model="m")
+        t.phase("queued", 1.0, 2.0)
+        t.phase("prefill", 2.0, 3.0, slot=0)
+        t.event("cow_fork", slot=0)
+        t.finish(status="ok", tokens=4)
+        tid = _tid_for(t.trace_id)
+        evs = [e for e in tr._events if e.get("tid") == tid]
+        names = [e["name"] for e in evs]
+        assert "thread_name" in names           # lane metadata
+        assert "req/queued" in names and "req/prefill" in names
+        assert "req/cow_fork" in names and "req/lifetime" in names
+        life = next(e for e in evs if e["name"] == "req/lifetime")
+        assert life["args"]["status"] == "ok"
+        assert life["args"]["trace_id"] == t.trace_id
+
+    def test_finish_idempotent_and_offline_safe(self):
+        # no tracer enabled: finish must be a cheap no-op, not a crash
+        t = RequestTrace()
+        t.phase("queued", 0.0, 1.0)
+        t.finish()
+        first = t.t_finished
+        t.finish(status="error")
+        assert t.t_finished == first and t.status == "ok"
+
+    def test_exemplar_sink_samples(self, tmp_path):
+        from deeplearning4j_tpu.monitor.reqtrace import (
+            clear_exemplar_sink,
+            set_exemplar_sink,
+        )
+        sink = tmp_path / "ex.jsonl"
+        set_exemplar_sink(str(sink), sample_every=2)
+        try:
+            ids = []
+            for _ in range(4):
+                t = RequestTrace()
+                ids.append(t.trace_id)
+                t.finish()
+        finally:
+            clear_exemplar_sink()
+        kept = [json.loads(l)["trace_id"]
+                for l in sink.read_text().splitlines()]
+        assert kept == [ids[1], ids[3]]         # every 2nd
+
+    def test_mint_trace_id_unique(self):
+        assert mint_trace_id() != mint_trace_id()
+        assert len(mint_trace_id()) == 16
+
+
+# =====================================================================
+# federation: many registries, one /metrics
+# =====================================================================
+def _worker_registry(reqs, depth, lat):
+    reg = MetricsRegistry()
+    reg.counter("serving_requests_total", model="m").inc(reqs)
+    reg.gauge("serving_queue_depth").set(depth)
+    h = reg.histogram("ttft_seconds", buckets=(0.1, 1.0))
+    h.observe(lat)
+    return reg
+
+
+class TestFederation:
+    def test_merge_semantics(self):
+        agg = MetricsAggregator()
+        e1 = export_snapshot(_worker_registry(3, 5, 0.05), "w1")
+        e2 = export_snapshot(_worker_registry(4, 7, 5.0), "w2")
+        e2["ts"] = e1["ts"] + 1.0               # w2 is newer
+        agg.ingest(e1)
+        agg.ingest(json.dumps(e2).encode())     # bytes path
+        assert agg.workers() == ["w1", "w2"]
+        snap = agg.snapshot()
+        # counters sum
+        assert snap["serving_requests_total"]["values"][0]["value"] == 7
+        # gauges: last write (newest snapshot) wins
+        assert snap["serving_queue_depth"]["values"][0]["value"] == 7
+        # histograms bucket-merge
+        h = snap["ttft_seconds"]["values"][0]
+        assert h["count"] == 2
+        assert h["bucket_counts"][0] == 1       # one obs ≤ 0.1
+
+    def test_exposition_worker_labels_and_grammar(self):
+        agg = MetricsAggregator()
+        agg.ingest_registry(_worker_registry(1, 1, 0.5), "w1")
+        agg.ingest_registry(_worker_registry(2, 2, 2.0), "w2")
+        text = agg.exposition()
+        _assert_exposition_parses(text)
+        assert 'worker="w1"' in text and 'worker="w2"' in text
+        # merged (unlabeled-by-worker) counter series exists too
+        merged = [l for l in text.splitlines()
+                  if l.startswith("serving_requests_total")
+                  and "worker=" not in l]
+        assert merged and float(merged[0].rsplit(" ", 1)[1]) == 3.0
+        # +Inf bucket rows carry the total count
+        inf = [l for l in text.splitlines()
+               if l.startswith("ttft_seconds_bucket")
+               and 'le="+Inf"' in l and "worker=" not in l]
+        assert inf and inf[0].endswith(" 2")
+
+    def test_bucket_layout_mismatch_degrades(self):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.histogram("x_seconds", buckets=(0.1, 1.0)).observe(0.5)
+        r2.histogram("x_seconds", buckets=(0.2, 2.0)).observe(0.5)
+        agg = MetricsAggregator()
+        agg.ingest_registry(r1, "a")
+        agg.ingest_registry(r2, "b")
+        merged = agg.snapshot()["x_seconds"]["values"][0]
+        assert merged["count"] == 2
+        assert "bucket_counts" not in merged    # sum/count only
+        _assert_exposition_parses(agg.exposition())
+
+    def test_escaped_labels_survive_federation(self):
+        reg = MetricsRegistry()
+        reg.counter("odd_total", model='a"b').inc()
+        agg = MetricsAggregator()
+        agg.ingest_registry(reg, "w1")
+        text = agg.exposition()
+        _assert_exposition_parses(text)
+        assert 'model="a\\"b"' in text
+
+    def test_transport_pipe(self):
+        tr = LocalQueueTransport()
+        pub1 = FederationPublisher(tr, "fed", "w1",
+                                   registry=_worker_registry(2, 1, 0.3))
+        pub2 = FederationPublisher(tr, "fed", "w2",
+                                   registry=_worker_registry(5, 2, 0.4))
+        pub1.publish_once()
+        pub2.publish_once()
+        col = FederationCollector(tr, "fed")
+        assert col.poll() == 2
+        snap = col.aggregator.snapshot()
+        assert snap["serving_requests_total"]["values"][0]["value"] == 7
+        assert col.aggregator.workers() == ["w1", "w2"]
+
+    def test_ingest_elastic_status(self):
+        status = {"members": {
+            "tok1": {"host": "h", "device_count": 1,
+                     "info": {"metrics": export_snapshot(
+                         _worker_registry(1, 1, 0.1), "tok1")}},
+            "tok2": {"host": "h", "device_count": 1, "info": {}},
+        }}
+        agg = MetricsAggregator()
+        assert ingest_elastic_status(status, agg) == 1
+        assert agg.workers() == ["tok1"]
+
+    def test_elastic_client_heartbeat_carries_metrics(self, mon):
+        reg, _ = mon
+        reg.counter("training_steps_total").inc(7)
+        from deeplearning4j_tpu.parallel.elastic import ElasticClient
+        c = ElasticClient("127.0.0.1:1", "tokX")
+        c.federate_metrics()
+        export = c._info["metrics"]
+        assert export["worker"] == "tokX"
+        agg = MetricsAggregator()
+        agg.ingest(export)
+        snap = agg.snapshot()
+        assert snap["training_steps_total"]["values"][0]["value"] == 7
+
+
+# =====================================================================
+# UI: aggregator as /metrics source + /events flight-recorder route
+# =====================================================================
+class TestObservabilityUI:
+    def test_metrics_route_serves_aggregator(self, mon):
+        from deeplearning4j_tpu.ui import UIServer
+        agg = MetricsAggregator()
+        agg.ingest_registry(_worker_registry(3, 1, 0.2), "w1")
+        agg.ingest_registry(_worker_registry(4, 2, 0.3), "w2")
+        ui = UIServer(registry=agg).start()
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/metrics",
+                timeout=10).read().decode()
+        finally:
+            ui.stop()
+        _assert_exposition_parses(text)
+        assert 'worker="w1"' in text and 'worker="w2"' in text
+
+    def test_events_route_renders_flight_recorder(self):
+        from deeplearning4j_tpu.monitor.flightrec import (
+            GLOBAL_FLIGHT_RECORDER,
+        )
+        from deeplearning4j_tpu.ui import UIServer
+        GLOBAL_FLIGHT_RECORDER.record("swap", model="ui-probe",
+                                      from_version=1, to_version=2)
+        ui = UIServer().start()
+        try:
+            base = f"http://127.0.0.1:{ui.port}"
+            html = urllib.request.urlopen(base + "/events",
+                                          timeout=10).read().decode()
+            assert "ui-probe" in html and "swap" in html
+            doc = json.loads(urllib.request.urlopen(
+                base + "/events?format=json&kind=swap",
+                timeout=10).read().decode())
+            assert any(e["model"] == "ui-probe"
+                       for e in doc["events"])
+        finally:
+            ui.stop()

@@ -687,3 +687,121 @@ class TestFleetObservability:
         assert any(r["metric"] == "fleet_swap_p99_ttft_ms"
                    for r in v["regressions"])
         assert compare_bench(rec(10240, 50.0), base)["status"] == "pass"
+
+
+# ==================================================== wire trace context
+class TestWireTracePropagation:
+    """Satellite: a client-minted trace id crosses the ND4T wire and the
+    router-side server spans stitch onto the SAME timeline (one track)
+    as the client's wire-level trace."""
+
+    @pytest.fixture
+    def mon(self):
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor import MetricsRegistry, Tracer
+        reg, tr = MetricsRegistry(), Tracer()
+        monitor.enable(registry=reg, tracer=tr)
+        yield reg, tr
+        monitor.disable()
+        monitor._STATE.registry = monitor.GLOBAL_REGISTRY
+        monitor._STATE.tracer = monitor.GLOBAL_TRACER
+
+    @staticmethod
+    def _req_events(tracer, trace_id):
+        return [e for e in tracer.events()
+                if str(e.get("name", "")).startswith("req/")
+                and e.get("args", {}).get("trace_id") == trace_id]
+
+    def test_remote_stream_stitches_one_timeline(self, mon, tmp_path,
+                                                 net_v1, prompts,
+                                                 ref_v1):
+        from deeplearning4j_tpu.monitor.reqtrace import _tid_for
+        from deeplearning4j_tpu.streaming import LocalQueueTransport
+        _, tracer = mon
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        tr = LocalQueueTransport()
+        router = FleetRouter(fleet, transport=tr)
+        try:
+            fleet.deploy("lm", n_slots=2, n_blocks=16, block_len=BL)
+            router.serve()
+            client = FleetClient(tr)
+            remote = client.generate("lm", prompts[0], 6)
+            got = remote.result(timeout=120)
+            np.testing.assert_array_equal(got, ref_v1[0])
+            tid = remote.trace_id
+            assert tid is not None
+            assert remote.trace is not None and remote.trace.finished
+            # the server-side trace flushes when the scheduler finishes
+            # the stream, a hair after the done-reply reaches us
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                evs = self._req_events(tracer, tid)
+                if sum(e["name"] == "req/lifetime" for e in evs) >= 2:
+                    break
+                time.sleep(0.02)
+            evs = self._req_events(tracer, tid)
+            names = {e["name"] for e in evs}
+            # client half (wire-level) + server half (scheduler phases)
+            # of ONE trace id, all on one derived track
+            assert {"req/wire_submit", "req/remote_stream",
+                    "req/queued", "req/prefill", "req/decode",
+                    "req/lifetime"} <= names
+            assert sum(e["name"] == "req/lifetime" for e in evs) == 2
+            assert {e["tid"] for e in evs} == {_tid_for(tid)}
+
+            # the server-side phase sequence matches the LOCAL path's
+            local = fleet.server("lm").generate_async(prompts[1], 6)
+            local.result(timeout=120)
+            local_names = [p["name"] for p in local.trace.phases]
+            remote_side = [e for e in evs
+                           if e["name"] in ("req/queued", "req/prefill",
+                                            "req/decode")]
+            assert [e["name"].removeprefix("req/")
+                    for e in remote_side[:2]] == local_names[:2] \
+                == ["queued", "prefill"]
+        finally:
+            router.stop()
+            fleet.stop()
+
+    def test_remote_shed_trace_annotated(self, mon, tmp_path, net_v1,
+                                         prompts):
+        from deeplearning4j_tpu.streaming import LocalQueueTransport
+        _, tracer = mon
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        tr = LocalQueueTransport()
+        router = FleetRouter(fleet, transport=tr, max_queue=0)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            router.serve()
+            remote = FleetClient(tr).generate("lm", prompts[0], 6)
+            with pytest.raises(ShedError):
+                remote.result(timeout=60)
+            assert remote.trace is not None
+            assert remote.trace.status == "shed"
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                evs = self._req_events(tracer, remote.trace_id)
+                if any(e["name"] == "req/shed" for e in evs):
+                    break
+                time.sleep(0.02)
+            shed = [e for e in evs if e["name"] == "req/shed"]
+            assert shed and shed[0]["args"]["reason"]
+            assert shed[0]["args"].get("router") is True
+        finally:
+            router.stop()
+            fleet.stop()
+
+    def test_wire_header_carries_trace_id(self):
+        from deeplearning4j_tpu.serving import wire
+        data = wire.encode_request("lm", "rid1", np.arange(3), 4,
+                                   trace_id="abcd1234abcd1234")
+        header, _ = wire.decode_request(data)
+        assert header["trace_id"] == "abcd1234abcd1234"
+        # absent by default — old routers keep decoding new clients
+        data = wire.encode_request("lm", "rid1", np.arange(3), 4)
+        header, _ = wire.decode_request(data)
+        assert "trace_id" not in header

@@ -1,0 +1,258 @@
+"""Request-plane lifecycle tracing on the serving scheduler.
+
+Contracts (ISSUE: observability tentpole):
+
+- every traced request carries queued → prefill → decode phases with
+  monotonic host timestamps, flushed to the Tracer on its own track;
+- tracing adds ZERO device syncs (`block_until_ready` count identical
+  traced vs untraced) and leaves emitted tokens bit-identical;
+- shed decisions are annotated into the trace and spend SLO budget;
+- `GenerationServer(name=)` labels serving_* metrics with `server=`.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import (
+    MetricsRegistry,
+    SLOObjective,
+    Tracer,
+)
+from deeplearning4j_tpu.monitor.flightrec import GLOBAL_FLIGHT_RECORDER
+from deeplearning4j_tpu.monitor.reqtrace import _tid_for
+from deeplearning4j_tpu.serving import GenerationServer, ShedError
+from deeplearning4j_tpu.zoo.transformer import TransformerLM, generate
+
+V, D, HEADS, LAYERS, MAXLEN = 23, 16, 4, 2, 32
+BL = 4
+
+
+@pytest.fixture(scope="module")
+def net():
+    return TransformerLM(vocab_size=V, d_model=D, n_layers=LAYERS,
+                         n_heads=HEADS, max_len=MAXLEN, seed=3).init()
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return np.random.default_rng(5).integers(0, V, (6, 3))
+
+
+@pytest.fixture(scope="module")
+def ref_tokens(net, prompts):
+    return generate(net, prompts, 6, temperature=0)
+
+
+@pytest.fixture
+def mon():
+    reg, tr = MetricsRegistry(), Tracer()
+    monitor.enable(registry=reg, tracer=tr)
+    yield reg, tr
+    monitor.disable()
+    monitor._STATE.registry = monitor.GLOBAL_REGISTRY
+    monitor._STATE.tracer = monitor.GLOBAL_TRACER
+
+
+def _serve(srv, prompts, n=6, n_tokens=6):
+    streams = [srv.generate_async(prompts[r % len(prompts)], n_tokens)
+               for r in range(n)]
+    toks = np.stack([s.result(timeout=300) for s in streams])
+    return streams, toks
+
+
+class TestRequestLifecycleTrace:
+    def test_phases_ordered_and_monotonic(self, mon, net, prompts,
+                                          ref_tokens):
+        _, tracer = mon
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            streams, toks = _serve(srv, prompts)
+        finally:
+            srv.stop()
+        np.testing.assert_array_equal(toks, ref_tokens)
+        ids = set()
+        for s in streams:
+            tr = s.trace
+            assert tr is not None and tr.finished and tr.status == "ok"
+            ids.add(tr.trace_id)
+            names = [p["name"] for p in tr.phases]
+            assert names[0] == "queued" and names[1] == "prefill"
+            assert "decode" in names[2:]
+            last = tr.t_created
+            for p in tr.phases:
+                assert p["t0"] <= p["t1"], p
+                assert p["t0"] >= last - 1e-9
+                last = p["t0"]
+            assert tr.t_finished >= tr.phases[-1]["t1"] - 1e-9
+            assert tr.meta["prompt_len"] == 3
+            assert tr.meta["ttft_s"] is not None
+            decode_tok = sum(p["args"]["tokens"] for p in tr.phases
+                             if p["name"] == "decode")
+            prefill_tok = sum(1 for p in tr.phases
+                              if p["name"] == "prefill")
+            assert decode_tok + prefill_tok == 6
+        assert len(ids) == 6                    # one trace per request
+        # each trace flushed onto its OWN tracer track
+        tids = {e["tid"] for e in tracer._events
+                if str(e.get("name", "")).startswith("req/lifetime")}
+        assert tids == {_tid_for(i) for i in ids}
+
+    def test_spec_counts_attributed_per_dispatch(self, mon, net):
+        """Single slot: every dispatch's speculative delta lands on
+        exactly one decode phase, so the per-trace sum equals the
+        engine counter."""
+        prompt = np.asarray([1, 2, 3, 1, 2, 3], np.int64)
+        srv = GenerationServer(net, n_slots=1, n_blocks=16,
+                               block_len=BL, speculative=4).start()
+        try:
+            s = srv.generate_async(prompt, 20)
+            s.result(timeout=300)
+            proposed = srv.engine.spec_proposed_total
+            accepted = srv.engine.spec_accepted_total
+        finally:
+            srv.stop()
+        decode = [p for p in s.trace.phases if p["name"] == "decode"]
+        assert sum(p["args"].get("spec_proposed", 0)
+                   for p in decode) == proposed
+        assert sum(p["args"].get("spec_accepted", 0)
+                   for p in decode) == accepted
+
+    def test_trace_off_serving_identical_and_traceless(self, net,
+                                                       prompts,
+                                                       ref_tokens):
+        assert not monitor.is_enabled()
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            streams, toks = _serve(srv, prompts)
+        finally:
+            srv.stop()
+        np.testing.assert_array_equal(toks, ref_tokens)
+        assert all(s.trace is None for s in streams)
+
+
+class TestTraceOverheadContract:
+    """Tracing must stamp host clocks only — the traced run performs
+    exactly the device syncs the untraced run does."""
+
+    @pytest.fixture
+    def sync_counter(self, monkeypatch):
+        calls = {"n": 0}
+        real = jax.block_until_ready
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(jax, "block_until_ready", counting)
+        return calls
+
+    def test_traced_equals_untraced_syncs(self, sync_counter, net,
+                                          prompts, ref_tokens):
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            _, toks_off = _serve(srv, prompts)
+        finally:
+            srv.stop()
+        untraced = sync_counter["n"]
+        monitor.enable(registry=MetricsRegistry(), tracer=Tracer())
+        try:
+            srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                                   block_len=BL).start()
+            try:
+                _, toks_on = _serve(srv, prompts)
+            finally:
+                srv.stop()
+        finally:
+            monitor.disable()
+            monitor._STATE.registry = monitor.GLOBAL_REGISTRY
+            monitor._STATE.tracer = monitor.GLOBAL_TRACER
+        assert sync_counter["n"] - untraced == untraced or untraced == 0
+        assert sync_counter["n"] == 2 * untraced
+        np.testing.assert_array_equal(toks_on, toks_off)
+        np.testing.assert_array_equal(toks_on, ref_tokens)
+
+
+class TestShedTraceAndSLO:
+    def test_shed_annotated_and_spends_budget(self, mon, net, prompts):
+        reg, _ = mon
+        before = len(GLOBAL_FLIGHT_RECORDER.events(kind="shed_burst"))
+        srv = GenerationServer(net, n_slots=1, n_blocks=4,
+                               block_len=BL, max_queue=1,
+                               slo_ttft_s=1e-3, name="shedder",
+                               slo=SLOObjective(ttft_s=60.0)).start()
+        try:
+            streams = [srv.generate_async(prompts[r % 6], 6)
+                       for r in range(8)]
+            shed = ok = 0
+            for s in streams:
+                try:
+                    s.result(timeout=300)
+                    ok += 1
+                except ShedError:
+                    shed += 1
+                    tr = s.trace
+                    assert tr is not None and tr.status == "shed"
+                    ev = [e for e in tr.events if e["name"] == "shed"]
+                    assert ev and ev[0]["args"]["reason"]
+        finally:
+            srv.stop()
+        assert shed >= 1 and ok >= 1
+        snap = reg.snapshot()
+        good = snap["slo_requests_good_total"]["values"][0]
+        bad = snap["slo_requests_bad_total"]["values"][0]
+        assert good["labels"] == {"model": "shedder"}
+        assert good["value"] == ok and bad["value"] == shed
+        burn = snap["slo_burn_rate"]["values"][0]["value"]
+        assert burn > 0.0                       # sheds burned budget
+        assert len(GLOBAL_FLIGHT_RECORDER.events(kind="shed_burst")) \
+            > before
+
+    def test_slo_all_good_when_target_generous(self, mon, net, prompts,
+                                               ref_tokens):
+        reg, _ = mon
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL, name="roomy",
+                               slo=SLOObjective(ttft_s=600.0,
+                                                tpot_s=600.0)).start()
+        try:
+            streams, toks = _serve(srv, prompts)
+        finally:
+            srv.stop()
+        np.testing.assert_array_equal(toks, ref_tokens)
+        snap = reg.snapshot()
+        assert snap["slo_requests_good_total"]["values"][0]["value"] == 6
+        assert "slo_requests_bad_total" in snap
+        assert snap["slo_requests_bad_total"]["values"][0]["value"] == 0
+        assert all(s.trace.meta["slo_good"] for s in streams)
+
+
+class TestServerNameLabel:
+    def test_named_server_labels_families(self, mon, net, prompts):
+        reg, _ = mon
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL, name="alpha").start()
+        try:
+            srv.generate_async(prompts[0], 6).result(timeout=300)
+        finally:
+            srv.stop()
+        fam = reg.snapshot()["serving_requests_total"]
+        assert fam["values"][0]["labels"] == {"server": "alpha"}
+        text = reg.exposition()
+        assert 'serving_requests_total{server="alpha"} 1' in text
+
+    def test_unnamed_server_stays_unlabeled(self, mon, net, prompts):
+        reg, _ = mon
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            srv.generate_async(prompts[0], 6).result(timeout=300)
+        finally:
+            srv.stop()
+        fam = reg.snapshot()["serving_requests_total"]
+        assert fam["values"][0].get("labels", {}) == {}
