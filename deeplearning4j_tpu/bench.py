@@ -1205,6 +1205,13 @@ GATE_TOLERANCES = {
     # against a shared baseline's >2 and gates as a regression instead
     # of masquerading as a sharing win (the int8/bf16 pattern)
     "serving_prefix_prefill_reduction": 0.02,
+    # STRUCTURAL (token-position accounting from the goodput ledger,
+    # not a timing): a silently-broken accounting path reports ~0
+    # (ledger never fed) or ~1.0 (padding never counted) against a
+    # real baseline's mid-range fraction and gates as a regression
+    # instead of masquerading as an efficiency change (the
+    # prefix-reduction pattern)
+    "serving_goodput_fraction": 0.05,
 }
 # metrics where a RISE past tolerance is the regression (latencies);
 # compare_bench inverts the ratio so the shared gate math applies
@@ -1265,6 +1272,10 @@ def _gate_metrics(rec):
          "extras", "serving_speculative", "tokens_per_sec")
     take("serving_prefix_prefill_reduction",
          "extras", "serving_prefix", "prefill_reduction")
+    # goodput ledger (loadtest "goodput" block): the useful fraction of
+    # dispatched token-positions — structural accounting, tight band
+    take("serving_goodput_fraction",
+         "extras", "goodput", "goodput_fraction")
     return out
 
 

@@ -88,6 +88,18 @@ from deeplearning4j_tpu.monitor.flightrec import (
     FlightRecorder,
     flight_recorder,
 )
+from deeplearning4j_tpu.monitor import goodput
+from deeplearning4j_tpu.monitor.goodput import (
+    GOODPUT_CLASSES,
+    GoodputLedger,
+    ttft_decomposition,
+)
+from deeplearning4j_tpu.monitor import alerts
+from deeplearning4j_tpu.monitor.alerts import (
+    AlertEngine,
+    AlertRule,
+    default_rule_pack,
+)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "Timer",
@@ -106,6 +118,8 @@ __all__ = [
     "slo", "SLOObjective", "SLOTracker",
     "flightrec", "FlightRecorder", "flight_recorder",
     "GLOBAL_FLIGHT_RECORDER",
+    "goodput", "GoodputLedger", "GOODPUT_CLASSES", "ttft_decomposition",
+    "alerts", "AlertEngine", "AlertRule", "default_rule_pack",
 ]
 
 

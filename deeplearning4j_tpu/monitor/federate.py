@@ -110,6 +110,21 @@ class MetricsAggregator:
         with self._lock:
             return sorted(self._exports)
 
+    def export_ages(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Seconds since each worker's last ingested export (by the
+        export's own `ts`, a `time.time()` stamp) — the scrape-side
+        liveness signal the worker-vanished alert rule evaluates."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            return {w: max(0.0, now - e["ts"])
+                    for w, e in self._exports.items()}
+
+    def drop_worker(self, worker: str) -> bool:
+        """Forget one worker's export (deliberate decommission — its
+        series leave `/metrics` instead of going stale)."""
+        with self._lock:
+            return self._exports.pop(str(worker), None) is not None
+
     def clear(self):
         with self._lock:
             self._exports.clear()

@@ -252,6 +252,12 @@ class PagedDecodeEngine:
         self.prefix_hits_total = 0
         self.prefix_tokens_saved_total = 0
         self.prefix_forks_total = 0
+        # token-goodput ledger: every dispatch site classifies the
+        # token-positions of the program it launches (host ints; the
+        # scheduler mirrors the classes onto the registry) — sum of
+        # classes == dispatched_total by construction
+        from deeplearning4j_tpu.monitor.goodput import GoodputLedger
+        self.goodput = GoodputLedger()
         self._preempted: List[dict] = []
         # per-slot attribution for the LAST admit_many wave (host-side
         # bookkeeping only — what request tracing reads to say whether
@@ -686,6 +692,9 @@ class PagedDecodeEngine:
             probs=np.asarray(probs[0]))
         self.prefix_pinned_blocks += nb
         self.block_grants_total += nb
+        # registration prefills once so later admissions don't: the P
+        # real positions are useful, the bucket padding is waste
+        self.goodput.account(useful=P, pad_waste=Pb - P)
         return key
 
     def release_prefix(self, key: tuple):
@@ -905,6 +914,17 @@ class PagedDecodeEngine:
             jnp.asarray(top_ps))
         firsts = np.asarray(firsts)
 
+        # ledger: the prefill program touched k2*Pb token-positions —
+        # live prompt positions are useful, a requeued continuation's
+        # re-prefill is preempt_discard (that work was already done
+        # once), width/length padding is pad_waste
+        fresh = sum(int(w["prompt"].shape[0]) for w in wave
+                    if not int(w["r"].get("emit_start") or 0))
+        redone = sum(int(w["prompt"].shape[0]) for w in wave
+                     if int(w["r"].get("emit_start") or 0))
+        self.goodput.account(useful=fresh, preempt_discard=redone,
+                             pad_waste=k2 * Pb - fresh - redone)
+
         for j, w in enumerate(wave):
             self._finish_admission(w, int(firsts[j]), keys[j], results)
 
@@ -1008,6 +1028,16 @@ class PagedDecodeEngine:
             chosen = np.asarray(chosen)
             for w in ext:
                 firsts[w["slot"]] = int(chosen[w["slot"]])
+            # ledger: the suffix-extension score program touched S*K
+            # positions — live suffix positions are useful (the shared
+            # prefix itself was accounted at registration), requeued
+            # continuations are preempt_discard, the rest is padding
+            fresh = sum(int(w["suffix"].shape[0]) for w in ext
+                        if not int(w["r"].get("emit_start") or 0))
+            redone = sum(int(w["suffix"].shape[0]) for w in ext
+                         if int(w["r"].get("emit_start") or 0))
+            self.goodput.account(useful=fresh, preempt_discard=redone,
+                                 pad_waste=S * K - fresh - redone)
         # exact-match admissions (prompt == prefix): next-token probs
         # were computed ONCE at registration — nothing to prefill,
         # just run the sampling tail on the cached distribution
@@ -1199,6 +1229,13 @@ class PagedDecodeEngine:
         valids = np.asarray(valids)
         taken = valids.sum(axis=0).astype(np.int32)  # [S] tokens emitted
         act = self.active
+        # ledger: the decode chunk touched J*S token-positions; emitted
+        # tokens on live lanes are useful, idle/finished lanes and the
+        # tail past each lane's budget are pad_waste
+        n_useful = int(np.where(act, taken, 0).sum())
+        self.goodput.account(
+            useful=n_useful,
+            pad_waste=int(toks.shape[0]) * int(toks.shape[1]) - n_useful)
         last_idx = np.clip(taken - 1, 0, None)
         self.last_token = np.where(
             act & (taken > 0), toks[last_idx, np.arange(toks.shape[1])],
@@ -1316,6 +1353,12 @@ class PagedDecodeEngine:
         greedy_mat = np.asarray(greedy_mat)
         chosen = np.asarray(chosen)
         self.spec_dispatches_total += 1
+        # ledger: the score program touched S*K token-positions; per
+        # slot, emitted tokens are useful, valid-but-rejected draft
+        # lanes are spec_rejected, positions past n_valid (and whole
+        # inactive rows) are pad_waste — tallied in the accept loop
+        gp_useful = 0
+        gp_rejected = 0
         emitted: Dict[int, List[int]] = {}
         finished = []
         for s in np.flatnonzero(self.active):
@@ -1336,6 +1379,8 @@ class PagedDecodeEngine:
                 self.spec_proposed_total += v - 1
                 self.spec_accepted_total += len(toks) - 1
             n = len(toks)
+            gp_useful += n
+            gp_rejected += v - n
             self.spec_emitted_total += n
             self.pos[s] += n
             self.emit_idx[s] += n
@@ -1349,6 +1394,9 @@ class PagedDecodeEngine:
             if self.remaining[s] <= 0:
                 finished.append(s)
                 self._release(s)
+        self.goodput.account(
+            useful=gp_useful, spec_rejected=gp_rejected,
+            pad_waste=S * K - gp_useful - gp_rejected)
         return emitted, finished
 
     # ------------------------------------------------------------ evict

@@ -176,7 +176,7 @@ class FleetServer:
             live.add(d.name)
             s = d.server
             m["version"](d.name).set(d.version)
-            m["queue"](d.name).set(len(s._pending) + s._queue.qsize())
+            m["queue"](d.name).set(s.queue_depth())
             m["slots_active"](d.name).set(s.engine.active_slots)
             m["slots"](d.name).set(s.engine.n_slots)
             m["pool_free"](d.name).set(s.engine.pool.free_blocks)
@@ -550,14 +550,23 @@ class FleetAutoscaler:
     `fleet_pool_blocks_{free,used}` gauge families on the metrics
     registry — the SAME signal plane /metrics exports (the gauges the
     ROADMAP names as the autoscaling inputs) — with a live-state
-    fallback when monitoring is disabled."""
+    fallback when monitoring is disabled.
+
+    Rules-driven mode: pass `rules=` (an `monitor.alerts.AlertEngine`)
+    and the pressure derivation flips from the two hardcoded thresholds
+    to the declarative rule set — a FIRING alert is pressure for the
+    model its rule's `model=`/`server=` label names (fleet-wide when
+    unlabeled), and `goodput_low=` adds a `serving_goodput_fraction`
+    floor (scale out when device work stops turning into kept tokens).
+    The legacy thresholds remain the default."""
 
     def __init__(self, fleet: FleetServer, *,
                  queue_depth_high: int = 32,
                  pool_free_frac_low: float = 0.25,
                  factor: int = 2, max_slots: int = 64,
                  max_blocks: int = 8192, cooldown_s: float = 0.0,
-                 drain_timeout: float = 600.0):
+                 drain_timeout: float = 600.0,
+                 rules=None, goodput_low: Optional[float] = None):
         self.fleet = fleet
         self.queue_depth_high = int(queue_depth_high)
         self.pool_free_frac_low = float(pool_free_frac_low)
@@ -566,6 +575,9 @@ class FleetAutoscaler:
         self.max_blocks = int(max_blocks)
         self.cooldown_s = float(cooldown_s)
         self.drain_timeout = float(drain_timeout)
+        self.rules = rules
+        self.goodput_low = (None if goodput_low is None
+                            else float(goodput_low))
         self._last_scaled: Dict[str, float] = {}
         self.decisions: List[dict] = []
         self._watch: Optional[threading.Thread] = None
@@ -601,11 +613,69 @@ class FleetAutoscaler:
             server = self.fleet.server(name)
         except KeyError:
             return None
-        return {"queue_depth": len(server._pending)
-                + server._queue.qsize(),
+        return {"queue_depth": server.queue_depth(),
                 "pool_free": server.engine.pool.free_blocks,
                 "pool_used": server.engine.pool.used_blocks,
                 "n_slots": server.engine.n_slots}
+
+    def _goodput(self, name: str, snap: Optional[dict]) -> Optional[float]:
+        """The model's `serving_goodput_fraction` (by its `server=`
+        label) from the shared snapshot, falling back to the live
+        ledger when monitoring is off.  Returns None until the server
+        has dispatched NON-warmup work — a warmed-but-idle server's
+        0.0 fraction is absence of traffic, not waste, and must not
+        read as scale-out pressure."""
+        from deeplearning4j_tpu.monitor.goodput import (
+            GOODPUT_COUNTER_FAMILIES)
+        if snap is not None:
+            frac = None
+            for e in (snap.get("serving_goodput_fraction")
+                      or {}).get("values", []):
+                if e.get("labels", {}).get("server") == name:
+                    frac = e.get("value")
+            if frac is not None:
+                served = 0.0
+                for cls, fam in GOODPUT_COUNTER_FAMILIES.items():
+                    if cls == "warmup":
+                        continue
+                    for e in (snap.get(fam) or {}).get("values", []):
+                        if e.get("labels", {}).get("server") == name:
+                            served += e.get("value") or 0.0
+                return frac if served > 0 else None
+        try:
+            server = self.fleet.server(name)
+        except KeyError:
+            return None
+        lg = server.engine.goodput
+        if lg.dispatched_total - lg.classes["warmup"] <= 0:
+            return None
+        return lg.goodput_fraction()
+
+    def _rules_pressure(self, name: str, snap: Optional[dict],
+                        states: List[dict]) -> List[str]:
+        """Rules-mode pressure: firing alerts targeting this model (or
+        fleet-wide), plus the optional goodput floor.  `states` is one
+        evaluation shared across the whole check() pass — delta-rate
+        rules need real intervals between evaluations."""
+        pressure = []
+        by_name = {r.name: r for r in self.rules.rules}
+        for s in states:
+            if s["state"] != "firing":
+                continue
+            rule = by_name.get(s["name"])
+            target = None
+            if rule is not None:
+                target = (rule.labels.get("model")
+                          or rule.labels.get("server"))
+            if target in (None, name):
+                pressure.append(f"alert {s['name']} firing "
+                                f"({s['severity']})")
+        if self.goodput_low is not None:
+            gp = self._goodput(name, snap)
+            if gp is not None and gp < self.goodput_low:
+                pressure.append(f"goodput fraction {gp:.2f} < "
+                                f"{self.goodput_low}")
+        return pressure
 
     # -------------------------------------------------------------- check
     def check(self, names: Optional[List[str]] = None) -> List[dict]:
@@ -615,6 +685,8 @@ class FleetAutoscaler:
         from deeplearning4j_tpu import monitor
         snap = (monitor.registry().snapshot()
                 if monitor.is_enabled() else None)
+        rule_states = (self.rules.evaluate()
+                       if self.rules is not None else None)
         made = []
         for name in (names or self.fleet.names()):
             sig = self._signal(name, snap)
@@ -623,17 +695,20 @@ class FleetAutoscaler:
             last = self._last_scaled.get(name, 0.0)
             if time.monotonic() - last < self.cooldown_s:
                 continue
-            total = sig["pool_free"] + sig["pool_used"]
-            free_frac = sig["pool_free"] / total if total else 1.0
-            pressure = []
-            if sig["queue_depth"] > self.queue_depth_high:
-                pressure.append(
-                    f"queue_depth {sig['queue_depth']:.0f} > "
-                    f"{self.queue_depth_high}")
-            if free_frac < self.pool_free_frac_low:
-                pressure.append(
-                    f"pool free fraction {free_frac:.2f} < "
-                    f"{self.pool_free_frac_low}")
+            if self.rules is not None:
+                pressure = self._rules_pressure(name, snap, rule_states)
+            else:
+                total = sig["pool_free"] + sig["pool_used"]
+                free_frac = sig["pool_free"] / total if total else 1.0
+                pressure = []
+                if sig["queue_depth"] > self.queue_depth_high:
+                    pressure.append(
+                        f"queue_depth {sig['queue_depth']:.0f} > "
+                        f"{self.queue_depth_high}")
+                if free_frac < self.pool_free_frac_low:
+                    pressure.append(
+                        f"pool free fraction {free_frac:.2f} < "
+                        f"{self.pool_free_frac_low}")
             if not pressure:
                 continue
             server = self.fleet.server(name)

@@ -136,7 +136,7 @@ class FleetRouter:
         return server._outstanding_tokens() + server.queued_tokens
 
     def _should_shed(self, name: str, server) -> Optional[str]:
-        depth = len(server._pending) + server._queue.qsize()
+        depth = server.queue_depth()
         if self.max_queue is not None and depth >= self.max_queue:
             return (f"model {name!r} admission queue full "
                     f"({depth} >= max_queue {self.max_queue})")

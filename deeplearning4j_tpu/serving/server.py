@@ -34,6 +34,8 @@ import numpy as np
 
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.monitor.flightrec import GLOBAL_FLIGHT_RECORDER
+from deeplearning4j_tpu.monitor.goodput import (
+    GOODPUT_COUNTER_FAMILIES, GOODPUT_FRACTION_GAUGE, ttft_decomposition)
 from deeplearning4j_tpu.monitor.reqtrace import RequestTrace
 from deeplearning4j_tpu.monitor.slo import SLOObjective, SLOTracker
 from deeplearning4j_tpu.parallel.inference import ParallelInference
@@ -260,6 +262,8 @@ class GenerationServer(ParallelInference):
         self._spec_dispatches_seen = 0
         self._prefix_hits_seen = 0
         self._prefix_saved_seen = 0
+        # goodput-ledger mirror cursors (one per classification class)
+        self._goodput_seen = {}
         # prefix registrations from foreign threads ride a control
         # queue the scheduler drains at each loop top (the engine is
         # single-threaded by contract); before start() they apply
@@ -308,6 +312,15 @@ class GenerationServer(ParallelInference):
         its mutex on every submit."""
         with self._open_lock:
             return max(0, self._queued_tokens)
+
+    def queue_depth(self) -> int:
+        """Requests awaiting admission: the submit queue plus the
+        scheduler's pending list — the same value the
+        `serving_queue_depth` gauge publishes, as a public seam so the
+        autoscaler's live fallback and the router's shed estimator
+        don't reach into scheduler internals. Lock-free reads of two
+        thread-safe sizes; may be one scheduler iteration stale."""
+        return len(self._pending) + self._queue.qsize()
 
     def _queue_item_taken(self, item):
         """Bookkeeping for every item removed from `_queue` (None
@@ -424,6 +437,11 @@ class GenerationServer(ParallelInference):
         # programs cold for live traffic of that shape.
         saved_prefixes, eng._prefixes = eng._prefixes, {}
         short_wave = None      # narrowest under-admitted wave seen
+        # goodput: everything the compile grid dispatches is warmup
+        # class — the ledger stays monotone (no counter reset here, so
+        # registry mirrors never see negative deltas) while the useful
+        # fraction keeps counting real traffic only
+        eng.goodput.set_mode("warmup")
         try:
             for k in widths:
                 for pl in buckets:
@@ -478,6 +496,7 @@ class GenerationServer(ParallelInference):
                     break
         finally:
             eng._prefixes = saved_prefixes
+            eng.goodput.set_mode(None)
         import jax.numpy as jnp
         # speculative + shared-prefix programs: the K-position score
         # program (both sampling variants), the CoW fork copy, and the
@@ -693,6 +712,26 @@ class GenerationServer(ParallelInference):
             "step": reg.timer("serving_step_seconds",
                               "one continuous-batching decode dispatch",
                               **lbl),
+            "goodput_frac": reg.gauge(
+                GOODPUT_FRACTION_GAUGE,
+                "useful token-positions / dispatched token-positions "
+                "(the goodput ledger's rolling fraction)", **lbl),
+            "goodput": {
+                c: reg.counter(
+                    fam, f"dispatched token-positions classified "
+                         f"{c} by the goodput ledger", **lbl)
+                for c, fam in GOODPUT_COUNTER_FAMILIES.items()
+            },
+            "ttft_queue": reg.timer(
+                "serving_ttft_queue_wait_seconds",
+                "TTFT decomposition: submit to admission wave", **lbl),
+            "ttft_prefill": reg.timer(
+                "serving_ttft_prefill_seconds",
+                "TTFT decomposition: the admission dispatch", **lbl),
+            "ttft_emit": reg.timer(
+                "serving_ttft_first_emit_seconds",
+                "TTFT decomposition: prefill completion to the consumer "
+                "seeing the first token", **lbl),
         }
 
     def _slo_metrics(self):
@@ -987,7 +1026,7 @@ class GenerationServer(ParallelInference):
             progressed = True
         # --------------------------------------------------- gauges
         if m is not None:
-            m["queue"].set(len(self._pending) + self._queue.qsize())
+            m["queue"].set(self.queue_depth())
             m["slots"].set(eng.active_slots)
             m["blocks"].set(eng.free_blocks)
             m["pool_free"].set(eng.pool.free_blocks)
@@ -1009,6 +1048,17 @@ class GenerationServer(ParallelInference):
                                           - self._prefix_saved_seen)
                     self._prefix_saved_seen = eng.prefix_tokens_saved_total
                     self._prefix_hits_seen = eng.prefix_hits_total
+            # goodput ledger mirror: per-class counter deltas + the
+            # rolling fraction (host ints the dispatch sites already
+            # wrote — zero extra syncs)
+            gp = eng.goodput
+            for cls, ctr in m["goodput"].items():
+                total = gp.classes[cls]
+                seen = self._goodput_seen.get(cls, 0)
+                if total > seen:
+                    ctr.inc(total - seen)
+                    self._goodput_seen[cls] = total
+            m["goodput_frac"].set(gp.goodput_fraction())
         return progressed
 
     # ------------------------------------------------ speculative policy
@@ -1117,6 +1167,14 @@ class GenerationServer(ParallelInference):
         st._finish()
         if m is not None and st.t_first is not None and n > 1:
             m["tpot"].observe((st.t_last - st.t_first) / (n - 1))
+        if m is not None and tr is not None:
+            # TTFT decomposition from the stamps the trace already
+            # carries (queued/prefill phases + the ttft annotation)
+            dec = ttft_decomposition(tr)
+            if dec is not None:
+                m["ttft_queue"].observe(dec["queue_wait_s"])
+                m["ttft_prefill"].observe(dec["prefill_s"])
+                m["ttft_emit"].observe(dec["first_emit_s"])
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
@@ -1148,6 +1206,13 @@ class GenerationServer(ParallelInference):
             # flag-set and count-read share the submit path's lock:
             # see the generate_async re-check
             self._draining = True
+        # goodput: dispatch work from here on belongs to the swap
+        # window — delivered, but attributed to drain (the fraction
+        # visibly dips during a swap, which is the operator's signal).
+        # The flag flip is racy against an in-flight dispatch by one
+        # dispatch at most; the ledger's mode reroute keeps every
+        # counter monotone either way.
+        self.engine.goodput.set_mode("drain")
         deadline = (None if timeout is None
                     else time.monotonic() + float(timeout))
         while self.open_streams > 0:

@@ -46,6 +46,8 @@ class UIServer:
         # /metrics exposition source; None → the process-global monitor
         # registry at request time (so enable() after server start works)
         self._registry = registry
+        # /alerts source: an AlertEngine attached via attach_alerts()
+        self._alerts = None
         self._tsne: Dict[str, dict] = {}          # session → {coords, labels}
         self._activations: Dict[str, bytes] = {}  # name → PNG bytes
         self._module_lock = threading.Lock()      # guards the two dicts
@@ -120,6 +122,15 @@ class UIServer:
                                    "application/json")
                     else:
                         self._send(200, outer._events_html(q))
+                elif path == "/alerts":
+                    # declarative alert states (monitor/alerts.py):
+                    # the attached AlertEngine's pending/firing/resolved
+                    # view — ?format=json for machine consumers
+                    if q.get("format", [""])[0] == "json":
+                        self._send(200, outer._alerts_json(),
+                                   "application/json")
+                    else:
+                        self._send(200, outer._alerts_html())
                 elif path == "/profile":
                     # AOT cost tables + roofline (benchtools/hlo_cost.py
                     # publishes; committed PROFILE_*/cost_*.json fill in)
@@ -218,7 +229,8 @@ class UIServer:
         pages = [("overview", "/train/overview"), ("model", "/train/model"),
                  ("system", "/train/system"), ("tsne", "/tsne"),
                  ("activations", "/activations"), ("profile", "/profile"),
-                 ("serving", "/serving"), ("events", "/events")]
+                 ("serving", "/serving"), ("events", "/events"),
+                 ("alerts", "/alerts")]
         links = "".join(
             f'<a href="{url}{qs}" style="margin-right:16px;'
             f'{"font-weight:bold" if p == active else ""}">'
@@ -610,6 +622,46 @@ class UIServer:
             body.append("</table>")
         return self._page(self._tr("title.events"), "".join(body))
 
+    def _alerts_json(self):
+        eng = self._alerts
+        states = eng.states() if eng is not None else []
+        return json.dumps({"attached": eng is not None,
+                           "alerts": states}, default=str)
+
+    def _alerts_html(self):
+        """Alert-engine view (monitor/alerts.py): every rule's current
+        pending/firing/ok state, most urgent first — the codified
+        "Default rule pack" table from docs/OBSERVABILITY.md, live."""
+        body = [self._nav("alerts")]
+        eng = self._alerts
+        states = eng.states() if eng is not None else []
+        if not states:
+            body.append(f"<p>{self._tr('no_alerts')}</p>")
+        else:
+            colors = {"firing": "#c62828", "pending": "#ef6c00",
+                      "ok": "#2e7d32"}
+            body.append("<table border='1' cellpadding='4'>"
+                        f"<tr><th>{self._tr('alert_rule')}</th>"
+                        f"<th>{self._tr('alert_state')}</th>"
+                        f"<th>{self._tr('alert_severity')}</th>"
+                        f"<th>{self._tr('alert_value')}</th>"
+                        f"<th>{self._tr('alert_desc')}</th></tr>")
+            for s in states:
+                state = str(s["state"])
+                val = s.get("value")
+                val = "-" if val is None else f"{float(val):.4g}"
+                body.append(
+                    f"<tr><td><code>{_html.escape(s['name'])}</code></td>"
+                    f"<td style='color:{colors.get(state, '#000')};"
+                    f"font-weight:bold'>"
+                    f"{_html.escape(self._tr('alert_' + state))}</td>"
+                    f"<td>{_html.escape(str(s['severity']))}</td>"
+                    f"<td>{val}</td>"
+                    f"<td>{_html.escape(str(s.get('description') or ''))}"
+                    f"</td></tr>")
+            body.append("</table>")
+        return self._page(self._tr("title.alerts"), "".join(body))
+
     def _tsne_html(self):
         body = [self._nav("tsne")]
         with self._module_lock:
@@ -719,6 +771,13 @@ class UIServer:
 
     def attach(self, storage: StatsStorage):
         self.storage = storage
+        return self
+
+    def attach_alerts(self, engine):
+        """Serve `/alerts` from this `monitor.alerts.AlertEngine` (the
+        states it also publishes as `alert_state` gauges on whatever
+        registry it was given)."""
+        self._alerts = engine
         return self
 
     def attach_registry(self, registry):

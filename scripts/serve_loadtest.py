@@ -26,7 +26,7 @@ like the training metrics:
    upfront admission-concurrency A/B;
 3. deliberate overload proving the SLO shedding path fires.
 
-Hard asserts (exit nonzero — verify.sh step [10/15] runs --smoke):
+Hard asserts (exit nonzero — verify.sh step [10/16] runs --smoke):
 
 - greedy parity: every stream bit-equal to its whole-batch
   `generate()` row — fp phase AND quantized phase (vs
@@ -124,7 +124,21 @@ def run_continuous(net, prompts, n_tokens, *, n_slots, n_blocks,
         "prefix_hits": eng.prefix_hits_total,
         "prefix_tokens_saved": eng.prefix_tokens_saved_total,
         "prefix_forks": eng.prefix_forks_total,
+        # goodput ledger: every dispatched token-position classified
+        # (conservation asserted downstream), plus per-stream TTFT
+        # decomposition from the request traces when tracing is on
+        "goodput": eng.goodput.snapshot(),
+        "goodput_conserved": eng.goodput.conserved(),
     }
+    from deeplearning4j_tpu.monitor.goodput import ttft_decomposition
+    parts = []
+    for s in streams:
+        tr = getattr(s, "trace", None)
+        if tr is not None:
+            dec = ttft_decomposition(tr)
+            if dec is not None:
+                parts.append(dec)
+    stats["ttft_parts"] = parts
     server.stop()
     if errors:
         detail = "; ".join(f"stream {i}: {e!r}" for i, e in errors[:5])
@@ -440,7 +454,7 @@ def run_fleet(args, *, metrics_check=False):
             f"successor must be warmed before the flip)")
 
     if metrics_check:
-        # the [12/15] acceptance surface: the fleet/registry gauge
+        # the [12/16] acceptance surface: the fleet/registry gauge
         # families must be live on /metrics
         import urllib.request
 
@@ -666,6 +680,35 @@ def run_shared_prefix(args, net, max_len):
     return block, failures
 
 
+def goodput_block(stats):
+    """`extras.goodput`: one server's token-position ledger as a BENCH
+    block.  `goodput_fraction` is the structurally-gated number
+    (bench.GATE_TOLERANCES — a silently-broken accounting path reports
+    ~0 or ~1.0 and gates); the waste split and the TTFT decomposition
+    ride along as diagnosis."""
+    from deeplearning4j_tpu.monitor.goodput import GOODPUT_CLASSES
+    gp = stats["goodput"]
+    total = max(1, gp["dispatched_total"])
+    block = {
+        "dispatched_token_positions": gp["dispatched_total"],
+        "goodput_fraction": round(gp["goodput_fraction"], 4),
+        "conserved": bool(stats["goodput_conserved"]),
+        "class_fractions": {c: round(gp[c] / total, 4)
+                            for c in GOODPUT_CLASSES},
+    }
+    parts = stats.get("ttft_parts") or []
+    if parts:
+        dec = {}
+        for key in ("queue_wait_s", "prefill_s", "first_emit_s"):
+            vals = np.asarray([p[key] for p in parts]) * 1e3
+            p50, p99 = np.percentile(vals, [50, 99])
+            dec[f"{key[:-2]}_p50_ms"] = round(float(p50), 3)
+            dec[f"{key[:-2]}_p99_ms"] = round(float(p99), 3)
+        block["ttft_decomposition_ms"] = dec
+        block["ttft_traced_streams"] = len(parts)
+    return block
+
+
 def run_overload(net, prompts, n_tokens, *, block_len):
     """Deliberate overload: a 1-slot, minimum-pool server with a tiny
     queue cap + SLO takes a burst it cannot possibly serve — the
@@ -690,7 +733,7 @@ def run_overload(net, prompts, n_tokens, *, block_len):
 
 
 def run_spec_smoke(args):
-    """verify.sh [14/15]: the speculative + shared-prefix phases alone
+    """verify.sh [14/16]: the speculative + shared-prefix phases alone
     (hard asserts inside each), then proof that compare_bench gates
     the two new ledger metrics — including the structural
     stale-fallback band (sharing silently disabled reports ~1.0
@@ -759,7 +802,7 @@ def run_spec_smoke(args):
 
 
 def run_trace_smoke(args):
-    """verify.sh [15/15]: the observability request plane end to end —
+    """verify.sh [15/16]: the observability request plane end to end —
     >= 64 routed requests each leaving a finished `RequestTrace` with
     monotonic queued -> prefill -> decode phase stamps, a two-objective
     SLO fleet driving BOTH good and bad counters non-zero, a mid-run
@@ -956,6 +999,204 @@ def run_trace_smoke(args):
     return 0
 
 
+def run_alert_smoke(args):
+    """verify.sh [16/16]: the alert engine + goodput ledger end to end —
+    an injected overload drives `serving_shed_total` up and the
+    shed-growth rule through firing -> resolved (after the drain), a
+    vanished federation worker fires the absence rule and re-publishing
+    resolves it, the overload server's goodput ledger conserves every
+    dispatched token-position, `/alerts` serves the rule table,
+    `serving_goodput_fraction` + `alert_state` are live on `/metrics`,
+    every transition lands in a flight-recorder dump, and compare_bench
+    structurally gates a broken goodput fraction."""
+    import urllib.request
+
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.bench import compare_bench
+    from deeplearning4j_tpu.monitor import (AlertEngine, MetricsRegistry,
+                                            Tracer, default_rule_pack)
+    from deeplearning4j_tpu.monitor.federate import (
+        FederationCollector, FederationPublisher, MetricsAggregator)
+    from deeplearning4j_tpu.monitor.flightrec import FlightRecorder
+    from deeplearning4j_tpu.monitor.goodput import ttft_decomposition
+    from deeplearning4j_tpu.serving import GenerationServer, ShedError
+    from deeplearning4j_tpu.streaming.ndarray import LocalQueueTransport
+    from deeplearning4j_tpu.ui import UIServer
+
+    reg, tracer = MetricsRegistry(), Tracer()
+    monitor.enable(registry=reg, tracer=tracer)
+    failures = []
+    n_tok, prompt_len, block_len = 16, 4, 4
+
+    # ---- federation plane: the serving registry + one training worker
+    # behind an aggregator — the alert engine's snapshot AND liveness
+    # source (worker-vanished needs the worker labels)
+    train_reg = MetricsRegistry()
+    train_reg.counter("train_steps_total",
+                      "optimizer steps (alert-smoke stand-in)").inc(3)
+    transport = LocalQueueTransport()
+    agg = MetricsAggregator()
+    collector = FederationCollector(transport, "metrics", aggregator=agg)
+    pubs = [FederationPublisher(transport, "metrics", w, registry=r)
+            for w, r in (("serve0", reg), ("train0", train_reg))]
+
+    def republish():
+        for p in pubs:
+            p.publish_once()
+        collector.poll()
+
+    recorder = FlightRecorder()
+    engine = AlertEngine(agg, default_rule_pack(shed_rate_per_s=0.01),
+                         recorder=recorder, registry=reg)
+
+    def state_of(name, states):
+        return next(s["state"] for s in states if s["name"] == name)
+
+    # t=0: prime the delta-rate cursors on a healthy plane — nothing
+    # may fire before the fault is injected
+    republish()
+    states = engine.evaluate(now=0.0)
+    if state_of("shed-growth", states) != "ok":
+        failures.append("shed-growth fired before the overload")
+    if state_of("worker-vanished", states) != "ok":
+        failures.append("worker-vanished fired with both workers live")
+
+    # ---- inject the overload: a 1-slot server with a tiny queue cap +
+    # impossible TTFT SLO takes a 16-stream burst (run_overload shape)
+    net = build_net(args.vocab, 16, 1, args.n_heads,
+                    prompt_len + n_tok + 4)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, args.vocab, prompt_len) for _ in range(4)]
+    nb = -(-(prompt_len + n_tok) // block_len) + 1
+    server = GenerationServer(net, n_slots=1, n_blocks=nb,
+                              block_len=block_len, max_queue=2,
+                              slo_ttft_s=1e-3)
+    # warmed on purpose: the compile grid routes into the ledger's
+    # `warmup` class, so the fraction is strictly inside (0, 1) and the
+    # mode bracket itself is exercised
+    server.warmup(prompt_len, n_tok).start()
+    streams = [server.generate_async(prompts[i % 4], n_tok)
+               for i in range(16)]
+    shed = served = 0
+    parts = []
+    for s in streams:
+        try:
+            s.result(timeout=600)
+            served += 1
+            tr = getattr(s, "trace", None)
+            dec = ttft_decomposition(tr) if tr is not None else None
+            if dec is not None:
+                parts.append(dec)
+        except ShedError:
+            shed += 1
+    ledger = server.engine.goodput
+    server.stop()
+    if shed < 1:
+        failures.append("overload shed nothing — no fault to alert on")
+    if served < 1 or not parts:
+        failures.append("no served stream left a decomposable trace")
+
+    # ---- the ledger survived the overload conserving every position
+    snap_gp = ledger.snapshot()
+    if not ledger.conserved():
+        failures.append(f"goodput ledger broke conservation: {snap_gp}")
+    if not 0.0 < snap_gp["goodput_fraction"] < 1.0:
+        failures.append(f"overload goodput fraction degenerate: "
+                        f"{snap_gp['goodput_fraction']}")
+
+    # t=10: the shed burst is visible as a counter rate -> firing
+    republish()
+    states = engine.evaluate(now=10.0)
+    if state_of("shed-growth", states) != "firing":
+        failures.append(f"shed-growth did not fire after the overload "
+                        f"(states: {states})")
+    # t=20: drained and idle -> the rate falls to zero -> resolved
+    republish()
+    states = engine.evaluate(now=20.0)
+    if state_of("shed-growth", states) != "ok":
+        failures.append("shed-growth did not resolve after the drain")
+
+    # ---- worker liveness: train0 vanishes from the scrape, fires;
+    # re-publishing it resolves
+    agg.drop_worker("train0")
+    states = engine.evaluate(now=30.0)
+    if state_of("worker-vanished", states) != "firing":
+        failures.append("worker-vanished did not fire on a dropped "
+                        "worker label")
+    republish()
+    states = engine.evaluate(now=40.0)
+    if state_of("worker-vanished", states) != "ok":
+        failures.append("worker-vanished did not resolve on re-publish")
+
+    # ---- every transition landed in the flight recorder
+    for kind, want in (("shed_growth", {"firing", "resolved"}),
+                       ("worker_vanished", {"firing", "resolved"})):
+        got = {e.get("state") for e in recorder.events(kind=kind)}
+        if not want <= got:
+            failures.append(f"{kind} transitions {sorted(got)} missing "
+                            f"{sorted(want - got)} in the recorder")
+    dump = recorder.dump()
+    for needle in ("shed_growth", "worker_vanished", "resolved"):
+        if needle not in dump:
+            failures.append(f"{needle} missing from the flight-recorder "
+                            f"dump")
+
+    # ---- the acceptance surface: /alerts + the goodput/alert families
+    # on /metrics
+    ui = UIServer(registry=reg).start()
+    ui.attach_alerts(engine)
+    try:
+        base = f"http://127.0.0.1:{ui.port}"
+        body = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=10).read().decode()
+        for fam in ("serving_goodput_fraction", "serving_tokens_useful",
+                    "serving_shed_total", "alert_state"):
+            if fam not in body:
+                failures.append(f"{fam} missing from /metrics")
+        page = urllib.request.urlopen(f"{base}/alerts",
+                                      timeout=10).read().decode()
+        for needle in ("shed-growth", "worker-vanished"):
+            if needle not in page:
+                failures.append(f"{needle} missing from /alerts")
+        aj = json.loads(urllib.request.urlopen(
+            f"{base}/alerts?format=json", timeout=10).read().decode())
+        if not aj.get("attached") or len(aj.get("alerts", [])) < 8:
+            failures.append(f"/alerts json incomplete: {aj}")
+    finally:
+        ui.stop()
+
+    # ---- compare_bench structurally gates a broken accounting path
+    rec = {"platform": "cpu-sandbox", "value": 1.0,
+           "extras": {"goodput": goodput_block(
+               {"goodput": snap_gp,
+                "goodput_conserved": ledger.conserved(),
+                "ttft_parts": parts})}}
+    print(json.dumps(rec["extras"], indent=2, sort_keys=True))
+    v = compare_bench(rec, rec)
+    if v["status"] != "pass":
+        failures.append(f"identical goodput records did not pass: {v}")
+    bad = json.loads(json.dumps(rec))
+    bad["extras"]["goodput"]["goodput_fraction"] = \
+        snap_gp["goodput_fraction"] * 0.5
+    v = compare_bench(bad, rec)
+    if v["status"] != "regression" or not any(
+            r["metric"] == "serving_goodput_fraction"
+            for r in v.get("regressions", [])):
+        failures.append(f"broken goodput fraction did not gate: {v}")
+
+    monitor.disable()
+    if failures:
+        for f_ in failures:
+            print(f"FAIL: {f_}", file=sys.stderr)
+        return 1
+    print(f"alert+goodput smoke OK (shed {shed}/{shed + served} fired "
+          f"and resolved shed-growth, worker-vanished fired+resolved, "
+          f"goodput {snap_gp['goodput_fraction']:.3f} over "
+          f"{snap_gp['dispatched_total']} positions conserved, "
+          f"/alerts + gauges live, transitions in the flight dump)")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--streams", type=int, default=128,
@@ -1002,7 +1243,7 @@ def main(argv=None):
                          "periods so the proposer can match inside "
                          "the prompt")
     ap.add_argument("--spec-smoke", action="store_true",
-                    help="verify.sh [14/15]: ONLY the speculative + "
+                    help="verify.sh [14/16]: ONLY the speculative + "
                          "shared-prefix phases at smoke scale, plus "
                          "compare_bench self-gates and the /metrics "
                          "families check")
@@ -1022,14 +1263,20 @@ def main(argv=None):
     ap.add_argument("--skip-fleet", action="store_true",
                     help="run only the single-server phases 1-3")
     ap.add_argument("--fleet-smoke", action="store_true",
-                    help="verify.sh [12/15]: ONLY the fleet phase at "
+                    help="verify.sh [12/16]: ONLY the fleet phase at "
                          "smoke scale, plus the /metrics + /serving "
                          "acceptance checks")
     ap.add_argument("--trace-smoke", action="store_true",
-                    help="verify.sh [15/15]: ONLY the observability "
+                    help="verify.sh [15/16]: ONLY the observability "
                          "smoke — request-lifecycle traces, SLO "
                          "burn-rate, flight-recorder dump, federated "
                          "/metrics scrape")
+    ap.add_argument("--alert-smoke", action="store_true",
+                    help="verify.sh [16/16]: ONLY the alert-engine + "
+                         "goodput smoke — overload-driven rule "
+                         "firing/resolution, ledger conservation, "
+                         "/alerts + /metrics surfaces, flight-recorder "
+                         "transitions")
     ap.add_argument("--out", default="BENCH_serving.json")
     args = ap.parse_args(argv)
     if args.smoke or args.fleet_smoke or args.trace_smoke:
@@ -1039,6 +1286,8 @@ def main(argv=None):
         args.fleet_min_sustained = 128
     if args.trace_smoke:
         return run_trace_smoke(args)
+    if args.alert_smoke:
+        return run_alert_smoke(args)
     if args.fleet_smoke:
         from deeplearning4j_tpu import monitor
         monitor.enable()
@@ -1225,6 +1474,7 @@ def main(argv=None):
     }
     record["extras"]["serving_speculative"] = spec_block
     record["extras"]["serving_prefix"] = prefix_block
+    record["extras"]["goodput"] = goodput_block(stats1)
     if fleet_block:
         record["extras"]["serving_fleet"] = fleet_block
     with open(args.out, "w") as f:
@@ -1245,6 +1495,13 @@ def main(argv=None):
           f"{q['admitted_incremental']} vs {q['admitted_upfront']} "
           f"upfront | parity {q['greedy_parity_vs_quantized_generate']}")
     print(f"overload shed {shed}/{shed + served}")
+    gpb = record["extras"]["goodput"]
+    cf = gpb["class_fractions"]
+    print(f"goodput: {gpb['goodput_fraction']} useful over "
+          f"{gpb['dispatched_token_positions']} dispatched positions "
+          f"(pad {cf['pad_waste']}, warmup {cf['warmup']}, preempt "
+          f"{cf['preempt_discard']}) | TTFT split "
+          f"{gpb.get('ttft_decomposition_ms', {})}")
     sp, pf = spec_block, prefix_block
     print(f"phase5 (speculative k={sp['spec_k']}): "
           f"{sp['tokens_per_sec']} tok/s vs "
@@ -1312,6 +1569,14 @@ def main(argv=None):
         failures.append("mixed phase degenerated to one prompt length")
     if shed < 1:
         failures.append("overload phase shed nothing")
+    if not gpb["conserved"]:
+        failures.append("goodput ledger broke conservation: class sum "
+                        "!= dispatched total")
+    if not 0.0 < gpb["goodput_fraction"] < 1.0:
+        failures.append(
+            f"goodput fraction {gpb['goodput_fraction']} is degenerate "
+            f"— accounting path broken (~0: ledger never fed; ~1: "
+            f"padding/warmup never counted)")
     failures.extend(fleet_failures)
     failures.extend(spec_failures)
     failures.extend(prefix_failures)

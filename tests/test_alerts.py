@@ -1,0 +1,345 @@
+"""Declarative alert engine (ISSUE: observability tentpole).
+
+Contracts:
+
+- the pending→firing→resolved state machine honors `for_s` hysteresis:
+  a flap that un-breaches inside the window never fires; a sustained
+  breach fires exactly once;
+- absence rules fire when a previously-seen worker label vanishes from
+  a federated `MetricsAggregator` (or its export goes stale), and
+  resolve when it returns;
+- EVERY transition — including the resolution — reaches the flight
+  recorder under the rule's own event kind;
+- `delta_rate`'s `unless_metric` suppresses a breach that a guard
+  counter explains (a swap during a rollout is not an incident);
+- `burn_rate` averages engine-held history per window, ALL windows
+  breaching;
+- the default rule pack evaluates clean (all ok) on a healthy registry;
+- rule states publish as `alert_state{alert=,severity=}` gauges.
+"""
+
+import pytest
+
+from deeplearning4j_tpu.monitor.alerts import (
+    ALERT_STATE_GAUGE,
+    AlertEngine,
+    AlertRule,
+    default_rule_pack,
+)
+from deeplearning4j_tpu.monitor.federate import MetricsAggregator
+from deeplearning4j_tpu.monitor.flightrec import FlightRecorder
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+
+
+def gauge_snap(value, metric="m", **labels):
+    return {metric: {"type": "gauge", "help": "",
+                     "values": [{"labels": labels, "value": value}]}}
+
+
+def make_engine(source, *rules, registry=None):
+    rec = FlightRecorder()
+    eng = AlertEngine(source, rules, recorder=rec,
+                      registry=registry or MetricsRegistry())
+    return eng, rec
+
+
+def state_of(states, name):
+    return next(s["state"] for s in states if s["name"] == name)
+
+
+# ==================================================== threshold + for_s
+class TestThresholdHysteresis:
+    def test_flap_inside_window_never_fires(self):
+        box = {"v": 0.0}
+        eng, rec = make_engine(
+            lambda: gauge_snap(box["v"]),
+            AlertRule(name="hot", kind="threshold", metric="m", op=">",
+                      value=10.0, for_s=5.0, event_kind="hot_ev"))
+        assert state_of(eng.evaluate(now=0.0), "hot") == "ok"
+        box["v"] = 99.0
+        assert state_of(eng.evaluate(now=1.0), "hot") == "pending"
+        box["v"] = 0.0                        # un-breach inside for_s
+        assert state_of(eng.evaluate(now=3.0), "hot") == "ok"
+        states = {e["state"] for e in rec.events(kind="hot_ev")}
+        assert "firing" not in states
+        assert "resolved" not in states       # a flap is not an incident
+
+    def test_sustained_breach_fires_then_resolves(self):
+        box = {"v": 99.0}
+        eng, rec = make_engine(
+            lambda: gauge_snap(box["v"]),
+            AlertRule(name="hot", kind="threshold", metric="m", op=">",
+                      value=10.0, for_s=5.0, severity="page",
+                      event_kind="hot_ev"))
+        assert state_of(eng.evaluate(now=0.0), "hot") == "pending"
+        assert state_of(eng.evaluate(now=2.0), "hot") == "pending"
+        states = eng.evaluate(now=6.0)        # held past for_s
+        assert state_of(states, "hot") == "firing"
+        assert eng.firing()[0]["name"] == "hot"
+        box["v"] = 0.0
+        assert state_of(eng.evaluate(now=8.0), "hot") == "ok"
+        labels = [e["state"] for e in rec.events(kind="hot_ev")]
+        assert labels == ["pending", "firing", "resolved"]
+        resolved = rec.events(kind="hot_ev")[-1]
+        assert resolved["alert"] == "hot"
+        assert resolved["severity"] == "page"
+
+    def test_missing_family_never_breaches(self):
+        eng, _ = make_engine(
+            lambda: {},
+            AlertRule(name="hot", kind="threshold", metric="m", op=">",
+                      value=10.0))
+        assert state_of(eng.evaluate(now=0.0), "hot") == "ok"
+
+    def test_label_filter_scopes_series(self):
+        snap = {"m": {"type": "gauge", "help": "", "values": [
+            {"labels": {"model": "a"}, "value": 99.0},
+            {"labels": {"model": "b"}, "value": 1.0}]}}
+        eng, _ = make_engine(
+            lambda: snap,
+            AlertRule(name="a-only", kind="threshold", metric="m",
+                      labels={"model": "b"}, op=">", value=10.0))
+        assert state_of(eng.evaluate(now=0.0), "a-only") == "ok"
+
+
+# ======================================================= worker absence
+class TestWorkerAbsence:
+    def rule(self, **kw):
+        return AlertRule(name="worker-vanished", kind="absence",
+                         metric=None, severity="page",
+                         event_kind="worker_vanished", **kw)
+
+    def test_vanished_worker_fires_and_return_resolves(self):
+        agg = MetricsAggregator()
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.counter("a_total", "a").inc()
+        r2.counter("b_total", "b").inc()
+        agg.ingest_registry(r1, "serve0")
+        agg.ingest_registry(r2, "train0")
+        eng, rec = make_engine(agg, self.rule())
+        assert state_of(eng.evaluate(now=0.0),
+                        "worker-vanished") == "ok"
+        agg.drop_worker("train0")
+        states = eng.evaluate(now=1.0)
+        assert state_of(states, "worker-vanished") == "firing"
+        st = next(s for s in states if s["name"] == "worker-vanished")
+        assert st["context"]["missing"] == ["train0"]
+        agg.ingest_registry(r2, "train0")     # the publisher came back
+        assert state_of(eng.evaluate(now=2.0),
+                        "worker-vanished") == "ok"
+        labels = [e["state"] for e in rec.events(kind="worker_vanished")]
+        assert labels == ["firing", "resolved"]
+
+    def test_stale_export_fires(self):
+        agg = MetricsAggregator()
+        r1 = MetricsRegistry()
+        r1.counter("a_total", "a").inc()
+        agg.ingest_registry(r1, "serve0")
+        eng, _ = make_engine(agg, self.rule(stale_s=0.0))
+        states = eng.evaluate(now=0.0)        # any age > 0.0 is stale
+        assert state_of(states, "worker-vanished") == "firing"
+        st = next(s for s in states if s["name"] == "worker-vanished")
+        assert st["context"]["stale"] == ["serve0"]
+
+    def test_plain_registry_source_is_inert(self):
+        # worker liveness needs an aggregator; against a bare registry
+        # the rule simply never matches
+        eng, _ = make_engine(MetricsRegistry(), self.rule())
+        assert state_of(eng.evaluate(now=0.0),
+                        "worker-vanished") == "ok"
+
+
+# =================================================== series absence
+class TestSeriesAbsence:
+    def test_vanished_series_fires(self):
+        box = {"snap": {"m": {"type": "gauge", "help": "", "values": [
+            {"labels": {"model": "a"}, "value": 1.0},
+            {"labels": {"model": "b"}, "value": 1.0}]}}}
+        eng, _ = make_engine(
+            lambda: box["snap"],
+            AlertRule(name="gone", kind="absence", metric="m"))
+        assert state_of(eng.evaluate(now=0.0), "gone") == "ok"
+        box["snap"] = gauge_snap(1.0, model="a")
+        states = eng.evaluate(now=1.0)
+        assert state_of(states, "gone") == "firing"
+        st = next(s for s in states if s["name"] == "gone")
+        assert st["context"]["missing"] == [{"model": "b"}]
+
+
+# ========================================================== delta_rate
+class TestDeltaRate:
+    def counter_snap(self, shed, published=None):
+        snap = {"serving_shed_total": {
+            "type": "counter", "help": "",
+            "values": [{"labels": {}, "value": shed}]}}
+        if published is not None:
+            snap["registry_published_total"] = {
+                "type": "counter", "help": "",
+                "values": [{"labels": {}, "value": published}]}
+        return snap
+
+    def test_rate_fires_and_quiescence_resolves(self):
+        box = {"shed": 0.0}
+        eng, rec = make_engine(
+            lambda: self.counter_snap(box["shed"]),
+            AlertRule(name="shed-growth", kind="delta_rate",
+                      metric="serving_shed_total", op=">", value=1.0,
+                      aggregate="sum", event_kind="shed_growth"))
+        eng.evaluate(now=0.0)                 # primes the cursor
+        box["shed"] = 100.0                   # 10/s over the interval
+        assert state_of(eng.evaluate(now=10.0),
+                        "shed-growth") == "firing"
+        assert state_of(eng.evaluate(now=20.0),
+                        "shed-growth") == "ok"
+        labels = [e["state"] for e in rec.events(kind="shed_growth")]
+        assert labels == ["firing", "resolved"]
+
+    def test_counter_reset_never_negative_rate(self):
+        box = {"shed": 100.0}
+        eng, _ = make_engine(
+            lambda: self.counter_snap(box["shed"]),
+            AlertRule(name="shed-growth", kind="delta_rate",
+                      metric="serving_shed_total", op=">", value=-1.0))
+        eng.evaluate(now=0.0)
+        box["shed"] = 0.0                     # process restart
+        states = eng.evaluate(now=10.0)
+        st = next(s for s in states if s["name"] == "shed-growth")
+        assert st["value"] == 0.0             # clamped, not -10/s
+
+    def test_unless_metric_suppresses_rollout(self):
+        box = {"swaps": 0.0, "pub": 0.0}
+
+        def snap():
+            return {
+                "fleet_swaps_total": {
+                    "type": "counter", "help": "",
+                    "values": [{"labels": {}, "value": box["swaps"]}]},
+                "registry_published_total": {
+                    "type": "counter", "help": "",
+                    "values": [{"labels": {}, "value": box["pub"]}]}}
+
+        eng, _ = make_engine(
+            snap,
+            AlertRule(name="swap-no-pub", kind="delta_rate",
+                      metric="fleet_swaps_total", op=">", value=0.0,
+                      unless_metric="registry_published_total"))
+        eng.evaluate(now=0.0)
+        box["swaps"] += 1                     # swap WITH a publish:
+        box["pub"] += 1                       # a rollout, not an alert
+        assert state_of(eng.evaluate(now=10.0), "swap-no-pub") == "ok"
+        box["swaps"] += 1                     # swap with NO publish
+        assert state_of(eng.evaluate(now=20.0),
+                        "swap-no-pub") == "firing"
+
+
+# =========================================================== burn_rate
+class TestBurnRate:
+    def test_windowed_average_fires_and_decays(self):
+        box = {"v": 20.0}
+        eng, _ = make_engine(
+            lambda: gauge_snap(box["v"], metric="slo_burn_rate"),
+            AlertRule(name="slo-burn", kind="burn_rate",
+                      metric="slo_burn_rate", op=">",
+                      windows=((60.0, 14.0),)))
+        assert state_of(eng.evaluate(now=0.0), "slo-burn") == "firing"
+        box["v"] = 0.0                        # budget stops burning:
+        eng.evaluate(now=20.0)                # avg (20+0)/2 = 10 < 14
+        assert state_of(eng.evaluate(now=40.0), "slo-burn") == "ok"
+
+    def test_all_windows_must_breach(self):
+        box = {"v": 20.0}
+        eng, _ = make_engine(
+            lambda: gauge_snap(box["v"], metric="slo_burn_rate"),
+            AlertRule(name="slo-burn", kind="burn_rate",
+                      metric="slo_burn_rate", op=">",
+                      windows=((60.0, 14.0), (60.0, 100.0))))
+        # fast window breaches (20 > 14) but the second bound (100)
+        # does not — no page
+        assert state_of(eng.evaluate(now=0.0), "slo-burn") == "ok"
+
+
+# ===================================================== rule validation
+class TestRuleValidation:
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            AlertRule(name="x", kind="vibes", metric="m")
+
+    def test_metric_required_outside_absence(self):
+        with pytest.raises(ValueError, match="metric"):
+            AlertRule(name="x", kind="threshold")
+
+    def test_burn_rate_needs_windows(self):
+        with pytest.raises(ValueError, match="windows"):
+            AlertRule(name="x", kind="burn_rate", metric="m")
+
+    def test_duplicate_rule_name_rejected(self):
+        eng, _ = make_engine(lambda: {})
+        eng.add_rule(AlertRule(name="x", kind="threshold", metric="m"))
+        with pytest.raises(ValueError, match="duplicate"):
+            eng.add_rule(AlertRule(name="x", kind="threshold",
+                                   metric="m"))
+
+
+# ================================================== default rule pack
+class TestDefaultRulePack:
+    def healthy_registry(self):
+        reg = MetricsRegistry()
+        reg.gauge("checkpoint_last_age_seconds").set(4.0)
+        reg.gauge("elastic_live_processes").set(4.0)
+        reg.gauge("streaming_watermark_age_seconds").set(2.0)
+        reg.gauge("slo_burn_rate").set(0.2)
+        reg.counter("serving_shed_total", "sheds").inc(0)
+        reg.counter("registry_resolve_fallback_total", "fallbacks")
+        reg.counter("fleet_swaps_total", "swaps")
+        reg.counter("registry_published_total", "publishes").inc(2)
+        return reg
+
+    def test_pack_covers_the_eight_documented_shapes(self):
+        pack = default_rule_pack()
+        assert sorted(r.name for r in pack) == [
+            "checkpoint-staleness", "elastic-shrink",
+            "registry-fallback", "shed-growth", "slo-burn",
+            "swap-without-publish", "watermark-lag", "worker-vanished"]
+        assert len({r.event_kind for r in pack}) == len(pack)
+
+    def test_pack_clean_on_healthy_registry(self):
+        eng, rec = make_engine(self.healthy_registry(),
+                               *default_rule_pack())
+        # two passes so every delta-rate cursor is primed and evaluated
+        eng.evaluate(now=0.0)
+        states = eng.evaluate(now=10.0)
+        assert all(s["state"] == "ok" for s in states), states
+        assert rec.events() == []             # zero transitions
+
+    def test_pack_fires_on_stale_checkpoint(self):
+        reg = self.healthy_registry()
+        reg.gauge("checkpoint_last_age_seconds").set(9999.0)
+        eng, rec = make_engine(reg, *default_rule_pack())
+        states = eng.evaluate(now=0.0)
+        assert state_of(states, "checkpoint-staleness") == "firing"
+        assert rec.events(kind="checkpoint_stale")
+
+
+# ====================================================== gauge publish
+class TestStateGauges:
+    def test_states_published_to_registry(self):
+        out = MetricsRegistry()
+        box = {"v": 99.0}
+        eng = AlertEngine(
+            lambda: gauge_snap(box["v"]),
+            [AlertRule(name="hot", kind="threshold", metric="m",
+                       op=">", value=10.0, severity="page")],
+            recorder=FlightRecorder(), registry=out)
+        eng.evaluate(now=0.0)
+        vals = out.snapshot()[ALERT_STATE_GAUGE]["values"]
+        entry = next(v for v in vals
+                     if v["labels"] == {"alert": "hot",
+                                        "severity": "page"})
+        assert entry["value"] == 2.0          # firing
+        box["v"] = 0.0
+        eng.evaluate(now=1.0)
+        vals = out.snapshot()[ALERT_STATE_GAUGE]["values"]
+        entry = next(v for v in vals
+                     if v["labels"] == {"alert": "hot",
+                                        "severity": "page"})
+        assert entry["value"] == 0.0          # back to ok

@@ -1,0 +1,276 @@
+"""Token-goodput ledger + TTFT decomposition (ISSUE: observability).
+
+Contracts:
+
+- conservation BY CONSTRUCTION: ``sum(classes) == dispatched_total`` at
+  every instant, across per-class accounting AND warmup/drain mode
+  routing — test-enforced over real serving runs;
+- a warmed server's run lands strictly inside (0, 1): warmup work is on
+  the books (never in ``useful``), served tokens are, and the registry
+  mirror carries the same totals without ever seeing a negative delta;
+- goodput accounting adds ZERO device syncs (`block_until_ready` count
+  identical monitored vs unmonitored — the request-tracing contract);
+- `ttft_decomposition` splits TTFT into queue-wait / prefill /
+  first-emit from host stamps alone; a shed request (no prefill phase)
+  yields None.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import MetricsRegistry, Tracer
+from deeplearning4j_tpu.monitor.goodput import (
+    GOODPUT_CLASSES,
+    GOODPUT_COUNTER_FAMILIES,
+    GoodputLedger,
+    ttft_decomposition,
+)
+from deeplearning4j_tpu.serving import GenerationServer
+from deeplearning4j_tpu.zoo.transformer import TransformerLM, generate
+
+V, D, HEADS, LAYERS, MAXLEN = 23, 16, 4, 2, 32
+BL = 4
+
+
+@pytest.fixture(scope="module")
+def net():
+    return TransformerLM(vocab_size=V, d_model=D, n_layers=LAYERS,
+                         n_heads=HEADS, max_len=MAXLEN, seed=3).init()
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return np.random.default_rng(5).integers(0, V, (6, 3))
+
+
+@pytest.fixture
+def mon():
+    reg, tr = MetricsRegistry(), Tracer()
+    monitor.enable(registry=reg, tracer=tr)
+    yield reg, tr
+    monitor.disable()
+    monitor._STATE.registry = monitor.GLOBAL_REGISTRY
+    monitor._STATE.tracer = monitor.GLOBAL_TRACER
+
+
+def _serve(srv, prompts, n=6, n_tokens=6):
+    streams = [srv.generate_async(prompts[r % len(prompts)], n_tokens)
+               for r in range(n)]
+    toks = np.stack([s.result(timeout=300) for s in streams])
+    return streams, toks
+
+
+# ============================================================== ledger
+class TestLedgerUnit:
+    def test_conservation_by_construction(self):
+        lg = GoodputLedger()
+        lg.account(useful=5, pad_waste=3)
+        lg.account(useful=2, spec_rejected=4, preempt_discard=1)
+        assert lg.dispatched_total == 15
+        assert sum(lg.classes.values()) == lg.dispatched_total
+        assert lg.conserved()
+        assert lg.classes["useful"] == 7
+        assert lg.goodput_fraction() == pytest.approx(7 / 15)
+
+    def test_mode_routes_everything(self):
+        lg = GoodputLedger()
+        lg.set_mode("warmup")
+        lg.account(useful=8, pad_waste=2)
+        assert lg.classes["warmup"] == 10 and lg.classes["useful"] == 0
+        lg.set_mode(None)
+        lg.account(useful=5)
+        lg.set_mode("drain")
+        lg.account(useful=3, pad_waste=1)
+        assert lg.classes["drain"] == 4
+        assert lg.conserved()
+        # drain + warmup never count as useful
+        assert lg.goodput_fraction() == pytest.approx(5 / 19)
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            GoodputLedger().set_mode("lunch")
+
+    def test_negative_class_rejected(self):
+        lg = GoodputLedger()
+        with pytest.raises(ValueError, match="non-negative"):
+            lg.account(useful=5, pad_waste=-1)
+
+    def test_zero_total_is_noop_and_fraction_honest_zero(self):
+        lg = GoodputLedger()
+        lg.account()                 # nothing dispatched, nothing booked
+        assert lg.dispatched_total == 0
+        # honest zero, never a flattering 1.0
+        assert lg.goodput_fraction() == 0.0
+
+    def test_snapshot_carries_totals(self):
+        lg = GoodputLedger()
+        lg.account(useful=4, pad_waste=4)
+        snap = lg.snapshot()
+        assert snap["dispatched_total"] == 8
+        assert snap["goodput_fraction"] == pytest.approx(0.5)
+        for c in GOODPUT_CLASSES:
+            assert c in snap
+
+
+# ===================================================== serving runs
+class TestServingConservation:
+    def test_warmed_run_conserves_and_mirrors(self, mon, net, prompts):
+        reg, _ = mon
+        srv = GenerationServer(net, n_slots=2, n_blocks=16, block_len=BL)
+        srv.warmup(3, 6).start()
+        try:
+            _serve(srv, prompts)
+        finally:
+            srv.stop()
+        lg = srv.engine.goodput
+        assert lg.conserved()
+        assert lg.classes["warmup"] > 0          # the compile grid
+        assert lg.classes["useful"] > 0          # the served tokens
+        assert lg.mode is None                   # bracket restored
+        assert 0.0 < lg.goodput_fraction() < 1.0
+        # the registry mirror carries the same totals (delta-published,
+        # monotone — no negative increments possible)
+        snap = reg.snapshot()
+        for cls, fam in GOODPUT_COUNTER_FAMILIES.items():
+            vals = snap.get(fam, {"values": []})["values"]
+            mirrored = sum(v["value"] for v in vals)
+            assert mirrored == lg.classes[cls], (cls, mirrored)
+        frac = snap["serving_goodput_fraction"]["values"][0]["value"]
+        assert frac == pytest.approx(lg.goodput_fraction())
+
+    def test_unmonitored_run_still_accounts(self, net, prompts):
+        assert not monitor.is_enabled()
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            _serve(srv, prompts)
+        finally:
+            srv.stop()
+        lg = srv.engine.goodput
+        assert lg.conserved() and lg.dispatched_total > 0
+        assert lg.classes["useful"] > 0
+
+    def test_speculative_run_conserves(self, net):
+        prompt = np.asarray([1, 2, 3, 1, 2, 3], np.int64)
+        srv = GenerationServer(net, n_slots=1, n_blocks=16,
+                               block_len=BL, speculative=4).start()
+        try:
+            srv.generate_async(prompt, 20).result(timeout=300)
+            proposed = srv.engine.spec_proposed_total
+            accepted = srv.engine.spec_accepted_total
+        finally:
+            srv.stop()
+        lg = srv.engine.goodput
+        assert lg.conserved()
+        if proposed > accepted:      # any rejection must be on the books
+            assert lg.classes["spec_rejected"] > 0
+
+    def test_drain_flips_mode(self, net, prompts):
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            _serve(srv, prompts, n=2)
+            assert srv.drain(timeout=60)
+            assert srv.engine.goodput.mode == "drain"
+            assert srv.engine.goodput.conserved()
+        finally:
+            srv.stop()
+
+
+# =============================================== zero-device-sync
+class TestGoodputSyncContract:
+    """The ledger is host ints fed from values the scheduler already
+    materialized: the monitored run (ledger mirror + gauges live)
+    performs exactly the device syncs the unmonitored run does."""
+
+    @pytest.fixture
+    def sync_counter(self, monkeypatch):
+        calls = {"n": 0}
+        real = jax.block_until_ready
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(jax, "block_until_ready", counting)
+        return calls
+
+    def test_monitored_equals_unmonitored_syncs(self, sync_counter, net,
+                                                prompts):
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            _serve(srv, prompts)
+        finally:
+            srv.stop()
+        off = sync_counter["n"]
+        ledger_off = srv.engine.goodput
+        monitor.enable(registry=MetricsRegistry(), tracer=Tracer())
+        try:
+            srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                                   block_len=BL).start()
+            try:
+                _serve(srv, prompts)
+            finally:
+                srv.stop()
+        finally:
+            monitor.disable()
+            monitor._STATE.registry = monitor.GLOBAL_REGISTRY
+            monitor._STATE.tracer = monitor.GLOBAL_TRACER
+        assert sync_counter["n"] == 2 * off
+        # pad_waste rides wave composition (thread-timing dependent),
+        # but the USEFUL work — prompts prefilled + tokens kept — is
+        # identical, and both runs conserve
+        assert srv.engine.goodput.classes["useful"] \
+            == ledger_off.classes["useful"]
+        assert srv.engine.goodput.conserved() and ledger_off.conserved()
+
+
+# ========================================== TTFT decomposition
+class TestTTFTDecomposition:
+    def test_splits_from_host_stamps(self):
+        tr = {"phases": [
+                  {"name": "queued", "t0": 1.0, "t1": 1.5, "args": {}},
+                  {"name": "prefill", "t0": 1.5, "t1": 1.8, "args": {}},
+                  {"name": "decode", "t0": 1.8, "t1": 2.0, "args": {}}],
+              "meta": {"ttft_s": 1.0}}
+        dec = ttft_decomposition(tr)
+        assert dec["queue_wait_s"] == pytest.approx(0.5)
+        assert dec["prefill_s"] == pytest.approx(0.3)
+        assert dec["first_emit_s"] == pytest.approx(0.2)
+        assert dec["ttft_s"] == pytest.approx(1.0)
+
+    def test_shed_trace_yields_none(self):
+        tr = {"phases": [{"name": "queued", "t0": 0.0, "t1": 0.2,
+                          "args": {}}],
+              "meta": {}}
+        assert ttft_decomposition(tr) is None
+
+    def test_missing_ttft_annotation_degrades(self):
+        tr = {"phases": [
+                  {"name": "queued", "t0": 0.0, "t1": 0.4, "args": {}},
+                  {"name": "prefill", "t0": 0.4, "t1": 0.6, "args": {}}],
+              "meta": {}}
+        dec = ttft_decomposition(tr)
+        assert dec["first_emit_s"] == 0.0
+        assert dec["ttft_s"] == pytest.approx(0.6)
+
+    def test_real_traces_decompose_and_sum(self, mon, net, prompts):
+        srv = GenerationServer(net, n_slots=2, n_blocks=16,
+                               block_len=BL).start()
+        try:
+            streams, _ = _serve(srv, prompts)
+        finally:
+            srv.stop()
+        for s in streams:
+            dec = ttft_decomposition(s.trace)
+            assert dec is not None
+            assert min(dec.values()) >= 0.0
+            assert (dec["queue_wait_s"] + dec["prefill_s"]
+                    + dec["first_emit_s"]) == pytest.approx(
+                        dec["ttft_s"], abs=1e-9)
+            assert dec["ttft_s"] == pytest.approx(
+                s.trace.meta["ttft_s"])

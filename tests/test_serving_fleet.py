@@ -612,6 +612,140 @@ class TestAutoscaler:
             fleet.stop()
 
 
+# ===================================== queue-depth seam + rules mode
+class TestQueueDepthSeam:
+    def test_public_queue_depth_counts_pending_work(self, net_v1,
+                                                    prompts):
+        srv = GenerationServer(net_v1, n_slots=1, n_blocks=8,
+                               block_len=BL).start()
+        try:
+            # one slot: at most one stream is ever in flight, so right
+            # after a 6-request burst >= 4 submissions are still
+            # awaiting admission — visible through the public seam
+            streams = [srv.generate_async(prompts[i], 12)
+                       for i in range(6)]
+            assert srv.queue_depth() >= 4
+            for s in streams:
+                s.result(timeout=120)
+        finally:
+            srv.stop()
+        assert srv.queue_depth() == 0
+
+    def test_live_autoscaler_path_monitoring_off(self, tmp_path, net_v1,
+                                                 prompts, ref_v1):
+        """The live fallback reads the public seam, not scheduler
+        internals — backlog pressure must scale with monitoring
+        DISABLED (no gauges to read)."""
+        from deeplearning4j_tpu import monitor
+        assert not monitor.is_enabled()
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        router = FleetRouter(fleet)
+        scaler = FleetAutoscaler(fleet, queue_depth_high=2, factor=4,
+                                 max_slots=4, max_blocks=32)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            streams = [router.submit("lm", prompts[i % 8], 6)
+                       for i in range(8)]
+            made = scaler.check()
+            assert len(made) == 1
+            assert "queue_depth" in made[0]["reason"]
+            assert fleet.server("lm").engine.n_slots == 4
+            got = np.stack([s.result(timeout=120) for s in streams])
+            np.testing.assert_array_equal(
+                got, np.stack([ref_v1[i % 8] for i in range(8)]))
+        finally:
+            fleet.stop()
+
+
+class TestRulesDrivenAutoscaler:
+    def test_firing_alert_is_pressure_for_its_model(self, tmp_path,
+                                                    net_v1):
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor.alerts import (AlertEngine,
+                                                       AlertRule)
+        from deeplearning4j_tpu.monitor.flightrec import FlightRecorder
+        from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+        mreg = monitor.enable(registry=MetricsRegistry())
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            fleet.publish_gauges()
+            rules = AlertEngine(
+                mreg,
+                [AlertRule(name="lm-hot", kind="threshold",
+                           metric="fleet_model_version",
+                           labels={"model": "lm"}, op=">=", value=1.0,
+                           severity="page")],
+                recorder=FlightRecorder(), registry=MetricsRegistry())
+            scaler = FleetAutoscaler(fleet, rules=rules, factor=2,
+                                     max_slots=2, max_blocks=16)
+            made = scaler.check()
+            assert len(made) == 1
+            assert "alert lm-hot firing" in made[0]["reason"]
+            assert fleet.server("lm").engine.n_slots == 2
+            # at the cap: a still-firing alert cannot scale further
+            fleet.publish_gauges()
+            assert scaler.check() == []
+        finally:
+            fleet.stop()
+            monitor.disable()
+
+    def test_quiet_rules_never_scale(self, tmp_path, net_v1):
+        from deeplearning4j_tpu.monitor.alerts import AlertEngine
+        from deeplearning4j_tpu.monitor.flightrec import FlightRecorder
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL)
+            rules = AlertEngine(lambda: {}, [],
+                                recorder=FlightRecorder())
+            scaler = FleetAutoscaler(fleet, rules=rules,
+                                     queue_depth_high=0)
+            # legacy thresholds would see pressure at depth 0 — rules
+            # mode must consult the (empty, quiet) rule set instead
+            assert scaler.check() == []
+            assert fleet.server("lm").engine.n_slots == 1
+        finally:
+            fleet.stop()
+
+    def test_goodput_floor_reads_live_ledger(self, tmp_path, net_v1,
+                                             prompts):
+        """`goodput_low=` pressure through the LIVE fallback (monitoring
+        off): a warmed server whose run is warmup-dominated sits far
+        below the floor once real traffic lands."""
+        from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor.alerts import AlertEngine
+        from deeplearning4j_tpu.monitor.flightrec import FlightRecorder
+        assert not monitor.is_enabled()
+        reg = ModelRegistry(tmp_path)
+        reg.publish("lm", net_v1)
+        fleet = FleetServer(reg)
+        router = FleetRouter(fleet)
+        quiet = AlertEngine(lambda: {}, [], recorder=FlightRecorder())
+        scaler = FleetAutoscaler(fleet, rules=quiet, goodput_low=0.99,
+                                 factor=2, max_slots=2, max_blocks=16)
+        try:
+            fleet.deploy("lm", n_slots=1, n_blocks=8, block_len=BL,
+                         warmup_prompt_len=3)
+            # warmed but idle: 0.0 fraction is absence of traffic, NOT
+            # waste — the floor must not fire yet
+            assert scaler.check() == []
+            router.submit("lm", prompts[0], 6).result(timeout=120)
+            srv = fleet.server("lm")
+            assert 0.0 < srv.engine.goodput.goodput_fraction() < 0.99
+            made = scaler.check()
+            assert len(made) == 1
+            assert "goodput fraction" in made[0]["reason"]
+            assert fleet.server("lm").engine.n_slots == 2
+        finally:
+            fleet.stop()
+
+
 # ======================================================= UI + bench gate
 class TestFleetObservability:
     def test_serving_page_per_model_rows_and_metrics(self, tmp_path,
