@@ -1212,6 +1212,20 @@ GATE_TOLERANCES = {
     # instead of masquerading as an efficiency change (the
     # prefix-reduction pattern)
     "serving_goodput_fraction": 0.05,
+    # rejection-sampled speculation on sampled traffic: host-timing
+    # number (wide band) — a silently-greedy-only drafting path drops
+    # the sampled arm back to one dispatch per token, far past it
+    "serving_sampled_spec_tokens_per_sec": 0.25,
+    # truncated-layer drafter acceptance on the n-gram-adversarial
+    # workload: deterministic-seeded but acceptance-EWMA-coupled, so a
+    # mid band — a drafter that stops agreeing with the full model
+    # collapses it orders past 50%
+    "serving_truncated_draft_truncated_accept_rate": 0.5,
+    # STRUCTURAL (prompt-token accounting): radix auto-dedup silently
+    # disabled reports ~1.0 against a shared baseline's >=2 and gates
+    # instead of masquerading as a cache win (the registered-prefix
+    # pattern)
+    "serving_radix_prefill_reduction": 0.02,
 }
 # metrics where a RISE past tolerance is the regression (latencies);
 # compare_bench inverts the ratio so the shared gate math applies
@@ -1276,6 +1290,14 @@ def _gate_metrics(rec):
     # dispatched token-positions — structural accounting, tight band
     take("serving_goodput_fraction",
          "extras", "goodput", "goodput_fraction")
+    # sampled speculation + truncated drafter + radix prefix cache
+    # (loadtest phases 7-9)
+    take("serving_sampled_spec_tokens_per_sec",
+         "extras", "serving_sampled_spec", "tokens_per_sec")
+    take("serving_truncated_draft_truncated_accept_rate",
+         "extras", "serving_truncated_draft", "truncated_accept_rate")
+    take("serving_radix_prefill_reduction",
+         "extras", "serving_radix", "prefill_reduction")
     return out
 
 

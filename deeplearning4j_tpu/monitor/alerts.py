@@ -34,9 +34,10 @@ Evaluation is pure host math over an already-materialized snapshot —
 zero device syncs, nothing at all when never called.  `evaluate(now=)`
 takes an explicit clock so tests drive hysteresis deterministically.
 
-`default_rule_pack()` ships the eight documented shapes: checkpoint
+`default_rule_pack()` ships the ten documented shapes: checkpoint
 staleness, elastic shrink, shed growth, registry fallback, watermark
-lag, worker-vanished, SLO burn, swap-without-publish.
+lag, worker-vanished, SLO burn, swap-without-publish, radix eviction
+churn, sampled-spec acceptance collapse.
 """
 
 from __future__ import annotations
@@ -417,6 +418,8 @@ def default_rule_pack(*, checkpoint_stale_s: float = 120.0,
                       slo_fast_burn: float = 14.0,
                       slo_fast_window_s: float = 60.0,
                       worker_stale_s: Optional[float] = None,
+                      radix_evict_per_s: float = 5.0,
+                      spec_accept_collapse: float = 0.05,
                       for_s: float = 5.0) -> List[AlertRule]:
     """The shipped rules, one per documented alert shape (the table in
     docs/OBSERVABILITY.md).  Rules over families a process never exports
@@ -479,4 +482,23 @@ def default_rule_pack(*, checkpoint_stale_s: float = 120.0,
             description="fleet swapped servers with no matching publish "
                         "— the autoscaler is resizing (check "
                         "fleet_slot_count)"),
+        AlertRule(
+            name="radix-eviction-churn", kind="delta_rate",
+            metric="serving_radix_evictions_total", op=">",
+            value=radix_evict_per_s, aggregate="sum",
+            severity="ticket", event_kind="radix_eviction_churn",
+            description="radix prefix-cache nodes evicted faster than "
+                        "they pay back — the pool is too small for the "
+                        "working set and every admission re-prefills "
+                        "what the last one cached"),
+        AlertRule(
+            name="sampled-spec-acceptance-collapse", kind="threshold",
+            metric="serving_spec_accept_rate", op="<",
+            value=spec_accept_collapse, aggregate="min",
+            severity="ticket", event_kind="spec_acceptance_collapse",
+            description="a speculative proposer's acceptance EWMA "
+                        "collapsed — sampled streams are paying the "
+                        "K-wide verify dispatch for ~1 token/dispatch "
+                        "(check the proposer label; rejection-sampling "
+                        "acceptance tracks draft/target divergence)"),
     ]

@@ -12,7 +12,10 @@ just launched into exactly one of six classes:
 - ``useful``           — positions that prefilled a live prompt or
                          emitted a kept token;
 - ``spec_rejected``    — valid speculative draft positions whose tokens
-                         the target model rejected;
+                         the target model rejected; also the
+                         truncated-layer drafter's generation pass (its
+                         real lanes are speculation overhead — they
+                         never emit directly, the verify dispatch does);
 - ``pad_waste``        — padding to pow2 wave widths / length buckets /
                          idle decode lanes;
 - ``warmup``           — everything dispatched inside `warmup()`'s

@@ -68,6 +68,7 @@ from deeplearning4j_tpu.nn.layers.transformer import (
 from deeplearning4j_tpu.serving.paged import (
     GARBAGE_BLOCK,
     PagedKVPool,
+    RadixPrefixCache,
     blocks_needed,
 )
 
@@ -131,7 +132,10 @@ class PagedDecodeEngine:
                  quantize: Optional[str] = None,
                  allocation: str = "incremental",
                  speculative: Optional[int] = None,
-                 spec_max_ngram: int = 3):
+                 spec_max_ngram: int = 3,
+                 spec_sampled: bool = False,
+                 spec_draft_layers: Optional[int] = None,
+                 prefix_cache: str = "registered"):
         if not getattr(net, "_initialized", False):
             net.init()
         self.net = net
@@ -157,6 +161,32 @@ class PagedDecodeEngine:
                     f"k=1 is ordinary decode; got {speculative}")
         self.spec_k = speculative
         self.spec_max_ngram = int(spec_max_ngram)
+        # sampled speculation (rejection sampling over delta drafts —
+        # zoo.transformer.rejection_sample_drafts): OPT-IN because it
+        # trades the sampled bit-parity contract for a distributional
+        # one (docs/SERVING.md acceptance-oracle table); greedy slots
+        # keep the bit-exact argmax oracle either way
+        self.spec_sampled = bool(spec_sampled)
+        if self.spec_sampled and self.spec_k is None:
+            raise ValueError(
+                "spec_sampled=True without speculative=k — there is "
+                "no draft depth to rejection-sample over")
+        # truncated-layer drafter: the SECOND _propose backend — the
+        # first `spec_draft_layers` transformer blocks of the SAME
+        # weights greedily draft k-1 tokens when the n-gram suffix
+        # cache has nothing (non-repetitive text)
+        if spec_draft_layers is not None:
+            spec_draft_layers = int(spec_draft_layers)
+            if self.spec_k is None:
+                raise ValueError(
+                    "spec_draft_layers without speculative=k — the "
+                    "drafter only feeds speculative dispatches")
+        self.spec_draft_layers = spec_draft_layers
+        if prefix_cache not in ("registered", "radix"):
+            raise ValueError(
+                f"prefix_cache must be 'registered' or 'radix'; "
+                f"got {prefix_cache!r}")
+        self.prefix_cache_mode = prefix_cache
         # pay the quantization pass NOW, not inside the first live
         # dispatch (the tree itself is resolved per dispatch — see
         # the _params property)
@@ -207,6 +237,28 @@ class PagedDecodeEngine:
                     "recurrent state but has no paged decode path")
             else:
                 self._plan.append(("plain", i))
+        # truncated-drafter plan: the SAME walk minus the deep blocks —
+        # embedding/positional/unembedding layers all kept, only the
+        # first `spec_draft_layers` ("block", i, j) entries survive.
+        # Layer-i K/V depends only on layers < i, so the slot's real
+        # pages double as the draft model's cache for committed tokens
+        # with NO extra state
+        self._draft_plan: Optional[List[Tuple]] = None
+        if self.spec_draft_layers is not None:
+            n_layers = sum(1 for e in self._plan if e[0] == "block")
+            if not (1 <= self.spec_draft_layers < n_layers):
+                raise ValueError(
+                    f"spec_draft_layers must be in [1, {n_layers - 1}] "
+                    f"(a strict truncation of the {n_layers}-block "
+                    f"target); got {self.spec_draft_layers}")
+            kept = 0
+            self._draft_plan = []
+            for e in self._plan:
+                if e[0] == "block":
+                    if kept >= self.spec_draft_layers:
+                        continue
+                    kept += 1
+                self._draft_plan.append(e)
         # host slot state (uploaded per step; a few [S] vectors)
         S = self.n_slots
         self.block_tables = np.zeros((S, self.max_blocks), np.int32)
@@ -231,12 +283,24 @@ class PagedDecodeEngine:
         self._score = {}
         self._fork = None
         self._first_token = {}
+        self._draft_fn = None         # truncated-layer draft scan
         # copy-on-write shared-prefix registry: key (token-id tuple) ->
         # {tokens, len, blocks, probs}; the cache itself holds one
         # allocator reference per block so registered prefixes survive
         # every slot release
         self._prefixes: Dict[tuple, dict] = {}
         self.prefix_pinned_blocks = 0
+        # radix prefix cache (prefix_cache="radix"): automatic
+        # block-aligned mid-prompt dedup across all admissions — the
+        # registered-prefix registry above keeps working alongside it
+        # (exact registered matches win; the tree catches everything
+        # else). Radix-held blocks are NOT pinned capacity: eviction
+        # reclaims them on demand (LRU leaves first, live slots never)
+        self._radix: Optional[RadixPrefixCache] = (
+            RadixPrefixCache(self.pool.allocator, self.block_len)
+            if prefix_cache == "radix" else None)
+        self.radix_hit_tokens_total = 0
+        self.radix_evictions_total = 0
         # allocator observability (host ints — the scheduler mirrors
         # them onto the metrics registry) + preemption notices the
         # scheduler drains for requeue
@@ -248,6 +312,15 @@ class PagedDecodeEngine:
         self.spec_proposed_total = 0
         self.spec_accepted_total = 0
         self.spec_emitted_total = 0
+        # per-proposer split of the same accounting (the scheduler's
+        # per-proposer EWMAs and the serving_spec_*{proposer=} label
+        # families read these; the global counters above are the sum
+        # over proposers and keep their exact PR-14 semantics)
+        self.spec_proposed_by: Dict[str, int] = {"ngram": 0,
+                                                 "truncated": 0}
+        self.spec_accepted_by: Dict[str, int] = {"ngram": 0,
+                                                 "truncated": 0}
+        self.spec_draft_dispatches_total = 0
         # shared-prefix accounting
         self.prefix_hits_total = 0
         self.prefix_tokens_saved_total = 0
@@ -332,19 +405,64 @@ class PagedDecodeEngine:
         fork = 0 if entry["len"] % self.block_len == 0 else 1
         return blocks_needed(map_tokens, self.block_len) - nb_sh + fork
 
+    def _reclaimable_blocks(self) -> int:
+        """Blocks an admission could obtain right now: the free list
+        plus whatever evicting the whole unpinned radix tree would
+        return (cache-only references — `_alloc_admit` realizes them
+        LRU-first on demand)."""
+        extra = (self._radix.evictable_blocks
+                 if self._radix is not None else 0)
+        return self.pool.free_blocks + extra
+
+    def _match_radix(self, prompt) -> Optional[dict]:
+        """Longest block-aligned radix-cached prefix of `prompt` as a
+        synthetic CoW entry (the same dict shape `_match_prefix`
+        returns, minus cached probs — a radix match is always capped
+        BELOW the full prompt, so the suffix-extension score path
+        computes the first token and no cached distribution is ever
+        needed; block alignment means the mid-block fork never
+        fires)."""
+        if self._radix is None:
+            return None
+        P = int(prompt.shape[0])
+        matched, blocks = self._radix.match(prompt)
+        if matched >= P:
+            matched -= self.block_len
+            blocks = blocks[:-1]
+        if matched <= 0:
+            return None
+        return dict(tokens=np.asarray(prompt[:matched], np.int64),
+                    len=matched, blocks=blocks, probs=None, radix=True)
+
+    def _alloc_admit(self, n: int) -> Optional[List[int]]:
+        """Admission-path allocation: on pool exhaustion, evict radix
+        LRU leaves (cache-only references — never a live slot) until
+        the grant fits or nothing evictable remains."""
+        got = self.pool.allocator.allocate(n)
+        while got is None and self._radix is not None:
+            if not self._radix.evict_lru():
+                break
+            self.radix_evictions_total += 1
+            got = self.pool.allocator.allocate(n)
+        return got
+
     def can_admit(self, prompt_len: int, n_tokens: int,
                   prompt_ids=None) -> bool:
         if not any(s is None for s in self.slots):
             return False
-        if prompt_ids is not None and self._prefixes:
-            entry = self._match_prefix(np.asarray(prompt_ids))
+        if prompt_ids is not None and (self._prefixes
+                                       or self._radix is not None):
+            prompt = np.asarray(prompt_ids)
+            entry = self._match_prefix(prompt)
+            if entry is None:
+                entry = self._match_radix(prompt)
             if entry is not None:
                 map_tokens = (prompt_len if self.allocation == "incremental"
                               else prompt_len + n_tokens)
                 return (self._cow_fresh_blocks(entry, map_tokens)
-                        <= self.pool.free_blocks)
+                        <= self._reclaimable_blocks())
         return self._admit_blocks(prompt_len, n_tokens) \
-            <= self.pool.free_blocks
+            <= self._reclaimable_blocks()
 
     def check_budget(self, prompt_len: int, n_tokens: int,
                      prompt_ids=None):
@@ -565,13 +683,44 @@ class PagedDecodeEngine:
 
         return score
 
-    def _get_score(self, K: int, greedy_only: bool):
-        key = (int(K), bool(greedy_only))
+    def _score_rs_body(self):
+        """The sampled-speculation score variant (`spec_sampled=True`
+        dispatches with sampled slots in flight): same target forward,
+        but the sampling tail is the rejection-sampling chain
+        (zoo.transformer.rejection_sample_drafts) — per slot it
+        returns how many leading drafts survived (`n_acc`) and the
+        residual/bonus token at the first divergence (`final`).
+        Greedy slots in the same dispatch keep the bit-exact argmax
+        oracle: the host reads their rows from `greedy_mat` and
+        ignores the sampled outputs."""
+        net, plan = self.net, self._plan
+        from deeplearning4j_tpu.zoo.transformer import (
+            paged_score_forward, rejection_sample_drafts)
+
+        def score(params, state, kv, block_tables, token_mat, pos,
+                  n_valid, keys, emit_idx, temp, top_p):
+            params = net.dtype.cast_params(params)
+            kv, probs = paged_score_forward(
+                net, plan, params, state, kv, block_tables, token_mat,
+                pos, n_valid)
+            greedy_mat = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            n_acc, final = rejection_sample_drafts(
+                probs, token_mat, n_valid, keys, emit_idx, temp,
+                top_p, self.top_k)
+            return kv, greedy_mat, n_acc, final
+
+        return score
+
+    def _get_score(self, K: int, variant):
+        """`variant`: True/False = the greedy_only split, "rs" = the
+        rejection-sampling tail (sampled speculation)."""
+        key = (int(K), variant)
         fn = self._score.get(key)
         if fn is None:
+            body = (self._score_rs_body() if variant == "rs"
+                    else self._score_body(variant))
             fn = self._score[key] = jax.jit(
-                self._score_body(greedy_only),
-                donate_argnums=donate_argnums(2))
+                body, donate_argnums=donate_argnums(2))
         return fn
 
     def _build_fork(self):
@@ -616,6 +765,83 @@ class PagedDecodeEngine:
                                     greedy_only=greedy_only)
 
         return jax.jit(first)
+
+    def _draft_body(self):
+        """The truncated-layer draft scan: k-1 greedy micro-steps of
+        the FIRST `spec_draft_layers` transformer blocks (same
+        weights, same embedding/positional/unembedding — the plan
+        minus its deep blocks) fused into one program. The slot's real
+        pages are the draft model's KV cache for free: layer-i K/V
+        depends only on layers < i, so the full model's committed
+        pages ARE the truncated model's. Draft K/V writes land in the
+        slot's not-yet-committed write window — every one of those
+        positions is rewritten with full-model K/V by the verify
+        dispatch in the same `_spec_step` (write-before-read, the same
+        discipline rejected speculative lanes ride). Non-drafting
+        slots' table rows point at the garbage block."""
+        net, layers = self.net, self.net.layers
+        dplan = self._draft_plan
+
+        def draft(params, state, kv, block_tables, token_ids, pos):
+            params = net.dtype.cast_params(params)
+
+            def micro(carry, _):
+                kv, tok, pos = carry
+                h = tok[:, None]            # [S, 1] int ids
+                kv = list(kv)
+                for entry in dplan:
+                    kind, i = entry[0], entry[1]
+                    layer = layers[i]
+                    lp = params.get(str(i), {})
+                    ls = state.get(str(i), {})
+                    if kind == "plain":
+                        h, _ = layer.forward(lp, ls, h, train=False,
+                                             rng=None)
+                    elif kind == "pos":
+                        h, _ = layer.forward_at_positions(lp, ls, h, pos)
+                    else:
+                        j = entry[2]
+                        k_pool, v_pool = kv[j]
+                        h, k_pool, v_pool = layer.forward_paged(
+                            lp, h, k_pool, v_pool, block_tables, pos)
+                        kv[j] = (k_pool, v_pool)
+                nxt = jnp.argmax(h[:, -1], axis=-1).astype(jnp.int32)
+                return (tuple(kv), nxt, pos + 1), nxt
+
+            carry = (kv, token_ids, pos)
+            (kv, _, _), drafts = jax.lax.scan(micro, carry, None,
+                                              length=self.spec_k - 1)
+            return kv, drafts               # [k-1, S]
+
+        return draft
+
+    def _run_draft(self, trunc_slots):
+        """One truncated-layer draft dispatch over `trunc_slots`
+        ([(slot, depth)] — write windows already granted/forked).
+        Returns the [k-1, S] draft matrix; rows of non-participating
+        slots are garbage and never read. Ledger: draft positions
+        never emit directly (the verify dispatch emits), so the real
+        lanes are speculation overhead — spec_rejected — and the
+        masked lanes padding."""
+        S, K = self.n_slots, self.spec_k
+        mask = np.zeros(S, bool)
+        for s, _ in trunc_slots:
+            mask[s] = True
+        tables = np.where(mask[:, None], self.block_tables,
+                          GARBAGE_BLOCK).astype(np.int32)
+        if self._draft_fn is None:
+            self._draft_fn = jax.jit(self._draft_body(),
+                                     donate_argnums=donate_argnums(2))
+        kv, drafts = self._draft_fn(
+            self._params, self.net.net_state, self.pool.kv,
+            jnp.asarray(tables), jnp.asarray(self.last_token),
+            jnp.asarray(self.pos))
+        self.pool.kv = kv
+        self.spec_draft_dispatches_total += 1
+        real = sum(d - 1 for _, d in trunc_slots)
+        self.goodput.account(spec_rejected=real,
+                             pad_waste=(K - 1) * S - real)
+        return np.asarray(drafts)
 
     # ------------------------------------------------- shared prefixes
     def register_prefix(self, token_ids) -> tuple:
@@ -760,8 +986,13 @@ class PagedDecodeEngine:
                     break
                 entry = self._match_prefix(prompt)
                 if entry is None:
+                    # no registered exact match — the radix tree
+                    # catches block-aligned mid-prompt sharing across
+                    # ALL prior admissions (prefix_cache="radix")
+                    entry = self._match_radix(prompt)
+                if entry is None:
                     nb = self._admit_blocks(P, n_tokens)
-                    blocks = self.pool.allocator.allocate(nb)
+                    blocks = self._alloc_admit(nb)
                     if blocks is None:
                         break
                     w = dict(blocks=blocks, grants=nb, entry=None,
@@ -774,7 +1005,21 @@ class PagedDecodeEngine:
                 wave.append(w)
             if not wave:
                 return []
-            return self._admit_dispatch(wave)
+            out = self._admit_dispatch(wave)
+            if self._radix is not None:
+                # every admission's fully-written prompt blocks feed
+                # the tree on the way in (automatic dedup — no manual
+                # register/release); the partial tail block, which the
+                # slot will keep writing, never enters
+                for w in wave:
+                    slot = self.slots[w["slot"]]
+                    if slot is None:      # n_tokens == 1: already done
+                        continue
+                    n_full = len(w["prompt"]) // self.block_len
+                    if n_full:
+                        self._radix.insert(w["prompt"],
+                                           slot.blocks[:n_full])
+            return out
         except Exception:
             # a mid-wave failure (validation of a later request, a
             # prefill/admit dispatch error) must return the wave's
@@ -817,10 +1062,16 @@ class PagedDecodeEngine:
         map_tokens = (prompt_len if self.allocation == "incremental"
                       else prompt_len + n_tokens)
         n_fresh = self._cow_fresh_blocks(entry, map_tokens)
-        fresh = [] if n_fresh == 0 else alloc.allocate(n_fresh)
-        if fresh is None:
-            return None
+        # take the shared references BEFORE allocating fresh blocks:
+        # covering the fresh grant may evict radix LRU nodes, and an
+        # unshared match could be evicted out from under us — the
+        # share pins the matched blocks regardless of what the tree
+        # does
         alloc.share(entry["blocks"][:nb_sh])
+        fresh = [] if n_fresh == 0 else self._alloc_admit(n_fresh)
+        if fresh is None:
+            alloc.free(entry["blocks"][:nb_sh])
+            return None
         if P % bl == 0:
             blocks = list(entry["blocks"][:nb_sh]) + fresh
             fork = None
@@ -966,6 +1217,8 @@ class PagedDecodeEngine:
         if w["entry"] is not None:
             self.prefix_hits_total += 1
             self.prefix_tokens_saved_total += w["entry"]["len"]
+            if w["entry"].get("radix"):
+                self.radix_hit_tokens_total += w["entry"]["len"]
         if done:
             self._release(slot)
         results[slot] = (slot, first, done)
@@ -1115,6 +1368,12 @@ class PagedDecodeEngine:
         preempted and released)."""
         got = self.pool.allocator.allocate(n)
         while got is None:
+            # radix LRU leaves go first — cache-only references, no
+            # re-prefill cost — before any live slot is preempted
+            if self._radix is not None and self._radix.evict_lru():
+                self.radix_evictions_total += 1
+                got = self.pool.allocator.allocate(n)
+                continue
             victim = self._lowest_progress_active()
             self._preempt(victim)
             if victim == s:
@@ -1185,7 +1444,8 @@ class PagedDecodeEngine:
             self._run_fork(fork_pairs)
 
     # ------------------------------------------------------------- decode
-    def step(self, *, speculate: Optional[bool] = None
+    def step(self, *, speculate: Optional[bool] = None,
+             proposers: Optional[tuple] = None
              ) -> Tuple[Dict[int, List[int]], List[int]]:
         """One continuous-batching dispatch: every active slot advances
         up to `steps_per_dispatch` tokens — or, with `speculative=k`
@@ -1200,8 +1460,9 @@ class PagedDecodeEngine:
         if speculate is None:
             speculate = self.spec_k is not None
         if speculate and self.spec_k:
-            return self._spec_step()
-        if self.allocation == "incremental" or self._prefixes:
+            return self._spec_step(proposers=proposers)
+        if (self.allocation == "incremental" or self._prefixes
+                or self._radix is not None):
             # upfront allocation never grows, but the CoW fork pass
             # (shared write-window blocks) must still run
             self._grow_block_tables()
@@ -1302,7 +1563,8 @@ class PagedDecodeEngine:
                     return [int(t) for t in cont]
         return []
 
-    def _spec_step(self) -> Tuple[Dict[int, List[int]], List[int]]:
+    def _spec_step(self, proposers: Optional[tuple] = None
+                   ) -> Tuple[Dict[int, List[int]], List[int]]:
         """One speculative dispatch: the proposer drafts up to k-1
         tokens per greedy slot, ONE k-position score dispatch
         (`_get_score`) runs the target over [last_token, d1..d_{k-1}],
@@ -1313,26 +1575,63 @@ class PagedDecodeEngine:
         were (rejected lanes' K/V writes sit beyond the advanced `pos`
         and are overwritten by the dispatch that reaches them, the
         same write-before-read discipline the garbage block rests on).
-        Sampled slots ride the same dispatch at depth 1 — their token
-        comes from the `chosen` sampling tail, untouched by
-        speculation. Emits 1..k tokens per slot per dispatch."""
+        Sampled slots: with `spec_sampled=False` (the default) they
+        ride the same dispatch at depth 1 — their token comes from the
+        `chosen` sampling tail, untouched by speculation and bit-equal
+        to the spec-free engine. With `spec_sampled=True` they take
+        drafts too and the acceptance oracle is REJECTION SAMPLING
+        (`rejection_sample_drafts`): each emitted token is marginally
+        a vanilla sample from the target's filtered distribution — a
+        distributional contract, not a bit one. `proposers` (the
+        scheduler's per-proposer arbitration) restricts which draft
+        backends may run this dispatch; None allows all configured.
+        Emits 1..k tokens per slot per dispatch."""
         if not self.active.any():
             return {}, []
         K = self.spec_k
         S = self.n_slots
+        allow_ngram = proposers is None or "ngram" in proposers
+        allow_trunc = (self._draft_plan is not None
+                       and (proposers is None or "truncated" in proposers))
         token_mat = np.zeros((S, K), np.int32)
         n_valid = np.zeros(S, np.int32)
+        by_proposer: Dict[int, str] = {}
+        trunc_slots: List[Tuple[int, int]] = []
         for s in np.flatnonzero(self.active):
             s = int(s)
             token_mat[s, 0] = self.last_token[s]
-            if self.temp[s] > 0:
+            if self.temp[s] > 0 and not self.spec_sampled:
                 n_valid[s] = 1          # sampling has no greedy oracle
                 continue
             depth = int(min(K, self.remaining[s]))
-            draft = self._propose(s, depth - 1)
-            n_valid[s] = 1 + len(draft)
+            draft = self._propose(s, depth - 1) if allow_ngram else []
             if draft:
+                by_proposer[s] = "ngram"
+                n_valid[s] = 1 + len(draft)
                 token_mat[s, 1:1 + len(draft)] = draft
+            elif allow_trunc and depth >= 2:
+                # n-gram came up empty — the truncated-layer drafter
+                # takes the slot (drafts filled in below, after its
+                # write window is granted)
+                trunc_slots.append((s, depth))
+                n_valid[s] = depth
+            else:
+                n_valid[s] = 1
+        if trunc_slots:
+            # grant (and CoW-fork) the drafting slots' FULL windows
+            # first: the truncated pass writes draft K/V into the
+            # slot's own not-yet-committed positions [pos, pos+d-2],
+            # all of which the verify dispatch below rewrites with
+            # full-model K/V (write-before-read)
+            self._grow_block_tables(dict(trunc_slots))
+            trunc_slots = [(s, d) for s, d in trunc_slots
+                           if self.slots[s] is not None
+                           and self.active[s]]
+        if trunc_slots:
+            drafts = self._run_draft(trunc_slots)
+            for s, d in trunc_slots:
+                by_proposer[s] = "truncated"
+                token_mat[s, 1:d] = drafts[:d - 1, s]
         # grant (and CoW-fork) each slot's write window [pos,
         # pos+n_valid) — pool pressure preempts exactly like the
         # chunked path
@@ -1342,16 +1641,23 @@ class PagedDecodeEngine:
         if not self.active.any():
             return {}, []
         greedy_only = not bool((self.temp[self.active] > 0).any())
-        score = self._get_score(K, greedy_only)
-        kv, greedy_mat, chosen = score(
+        use_rs = self.spec_sampled and not greedy_only
+        score = self._get_score(K, "rs" if use_rs else greedy_only)
+        out = score(
             self._params, self.net.net_state, self.pool.kv,
             jnp.asarray(self.block_tables), jnp.asarray(token_mat),
             jnp.asarray(self.pos), jnp.asarray(n_valid),
             jnp.asarray(self.keys), jnp.asarray(self.emit_idx),
             jnp.asarray(self.temp), jnp.asarray(self.top_p))
+        if use_rs:
+            kv, greedy_mat, n_acc, final = out
+            n_acc, final = np.asarray(n_acc), np.asarray(final)
+            chosen = None
+        else:
+            kv, greedy_mat, chosen = out
+            chosen = np.asarray(chosen)
         self.pool.kv = kv
         greedy_mat = np.asarray(greedy_mat)
-        chosen = np.asarray(chosen)
         self.spec_dispatches_total += 1
         # ledger: the score program touched S*K token-positions; per
         # slot, emitted tokens are useful, valid-but-rejected draft
@@ -1364,8 +1670,21 @@ class PagedDecodeEngine:
         for s in np.flatnonzero(self.active):
             s = int(s)
             v = int(n_valid[s])
+            prop = by_proposer.get(s)
             if self.temp[s] > 0:
-                toks = [int(chosen[s])]
+                if use_rs:
+                    # rejection sampling: the first n_acc drafts
+                    # survived their u < q_t(d) tests; `final` is the
+                    # residual resample at the divergence (or the
+                    # bonus token when every draft survived)
+                    acc = min(int(n_acc[s]), v - 1)
+                    toks = [int(token_mat[s, j])
+                            for j in range(1, 1 + acc)] + [int(final[s])]
+                else:
+                    toks = [int(chosen[s])]
+                if v > 1:
+                    self.spec_proposed_total += v - 1
+                    self.spec_accepted_total += len(toks) - 1
             else:
                 # acceptance: draft j survives iff it EQUALS the
                 # target's argmax after position j-1; the first miss
@@ -1378,6 +1697,9 @@ class PagedDecodeEngine:
                     toks.append(int(row[j]))
                 self.spec_proposed_total += v - 1
                 self.spec_accepted_total += len(toks) - 1
+            if prop is not None and v > 1:
+                self.spec_proposed_by[prop] += v - 1
+                self.spec_accepted_by[prop] += len(toks) - 1
             n = len(toks)
             gp_useful += n
             gp_rejected += v - n

@@ -246,7 +246,13 @@ class FleetServer:
             server = GenerationServer(net, **server_kw)
             # shared prefixes registered for this NAME re-apply to the
             # successor BEFORE warmup (prefill under the new weights;
-            # warmup then pre-compiles the suffix-extension programs)
+            # warmup then pre-compiles the suffix-extension programs).
+            # The radix prefix cache needs NO such replay: the
+            # `prefix_cache="radix"` kwarg rides server_kw through
+            # swap()/scale(), and the successor's tree rebuilds itself
+            # from live traffic — every admission inserts its prompt
+            # blocks, so dedup resumes within one wave of repeats and
+            # stale-weight K/V can never leak across a swap
             with self._lock:
                 prefixes = list(self._prefixes.get(name, ()))
             for ids in prefixes:

@@ -133,6 +133,198 @@ class BlockAllocator:
                 self._free.append(b)
 
 
+class _RadixNode:
+    """One edge of the radix tree: a run of BLOCK-ALIGNED token chunks
+    and the pool blocks holding their K/V. `tokens` is always a
+    multiple of `block_len` long and `blocks[j]` holds tokens
+    `tokens[j*bl:(j+1)*bl]`; children are keyed by the first block's
+    token tuple (unique among siblings — any two edges sharing a full
+    first block get factored by a split, and edges differing within
+    the first block differ in the key)."""
+
+    __slots__ = ("tokens", "blocks", "children", "parent", "last_used",
+                 "pinned")
+
+    def __init__(self, tokens, blocks, parent):
+        self.tokens = tokens
+        self.blocks = blocks
+        self.children: Dict[tuple, "_RadixNode"] = {}
+        self.parent = parent
+        self.last_used = 0
+        self.pinned = False
+
+
+class RadixPrefixCache:
+    """Radix tree over block-aligned token chunks — automatic
+    mid-prompt K/V dedup across ALL admissions (the `prefix_cache=
+    "radix"` engine mode, docs/SERVING.md), replacing the manual
+    exact-match-from-token-0 `register_prefix` contract.
+
+    Every admission's prompt is `match()`ed against the tree (longest
+    block-aligned shared prefix → those blocks are `share()`d to the
+    new slot, copy-on-write discipline unchanged) and `insert()`ed on
+    the way in (the slot's fully-written prompt blocks become tree
+    edges, with the cache holding its OWN allocator reference on each
+    — a finished slot's release leaves the prefix resident). Matching
+    and splitting happen only at block boundaries, so a radix hit
+    never needs a mid-block fork or cached next-token probs: the
+    engine caps the match below the full prompt and runs its ordinary
+    suffix-extension prefill for the remainder.
+
+    Eviction is LRU over UNPINNED LEAVES (`evict_lru()`): the engine
+    calls it under pool pressure BEFORE preempting live slots, and the
+    cache drops its reference — a block still mapped by an active slot
+    survives at the slot's refcount (the same last-holder-frees rule
+    every other release rides). Nothing here is pinned capacity:
+    `check_budget` ignores radix-held blocks because they are
+    reclaimable on demand."""
+
+    def __init__(self, allocator: BlockAllocator, block_len: int):
+        self.alloc = allocator
+        self.block_len = int(block_len)
+        self.root = _RadixNode((), [], None)
+        self._n_nodes = 0
+        self._clock = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    @property
+    def nodes(self) -> int:
+        """Edge count (root excluded) — the `serving_radix_nodes`
+        gauge."""
+        return self._n_nodes
+
+    def _iter_nodes(self):
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            yield n
+
+    @property
+    def held_blocks(self) -> int:
+        return sum(len(n.blocks) for n in self._iter_nodes())
+
+    @property
+    def evictable_blocks(self) -> int:
+        """Blocks that would return to the free list if the whole
+        unpinned tree were evicted (cache is the only holder)."""
+        return sum(1 for n in self._iter_nodes() if not n.pinned
+                   for b in n.blocks if self.alloc.refcount(b) == 1)
+
+    def match(self, tokens) -> Tuple[int, List[int]]:
+        """Longest block-aligned cached prefix of `tokens`: returns
+        `(n_matched_tokens, blocks)` — the caller `share()`s the
+        blocks onto the admitted slot. Touches the path for LRU."""
+        t = tuple(int(x) for x in tokens)
+        bl = self.block_len
+        now = self._tick()
+        node, i, out = self.root, 0, []
+        while len(t) - i >= bl:
+            child = node.children.get(t[i:i + bl])
+            if child is None:
+                break
+            m = 0
+            while (m < len(child.blocks) and i + (m + 1) * bl <= len(t)
+                   and child.tokens[m * bl:(m + 1) * bl]
+                   == t[i + m * bl:i + (m + 1) * bl]):
+                m += 1
+            child.last_used = now
+            out.extend(child.blocks[:m])
+            i += m * bl
+            if m < len(child.blocks):
+                break
+            node = child
+        return i, out
+
+    def insert(self, tokens, blocks) -> int:
+        """Insert the fully-written prompt blocks of a just-admitted
+        slot. `tokens[:len(blocks)*block_len]` must be the tokens those
+        blocks hold. Shared portions already in the tree are skipped
+        (the tree keeps ITS blocks); the diverging suffix becomes a new
+        edge the cache takes its own references on. Returns the number
+        of newly referenced blocks."""
+        bl = self.block_len
+        t = tuple(int(x) for x in tokens)
+        nb = min(len(t) // bl, len(blocks))
+        t = t[:nb * bl]
+        now = self._tick()
+        node, i, bi = self.root, 0, 0
+        while bi < nb:
+            key = t[i:i + bl]
+            child = node.children.get(key)
+            if child is None:
+                new_blocks = [int(b) for b in blocks[bi:nb]]
+                self.alloc.share(new_blocks)
+                leaf = _RadixNode(t[i:], new_blocks, node)
+                leaf.last_used = now
+                node.children[key] = leaf
+                self._n_nodes += 1
+                return len(new_blocks)
+            m = 0
+            while (m < len(child.blocks) and bi + m < nb
+                   and child.tokens[m * bl:(m + 1) * bl]
+                   == t[i + m * bl:i + (m + 1) * bl]):
+                m += 1
+            child.last_used = now
+            if m == len(child.blocks):
+                node, i, bi = child, i + m * bl, bi + m
+                continue
+            if bi + m == nb:
+                return 0          # prompt ends inside the edge: cached
+            node = self._split(child, m)
+            i, bi = i + m * bl, bi + m
+        return 0
+
+    def _split(self, child: "_RadixNode", m: int) -> "_RadixNode":
+        """Split `child` at block boundary `m` (0 < m < blocks): the
+        upper part becomes a new interior node, `child` keeps the
+        tail."""
+        bl = self.block_len
+        parent = child.parent
+        upper = _RadixNode(child.tokens[:m * bl], child.blocks[:m], parent)
+        upper.last_used = child.last_used
+        upper.pinned = child.pinned
+        parent.children[child.tokens[:bl]] = upper
+        child.tokens = child.tokens[m * bl:]
+        child.blocks = child.blocks[m:]
+        child.parent = upper
+        upper.children[child.tokens[:bl]] = child
+        self._n_nodes += 1
+        return upper
+
+    def evict_lru(self) -> int:
+        """Drop the cache's references on the least-recently-used
+        unpinned LEAF. Returns how many block references were released
+        (0 = nothing evictable). Blocks still mapped by a live slot
+        stay granted at the slot's refcount."""
+        best = None
+        for n in self._iter_nodes():
+            if n.children or n.pinned:
+                continue
+            if best is None or n.last_used < best.last_used:
+                best = n
+        if best is None:
+            return 0
+        del best.parent.children[best.tokens[:self.block_len]]
+        self.alloc.free(best.blocks)
+        self._n_nodes -= 1
+        return len(best.blocks)
+
+    def clear(self) -> int:
+        """Release every cache-held reference (drain/evict-all). The
+        tree rebuilds from traffic — fleet swap successors start here."""
+        dropped = 0
+        for n in list(self._iter_nodes()):
+            self.alloc.free(n.blocks)
+            dropped += len(n.blocks)
+        self.root.children.clear()
+        self._n_nodes = 0
+        return dropped
+
+
 class PagedKVPool:
     """The per-layer block pools for one model + the shared allocator.
 

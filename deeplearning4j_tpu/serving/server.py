@@ -222,6 +222,9 @@ class GenerationServer(ParallelInference):
                  speculative: Optional[int] = None,
                  spec_accept_floor: float = 0.3,
                  spec_probe_every: int = 50,
+                 spec_sampled: bool = False,
+                 spec_draft_layers: Optional[int] = None,
+                 prefix_cache: str = "registered",
                  name: Optional[str] = None,
                  slo: Optional[SLOObjective] = None):
         super().__init__(net)
@@ -241,7 +244,9 @@ class GenerationServer(ParallelInference):
             net, n_slots=n_slots, n_blocks=n_blocks, block_len=block_len,
             top_k=top_k, steps_per_dispatch=steps_per_dispatch,
             quantize=quantize, allocation=allocation,
-            speculative=speculative)
+            speculative=speculative, spec_sampled=spec_sampled,
+            spec_draft_layers=spec_draft_layers,
+            prefix_cache=prefix_cache)
         self._metrics_cache = None
         # speculative-decoding policy: drafting is only worth its
         # k-wide scoring dispatch while the proposer's tokens actually
@@ -260,8 +265,18 @@ class GenerationServer(ParallelInference):
         self._spec_accepted_seen = 0
         self._spec_emitted_seen = 0
         self._spec_dispatches_seen = 0
+        # per-proposer arbitration: separate acceptance EWMAs so a
+        # collapsed n-gram cache (non-repetitive traffic) hands the
+        # drafting seam to the truncated-layer backend instead of
+        # disabling speculation outright; the global EWMA/latch above
+        # stays authoritative for the enable/disable decision
+        self._spec_prop_ewma = {"ngram": None, "truncated": None}
+        self._spec_prop_seen = {"ngram": (0, 0), "truncated": (0, 0)}
         self._prefix_hits_seen = 0
         self._prefix_saved_seen = 0
+        # radix-cache counter mirrors (radix mode only)
+        self._radix_hits_seen = 0
+        self._radix_evict_seen = 0
         # goodput-ledger mirror cursors (one per classification class)
         self._goodput_seen = {}
         # prefix registrations from foreign threads ride a control
@@ -436,6 +451,10 @@ class GenerationServer(ParallelInference):
         # through the CoW path and leave the REAL full-prefill
         # programs cold for live traffic of that shape.
         saved_prefixes, eng._prefixes = eng._prefixes, {}
+        # the radix cache is suspended for the same reason — and so the
+        # grid's synthetic zero prompts don't seed the tree with
+        # garbage-content nodes real traffic would then "hit"
+        saved_radix, eng._radix = eng._radix, None
         short_wave = None      # narrowest under-admitted wave seen
         # goodput: everything the compile grid dispatches is warmup
         # class — the ledger stays monotone (no counter reset here, so
@@ -496,6 +515,7 @@ class GenerationServer(ParallelInference):
                     break
         finally:
             eng._prefixes = saved_prefixes
+            eng._radix = saved_radix
             eng.goodput.set_mode(None)
         import jax.numpy as jnp
         # speculative + shared-prefix programs: the K-position score
@@ -506,17 +526,22 @@ class GenerationServer(ParallelInference):
         score_ks = []
         if eng.spec_k:
             score_ks.append(eng.spec_k)
-        if eng.has_prefixes:
+        if eng.has_prefixes or eng._radix is not None:
             # suffix-extension buckets: every pow2 up to the prompt
-            # bucket (a hit's suffix is at most prompt minus prefix)
+            # bucket (a hit's suffix is at most prompt minus prefix) —
+            # radix hits ride the same suffix-extension score programs
             b = 1
             while b <= bucket_len(int(prompt_len), eng.max_total_tokens):
                 score_ks.append(b)
                 b *= 2
         S = eng.n_slots
         for K in sorted(set(score_ks)):
-            for greedy in (True, False):
-                score = eng._get_score(K, greedy)
+            variants = [True, False]
+            if eng.spec_sampled and eng.spec_k and K == eng.spec_k:
+                # rejection-sampling score variant (sampled streams)
+                variants.append("rs")
+            for variant in variants:
+                score = eng._get_score(K, variant)
                 eng.pool.kv = score(
                     eng._params, eng.net.net_state, eng.pool.kv,
                     jnp.asarray(eng.block_tables),
@@ -525,6 +550,14 @@ class GenerationServer(ParallelInference):
                     jnp.zeros((S, 2), jnp.uint32),
                     jnp.zeros(S, jnp.int32), jnp.zeros(S, jnp.float32),
                     jnp.ones(S, jnp.float32))[0]
+        if eng._draft_plan is not None:
+            # truncated-layer draft program: a dead dispatch (every
+            # table row garbage) compiles the k-1 micro-step scan
+            eng.goodput.set_mode("warmup")
+            try:
+                eng._run_draft([])
+            finally:
+                eng.goodput.set_mode(None)
         if eng.has_prefixes:
             # fork widths up to a full wave of mid-block tails (every
             # admission in a wave can fork one) — garbage self-copies
@@ -564,6 +597,9 @@ class GenerationServer(ParallelInference):
         eng.prefix_forks_total = 0
         eng.prefix_hits_total = 0
         eng.prefix_tokens_saved_total = 0
+        eng.spec_draft_dispatches_total = 0
+        eng.radix_hit_tokens_total = 0
+        eng.radix_evictions_total = 0
         return self
 
     # ------------------------------------------------------------- submit
@@ -654,7 +690,7 @@ class GenerationServer(ParallelInference):
         # one process (the fleet path) get distinct children; a
         # name-less server keeps the original unlabeled series
         lbl = {"server": self.name} if self.name else {}
-        return {
+        fams = {
             "queue": reg.gauge("serving_queue_depth",
                                "generation requests awaiting admission",
                                **lbl),
@@ -704,6 +740,36 @@ class GenerationServer(ParallelInference):
                 "serving_prefix_tokens_saved_total",
                 "prompt tokens NOT prefilled thanks to shared-prefix "
                 "block reuse", **lbl),
+            "spec_accept_by": {
+                p: reg.gauge(
+                    "serving_spec_accept_rate",
+                    "EWMA of the draft-token acceptance rate (speculative "
+                    "decoding; drives the auto-disable policy)",
+                    proposer=p, **lbl)
+                for p in ("ngram", "truncated")},
+            "spec_proposed_by": {
+                p: reg.counter(
+                    "serving_spec_proposed_total",
+                    "draft tokens offered to the verify dispatch",
+                    proposer=p, **lbl)
+                for p in ("ngram", "truncated")},
+            "spec_accepted_by": {
+                p: reg.counter(
+                    "serving_spec_accepted_total",
+                    "draft tokens accepted by the verify dispatch",
+                    proposer=p, **lbl)
+                for p in ("ngram", "truncated")},
+            "radix_nodes": reg.gauge(
+                "serving_radix_nodes",
+                "radix prefix-cache tree nodes currently held", **lbl),
+            "radix_hits": reg.counter(
+                "serving_radix_hit_tokens_total",
+                "prompt tokens matched in the radix prefix cache "
+                "instead of prefilled", **lbl),
+            "radix_evict": reg.counter(
+                "serving_radix_evictions_total",
+                "radix prefix-cache nodes evicted under pool pressure",
+                **lbl),
             "ttft": reg.timer("serving_ttft_seconds",
                               "submit-to-first-token latency", **lbl),
             "tpot": reg.timer("serving_tpot_seconds",
@@ -733,6 +799,15 @@ class GenerationServer(ParallelInference):
                 "TTFT decomposition: prefill completion to the consumer "
                 "seeing the first token", **lbl),
         }
+        # acceptance gauges start at 1.0, not the registry's default 0:
+        # "no evidence yet" must read healthy, or the default alert
+        # pack's acceptance-collapse rule (min over series < floor)
+        # fires on every freshly-built server before its first
+        # speculative dispatch
+        fams["spec_accept"].set(1.0)
+        for g in fams["spec_accept_by"].values():
+            g.set(1.0)
+        return fams
 
     def _slo_metrics(self):
         return self._resolve_metrics("_slo_cache", self._build_slo_metrics)
@@ -891,7 +966,8 @@ class GenerationServer(ParallelInference):
             if not eng.can_admit(len(head[0].prompt) + head[0].emitted,
                                  head[0].n_left,
                                  prompt_ids=(head[0].effective_prompt()
-                                             if eng.has_prefixes
+                                             if (eng.has_prefixes
+                                                 or eng._radix is not None)
                                              else None)):
                 # a head that can NEVER be admitted must shed, not
                 # wait — waiting would wedge the FIFO queue (and
@@ -974,7 +1050,8 @@ class GenerationServer(ParallelInference):
         # --------------------------------------------------- decode
         if eng.active.any():
             t0 = time.perf_counter()
-            emitted, finished = eng.step(speculate=self._spec_policy())
+            emitted, finished = eng.step(speculate=self._spec_policy(),
+                                         proposers=self._spec_proposers())
             dt = time.perf_counter() - t0
             # dispatch-level speculative deltas for trace attribution —
             # read BEFORE _spec_update advances the *_seen cursors
@@ -1048,6 +1125,16 @@ class GenerationServer(ParallelInference):
                                           - self._prefix_saved_seen)
                     self._prefix_saved_seen = eng.prefix_tokens_saved_total
                     self._prefix_hits_seen = eng.prefix_hits_total
+            if eng._radix is not None:
+                m["radix_nodes"].set(eng._radix.nodes)
+                if eng.radix_hit_tokens_total > self._radix_hits_seen:
+                    m["radix_hits"].inc(eng.radix_hit_tokens_total
+                                        - self._radix_hits_seen)
+                    self._radix_hits_seen = eng.radix_hit_tokens_total
+                if eng.radix_evictions_total > self._radix_evict_seen:
+                    m["radix_evict"].inc(eng.radix_evictions_total
+                                         - self._radix_evict_seen)
+                    self._radix_evict_seen = eng.radix_evictions_total
             # goodput ledger mirror: per-class counter deltas + the
             # rolling fraction (host ints the dispatch sites already
             # wrote — zero extra syncs)
@@ -1076,6 +1163,27 @@ class GenerationServer(ParallelInference):
             self._spec_probe_in = self.spec_probe_every
             return True                      # probe dispatch
         return False
+
+    def _spec_proposers(self) -> Optional[tuple]:
+        """Per-proposer arbitration on top of `_spec_policy`'s global
+        enable/disable: when the truncated-layer drafter is configured
+        and the n-gram proposer's OWN acceptance EWMA has collapsed
+        below the floor while the drafter's hasn't, restrict drafting
+        to the truncated backend — its K-wide scan is only worth
+        dispatching on lanes it can actually fill, and a dead n-gram
+        cache (non-repetitive traffic) would otherwise keep winning
+        the proposal race with garbage continuations. Returns None
+        (engine default: all proposers) otherwise; if BOTH EWMAs sink,
+        the global latch above disables speculation outright."""
+        eng = self.engine
+        if not eng.spec_k or eng._draft_plan is None:
+            return None
+        ng = self._spec_prop_ewma["ngram"]
+        tr = self._spec_prop_ewma["truncated"]
+        if ng is not None and ng < self.spec_accept_floor \
+                and (tr is None or tr >= self.spec_accept_floor):
+            return ("truncated",)
+        return None
 
     def _spec_update(self, m):
         """Fold the engine's per-dispatch speculative counters into the
@@ -1113,6 +1221,25 @@ class GenerationServer(ParallelInference):
         elif self._spec_disabled \
                 and self._spec_accept_ewma >= self.spec_accept_floor:
             self._spec_disabled = False
+        # per-proposer EWMAs (arbitration inputs for _spec_proposers):
+        # same α, same "no data this dispatch → no update" rule — a
+        # proposer that drafted nothing is judged only when it ran
+        for prop in ("ngram", "truncated"):
+            pp, pa = self._spec_prop_seen[prop]
+            tot_p = eng.spec_proposed_by[prop]
+            tot_a = eng.spec_accepted_by[prop]
+            d_pp, d_pa = tot_p - pp, tot_a - pa
+            self._spec_prop_seen[prop] = (tot_p, tot_a)
+            if d_pp > 0:
+                r = d_pa / d_pp
+                prev = self._spec_prop_ewma[prop]
+                self._spec_prop_ewma[prop] = (
+                    r if prev is None else 0.8 * prev + 0.2 * r)
+            if m is not None and d_pp > 0:
+                m["spec_proposed_by"][prop].inc(d_pp)
+                if d_pa > 0:
+                    m["spec_accepted_by"][prop].inc(d_pa)
+                m["spec_accept_by"][prop].set(self._spec_prop_ewma[prop])
         if m is not None:
             m["spec_accept"].set(self._spec_accept_ewma)
             if self._spec_tpd_ewma is not None:

@@ -549,6 +549,19 @@ class UIServer:
                          f"({100.0 * used / (used + free):.0f}%)")
         else:
             occupancy = "–"
+        def lval(name, default="–", **labels):
+            # label-selected series (e.g. per-proposer accept rate —
+            # `val` reads values[0], wrong once a family has children)
+            fam = snap.get(name)
+            for e in (fam or {}).get("values", []):
+                if all(e.get("labels", {}).get(k) == v
+                       for k, v in labels.items()):
+                    v = e.get("value", default)
+                    if isinstance(v, float) and not v.is_integer():
+                        return f"{v:.3f}"
+                    return v
+            return default
+
         rows = [
             ("queue depth", val("serving_queue_depth")),
             ("active slots", val("serving_active_slots")),
@@ -560,6 +573,13 @@ class UIServer:
             ("tokens emitted", val("serving_tokens_total", 0)),
             ("requests shed (SLO)", val("serving_shed_total", 0)),
             ("evicted mid-stream", val("serving_evicted_total", 0)),
+            ("radix-cache nodes", val("serving_radix_nodes", 0)),
+            ("radix hit tokens", val("serving_radix_hit_tokens_total", 0)),
+            ("radix evictions", val("serving_radix_evictions_total", 0)),
+            ("spec accept (ngram)",
+             lval("serving_spec_accept_rate", proposer="ngram")),
+            ("spec accept (truncated)",
+             lval("serving_spec_accept_rate", proposer="truncated")),
             ("TTFT", hist("serving_ttft_seconds")),
             ("per-token (TPOT)", hist("serving_tpot_seconds")),
             ("decode dispatch", hist("serving_step_seconds")),

@@ -249,6 +249,90 @@ def paged_score_forward(net, plan, params, state, kv, block_tables,
     return tuple(kv), h                                  # [S, K, V]
 
 
+def rejection_sample_drafts(probs, token_mat, n_valid, keys, emit_idx,
+                            temp, top_p, top_k):
+    """Speculative REJECTION SAMPLING over delta drafts — the sampled
+    counterpart of the greedy acceptance oracle (arXiv:2211.17192
+    specialized to point-mass draft distributions; serving/engine.py's
+    `_spec_step` sampled path; docs/SERVING.md).
+
+    Both proposers (n-gram suffix cache, truncated-layer drafter) emit
+    CONCRETE tokens, i.e. the draft distribution is a delta at the
+    proposed id `d`. The general rule — accept with prob
+    `min(1, p_t(x)/p_d(x))`, on rejection resample from the normalized
+    residual `max(0, p_t - p_d)` — then collapses to: accept draft `d`
+    with prob `q_t(d)`, and the residual is `q_t` with `d` masked out,
+    where `q_t` is the TARGET's filtered/tempered distribution (the
+    exact `filter_logits(log(p)/T, top_k, top_p)` chain `_sample_ids`
+    runs — one copy, no drift). Each emitted token is marginally
+    distributed as a vanilla sample from `q_t` given its prefix (the
+    chi-square harness in tests/test_serving_statistical.py holds this
+    to a distributional contract), and the acceptance identity
+    `E[#accepted at lane j] = sum_x min(q_t(x), p_d(x)) = q_t(d)`
+    falls out of the delta specialization (unit-tested).
+
+    Randomness keys off the SAME per-slot chain as vanilla decode —
+    position t consumes `fold_in(key, emit_idx + t)` — with sub-folds
+    (1 = acceptance uniform, 2 = resample/bonus categorical) so one
+    position's accept test and its resample draw are independent.
+    Fully deterministic under fixed keys.
+
+    `probs` [S, K, V] from `paged_score_forward` (probs[s, j] is the
+    target distribution AFTER consuming token_mat[s, j]); lanes
+    `1..n_valid-1` of `token_mat` are drafts. Rows with `temp == 0`
+    are computed under a guard temperature and their outputs ignored —
+    the host keeps greedy slots on the bit-exact argmax oracle.
+    Zero-support drafts (q_t(d) = 0, e.g. filtered out by top-k) are
+    always rejected: `u ~ U[0,1) < 0` never fires. Returns
+    `(n_acc [S], final [S])`: the count of leading accepted drafts and
+    the resampled/bonus token at lane `n_acc` — the slot emits
+    `n_acc + 1` tokens. Only these two small vectors cross d2h."""
+    import jax
+    import jax.numpy as jnp
+
+    S, K, V = probs.shape
+    safe_t = jnp.where(temp > 0, temp, 1.0)[:, None, None]
+    logits = jnp.log(jnp.clip(probs, 1e-9)) / safe_t
+    logits = filter_logits(
+        logits, top_k, None if top_p is None else top_p[:, None, None])
+    qt = jax.nn.softmax(logits, axis=-1)                   # [S, K, V]
+
+    # per-(slot, lane) keys: the vanilla chain's fold_in(key, t)
+    lanes = emit_idx[:, None] + jnp.arange(K)[None, :]     # [S, K]
+    pos_keys = jax.vmap(jax.vmap(jax.random.fold_in, (None, 0)),
+                        (0, 0))(keys, lanes)               # [S, K, 2]
+
+    # accept draft at lane j+1 iff u < q_t[s, j](d) and the lane is real
+    drafts = token_mat[:, 1:]                              # [S, K-1]
+    p_acc = jnp.take_along_axis(
+        qt[:, :-1, :], drafts[..., None], axis=-1)[..., 0]
+    u = jax.vmap(jax.vmap(
+        lambda k: jax.random.uniform(jax.random.fold_in(k, 1))))(
+        pos_keys[:, :-1])                                  # [S, K-1]
+    lane_ok = jnp.arange(K - 1)[None, :] < (n_valid[:, None] - 1)
+    acc = (u < p_acc) & lane_ok
+    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+
+    # resample lane n_acc: residual masks the rejected draft (a
+    # rejection implies q_t(d) < 1, so at least one other token
+    # survives the filters); all-accepted rows sample the bonus token
+    # from the last lane unmasked
+    lane = jnp.clip(n_acc, 0, K - 1)
+    final_logits = jnp.take_along_axis(
+        logits, lane[:, None, None], axis=1)[:, 0, :]      # [S, V]
+    rejected = n_acc < jnp.maximum(n_valid - 1, 0)
+    rej_tok = jnp.take_along_axis(
+        token_mat, jnp.clip(n_acc + 1, 0, K - 1)[:, None], axis=1)[:, 0]
+    mask = (jax.nn.one_hot(rej_tok, V, dtype=bool)
+            & rejected[:, None])
+    final_logits = jnp.where(mask, -jnp.inf, final_logits)
+    fin_keys = jax.vmap(lambda k: jax.random.fold_in(k, 2))(
+        jnp.take_along_axis(
+            pos_keys, lane[:, None, None], axis=1)[:, 0])  # [S, 2]
+    final = jax.vmap(jax.random.categorical)(fin_keys, final_logits)
+    return n_acc, final
+
+
 def generate(net: MultiLayerNetwork, prompt_ids, n_tokens: int, *,
              temperature: float = 1.0, top_k: int = None,
              top_p: float = None, rng=None, quantize: str = None):

@@ -292,14 +292,19 @@ class TestDefaultRulePack:
         reg.counter("registry_resolve_fallback_total", "fallbacks")
         reg.counter("fleet_swaps_total", "swaps")
         reg.counter("registry_published_total", "publishes").inc(2)
+        reg.counter("serving_radix_evictions_total", "evictions")
+        reg.gauge("serving_spec_accept_rate").set(1.0)
+        reg.gauge("serving_spec_accept_rate", proposer="ngram").set(0.8)
         return reg
 
-    def test_pack_covers_the_eight_documented_shapes(self):
+    def test_pack_covers_the_ten_documented_shapes(self):
         pack = default_rule_pack()
         assert sorted(r.name for r in pack) == [
             "checkpoint-staleness", "elastic-shrink",
-            "registry-fallback", "shed-growth", "slo-burn",
-            "swap-without-publish", "watermark-lag", "worker-vanished"]
+            "radix-eviction-churn", "registry-fallback",
+            "sampled-spec-acceptance-collapse", "shed-growth",
+            "slo-burn", "swap-without-publish", "watermark-lag",
+            "worker-vanished"]
         assert len({r.event_kind for r in pack}) == len(pack)
 
     def test_pack_clean_on_healthy_registry(self):
@@ -318,6 +323,25 @@ class TestDefaultRulePack:
         states = eng.evaluate(now=0.0)
         assert state_of(states, "checkpoint-staleness") == "firing"
         assert rec.events(kind="checkpoint_stale")
+
+    def test_pack_fires_on_radix_eviction_churn(self):
+        reg = self.healthy_registry()
+        eng, rec = make_engine(reg, *default_rule_pack(for_s=0.0))
+        eng.evaluate(now=0.0)                 # prime the delta cursor
+        reg.counter("serving_radix_evictions_total").inc(500)
+        states = eng.evaluate(now=10.0)       # 50/s >> 5/s bound
+        assert state_of(states, "radix-eviction-churn") == "firing"
+        assert rec.events(kind="radix_eviction_churn")
+
+    def test_pack_fires_on_spec_acceptance_collapse(self):
+        reg = self.healthy_registry()
+        reg.gauge("serving_spec_accept_rate",
+                  proposer="ngram").set(0.01)  # min over series
+        eng, rec = make_engine(reg, *default_rule_pack(for_s=0.0))
+        states = eng.evaluate(now=0.0)
+        assert state_of(states,
+                        "sampled-spec-acceptance-collapse") == "firing"
+        assert rec.events(kind="spec_acceptance_collapse")
 
 
 # ====================================================== gauge publish

@@ -1,0 +1,496 @@
+"""Sampled speculation, truncated-layer drafter, radix prefix cache.
+
+Unit-level contracts for the three PR-18 levers (docs/SERVING.md):
+
+- REJECTION SAMPLING over delta drafts (`rejection_sample_drafts`):
+  accept draft `d` with prob `q_t(d)`, resample the residual with `d`
+  masked out.  Pinned-key determinism, zero-support auto-rejection,
+  residual support, and the acceptance identity
+  `E[#accepted] = sum_x min(q_t(x), p_d(x)) = q_t(d)` are all exact or
+  pinned-seed checks — the large-sample marginal test lives in
+  tests/test_serving_statistical.py behind `-m statistical`.
+- TRUNCATED-LAYER DRAFTER: greedy streams stay bit-equal to vanilla
+  `generate()` whatever the drafts were (the acceptance oracle is the
+  target's own argmax), and the drafter actually proposes on
+  non-repetitive traffic where the n-gram suffix cache returns nothing.
+- RADIX PREFIX CACHE: automatic block-aligned mid-prompt dedup with
+  cache-held references, LRU eviction of unpinned leaves only, and
+  bit-exact streams for admissions that ride matched blocks.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.serving import (
+    BlockAllocator,
+    GenerationServer,
+    PagedDecodeEngine,
+)
+from deeplearning4j_tpu.serving.paged import RadixPrefixCache
+from deeplearning4j_tpu.zoo.transformer import (
+    TransformerLM,
+    generate,
+    rejection_sample_drafts,
+)
+
+V, D, HEADS, LAYERS, MAXLEN = 23, 16, 4, 2, 32
+BL = 4
+
+
+def tiny_lm(seed=3):
+    return TransformerLM(vocab_size=V, d_model=D, n_layers=LAYERS,
+                         n_heads=HEADS, max_len=MAXLEN, seed=seed).init()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return tiny_lm()
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return np.random.default_rng(5).integers(0, V, (6, 5))
+
+
+@pytest.fixture(scope="module")
+def ref_tokens(net, prompts):
+    return generate(net, prompts, 20, temperature=0)    # [6, 20]
+
+
+def drain(eng, slot2req, out, **step_kw):
+    guard = 0
+    while eng.active.any():
+        emitted, finished = eng.step(**step_kw)
+        for slot, toks in emitted.items():
+            out[slot2req[slot]].extend(toks)
+        for slot in finished:
+            del slot2req[slot]
+        guard += 1
+        assert guard < 400, "engine failed to drain"
+
+
+def admit_all(eng, reqs):
+    admitted = eng.admit_many(reqs)
+    assert len(admitted) == len(reqs)
+    s2r, out = {}, {}
+    for i, (slot, first, done) in enumerate(admitted):
+        out[i] = [first]
+        if not done:
+            s2r[slot] = i
+    return s2r, out
+
+
+# --------------------------------------------------------------------------
+# rejection-sampling math (direct calls — no engine, no model)
+# --------------------------------------------------------------------------
+def run_rs(probs, token_mat, n_valid, keys, *, emit_idx=None, temp=None,
+           top_p=None, top_k=None):
+    """Call `rejection_sample_drafts` with engine-shaped arguments."""
+    S, K, _ = probs.shape
+    if emit_idx is None:
+        emit_idx = np.zeros(S, np.int32)
+    if temp is None:
+        temp = np.ones(S, np.float32)
+    n_acc, final = rejection_sample_drafts(
+        jnp.asarray(probs, jnp.float32),
+        jnp.asarray(token_mat, jnp.int32),
+        jnp.asarray(n_valid, jnp.int32),
+        jnp.asarray(keys, jnp.uint32),
+        jnp.asarray(emit_idx, jnp.int32),
+        jnp.asarray(temp, jnp.float32),
+        None if top_p is None else jnp.asarray(top_p, jnp.float32),
+        top_k)
+    return np.asarray(n_acc), np.asarray(final)
+
+
+def batch_keys(rng, n):
+    return np.asarray(rng.integers(0, 2**32, (n, 2)), np.uint32)
+
+
+class TestRejectionSamplingMath:
+    """SAMPLE SIZES: the empirical checks below use n=4000 pinned-seed
+    draws; a binomial proportion at p=0.6 has sigma ~= 0.0077 at that
+    n, and the assertions allow ~5 sigma — deterministic under the
+    pinned seed, and far beyond any plausible implementation drift."""
+
+    def test_deterministic_under_fixed_keys(self):
+        rng = np.random.default_rng(11)
+        S, K = 4, 4
+        probs = rng.dirichlet(np.ones(V), (S, K)).astype(np.float32)
+        token_mat = rng.integers(0, V, (S, K)).astype(np.int32)
+        n_valid = np.array([K, K, 2, 1], np.int32)
+        keys = batch_keys(rng, S)
+        a1 = run_rs(probs, token_mat, n_valid, keys)
+        a2 = run_rs(probs, token_mat, n_valid, keys)
+        np.testing.assert_array_equal(a1[0], a2[0])
+        np.testing.assert_array_equal(a1[1], a2[1])
+        # a different key moves at least one row's outcome
+        other = run_rs(probs, token_mat, n_valid, batch_keys(rng, S))
+        assert (a1[0] != other[0]).any() or (a1[1] != other[1]).any()
+
+    def test_zero_support_draft_always_rejected(self):
+        """A draft outside the target's top-k filter has q_t(d) = 0
+        exactly — `u ~ U[0,1) < 0` never fires, and the residual can
+        never resample it either."""
+        n = 512
+        rng = np.random.default_rng(12)
+        probs = np.full((n, 2, V), 1e-4, np.float32)
+        probs[:, :, 0] = 0.6           # top-2 = tokens {0, 1}
+        probs[:, :, 1] = 0.3
+        probs /= probs.sum(-1, keepdims=True)
+        dead = 7                       # outside top-2: filtered to -inf
+        token_mat = np.zeros((n, 2), np.int32)
+        token_mat[:, 1] = dead
+        n_valid = np.full(n, 2, np.int32)
+        n_acc, final = run_rs(probs, token_mat, n_valid,
+                              batch_keys(rng, n), top_k=2)
+        assert (n_acc == 0).all()
+        assert (final != dead).all()
+        assert np.isin(final, [0, 1]).all()
+
+    def test_residual_masks_rejected_draft(self):
+        """With support {0, 1} and draft 0, every rejection must emit
+        token 1 — the residual `max(0, q_t - delta_d)` has exactly one
+        surviving atom."""
+        n = 2048
+        rng = np.random.default_rng(13)
+        probs = np.zeros((n, 2, V), np.float32)
+        probs[:, :, 0] = 0.6
+        probs[:, :, 1] = 0.4
+        token_mat = np.zeros((n, 2), np.int32)    # draft token 0
+        n_valid = np.full(n, 2, np.int32)
+        n_acc, final = run_rs(probs, token_mat, n_valid,
+                              batch_keys(rng, n))
+        rejected = n_acc == 0
+        assert rejected.any() and (~rejected).any()
+        assert (final[rejected] == 1).all()
+
+    def test_acceptance_identity(self):
+        """`E[accepted] = sum_x min(q_t(x), p_d(x)) = q_t(d)` for a
+        delta draft: the empirical acceptance frequency over 4000
+        pinned-seed rows tracks q_t(d) = 0.6 (tolerance ~5 sigma)."""
+        n = 4000
+        rng = np.random.default_rng(14)
+        probs = np.zeros((n, 2, V), np.float32)
+        probs[:, :, 3] = 0.6
+        probs[:, :, 4] = 0.25
+        probs[:, :, 5] = 0.15
+        token_mat = np.full((n, 2), 3, np.int32)  # draft token 3
+        n_valid = np.full(n, 2, np.int32)
+        n_acc, _ = run_rs(probs, token_mat, n_valid, batch_keys(rng, n))
+        assert abs(n_acc.mean() - 0.6) < 0.04
+
+    def test_lanewise_truncation_at_first_rejection(self):
+        """top_k=1 makes q_t one-hot: a draft equal to the argmax is
+        accepted with prob 1, any other rejected with prob 1 — so
+        acceptance counts and the final token are fully determined."""
+        rng = np.random.default_rng(15)
+        S, K = 3, 4
+        probs = np.full((S, K, V), 1e-6, np.float32)
+        probs[:, :, 2] = 0.9                      # argmax everywhere
+        token_mat = np.full((S, K), 2, np.int32)
+        token_mat[0, 1] = 9       # first draft wrong -> n_acc 0
+        token_mat[1, 2] = 9       # second draft wrong -> n_acc 1
+        n_valid = np.full(S, K, np.int32)         # row 2: all drafts ok
+        n_acc, final = run_rs(probs, token_mat, n_valid,
+                              batch_keys(rng, S), top_k=1)
+        np.testing.assert_array_equal(n_acc, [0, 1, 3])
+        # rows 0/1 resample the one-hot residual... which masked its
+        # only atom's competitor: the argmax survives unless IT was
+        # the rejected draft (it wasn't — 9 was)
+        np.testing.assert_array_equal(final, [2, 2, 2])
+
+    def test_greedy_rows_guarded(self):
+        """temp == 0 rows run under a guard temperature and must stay
+        finite — the engine ignores their outputs (greedy slots keep
+        the argmax oracle) but NaNs would poison the whole dispatch."""
+        rng = np.random.default_rng(16)
+        probs = rng.dirichlet(np.ones(V), (2, 3)).astype(np.float32)
+        token_mat = rng.integers(0, V, (2, 3)).astype(np.int32)
+        n_acc, final = run_rs(probs, token_mat,
+                              np.array([3, 3], np.int32),
+                              batch_keys(rng, 2),
+                              temp=np.array([0.0, 1.0], np.float32))
+        assert (0 <= final).all() and (final < V).all()
+        assert (0 <= n_acc).all() and (n_acc <= 2).all()
+
+
+# --------------------------------------------------------------------------
+# engine: sampled speculation
+# --------------------------------------------------------------------------
+class TestSampledSpeculation:
+    def test_requires_speculative(self, net):
+        with pytest.raises(ValueError, match="spec_sampled"):
+            PagedDecodeEngine(net, n_slots=2, n_blocks=16, block_len=BL,
+                              spec_sampled=True)
+
+    def test_mixed_wave_emits_and_conserves(self, net, prompts,
+                                            ref_tokens):
+        """A mixed greedy+sampled wave under spec_sampled=True: greedy
+        slots stay bit-equal to vanilla generate() (their oracle is
+        untouched), sampled slots emit exactly n_tokens of in-vocab
+        ids, drafts flow to sampled slots too, and the goodput ledger
+        stays conserved."""
+        eng = PagedDecodeEngine(net, n_slots=4, n_blocks=48, block_len=BL,
+                                speculative=4, spec_sampled=True)
+        n = 20
+        reqs = [dict(prompt_ids=prompts[0], n_tokens=n),
+                dict(prompt_ids=prompts[1], n_tokens=n, temperature=1.0,
+                     rng=np.array([0, 7], np.uint32)),
+                dict(prompt_ids=prompts[2], n_tokens=n),
+                dict(prompt_ids=prompts[3], n_tokens=n, temperature=0.8,
+                     top_p=0.95, rng=np.array([0, 9], np.uint32))]
+        s2r, out = admit_all(eng, reqs)
+        drain(eng, s2r, out, speculate=True)
+        for i in (0, 2):
+            np.testing.assert_array_equal(
+                np.asarray(out[i], np.int64),
+                np.asarray(ref_tokens[i], np.int64))
+        for i in (1, 3):
+            assert len(out[i]) == n
+            assert all(0 <= t < V for t in out[i])
+        assert eng.spec_dispatches_total > 0
+        # sampled slots took real drafts (depth > 1) at least once
+        assert eng.spec_proposed_total > 0
+        assert eng.spec_accepted_total <= eng.spec_proposed_total
+        assert eng.goodput.conserved()
+
+    def test_sampled_slots_stay_depth_one_by_default(self, net, prompts):
+        """spec_sampled=False (the default): sampled slots ride the
+        dispatch at depth 1 — the PR-14 contract that sampled streams
+        are bit-equal to the spec-free engine stays test-enforced in
+        test_serving_spec.py; here we pin the counter shape."""
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=32, block_len=BL,
+                                speculative=4)
+        reqs = [dict(prompt_ids=prompts[0], n_tokens=12, temperature=1.0,
+                     rng=np.array([0, 3], np.uint32))]
+        s2r, out = admit_all(eng, reqs)
+        drain(eng, s2r, out, speculate=True)
+        assert eng.spec_proposed_total == 0    # no sampled drafting
+        assert eng.goodput.conserved()
+
+
+# --------------------------------------------------------------------------
+# engine: truncated-layer drafter
+# --------------------------------------------------------------------------
+class TestTruncatedDrafter:
+    def test_requires_speculative(self, net):
+        with pytest.raises(ValueError, match="spec_draft_layers"):
+            PagedDecodeEngine(net, n_slots=2, n_blocks=16, block_len=BL,
+                              spec_draft_layers=1)
+
+    def test_must_truncate_strictly(self, net):
+        with pytest.raises(ValueError, match="strict truncation"):
+            PagedDecodeEngine(net, n_slots=2, n_blocks=16, block_len=BL,
+                              speculative=4, spec_draft_layers=LAYERS)
+
+    def test_greedy_parity_with_drafting(self, net, prompts, ref_tokens):
+        """Whatever the truncated model drafts, greedy emission equals
+        vanilla generate() bit-for-bit — the verify dispatch's argmax
+        is the oracle, drafts only set how far one dispatch reaches."""
+        eng = PagedDecodeEngine(net, n_slots=4, n_blocks=48, block_len=BL,
+                                speculative=4, spec_draft_layers=1)
+        reqs = [dict(prompt_ids=prompts[i], n_tokens=20)
+                for i in range(4)]
+        s2r, out = admit_all(eng, reqs)
+        drain(eng, s2r, out, speculate=True)
+        for i in range(4):
+            np.testing.assert_array_equal(
+                np.asarray(out[i], np.int64),
+                np.asarray(ref_tokens[i], np.int64))
+        # random prompts: the n-gram suffix cache starts empty, so the
+        # truncated drafter carried real proposals
+        assert eng.spec_proposed_by["truncated"] > 0
+        assert eng.spec_draft_dispatches_total > 0
+        assert (eng.spec_proposed_by["ngram"]
+                + eng.spec_proposed_by["truncated"]
+                == eng.spec_proposed_total)
+        assert (eng.spec_accepted_by["ngram"]
+                + eng.spec_accepted_by["truncated"]
+                == eng.spec_accepted_total)
+        assert eng.goodput.conserved()
+
+    def test_proposer_restriction(self, net, prompts, ref_tokens):
+        """`proposers=("truncated",)` (the scheduler's arbitration when
+        the n-gram EWMA collapses) keeps the n-gram cache silent."""
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=32, block_len=BL,
+                                speculative=4, spec_draft_layers=1)
+        reqs = [dict(prompt_ids=prompts[0], n_tokens=20)]
+        s2r, out = admit_all(eng, reqs)
+        drain(eng, s2r, out, speculate=True, proposers=("truncated",))
+        np.testing.assert_array_equal(
+            np.asarray(out[0], np.int64),
+            np.asarray(ref_tokens[0], np.int64))
+        assert eng.spec_proposed_by["ngram"] == 0
+        assert eng.spec_proposed_by["truncated"] > 0
+
+
+# --------------------------------------------------------------------------
+# radix prefix cache: tree unit level
+# --------------------------------------------------------------------------
+class TestRadixTree:
+    def _cache(self, n_blocks=32):
+        alloc = BlockAllocator(n_blocks)
+        return alloc, RadixPrefixCache(alloc, BL)
+
+    def test_insert_match_roundtrip(self):
+        alloc, cache = self._cache()
+        toks = list(range(12))
+        blocks = alloc.allocate(3)
+        assert cache.insert(toks, blocks) == 3
+        assert cache.nodes == 1
+        assert all(alloc.refcount(b) == 2 for b in blocks)
+        m, got = cache.match(toks + [99])
+        assert m == 12 and got == blocks
+        # a diverging prompt matches only the shared leading blocks
+        m, got = cache.match(toks[:8] + [99, 98, 97, 96])
+        assert m == 8 and got == blocks[:2]
+
+    def test_split_on_divergence(self):
+        alloc, cache = self._cache()
+        a = alloc.allocate(3)
+        cache.insert(list(range(12)), a)
+        b = alloc.allocate(3)
+        # same first block, divergent afterwards -> split at boundary
+        cache.insert(list(range(4)) + [20, 21, 22, 23, 24, 25, 26, 27], b)
+        assert cache.nodes == 3          # upper + two tails
+        # the shared first block was NOT re-referenced: the tree keeps
+        # its original block, the new edge holds only the tail
+        assert alloc.refcount(a[0]) == 2
+        assert alloc.refcount(b[0]) == 1   # caller's ref only
+        m, got = cache.match(list(range(4)) + [20, 21, 22, 23])
+        assert m == 8 and got == [a[0], b[1]]
+
+    def test_cache_outlives_the_inserter(self):
+        """The cache holds its OWN reference per block: the inserting
+        slot's release leaves the prefix resident (the automatic
+        version of register_prefix's pin)."""
+        alloc, cache = self._cache()
+        blocks = alloc.allocate(2)
+        cache.insert(list(range(8)), blocks)
+        alloc.free(blocks)               # the slot finished
+        assert all(alloc.refcount(b) == 1 for b in blocks)
+        m, got = cache.match(list(range(8)) + [1])
+        assert m == 8 and got == blocks
+
+    def test_evict_lru_leaves_first(self):
+        alloc, cache = self._cache()
+        a = alloc.allocate(2)
+        cache.insert([1, 2, 3, 4, 5, 6, 7, 8], a)
+        b = alloc.allocate(2)
+        cache.insert([9, 10, 11, 12, 13, 14, 15, 16], b)
+        alloc.free(a)
+        alloc.free(b)
+        cache.match([9, 10, 11, 12])     # touch b: a becomes LRU
+        freed = cache.evict_lru()
+        assert freed == 2
+        assert cache.nodes == 1
+        assert all(alloc.refcount(x) == 0 for x in a)
+        m, _ = cache.match([1, 2, 3, 4])
+        assert m == 0                    # a is gone
+        m, _ = cache.match([9, 10, 11, 12])
+        assert m == 4                    # b survives
+
+    def test_pinned_nodes_never_evict(self):
+        alloc, cache = self._cache()
+        a = alloc.allocate(1)
+        cache.insert([1, 2, 3, 4], a)
+        for n in cache._iter_nodes():
+            n.pinned = True
+        assert cache.evict_lru() == 0
+        assert cache.evictable_blocks == 0
+
+    def test_clear_releases_everything(self):
+        alloc, cache = self._cache()
+        free0 = alloc.free_blocks
+        a = alloc.allocate(2)
+        cache.insert([1, 2, 3, 4, 5, 6, 7, 8], a)
+        alloc.free(a)
+        assert cache.clear() == 2
+        assert cache.nodes == 0
+        assert alloc.free_blocks == free0
+
+
+# --------------------------------------------------------------------------
+# radix prefix cache: engine level
+# --------------------------------------------------------------------------
+class TestRadixEngine:
+    def test_mode_validated(self, net):
+        with pytest.raises(ValueError, match="prefix_cache"):
+            PagedDecodeEngine(net, n_slots=2, n_blocks=16, block_len=BL,
+                              prefix_cache="lru")
+
+    def test_auto_dedup_is_bit_exact(self, net):
+        """Two admissions sharing two full blocks of prompt: the second
+        rides the first's cached blocks (no register_prefix anywhere)
+        and still emits exactly what a private-prefill engine does."""
+        rng = np.random.default_rng(21)
+        shared = rng.integers(0, V, 8)
+        p1 = np.concatenate([shared, rng.integers(0, V, 2)])
+        p2 = np.concatenate([shared, rng.integers(0, V, 2)])
+        ref = generate(net, np.stack([p1, p2]), 12, temperature=0)
+
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=32, block_len=BL,
+                                prefix_cache="radix")
+        s2r, out = admit_all(eng, [dict(prompt_ids=p1, n_tokens=12)])
+        drain(eng, s2r, out)
+        res1 = out[0]
+        assert eng.radix_hit_tokens_total == 0   # first ever admission
+        s2r, out = admit_all(eng, [dict(prompt_ids=p2, n_tokens=12)])
+        drain(eng, s2r, out)
+        res2 = out[0]
+        np.testing.assert_array_equal(np.asarray(res1, np.int64),
+                                      np.asarray(ref[0], np.int64))
+        np.testing.assert_array_equal(np.asarray(res2, np.int64),
+                                      np.asarray(ref[1], np.int64))
+        assert eng.radix_hit_tokens_total == 8   # both full blocks
+        assert eng.prefix_hits_total == 1
+        assert eng.prefix_tokens_saved_total == 8
+
+    def test_full_prompt_match_is_capped(self, net):
+        """An identical prompt must still compute its own first token:
+        the match is capped one block below the full prompt, so the
+        suffix-extension path always runs (no cached probs exist)."""
+        rng = np.random.default_rng(22)
+        p = rng.integers(0, V, 8)        # exactly two blocks
+        ref = generate(net, p[None, :], 10, temperature=0)[0]
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=32, block_len=BL,
+                                prefix_cache="radix")
+        for _ in range(2):
+            s2r, out = admit_all(eng, [dict(prompt_ids=p, n_tokens=10)])
+            drain(eng, s2r, out)
+            np.testing.assert_array_equal(np.asarray(out[0], np.int64),
+                                          np.asarray(ref, np.int64))
+        assert eng.radix_hit_tokens_total == 4   # capped below P=8
+
+    def test_eviction_under_pool_pressure(self, net):
+        """A pool too small to hold every cached prefix evicts radix
+        LRU leaves instead of refusing admission — and the eviction
+        counter records it."""
+        rng = np.random.default_rng(23)
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=10, block_len=BL,
+                                prefix_cache="radix")
+        for i in range(6):
+            p = rng.integers(0, V, 8)
+            s2r, out = admit_all(eng, [dict(prompt_ids=p, n_tokens=6)])
+            drain(eng, s2r, out)
+            assert len(out[0]) == 6
+        assert eng.radix_evictions_total > 0
+        assert eng.goodput.conserved()
+
+    def test_budget_ignores_radix_blocks(self, net):
+        """Radix-held blocks are reclaimable, not pinned capacity:
+        check_budget and can_admit treat them as available."""
+        rng = np.random.default_rng(24)
+        eng = PagedDecodeEngine(net, n_slots=2, n_blocks=10, block_len=BL,
+                                prefix_cache="radix")
+        p = rng.integers(0, V, 8)
+        s2r, out = admit_all(eng, [dict(prompt_ids=p, n_tokens=6)])
+        drain(eng, s2r, out)
+        assert eng._radix.held_blocks > 0
+        # a request needing nearly the whole pool must still pass
+        eng.check_budget(16, 8)
+        assert eng.can_admit(16, 8)

@@ -1,0 +1,198 @@
+"""Distributional contract of sampled speculation (`-m statistical`).
+
+Rejection sampling over delta drafts promises each emitted token is
+MARGINALLY a vanilla sample from the target's filtered/tempered
+distribution `q_t` given its prefix — a distributional contract, not a
+bit one (docs/SERVING.md acceptance-oracle table; the greedy contract
+stays bit-exact and is enforced in test_serving_spec.py /
+test_serving_rs.py).  These tests hold that contract with chi-square
+goodness-of-fit over large-sample token marginals.
+
+DETERMINISM + FALSE-POSITIVE BUDGET: every test pins its seeds, so
+tier-1 runs are bit-reproducible; the chi-square thresholds are the
+q = 1 - 1e-4 quantiles, so even under seed churn a correct
+implementation fails any single test with probability < 1e-4.  Sample
+sizes (documented per test) are chosen so the tests also have power:
+at n = 20000 a total-variation defect of ~2% in a 6-atom marginal
+drives the statistic past the threshold with near-certainty.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.zoo.transformer import (
+    filter_logits,
+    rejection_sample_drafts,
+)
+
+V = 23
+
+pytestmark = pytest.mark.statistical
+
+
+def chi2_crit(df: int, q: float = 0.9999) -> float:
+    """Upper chi-square quantile: scipy when present, Wilson-Hilferty
+    otherwise (accurate to ~1% at these df — the +5% safety margin in
+    the callers swamps it)."""
+    try:
+        from scipy.stats import chi2
+        return float(chi2.ppf(q, df))
+    except Exception:  # noqa: BLE001 — scipy is optional
+        z = 3.719      # standard normal quantile at 1 - 1e-4
+        a = 2.0 / (9.0 * df)
+        return df * (1.0 - a + z * np.sqrt(a)) ** 3
+
+
+def chi2_stat(counts: np.ndarray, expected: np.ndarray) -> float:
+    keep = expected > 0
+    return float(((counts[keep] - expected[keep]) ** 2
+                  / expected[keep]).sum())
+
+
+def target_dist(probs_row: np.ndarray, temp: float, top_k, top_p):
+    """The exact q_t the engine samples from: the
+    `filter_logits(log(clip(p, 1e-9)) / T, top_k, top_p)` chain
+    `_sample_ids` and `rejection_sample_drafts` share — replayed once
+    here to get analytic expected counts."""
+    logits = jnp.log(jnp.clip(jnp.asarray(probs_row, jnp.float32),
+                              1e-9)) / temp
+    logits = filter_logits(logits[None, :], top_k,
+                           None if top_p is None else
+                           jnp.full((1, 1), top_p, jnp.float32))
+    return np.asarray(jax.nn.softmax(logits, axis=-1))[0]
+
+
+def run_rs(probs, token_mat, n_valid, keys, temp, top_p=None, top_k=None):
+    S = probs.shape[0]
+    out = rejection_sample_drafts(
+        jnp.asarray(probs, jnp.float32),
+        jnp.asarray(token_mat, jnp.int32),
+        jnp.asarray(n_valid, jnp.int32),
+        jnp.asarray(keys, jnp.uint32),
+        jnp.zeros(S, jnp.int32),
+        jnp.full(S, temp, jnp.float32),
+        None if top_p is None else jnp.full(S, top_p, jnp.float32),
+        top_k)
+    return np.asarray(out[0]), np.asarray(out[1])
+
+
+def emitted_first_token(n_acc, final, token_mat):
+    """The token a slot emits at the FIRST speculative position: the
+    draft when lane 1 was accepted, the residual/bonus sample when
+    not."""
+    return np.where(n_acc >= 1, token_mat[:, 1], final)
+
+
+class TestMarginalChiSquare:
+    """n = 20000 rows per case; every row is an independent
+    (key, accept-test, resample) chain with the SAME target
+    distribution, so token counts are multinomial(n, q_t)."""
+
+    N = 20000
+
+    def _case(self, seed, temp, top_k, top_p, peak):
+        rng = np.random.default_rng(seed)
+        base = rng.dirichlet(np.ones(V) * 0.4)
+        base = 0.5 * base + 0.5 * peak
+        probs = np.broadcast_to(base, (self.N, 2, V)).astype(np.float32)
+        qt = target_dist(base, temp, top_k, top_p)
+        draft = int(np.argsort(qt)[-2])       # a plausible-but-not-top
+        token_mat = np.zeros((self.N, 2), np.int32)
+        token_mat[:, 1] = draft
+        keys = np.asarray(rng.integers(0, 2**32, (self.N, 2)), np.uint32)
+        n_acc, final = run_rs(probs, token_mat,
+                              np.full(self.N, 2, np.int32), keys,
+                              temp, top_p, top_k)
+        emitted = emitted_first_token(n_acc, final, token_mat)
+        return qt, emitted
+
+    def _assert_fits(self, qt, emitted):
+        expected = qt * len(emitted)
+        # chi-square needs expected counts >= ~5: lump the tail mass
+        big = expected >= 5.0
+        counts = np.bincount(emitted, minlength=V).astype(float)
+        obs = np.append(counts[big], counts[~big].sum())
+        exp = np.append(expected[big], expected[~big].sum())
+        df = len(obs) - 1
+        stat = chi2_stat(obs, exp)
+        assert stat < 1.05 * chi2_crit(df), (
+            f"chi2={stat:.1f} over df={df} exceeds the 1e-4 critical "
+            f"value {chi2_crit(df):.1f} — the emitted marginal has "
+            f"drifted from the target distribution")
+
+    def test_marginal_matches_target_plain(self):
+        peak = np.zeros(V)
+        peak[[2, 5, 9]] = [0.5, 0.3, 0.2]
+        qt, emitted = self._case(seed=101, temp=1.0, top_k=None,
+                                 top_p=None, peak=peak)
+        self._assert_fits(qt, emitted)
+
+    def test_marginal_matches_target_tempered_topk(self):
+        peak = np.zeros(V)
+        peak[[1, 3, 4, 8]] = [0.4, 0.3, 0.2, 0.1]
+        qt, emitted = self._case(seed=102, temp=0.7, top_k=6,
+                                 top_p=None, peak=peak)
+        self._assert_fits(qt, emitted)
+
+    def test_marginal_matches_target_nucleus(self):
+        peak = np.zeros(V)
+        peak[[0, 7, 11, 19]] = [0.35, 0.3, 0.2, 0.15]
+        qt, emitted = self._case(seed=103, temp=1.2, top_k=None,
+                                 top_p=0.9, peak=peak)
+        self._assert_fits(qt, emitted)
+
+    def test_matches_vanilla_sampler_two_sample(self):
+        """Rejection-path emissions vs `jax.random.categorical` draws
+        from the SAME filtered logits (matched temperature/top-k/top-p
+        — the vanilla `_sample_ids` tail): two-sample chi-square
+        homogeneity at n = 20000 per arm."""
+        rng = np.random.default_rng(104)
+        peak = np.zeros(V)
+        peak[[2, 6, 13]] = [0.45, 0.35, 0.2]
+        qt, emitted = self._case(seed=104, temp=0.8, top_k=8,
+                                 top_p=None, peak=peak)
+        n = len(emitted)
+        vkeys = jax.vmap(jax.random.PRNGKey)(
+            jnp.asarray(rng.integers(0, 2**31, n)))
+        logits = jnp.log(jnp.clip(jnp.asarray(qt, jnp.float32), 1e-12))
+        vanilla = np.asarray(jax.vmap(
+            lambda k: jax.random.categorical(k, logits))(vkeys))
+        c1 = np.bincount(emitted, minlength=V).astype(float)
+        c2 = np.bincount(vanilla, minlength=V).astype(float)
+        tot = c1 + c2
+        big = tot >= 10.0
+        c1 = np.append(c1[big], c1[~big].sum())
+        c2 = np.append(c2[big], c2[~big].sum())
+        tot = c1 + c2
+        keep = tot > 0
+        # standard 2xk homogeneity statistic, df = k-1 (equal arms)
+        exp1, exp2 = tot[keep] / 2.0, tot[keep] / 2.0
+        stat = chi2_stat(c1[keep], exp1) + chi2_stat(c2[keep], exp2)
+        df = int(keep.sum()) - 1
+        assert stat < 1.05 * chi2_crit(df), (
+            f"chi2={stat:.1f} over df={df}: rejection-sampling "
+            f"emissions are distinguishable from vanilla sampling")
+
+    def test_acceptance_rate_tracks_draft_mass(self):
+        """E[n_acc at lane 1] = q_t(d): binomial check at n = 20000
+        (sigma ~= 0.0035) — 5-sigma tolerance."""
+        peak = np.zeros(V)
+        peak[[4, 10]] = [0.6, 0.4]
+        qt_emitted = self._case(seed=105, temp=1.0, top_k=None,
+                                top_p=None, peak=peak)
+        qt, _ = qt_emitted
+        rng = np.random.default_rng(105)
+        base = rng.dirichlet(np.ones(V) * 0.4)
+        base = 0.5 * base + 0.5 * peak
+        probs = np.broadcast_to(base, (self.N, 2, V)).astype(np.float32)
+        draft = int(np.argsort(qt)[-2])
+        token_mat = np.zeros((self.N, 2), np.int32)
+        token_mat[:, 1] = draft
+        keys = np.asarray(rng.integers(0, 2**32, (self.N, 2)), np.uint32)
+        n_acc, _ = run_rs(probs, token_mat,
+                          np.full(self.N, 2, np.int32), keys, 1.0)
+        assert abs(n_acc.mean() - qt[draft]) < 5 * np.sqrt(
+            qt[draft] * (1 - qt[draft]) / self.N)
