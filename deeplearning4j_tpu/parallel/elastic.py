@@ -322,7 +322,17 @@ class ElasticCoordinator:
     def _commit_generation(self):
         # lock held by caller
         self._generation += 1
-        members = sorted(self._members.values(), key=lambda m: m.token)
+        # serving members advertise capacity, not training ranks: they
+        # never enter the rank-numbered data-parallel plan (a decode
+        # replica must not shift every trainer's rank when it joins),
+        # but ride the SAME generation number so a router sees one
+        # consistent replica view across joins/deaths
+        members = sorted((m for m in self._members.values()
+                          if m.info.get("role") != "serving"),
+                         key=lambda m: m.token)
+        serving = sorted((m for m in self._members.values()
+                          if m.info.get("role") == "serving"),
+                         key=lambda m: m.token)
         port = self.jax_port_base + (self._generation % self.jax_port_span)
         self._plan = {
             "generation": self._generation,
@@ -330,6 +340,9 @@ class ElasticCoordinator:
             "members": [{"token": m.token, "host": m.host,
                          "device_count": m.device_count, "rank": r}
                         for r, m in enumerate(members)],
+            "serving_members": [{"token": m.token, "host": m.host,
+                                 "info": dict(m.info)}
+                                for m in serving],
             "coordinator_address": (f"{members[0].host}:{port}"
                                     if members else None),
         }
@@ -370,6 +383,41 @@ class ElasticCoordinator:
                                     "device_count": m.device_count,
                                     "info": dict(m.info)}
                                 for t, m in self._members.items()}}
+
+
+def serving_directory(status: dict, model: Optional[str] = None) -> dict:
+    """Replica view over a coordinator `status()` payload: the live
+    serving-role members (optionally filtered to one model) with the
+    freshest heartbeat-carried load gauges, under the membership
+    generation number. This is what a router polls — `status()`
+    reflects member info updated on EVERY heartbeat, while the
+    committed plan only snapshots info at generation boundaries.
+
+    Returns ``{"generation": g, "replicas": [{token, host, port,
+    model, load}, ...]}`` with replicas in stable token order; `load`
+    carries whatever gauges the replica advertised (queue_depth,
+    outstanding_tokens, ewma_tok_s, open_streams, n_slots)."""
+    replicas = []
+    for token, m in (status.get("members") or {}).items():
+        info = m.get("info") or {}
+        if info.get("role") != "serving":
+            continue
+        if model is not None and info.get("model") != model:
+            continue
+        addr = info.get("addr") or [m.get("host"), None]
+        replicas.append({
+            "token": token,
+            "host": addr[0],
+            "port": None if addr[1] is None else int(addr[1]),
+            "model": info.get("model"),
+            "version": info.get("version"),
+            "load": {k: info[k] for k in
+                     ("queue_depth", "outstanding_tokens", "ewma_tok_s",
+                      "open_streams", "n_slots") if k in info},
+        })
+    replicas.sort(key=lambda r: r["token"])
+    return {"generation": int(status.get("generation") or 0),
+            "replicas": replicas}
 
 
 # =====================================================================
@@ -416,6 +464,22 @@ class ElasticClient:
         reply = self._request(self._registration)
         self._absorb(reply)
         return reply
+
+    def register_serving(self, *, model: str, host: str, port: int,
+                         info: Optional[dict] = None) -> dict:
+        """Register as a SERVING member: advertises capacity for
+        `model` at `host:port` instead of training ranks. Serving
+        members never enter the rank-numbered training plan; they show
+        up in `plan["serving_members"]` / `serving_directory()` under
+        the same generation numbers. Load gauges (queue depth,
+        outstanding tokens, tok/s EWMA) ride `set_info` on every
+        heartbeat."""
+        full = {"role": "serving", "model": str(model),
+                "addr": [host, int(port)]}
+        full.update(info or {})
+        with self._lock:
+            self._info.update(full)
+        return self.register(host=host, device_count=0, info=full)
 
     def leave(self, reason: str = "unspecified"):
         try:

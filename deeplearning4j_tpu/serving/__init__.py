@@ -12,10 +12,16 @@ plus the fleet deployment plane around it.
   checkpoint-as-publish listener)
 - `serving.fleet`    — `FleetServer` multi-model hosting with
   zero-downtime hot-swap + `FleetAutoscaler`
-- `serving.router`   — `FleetRouter` front end (weighted SLO shedding,
-  transport request plane) + `FleetClient`
-- `serving.wire`     — request/reply frames over the streaming
-  transports' ndarray wire format
+- `serving.router`   — `FleetRouter` front end (least-loaded replica
+  balancing, weighted SLO shedding, transport request plane) +
+  `FleetClient` + `MigratingStream`
+- `serving.wire`     — request/reply/handoff frames over the streaming
+  transports' ndarray wire format (typed `WireFormatError` decoding)
+- `serving.replica`  — horizontal serving: `ReplicaWorker` processes
+  behind the elastic coordinator, `ReplicaSet`/`ReplicaClient` on the
+  router side, `ReplicaManager` + `spawn_replica` for fleets
+- `serving.disagg`   — disaggregated prefill/decode workers over the
+  `DLFP` paged-K/V handoff frame
 
 See docs/SERVING.md for the scheduler model, the paged-pool
 invariants, the shedding policy, the decode-parity contract, and the
@@ -45,8 +51,23 @@ from deeplearning4j_tpu.serving.fleet import FleetAutoscaler, FleetServer
 from deeplearning4j_tpu.serving.router import (
     FleetClient,
     FleetRouter,
+    MigratingStream,
     RemoteTokenStream,
     UnknownModelError,
+)
+from deeplearning4j_tpu.serving.wire import WireFormatError
+from deeplearning4j_tpu.serving.replica import (
+    ReplicaClient,
+    ReplicaLostError,
+    ReplicaManager,
+    ReplicaSet,
+    ReplicaWorker,
+    spawn_replica,
+)
+from deeplearning4j_tpu.serving.disagg import (
+    DecodeWorker,
+    PrefillWorker,
+    run_disaggregated,
 )
 
 __all__ = [
@@ -55,5 +76,9 @@ __all__ = [
     "ServerDrainingError", "ServerStoppedError",
     "ModelRegistry", "RegistryPublishListener", "VersionConflictError",
     "FleetServer", "FleetAutoscaler",
-    "FleetRouter", "FleetClient", "RemoteTokenStream", "UnknownModelError",
+    "FleetRouter", "FleetClient", "MigratingStream", "RemoteTokenStream",
+    "UnknownModelError",
+    "WireFormatError", "ReplicaClient", "ReplicaLostError",
+    "ReplicaManager", "ReplicaSet", "ReplicaWorker", "spawn_replica",
+    "PrefillWorker", "DecodeWorker", "run_disaggregated",
 ]

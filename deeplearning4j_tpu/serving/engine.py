@@ -1738,3 +1738,115 @@ class PagedDecodeEngine:
         self.active[slot] = False
         self.remaining[slot] = 0
         self.block_tables[slot] = GARBAGE_BLOCK
+
+    # --------------------------------------- disaggregation handoff
+    def export_handoff(self, slot: int) -> Tuple[dict, np.ndarray]:
+        """Serialize one LIVE slot for a prefill→decode handoff: the
+        paged block table is the handoff format — the returned header
+        is the slot's full host state, the array its granted K/V
+        blocks gathered from the pool and stacked
+        ``[n_layers, 2, n_blocks, block_len, heads, head_dim]`` in the
+        pool's compute dtype. `wire.encode_handoff` puts both on the
+        ND4T wire; a decode engine's `adopt_handoff` rebuilds the slot
+        bit-identically (shared/CoW source blocks are gathered by
+        VALUE, so the adopting pool always gets private copies).
+
+        The exporting engine is left untouched — the caller releases
+        the slot with `evict()` once the handoff is safely delivered
+        (at-least-once: a failed send keeps the slot decodable here)."""
+        s = self.slots[slot]
+        if s is None:
+            raise ValueError(f"slot {slot} is not in use")
+        if not self.active[slot]:
+            raise ValueError(
+                f"slot {slot} already finished — nothing to hand off")
+        idx = np.asarray(s.blocks, np.int64)
+        per_layer = []
+        for (k, v) in self.pool.kv:
+            per_layer.append(np.stack([np.asarray(k)[idx],
+                                       np.asarray(v)[idx]]))
+        kv = np.stack(per_layer)
+        header = {
+            "request_id": s.request_id,
+            "prompt_len": int(s.prompt_len),
+            "n_tokens": int(s.n_tokens),
+            "pos": int(self.pos[slot]),
+            "remaining": int(self.remaining[slot]),
+            "emitted": int(s.emitted),
+            "emit_base": int(s.emit_base),
+            "emit_idx": int(self.emit_idx[slot]),
+            "last_token": int(self.last_token[slot]),
+            "history": [int(t) for t in s.history],
+            "keys": [int(x) for x in self.keys[slot]],
+            "temperature": float(self.temp[slot]),
+            "top_p": float(self.top_p[slot]),
+            "block_len": int(self.block_len),
+            "n_layers": len(self.pool.kv),
+        }
+        return header, kv
+
+    def adopt_handoff(self, header: dict, kv) -> int:
+        """Adopt a handed-off slot: allocate private blocks, scatter
+        the K/V payload into the pool, and rebuild the host slot state
+        so the next `step()` continues the stream bit-identically to
+        the exporting engine having kept it (the PR-9 parity contract
+        extended across the wire). Raises ValueError on a pool-shape/
+        dtype mismatch, RuntimeError when no slot or blocks are free
+        (the caller's backpressure signal — nothing is mutated)."""
+        kv = np.asarray(kv)
+        L = len(self.pool.kv)
+        k0 = self.pool.kv[0][0]
+        if kv.ndim != 6 or kv.shape[0] != L or kv.shape[1] != 2:
+            raise ValueError(
+                f"handoff K/V shape {kv.shape} does not match this "
+                f"pool's {L} layers")
+        if int(header["block_len"]) != self.block_len:
+            raise ValueError(
+                f"handoff block_len {header['block_len']} != engine "
+                f"block_len {self.block_len}")
+        if tuple(kv.shape[3:]) != tuple(k0.shape[1:]):
+            raise ValueError(
+                f"handoff block shape {kv.shape[3:]} != pool block "
+                f"shape {tuple(k0.shape[1:])}")
+        if np.dtype(kv.dtype) != np.dtype(k0.dtype):
+            raise ValueError(
+                f"handoff dtype {kv.dtype} != pool compute dtype "
+                f"{k0.dtype} — a silent cast would break bit-parity")
+        slot = next((i for i, s in enumerate(self.slots) if s is None),
+                    None)
+        if slot is None:
+            raise RuntimeError("no free slot to adopt the handoff")
+        n_blocks = int(kv.shape[2])
+        blocks = self._alloc_admit(n_blocks)
+        if blocks is None:
+            raise RuntimeError(
+                f"pool cannot grant {n_blocks} blocks for the handoff "
+                f"({self.pool.free_blocks} free)")
+        bidx = jnp.asarray(np.asarray(blocks, np.int32))
+        new_kv = []
+        for l, (k, v) in enumerate(self.pool.kv):
+            new_kv.append((k.at[bidx].set(jnp.asarray(kv[l, 0])),
+                           v.at[bidx].set(jnp.asarray(kv[l, 1]))))
+        self.pool.kv = tuple(new_kv)
+        s = Slot(header.get("request_id"), blocks,
+                 int(header["prompt_len"]), int(header["n_tokens"]),
+                 emit_base=int(header.get("emit_base") or 0),
+                 history=[int(t) for t in (header.get("history") or [])])
+        s.emitted = int(header["emitted"])
+        s.pos = int(header["pos"])
+        self.slots[slot] = s
+        self.block_tables[slot] = GARBAGE_BLOCK
+        self.block_tables[slot, :len(blocks)] = blocks
+        self.pos[slot] = int(header["pos"])
+        self.remaining[slot] = int(header["remaining"])
+        self.emit_idx[slot] = int(
+            header.get("emit_idx", s.emit_base + s.emitted))
+        self.last_token[slot] = int(header["last_token"])
+        self.keys[slot] = np.asarray(header.get("keys") or [0, 0],
+                                     np.uint32)
+        self.temp[slot] = float(header.get("temperature") or 0.0)
+        tp = header.get("top_p")
+        self.top_p[slot] = 1.0 if tp is None else float(tp)
+        self.active[slot] = int(header["remaining"]) > 0
+        self.block_grants_total += n_blocks
+        return slot

@@ -373,6 +373,27 @@ class FleetServer:
             with self._lock:
                 d = self._models[name]
                 d.server, d.version, d.server_kw = successor, v, kw
+            # queued-but-unstarted requests MIGRATE to the warmed
+            # successor instead of waiting out the incumbent's drain
+            # behind its in-flight streams: a queued request has
+            # emitted nothing, so it has no old-weights state to honor
+            # — it moves wholesale (same TokenStream, same consumer
+            # future) and decodes entirely on the successor. In-flight
+            # streams stay put and finish on the old weights (the
+            # version-parity contract).
+            moved = old_server.export_queued()
+            if moved:
+                try:
+                    successor.adopt_queued(moved)
+                    GLOBAL_FLIGHT_RECORDER.record(
+                        "swap_migrate", model=name, count=len(moved),
+                        to_version=v)
+                except Exception:  # noqa: BLE001 — a refusing successor
+                    # must not lose the requests: put them back on the
+                    # incumbent (drain below then serves them out)
+                    log.exception("swap migration refused; requests "
+                                  "stay on the incumbent")
+                    old_server.adopt_queued(moved)
             # from here every router resolve sees the successor; the
             # incumbent only owes its already-admitted streams
             drained = old_server.drain(timeout=drain_timeout)
@@ -564,7 +585,16 @@ class FleetAutoscaler:
     model its rule's `model=`/`server=` label names (fleet-wide when
     unlabeled), and `goodput_low=` adds a `serving_goodput_fraction`
     floor (scale out when device work stops turning into kept tokens).
-    The legacy thresholds remain the default."""
+    The legacy thresholds remain the default.
+
+    Horizontal mode: pass `replicas=` (a `serving.replica.
+    ReplicaManager`) and the SAME pressure signal gains a second axis —
+    when a model is under pressure but its vertical levers are at their
+    caps (`max_slots`/`max_blocks`), the autoscaler GROWS the replica
+    count instead (decision records carry ``action:
+    "grow_replicas"``); after `replica_idle_passes` consecutive
+    pressure-free passes with an empty queue it SHRINKS back toward
+    `ReplicaManager.min_replicas` (newest replica first)."""
 
     def __init__(self, fleet: FleetServer, *,
                  queue_depth_high: int = 32,
@@ -572,7 +602,8 @@ class FleetAutoscaler:
                  factor: int = 2, max_slots: int = 64,
                  max_blocks: int = 8192, cooldown_s: float = 0.0,
                  drain_timeout: float = 600.0,
-                 rules=None, goodput_low: Optional[float] = None):
+                 rules=None, goodput_low: Optional[float] = None,
+                 replicas=None, replica_idle_passes: int = 4):
         self.fleet = fleet
         self.queue_depth_high = int(queue_depth_high)
         self.pool_free_frac_low = float(pool_free_frac_low)
@@ -584,6 +615,11 @@ class FleetAutoscaler:
         self.rules = rules
         self.goodput_low = (None if goodput_low is None
                             else float(goodput_low))
+        # horizontal axis: a ReplicaManager (or anything with
+        # count/grow/shrink) — None keeps the vertical-only behavior
+        self.replicas = replicas
+        self.replica_idle_passes = int(replica_idle_passes)
+        self._idle_passes: Dict[str, int] = {}
         self._last_scaled: Dict[str, float] = {}
         self.decisions: List[dict] = []
         self._watch: Optional[threading.Thread] = None
@@ -716,14 +752,24 @@ class FleetAutoscaler:
                         f"pool free fraction {free_frac:.2f} < "
                         f"{self.pool_free_frac_low}")
             if not pressure:
+                rec = self._maybe_shrink_replicas(name, sig)
+                if rec is not None:
+                    made.append(rec)
                 continue
+            self._idle_passes[name] = 0
             server = self.fleet.server(name)
             cur_slots = server.engine.n_slots
             cur_blocks = server.engine.pool.n_blocks
             new_slots = min(cur_slots * self.factor, self.max_slots)
             new_blocks = min(cur_blocks * self.factor, self.max_blocks)
             if new_slots <= cur_slots and new_blocks <= cur_blocks:
-                continue           # already at the cap
+                # vertical levers at their caps: go HORIZONTAL — add a
+                # replica process (the router's least-loaded balancing
+                # spreads traffic onto it as soon as it registers)
+                rec = self._grow_replicas(name, sig, pressure)
+                if rec is not None:
+                    made.append(rec)
+                continue
             rec = self.fleet.scale(
                 name, n_slots=new_slots, n_blocks=new_blocks,
                 drain_timeout=self.drain_timeout)
@@ -738,6 +784,54 @@ class FleetAutoscaler:
             log.info("autoscaled %s: %s -> %s (%s)", name,
                      rec["before"], rec["after"], rec["reason"])
         return made
+
+    # ------------------------------------------------- horizontal scaling
+    def _grow_replicas(self, name: str, sig: dict,
+                       pressure: List[str]) -> Optional[dict]:
+        if self.replicas is None or not self.replicas.grow():
+            return None            # no manager, or at max_replicas
+        rec = {"name": name, "action": "grow_replicas",
+               "replicas": self.replicas.count(),
+               "reason": "; ".join(pressure), "signal": sig}
+        self._last_scaled[name] = time.monotonic()
+        self.decisions.append(rec)
+        GLOBAL_FLIGHT_RECORDER.record(
+            "autoscale", model=name, action="grow_replicas",
+            replicas=rec["replicas"], reason=rec["reason"])
+        log.info("autoscaled %s horizontally: %d replicas (%s)", name,
+                 rec["replicas"], rec["reason"])
+        return rec
+
+    def _maybe_shrink_replicas(self, name: str,
+                               sig: dict) -> Optional[dict]:
+        """No pressure this pass: one idle tick toward shrinking. Only
+        a run of `replica_idle_passes` pressure-free passes WITH an
+        empty admission queue releases a replica — a single quiet
+        sample between bursts must not thrash the fleet."""
+        if self.replicas is None:
+            return None
+        if (sig.get("queue_depth") or 0) > 0:
+            self._idle_passes[name] = 0
+            return None
+        n = self._idle_passes.get(name, 0) + 1
+        self._idle_passes[name] = n
+        if n < self.replica_idle_passes:
+            return None
+        self._idle_passes[name] = 0
+        if not self.replicas.shrink():
+            return None            # already at min_replicas
+        rec = {"name": name, "action": "shrink_replicas",
+               "replicas": self.replicas.count(),
+               "reason": f"idle for {n} consecutive passes",
+               "signal": sig}
+        self._last_scaled[name] = time.monotonic()
+        self.decisions.append(rec)
+        GLOBAL_FLIGHT_RECORDER.record(
+            "autoscale", model=name, action="shrink_replicas",
+            replicas=rec["replicas"], reason=rec["reason"])
+        log.info("autoscaled %s horizontally: %d replicas (%s)", name,
+                 rec["replicas"], rec["reason"])
+        return rec
 
     # -------------------------------------------------------------- watch
     def start(self, interval_s: float = 0.5) -> "FleetAutoscaler":

@@ -31,11 +31,13 @@ sees one message per decode chunk, not per token.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
 import uuid
-from typing import Dict, Optional, Tuple
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -43,6 +45,7 @@ from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.monitor.flightrec import GLOBAL_FLIGHT_RECORDER
 from deeplearning4j_tpu.monitor.reqtrace import RequestTrace
 from deeplearning4j_tpu.serving import wire
+from deeplearning4j_tpu.serving.replica import ReplicaLostError
 from deeplearning4j_tpu.serving.server import (
     ServerDrainingError,
     ShedError,
@@ -72,6 +75,16 @@ class FleetRouter:
         self._outputs: Dict[str, object] = {}
         self._out_inflight: Dict[str, int] = {}
         self._out_lock = threading.Lock()
+        # horizontal serving: one ReplicaSet per replicated model, plus
+        # this router's own not-yet-resolved token debt per replica —
+        # the directory's load gauges refresh once per heartbeat, so a
+        # burst submitted between refreshes must see its OWN submissions
+        # or every request in the burst lands on the same "least-loaded"
+        # replica
+        self._replica_sets: Dict[str, object] = {}
+        self._replica_migrations: Dict[str, int] = {}
+        self._replica_pending: Dict[str, int] = {}
+        self._replica_lock = threading.Lock()
         self._metrics_cache = None
         # transport-plane threads + active remote streams
         self._running = False
@@ -172,6 +185,11 @@ class FleetRouter:
         m = self._metrics()
         if trace is None and monitor.is_enabled():
             trace = RequestTrace(model=name)
+        rset = self._replica_sets.get(name)
+        if rset is not None:
+            return self._submit_replicated(
+                name, rset, prompt_ids, n_tokens, temperature=temperature,
+                top_p=top_p, rng=rng, trace=trace)
         for _ in range(64):
             server, version = self._resolve(name)
             reason = self._should_shed(name, server)
@@ -207,6 +225,190 @@ class FleetRouter:
         raise RuntimeError(
             f"model {name!r} stayed in draining state across retries — "
             f"is a swap stuck without a successor?")
+
+    # ----------------------------------------------- horizontal replicas
+    def attach_replicas(self, name: str, replica_set, *,
+                        max_migrations: int = 3):
+        """Front `name` with a horizontally-replicated backend: a
+        `ReplicaSet` polling the elastic coordinator's serving
+        directory. Submits to `name` now BALANCE before they shed —
+        backends are ordered least-loaded first on their advertised
+        gauges (projected delay = outstanding tokens / tok/s EWMA, plus
+        this router's own unresolved submissions) and a request is
+        refused only when EVERY live replica fails its admission check
+        (queue full, or projected past the weighted SLO budget). A
+        replica dying mid-stream migrates the request: nothing-received
+        resubmits verbatim to any survivor, a partial stream continues
+        as prompt+received with emit_start on a same-version replica —
+        up to `max_migrations` hops before the typed `ReplicaLostError`
+        surfaces to the caller."""
+        self._replica_sets[name] = replica_set
+        self._replica_migrations[name] = int(max_migrations)
+
+    def detach_replicas(self, name: str):
+        """Stop fronting `name` with replicas (the set itself is the
+        caller's to close); subsequent submits fall back to the local
+        fleet path."""
+        self._replica_sets.pop(name, None)
+        self._replica_migrations.pop(name, None)
+
+    def replica_pending(self, token: str) -> int:
+        """Tokens this router has submitted to `token` and not yet seen
+        resolve — the between-heartbeats half of the balance signal."""
+        with self._replica_lock:
+            return self._replica_pending.get(token, 0)
+
+    def _replica_order_key(self, backend):
+        """Least-loaded ordering on the WORK gauges — outstanding
+        tokens (advertised + this router's own unresolved submits),
+        then queue depth — ties broken by token for stability.
+
+        Deliberately NOT the projected-delay estimator: that divides
+        by the throughput EWMA, and a freshly-warmed replica's EWMA
+        comes from a 1-slot warmup dispatch — an order of magnitude
+        below its full-batch rate — so delay-ordering starves exactly
+        the replica that fan-out just added. Outstanding work is
+        rate-free: a cold replica reads 0 and attracts traffic, which
+        warms it. Projected delay stays where the SLO lives — the
+        shed decision (`_replica_shed_reason`)."""
+        tok, _client, meta = backend
+        load = meta.get("load") or {}
+        with self._replica_lock:
+            pend = self._replica_pending.get(tok, 0)
+        out = int(load.get("outstanding_tokens") or 0) + pend
+        return (out, int(load.get("queue_depth") or 0), tok)
+
+    def _replica_shed_reason(self, name: str, tok: str,
+                             meta: dict) -> Optional[str]:
+        """Per-replica admission check — `_should_shed` over advertised
+        gauges instead of a live server reference."""
+        load = meta.get("load") or {}
+        depth = int(load.get("queue_depth") or 0)
+        if self.max_queue is not None and depth >= self.max_queue:
+            return (f"replica {tok} of {name!r} admission queue full "
+                    f"({depth} >= max_queue {self.max_queue})")
+        rate = float(load.get("ewma_tok_s") or 0.0)
+        if self.slo_ttft_s is not None and rate > 0:
+            with self._replica_lock:
+                pend = self._replica_pending.get(tok, 0)
+            out = int(load.get("outstanding_tokens") or 0) + pend
+            budget = self.slo_ttft_s * self.weights.get(name, 1.0)
+            projected = out / rate
+            if projected > budget:
+                return (f"replica {tok} of {name!r} projected delay "
+                        f"{projected:.2f}s exceeds its weighted "
+                        f"{budget:.2f}s TTFT budget at {rate:.1f} tok/s")
+        return None
+
+    def _note_replica_submit(self, tok: str, n_tokens: int, stream):
+        with self._replica_lock:
+            self._replica_pending[tok] = (
+                self._replica_pending.get(tok, 0) + int(n_tokens))
+
+        def _resolved(_f, tok=tok, n=int(n_tokens)):
+            with self._replica_lock:
+                self._replica_pending[tok] = max(
+                    0, self._replica_pending.get(tok, 0) - n)
+
+        stream._fut.add_done_callback(_resolved)
+
+    def _submit_replicated(self, name: str, rset, prompt_ids,
+                           n_tokens: int, *, temperature: float,
+                           top_p, rng, trace) -> "MigratingStream":
+        m = self._metrics()
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        # mint the sampling rng HERE, not replica-side: a migrated
+        # continuation must fold the SAME key at the same positions on
+        # the survivor, so the key has to live with the logical stream
+        if temperature and rng is None:
+            rng = np.frombuffer(os.urandom(8), np.uint32).copy()
+        ms = MigratingStream(
+            self, name, rset, prompt, n_tokens, temperature=temperature,
+            top_p=top_p, rng=rng, trace=trace,
+            max_migrations=self._replica_migrations.get(name, 3))
+        try:
+            self._dispatch_replica(ms)
+        except ShedError as e:
+            if m is not None:
+                m["shed"](name).inc()
+            if trace is not None:
+                trace.event("shed", reason=str(e), router=True)
+                trace.finish(status="shed")
+            self._note_shed_burst(name, str(e))
+            raise
+        if m is not None:
+            m["streams"](name).inc()
+        if trace is not None:
+            trace.annotate(replica=ms.replica)
+        return ms
+
+    def _dispatch_replica(self, ms: "MigratingStream") -> None:
+        """(Re)submit one logical stream to the best live replica.
+        Balance-THEN-shed: candidates are tried least-loaded first and
+        `ShedError` is raised only when every live one fails its
+        admission check — a single overloaded replica never sheds a
+        request another could serve. Called for the initial submit and
+        again per migration hop (from the dead client's reader thread,
+        via the attempt's done callback)."""
+        ms._rset.refresh()
+        name = ms.model
+        committed = list(ms._committed)
+        remaining = ms.n_tokens - len(committed)
+        prompt = ms._prompt
+        if committed:
+            prompt = np.concatenate(
+                [prompt, np.asarray(committed, prompt.dtype)])
+        dead = set(ms._dead)
+        cands = []
+        for tok, client, meta in ms._rset.backends():
+            if tok in dead or client.closed:
+                continue
+            if committed and ms._version_pin is not None \
+                    and meta.get("version") is not None \
+                    and int(meta["version"]) != ms._version_pin:
+                # continuations must stay on their version: a partial
+                # stream joined across versions would splice two
+                # different models' numerics into one "stream"
+                continue
+            cands.append((tok, client, meta))
+        if not cands:
+            raise ReplicaLostError(
+                f"no live replica of {name!r} can take this stream "
+                f"(directory generation {ms._rset.generation}, "
+                f"{len(dead)} known dead, version pin "
+                f"{ms._version_pin})",
+                request_id=ms.request_id, tokens=committed)
+        reasons: List[str] = []
+        for tok, client, meta in sorted(cands,
+                                        key=self._replica_order_key):
+            reason = self._replica_shed_reason(name, tok, meta)
+            if reason is not None:
+                reasons.append(reason)
+                continue
+            try:
+                stream = client.submit(
+                    name, prompt, remaining,
+                    temperature=ms._temperature, top_p=ms._top_p,
+                    rng=ms._rng, emit_start=len(committed),
+                    trace_id=(None if ms.trace is None
+                              else ms.trace.trace_id))
+            except ReplicaLostError:
+                # died between refresh and submit: same as dead in the
+                # directory — move on to the next candidate
+                ms._dead.append(tok)
+                continue
+            self._note_replica_submit(tok, remaining, stream)
+            ms._bind(stream)
+            return
+        if reasons:
+            raise ShedError(
+                f"all {len(reasons)} live replicas of {name!r} are past "
+                f"their admission budget — {reasons[0]}")
+        raise ReplicaLostError(
+            f"every live replica of {name!r} died at submit",
+            request_id=ms.request_id, tokens=committed)
 
     def _note_shed_burst(self, name: str, reason: str):
         self._shed_recent += 1
@@ -404,6 +606,136 @@ class FleetRouter:
                                   error=exc))
         except Exception:  # noqa: BLE001 — teardown must not throw
             log.exception("reply publish failed for %s", rid)
+
+
+# ------------------------------------------------------- migrating stream
+class MigratingStream:
+    """One logical replica-served generation that SURVIVES worker death:
+    wraps successive `ReplicaStream` attempts behind a single future
+    face. When an attempt fails with `ReplicaLostError`, the tokens it
+    delivered are committed, the dead replica is excluded, and the
+    remainder resubmits through the router's balance-then-shed picker —
+    verbatim to any survivor when nothing arrived, as prompt+received
+    with ``emit_start`` on a same-version survivor when the stream was
+    partial (the continuation contract: greedy rejoins bit-exactly,
+    sampled keeps its fold_in chain because the rng key lives here, not
+    on the replica). After `max_migrations` hops, or on any non-lost
+    error, the failure surfaces unchanged."""
+
+    def __init__(self, router, name: str, rset, prompt,
+                 n_tokens: int, *, temperature: float = 0.0,
+                 top_p=None, rng=None, trace=None,
+                 max_migrations: int = 3):
+        self._router = router
+        self._rset = rset
+        self.model = name
+        self.request_id = uuid.uuid4().hex
+        self._prompt = np.asarray(prompt)
+        self.n_tokens = int(n_tokens)
+        self._temperature = float(temperature)
+        self._top_p = top_p
+        self._rng = rng
+        self.trace = trace
+        self._fut: Future = Future()
+        self._lock = threading.Lock()
+        self._committed: List[int] = []
+        self._cur = None
+        self._dead: List[str] = []
+        self._version_pin: Optional[int] = None
+        self.max_migrations = int(max_migrations)
+        self.migrations = 0
+        self.version: Optional[int] = None
+        self.replica: Optional[str] = None
+        self.t_submit = time.monotonic()
+        self._t_first: Optional[float] = None
+
+    # ------------------------------------------------------------ consumer
+    @property
+    def tokens(self) -> List[int]:
+        """Committed tokens from finished attempts plus the live
+        attempt's stream so far — the one logical token list."""
+        with self._lock:
+            out = list(self._committed)
+            cur = self._cur
+        if cur is not None:
+            out.extend(cur.tokens)
+        return out
+
+    @property
+    def t_first(self) -> Optional[float]:
+        if self._t_first is not None:
+            return self._t_first
+        cur = self._cur
+        return None if cur is None else cur.t_first
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        return np.asarray(self._fut.result(timeout), np.int32)
+
+    # ------------------------------------------------------------ internal
+    def _bind(self, stream) -> None:
+        with self._lock:
+            self._cur = stream
+        self.replica = stream.replica
+        stream._fut.add_done_callback(
+            lambda _f, s=stream: self._attempt_done(s))
+
+    def _attempt_done(self, stream) -> None:
+        if self._fut.done():
+            return
+        if self._t_first is None and stream.t_first is not None:
+            self._t_first = stream.t_first
+        exc = stream._fut.exception()
+        if exc is None:
+            with self._lock:
+                self._committed.extend(stream.tokens)
+                self._cur = None
+                toks = list(self._committed)
+            self.version = stream.version
+            if self.trace is not None:
+                self.trace.finish(status="ok")
+            self._fut.set_result(toks)
+            return
+        if (not isinstance(exc, ReplicaLostError)
+                or self.migrations >= self.max_migrations):
+            if self.trace is not None:
+                self.trace.finish(
+                    status="shed" if isinstance(exc, ShedError)
+                    else "error", error=type(exc).__name__)
+            self._fut.set_exception(exc)
+            return
+        # ------------------------------------------------- migrate
+        with self._lock:
+            got = list(stream.tokens)
+            self._committed.extend(got)
+            self._cur = None
+            n_done = len(self._committed)
+        if self._committed and stream.version is not None:
+            # a PARTIAL stream pins its version: the continuation's
+            # numerics must come from the same weights
+            self._version_pin = int(stream.version)
+        if stream.replica is not None:
+            self._dead.append(stream.replica)
+        self.migrations += 1
+        if self.trace is not None:
+            self.trace.event("replica_migrate", lost=stream.replica,
+                             committed=n_done, hop=self.migrations)
+        if n_done >= self.n_tokens:
+            # the worker emitted everything before dying — only the
+            # terminal frame was lost
+            self.version = stream.version
+            self._fut.set_result(list(self._committed))
+            return
+        try:
+            self._router._dispatch_replica(self)
+        except Exception as e:  # noqa: BLE001 — resubmit failure is
+            # THIS stream's terminal error (shed, nothing live, ...)
+            if self.trace is not None:
+                self.trace.finish(status="error",
+                                  error=type(e).__name__)
+            self._fut.set_exception(e)
 
 
 # ------------------------------------------------------------------ client

@@ -167,9 +167,10 @@ class TokenStream:
 
 class _Request:
     __slots__ = ("prompt", "n_tokens", "temperature", "top_p", "rng",
-                 "stream", "slot")
+                 "stream", "slot", "emit_base")
 
-    def __init__(self, prompt, n_tokens, temperature, top_p, rng, stream):
+    def __init__(self, prompt, n_tokens, temperature, top_p, rng, stream,
+                 emit_base: int = 0):
         self.prompt = prompt
         self.n_tokens = n_tokens
         self.temperature = temperature
@@ -177,6 +178,12 @@ class _Request:
         self.rng = rng
         self.stream = stream
         self.slot = None
+        # rng fold offset carried in from OUTSIDE this server: a
+        # cross-replica continuation (migration after a replica died
+        # mid-stream) arrives as prompt+received with emit_start =
+        # tokens already emitted elsewhere — sampling must keep folding
+        # at the original stream's positions, not restart at 0
+        self.emit_base = int(emit_base)
 
     # ---- preempt-and-requeue continuation (incremental allocation):
     # a pool-pressure eviction re-admits the request as its original
@@ -217,6 +224,7 @@ class GenerationServer(ParallelInference):
                  slo_ttft_s: Optional[float] = None,
                  max_queue: Optional[int] = None,
                  idle_wait_s: float = 0.05,
+                 dispatch_floor_s: Optional[float] = None,
                  quantize: Optional[str] = None,
                  allocation: str = "incremental",
                  speculative: Optional[int] = None,
@@ -287,6 +295,15 @@ class GenerationServer(ParallelInference):
         self.slo_ttft_s = slo_ttft_s
         self.max_queue = max_queue
         self.idle_wait_s = idle_wait_s
+        # emulated device-step latency floor (sandbox/test seam): each
+        # decode dispatch takes at least this long, with the host
+        # sleeping out the remainder as if the accelerator owned the
+        # step. On a CPU-only sandbox this reproduces the device-bound
+        # serving regime (host idle inside the step) that replica
+        # fan-out and SLO tests are really about — it must never be
+        # set in production serving.
+        self.dispatch_floor_s = (None if dispatch_floor_s is None
+                                 else float(dispatch_floor_s))
         self._pending: List = []          # admission order, after _queue
         self._slot2req = {}
         # shedding estimator: EWMA of aggregate decode throughput
@@ -606,11 +623,18 @@ class GenerationServer(ParallelInference):
     def generate_async(self, prompt_ids, n_tokens: int, *,
                        temperature: float = 0.0,
                        top_p: Optional[float] = None,
-                       rng=None,
+                       rng=None, emit_start: int = 0,
                        trace: Optional[RequestTrace] = None) -> TokenStream:
         """Enqueue one generation request; returns its token stream.
         Eager validation (the `generate()` pattern): impossible
         requests fail HERE, not as a scheduler-thread error.
+
+        `emit_start` is the continuation seam for CROSS-SERVER
+        migration: a stream that died on another replica after K tokens
+        resubmits as prompt+received with ``emit_start=K`` — greedy
+        continuations are bit-consistent by the parity contract and
+        sampled ones keep their fold_in(key, position) indices, so the
+        joined stream equals the uninterrupted one.
 
         `trace` carries upstream trace context (a router-side
         RequestTrace or one rehydrated from the wire); with monitoring
@@ -674,11 +698,66 @@ class GenerationServer(ParallelInference):
             self._open_streams += 1
             self._queued_tokens += int(n_tokens)
         req = _Request(prompt.astype(np.int64), int(n_tokens),
-                       float(temperature), top_p, rng, stream)
+                       float(temperature), top_p, rng, stream,
+                       emit_base=int(emit_start))
         self._queue.put((req, fut, stream.t_submit))
         if getattr(self, "_shutdown", False):
             self._fail_pending()
         return stream
+
+    # -------------------------------------------- queued-request migration
+    def export_queued(self) -> List:
+        """Take every QUEUED-BUT-UNSTARTED request out of the submit
+        queue for migration to another server (the hot-swap successor,
+        or a less-loaded replica). Only the submit queue is exported —
+        requests the scheduler has already seen (pending list, live
+        slots) have state on THIS server and finish here; a queued item
+        has emitted nothing, so it moves wholesale. Thread-safe against
+        a running scheduler: both sides drain the same thread-safe
+        queue, so each item lands exactly once — here or in a slot,
+        never both. Returns opaque items for `adopt_queued`."""
+        items = []
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._queue_item_taken(item)
+            if item is None:
+                continue
+            items.append(item)
+        if items:
+            # the streams remain OPEN (their consumers keep waiting) but
+            # no longer this server's liability: drain() must not block
+            # on requests another server now owes
+            with self._open_lock:
+                self._open_streams -= len(items)
+        return items
+
+    def adopt_queued(self, items) -> int:
+        """Adopt requests exported from another server's queue: each
+        stream object is re-owned wholesale — same TokenStream, same
+        consumer-held future, new server on the hook for it (the close
+        hook rebinds, so open-stream accounting follows the request).
+        Returns the number adopted."""
+        if not items:
+            return 0
+        if getattr(self, "_shutdown", False) or self._stopped:
+            raise RuntimeError("GenerationServer is shut down")
+        if self._draining:
+            raise ServerDrainingError(
+                "cannot adopt migrated requests into a draining server")
+        for item in items:
+            req = item[0]
+            req.stream._on_close = self._stream_closed
+            tr = req.stream.trace
+            if tr is not None:
+                tr.event("migrated", to=self.name)
+            with self._open_lock:
+                self._open_streams += 1
+                self._queued_tokens += int(req.n_tokens)
+            self._queue.put(item)
+        return len(items)
 
     # ------------------------------------------------------------ metrics
     def _serving_metrics(self):
@@ -1011,10 +1090,17 @@ class GenerationServer(ParallelInference):
                      n_tokens=it[0].n_left, request_id=id(it[0]),
                      temperature=it[0].temperature,
                      top_p=it[0].top_p, rng=it[0].rng,
-                     emit_start=it[0].emitted)
+                     emit_start=it[0].emit_base + it[0].emitted)
                 for it in wave])
             if not admitted:
                 break
+            if self.dispatch_floor_s is not None:
+                dtp = time.perf_counter() - t0p
+                if dtp < self.dispatch_floor_s:
+                    # the prefill wave is device work too — under the
+                    # emulated floor it must overlap across replicas
+                    # the same way decode dispatches do
+                    time.sleep(self.dispatch_floor_s - dtp)
             t1p = time.perf_counter()
             now = time.monotonic()
             for (slot, first, done), (req, fut, t_submit) in zip(
@@ -1053,6 +1139,11 @@ class GenerationServer(ParallelInference):
             emitted, finished = eng.step(speculate=self._spec_policy(),
                                          proposers=self._spec_proposers())
             dt = time.perf_counter() - t0
+            if self.dispatch_floor_s is not None \
+                    and dt < self.dispatch_floor_s:
+                time.sleep(self.dispatch_floor_s - dt)
+                dt = self.dispatch_floor_s   # EWMA/trace see the
+                # emulated device rate, not the host-compute rate
             # dispatch-level speculative deltas for trace attribution —
             # read BEFORE _spec_update advances the *_seen cursors
             d_spec_prop = eng.spec_proposed_total - self._spec_proposed_seen

@@ -26,7 +26,7 @@ like the training metrics:
    upfront admission-concurrency A/B;
 3. deliberate overload proving the SLO shedding path fires.
 
-Hard asserts (exit nonzero — verify.sh step [10/17] runs --smoke):
+Hard asserts (exit nonzero — verify.sh step [10/18] runs --smoke):
 
 - greedy parity: every stream bit-equal to its whole-batch
   `generate()` row — fp phase AND quantized phase (vs
@@ -483,7 +483,7 @@ def run_fleet(args, *, metrics_check=False):
             f"successor must be warmed before the flip)")
 
     if metrics_check:
-        # the [12/17] acceptance surface: the fleet/registry gauge
+        # the [12/18] acceptance surface: the fleet/registry gauge
         # families must be live on /metrics
         import urllib.request
 
@@ -509,6 +509,253 @@ def run_fleet(args, *, metrics_check=False):
 
     fleet.stop()
     return fleet_block, failures
+
+
+def run_replicated(args):
+    """Horizontal-serving phase: a multi-PROCESS replica fleet behind
+    the elastic coordinator and the router's least-loaded balancing.
+
+    Arms (matched floods, best-of-2 windows):
+
+    A. ONE `spawn_replica` subprocess — flood S streams x T tokens
+       through FleetRouter/ReplicaSet, greedy parity vs generate();
+    B. TWO subprocesses (second warms against the SAME
+       `DL4J_COMPILE_CACHE_DIR` volume) — same flood; the aggregate
+       tok/s must scale >= 1.7x.
+
+    Every replica runs with a `--step-floor-ms` emulated device-step
+    floor: on the 1-core CPU sandbox two processes cannot beat one on
+    raw FLOPs, so the arms measure the DEVICE-BOUND regime (host idle
+    inside each accelerator step — the regime replica fan-out exists
+    for). The gate therefore verifies the serving PLANE — router
+    balancing, wire, per-process schedulers — adds no serialization,
+    not that the sandbox grew a second core; `sandbox_model` in the
+    ledger says exactly that.
+
+    Then the replica-death drill (hard SIGKILL of one replica
+    mid-flood: zero dropped accepted streams, migrated continuations
+    bit-equal, router converges to the survivor set), the
+    disaggregated prefill->decode parity check over DLFP frames, and
+    the PR-15 federation check (per-replica `serving_replica_*` gauges
+    riding heartbeats into one aggregated snapshot).
+
+    Returns (replicated_block, failures)."""
+    import tempfile
+
+    from deeplearning4j_tpu.monitor.federate import (
+        MetricsAggregator,
+        ingest_elastic_status,
+    )
+    from deeplearning4j_tpu.parallel.elastic import (
+        ElasticCoordinator,
+        retry_request,
+    )
+    from deeplearning4j_tpu.serving import FleetRouter
+    from deeplearning4j_tpu.serving.disagg import (
+        DecodeWorker,
+        PrefillWorker,
+        run_disaggregated,
+    )
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    from deeplearning4j_tpu.serving.replica import (
+        ReplicaSet,
+        spawn_replica,
+    )
+
+    streams = args.replica_streams
+    n_tok = 32
+    prompt_len = 6
+    block_len = 4
+    n_slots = 8
+    floor_ms = args.replica_step_floor_ms
+    max_len = prompt_len + n_tok + block_len
+    max_len += (-max_len) % block_len
+    net = build_net(64, 16, 2, args.n_heads, max_len, seed=31)
+
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, 64, prompt_len) for _ in range(streams)]
+    ref = reference_tokens(net, prompts, n_tok)
+
+    root = tempfile.mkdtemp(prefix="replica-registry-")
+    cache = tempfile.mkdtemp(prefix="replica-compile-cache-")
+    ModelRegistry(root).publish("m", net)
+    coord = ElasticCoordinator(settle_s=0.2, grace_s=2.0).start()
+    bps = -(-max_len // block_len)
+
+    def spawn(token):
+        t0 = time.monotonic()
+        proc = spawn_replica(
+            root, "m", coordinator=coord.address, n_slots=n_slots,
+            n_blocks=n_slots * bps + 1, block_len=block_len,
+            steps_per_dispatch=4, warmup_prompt_len=prompt_len,
+            token=token, compile_cache_dir=cache,
+            step_floor_ms=floor_ms)
+        return proc, round(time.monotonic() - t0, 3)
+
+    def flood(router, n_replicas, n=n_tok, ps=prompts):
+        rset.refresh(force=True)
+        deadline = time.monotonic() + 30
+        while len(rset.backends()) < n_replicas \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+            rset.refresh(force=True)
+        t0 = time.monotonic()
+        ss = [router.submit("m", p, n) for p in ps]
+        outs = [s.result(300) for s in ss]
+        return ss, outs, time.monotonic() - t0
+
+    failures = []
+    r1, warm1_s = spawn("replica-1")
+    rset = ReplicaSet(coord.address, "m", refresh_s=0.05)
+    router = FleetRouter()
+    router.attach_replicas("m", rset)
+
+    # --------------------------------------------- arm A: one replica
+    _, outs, wall_1r = min((flood(router, 1) for _ in range(2)),
+                           key=lambda o: o[2])
+    par_1r = all(np.array_equal(a, b) for a, b in zip(outs, ref))
+    tps_1r = streams * n_tok / wall_1r
+
+    # -------------------------------------------- arm B: two replicas
+    r2, warm2_s = spawn("replica-2")
+    ss, outs, wall_2r = min((flood(router, 2) for _ in range(2)),
+                            key=lambda o: o[2])
+    par_2r = all(np.array_equal(a, b) for a, b in zip(outs, ref))
+    tps_2r = streams * n_tok / wall_2r
+    used_2r = {s.replica for s in ss}
+    scale = tps_2r / tps_1r
+
+    # ---------------- federation: per-replica gauges on the heartbeat
+    status = retry_request(coord.address, {"op": "status"})["status"]
+    agg = MetricsAggregator()
+    ingest_elastic_status(status, agg)
+    fed = agg.snapshot()
+    fed_fams = sorted(f for f in fed if f.startswith("serving_replica_"))
+    fed_replicas = {e.get("labels", {}).get("replica")
+                    for f in fed_fams for e in fed[f]["values"]}
+
+    # ------------------------- drill: hard-kill a replica mid-flood
+    drill_tok = 24
+    drill_ref = reference_tokens(net, prompts, drill_tok)
+    t0 = time.monotonic()
+    drill = [router.submit("m", p, drill_tok) for p in prompts]
+    time.sleep(max(0.2, wall_2r * 0.25))
+    victim = r2 if any(s.replica == "replica-2" for s in drill) else r1
+    victim.kill()                                  # SIGKILL, no drain
+    errors = 0
+    completed = []
+    for s in drill:
+        try:
+            completed.append(s.result(300))
+        except Exception:  # noqa: BLE001 — counted, asserted below
+            errors += 1
+    drill_wall = time.monotonic() - t0
+    drill_par = (len(completed) == len(drill)
+                 and all(np.array_equal(a, b)
+                         for a, b in zip(completed, drill_ref)))
+    migrated = sum(1 for s in drill if s.migrations > 0)
+    survivor = "replica-1" if victim is r2 else "replica-2"
+    deadline = time.monotonic() + 30
+    toks = None
+    while time.monotonic() < deadline:
+        rset.refresh(force=True)
+        toks = [t for t, _, _ in rset.backends()]
+        if toks == [survivor]:
+            break
+        time.sleep(0.1)
+    post = router.submit("m", prompts[0], n_tok)
+    post_ok = (np.array_equal(post.result(60), ref[0])
+               and post.replica == survivor)
+
+    rset.close()
+    for proc in (r1, r2):
+        proc.stop()
+    coord.stop()
+
+    # -------------------- disaggregated prefill/decode (DLFP frames)
+    pre = PrefillWorker(net, n_slots=n_slots, n_blocks=n_slots * bps,
+                        block_len=block_len)
+    dec = DecodeWorker(net, n_slots=n_slots,
+                       n_blocks=n_slots * bps + 4, block_len=block_len)
+    disagg_out = run_disaggregated(pre, dec, prompts[:8], n_tok)
+    disagg_par = all(np.array_equal(a, b)
+                     for a, b in zip(disagg_out, ref[:8]))
+
+    replicated_block = {
+        "streams": streams,
+        "n_tokens": n_tok,
+        "step_floor_ms": floor_ms,
+        "sandbox_model": (
+            "per-dispatch device-step floor emulated on the 1-core "
+            "sandbox: the scale gate measures serving-plane overlap "
+            "in the device-bound regime, not CPU FLOPs scaling"),
+        "tokens_per_sec_1r": round(tps_1r, 2),
+        "tokens_per_sec_2r": round(tps_2r, 2),
+        "replica_scale_x": round(scale, 3),
+        "greedy_parity_1r": "exact" if par_1r else "BROKEN",
+        "greedy_parity_2r": "exact" if par_2r else "BROKEN",
+        "replicas_used_2r": len(used_2r),
+        "warmup_seconds_r1": warm1_s,
+        "warmup_seconds_r2": warm2_s,
+        "federated_gauge_families": fed_fams,
+        "federated_replicas": sorted(r for r in fed_replicas if r),
+        "kill_drill": {
+            "streams": len(drill),
+            "completed": len(completed),
+            "errors": errors,
+            "migrated": migrated,
+            "parity": "exact" if drill_par else "BROKEN",
+            "wall_seconds": round(drill_wall, 3),
+            "survivor_converged": toks == [survivor],
+            "post_kill_submit_ok": post_ok,
+        },
+        "disagg": {
+            "streams": 8,
+            "parity_vs_colocated": "exact" if disagg_par else "BROKEN",
+        },
+    }
+
+    # ---- hard asserts
+    if scale < args.replica_min_scale:
+        failures.append(
+            f"2-replica aggregate throughput scaled only {scale:.2f}x "
+            f"over 1 replica (< {args.replica_min_scale}x): the "
+            f"serving plane is serializing the fleet")
+    if not par_1r or not par_2r:
+        failures.append("replicated greedy streams diverge from "
+                        "single-process generate()")
+    if len(used_2r) < 2:
+        failures.append("least-loaded balancing left one replica idle "
+                        "through the whole 2-replica flood")
+    if errors:
+        failures.append(f"replica-death drill dropped {errors} "
+                        f"accepted streams (contract: zero)")
+    if not drill_par:
+        failures.append("post-migration continuations broke greedy "
+                        "parity")
+    if migrated < 1:
+        failures.append("the kill landed on an idle replica: no "
+                        "stream actually migrated")
+    if toks != [survivor]:
+        failures.append(f"router never converged to the survivor set "
+                        f"(saw {toks})")
+    if not post_ok:
+        failures.append("post-kill traffic did not land cleanly on "
+                        "the survivor")
+    if not disagg_par:
+        failures.append("disaggregated prefill->decode handoff is not "
+                        "bit-equal to the colocated greedy path")
+    missing = {"serving_replica_queue_depth",
+               "serving_replica_outstanding_tokens",
+               "serving_replica_tok_s",
+               "serving_replica_open_streams"} - set(fed_fams)
+    if missing:
+        failures.append(f"federated snapshot lacks per-replica gauge "
+                        f"families: {sorted(missing)}")
+    elif len(fed_replicas - {None}) < 2:
+        failures.append("federation carried gauges for fewer than 2 "
+                        "replicas")
+    return replicated_block, failures
 
 
 def train_cyclic_lm(args, *, d_model, n_tok, prompt_len, period=8,
@@ -1153,7 +1400,7 @@ def run_overload(net, prompts, n_tokens, *, block_len):
 
 
 def run_spec_smoke(args):
-    """verify.sh [14/17]: the speculative + shared-prefix phases alone
+    """verify.sh [14/18]: the speculative + shared-prefix phases alone
     (hard asserts inside each), then proof that compare_bench gates
     the two new ledger metrics — including the structural
     stale-fallback band (sharing silently disabled reports ~1.0
@@ -1222,7 +1469,7 @@ def run_spec_smoke(args):
 
 
 def run_sampled_spec_smoke(args):
-    """verify.sh [17/17]: the sampled-speculation + truncated-drafter
+    """verify.sh [17/18]: the sampled-speculation + truncated-drafter
     + radix phases alone (hard asserts inside each — chi-square parity
     at the 1e-4 critical value, >=1.3x sampled-spec throughput at
     matched steps_per_dispatch, >=2x radix prefill reduction with ZERO
@@ -1316,7 +1563,7 @@ def run_sampled_spec_smoke(args):
 
 
 def run_trace_smoke(args):
-    """verify.sh [15/17]: the observability request plane end to end —
+    """verify.sh [15/18]: the observability request plane end to end —
     >= 64 routed requests each leaving a finished `RequestTrace` with
     monotonic queued -> prefill -> decode phase stamps, a two-objective
     SLO fleet driving BOTH good and bad counters non-zero, a mid-run
@@ -1514,7 +1761,7 @@ def run_trace_smoke(args):
 
 
 def run_alert_smoke(args):
-    """verify.sh [16/17]: the alert engine + goodput ledger end to end —
+    """verify.sh [16/18]: the alert engine + goodput ledger end to end —
     an injected overload drives `serving_shed_total` up and the
     shed-growth rule through firing -> resolved (after the drain), a
     vanished federation worker fires the absence rule and re-publishing
@@ -1757,12 +2004,12 @@ def main(argv=None):
                          "periods so the proposer can match inside "
                          "the prompt")
     ap.add_argument("--spec-smoke", action="store_true",
-                    help="verify.sh [14/17]: ONLY the speculative + "
+                    help="verify.sh [14/18]: ONLY the speculative + "
                          "shared-prefix phases at smoke scale, plus "
                          "compare_bench self-gates and the /metrics "
                          "families check")
     ap.add_argument("--sampled-spec-smoke", action="store_true",
-                    help="verify.sh [17/17]: ONLY the sampled-"
+                    help="verify.sh [17/18]: ONLY the sampled-"
                          "speculation + truncated-drafter + radix "
                          "phases at smoke scale, plus compare_bench "
                          "self-gates and the /metrics families check")
@@ -1782,20 +2029,37 @@ def main(argv=None):
     ap.add_argument("--skip-fleet", action="store_true",
                     help="run only the single-server phases 1-3")
     ap.add_argument("--fleet-smoke", action="store_true",
-                    help="verify.sh [12/17]: ONLY the fleet phase at "
+                    help="verify.sh [12/18]: ONLY the fleet phase at "
                          "smoke scale, plus the /metrics + /serving "
                          "acceptance checks")
     ap.add_argument("--trace-smoke", action="store_true",
-                    help="verify.sh [15/17]: ONLY the observability "
+                    help="verify.sh [15/18]: ONLY the observability "
                          "smoke — request-lifecycle traces, SLO "
                          "burn-rate, flight-recorder dump, federated "
                          "/metrics scrape")
     ap.add_argument("--alert-smoke", action="store_true",
-                    help="verify.sh [16/17]: ONLY the alert-engine + "
+                    help="verify.sh [16/18]: ONLY the alert-engine + "
                          "goodput smoke — overload-driven rule "
                          "firing/resolution, ledger conservation, "
                          "/alerts + /metrics surfaces, flight-recorder "
                          "transitions")
+    ap.add_argument("--replica-streams", type=int, default=32,
+                    help="flood width per arm of the replicated A/B")
+    ap.add_argument("--replica-step-floor-ms", type=float, default=25.0,
+                    help="emulated device-step floor per decode "
+                         "dispatch in each replica subprocess — makes "
+                         "the A/B measure serving-plane overlap in "
+                         "the device-bound regime on the 1-core "
+                         "sandbox (see run_replicated)")
+    ap.add_argument("--replica-min-scale", type=float, default=1.7,
+                    help="aggregate tok/s floor for 1->2 replicas")
+    ap.add_argument("--skip-replicated", action="store_true",
+                    help="skip the multi-process replicated phase")
+    ap.add_argument("--replica-smoke", action="store_true",
+                    help="verify.sh [18/18]: ONLY the horizontal "
+                         "serving phase — 2-subprocess replica fleet, "
+                         "greedy parity, mid-flood replica kill, "
+                         "aggregate-throughput floor, disagg parity")
     ap.add_argument("--out", default="BENCH_serving.json")
     args = ap.parse_args(argv)
     if args.smoke or args.fleet_smoke or args.trace_smoke:
@@ -1803,10 +2067,33 @@ def main(argv=None):
         args.fleet_tokens = 16
         args.fleet_post_swap = 64
         args.fleet_min_sustained = 128
+    if args.smoke or args.replica_smoke:
+        # keep the flood a multiple of 2x n_slots (16): each arm's
+        # waves pack the slot grid exactly, so the scale measurement
+        # reflects the serving plane, not a ragged final half-wave
+        args.replica_streams = min(args.replica_streams, 32)
     if args.trace_smoke:
         return run_trace_smoke(args)
     if args.alert_smoke:
         return run_alert_smoke(args)
+    if args.replica_smoke:
+        from deeplearning4j_tpu import monitor
+        monitor.enable()
+        replicated_block, failures = run_replicated(args)
+        print(json.dumps({"serving_replicated": replicated_block},
+                         indent=2, sort_keys=True))
+        if failures:
+            for f_ in failures:
+                print(f"FAIL: {f_}", file=sys.stderr)
+            return 1
+        rb = replicated_block
+        print(f"replicated smoke OK (scale "
+              f"{rb['replica_scale_x']}x, kill drill "
+              f"{rb['kill_drill']['completed']}/"
+              f"{rb['kill_drill']['streams']} with "
+              f"{rb['kill_drill']['migrated']} migrated, disagg "
+              f"{rb['disagg']['parity_vs_colocated']})")
+        return 0
     if args.fleet_smoke:
         from deeplearning4j_tpu import monitor
         monitor.enable()
@@ -1937,6 +2224,10 @@ def main(argv=None):
     fleet_block, fleet_failures = (
         ({}, []) if args.skip_fleet else run_fleet(args))
 
+    # -------------------- phase 10: horizontal multi-process replicas
+    replicated_block, replicated_failures = (
+        ({}, []) if args.skip_replicated else run_replicated(args))
+
     # --------- phases 5+6: speculative decode + shared-prefix CoW A/B
     spec_block, spec_failures, spec_net, spec_max_len = \
         run_speculative(args)
@@ -2009,6 +2300,8 @@ def main(argv=None):
     record["extras"]["goodput"] = goodput_block(stats1)
     if fleet_block:
         record["extras"]["serving_fleet"] = fleet_block
+    if replicated_block:
+        record["extras"]["serving_replicated"] = replicated_block
     with open(args.out, "w") as f:
         json.dump(record, f, indent=2, sort_keys=True)
     s = record["extras"]["serving"]
@@ -2082,6 +2375,17 @@ def main(argv=None):
               f"p99 TTFT {fb['swap_p99_ttft_ms']}ms | autoscale "
               f"{fb['autoscale']} | parity "
               f"{fb['parity_version_tagged']}")
+    if replicated_block:
+        rb = replicated_block
+        kd = rb["kill_drill"]
+        print(f"phase10 (replicated): {rb['tokens_per_sec_1r']} -> "
+              f"{rb['tokens_per_sec_2r']} tok/s from 1->2 replicas "
+              f"({rb['replica_scale_x']}x, floor "
+              f"{rb['step_floor_ms']}ms/dispatch) | kill drill "
+              f"{kd['completed']}/{kd['streams']} completed, "
+              f"{kd['migrated']} migrated, parity {kd['parity']} | "
+              f"disagg {rb['disagg']['parity_vs_colocated']} | "
+              f"parity {rb['greedy_parity_2r']}")
     print(f"ledger -> {args.out}")
 
     failures = []
@@ -2131,6 +2435,7 @@ def main(argv=None):
             f"— accounting path broken (~0: ledger never fed; ~1: "
             f"padding/warmup never counted)")
     failures.extend(fleet_failures)
+    failures.extend(replicated_failures)
     failures.extend(spec_failures)
     failures.extend(prefix_failures)
     failures.extend(sampled_failures)
