@@ -270,30 +270,55 @@ class ReplicaSet:
                         "the last known view", e)
             return
         d = serving_directory(status, self.model)
+        live = {}
+        for r in d["replicas"]:
+            if r["port"] is None:
+                continue
+            live[r["token"]] = r
+        # connect OUTSIDE the lock: ReplicaClient() is a blocking
+        # connect with a multi-second timeout, and one unreachable
+        # replica must not stall backends() — and every submit — for
+        # that long
         with self._lock:
-            self.generation = d["generation"]
-            live = {}
-            for r in d["replicas"]:
-                if r["port"] is None:
-                    continue
-                live[r["token"]] = r
-            self._meta = live
+            need = []
             for tok, r in live.items():
                 c = self._clients.get(tok)
-                if c is not None and not c.closed:
-                    continue
-                try:
-                    self._clients[tok] = ReplicaClient(
-                        r["host"], r["port"], token=tok)
-                except OSError as e:
-                    log.warning("replica %s unreachable at %s:%s (%s)",
-                                tok, r["host"], r["port"], e)
-                    self._clients.pop(tok, None)
+                if c is None or c.closed:
+                    need.append((tok, r["host"], r["port"]))
+        connected = []
+        for tok, host, port in need:
+            try:
+                connected.append((tok, ReplicaClient(host, port,
+                                                     token=tok)))
+            except OSError as e:
+                log.warning("replica %s unreachable at %s:%s (%s)",
+                            tok, host, port, e)
+        evicted: List[ReplicaClient] = []
+        with self._lock:
+            self.generation = d["generation"]
+            self._meta = live
+            for tok, c in connected:
+                old = self._clients.get(tok)
+                if old is not None and not old.closed:
+                    # a concurrent refresh connected first; keep its
+                    # client (it may already carry in-flight streams)
+                    evicted.append(c)
+                else:
+                    self._clients[tok] = c
             for tok in list(self._clients):
                 if tok not in live:
                     # evicted from the membership: fail its streams NOW
                     # (typed) instead of letting them ride a dead socket
-                    self._clients.pop(tok).close()
+                    evicted.append(self._clients.pop(tok))
+        # close AFTER releasing the lock: close() fails the client's
+        # in-flight streams synchronously on THIS thread, and a failed
+        # stream's migration path re-enters refresh()/backends() on
+        # this same ReplicaSet — closing under the non-reentrant lock
+        # deadlocks the whole replica set (the re-entrant refresh now
+        # just returns early via the throttle with the view installed
+        # above)
+        for c in evicted:
+            c.close()
 
     def backends(self) -> List[Tuple[str, ReplicaClient, dict]]:
         with self._lock:
@@ -703,20 +728,42 @@ def spawn_replica(registry_root: str, model: str, *,
         env["DL4J_COMPILE_CACHE_DIR"] = str(compile_cache_dir)
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=sys.stderr, env=env, text=True)
-    deadline = time.monotonic() + ready_timeout_s
+    # readline() has no timeout of its own, and a child hung in model
+    # load/warmup prints NOTHING to stdout (its logs go to stderr) —
+    # a watchdog kills it at the deadline so the blocked readline
+    # returns EOF instead of wedging the caller forever
+    timed_out = threading.Event()
+
+    def _watchdog():
+        timed_out.set()
+        proc.kill()
+
+    watchdog = threading.Timer(ready_timeout_s, _watchdog)
+    watchdog.daemon = True
+    watchdog.start()
     line = ""
-    while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if not line:
-            break
-        if line.startswith("REPLICA_READY "):
-            info = json.loads(line[len("REPLICA_READY "):])
-            return ReplicaProcess(proc, info["host"], info["port"],
-                                  info["token"])
-    proc.kill()
-    raise RuntimeError(
-        f"replica subprocess for {model!r} never reported ready "
-        f"(last line: {line!r})")
+    try:
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if line.startswith("REPLICA_READY "):
+                watchdog.cancel()
+                if timed_out.is_set():
+                    break        # READY raced the kill: already dead
+                info = json.loads(line[len("REPLICA_READY "):])
+                return ReplicaProcess(proc, info["host"], info["port"],
+                                      info["token"])
+        proc.kill()
+        if timed_out.is_set():
+            raise RuntimeError(
+                f"replica subprocess for {model!r} did not report "
+                f"ready within {ready_timeout_s}s")
+        raise RuntimeError(
+            f"replica subprocess for {model!r} never reported ready "
+            f"(last line: {line!r})")
+    finally:
+        watchdog.cancel()
 
 
 def main(argv=None) -> int:

@@ -64,7 +64,8 @@ class FleetRouter:
                  max_queue: Optional[int] = None,
                  weights: Optional[Dict[str, float]] = None,
                  transport=None, prefix: str = "fleet",
-                 poll_s: float = 0.005):
+                 poll_s: float = 0.005,
+                 replica_pending_ttl_s: float = 0.75):
         self.fleet = fleet
         self.slo_ttft_s = slo_ttft_s
         self.max_queue = max_queue
@@ -76,15 +77,21 @@ class FleetRouter:
         self._out_inflight: Dict[str, int] = {}
         self._out_lock = threading.Lock()
         # horizontal serving: one ReplicaSet per replicated model, plus
-        # this router's own not-yet-resolved token debt per replica —
+        # this router's own not-yet-absorbed token debt per replica —
         # the directory's load gauges refresh once per heartbeat, so a
         # burst submitted between refreshes must see its OWN submissions
         # or every request in the burst lands on the same "least-loaded"
-        # replica
+        # replica. Each debt entry is [n_tokens, t_submit] and counts
+        # only until the replica's next heartbeat has had time to land
+        # (replica_pending_ttl_s): after that the advertised
+        # outstanding_tokens gauge includes the same request, and
+        # counting both halves would double-count nearly every
+        # in-flight request for its whole lifetime
         self._replica_sets: Dict[str, object] = {}
         self._replica_migrations: Dict[str, int] = {}
-        self._replica_pending: Dict[str, int] = {}
+        self._replica_pending: Dict[str, List[list]] = {}
         self._replica_lock = threading.Lock()
+        self.replica_pending_ttl_s = float(replica_pending_ttl_s)
         self._metrics_cache = None
         # transport-plane threads + active remote streams
         self._running = False
@@ -108,6 +115,10 @@ class FleetRouter:
                     "fleet_shed_total",
                     "requests shed by the router admission policy",
                     model=name),
+                "lost": lambda name: reg.counter(
+                    "fleet_replica_lost_total",
+                    "requests failed because no live replica could "
+                    "take them", model=name),
                 "outputs": lambda name: reg.counter(
                     "fleet_output_requests_total",
                     "one-shot output() requests routed per model",
@@ -253,10 +264,26 @@ class FleetRouter:
         self._replica_migrations.pop(name, None)
 
     def replica_pending(self, token: str) -> int:
-        """Tokens this router has submitted to `token` and not yet seen
-        resolve — the between-heartbeats half of the balance signal."""
+        """Tokens this router has submitted to `token` that its
+        advertised gauges cannot see yet — the between-heartbeats half
+        of the balance signal. An entry stops counting when its stream
+        resolves OR when it outlives `replica_pending_ttl_s`: by then
+        the replica's own heartbeat-refreshed `outstanding_tokens`
+        covers the request, and the debt here must drop out or the
+        projection counts those tokens twice."""
+        now = time.monotonic()
+        cutoff = now - self.replica_pending_ttl_s
         with self._replica_lock:
-            return self._replica_pending.get(token, 0)
+            entries = self._replica_pending.get(token)
+            if not entries:
+                return 0
+            live = [e for e in entries if e[1] > cutoff]
+            if len(live) != len(entries):
+                if live:
+                    self._replica_pending[token] = live
+                else:
+                    self._replica_pending.pop(token, None)
+            return sum(e[0] for e in live)
 
     def _replica_order_key(self, backend):
         """Least-loaded ordering on the WORK gauges — outstanding
@@ -273,9 +300,8 @@ class FleetRouter:
         shed decision (`_replica_shed_reason`)."""
         tok, _client, meta = backend
         load = meta.get("load") or {}
-        with self._replica_lock:
-            pend = self._replica_pending.get(tok, 0)
-        out = int(load.get("outstanding_tokens") or 0) + pend
+        out = (int(load.get("outstanding_tokens") or 0)
+               + self.replica_pending(tok))
         return (out, int(load.get("queue_depth") or 0), tok)
 
     def _replica_shed_reason(self, name: str, tok: str,
@@ -289,9 +315,8 @@ class FleetRouter:
                     f"({depth} >= max_queue {self.max_queue})")
         rate = float(load.get("ewma_tok_s") or 0.0)
         if self.slo_ttft_s is not None and rate > 0:
-            with self._replica_lock:
-                pend = self._replica_pending.get(tok, 0)
-            out = int(load.get("outstanding_tokens") or 0) + pend
+            out = (int(load.get("outstanding_tokens") or 0)
+                   + self.replica_pending(tok))
             budget = self.slo_ttft_s * self.weights.get(name, 1.0)
             projected = out / rate
             if projected > budget:
@@ -301,14 +326,21 @@ class FleetRouter:
         return None
 
     def _note_replica_submit(self, tok: str, n_tokens: int, stream):
+        entry = [int(n_tokens), time.monotonic()]
         with self._replica_lock:
-            self._replica_pending[tok] = (
-                self._replica_pending.get(tok, 0) + int(n_tokens))
+            self._replica_pending.setdefault(tok, []).append(entry)
 
-        def _resolved(_f, tok=tok, n=int(n_tokens)):
+        def _resolved(_f, tok=tok, entry=entry):
             with self._replica_lock:
-                self._replica_pending[tok] = max(
-                    0, self._replica_pending.get(tok, 0) - n)
+                entries = self._replica_pending.get(tok)
+                if entries is None:
+                    return
+                try:
+                    entries.remove(entry)
+                except ValueError:
+                    pass                 # already expired out of view
+                if not entries:
+                    self._replica_pending.pop(tok, None)
 
         stream._fut.add_done_callback(_resolved)
 
@@ -337,6 +369,17 @@ class FleetRouter:
                 trace.event("shed", reason=str(e), router=True)
                 trace.finish(status="shed")
             self._note_shed_burst(name, str(e))
+            raise
+        except ReplicaLostError as e:
+            # no live replica could take it — finish the trace and
+            # count it, or the failure leaks an unfinished RequestTrace
+            # and stays invisible to error telemetry
+            if m is not None:
+                m["lost"](name).inc()
+            if trace is not None:
+                trace.event("replica_lost", reason=str(e), router=True)
+                trace.finish(status="error",
+                             error=type(e).__name__)
             raise
         if m is not None:
             m["streams"](name).inc()

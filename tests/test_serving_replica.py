@@ -401,6 +401,55 @@ class TestRouterReplicated:
             w1.stop()
             w2.stop()
 
+    def test_directory_eviction_migrates_without_deadlock(
+            self, coord, net, prompts):
+        """A replica evicted from the serving DIRECTORY while its
+        socket still works and streams are in flight: refresh() closes
+        the client, whose failing streams migrate SYNCHRONOUSLY on the
+        refreshing thread and re-enter refresh()/backends() on the same
+        set — a regression to closing under the set lock wedges that
+        thread (and every future submit) forever."""
+        w1 = _worker(net, coord.address)
+        w2 = _worker(net, coord.address)
+        rset = ReplicaSet(coord.address, "m", refresh_s=0.05)
+        router = FleetRouter()
+        router.attach_replicas("m", rset)
+        try:
+            _wait_replicas(rset, 2)
+            flood = [router.submit("m", p, 24)
+                     for p in list(prompts) * 2]
+            time.sleep(0.1)
+            # vanish from the directory WITHOUT breaking the socket —
+            # heartbeats off first, or the beat loop re-registers
+            w2._elastic.stop()
+            w2._elastic.leave("eviction drill")
+            converged = threading.Event()
+
+            def _refresh_until_survivor():
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    rset.refresh(force=True)
+                    if [t for t, _, _ in rset.backends()] \
+                            == [w1.token]:
+                        converged.set()
+                        return
+                    time.sleep(0.05)
+
+            t = threading.Thread(target=_refresh_until_survivor,
+                                 daemon=True)
+            t.start()
+            assert converged.wait(15), \
+                "refresh() wedged evicting a replica with live streams"
+            want = generate(net, np.asarray(list(prompts) * 2), 24,
+                            temperature=0)
+            for s, w_ in zip(flood, want):
+                np.testing.assert_array_equal(s.result(120), w_)
+            assert any(s.migrations > 0 for s in flood)
+        finally:
+            rset.close()
+            w1.stop()
+            w2.stop()
+
     def test_sampled_migration_keeps_fold_chain(self, coord, net,
                                                 prompts):
         rng = np.asarray([7, 29], np.uint32)
