@@ -1233,11 +1233,28 @@ GATE_TOLERANCES = {
     # loadtest itself hard-fails below 1.7 regardless of baseline
     "serving_replica_scale_x": 0.08,
     "serving_replicated_tokens_per_sec": 0.25,
+    # multi-tenant fleet (scripts/tenant_loadtest.py): throughput is a
+    # host-timing number (wide band); the other three are STRUCTURAL —
+    # shared_base_copies counts distinct in-memory base-weight copies
+    # (1 by construction; a tenant silently deep-copying the base
+    # doubles it, far past the band; lower is better),
+    # adapter_zip_fraction is adapter-artifact bytes over the full
+    # model zip (a publish path that silently ships base weights jumps
+    # from ~0.03 toward 1.0; lower is better), and the fair-share
+    # floor margin is light-tenant admitted share over its floor under
+    # 10:1 skew (an admission plane that stops protecting the floor
+    # collapses it below 1.0)
+    "tenant_tokens_per_sec": 0.25,
+    "tenant_shared_base_copies": 0.02,
+    "tenant_adapter_zip_fraction": 0.5,
+    "tenant_light_share_floor_margin": 0.10,
 }
 # metrics where a RISE past tolerance is the regression (latencies);
 # compare_bench inverts the ratio so the shared gate math applies
 GATE_LOWER_IS_BETTER = {"serving_mixed_p50_ttft_ms",
-                        "fleet_swap_p99_ttft_ms"}
+                        "fleet_swap_p99_ttft_ms",
+                        "tenant_shared_base_copies",
+                        "tenant_adapter_zip_fraction"}
 _GATE_HEADLINE = "resnet50_images_per_sec"
 
 
@@ -1311,6 +1328,16 @@ def _gate_metrics(rec):
          "extras", "serving_replicated", "replica_scale_x")
     take("serving_replicated_tokens_per_sec",
          "extras", "serving_replicated", "tokens_per_sec_2r")
+    # multi-tenant fleet (scripts/tenant_loadtest.py): shared-base
+    # memory claim, adapter-delta artifact size, fair-share floor
+    take("tenant_tokens_per_sec",
+         "extras", "serving_tenancy", "tokens_per_sec")
+    take("tenant_shared_base_copies",
+         "extras", "serving_tenancy", "shared_base_copies")
+    take("tenant_adapter_zip_fraction",
+         "extras", "serving_tenancy", "adapter_zip_fraction")
+    take("tenant_light_share_floor_margin",
+         "extras", "serving_tenancy", "fair_share", "floor_margin")
     return out
 
 

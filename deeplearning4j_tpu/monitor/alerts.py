@@ -24,7 +24,12 @@ Rule kinds:
                     ``unless_metric`` suppresses the breach when that
                     family ALSO increased (a `fleet_swaps_total` bump is
                     fine when `registry_published_total` moved too —
-                    that is a version rollout, not a silent resize);
+                    that is a version rollout, not a silent resize); an
+                    optional ``only_if_metric`` is the mirror image —
+                    the breach only counts when that family increased
+                    too (a tenant being shed is STARVATION only while
+                    the fleet is still doing useful work; when nothing
+                    moves, the fleet is down and other rules own it);
 - ``burn_rate``   — windowed average of a gauge against per-window
                     bounds, ALL windows breaching (the multi-window SLO
                     burn-rate pattern: sampled history lives in the
@@ -34,10 +39,11 @@ Evaluation is pure host math over an already-materialized snapshot —
 zero device syncs, nothing at all when never called.  `evaluate(now=)`
 takes an explicit clock so tests drive hysteresis deterministically.
 
-`default_rule_pack()` ships the ten documented shapes: checkpoint
+`default_rule_pack()` ships the twelve documented shapes: checkpoint
 staleness, elastic shrink, shed growth, registry fallback, watermark
 lag, worker-vanished, SLO burn, swap-without-publish, radix eviction
-churn, sampled-spec acceptance collapse.
+churn, sampled-spec acceptance collapse, drift-gate stuck-paused,
+tenant share starvation.
 """
 
 from __future__ import annotations
@@ -48,9 +54,14 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .flightrec import GLOBAL_FLIGHT_RECORDER
+from .goodput import GOODPUT_COUNTER_FAMILIES
 
 __all__ = ["AlertRule", "AlertEngine", "default_rule_pack",
            "ALERT_STATE_GAUGE", "STATE_VALUES"]
+
+#: the tenant-starvation co-requirement family — the fleet's "still
+#: moving useful tokens" signal (the goodput ledger's serving mirror).
+GOODPUT_USEFUL_FAMILY = GOODPUT_COUNTER_FAMILIES["useful"]
 
 ALERT_STATE_GAUGE = "alert_state"
 
@@ -83,6 +94,7 @@ class AlertRule:
     aggregate: str = "max"                 # max | min | sum over series
     stale_s: Optional[float] = None        # absence: export-age bound
     unless_metric: Optional[str] = None    # delta_rate suppressor
+    only_if_metric: Optional[str] = None   # delta_rate co-requirement
     windows: Tuple[Tuple[float, float], ...] = ()   # burn_rate
 
     def __post_init__(self):
@@ -272,20 +284,30 @@ class AlertEngine:
         ctx = {"missing": [dict(k) for k in sorted(missing)]}
         return bool(missing), float(len(missing)), ctx
 
+    def _guard_increase(self, rule_key: str, metric: str, snap: Dict,
+                        now: float) -> Optional[float]:
+        """Total positive increase of a companion counter family since
+        the previous evaluation (None on the first sighting)."""
+        gpairs = dict(_series_values(snap, metric, {}))
+        gprev = self._prev_counters.get(rule_key)
+        self._prev_counters[rule_key] = (now, gpairs)
+        if gprev is None:
+            return None
+        _, gold = gprev
+        return sum(max(0.0, v - gold.get(k, 0.0))
+                   for k, v in gpairs.items())
+
     def _eval_delta_rate(self, rule: AlertRule, snap: Dict, now: float):
         pairs = dict(_series_values(snap, rule.metric, rule.labels))
         prev = self._prev_counters.get(rule.name)
         self._prev_counters[rule.name] = (now, pairs)
-        guard_inc = 0.0
+        guard_inc = onlyif_inc = None
         if rule.unless_metric:
-            gpairs = dict(_series_values(snap, rule.unless_metric, {}))
-            gkey = rule.name + "/unless"
-            gprev = self._prev_counters.get(gkey)
-            self._prev_counters[gkey] = (now, gpairs)
-            if gprev is not None:
-                _, gold = gprev
-                guard_inc = sum(max(0.0, v - gold.get(k, 0.0))
-                                for k, v in gpairs.items())
+            guard_inc = self._guard_increase(
+                rule.name + "/unless", rule.unless_metric, snap, now)
+        if rule.only_if_metric:
+            onlyif_inc = self._guard_increase(
+                rule.name + "/only_if", rule.only_if_metric, snap, now)
         if prev is None:
             return False, None, {}
         t0, old = prev
@@ -296,8 +318,12 @@ class AlertEngine:
         rate = inc / dt
         ctx = {"increase": inc, "interval_s": dt}
         if rule.unless_metric:
-            ctx["unless_increase"] = guard_inc
-            if guard_inc > 0:
+            ctx["unless_increase"] = guard_inc or 0.0
+            if guard_inc:
+                return False, rate, ctx
+        if rule.only_if_metric:
+            ctx["only_if_increase"] = onlyif_inc or 0.0
+            if not onlyif_inc:
                 return False, rate, ctx
         return _OPS[rule.op](rate, rule.value), rate, ctx
 
@@ -408,7 +434,7 @@ class AlertEngine:
 
 
 # =====================================================================
-# the default rule pack: the eight documented alert shapes, codified
+# the default rule pack: the documented alert shapes, codified
 # =====================================================================
 
 def default_rule_pack(*, checkpoint_stale_s: float = 120.0,
@@ -420,6 +446,8 @@ def default_rule_pack(*, checkpoint_stale_s: float = 120.0,
                       worker_stale_s: Optional[float] = None,
                       radix_evict_per_s: float = 5.0,
                       spec_accept_collapse: float = 0.05,
+                      drift_paused_for_s: float = 120.0,
+                      tenant_shed_rate_per_s: float = 1.0,
                       for_s: float = 5.0) -> List[AlertRule]:
     """The shipped rules, one per documented alert shape (the table in
     docs/OBSERVABILITY.md).  Rules over families a process never exports
@@ -501,4 +529,26 @@ def default_rule_pack(*, checkpoint_stale_s: float = 120.0,
                         "K-wide verify dispatch for ~1 token/dispatch "
                         "(check the proposer label; rejection-sampling "
                         "acceptance tracks draft/target divergence)"),
+        AlertRule(
+            name="drift-gate-stuck-paused", kind="threshold",
+            metric="online_publish_paused", op=">=", value=1.0,
+            aggregate="max", for_s=drift_paused_for_s,
+            severity="ticket", event_kind="drift_gate_stuck",
+            description="a DriftGate has held publishes paused past "
+                        "the hysteresis window — the tenant's stream "
+                        "shifted and stayed shifted, so its serving "
+                        "adapter is frozen on stale data (check the "
+                        "tag label for which tenant)"),
+        AlertRule(
+            name="tenant-share-starvation", kind="delta_rate",
+            metric="fleet_tenant_shed_total", op=">",
+            value=tenant_shed_rate_per_s, aggregate="sum",
+            only_if_metric=GOODPUT_USEFUL_FAMILY,
+            severity="ticket", event_kind="tenant_starvation",
+            description="a tenant's shed rate is climbing while the "
+                        "fleet is still moving useful tokens — a "
+                        "fairness problem (heavy neighbor), not an "
+                        "outage: check fleet_tenant_share against the "
+                        "tenant's floor and the heavy tenant's "
+                        "weight"),
     ]

@@ -122,6 +122,24 @@ def dequantize(t: QuantizedTensor, dtype=jnp.float32):
     return (t.q.astype(jnp.float32) * t.scale).astype(dtype)
 
 
+# weight-wrapper extension point: other pytree weight wrappers (the
+# LoRA adapter node in tenancy/lora.py) register their own matmul here
+# at import time, so every layer seam picks them up without this leaf
+# module importing anyone. Dispatch still happens at trace time; plain
+# fp weights never reach the loop.
+_MATMUL_EXTENSIONS: list = []
+
+
+def register_matmul_extension(cls, fn):
+    """Register `fn(x, w)` for weight leaves of type `cls` in the
+    `matmul` seam. Last registration of a class wins (idempotent under
+    module reload)."""
+    global _MATMUL_EXTENSIONS
+    _MATMUL_EXTENSIONS = [(c, f) for c, f in _MATMUL_EXTENSIONS
+                          if c is not cls]
+    _MATMUL_EXTENSIONS.append((cls, fn))
+
+
 def matmul(x, w):
     """`x @ w` with dequantize-inside-matmul when `w` is quantized —
     the ONE seam every quantizable layer matmul routes through. The
@@ -132,6 +150,10 @@ def matmul(x, w):
         # scale is [1, ..., n_out] (keepdims) — broadcasts over the
         # result's trailing output-channel axis exactly
         return y * w.scale.astype(x.dtype)
+    if _MATMUL_EXTENSIONS:
+        for cls, fn in _MATMUL_EXTENSIONS:
+            if isinstance(w, cls):
+                return fn(x, w)
     return x @ w
 
 

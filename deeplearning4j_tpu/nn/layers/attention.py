@@ -181,6 +181,11 @@ class MultiHeadAttention(Layer):
         # (nd/quant.py int8 serving quantization; biases stay fp)
         return ("Wq", "Wk", "Wv", "Wo")
 
+    def adapter_weights(self):
+        # the same projections carry per-tenant LoRA deltas — every
+        # one routes through `quant.matmul` (tenancy/lora.py)
+        return ("Wq", "Wk", "Wv", "Wo")
+
     def _project(self, params, x, name):
         z = quant.matmul(x, params[name])
         if self.has_bias:

@@ -210,6 +210,18 @@ class Layer:
         gain/shift and embedding tables stay floating."""
         return ()
 
+    # ---- low-rank adapters (tenancy/lora.py) -----------------------------
+    def adapter_weights(self):
+        """Param keys eligible for a LoRA-style low-rank delta
+        (`tenancy.lora`): 2-D matmul weights whose forward routes
+        through the `nd.quant.matmul` seam, so a wrapped
+        `LoRAWeight(base, B, A)` leaf composes at dispatch without the
+        layer knowing. Default: none — the same contract as
+        `quantizable_weights()` (and in practice the same key set for
+        the projection matmuls); embedding tables do NOT participate
+        (their gather path bypasses the matmul seam)."""
+        return ()
+
     # ---- weight noise (container calls before forward during training) ---
     def apply_weight_noise(self, params, train: bool, rng):
         if not train or self.weight_noise is None or rng is None or not params:

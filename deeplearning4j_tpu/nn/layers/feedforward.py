@@ -65,6 +65,10 @@ class DenseLayer(Layer):
         # RnnOutputLayer (tied or untied LM heads) via inheritance
         return ("W",)
 
+    def adapter_weights(self):
+        # same matmul seam carries the LoRA delta (tenancy/lora.py)
+        return ("W",)
+
     def pre_output(self, params, x):
         z = quant.matmul(x, params["W"])
         if self.has_bias:

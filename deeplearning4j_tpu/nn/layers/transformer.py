@@ -238,6 +238,12 @@ class TransformerEncoderBlock(BaseRecurrentLayer):
         return ("attn_Wq", "attn_Wk", "attn_Wv", "attn_Wo",
                 "ff_W1", "ff_W2")
 
+    def adapter_weights(self):
+        # attention projections + FF pair take per-tenant LoRA deltas
+        # through the same `quant.matmul` seams (tenancy/lora.py)
+        return ("attn_Wq", "attn_Wk", "attn_Wv", "attn_Wo",
+                "ff_W1", "ff_W2")
+
     def init_carry(self, batch, dtype=jnp.float32):
         if self._mha is None:
             self._build_sublayers()

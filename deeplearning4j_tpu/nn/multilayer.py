@@ -391,19 +391,34 @@ class MultiLayerNetwork:
             self._packed_runs_cache = runs
         return runs
 
-    def _fused_state_runs(self, runs):
+    def _fused_state_runs(self, runs, params=None):
         """Packed runs whose updater takes the fused-Adam kernel —
         their m/v ride the step programs in the kernel's pre-flattened
         [rows, 128] layout (kernels/fused_adam.py: the relayout that
         used to happen around the kernel every micro-step now happens
-        once per program, at the pack/unpack boundary)."""
+        once per program, at the pack/unpack boundary). Runs carrying
+        LoRA adapter nodes (tenancy/lora.py) stay on the per-leaf path
+        — the kernel's flat layout has no notion of a wrapped weight."""
         from deeplearning4j_tpu.kernels import fused_adam as fa
+        from deeplearning4j_tpu.tenancy import lora
         return [scan_stack.run_key(keys) for keys in runs
                 if fa.fused_adam_eligible(
-                    self.layers[int(keys[0])].updater or Sgd(1e-3))]
+                    self.layers[int(keys[0])].updater or Sgd(1e-3))
+                and not (params is not None and any(
+                    lora.contains_lora(params.get(k, {})) for k in keys))]
 
     def _apply_updates(self, params, grads, upd_state, step):
         from deeplearning4j_tpu.kernels import fused_adam as fa
+        from deeplearning4j_tpu.tenancy import lora
+        # a FROZEN attached adapter freezes the WHOLE base, not just
+        # the wrapped matmul weights: biases, norms and embeddings hold
+        # still too, so the published delta fully describes the tenant
+        # and N tenants fine-tuned off one base stay composable. The
+        # flag is derived from leaf types/aux (static under trace —
+        # part of the treedef, so no stale-compile hazard).
+        frozen_base = any(
+            w.frozen for lv in params.values() for w in lv.values()
+            if type(w).__name__ == "LoRAWeight")
         new_params, new_upd = {}, {}
         for lk, lgrads in grads.items():
             if scan_stack.is_run_key(lk):
@@ -414,6 +429,12 @@ class MultiLayerNetwork:
             else:
                 layer = self.layers[int(lk)]
             updater = layer.updater or Sgd(1e-3)
+            if frozen_base and not lora.contains_lora(params[lk]):
+                # frozen-base training, no adapter in this entry
+                # (packed runs included): nothing here may move
+                new_params[lk] = params[lk]
+                new_upd[lk] = upd_state[lk]
+                continue
             if (scan_stack.is_run_key(lk)
                     and fa.fused_adam_eligible(updater)):
                 # Pallas fast path: ONE kernel read-modify-writes the
@@ -427,11 +448,26 @@ class MultiLayerNetwork:
                 continue
             lp, lu = {}, {}
             for pk, g in lgrads.items():
+                p = params[lk][pk]
+                if type(p).__name__ == "LoRAWeight":
+                    # adapter leaf (tenancy/lora.py): B/A move through
+                    # the updater; a frozen base keeps its object
+                    # identity — zero copies, bit-identical base
+                    from deeplearning4j_tpu.tenancy import lora
+                    lp[pk], lu[pk] = lora.apply_adapter_update(
+                        updater, p, g, upd_state[lk][pk], step)
+                    continue
+                if frozen_base:
+                    # plain leaf beside an adapted one (a Dense bias
+                    # next to its wrapped W): frozen too
+                    lp[pk] = p
+                    lu[pk] = upd_state[lk][pk]
+                    continue
                 # bf16 grads (mixed policy) meet the fp32 master here:
                 # upcast BEFORE the updater so m/v/param stay fp32
-                g = g.astype(params[lk][pk].dtype)
+                g = g.astype(p.dtype)
                 delta, new_s = updater.apply(g, upd_state[lk][pk], step)
-                lp[pk] = params[lk][pk] - delta.astype(params[lk][pk].dtype)
+                lp[pk] = p - delta.astype(p.dtype)
                 lu[pk] = new_s
             new_params[lk] = (lp if scan_stack.is_run_key(lk)
                               else layer.apply_constraints(lp))
@@ -457,7 +493,7 @@ class MultiLayerNetwork:
             fused_runs = []
             if runs:
                 from deeplearning4j_tpu.kernels import fused_adam as fa
-                fused_runs = self._fused_state_runs(runs)
+                fused_runs = self._fused_state_runs(runs, params)
                 params, upd_state = fa.pack_run_trees(
                     params, upd_state, runs, fused_runs)
 
@@ -552,7 +588,7 @@ class MultiLayerNetwork:
             fused_runs = []
             if runs:
                 from deeplearning4j_tpu.kernels import fused_adam as fa
-                fused_runs = self._fused_state_runs(runs)
+                fused_runs = self._fused_state_runs(runs, params)
                 params, upd = fa.pack_run_trees(params, upd, runs,
                                                 fused_runs)
             (params, upd, state, _), (losses, dvs) = jax.lax.scan(

@@ -527,6 +527,24 @@ class PagedDecodeEngine:
         return jnp.where(temp > 0, sampled.astype(jnp.int32), greedy_ids)
 
     # ------------------------------------------------------ jit builders
+    def _shared_jit(self, key, builder):
+        """Jitted-program cache shared ACROSS engines of the same net —
+        anchored on `net.__dict__` (the `get_prefill_bucketed` idiom).
+        A per-engine `jax.jit(closure)` is a fresh callable every
+        construction, so every hot-swap successor and every tenant of a
+        shared base used to pay the full ~10s+ decode/admit compile
+        again; a `tenancy._TenantNetView` pre-seeds this attribute with
+        the base net's dict, so N tenant servers and every adapter
+        swap reuse ONE compile (params are arguments, never baked in).
+        Keys carry every non-shape static the closure bakes into the
+        trace (plan, scan length, greedy variant, top_k, block_len) —
+        shape specialization is jit's own per-shape cache."""
+        cache = self.net.__dict__.setdefault("_serving_jit_cache", {})
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = builder()
+        return fn
+
     def _decode_body(self, greedy_only: bool):
         """The decode-chunk python body (jitted by `_build_decode`;
         traced directly by `decode_cost_report` for the byte-table
@@ -585,8 +603,11 @@ class PagedDecodeEngine:
         return decode_step
 
     def _build_decode(self, greedy_only: bool):
-        return jax.jit(self._decode_body(greedy_only),
-                       donate_argnums=donate_argnums(2))
+        return self._shared_jit(
+            ("decode", greedy_only, self.steps_per_dispatch,
+             tuple(self._plan), self.top_k),
+            lambda: jax.jit(self._decode_body(greedy_only),
+                            donate_argnums=donate_argnums(2)))
 
     def decode_cost_report(self) -> dict:
         """Byte accounting of the REAL decode program (greedy variant)
@@ -652,7 +673,10 @@ class PagedDecodeEngine:
                                       greedy_only=greedy_only)
             return tuple(out), firsts
 
-        return jax.jit(admit_finish, donate_argnums=donate_argnums(0))
+        return self._shared_jit(
+            ("admit", int(k), greedy_only, self.block_len, self.top_k),
+            lambda: jax.jit(admit_finish,
+                            donate_argnums=donate_argnums(0)))
 
     def _score_body(self, greedy_only: bool):
         """The K-position score program (zoo.transformer.
@@ -717,10 +741,13 @@ class PagedDecodeEngine:
         key = (int(K), variant)
         fn = self._score.get(key)
         if fn is None:
-            body = (self._score_rs_body() if variant == "rs"
-                    else self._score_body(variant))
-            fn = self._score[key] = jax.jit(
-                body, donate_argnums=donate_argnums(2))
+            def build():
+                body = (self._score_rs_body() if variant == "rs"
+                        else self._score_body(variant))
+                return jax.jit(body, donate_argnums=donate_argnums(2))
+            fn = self._score[key] = self._shared_jit(
+                ("score", int(K), variant, tuple(self._plan),
+                 self.top_k), build)
         return fn
 
     def _build_fork(self):
@@ -738,7 +765,9 @@ class PagedDecodeEngine:
                             v_pool.at[dst].set(v_pool[src])))
             return tuple(out)
 
-        return jax.jit(fork, donate_argnums=donate_argnums(0))
+        return self._shared_jit(
+            ("fork",),
+            lambda: jax.jit(fork, donate_argnums=donate_argnums(0)))
 
     def _run_fork(self, pairs):
         w = 1
@@ -764,7 +793,8 @@ class PagedDecodeEngine:
             return self._sample_ids(probs, keys, emit0, temp, top_p,
                                     greedy_only=greedy_only)
 
-        return jax.jit(first)
+        return self._shared_jit(("first", greedy_only, self.top_k),
+                                lambda: jax.jit(first))
 
     def _draft_body(self):
         """The truncated-layer draft scan: k-1 greedy micro-steps of
@@ -830,8 +860,10 @@ class PagedDecodeEngine:
         tables = np.where(mask[:, None], self.block_tables,
                           GARBAGE_BLOCK).astype(np.int32)
         if self._draft_fn is None:
-            self._draft_fn = jax.jit(self._draft_body(),
-                                     donate_argnums=donate_argnums(2))
+            self._draft_fn = self._shared_jit(
+                ("draft", self.spec_k, tuple(self._draft_plan or ())),
+                lambda: jax.jit(self._draft_body(),
+                                donate_argnums=donate_argnums(2)))
         kv, drafts = self._draft_fn(
             self._params, self.net.net_state, self.pool.kv,
             jnp.asarray(tables), jnp.asarray(self.last_token),

@@ -207,6 +207,14 @@ class FleetServer:
             self._gauge_thread.start()
 
     # ------------------------------------------------------------- deploy
+    def _release_version(self, name: str, version: int):
+        """Release the retention pin of a no-longer-served version —
+        the ONE seam swap()/undeploy()/reap_retired() go through, so a
+        subclass whose versions live in a different store (the
+        TenantFleet's per-tenant adapter sequence) redirects every
+        release by overriding this."""
+        self.registry.unpin(name, version)
+
     def _build_server(self, name: str, version, server_kw: dict,
                       warm_len: Optional[int], warm_tokens: int):
         """Resolve + warm + start one server. The target version is
@@ -385,6 +393,14 @@ class FleetServer:
             if moved:
                 try:
                     successor.adopt_queued(moved)
+                    # a migrated request decodes ENTIRELY on the
+                    # successor, so the router's version tag must
+                    # follow it — keeping the incumbent's version on
+                    # the stream would break version-tagged parity
+                    for item in moved:
+                        st = item[0].stream
+                        if getattr(st, "version", None) is not None:
+                            st.version = v
                     GLOBAL_FLIGHT_RECORDER.record(
                         "swap_migrate", model=name, count=len(moved),
                         to_version=v)
@@ -415,7 +431,7 @@ class FleetServer:
                     f"call reap_retired() once its streams finish")
             old_server.stop()
             if old_version != v:
-                self.registry.unpin(name, old_version)
+                self._release_version(name, old_version)
         m = self._metrics()
         if m is not None:
             m["swaps"](name).inc()
@@ -484,7 +500,7 @@ class FleetServer:
                 # the LIVE deployment — never release a pin a live
                 # server still needs
                 if (name, version) not in live:
-                    self.registry.unpin(name, version)
+                    self._release_version(name, version)
                 GLOBAL_FLIGHT_RECORDER.record(
                     "reap_retired", model=name, version=version,
                     forced=bool(force))
@@ -524,7 +540,7 @@ class FleetServer:
             with self._lock:
                 self._models.pop(name, None)
             d.server.stop()
-            self.registry.unpin(name, d.version)
+            self._release_version(name, d.version)
         GLOBAL_FLIGHT_RECORDER.record(
             "undeploy", model=name, version=d.version,
             drained=bool(drain))

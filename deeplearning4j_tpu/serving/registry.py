@@ -93,6 +93,7 @@ class ModelRegistry:
         self.keep_every = keep_every
         self._lock = threading.Lock()
         self._pinned: Set[Tuple[str, int]] = set()
+        self._adapter_pinned: Set[Tuple[str, str, int]] = set()
         self._metrics_cache = None
 
     # ------------------------------------------------------------- layout
@@ -383,6 +384,230 @@ class ModelRegistry:
         if dropped and m is not None:
             m["gc"].inc(dropped)
 
+    # ----------------------------------------------------- adapter store
+    # Per-tenant LoRA adapter deltas (tenancy/lora.py): the publish
+    # unit of the multi-tenant fleet. Layout mirrors the model store
+    # one level down, with its own version sequence per tenant:
+    #
+    #     <root>/<name>/adapters/<tenant>/v<version>.zip
+    #
+    # Same contracts: one-winner link claim, newest-first resolve with
+    # corrupt-artifact fallback, retention that never collects a
+    # pinned (= served) adapter — pins ride `.pin-v<v>.<pid>` markers
+    # in the tenant directory so a separate serving process is visible
+    # to the publisher's GC.
+
+    def adapter_dir(self, name: str, tenant: str) -> Path:
+        if not tenant or "/" in tenant or tenant.startswith("."):
+            raise ValueError(f"invalid tenant name {tenant!r}")
+        return self.model_dir(name) / "adapters" / tenant
+
+    def adapter_path(self, name: str, tenant: str, version: int) -> Path:
+        return self.adapter_dir(name, tenant) / f"v{int(version)}.zip"
+
+    def tenants(self, name: str) -> List[str]:
+        d = self.model_dir(name) / "adapters"
+        if not d.exists():
+            return []
+        return sorted(t.name for t in d.iterdir()
+                      if t.is_dir() and not t.name.startswith(".")
+                      and self.adapter_versions(name, t.name))
+
+    def adapter_versions(self, name: str, tenant: str) -> List[int]:
+        d = self.adapter_dir(name, tenant)
+        if not d.exists():
+            return []
+        return sorted(v for v in (_version_of(p) for p in d.iterdir())
+                      if v is not None)
+
+    def latest_adapter(self, name: str, tenant: str) -> Optional[int]:
+        vs = self.adapter_versions(name, tenant)
+        return vs[-1] if vs else None
+
+    def publish_adapter(self, name: str, tenant: str, adapter: dict, *,
+                        base_version: int, rank: int, alpha: float,
+                        version: Optional[int] = None,
+                        extra_meta: Optional[dict] = None) -> int:
+        """Publish a tenant's adapter tree against a pinned
+        `base_version` of `name` — the artifact is the DELTA alone
+        (kilobytes), never a model zip. Returns the adapter version
+        committed; `rank`/`alpha`/`base_version` ride meta.json so
+        `resolve_adapter` can compose without side-channel state."""
+        from deeplearning4j_tpu.tenancy import lora
+        d = self.adapter_dir(name, tenant)
+        d.mkdir(parents=True, exist_ok=True)
+        tmp = d / f".publish-{os.getpid()}-{uuid.uuid4().hex[:8]}.tmp.zip"
+        meta = dict(extra_meta or {})
+        meta.update(model=name, tenant=tenant,
+                    base_version=int(base_version), rank=int(rank),
+                    alpha=float(alpha))
+        try:
+            lora.save_adapter(tmp, adapter, meta=meta)
+            if version is not None:
+                committed = self._claim_at(tmp, self.adapter_path(
+                    name, tenant, int(version)), int(version))
+                if committed is None:
+                    raise VersionConflictError(
+                        f"{name}/{tenant} adapter v{version} already "
+                        f"exists — a concurrent publish won the claim")
+            else:
+                while True:
+                    nxt = (self.latest_adapter(name, tenant) or 0) + 1
+                    committed = self._claim_at(
+                        tmp, self.adapter_path(name, tenant, nxt), nxt)
+                    if committed is not None:
+                        break
+        finally:
+            if tmp.exists():
+                tmp.unlink()
+        self._fsync_dir(d)
+        from deeplearning4j_tpu import monitor
+        if monitor.is_enabled():
+            monitor.registry().counter(
+                "registry_adapter_published_total",
+                help="tenant adapter versions published",
+                model=name, tenant=tenant).inc()
+        self._gc_adapters(name, tenant)
+        GLOBAL_FLIGHT_RECORDER.record("publish_adapter", model=name,
+                                      tenant=tenant, version=committed,
+                                      base_version=int(base_version))
+        log.info("published adapter %s/%s v%d (base v%d) -> %s",
+                 name, tenant, committed, base_version,
+                 self.adapter_path(name, tenant, committed))
+        return committed
+
+    @staticmethod
+    def _claim_at(tmp: Path, final: Path, version: int) -> Optional[int]:
+        try:
+            os.link(tmp, final)
+            return version
+        except FileExistsError:
+            return None
+
+    def resolve_adapter(self, name: str, tenant: str,
+                        version: Union[int, str] = "latest"):
+        """-> (adapter_tree, meta, version). `"latest"` walks
+        newest-first with corrupt-artifact fallback (the model-store
+        semantics); an explicit version fails hard on damage."""
+        vs = self.adapter_versions(name, tenant)
+        if not vs:
+            raise FileNotFoundError(
+                f"no published adapters for {name!r} tenant {tenant!r} "
+                f"under {self.root} (known tenants: {self.tenants(name)})")
+        from deeplearning4j_tpu.tenancy import lora
+        if version != "latest":
+            v = int(version)
+            if v not in vs:
+                raise FileNotFoundError(
+                    f"{name}/{tenant} adapter v{v} is not in the "
+                    f"registry (have {vs})")
+            adapter, meta = lora.load_adapter(
+                self.adapter_path(name, tenant, v))
+            return adapter, meta, v
+        m = self._metrics()
+        tried = []
+        for v in reversed(vs):
+            try:
+                adapter, meta = lora.load_adapter(
+                    self.adapter_path(name, tenant, v))
+                return adapter, meta, v
+            except (ValueError, KeyError, OSError) as e:
+                log.warning("%s/%s adapter v%d is corrupt (%s); "
+                            "falling back", name, tenant, v, e)
+                if m is not None:
+                    m["fallback"].inc()
+                tried.append((v, e))
+        detail = "; ".join(f"v{v}: {e}" for v, e in tried)
+        raise CheckpointCorruptError(
+            f"every published adapter of {name!r}/{tenant!r} failed "
+            f"verification ({len(tried)} candidates tried) — {detail}")
+
+    def _adapter_pin_marker(self, name: str, tenant: str,
+                            version: int) -> Path:
+        return self.adapter_dir(name, tenant) / \
+            f".pin-v{int(version)}.{os.getpid()}"
+
+    def pin_adapter(self, name: str, tenant: str, version: int):
+        """Protect a served adapter version from retention GC — the
+        TenantFleet pins what each tenant is decoding with, exactly
+        like the model-store pin (in-memory + on-disk marker)."""
+        with self._lock:
+            self._adapter_pinned.add((name, tenant, int(version)))
+        d = self.adapter_dir(name, tenant)
+        d.mkdir(parents=True, exist_ok=True)
+        try:
+            self._adapter_pin_marker(name, tenant, version).touch()
+        except OSError:
+            pass
+
+    def unpin_adapter(self, name: str, tenant: str, version: int):
+        with self._lock:
+            self._adapter_pinned.discard((name, tenant, int(version)))
+        try:
+            self._adapter_pin_marker(name, tenant, version).unlink()
+        except OSError:
+            pass
+        self._gc_adapters(name, tenant)
+
+    def _adapter_marker_pins(self, name: str, tenant: str) -> Set[int]:
+        import re
+        keep: Set[int] = set()
+        d = self.adapter_dir(name, tenant)
+        if not d.exists():
+            return keep
+        for p in d.glob(".pin-v*.*"):
+            mm = re.fullmatch(r"\.pin-v(\d+)\.(\d+)", p.name)
+            if not mm:
+                continue
+            v, pid = int(mm.group(1)), int(mm.group(2))
+            if pid == os.getpid() or self._pid_alive(pid):
+                keep.add(v)
+            else:
+                try:
+                    p.unlink()
+                except OSError:
+                    pass
+        return keep
+
+    def _gc_adapters(self, name: str, tenant: str):
+        vs = self.adapter_versions(name, tenant)
+        keep = set(vs[-self.keep_last:])
+        if self.keep_every:
+            keep.update(v for v in vs if v % self.keep_every == 0)
+        with self._lock:
+            keep.update(v for n, t, v in self._adapter_pinned
+                        if n == name and t == tenant)
+        keep.update(self._adapter_marker_pins(name, tenant))
+        m = self._metrics()
+        dropped = 0
+        for v in vs:
+            if v in keep:
+                continue
+            try:
+                self.adapter_path(name, tenant, v).unlink()
+                dropped += 1
+                log.info("retention GC dropped adapter %s/%s v%d",
+                         name, tenant, v)
+            except OSError:
+                pass
+        if dropped and m is not None:
+            m["gc"].inc(dropped)
+
+    def adapter_publish_listener(self, name: str, tenant: str, *,
+                                 base_version: int, rank: int,
+                                 alpha: float, frequency: int = 100,
+                                 every_s: Optional[float] = None,
+                                 publish_at_fit_end: bool = True,
+                                 gate=None):
+        """The adapter-delta twin of `publish_listener`: every cadence
+        boundary ships `tenancy.lora.extract_adapter(net)` via
+        `publish_adapter` — kilobytes per release instead of a model
+        zip, same step-boundary discipline and drift-gate semantics."""
+        return AdapterPublishListener(
+            self, name, tenant, base_version=base_version, rank=rank,
+            alpha=alpha, frequency=frequency, every_s=every_s,
+            publish_at_fit_end=publish_at_fit_end, gate=gate)
+
     # -------------------------------------------------- publish listener
     def publish_listener(self, name: str, *, frequency: int = 100,
                          epoch_frequency: Optional[int] = None,
@@ -563,3 +788,48 @@ class RegistryPublishListener(TrainingListener):
                 and not self._gated(int(model.iteration_count),
                                     windowed=False):
             self._publish(model, int(model.iteration_count))
+
+
+class AdapterPublishListener(RegistryPublishListener):
+    """RegistryPublishListener whose publish unit is the tenant's
+    adapter DELTA (`tenancy.lora.extract_adapter`) against a pinned
+    base version — all cadence/gate/step-boundary semantics inherited;
+    only what ships changes."""
+
+    def __init__(self, registry: ModelRegistry, name: str, tenant: str,
+                 *, base_version: int, rank: int, alpha: float,
+                 frequency: int = 100, every_s: Optional[float] = None,
+                 publish_at_fit_end: bool = True, gate=None):
+        super().__init__(registry, name, frequency=frequency,
+                         every_s=every_s,
+                         publish_at_fit_end=publish_at_fit_end,
+                         gate=gate)
+        self.tenant = tenant
+        self.base_version = int(base_version)
+        self.rank = int(rank)
+        self.alpha = float(alpha)
+
+    def _publish(self, model, step: int):
+        from deeplearning4j_tpu.tenancy import lora
+        adapter = lora.extract_adapter(model)
+        if not adapter:
+            raise ValueError(
+                f"model for {self.name}/{self.tenant} carries no "
+                f"attached adapter — lora.attach_adapter() before fit")
+        v = self.registry.publish_adapter(
+            self.name, self.tenant, adapter,
+            base_version=self.base_version, rank=self.rank,
+            alpha=self.alpha, extra_meta={"step": step})
+        self.published_versions.append(v)
+        self.published_steps.append(step)
+        self._last_published_step = step
+        if self.every_s is not None:
+            import time
+            self._last_published_time = time.monotonic()
+        from deeplearning4j_tpu import monitor
+        if monitor.is_enabled():
+            monitor.registry().counter(
+                "online_adapter_publishes_total",
+                help="adapter deltas published into the serving "
+                     "registry from a tenant's training loop",
+                model=self.name, tenant=self.tenant).inc()

@@ -19,6 +19,22 @@ admitting. `max_queue` is the per-model hard backstop before any
 throughput estimate exists. Shed requests raise `ShedError` (locally)
 or carry it across the wire (`wire.reply_error`).
 
+Fair-share admission (multi-tenant floors): when the router fronts a
+`TenantFleet`, model names are TENANTS of one shared base and the
+device is a shared resource — a heavy tenant's flood degrades every
+tenant's throughput EWMA, so the light tenant's projected delay grows
+through no fault of its own and plain SLO shedding starves it.
+`set_share_floor(tenant, floor)` grants a tenant a guaranteed
+fraction of recently-admitted fleet work (windowed token accounting,
+`share_window_s`): while its admitted share sits below the floor, the
+projected-delay shed is bypassed (only its own `max_queue` backstop
+applies), and any tenant consuming MORE than its weight-proportional
+fair share has its budget tightened while a floored tenant is being
+squeezed — the heavy tenant absorbs the shedding. Per-tenant
+`tenant=`-labeled families (`fleet_tenant_shed_total`,
+`fleet_tenant_admitted_tokens_total`, `fleet_tenant_share`,
+`fleet_tenant_floor_admits_total`) make the division auditable.
+
 Transport plane: `serve()` starts a pump thread consuming
 `<prefix>.requests` frames from a `streaming.Transport` and a relay
 thread fanning each stream's token chunks onto
@@ -36,6 +52,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
@@ -65,11 +82,27 @@ class FleetRouter:
                  weights: Optional[Dict[str, float]] = None,
                  transport=None, prefix: str = "fleet",
                  poll_s: float = 0.005,
-                 replica_pending_ttl_s: float = 0.75):
+                 replica_pending_ttl_s: float = 0.75,
+                 share_floors: Optional[Dict[str, float]] = None,
+                 share_window_s: float = 10.0):
         self.fleet = fleet
         self.slo_ttft_s = slo_ttft_s
         self.max_queue = max_queue
         self.weights = dict(weights or {})
+        # fair-share admission state: a sliding window of per-tenant
+        # offered/admitted token counts. `share_floors` maps tenant ->
+        # guaranteed fraction of admitted fleet work; the log is one
+        # deque of (t, name, n_tokens, admitted) with running totals so
+        # admitted_share() is O(expired entries), not O(window)
+        self.share_floors: Dict[str, float] = {}
+        self.share_window_s = float(share_window_s)
+        self._share_lock = threading.Lock()
+        self._share_log: deque = deque()
+        self._share_admitted: Dict[str, int] = {}
+        self._share_offered: Dict[str, int] = {}
+        self._share_admitted_total = 0
+        for k, v in (share_floors or {}).items():
+            self.set_share_floor(k, v)
         self.transport = transport
         self.prefix = prefix
         self.poll_s = float(poll_s)
@@ -123,6 +156,23 @@ class FleetRouter:
                     "fleet_output_requests_total",
                     "one-shot output() requests routed per model",
                     model=name),
+                "t_shed": lambda name: reg.counter(
+                    "fleet_tenant_shed_total",
+                    "requests shed per tenant by the fair-share "
+                    "admission policy", tenant=name),
+                "t_admitted": lambda name: reg.counter(
+                    "fleet_tenant_admitted_tokens_total",
+                    "generation tokens admitted per tenant",
+                    tenant=name),
+                "t_share": lambda name: reg.gauge(
+                    "fleet_tenant_share",
+                    "tenant's fraction of admitted fleet work over "
+                    "the share window", tenant=name),
+                "t_floor": lambda name: reg.counter(
+                    "fleet_tenant_floor_admits_total",
+                    "admissions granted under fair-share floor "
+                    "protection (projected-delay shed bypassed)",
+                    tenant=name),
             })
 
     def set_weight(self, name: str, weight: float):
@@ -132,6 +182,116 @@ class FleetRouter:
         if weight <= 0:
             raise ValueError(f"weight must be > 0; got {weight}")
         self.weights[name] = float(weight)
+
+    # --------------------------------------------------------- fair share
+    def set_share_floor(self, name: str, floor: float):
+        """Guarantee tenant `name` at least `floor` (a fraction in
+        [0, 1)) of the recently-admitted fleet work: while its admitted
+        share sits below the floor, the projected-delay shed is
+        bypassed for it (the per-tenant `max_queue` hard backstop still
+        applies). The sum of all floors must stay below 1 — the fleet
+        cannot guarantee more than itself."""
+        floor = float(floor)
+        if not 0.0 <= floor < 1.0:
+            raise ValueError(f"share floor must be in [0, 1); "
+                             f"got {floor}")
+        others = sum(v for k, v in self.share_floors.items()
+                     if k != name)
+        if others + floor >= 1.0:
+            raise ValueError(
+                f"share floors must sum below 1.0; {name!r} at "
+                f"{floor} would bring the total to {others + floor}")
+        self.share_floors[name] = floor
+
+    def _note_share(self, name: str, n_tokens: int, admitted: bool):
+        """Record one routing decision in the sliding share window and
+        refresh the tenant's share gauge."""
+        now = time.monotonic()
+        n = int(n_tokens)
+        with self._share_lock:
+            self._share_log.append((now, name, n, admitted))
+            self._share_offered[name] = \
+                self._share_offered.get(name, 0) + n
+            if admitted:
+                self._share_admitted[name] = \
+                    self._share_admitted.get(name, 0) + n
+                self._share_admitted_total += n
+            self._trim_share(now)
+        m = self._metrics()
+        if m is not None:
+            if admitted:
+                m["t_admitted"](name).inc(n)
+            m["t_share"](name).set(self.admitted_share(name))
+
+    def _trim_share(self, now: float):
+        """Drop window-expired entries (caller holds _share_lock)."""
+        cutoff = now - self.share_window_s
+        log_ = self._share_log
+        while log_ and log_[0][0] < cutoff:
+            _, nm, n, adm = log_.popleft()
+            left = self._share_offered.get(nm, 0) - n
+            if left > 0:
+                self._share_offered[nm] = left
+            else:
+                self._share_offered.pop(nm, None)
+            if adm:
+                left = self._share_admitted.get(nm, 0) - n
+                if left > 0:
+                    self._share_admitted[nm] = left
+                else:
+                    self._share_admitted.pop(nm, None)
+                self._share_admitted_total = max(
+                    0, self._share_admitted_total - n)
+
+    def admitted_share(self, name: str) -> float:
+        """Tenant's fraction of admitted fleet tokens over the share
+        window (0.0 when the window is empty)."""
+        with self._share_lock:
+            self._trim_share(time.monotonic())
+            if self._share_admitted_total <= 0:
+                return 0.0
+            return (self._share_admitted.get(name, 0)
+                    / self._share_admitted_total)
+
+    def _floor_protected(self, name: str) -> bool:
+        """True while `name` sits below its configured share floor —
+        its projected-delay shed is bypassed (it is being squeezed by
+        OTHER tenants' load on the shared device, not by itself)."""
+        floor = self.share_floors.get(name)
+        if floor is None:
+            return False
+        return self.admitted_share(name) < floor
+
+    def _overshare_scale(self, name: str) -> float:
+        """SLO-budget multiplier in (0, 1] for a tenant consuming more
+        than its weight-proportional fair share WHILE some floored
+        tenant with live demand is starved below its floor: the heavy
+        tenant's delay budget tightens by fair/actual (floored at 1/4)
+        so it sheds first and the floor-protected admissions have
+        capacity to land on."""
+        if not self.share_floors or self.fleet is None:
+            return 1.0
+        try:
+            names = self.fleet.names()
+        except Exception:  # noqa: BLE001 — fleet mid-teardown
+            return 1.0
+        if name not in names or len(names) < 2:
+            return 1.0
+        share = self.admitted_share(name)
+        wsum = sum(self.weights.get(n, 1.0) for n in names)
+        fair = (self.weights.get(name, 1.0) / wsum) if wsum > 0 else 1.0
+        if share <= fair:
+            return 1.0
+        with self._share_lock:
+            self._trim_share(time.monotonic())
+            offered = dict(self._share_offered)
+        starving = any(
+            n != name and offered.get(n, 0) > 0
+            and self.admitted_share(n) < f
+            for n, f in self.share_floors.items())
+        if not starving:
+            return 1.0
+        return max(0.25, fair / share)
 
     # ----------------------------------------------------------- resolve
     def _resolve(self, name: str):
@@ -167,11 +327,19 @@ class FleetRouter:
         if self.slo_ttft_s is not None and server._ewma_tok_s:
             # the serving tier's own projected-delay estimator, scaled
             # by the model's weight — fleet-wide pressure sheds the
-            # low-weight models first
-            budget = self.slo_ttft_s * self.weights.get(name, 1.0)
+            # low-weight models first. A tenant past its fair share
+            # while a floored tenant starves gets a TIGHTENED budget;
+            # a tenant below its floor bypasses the delay shed.
+            budget = (self.slo_ttft_s * self.weights.get(name, 1.0)
+                      * self._overshare_scale(name))
             projected = (self._outstanding_tokens(server)
                          / server._ewma_tok_s)
             if projected > budget:
+                if self._floor_protected(name):
+                    m = self._metrics()
+                    if m is not None:
+                        m["t_floor"](name).inc()
+                    return None
                 return (f"model {name!r} projected delay "
                         f"{projected:.2f}s exceeds its weighted "
                         f"{budget:.2f}s TTFT budget at "
@@ -207,10 +375,12 @@ class FleetRouter:
             if reason is not None:
                 if m is not None:
                     m["shed"](name).inc()
+                    m["t_shed"](name).inc()
                 if trace is not None:
                     # the router's shed decision, auditable per request
                     trace.event("shed", reason=reason, router=True)
                     trace.finish(status="shed")
+                self._note_share(name, n_tokens, admitted=False)
                 self._note_shed_burst(name, reason)
                 raise ShedError(reason)
             try:
@@ -232,6 +402,7 @@ class FleetRouter:
                 trace.annotate(version=version)
             if m is not None:
                 m["streams"](name).inc()
+            self._note_share(name, n_tokens, admitted=True)
             return stream
         raise RuntimeError(
             f"model {name!r} stayed in draining state across retries — "
@@ -317,9 +488,15 @@ class FleetRouter:
         if self.slo_ttft_s is not None and rate > 0:
             out = (int(load.get("outstanding_tokens") or 0)
                    + self.replica_pending(tok))
-            budget = self.slo_ttft_s * self.weights.get(name, 1.0)
+            budget = (self.slo_ttft_s * self.weights.get(name, 1.0)
+                      * self._overshare_scale(name))
             projected = out / rate
             if projected > budget:
+                if self._floor_protected(name):
+                    m = self._metrics()
+                    if m is not None:
+                        m["t_floor"](name).inc()
+                    return None
                 return (f"replica {tok} of {name!r} projected delay "
                         f"{projected:.2f}s exceeds its weighted "
                         f"{budget:.2f}s TTFT budget at {rate:.1f} tok/s")
@@ -365,9 +542,11 @@ class FleetRouter:
         except ShedError as e:
             if m is not None:
                 m["shed"](name).inc()
+                m["t_shed"](name).inc()
             if trace is not None:
                 trace.event("shed", reason=str(e), router=True)
                 trace.finish(status="shed")
+            self._note_share(name, n_tokens, admitted=False)
             self._note_shed_burst(name, str(e))
             raise
         except ReplicaLostError as e:
@@ -385,6 +564,7 @@ class FleetRouter:
             m["streams"](name).inc()
         if trace is not None:
             trace.annotate(replica=ms.replica)
+        self._note_share(name, n_tokens, admitted=True)
         return ms
 
     def _dispatch_replica(self, ms: "MigratingStream") -> None:

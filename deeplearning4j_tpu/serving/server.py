@@ -301,7 +301,17 @@ class GenerationServer(ParallelInference):
         # step. On a CPU-only sandbox this reproduces the device-bound
         # serving regime (host idle inside the step) that replica
         # fan-out and SLO tests are really about — it must never be
-        # set in production serving.
+        # set in production serving, so setting it requires the
+        # explicit sandbox opt-in (DL4J_SANDBOX_MODEL=1): a copied
+        # loadtest config can otherwise silently cap a production
+        # server's throughput at 1/dispatch_floor_s dispatches/s.
+        if dispatch_floor_s is not None \
+                and os.environ.get("DL4J_SANDBOX_MODEL") != "1":
+            raise ValueError(
+                "dispatch_floor_s emulates device-step latency and is "
+                "a sandbox-only seam — it must never be set in "
+                "production serving. Set DL4J_SANDBOX_MODEL=1 to "
+                "acknowledge this is a sandbox/loadtest process.")
         self.dispatch_floor_s = (None if dispatch_floor_s is None
                                  else float(dispatch_floor_s))
         self._pending: List = []          # admission order, after _queue

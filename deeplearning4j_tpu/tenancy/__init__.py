@@ -1,0 +1,15 @@
+"""Multi-tenant continuous learning: LoRA adapter deltas over a
+shared base model, per-tenant train→publish→swap.
+
+`lora` owns the adapter math and the `LoRAWeight` pytree node;
+`fleet` owns `TenantFleet`, the shared-base serving host. The publish
+unit is the adapter tree alone (kilobytes) — `ModelRegistry.
+publish_adapter` / `resolve_adapter` in serving/registry.py.
+"""
+
+from deeplearning4j_tpu.tenancy.lora import (  # noqa: F401
+    LoRAWeight, adapter_weight_keys, init_adapter, attach_adapter,
+    extract_adapter, strip_adapter, compose_params, adapter_bytes,
+    save_adapter, load_adapter, contains_lora,
+)
+from deeplearning4j_tpu.tenancy.fleet import TenantFleet  # noqa: F401

@@ -26,7 +26,7 @@ like the training metrics:
    upfront admission-concurrency A/B;
 3. deliberate overload proving the SLO shedding path fires.
 
-Hard asserts (exit nonzero — verify.sh step [10/18] runs --smoke):
+Hard asserts (exit nonzero — verify.sh step [10/19] runs --smoke):
 
 - greedy parity: every stream bit-equal to its whole-batch
   `generate()` row — fp phase AND quantized phase (vs
@@ -61,6 +61,21 @@ def build_net(vocab, d_model, n_layers, n_heads, max_len, seed=11):
     return TransformerLM(vocab_size=vocab, d_model=d_model,
                          n_layers=n_layers, n_heads=n_heads,
                          max_len=max_len, seed=seed).init()
+
+
+def clamp_to_waves(n, n_slots, label):
+    """Round a flood width DOWN to a multiple of one admission wave
+    (2 x n_slots). A ragged final half-wave measures slot-grid
+    underfill, not the serving plane — the scale-measurement gotcha
+    every flood phase used to dodge by hand-picked defaults is now
+    enforced with a logged note instead of remembered."""
+    wave = 2 * int(n_slots)
+    clamped = max(wave, (int(n) // wave) * wave)
+    if clamped != int(n):
+        print(f"note: {label} {n} -> {clamped} (clamped to a multiple "
+              f"of 2*n_slots={wave} so flood waves pack the slot grid "
+              f"exactly)")
+    return clamped
 
 
 def run_continuous(net, prompts, n_tokens, *, n_slots, n_blocks,
@@ -483,7 +498,7 @@ def run_fleet(args, *, metrics_check=False):
             f"successor must be warmed before the flip)")
 
     if metrics_check:
-        # the [12/18] acceptance surface: the fleet/registry gauge
+        # the [12/19] acceptance surface: the fleet/registry gauge
         # families must be live on /metrics
         import urllib.request
 
@@ -561,6 +576,12 @@ def run_replicated(args):
         ReplicaSet,
         spawn_replica,
     )
+
+    # each replica worker sets dispatch_floor_s (the emulated device-
+    # step floor) — a sandbox-only seam GenerationServer refuses
+    # outside a process that acknowledges it; subprocesses inherit the
+    # acknowledgement through the environment
+    os.environ["DL4J_SANDBOX_MODEL"] = "1"
 
     streams = args.replica_streams
     n_tok = 32
@@ -1400,7 +1421,7 @@ def run_overload(net, prompts, n_tokens, *, block_len):
 
 
 def run_spec_smoke(args):
-    """verify.sh [14/18]: the speculative + shared-prefix phases alone
+    """verify.sh [14/19]: the speculative + shared-prefix phases alone
     (hard asserts inside each), then proof that compare_bench gates
     the two new ledger metrics — including the structural
     stale-fallback band (sharing silently disabled reports ~1.0
@@ -1469,7 +1490,7 @@ def run_spec_smoke(args):
 
 
 def run_sampled_spec_smoke(args):
-    """verify.sh [17/18]: the sampled-speculation + truncated-drafter
+    """verify.sh [17/19]: the sampled-speculation + truncated-drafter
     + radix phases alone (hard asserts inside each — chi-square parity
     at the 1e-4 critical value, >=1.3x sampled-spec throughput at
     matched steps_per_dispatch, >=2x radix prefill reduction with ZERO
@@ -1563,7 +1584,7 @@ def run_sampled_spec_smoke(args):
 
 
 def run_trace_smoke(args):
-    """verify.sh [15/18]: the observability request plane end to end —
+    """verify.sh [15/19]: the observability request plane end to end —
     >= 64 routed requests each leaving a finished `RequestTrace` with
     monotonic queued -> prefill -> decode phase stamps, a two-objective
     SLO fleet driving BOTH good and bad counters non-zero, a mid-run
@@ -1761,7 +1782,7 @@ def run_trace_smoke(args):
 
 
 def run_alert_smoke(args):
-    """verify.sh [16/18]: the alert engine + goodput ledger end to end —
+    """verify.sh [16/19]: the alert engine + goodput ledger end to end —
     an injected overload drives `serving_shed_total` up and the
     shed-growth rule through firing -> resolved (after the drain), a
     vanished federation worker fires the absence rule and re-publishing
@@ -2004,12 +2025,12 @@ def main(argv=None):
                          "periods so the proposer can match inside "
                          "the prompt")
     ap.add_argument("--spec-smoke", action="store_true",
-                    help="verify.sh [14/18]: ONLY the speculative + "
+                    help="verify.sh [14/19]: ONLY the speculative + "
                          "shared-prefix phases at smoke scale, plus "
                          "compare_bench self-gates and the /metrics "
                          "families check")
     ap.add_argument("--sampled-spec-smoke", action="store_true",
-                    help="verify.sh [17/18]: ONLY the sampled-"
+                    help="verify.sh [17/19]: ONLY the sampled-"
                          "speculation + truncated-drafter + radix "
                          "phases at smoke scale, plus compare_bench "
                          "self-gates and the /metrics families check")
@@ -2029,16 +2050,16 @@ def main(argv=None):
     ap.add_argument("--skip-fleet", action="store_true",
                     help="run only the single-server phases 1-3")
     ap.add_argument("--fleet-smoke", action="store_true",
-                    help="verify.sh [12/18]: ONLY the fleet phase at "
+                    help="verify.sh [12/19]: ONLY the fleet phase at "
                          "smoke scale, plus the /metrics + /serving "
                          "acceptance checks")
     ap.add_argument("--trace-smoke", action="store_true",
-                    help="verify.sh [15/18]: ONLY the observability "
+                    help="verify.sh [15/19]: ONLY the observability "
                          "smoke — request-lifecycle traces, SLO "
                          "burn-rate, flight-recorder dump, federated "
                          "/metrics scrape")
     ap.add_argument("--alert-smoke", action="store_true",
-                    help="verify.sh [16/18]: ONLY the alert-engine + "
+                    help="verify.sh [16/19]: ONLY the alert-engine + "
                          "goodput smoke — overload-driven rule "
                          "firing/resolution, ledger conservation, "
                          "/alerts + /metrics surfaces, flight-recorder "
@@ -2056,7 +2077,7 @@ def main(argv=None):
     ap.add_argument("--skip-replicated", action="store_true",
                     help="skip the multi-process replicated phase")
     ap.add_argument("--replica-smoke", action="store_true",
-                    help="verify.sh [18/18]: ONLY the horizontal "
+                    help="verify.sh [18/19]: ONLY the horizontal "
                          "serving phase — 2-subprocess replica fleet, "
                          "greedy parity, mid-flood replica kill, "
                          "aggregate-throughput floor, disagg parity")
@@ -2072,6 +2093,12 @@ def main(argv=None):
         # waves pack the slot grid exactly, so the scale measurement
         # reflects the serving plane, not a ragged final half-wave
         args.replica_streams = min(args.replica_streams, 32)
+    # flood widths pack the slot grid in full waves — enforced, not
+    # just documented (the replicated phase runs n_slots=8 per replica)
+    args.fleet_streams = clamp_to_waves(args.fleet_streams,
+                                        args.n_slots, "--fleet-streams")
+    args.replica_streams = clamp_to_waves(args.replica_streams, 8,
+                                          "--replica-streams")
     if args.trace_smoke:
         return run_trace_smoke(args)
     if args.alert_smoke:
@@ -2125,6 +2152,8 @@ def main(argv=None):
         args.steps_per_dispatch = 12
         args.min_weight_reduction = 2.5
         args.spec_tokens = 24
+    args.streams = clamp_to_waves(args.streams, args.n_slots,
+                                  "--streams")
     if args.spec_epochs is None:
         args.spec_epochs = 40 if (args.smoke or args.spec_smoke
                                   or args.sampled_spec_smoke) else 30

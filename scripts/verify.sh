@@ -38,7 +38,7 @@
 #    params/updater state, bf16 gradients, and the fused-Adam Pallas
 #    kernel bit-comparable (inside jit) to the jnp updater path in
 #    interpret mode. The hlo_cost `precision` block (bf16 bytes <
-#    fp32 bytes) is asserted in step [4/18] where the reports are
+#    fp32 bytes) is asserted in step [4/19] where the reports are
 #    already on disk.
 # 9. Serving smoke: `scripts/serve_loadtest.py --smoke` — >=64
 #    concurrent streams continuously batched over the paged KV pool on
@@ -50,7 +50,7 @@
 #    request (SLO admission policy; `serving_shed_total`). The smoke
 #    ledger now also carries the mixed-length + int8-quantized phase
 #    and the incremental-vs-upfront admission A/B.
-# 10. Quantized-serving gate: re-asserts the [9/18] ledger's three
+# 10. Quantized-serving gate: re-asserts the [9/19] ledger's three
 #    perf-lever evidence fields (greedy parity exact fp AND int8,
 #    mixed-length wave admission, incremental >= 2x upfront
 #    concurrency, weight-byte reduction) and proves compare_bench
@@ -103,7 +103,7 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== [1/18] tier-1 tests (ROADMAP.md verbatim) =="
+echo "== [1/19] tier-1 tests (ROADMAP.md verbatim) =="
 # stale-report guard: a timeout-killed suite never reaches
 # pytest_sessionfinish, and step [2/3] must not read the previous
 # run's durations as this run's
@@ -111,7 +111,7 @@ rm -f "${DL4J_SUITE_DURATIONS:-/tmp/_t1_durations.json}"
 bash -c "set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=\${PIPESTATUS[0]}; echo DOTS_PASSED=\$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?\$' /tmp/_t1.log | tr -cd . | wc -c); exit \$rc"
 tier1_rc=$?
 
-echo "== [2/18] suite duration budget =="
+echo "== [2/19] suite duration budget =="
 python - <<'EOF'
 import json
 import os
@@ -138,7 +138,7 @@ if total > soft:
           "mark 'slow' the top offenders above before adding tests.")
 EOF
 
-echo "== [3/18] /metrics smoke =="
+echo "== [3/19] /metrics smoke =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import sys
 import urllib.request
@@ -180,7 +180,7 @@ print(f"/metrics smoke OK ({len(body.splitlines())} exposition lines, "
 EOF
 smoke_rc=$?
 
-echo "== [4/18] AOT cost smoke (hlo_cost --all) =="
+echo "== [4/19] AOT cost smoke (hlo_cost --all) =="
 hlo_out=$(mktemp -d)
 timeout -k 10 840 env JAX_PLATFORMS=cpu \
     python -m benchtools.hlo_cost --all --batch 8 --steps 2 --out "$hlo_out"
@@ -264,7 +264,7 @@ EOF
 hlo_rc=$?
 rm -rf "$hlo_out"
 
-echo "== [5/18] gradient-sharing smoke (dense vs threshold) =="
+echo "== [5/19] gradient-sharing smoke (dense vs threshold) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
     timeout -k 10 300 python - <<'PYEOF'
 import numpy as np
@@ -332,7 +332,7 @@ print(f"gradient-sharing smoke OK (init={init:.3f} dense={d:.3f} "
 PYEOF
 gs_rc=$?
 
-echo "== [6/18] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
+echo "== [6/19] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
 # train 30 steps on a tiny MLP in a child process, SIGTERM at step 15
 # (async checkpoint every 5, atomic tmp+fsync+rename commits), auto-
 # resume from the newest valid checkpoint, and require the final
@@ -341,7 +341,7 @@ echo "== [6/18] fault-drill smoke (kill@15 + auto-resume, bit parity) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python scripts/fault_drill.py --smoke
 drill_rc=$?
 
-echo "== [7/18] mixed-precision smoke (bf16 trajectory + fused-Adam parity) =="
+echo "== [7/19] mixed-precision smoke (bf16 trajectory + fused-Adam parity) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PYEOF'
 import jax
 import jax.numpy as jnp
@@ -430,7 +430,7 @@ print(f"mixed-precision smoke OK (init={init:.3f} fp32={d:.3f} "
 PYEOF
 mp_rc=$?
 
-echo "== [8/18] diagnostics smoke (watchdog drill + real UI feed) =="
+echo "== [8/19] diagnostics smoke (watchdog drill + real UI feed) =="
 JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PYEOF'
 import urllib.request
 
@@ -521,17 +521,17 @@ print(f"diagnostics smoke OK (skipped={net._diag.skipped_total}, "
 PYEOF
 diag_rc=$?
 
-echo "== [9/18] serving smoke (continuous batching, parity + SLO shed) =="
+echo "== [9/19] serving smoke (continuous batching, parity + SLO shed) =="
 serving_out=$(mktemp /tmp/_serving_smoke_XXXX.json)
-# --skip-fleet: the fleet tier gets its own dedicated [12/18] smoke —
+# --skip-fleet: the fleet tier gets its own dedicated [12/19] smoke —
 # running it twice would double the warmup-grid compile cost
 JAX_PLATFORMS=cpu timeout -k 10 420 \
     python scripts/serve_loadtest.py --smoke --skip-fleet \
     --out "$serving_out"
 serving_rc=$?
 
-echo "== [10/18] quantized-serving gate (ledger + compare_bench) =="
-# the smoke ledger [9/18] just wrote carries the quantized / mixed-
+echo "== [10/19] quantized-serving gate (ledger + compare_bench) =="
+# the smoke ledger [9/19] just wrote carries the quantized / mixed-
 # length / incremental-allocation phase: re-assert the three levers'
 # evidence HERE (independent of the loadtest's own exit code) and
 # prove compare_bench gates them — including the structural stale-
@@ -577,7 +577,7 @@ assert v["status"] == "regression" and any(
     r["metric"] == "serving_mixed_p50_ttft_ms"
     for r in v["regressions"]), v
 # fleet gate wiring (the committed ledger carries the real block; the
-# live fleet drill runs in [12/18]): a sustained-concurrency collapse
+# live fleet drill runs in [12/19]): a sustained-concurrency collapse
 # gates through the structural band, a swap-window TTFT RISE gates
 # through the lower-is-better inversion
 fl = {"platform": "cpu-sandbox", "value": 1.0,
@@ -604,7 +604,7 @@ EOF
 qgate_rc=$?
 rm -f "$serving_out"
 
-echo "== [11/18] elastic-drill smoke (SIGKILL shrink + grow, membership) =="
+echo "== [11/19] elastic-drill smoke (SIGKILL shrink + grow, membership) =="
 # 4 gloo worker processes under the membership coordinator; SIGKILL
 # one at step ~15 (shrink to a re-formed 3-process mesh, resumed from
 # the newest valid checkpoint with re-sharded threshold residual/τ),
@@ -616,7 +616,7 @@ JAX_PLATFORMS=cpu timeout -k 10 560 \
     python scripts/fault_drill.py --elastic-smoke
 elastic_rc=$?
 
-echo "== [12/18] fleet smoke (registry, hot-swap, router, autoscale) =="
+echo "== [12/19] fleet smoke (registry, hot-swap, router, autoscale) =="
 # two tiny models published into the registry, 128+ streams through
 # the router, mid-run hot-swap of alpha (warmed successor -> pointer
 # flip -> incumbent drain): zero dropped streams, version-tagged
@@ -626,7 +626,7 @@ JAX_PLATFORMS=cpu timeout -k 10 560 \
     python scripts/serve_loadtest.py --fleet-smoke
 fleet_rc=$?
 
-echo "== [13/18] online-learning smoke (firehose train -> publish -> hot-swap) =="
+echo "== [13/19] online-learning smoke (firehose train -> publish -> hot-swap) =="
 # TransformerLM continuously fine-tuning from a local firehose
 # (StreamingDataSetIterator over LocalLogTransport) while a
 # FleetServer hot-swaps to each published snapshot under live decode
@@ -642,7 +642,7 @@ JAX_PLATFORMS=cpu timeout -k 10 560 \
     python scripts/online_loop.py --smoke
 online_rc=$?
 
-echo "== [14/18] speculative + shared-prefix CoW smoke (parity, accept, gates) =="
+echo "== [14/19] speculative + shared-prefix CoW smoke (parity, accept, gates) =="
 # Draft-accept speculative decoding + copy-on-write shared-prefix
 # block reuse (docs/SERVING.md). Hard asserts inside the script:
 # speculative greedy BIT-equal to vanilla greedy (the acceptance
@@ -659,7 +659,7 @@ JAX_PLATFORMS=cpu timeout -k 10 420 \
     python scripts/serve_loadtest.py --spec-smoke
 spec_rc=$?
 
-echo "== [15/18] trace/observability smoke (request traces, SLO burn, flight dump, federation) =="
+echo "== [15/19] trace/observability smoke (request traces, SLO burn, flight dump, federation) =="
 # The observability request plane end to end (docs/OBSERVABILITY.md):
 # >= 64 routed requests each leaving a finished RequestTrace with
 # monotonic queued -> prefill -> decode phase stamps, a two-objective
@@ -672,7 +672,7 @@ JAX_PLATFORMS=cpu timeout -k 10 420 \
     python scripts/serve_loadtest.py --trace-smoke
 trace_rc=$?
 
-echo "== [16/18] alert + goodput smoke (rule pack, ledger conservation, /alerts) =="
+echo "== [16/19] alert + goodput smoke (rule pack, ledger conservation, /alerts) =="
 # The alert engine + goodput ledger end to end (docs/OBSERVABILITY.md
 # "Alert engine" / "Goodput ledger"): the default rule pack evaluated
 # clean against a healthy two-worker aggregator, shed-growth firing
@@ -688,7 +688,7 @@ JAX_PLATFORMS=cpu timeout -k 10 420 \
     python scripts/serve_loadtest.py --alert-smoke
 alert_rc=$?
 
-echo "== [17/18] sampled-spec + truncated-drafter + radix smoke (chi-square, accept, dedup, gates) =="
+echo "== [17/19] sampled-spec + truncated-drafter + radix smoke (chi-square, accept, dedup, gates) =="
 # Rejection-sampled speculation + truncated-layer drafter + radix
 # prefix cache (docs/SERVING.md). Hard asserts inside the script:
 # greedy-subset streams BIT-equal to vanilla generate() under
@@ -710,7 +710,7 @@ JAX_PLATFORMS=cpu timeout -k 10 560 \
     python scripts/serve_loadtest.py --sampled-spec-smoke
 sspec_rc=$?
 
-echo "== [18/18] replicated-serving smoke (2-process fleet, balance, kill drill, disagg) =="
+echo "== [18/19] replicated-serving smoke (2-process fleet, balance, kill drill, disagg) =="
 # Horizontal serving (docs/SERVING.md "Horizontal serving"): a
 # 2-subprocess replica fleet registered through the elastic
 # coordinator, floods routed by the FleetRouter's least-loaded
@@ -728,8 +728,27 @@ JAX_PLATFORMS=cpu timeout -k 10 560 \
     python scripts/serve_loadtest.py --replica-smoke
 replica_rc=$?
 
-echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc} mp_rc=${mp_rc} diag_rc=${diag_rc} serving_rc=${serving_rc} qgate_rc=${qgate_rc} elastic_rc=${elastic_rc} fleet_rc=${fleet_rc} online_rc=${online_rc} spec_rc=${spec_rc} trace_rc=${trace_rc} alert_rc=${alert_rc} sspec_rc=${sspec_rc} replica_rc=${replica_rc}"
-if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ] || [ "$mp_rc" -ne 0 ] || [ "$diag_rc" -ne 0 ] || [ "$serving_rc" -ne 0 ] || [ "$qgate_rc" -ne 0 ] || [ "$elastic_rc" -ne 0 ] || [ "$fleet_rc" -ne 0 ] || [ "$online_rc" -ne 0 ] || [ "$spec_rc" -ne 0 ] || [ "$trace_rc" -ne 0 ] || [ "$alert_rc" -ne 0 ] || [ "$sspec_rc" -ne 0 ] || [ "$replica_rc" -ne 0 ]; then
+echo "== [19/19] multi-tenant smoke (adapter deltas, shared base, fair-share) =="
+# Multi-tenant continuous learning (docs/SERVING.md "Multi-tenant"):
+# 3 tenants train LoRA adapters on their own online streams against
+# ONE frozen shared base, publish delta-only artifacts (< 5% of the
+# full zip) and hot-swap them into a TenantFleet under live traffic.
+# Hard asserts inside the script: shared_base_copies == 1, the base
+# params bit-identical after all adapter training, zero dropped
+# streams across mid-traffic swaps with version-tagged greedy parity
+# (>= 2 adapter versions served per tenant), the drifted tenant's
+# gate trips + pauses publishes while the others keep publishing, a
+# cursor()/seek() membership change mid-consumption loses/replays no
+# batch, the 10:1 fair-share flood holds the light tenant's floor
+# while the heavy tenant absorbs the shedding, tenant-labeled
+# fleet_tenant_* + adapter-publish families live on /metrics, and
+# compare_bench gates the tenant_* metrics.
+JAX_PLATFORMS=cpu timeout -k 10 560 \
+    python scripts/tenant_loadtest.py --smoke --out /tmp/tenant_smoke.json
+tenant_rc=$?
+
+echo "tier1_rc=${tier1_rc} metrics_smoke_rc=${smoke_rc} hlo_run_rc=${hlo_run_rc} hlo_smoke_rc=${hlo_rc} gs_rc=${gs_rc} drill_rc=${drill_rc} mp_rc=${mp_rc} diag_rc=${diag_rc} serving_rc=${serving_rc} qgate_rc=${qgate_rc} elastic_rc=${elastic_rc} fleet_rc=${fleet_rc} online_rc=${online_rc} spec_rc=${spec_rc} trace_rc=${trace_rc} alert_rc=${alert_rc} sspec_rc=${sspec_rc} replica_rc=${replica_rc} tenant_rc=${tenant_rc}"
+if [ "$tier1_rc" -ne 0 ] || [ "$smoke_rc" -ne 0 ] || [ "$hlo_run_rc" -ne 0 ] || [ "$hlo_rc" -ne 0 ] || [ "$gs_rc" -ne 0 ] || [ "$drill_rc" -ne 0 ] || [ "$mp_rc" -ne 0 ] || [ "$diag_rc" -ne 0 ] || [ "$serving_rc" -ne 0 ] || [ "$qgate_rc" -ne 0 ] || [ "$elastic_rc" -ne 0 ] || [ "$fleet_rc" -ne 0 ] || [ "$online_rc" -ne 0 ] || [ "$spec_rc" -ne 0 ] || [ "$trace_rc" -ne 0 ] || [ "$alert_rc" -ne 0 ] || [ "$sspec_rc" -ne 0 ] || [ "$replica_rc" -ne 0 ] || [ "$tenant_rc" -ne 0 ]; then
     exit 1
 fi
 echo "VERIFY OK"

@@ -12,6 +12,9 @@ Contracts:
   recorder under the rule's own event kind;
 - `delta_rate`'s `unless_metric` suppresses a breach that a guard
   counter explains (a swap during a rollout is not an incident);
+- `delta_rate`'s `only_if_metric` is the mirror image: the breach only
+  counts when the co-metric ALSO increased (tenant shed growth is
+  starvation only while the fleet keeps doing useful work);
 - `burn_rate` averages engine-held history per window, ALL windows
   breaching;
 - the default rule pack evaluates clean (all ok) on a healthy registry;
@@ -231,6 +234,35 @@ class TestDeltaRate:
         assert state_of(eng.evaluate(now=20.0),
                         "swap-no-pub") == "firing"
 
+    def test_only_if_metric_requires_co_increase(self):
+        box = {"shed": 0.0, "useful": 0.0}
+
+        def snap():
+            return {
+                "fleet_tenant_shed_total": {
+                    "type": "counter", "help": "",
+                    "values": [{"labels": {"tenant": "a"},
+                                "value": box["shed"]}]},
+                "serving_tokens_useful_total": {
+                    "type": "counter", "help": "",
+                    "values": [{"labels": {}, "value": box["useful"]}]}}
+
+        eng, _ = make_engine(
+            snap,
+            AlertRule(name="starved", kind="delta_rate",
+                      metric="fleet_tenant_shed_total", op=">",
+                      value=1.0, aggregate="sum",
+                      only_if_metric="serving_tokens_useful_total"))
+        eng.evaluate(now=0.0)
+        box["shed"] += 100                    # sheds grow, goodput flat:
+        states = eng.evaluate(now=10.0)       # the fleet ISN'T healthy —
+        assert state_of(states, "starved") == "ok"   # not starvation
+        st = next(s for s in states if s["name"] == "starved")
+        assert st["context"]["only_if_increase"] == 0.0
+        box["shed"] += 100                    # sheds grow AND the fleet
+        box["useful"] += 500                  # keeps serving: starvation
+        assert state_of(eng.evaluate(now=20.0), "starved") == "firing"
+
 
 # =========================================================== burn_rate
 class TestBurnRate:
@@ -297,13 +329,14 @@ class TestDefaultRulePack:
         reg.gauge("serving_spec_accept_rate", proposer="ngram").set(0.8)
         return reg
 
-    def test_pack_covers_the_ten_documented_shapes(self):
+    def test_pack_covers_the_twelve_documented_shapes(self):
         pack = default_rule_pack()
         assert sorted(r.name for r in pack) == [
-            "checkpoint-staleness", "elastic-shrink",
-            "radix-eviction-churn", "registry-fallback",
-            "sampled-spec-acceptance-collapse", "shed-growth",
-            "slo-burn", "swap-without-publish", "watermark-lag",
+            "checkpoint-staleness", "drift-gate-stuck-paused",
+            "elastic-shrink", "radix-eviction-churn",
+            "registry-fallback", "sampled-spec-acceptance-collapse",
+            "shed-growth", "slo-burn", "swap-without-publish",
+            "tenant-share-starvation", "watermark-lag",
             "worker-vanished"]
         assert len({r.event_kind for r in pack}) == len(pack)
 
@@ -342,6 +375,30 @@ class TestDefaultRulePack:
         assert state_of(states,
                         "sampled-spec-acceptance-collapse") == "firing"
         assert rec.events(kind="spec_acceptance_collapse")
+
+    def test_pack_fires_on_drift_gate_stuck_paused(self):
+        reg = self.healthy_registry()
+        reg.gauge("online_publish_paused", tag="tenant-beta").set(1.0)
+        eng, rec = make_engine(reg, *default_rule_pack(
+            drift_paused_for_s=5.0))
+        states = eng.evaluate(now=0.0)       # breach seen, hysteresis
+        assert state_of(states, "drift-gate-stuck-paused") == "pending"
+        states = eng.evaluate(now=10.0)      # held past for_s -> fire
+        assert state_of(states, "drift-gate-stuck-paused") == "firing"
+        assert rec.events(kind="drift_gate_stuck")
+
+    def test_pack_fires_on_tenant_share_starvation(self):
+        reg = self.healthy_registry()
+        shed = reg.counter("fleet_tenant_shed_total", "sheds",
+                           tenant="gamma")
+        useful = reg.counter("serving_tokens_useful_total", "useful")
+        eng, rec = make_engine(reg, *default_rule_pack())
+        eng.evaluate(now=0.0)                # prime the delta cursors
+        shed.inc(100)                        # 10/s >> 1/s bound...
+        useful.inc(500)                      # ...while goodput flows
+        states = eng.evaluate(now=10.0)
+        assert state_of(states, "tenant-share-starvation") == "firing"
+        assert rec.events(kind="tenant_starvation")
 
 
 # ====================================================== gauge publish

@@ -1,0 +1,335 @@
+"""Multi-tenant LoRA tenancy (ISSUE: continuous-learning fleet).
+
+Contracts:
+
+- adapter serde round-trips bit-exact (file and registry forms), the
+  registry artifact is a small fraction of the full model zip, and
+  adapter retention never collects a pinned (served) version;
+- `frozen=True` adapter training moves ONLY the adapter: every base
+  leaf — wrapped matmuls, biases, norms, embeddings — is bit-identical
+  after fit, and the adapter factors actually move;
+- a zero-initialized adapter (B = 0) composes to the base function:
+  greedy generation is bit-equal with the adapter on or off, on both
+  the train-side (`attach_adapter`) and serve-side (`compose_params`)
+  composition paths;
+- `TenantFleet.composed_params` caches per (base version, adapter
+  version, quantize mode) and invalidates when the base net's params
+  tree is REASSIGNED (fit()/restore — the `quant.serving_params`
+  identity pattern);
+- fair-share admission: under a seeded 10:1 admitted-share skew the
+  light tenant is floor-protected (projected-delay shed bypassed) and
+  the heavy tenant's TTFT budget tightens, so the heavy tenant sheds
+  first; floors are validated (range, sum < 1);
+- `GenerationServer(dispatch_floor_s=...)` is a sandbox-only seam: it
+  refuses to construct unless DL4J_SANDBOX_MODEL=1 acknowledges it.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import FleetRouter, ModelRegistry
+from deeplearning4j_tpu.tenancy import TenantFleet, lora
+
+
+def tiny_lm(seed=7):
+    from deeplearning4j_tpu.zoo.transformer import TransformerLM
+    return TransformerLM(vocab_size=12, d_model=16, n_layers=1,
+                         n_heads=2, max_len=12, seed=seed).init()
+
+
+def leaf_bytes(params):
+    return {(lk, pk): np.asarray(w).tobytes()
+            for lk, lv in params.items() for pk, w in lv.items()}
+
+
+def fit_once(lm, steps=2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 12, (4, 8)).astype(np.float32)
+    y = np.eye(12, dtype=np.float32)[rng.integers(0, 12, (4, 8))]
+    for _ in range(steps):
+        lm.fit(x, y, epochs=1, batch_size=4, shuffle=False)
+
+
+# ========================================================== serde
+class TestAdapterSerde:
+    def test_file_round_trip_bit_exact(self, tmp_path):
+        lm = tiny_lm()
+        ad = lora.init_adapter(lm, rank=2, seed=3)
+        p = tmp_path / "adapter.zip"
+        lora.save_adapter(p, ad, meta={"rank": 2, "alpha": 4.0})
+        back, meta = lora.load_adapter(p)
+        assert meta["rank"] == 2 and meta["alpha"] == 4.0
+        for lk, lv in ad.items():
+            for pk, ba in lv.items():
+                got = back[str(lk)] if str(lk) in back else back[lk]
+                assert np.asarray(got[pk]["B"]).tobytes() \
+                    == np.asarray(ba["B"]).tobytes()
+                assert np.asarray(got[pk]["A"]).tobytes() \
+                    == np.asarray(ba["A"]).tobytes()
+
+    def test_registry_round_trip_and_artifact_fraction(self, tmp_path):
+        lm = tiny_lm()
+        reg = ModelRegistry(str(tmp_path))
+        base_v = reg.publish("m", lm)
+        ad = lora.init_adapter(lm, rank=1, seed=1)
+        v = reg.publish_adapter("m", "acme", ad, base_version=base_v,
+                                rank=1, alpha=2.0)
+        back, meta, got_v = reg.resolve_adapter("m", "acme", v)
+        assert got_v == v
+        assert meta["base_version"] == base_v
+        assert meta["rank"] == 1 and meta["alpha"] == 2.0
+        # the delta artifact ships kilobytes, not a model zip
+        full = reg.path("m", base_v).stat().st_size
+        delta = reg.adapter_path("m", "acme", v).stat().st_size
+        assert delta < 0.25 * full
+        flat_ad = {(str(lk), pk): np.asarray(ba["B"]).tobytes()
+                   for lk, lv in ad.items() for pk, ba in lv.items()}
+        flat_back = {(str(lk), pk): np.asarray(ba["B"]).tobytes()
+                     for lk, lv in back.items()
+                     for pk, ba in lv.items()}
+        assert flat_ad == flat_back
+
+    def test_retention_never_collects_pinned(self, tmp_path):
+        lm = tiny_lm()
+        reg = ModelRegistry(str(tmp_path), keep_last=2)
+        base_v = reg.publish("m", lm)
+        ad = lora.init_adapter(lm, rank=1)
+        v1 = reg.publish_adapter("m", "acme", ad, base_version=base_v,
+                                 rank=1, alpha=2.0)
+        reg.pin_adapter("m", "acme", v1)
+        for _ in range(3):
+            last = reg.publish_adapter("m", "acme", ad,
+                                       base_version=base_v, rank=1,
+                                       alpha=2.0)
+        # v1 is pinned (served) — retention must keep it; the unpinned
+        # middle versions age out to keep_last
+        assert reg.adapter_path("m", "acme", v1).exists()
+        assert v1 in reg.adapter_versions("m", "acme")
+        assert last in reg.adapter_versions("m", "acme")
+        assert len(reg.adapter_versions("m", "acme")) <= 3
+        reg.unpin_adapter("m", "acme", v1)
+
+
+# ============================================== frozen-base training
+class TestFrozenBaseTraining:
+    def test_frozen_fit_moves_only_the_adapter(self):
+        lm = tiny_lm()
+        fit_once(lm)                      # past any init-step effects
+        before = leaf_bytes(lm.params)
+        ad = lora.init_adapter(lm, rank=1, seed=5)
+        lora.attach_adapter(lm, ad, rank=1, alpha=2.0, frozen=True)
+        fit_once(lm, steps=3, seed=1)
+        trained = lora.extract_adapter(lm)
+        moved = any(float(np.abs(np.asarray(ba["B"])).sum()) > 0
+                    for lv in trained.values() for ba in lv.values())
+        assert moved, "adapter factors never moved"
+        lora.strip_adapter(lm)
+        # EVERY base leaf — wrapped matmuls, biases, norms,
+        # embeddings — is bit-identical
+        assert leaf_bytes(lm.params) == before
+
+    def test_unfrozen_fit_moves_the_base(self):
+        lm = tiny_lm()
+        fit_once(lm)
+        before = leaf_bytes(lm.params)
+        ad = lora.init_adapter(lm, rank=1, seed=5)
+        lora.attach_adapter(lm, ad, rank=1, alpha=2.0, frozen=False)
+        fit_once(lm, steps=3, seed=1)
+        lora.strip_adapter(lm)
+        assert leaf_bytes(lm.params) != before
+
+
+# ===================================================== on/off parity
+class TestAdapterParity:
+    def test_zero_adapter_is_the_base_function(self):
+        from deeplearning4j_tpu.zoo.transformer import generate
+        lm = tiny_lm()
+        fit_once(lm)
+        prompts = np.stack([np.arange(4) % 12, (np.arange(4) + 3) % 12])
+        ref = np.asarray(generate(lm, prompts, 6, temperature=0))
+        # train-side composition: B is zero-init, delta is exactly 0
+        ad = lora.init_adapter(lm, rank=2, seed=9)
+        lora.attach_adapter(lm, ad, rank=2, alpha=4.0, frozen=True)
+        on = np.asarray(generate(lm, prompts, 6, temperature=0))
+        lora.strip_adapter(lm)
+        assert np.array_equal(ref, on)
+        # serve-side composition path (LoRAWeight over the raw tree)
+        composed = lora.compose_params(lm.params, ad, rank=2, alpha=4.0)
+        old = lm.params
+        try:
+            lm.params = composed
+            served = np.asarray(generate(lm, prompts, 6, temperature=0))
+        finally:
+            lm.params = old
+        assert np.array_equal(ref, served)
+
+    def test_trained_adapter_changes_the_function(self):
+        # probs, not greedy tokens: a few rank-2 steps reliably move
+        # the distribution but need not flip a tiny model's argmax
+        lm = tiny_lm()
+        fit_once(lm)
+        prompts = np.stack([np.arange(4) % 12]).astype(np.float32)
+        ref = np.asarray(lm.output(prompts))
+        ad = lora.init_adapter(lm, rank=2, seed=9)
+        lora.attach_adapter(lm, ad, rank=2, alpha=4.0, frozen=True)
+        fit_once(lm, steps=6, seed=2)
+        on = np.asarray(lm.output(prompts))
+        lora.strip_adapter(lm)
+        off = np.asarray(lm.output(prompts))
+        assert np.array_equal(ref, off)   # stripping restores the base
+        assert not np.array_equal(ref, on)
+
+
+# ============================================== composed-params cache
+class TestComposedParamsCache:
+    def make_fleet(self, tmp_path):
+        lm = tiny_lm()
+        reg = ModelRegistry(str(tmp_path))
+        base_v = reg.publish("m", lm)
+        ad = lora.init_adapter(lm, rank=1)
+        reg.publish_adapter("m", "acme", ad, base_version=base_v,
+                            rank=1, alpha=2.0)
+        return TenantFleet(reg, "m"), reg, ad
+
+    def test_cache_hit_and_identity_invalidation(self, tmp_path):
+        fleet, reg, ad = self.make_fleet(tmp_path)
+        try:
+            t1 = fleet.composed_params("acme", ad, 1, rank=1, alpha=2.0)
+            t2 = fleet.composed_params("acme", ad, 1, rank=1, alpha=2.0)
+            assert t1 is t2               # cache hit
+            # fit()/restore reassigns the base net's params tree — the
+            # identity check must invalidate every tenant's composition
+            fleet.base_net.params = {lk: dict(lv) for lk, lv
+                                     in fleet.base_net.params.items()}
+            t3 = fleet.composed_params("acme", ad, 1, rank=1, alpha=2.0)
+            assert t3 is not t1
+        finally:
+            fleet.stop()
+
+    def test_adapter_version_bump_invalidates(self, tmp_path):
+        fleet, reg, ad = self.make_fleet(tmp_path)
+        try:
+            t1 = fleet.composed_params("acme", ad, 1, rank=1, alpha=2.0)
+            t2 = fleet.composed_params("acme", ad, 2, rank=1, alpha=2.0)
+            assert t2 is not t1
+            # the composed tree shares base leaves BY REFERENCE
+            base_ids = {id(w) for lv in fleet.base_net.params.values()
+                        for w in lv.values()}
+            for lv in t1.values():
+                for w in lv.values():
+                    if isinstance(w, lora.LoRAWeight):
+                        assert id(w.base) in base_ids
+        finally:
+            fleet.stop()
+
+
+# ================================================== fair-share floor
+class _FakeServer:
+    """Just enough surface for FleetRouter._should_shed: a congested
+    queue-and-throughput snapshot."""
+
+    def __init__(self, outstanding=400, ewma=100.0, depth=0,
+                 queued=0):
+        self._outstanding = outstanding
+        self._ewma_tok_s = ewma
+        self._depth = depth
+        self.queued_tokens = queued
+
+    def queue_depth(self):
+        return self._depth
+
+    def _outstanding_tokens(self):
+        return self._outstanding
+
+
+class _FakeFleet:
+    def __init__(self, servers):
+        self.servers = servers
+
+    def names(self):
+        return list(self.servers)
+
+    def has(self, name):
+        return name in self.servers
+
+    def active(self, name):
+        return self.servers[name], 1
+
+
+class TestFairShareAdmission:
+    def seeded_router(self, **kw):
+        fleet = _FakeFleet({"heavy": _FakeServer(),
+                            "light": _FakeServer()})
+        router = FleetRouter(fleet, slo_ttft_s=0.5,
+                             share_floors={"light": 0.3},
+                             share_window_s=60.0, **kw)
+        # seed a 10:1 admitted skew through the real accounting path
+        for _ in range(10):
+            router._note_share("heavy", 100, admitted=True)
+        router._note_share("light", 100, admitted=True)
+        return router, fleet
+
+    def test_floor_protects_light_and_tightens_heavy(self):
+        router, fleet = self.seeded_router()
+        assert router.admitted_share("heavy") == pytest.approx(10 / 11)
+        assert router.admitted_share("light") == pytest.approx(1 / 11)
+        # light sits below its floor WITH live offered demand
+        assert router._floor_protected("light")
+        assert not router._floor_protected("heavy")
+        # the heavy tenant is past its fair share (1/2) while a
+        # floored tenant starves: budget tightens toward fair/share
+        scale = router._overshare_scale("heavy")
+        assert scale == pytest.approx(max(0.25, 0.5 / (10 / 11)))
+        assert router._overshare_scale("light") == 1.0
+        # both servers look equally congested (projected delay 4s >>
+        # 0.5s budget) — the heavy tenant sheds, the light does not
+        assert router._should_shed("heavy",
+                                   fleet.servers["heavy"]) is not None
+        assert router._should_shed("light",
+                                   fleet.servers["light"]) is None
+
+    def test_max_queue_backstop_applies_even_under_floor(self):
+        router, fleet = self.seeded_router(max_queue=4)
+        congested = _FakeServer(depth=10)
+        assert router._should_shed("light", congested) is not None
+
+    def test_idle_floored_tenant_does_not_tighten_heavy(self):
+        fleet = _FakeFleet({"heavy": _FakeServer(),
+                            "light": _FakeServer()})
+        router = FleetRouter(fleet, slo_ttft_s=0.5,
+                             share_floors={"light": 0.3},
+                             share_window_s=60.0)
+        for _ in range(10):
+            router._note_share("heavy", 100, admitted=True)
+        # light never OFFERED work in the window — heavy's overshare
+        # is nobody's starvation, its budget stays whole
+        assert router._overshare_scale("heavy") == 1.0
+
+    def test_floor_validation(self):
+        router = FleetRouter()
+        with pytest.raises(ValueError):
+            router.set_share_floor("a", 1.2)
+        with pytest.raises(ValueError):
+            router.set_share_floor("a", -0.1)
+        router.set_share_floor("a", 0.5)
+        with pytest.raises(ValueError, match="sum"):
+            router.set_share_floor("b", 0.6)
+
+
+# ============================================ dispatch-floor guard
+class TestDispatchFloorGuard:
+    def test_refuses_outside_sandbox(self, monkeypatch):
+        from deeplearning4j_tpu.serving import GenerationServer
+        monkeypatch.delenv("DL4J_SANDBOX_MODEL", raising=False)
+        lm = tiny_lm()
+        with pytest.raises(ValueError, match="sandbox"):
+            GenerationServer(lm, n_slots=2, n_blocks=9, block_len=4,
+                             dispatch_floor_s=0.001)
+
+    def test_env_acknowledges_sandbox(self, monkeypatch):
+        from deeplearning4j_tpu.serving import GenerationServer
+        monkeypatch.setenv("DL4J_SANDBOX_MODEL", "1")
+        lm = tiny_lm()
+        s = GenerationServer(lm, n_slots=2, n_blocks=9, block_len=4,
+                             dispatch_floor_s=0.001)
+        assert s.dispatch_floor_s == 0.001
